@@ -1,0 +1,42 @@
+"""mxnet_tpu — a TPU-native deep-learning framework with the capabilities of
+Apache MXNet (~0.11, NNVM era), re-architected for JAX/XLA/Pallas/pjit.
+
+Blueprint: SURVEY.md at the repo root. Mapping of the reference's layers:
+  ThreadedEngine/GraphExecutor/PlanMemory  -> jax.jit + XLA (async, fused)
+  mshadow/CUDA kernels                     -> jnp/lax (+ Pallas hot ops)
+  KVStore comm trees + ps-lite             -> XLA collectives over the mesh
+  Module/Gluon/NDArray/Symbol user surface -> preserved API, same semantics
+"""
+__version__ = "0.1.0"
+
+import os as _os
+
+import jax as _jax
+
+# MXNet semantics: float32 arrays mean float32 math. JAX's DEFAULT matmul
+# precision lowers f32 matmuls to bf16 passes on TPU; we keep reference
+# numerics for f32 and get MXU speed by using bf16 *dtypes* on the perf path
+# (the reference's multi-precision story, mp_sgd_*, maps to this).
+# Override with MXNET_MATMUL_PRECISION=default|high|highest.
+_prec = _os.environ.get("MXNET_MATMUL_PRECISION", "highest")
+if _prec != "default":
+    _jax.config.update("jax_default_matmul_precision",
+                       {"high": "bfloat16_3x", "highest": "float32"}.get(
+                           _prec, _prec))
+
+from . import base
+from .base import MXNetError
+
+from . import context
+from .context import Context, cpu, gpu, tpu, cpu_pinned, current_context
+
+from . import ops  # populates the operator registry
+
+from . import ndarray
+from . import ndarray as nd
+from .ndarray import NDArray
+
+from . import random
+from . import random as rnd
+
+from . import autograd

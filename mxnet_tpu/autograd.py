@@ -1,0 +1,339 @@
+"""Imperative autograd — tape over per-op ``jax.vjp``.
+
+Reference: ``python/mxnet/autograd.py`` + the C++ AutogradRuntime
+(``src/ndarray/autograd.cc``): recording attaches AGNode history to output
+NDArrays; ``backward`` re-symbolizes the tape and binds a temp GraphExecutor.
+
+TPU-native design: each recorded op stores the vjp closure produced by
+``jax.vjp`` at forward time (residuals live on device, scheduled by XLA).
+``backward`` is a reverse-topological sweep calling those closures — no graph
+re-binding, no executor. Gradients land in the arrays attached via
+``attach_grad``/``mark_variables``, honoring grad_req write/add/null.
+"""
+from __future__ import annotations
+
+import threading
+
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = ["record", "pause", "train_mode", "predict_mode", "is_recording",
+           "is_training", "set_recording", "set_training", "mark_variables",
+           "backward", "grad", "get_symbol", "Function"]
+
+_state = threading.local()
+
+
+def _st():
+    if not hasattr(_state, "recording"):
+        _state.recording = False
+        _state.training = False
+    return _state
+
+
+def is_recording():
+    return _st().recording
+
+
+def is_training():
+    return _st().training
+
+
+def set_recording(is_record):
+    prev = _st().recording
+    _state.recording = bool(is_record)
+    return prev
+
+
+def set_training(train_mode_):
+    prev = _st().training
+    _state.training = bool(train_mode_)
+    return prev
+
+
+class _Scope:
+    def __init__(self, recording=None, training=None):
+        self._recording = recording
+        self._training = training
+
+    def __enter__(self):
+        st = _st()
+        self._prev = (st.recording, st.training)
+        if self._recording is not None:
+            st.recording = self._recording
+        if self._training is not None:
+            st.training = self._training
+        return self
+
+    def __exit__(self, *a):
+        st = _st()
+        st.recording, st.training = self._prev
+
+
+def record(train_mode=True):  # noqa: D401  (reference autograd.py:121)
+    """Scope: operations are recorded for differentiation."""
+    return _Scope(recording=True, training=train_mode)
+
+
+def pause(train_mode=False):
+    """Scope: recording suspended (reference autograd.py:141)."""
+    return _Scope(recording=False, training=train_mode)
+
+
+def train_mode():
+    return _Scope(training=True)
+
+
+def predict_mode():
+    return _Scope(training=False)
+
+
+# ---------------------------------------------------------------------------
+# tape
+# ---------------------------------------------------------------------------
+
+class _TapeNode:
+    """One recorded op (analogue of AGNode, src/ndarray/autograd.h:72)."""
+    __slots__ = ("vjp_fn", "in_entries", "rng_offset", "raw_shapes",
+                 "raw_dtypes", "raw_is_tuple", "opname")
+
+    def __init__(self, vjp_fn, in_entries, rng_offset, raw_shapes,
+                 raw_dtypes, raw_is_tuple, opname):
+        self.vjp_fn = vjp_fn
+        self.in_entries = in_entries    # per op input: ("node", node, idx) |
+        #                                  ("var", ndarray) | None
+        self.rng_offset = rng_offset
+        self.raw_shapes = raw_shapes    # shapes/dtypes of ALL raw fn outputs
+        self.raw_dtypes = raw_dtypes
+        self.raw_is_tuple = raw_is_tuple
+        self.opname = opname
+
+    @property
+    def n_raw_outputs(self):
+        return len(self.raw_shapes)
+
+
+def _entry_of(x):
+    from .ndarray.ndarray import NDArray
+    if not isinstance(x, NDArray):
+        return None
+    if getattr(x, "_grad", None) is not None and x._grad_req != "null":
+        return ("var", x)
+    ent = getattr(x, "_ag_entry", None)
+    if ent is not None:
+        return ("node", ent[0], ent[1])
+    return None
+
+
+def _record_op(opdef, nd_inputs, nd_outputs, vjp_fn, raw_shapes, raw_dtypes,
+               raw_is_tuple, rng_offset):
+    """Called by ops.registry.invoke_eager while recording."""
+    in_entries = []
+    for i, x in enumerate(nd_inputs):
+        if i in opdef.nondiff_inputs:
+            in_entries.append(None)
+        else:
+            in_entries.append(_entry_of(x))
+    if not any(e is not None for e in in_entries):
+        return  # nothing upstream needs grad: don't grow the tape
+    node = _TapeNode(vjp_fn, in_entries, rng_offset, raw_shapes, raw_dtypes,
+                     raw_is_tuple, opdef.name)
+    for i, o in enumerate(nd_outputs):
+        o._ag_entry = (node, i)
+
+
+def mark_variables(variables, gradients, grad_reqs="write"):
+    """Attach grad buffers (reference autograd.py:196 / autograd.cc:79)."""
+    from .base import _as_list
+    variables = _as_list(variables)
+    gradients = _as_list(gradients)
+    if isinstance(grad_reqs, str):
+        grad_reqs = [grad_reqs] * len(variables)
+    for v, g, req in zip(variables, gradients, grad_reqs):
+        v._grad = g
+        v._grad_req = req
+
+
+def backward(heads, head_grads=None, retain_graph=False, train_mode=True):
+    """Compute gradients of heads w.r.t. marked variables
+    (reference autograd.py:227 → AutogradRuntime::ComputeGradient)."""
+    from .base import _as_list
+    from .ndarray.ndarray import NDArray
+
+    heads = _as_list(heads)
+    if head_grads is None:
+        head_grads = [None] * len(heads)
+    else:
+        head_grads = _as_list(head_grads)
+
+    # cotangent accumulator keyed by (id(node)) -> list per raw output
+    cts = {}
+    nodes = {}
+    # per-variable accumulation WITHIN this backward pass; grad_req
+    # write/add applies when flushing at the end (reference semantics:
+    # kWriteTo overwrites across backward calls, sums within one).
+    var_cts = {}
+    var_objs = {}
+
+    def _accum_var(var, ct):
+        key = id(var)
+        cur = var_cts.get(key)
+        var_cts[key] = ct if cur is None else cur + ct
+        var_objs[key] = var
+
+    def _add_ct(node, idx, val):
+        key = id(node)
+        if key not in cts:
+            cts[key] = [None] * node.n_raw_outputs
+            nodes[key] = node
+        cur = cts[key][idx]
+        cts[key][idx] = val if cur is None else cur + val
+
+    any_head = False
+    for h, hg in zip(heads, head_grads):
+        ent = getattr(h, "_ag_entry", None)
+        if ent is None:
+            if getattr(h, "_grad", None) is not None:
+                # head IS a marked variable: d head/d head = head_grad
+                g = hg._data if isinstance(hg, NDArray) else (
+                    jnp.ones(h.shape, h._data.dtype) if hg is None else jnp.asarray(hg))
+                _accum_var(h, g)
+                any_head = True
+            continue
+        node, idx = ent
+        if hg is None:
+            g = jnp.ones(h.shape, h._data.dtype)
+        else:
+            g = hg._data if isinstance(hg, NDArray) else jnp.asarray(hg)
+        _add_ct(node, idx, g)
+        any_head = True
+    if not any_head:
+        raise ValueError("cannot differentiate: no head is attached to the "
+                         "recorded graph (did you call backward outside "
+                         "autograd.record()?)")
+
+    # reverse sweep — nodes were created in forward order; process by a DFS
+    # topological order over the node graph.
+    order = []
+    seen = set()
+
+    def _visit(node):
+        if id(node) in seen:
+            return
+        seen.add(id(node))
+        for e in node.in_entries:
+            if e is not None and e[0] == "node":
+                _visit(e[1])
+        order.append(node)
+
+    for key in list(cts):
+        _visit(nodes[key])
+
+    for node in reversed(order):
+        key = id(node)
+        if key not in cts:
+            continue
+        out_cts = cts.pop(key)
+        full = []
+        for i in range(node.n_raw_outputs):
+            c = out_cts[i] if i < len(out_cts) else None
+            if c is None:
+                c = jnp.zeros(node.raw_shapes[i], node.raw_dtypes[i])
+            full.append(c)
+        raw_ct = tuple(full) if node.raw_is_tuple else full[0]
+        in_cts = node.vjp_fn(raw_ct)
+        # strip rng cotangent if fn took a leading key
+        in_cts = in_cts[node.rng_offset:]
+        for e, c in zip(node.in_entries, in_cts):
+            if e is None or c is None:
+                continue
+            if e[0] == "node":
+                _add_ct(e[1], e[2], c)
+            else:
+                _accum_var(e[1], c)
+
+    for key, ct in var_cts.items():
+        _flush_var(var_objs[key], ct)
+
+
+def _flush_var(var, ct):
+    req = getattr(var, "_grad_req", "write")
+    gbuf = var._grad
+    if gbuf is None or req == "null":
+        return
+    ct = jnp.asarray(ct, gbuf._data.dtype).reshape(gbuf.shape)
+    if req == "add":
+        gbuf._set_data(gbuf._data + ct)
+    else:
+        gbuf._set_data(ct)
+
+
+def grad(heads, variables, head_grads=None, retain_graph=None,
+         create_graph=False, train_mode=True):
+    """Functional variant returning new grad arrays (reference autograd.py
+    ``grad``)."""
+    from .base import _as_list
+    from .ndarray.ndarray import zeros_like
+    variables = _as_list(variables)
+    saved = [(getattr(v, "_grad", None), getattr(v, "_grad_req", "null"))
+             for v in variables]
+    bufs = [zeros_like(v) for v in variables]
+    mark_variables(variables, bufs, "write")
+    try:
+        backward(heads, head_grads, retain_graph=bool(retain_graph),
+                 train_mode=train_mode)
+    finally:
+        for v, (g, r) in zip(variables, saved):
+            v._grad, v._grad_req = g, r
+    return bufs
+
+
+def get_symbol(x):  # pragma: no cover - compat shim
+    """Reference returns the recorded graph as a Symbol; here the tape is a
+    vjp-closure chain without a symbolic form. Provided for API compat."""
+    raise NotImplementedError(
+        "get_symbol is not supported: the TPU autograd tape stores "
+        "linearized vjp closures, not a symbolic graph. Use sym/HybridBlock "
+        "tracing for a graph view.")
+
+
+class Function:
+    """User-defined differentiable function (reference autograd.py:309).
+
+    Subclass and implement forward(self, *inputs) and
+    backward(self, *output_grads); call the instance on NDArrays.
+    """
+
+    def __call__(self, *inputs):
+        from .ndarray.ndarray import NDArray, _wrap
+        with pause():
+            outputs = self.forward(*inputs)
+        single = not isinstance(outputs, (tuple, list))
+        outs = [outputs] if single else list(outputs)
+        if is_recording():
+            func = self
+
+            def vjp_fn(out_cts):
+                if not isinstance(out_cts, (tuple, list)):
+                    out_cts = (out_cts,)
+                with pause():
+                    in_grads = func.backward(*[_wrap(c) for c in out_cts])
+                if not isinstance(in_grads, (tuple, list)):
+                    in_grads = (in_grads,)
+                return tuple(g._data if isinstance(g, NDArray) else g
+                             for g in in_grads)
+
+            in_entries = [_entry_of(x) for x in inputs]
+            node = _TapeNode(vjp_fn, in_entries, 0,
+                             tuple(o.shape for o in outs),
+                             tuple(o._data.dtype for o in outs),
+                             not single, type(self).__name__)
+            for i, o in enumerate(outs):
+                o._ag_entry = (node, i)
+        return outputs
+
+    def forward(self, *inputs):
+        raise NotImplementedError
+
+    def backward(self, *output_grads):
+        raise NotImplementedError

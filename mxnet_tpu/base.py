@@ -1,0 +1,76 @@
+"""Shared plumbing: dtype maps, error types, name management.
+
+The reference's ``python/mxnet/base.py`` is ctypes plumbing into the C ABI;
+here the "ABI" is the in-process op registry (ops/registry.py) so this module
+only keeps what the rest of the Python surface needs.
+"""
+from __future__ import annotations
+
+import re
+
+import numpy as np
+
+__all__ = ["MXNetError", "string_types", "numeric_types", "integer_types",
+           "DTYPE_MX_TO_NP", "DTYPE_NP_TO_MX", "mx_real_t", "mx_uint",
+           "np_dtype", "_as_list"]
+
+
+class MXNetError(RuntimeError):
+    """Error raised by the framework (reference: base.py MXNetError)."""
+
+
+string_types = (str,)
+numeric_types = (float, int, np.generic)
+integer_types = (int, np.integer)
+
+mx_real_t = np.float32
+mx_uint = int
+
+# Reference dtype code table (python/mxnet/ndarray/ndarray.py _DTYPE_NP_TO_MX)
+# kept verbatim so saved .params/.ndarray blobs round-trip, plus bf16 which is
+# the TPU-native compute dtype.
+DTYPE_NP_TO_MX = {
+    None: -1,
+    np.dtype(np.float32): 0,
+    np.dtype(np.float64): 1,
+    np.dtype(np.float16): 2,
+    np.dtype(np.uint8): 3,
+    np.dtype(np.int32): 4,
+    np.dtype(np.int8): 5,
+    np.dtype(np.int64): 6,
+    np.dtype(np.bool_): 7,
+    # extension codes (not in the reference's table)
+    "bfloat16": 12,
+}
+DTYPE_MX_TO_NP = {v: k for k, v in DTYPE_NP_TO_MX.items()}
+
+
+def np_dtype(dtype):
+    """Normalize user dtype input (np dtype, str incl. 'bfloat16', type)."""
+    if dtype is None:
+        return np.dtype(np.float32)
+    if isinstance(dtype, str) and dtype == "bfloat16":
+        import jax.numpy as jnp
+        return jnp.bfloat16
+    try:
+        import jax.numpy as jnp
+        if dtype is jnp.bfloat16 or getattr(dtype, "name", "") == "bfloat16":
+            return jnp.bfloat16
+    except ImportError:  # pragma: no cover
+        pass
+    return np.dtype(dtype)
+
+
+def _as_list(obj):
+    if isinstance(obj, (list, tuple)):
+        return list(obj)
+    return [obj]
+
+
+_NAME_PAT = re.compile(r"^[A-Za-z0-9_.\-]+$")
+
+
+def check_name(name):
+    if name is not None and not _NAME_PAT.match(name):
+        raise ValueError("invalid name %r" % (name,))
+    return name

@@ -1,0 +1,22 @@
+"""NDArray namespace: the imperative API (``mx.nd``).
+
+Creation fns + the auto-generated operator namespace (reference:
+python/mxnet/ndarray/__init__.py).
+"""
+from .ndarray import (NDArray, array, arange, concatenate, empty, full,
+                      imresize, load, moveaxis, ones, ones_like,
+                      onehot_encode, save, waitall, zeros, zeros_like,
+                      _wrap)
+from . import sparse
+from .sparse import CSRNDArray, RowSparseNDArray
+
+from . import op
+from .op import *  # noqa: F401,F403 — generated operator functions
+
+# re-export every generated op (including _underscore internals) at package
+# level, as the reference does via _init_ops
+from ..ops import registry as _reg
+
+for _name in _reg.list_ops():
+    globals()[_name] = getattr(op, _name)
+del _name

@@ -1,0 +1,638 @@
+"""NDArray — the imperative n-dimensional array over ``jax.Array``.
+
+Reference: ``python/mxnet/ndarray/ndarray.py`` (2766 LoC) + the C++ chunk
+management in ``src/ndarray/ndarray.cc``. There, every NDArray is a
+ref-counted buffer and every mutation is an async engine push serialized by
+read/write variable tracking. Here the buffer is an immutable ``jax.Array``
+and "mutation" rebinds the handle (``_set_data``) — JAX's async dispatch
+plays the engine's role (ops return immediately; ``wait_to_read`` blocks,
+exactly like the reference's `WaitToRead`), and immutability of the
+underlying buffers is what makes the autograd tape safe without variable
+queues.
+
+Operator methods (``x.sum()``, ``x + y`` …) all route through the shared op
+registry so eager and symbolic modes use the same kernels and the autograd
+tape sees every call (reference parity: eager and Symbol share FCompute
+kernels, SURVEY.md §intro).
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..base import DTYPE_MX_TO_NP, DTYPE_NP_TO_MX, np_dtype, numeric_types
+from ..context import Context, cpu, current_context
+from ..ops import registry as _reg
+
+__all__ = ["NDArray", "array", "zeros", "ones", "full", "empty", "arange",
+           "zeros_like", "ones_like", "concatenate", "waitall", "load",
+           "save", "imresize", "moveaxis", "onehot_encode", "_wrap"]
+
+
+def _ctx_of_data(data):
+    try:
+        dev = next(iter(data.devices()))
+    except Exception:
+        return current_context()
+    if dev.platform == "cpu":
+        return Context("cpu", dev.id)
+    return Context("gpu", dev.id)
+
+
+class NDArray:
+    """An array object representing a multidimensional, homogeneous array of
+    fixed-size items, executing on TPU via XLA."""
+
+    __slots__ = ("_data", "_grad", "_grad_req", "_ag_entry", "_stype",
+                 "__weakref__")
+
+    # numpy should defer to us in mixed expressions
+    __array_priority__ = 1000.0
+
+    def __init__(self, data, ctx=None, stype="default"):
+        if isinstance(data, NDArray):
+            data = data._data
+        if not isinstance(data, jax.Array):
+            data = jnp.asarray(data)
+        if ctx is not None:
+            data = jax.device_put(data, ctx.jax_device())
+        self._data = data
+        self._grad = None
+        self._grad_req = "null"
+        self._ag_entry = None
+        self._stype = stype
+
+    # -- basic properties ---------------------------------------------------
+    @property
+    def shape(self):
+        return tuple(self._data.shape)
+
+    @property
+    def dtype(self):
+        dt = self._data.dtype
+        if dt == jnp.bfloat16:
+            return jnp.bfloat16
+        return np.dtype(dt)
+
+    @property
+    def size(self):
+        return int(np.prod(self.shape)) if self.shape else 1
+
+    @property
+    def ndim(self):
+        return self._data.ndim
+
+    @property
+    def context(self):
+        return _ctx_of_data(self._data)
+
+    ctx = context
+
+    @property
+    def stype(self):
+        return self._stype
+
+    @property
+    def handle(self):
+        """The backing jax.Array (the 'handle' in reference terms)."""
+        return self._data
+
+    @property
+    def grad(self):
+        return self._grad
+
+    @property
+    def T(self):
+        return self.transpose()
+
+    # -- data movement ------------------------------------------------------
+    def _set_data(self, data):
+        self._data = data if isinstance(data, jax.Array) else jnp.asarray(data)
+
+    def asnumpy(self):
+        arr = np.asarray(jax.device_get(self._data))
+        if self._data.dtype == jnp.bfloat16:
+            arr = arr.astype(np.float32)
+        return arr
+
+    def asscalar(self):
+        if self.size != 1:
+            raise ValueError("The current array is not a scalar")
+        return self.asnumpy().reshape(())[()]
+
+    def item(self):
+        return self.asscalar()
+
+    def tolist(self):
+        return self.asnumpy().tolist()
+
+    def wait_to_read(self):
+        self._data.block_until_ready()
+
+    def wait_to_write(self):
+        self._data.block_until_ready()
+
+    def copy(self):
+        return NDArray(jnp.array(self._data))
+
+    def copyto(self, other):
+        if isinstance(other, NDArray):
+            other._set_data(jax.device_put(self._data,
+                                           other.context.jax_device()))
+            return other
+        if isinstance(other, Context):
+            return NDArray(jax.device_put(self._data, other.jax_device()))
+        raise TypeError("copyto does not support type %s" % type(other))
+
+    def as_in_context(self, context):
+        if context == self.context:
+            return self
+        return NDArray(jax.device_put(self._data, context.jax_device()))
+
+    def astype(self, dtype, copy=True):
+        dt = np_dtype(dtype)
+        if not copy and self._data.dtype == dt:
+            return self
+        return NDArray(self._data.astype(dt))
+
+    def asnormal(self):  # pragma: no cover - compat
+        return self
+
+    def detach(self):
+        out = NDArray(self._data)
+        return out
+
+    def tostype(self, stype):
+        from .sparse import tostype as _tostype
+        return _tostype(self, stype)
+
+    # -- autograd -----------------------------------------------------------
+    def attach_grad(self, grad_req="write", stype=None):
+        self._grad = NDArray(jnp.zeros(self.shape, self._data.dtype))
+        self._grad_req = grad_req
+
+    def backward(self, out_grad=None, retain_graph=False, train_mode=True):
+        from .. import autograd
+        autograd.backward([self], [out_grad] if out_grad is not None else None,
+                          retain_graph=retain_graph, train_mode=train_mode)
+
+    # -- printing / conversion ---------------------------------------------
+    def __repr__(self):
+        shape_info = "x".join(str(s) for s in self.shape)
+        return "\n%s\n<%s %s @%s>" % (self.asnumpy(), type(self).__name__,
+                                      shape_info, self.context)
+
+    def __str__(self):
+        return self.__repr__()
+
+    def __len__(self):
+        if not self.shape:
+            raise TypeError("len() of unsized object")
+        return self.shape[0]
+
+    def __iter__(self):
+        for i in range(len(self)):
+            yield self[i]
+
+    def __bool__(self):
+        if self.size == 1:
+            return bool(self.asscalar())
+        raise ValueError("The truth value of an NDArray with multiple "
+                         "elements is ambiguous.")
+
+    def __float__(self):
+        return float(self.asscalar())
+
+    def __int__(self):
+        return int(self.asscalar())
+
+    def __array__(self, dtype=None):
+        a = self.asnumpy()
+        return a.astype(dtype) if dtype is not None else a
+
+    def __getstate__(self):
+        return {"data": self.asnumpy(), "stype": self._stype}
+
+    def __setstate__(self, state):
+        self._data = jnp.asarray(state["data"])
+        self._grad = None
+        self._grad_req = "null"
+        self._ag_entry = None
+        self._stype = state.get("stype", "default")
+
+    # -- indexing -----------------------------------------------------------
+    def __getitem__(self, key):
+        from .. import autograd
+        key2 = key._data if isinstance(key, NDArray) else key
+        if isinstance(key2, (jax.Array, np.ndarray)):
+            if jnp.asarray(key2).dtype == jnp.bool_:
+                raise NotImplementedError(
+                    "boolean-mask indexing produces data-dependent shapes, "
+                    "which XLA cannot compile; use nd.where / "
+                    "nd._sparse_retain instead")
+            # advanced (integer array) indexing along axis 0 == take
+            return _op("take")(self, _wrap(jnp.asarray(key2)), axis=0)
+        norm = _normalize_index(key2)
+        if autograd.is_recording():
+            return _op("_index")(self, index=norm)
+        return _wrap(self._data[_unwrap_index(norm)])
+
+    def __setitem__(self, key, value):
+        from .. import autograd
+        from ..base import MXNetError
+        if autograd.is_recording() and self._ag_entry is not None:
+            raise MXNetError(
+                "in-place assignment to an array produced inside "
+                "autograd.record() would silently corrupt gradients; "
+                "compute a new array instead (e.g. via nd.where)")
+        key2 = key._data if isinstance(key, NDArray) else key
+        if isinstance(value, NDArray):
+            value = value._data
+        elif not isinstance(value, (jax.Array, numeric_types)):
+            value = jnp.asarray(value)
+        if isinstance(key2, slice) and key2 == slice(None):
+            if isinstance(value, numeric_types):
+                self._set_data(jnp.full(self.shape, value, self._data.dtype))
+            else:
+                self._set_data(jnp.broadcast_to(
+                    jnp.asarray(value, self._data.dtype), self.shape))
+            return
+        norm = _unwrap_index(_normalize_index(key2))
+        self._set_data(self._data.at[norm].set(value))
+
+    def slice(self, begin, end, step=None, **kw):
+        return _op("slice")(self, begin=begin, end=end, step=step)
+
+    def slice_axis(self, axis, begin, end):
+        return _op("slice_axis")(self, axis=axis, begin=begin, end=end)
+
+    # -- reshaping (methods the reference defines natively) ----------------
+    def reshape(self, *shape, **kwargs):
+        if len(shape) == 1 and isinstance(shape[0], (list, tuple)):
+            shape = tuple(shape[0])
+        if kwargs.get("shape"):
+            shape = tuple(kwargs["shape"])
+        return _op("reshape")(self, shape=shape)
+
+    def reshape_like(self, other):
+        return _op("reshape")(self, shape=other.shape)
+
+    def broadcast_to(self, shape):
+        return _op("broadcast_to")(self, shape=tuple(shape))
+
+    def broadcast_like(self, other):
+        return self.broadcast_to(other.shape)
+
+    def expand_dims(self, axis):
+        return _op("expand_dims")(self, axis=axis)
+
+    # -- arithmetic ---------------------------------------------------------
+    def __add__(self, other):
+        return _binary("broadcast_add", "_plus_scalar", self, other)
+
+    def __radd__(self, other):
+        return self.__add__(other)
+
+    def __iadd__(self, other):
+        res = self.__add__(other)
+        self._set_data(res._data)
+        self._ag_entry = res._ag_entry
+        return self
+
+    def __sub__(self, other):
+        return _binary("broadcast_sub", "_minus_scalar", self, other)
+
+    def __rsub__(self, other):
+        return _binary_r("_rminus_scalar", self, other)
+
+    def __isub__(self, other):
+        res = self.__sub__(other)
+        self._set_data(res._data)
+        self._ag_entry = res._ag_entry
+        return self
+
+    def __mul__(self, other):
+        return _binary("broadcast_mul", "_mul_scalar", self, other)
+
+    def __rmul__(self, other):
+        return self.__mul__(other)
+
+    def __imul__(self, other):
+        res = self.__mul__(other)
+        self._set_data(res._data)
+        self._ag_entry = res._ag_entry
+        return self
+
+    def __truediv__(self, other):
+        return _binary("broadcast_div", "_div_scalar", self, other)
+
+    def __rtruediv__(self, other):
+        return _binary_r("_rdiv_scalar", self, other)
+
+    def __itruediv__(self, other):
+        res = self.__truediv__(other)
+        self._set_data(res._data)
+        self._ag_entry = res._ag_entry
+        return self
+
+    __div__ = __truediv__
+    __rdiv__ = __rtruediv__
+
+    def __mod__(self, other):
+        return _binary("broadcast_mod", "_mod_scalar", self, other)
+
+    def __rmod__(self, other):
+        return _binary_r("_rmod_scalar", self, other)
+
+    def __pow__(self, other):
+        return _binary("broadcast_power", "_power_scalar", self, other)
+
+    def __rpow__(self, other):
+        return _binary_r("_rpower_scalar", self, other)
+
+    def __neg__(self):
+        return _op("negative")(self)
+
+    def __abs__(self):
+        return _op("abs")(self)
+
+    def __eq__(self, other):
+        return _binary("broadcast_equal", "_equal_scalar", self, other)
+
+    def __ne__(self, other):
+        return _binary("broadcast_not_equal", "_not_equal_scalar", self, other)
+
+    def __gt__(self, other):
+        return _binary("broadcast_greater", "_greater_scalar", self, other)
+
+    def __ge__(self, other):
+        return _binary("broadcast_greater_equal", "_greater_equal_scalar",
+                       self, other)
+
+    def __lt__(self, other):
+        return _binary("broadcast_lesser", "_lesser_scalar", self, other)
+
+    def __le__(self, other):
+        return _binary("broadcast_lesser_equal", "_lesser_equal_scalar",
+                       self, other)
+
+    def __hash__(self):
+        return id(self)
+
+    # -- generic op-method fallback ----------------------------------------
+    # Any registered unary/reduce/etc op is available as a method with the
+    # array as first argument: x.sum(axis=1), x.relu(), x.topk(k=3), ...
+    # (reference: these are hand-stamped methods over the same generated fns)
+    def __getattr__(self, name):
+        if name.startswith("_"):
+            raise AttributeError(name)
+        try:
+            opdef = _reg.get_op(name)
+        except KeyError:
+            raise AttributeError(
+                "'NDArray' object has no attribute %r" % (name,)) from None
+        return functools.partial(_invoke_named, opdef, self)
+
+
+class _IdxWrap:
+    """Hashable wrapper marking a list index (fancy indexing) so it can be a
+    static attr of the jit-cached _index op."""
+    __slots__ = ("key",)
+
+    def __init__(self, key):
+        self.key = key
+
+    def __hash__(self):
+        return hash(("_IdxWrap", self.key))
+
+    def __eq__(self, other):
+        return isinstance(other, _IdxWrap) and self.key == other.key
+
+
+def _normalize_index(key):
+    """Make an index hashable/canonical for the jit-cached _index op."""
+    if isinstance(key, tuple):
+        return tuple(_normalize_index(k) for k in key)
+    if isinstance(key, slice):
+        return key
+    if isinstance(key, (int, np.integer)):
+        return int(key)
+    if key is None or key is Ellipsis:
+        return key
+    if isinstance(key, list):
+        return _IdxWrap(tuple(key))
+    return key
+
+
+def _unwrap_index(key):
+    """Inverse of _normalize_index: recover a jax-compatible index."""
+    if isinstance(key, _IdxWrap):
+        return list(key.key)
+    if isinstance(key, tuple):
+        return tuple(_unwrap_index(k) for k in key)
+    return key
+
+
+def _invoke_named(opdef, self_nd, *args, **kwargs):
+    out = kwargs.pop("out", None)
+    kwargs.pop("name", None)
+    inputs = [self_nd]
+    scalars = []
+    for a in args:
+        if isinstance(a, (NDArray, jax.Array, np.ndarray)):
+            inputs.append(a)
+        else:
+            scalars.append(a)
+    attrs = {k: v for k, v in kwargs.items() if not isinstance(v, NDArray)}
+    for k, v in list(kwargs.items()):
+        if isinstance(v, NDArray):
+            inputs.append(v)
+    if scalars:
+        # positional attrs map onto the op's parameter order, as the
+        # reference's hand-stamped NDArray methods do (x.sum(1), x.clip(-2,2))
+        free = [k for k in opdef.defaults if k not in attrs]
+        if len(scalars) > len(free):
+            raise TypeError("%s: too many positional arguments %r (attrs: %r)"
+                            % (opdef.name, scalars, list(opdef.defaults)))
+        for k, v in zip(free, scalars):
+            attrs[k] = v
+    return _reg.invoke_eager(opdef, inputs, attrs, out=out)
+
+
+def _op(name):
+    """nd-level invoker for a registered op."""
+    opdef = _reg.get_op(name)
+
+    def f(*args, out=None, **attrs):
+        inputs = [a for a in args if isinstance(a, NDArray)]
+        return _reg.invoke_eager(opdef, inputs, attrs, out=out)
+    return f
+
+
+def _binary(tensor_op, scalar_op, lhs, rhs):
+    if isinstance(rhs, NDArray):
+        return _op(tensor_op)(lhs, rhs)
+    if isinstance(rhs, numeric_types):
+        return _op(scalar_op)(lhs, scalar=float(rhs))
+    if isinstance(rhs, (np.ndarray, jax.Array)):
+        return _op(tensor_op)(lhs, _wrap(jnp.asarray(rhs)))
+    raise TypeError("unsupported operand type %s" % type(rhs))
+
+
+def _binary_r(scalar_op, lhs, rhs):
+    if isinstance(rhs, numeric_types):
+        return _op(scalar_op)(lhs, scalar=float(rhs))
+    raise TypeError("unsupported operand type %s" % type(rhs))
+
+
+def _wrap(data):
+    return NDArray(data)
+
+
+# ---------------------------------------------------------------------------
+# creation / module-level functions (reference ndarray.py free functions)
+# ---------------------------------------------------------------------------
+
+def array(source_array, ctx=None, dtype=None):
+    if isinstance(source_array, NDArray):
+        data = source_array._data
+        if dtype is not None:
+            data = data.astype(np_dtype(dtype))
+        return NDArray(data, ctx=ctx)
+    if dtype is None:
+        if isinstance(source_array, (np.ndarray, jax.Array)):
+            dtype = source_array.dtype
+            if dtype == np.float64:
+                dtype = np.float32
+            elif dtype == np.int64:
+                dtype = np.int32
+            elif dtype == np.uint64:
+                dtype = np.uint32
+        else:
+            dtype = np.float32
+    else:
+        try:
+            if np.dtype(dtype) == np.int64 and not jax.config.jax_enable_x64:
+                dtype = np.int32
+        except TypeError:
+            pass
+    arr = np.asarray(source_array, dtype=np_dtype(dtype)) \
+        if not isinstance(source_array, jax.Array) else source_array
+    return NDArray(jnp.asarray(arr, np_dtype(dtype)), ctx=ctx)
+
+
+def empty(shape, ctx=None, dtype=None):
+    return zeros(shape, ctx=ctx, dtype=dtype)
+
+
+def zeros(shape, ctx=None, dtype=None, stype=None, **kwargs):
+    if stype not in (None, "default"):
+        from .sparse import zeros as sparse_zeros
+        return sparse_zeros(stype, shape, ctx=ctx, dtype=dtype)
+    shape = (shape,) if isinstance(shape, int) else tuple(shape)
+    return NDArray(jnp.zeros(shape, np_dtype(dtype)), ctx=ctx)
+
+
+def ones(shape, ctx=None, dtype=None, **kwargs):
+    shape = (shape,) if isinstance(shape, int) else tuple(shape)
+    return NDArray(jnp.ones(shape, np_dtype(dtype)), ctx=ctx)
+
+
+def full(shape, val, ctx=None, dtype=None, out=None):
+    shape = (shape,) if isinstance(shape, int) else tuple(shape)
+    res = NDArray(jnp.full(shape, val, np_dtype(dtype)), ctx=ctx)
+    if out is not None:
+        out._set_data(res._data)
+        return out
+    return res
+
+
+def arange(start, stop=None, step=1.0, repeat=1, ctx=None, dtype=None):
+    dt = np_dtype(dtype)
+    arr = jnp.arange(start, stop, step, dtype=dt)
+    if repeat > 1:
+        arr = jnp.repeat(arr, repeat)
+    return NDArray(arr, ctx=ctx)
+
+
+def zeros_like(other, **kw):
+    return NDArray(jnp.zeros(other.shape, other._data.dtype))
+
+
+def ones_like(other, **kw):
+    return NDArray(jnp.ones(other.shape, other._data.dtype))
+
+
+def moveaxis(tensor, source, destination):
+    return _wrap(jnp.moveaxis(tensor._data, source, destination))
+
+
+def concatenate(arrays, axis=0, always_copy=True):
+    return _wrap(jnp.concatenate([a._data for a in arrays], axis=axis))
+
+
+def onehot_encode(indices, out):
+    depth = out.shape[1]
+    res = jnp.eye(depth, dtype=out._data.dtype)[
+        indices._data.astype(jnp.int32)]
+    out._set_data(res)
+    return out
+
+
+def imresize(src, w, h, *a, **kw):
+    import jax.image
+    out = jax.image.resize(src._data.astype(jnp.float32),
+                           (h, w) + src.shape[2:], method="bilinear")
+    return _wrap(out.astype(src._data.dtype))
+
+
+def waitall():
+    """Block until all queued async work completes (reference:
+    MXNDArrayWaitAll → Engine::WaitForAll). JAX has no global barrier, so
+    block on every live device array."""
+    for arr in jax.live_arrays():
+        try:
+            arr.block_until_ready()
+        except RuntimeError:  # deleted/donated buffers
+            pass
+
+
+# ---------------------------------------------------------------------------
+# save / load — reference format is dmlc-serialized binary
+# (src/ndarray/ndarray.cc NDArray::Save); we use an .npz container with the
+# same user-facing semantics: list-of-arrays or dict-of-arrays round-trip
+# (python/mxnet/ndarray/utils.py:158-194).
+# ---------------------------------------------------------------------------
+
+_SAVE_LIST_PREFIX = "__mx_list__:"
+
+
+def save(fname, data):
+    if isinstance(data, NDArray):
+        data = [data]
+    if isinstance(data, dict):
+        payload = {}
+        for k, v in data.items():
+            arr = v.asnumpy() if isinstance(v, NDArray) else np.asarray(v)
+            payload[k] = arr
+    elif isinstance(data, (list, tuple)):
+        payload = {}
+        for i, v in enumerate(data):
+            arr = v.asnumpy() if isinstance(v, NDArray) else np.asarray(v)
+            payload[_SAVE_LIST_PREFIX + str(i)] = arr
+    else:
+        raise ValueError("data must be NDArray, list of NDArrays or dict")
+    with open(fname, "wb") as f:
+        np.savez(f, **payload)
+
+
+def load(fname):
+    with np.load(fname, allow_pickle=False) as npz:
+        keys = list(npz.keys())
+        if keys and all(k.startswith(_SAVE_LIST_PREFIX) for k in keys):
+            idx = sorted(keys, key=lambda k: int(k[len(_SAVE_LIST_PREFIX):]))
+            return [array(npz[k]) for k in idx]
+        return {k: array(npz[k]) for k in keys}

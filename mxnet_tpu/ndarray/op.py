@@ -1,0 +1,80 @@
+"""Auto-generation of the ``mx.nd.*`` operator namespace from the registry.
+
+Reference: python/mxnet/ndarray/op.py:52-174 + base.py:381 — one Python
+function is stamped per registered op at import time. Same here, minus the
+ctypes marshalling: the 'C ABI' is the in-process registry.
+"""
+from __future__ import annotations
+
+import sys
+
+import jax
+import numpy as np
+
+from ..ops import registry as _reg
+from .ndarray import NDArray, array
+
+_ARRAY_LIKE = (NDArray, jax.Array, np.ndarray)
+
+
+def _to_nd(x):
+    return x if isinstance(x, NDArray) else array(x)
+
+
+def _make_nd_function(opdef):
+    def generic_op(*args, **kwargs):
+        out = kwargs.pop("out", None)
+        kwargs.pop("name", None)
+        inputs = []
+        if opdef.arg_names is None:
+            if len(args) == 1 and isinstance(args[0], (list, tuple)):
+                args = tuple(args[0])
+            inputs = [_to_nd(a) for a in args if isinstance(a, _ARRAY_LIKE)]
+            attrs = {}
+            for k, v in kwargs.items():
+                if isinstance(v, _ARRAY_LIKE):
+                    inputs.append(_to_nd(v))
+                else:
+                    attrs[k] = v
+        else:
+            pos = [a for a in args if isinstance(a, _ARRAY_LIKE)]
+            scalars = [a for a in args if not isinstance(a, _ARRAY_LIKE)]
+            inputs = [_to_nd(a) for a in pos]
+            n = len(inputs)
+            for an in opdef.arg_names[n:]:
+                if an in kwargs and isinstance(kwargs[an], _ARRAY_LIKE):
+                    inputs.append(_to_nd(kwargs.pop(an)))
+                elif an in kwargs and kwargs[an] is None:
+                    kwargs.pop(an)
+                    break
+                else:
+                    break
+            attrs = kwargs
+            if scalars:
+                # positional attrs map onto parameter declaration order
+                # (reference: dmlc::Parameter ordering in generated sigs)
+                free = [k for k in opdef.defaults if k not in attrs]
+                if len(scalars) > len(free):
+                    raise TypeError(
+                        "%s: too many positional arguments %r (attrs: %r)"
+                        % (opdef.name, scalars, list(opdef.defaults)))
+                for k, v in zip(free, scalars):
+                    attrs[k] = v
+        return _reg.invoke_eager(opdef, inputs, attrs, out=out)
+
+    generic_op.__name__ = opdef.name
+    generic_op.__doc__ = opdef.doc
+    generic_op.__qualname__ = opdef.name
+    return generic_op
+
+
+def _populate(target_module_name):
+    mod = sys.modules[target_module_name]
+    for name in _reg.list_ops():
+        opdef = _reg.get_op(name)
+        fn = _make_nd_function(opdef)
+        fn.__name__ = name
+        setattr(mod, name, fn)
+
+
+_populate(__name__)

@@ -1,0 +1,138 @@
+"""Sparse NDArray storage types: row_sparse and csr.
+
+Reference: python/mxnet/ndarray/sparse.py + src/ndarray (stype kDefault/
+kRowSparse/kCSR). XLA/TPU is dense-first (SURVEY.md §7 hard part (c)), so
+the TPU-native design keeps a dense device buffer as the compute
+representation and materializes indices/indptr views on demand — sparse
+semantics (e.g. sparse_update, retain, row_sparse_pull) are expressed as
+gather/scatter which XLA lowers natively. This preserves the reference API
+while keeping every op on the MXU-friendly dense path.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from .ndarray import NDArray, _wrap, array
+
+__all__ = ["BaseSparseNDArray", "CSRNDArray", "RowSparseNDArray",
+           "csr_matrix", "row_sparse_array", "tostype", "zeros"]
+
+
+class BaseSparseNDArray(NDArray):
+    __slots__ = ()
+
+    def asdense(self):
+        return NDArray(self._data)
+
+    def __repr__(self):
+        shape_info = "x".join(str(s) for s in self.shape)
+        return "\n<%s %s @%s>" % (type(self).__name__, shape_info,
+                                  self.context)
+
+
+class RowSparseNDArray(BaseSparseNDArray):
+    """Rows mostly zero; ``indices`` lists the non-zero rows."""
+    __slots__ = ()
+
+    def __init__(self, data, ctx=None):
+        super().__init__(data, ctx=ctx, stype="row_sparse")
+
+    @property
+    def indices(self):
+        nz = np.nonzero(np.any(self.asnumpy() != 0,
+                               axis=tuple(range(1, self.ndim))))[0]
+        return array(nz.astype(np.int64), dtype=np.int64)
+
+    @property
+    def data(self):
+        idx = self.indices.asnumpy().astype(np.int64)
+        return _wrap(self._data[idx])
+
+    def tostype(self, stype):
+        return tostype(self, stype)
+
+
+class CSRNDArray(BaseSparseNDArray):
+    """2D compressed-sparse-row array."""
+    __slots__ = ()
+
+    def __init__(self, data, ctx=None):
+        super().__init__(data, ctx=ctx, stype="csr")
+
+    @property
+    def indptr(self):
+        a = self.asnumpy()
+        counts = (a != 0).sum(axis=1)
+        return array(np.concatenate([[0], np.cumsum(counts)]).astype(
+            np.int64), dtype=np.int64)
+
+    @property
+    def indices(self):
+        a = self.asnumpy()
+        return array(np.nonzero(a)[1].astype(np.int64), dtype=np.int64)
+
+    @property
+    def data(self):
+        a = self.asnumpy()
+        return array(a[np.nonzero(a)])
+
+    def tostype(self, stype):
+        return tostype(self, stype)
+
+
+def tostype(arr, stype):
+    if stype in (None, "default"):
+        return NDArray(arr._data)
+    if stype == "row_sparse":
+        return RowSparseNDArray(arr._data)
+    if stype == "csr":
+        if arr.ndim != 2:
+            raise ValueError("csr requires 2D")
+        return CSRNDArray(arr._data)
+    raise ValueError("unknown stype %r" % stype)
+
+
+def csr_matrix(arg1, shape=None, ctx=None, dtype=None):
+    """Create a CSRNDArray from (data, indices, indptr) or dense source."""
+    if isinstance(arg1, tuple) and len(arg1) == 3:
+        data, indices, indptr = arg1
+        data = np.asarray(data)
+        indices = np.asarray(indices, dtype=np.int64)
+        indptr = np.asarray(indptr, dtype=np.int64)
+        dense = np.zeros(shape, dtype or data.dtype)
+        for r in range(shape[0]):
+            for k in range(indptr[r], indptr[r + 1]):
+                dense[r, indices[k]] = data[k]
+        return CSRNDArray(jnp.asarray(dense), ctx=ctx)
+    src = arg1.asnumpy() if isinstance(arg1, NDArray) else np.asarray(arg1)
+    if dtype is not None:
+        src = src.astype(dtype)
+    return CSRNDArray(jnp.asarray(src), ctx=ctx)
+
+
+def row_sparse_array(arg1, shape=None, ctx=None, dtype=None):
+    """Create a RowSparseNDArray from (data, indices) or dense source."""
+    if isinstance(arg1, tuple) and len(arg1) == 2:
+        data, indices = arg1
+        data = np.asarray(data)
+        indices = np.asarray(indices, dtype=np.int64)
+        full = (shape if shape is not None
+                else (int(indices.max()) + 1,) + data.shape[1:])
+        dense = np.zeros(full, dtype or data.dtype)
+        dense[indices] = data
+        return RowSparseNDArray(jnp.asarray(dense), ctx=ctx)
+    src = arg1.asnumpy() if isinstance(arg1, NDArray) else np.asarray(arg1)
+    if dtype is not None:
+        src = src.astype(dtype)
+    return RowSparseNDArray(jnp.asarray(src), ctx=ctx)
+
+
+def zeros(stype, shape, ctx=None, dtype=None):
+    shape = (shape,) if isinstance(shape, int) else tuple(shape)
+    dense = jnp.zeros(shape, dtype or jnp.float32)
+    if stype == "row_sparse":
+        return RowSparseNDArray(dense, ctx=ctx)
+    if stype == "csr":
+        return CSRNDArray(dense, ctx=ctx)
+    return NDArray(dense, ctx=ctx)

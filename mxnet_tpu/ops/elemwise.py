@@ -1,0 +1,200 @@
+"""Elementwise unary/binary/scalar/logic ops.
+
+Reference: src/operator/tensor/elemwise_* + mshadow_op.h functor zoo
+(SURVEY.md N11). One pure-jnp fn per op; XLA fuses chains of these into
+single HBM-bandwidth-bound kernels, which is the TPU replacement for
+mshadow expression templates.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import register
+
+
+def _u(name, fn, aliases=(), differentiable=True):
+    @register(name, arg_names=("data",), aliases=aliases,
+              differentiable=differentiable, doc="elementwise %s" % name)
+    def _f(x, **_):
+        return fn(x)
+    return _f
+
+
+# -- unary math (Appendix A list) -------------------------------------------
+_u("abs", jnp.abs)
+_u("sign", jnp.sign)
+_u("negative", jnp.negative)
+_u("reciprocal", lambda x: 1.0 / x)
+_u("rcbrt", lambda x: 1.0 / jnp.cbrt(x))
+_u("cbrt", jnp.cbrt)
+_u("sqrt", jnp.sqrt)
+_u("rsqrt", lambda x: lax.rsqrt(x))
+_u("square", jnp.square)
+_u("exp", jnp.exp)
+_u("expm1", jnp.expm1)
+_u("log", jnp.log)
+_u("log10", jnp.log10)
+_u("log1p", jnp.log1p)
+_u("log2", jnp.log2)
+_u("sin", jnp.sin)
+_u("cos", jnp.cos)
+_u("tan", jnp.tan)
+_u("sinh", jnp.sinh)
+_u("cosh", jnp.cosh)
+_u("tanh", jnp.tanh)
+_u("arcsin", jnp.arcsin)
+_u("arccos", jnp.arccos)
+_u("arctan", jnp.arctan)
+_u("arcsinh", jnp.arcsinh)
+_u("arccosh", jnp.arccosh)
+_u("arctanh", jnp.arctanh)
+_u("degrees", jnp.degrees)
+_u("radians", jnp.radians)
+_u("gamma", lambda x: jnp.exp(lax.lgamma(x)))
+_u("gammaln", lambda x: lax.lgamma(x))
+_u("relu", lambda x: jnp.maximum(x, 0))
+_u("sigmoid", lambda x: jnp.where(x >= 0, 1.0 / (1.0 + jnp.exp(-x)),
+                                  jnp.exp(x) / (1.0 + jnp.exp(x))))
+_u("softsign", lambda x: x / (1.0 + jnp.abs(x)))
+_u("ceil", jnp.ceil, differentiable=False)
+_u("floor", jnp.floor, differentiable=False)
+_u("rint", jnp.rint, differentiable=False)
+_u("round", jnp.round, differentiable=False)
+_u("fix", jnp.trunc, differentiable=False)
+_u("trunc", jnp.trunc, differentiable=False)
+_u("erf", lax.erf)
+_u("logical_not", lambda x: (x == 0).astype(x.dtype), differentiable=False)
+
+
+@register("_copy", arg_names=("data",), aliases=("identity",))
+def _copy(x, **_):
+    return x
+
+
+@register("BlockGrad", arg_names=("data",), aliases=("stop_gradient",))
+def _block_grad(x, **_):
+    return lax.stop_gradient(x)
+
+
+@register("make_loss", arg_names=("data",))
+def _make_loss_t(x, **_):
+    return x
+
+
+@register("_identity_with_attr_like_rhs", arg_names=("lhs", "rhs"),
+          nondiff_inputs=(1,))
+def _identity_like_rhs(lhs, rhs, **_):
+    return lhs
+
+
+@register("Cast", arg_names=("data",), aliases=("cast",))
+def _cast(x, dtype="float32", **_):
+    from ..base import np_dtype
+    return x.astype(np_dtype(dtype))
+
+
+# -- binary broadcasting -----------------------------------------------------
+
+def _b(name, fn, aliases=(), differentiable=True):
+    @register(name, arg_names=("lhs", "rhs"), aliases=aliases,
+              differentiable=differentiable, doc="broadcasting %s" % name)
+    def _f(lhs, rhs, **_):
+        return fn(lhs, rhs)
+    return _f
+
+
+_b("broadcast_add", jnp.add, aliases=("broadcast_plus", "elemwise_add",
+                                      "_plus", "_Plus"))
+_b("broadcast_sub", jnp.subtract, aliases=("broadcast_minus", "elemwise_sub",
+                                           "_minus", "_Minus", "_sub"))
+_b("broadcast_mul", jnp.multiply, aliases=("elemwise_mul", "_mul", "_Mul"))
+_b("broadcast_div", jnp.divide, aliases=("elemwise_div", "_div", "_Div"))
+_b("broadcast_mod", lambda l, r: jnp.where(r != 0, jnp.fmod(l, r), 0),
+   aliases=("_mod",))
+_b("broadcast_power", jnp.power, aliases=("_power", "_Power", "pow"))
+_b("broadcast_maximum", jnp.maximum, aliases=("_maximum", "_Maximum",
+                                              "maximum"))
+_b("broadcast_minimum", jnp.minimum, aliases=("_minimum", "_Minimum",
+                                              "minimum"))
+_b("broadcast_hypot", jnp.hypot, aliases=("_hypot",))
+_b("_grad_add", jnp.add)
+
+_b("broadcast_equal", lambda l, r: (l == r).astype(l.dtype),
+   aliases=("_equal",), differentiable=False)
+_b("broadcast_not_equal", lambda l, r: (l != r).astype(l.dtype),
+   aliases=("_not_equal",), differentiable=False)
+_b("broadcast_greater", lambda l, r: (l > r).astype(l.dtype),
+   aliases=("_greater",), differentiable=False)
+_b("broadcast_greater_equal", lambda l, r: (l >= r).astype(l.dtype),
+   aliases=("_greater_equal",), differentiable=False)
+_b("broadcast_lesser", lambda l, r: (l < r).astype(l.dtype),
+   aliases=("_lesser",), differentiable=False)
+_b("broadcast_lesser_equal", lambda l, r: (l <= r).astype(l.dtype),
+   aliases=("_lesser_equal",), differentiable=False)
+_b("broadcast_logical_and", lambda l, r: ((l != 0) & (r != 0)).astype(l.dtype),
+   differentiable=False)
+_b("broadcast_logical_or", lambda l, r: ((l != 0) | (r != 0)).astype(l.dtype),
+   differentiable=False)
+_b("broadcast_logical_xor", lambda l, r: ((l != 0) ^ (r != 0)).astype(l.dtype),
+   differentiable=False)
+
+
+@register("add_n", aliases=("ElementWiseSum", "_sum"), arg_names=None)
+def _add_n(*args, **_):
+    out = args[0]
+    for a in args[1:]:
+        out = out + a
+    return out
+
+
+# -- scalar ops --------------------------------------------------------------
+
+def _s(name, fn, aliases=(), differentiable=True):
+    @register(name, arg_names=("data",), aliases=aliases,
+              differentiable=differentiable, defaults={"scalar": 0.0})
+    def _f(x, scalar=0.0, **_):
+        s = jnp.asarray(scalar, x.dtype) if jnp.issubdtype(
+            jnp.asarray(x).dtype, jnp.number) else scalar
+        return fn(x, s)
+    return _f
+
+
+_s("_plus_scalar", jnp.add, aliases=("_PlusScalar",))
+_s("_minus_scalar", jnp.subtract, aliases=("_MinusScalar",))
+_s("_rminus_scalar", lambda x, s: s - x, aliases=("_RMinusScalar",))
+_s("_mul_scalar", jnp.multiply, aliases=("_MulScalar",))
+_s("_div_scalar", jnp.divide, aliases=("_DivScalar",))
+_s("_rdiv_scalar", lambda x, s: s / x, aliases=("_RDivScalar",))
+_s("_mod_scalar", jnp.fmod, aliases=("_ModScalar",))
+_s("_rmod_scalar", lambda x, s: jnp.fmod(s, x), aliases=("_RModScalar",))
+_s("_power_scalar", jnp.power, aliases=("_PowerScalar",))
+_s("_rpower_scalar", lambda x, s: jnp.power(s, x), aliases=("_RPowerScalar",))
+_s("_maximum_scalar", jnp.maximum, aliases=("_MaximumScalar",))
+_s("_minimum_scalar", jnp.minimum, aliases=("_MinimumScalar",))
+_s("_hypot_scalar", jnp.hypot, aliases=("_HypotScalar",))
+_s("_equal_scalar", lambda x, s: (x == s).astype(x.dtype),
+   differentiable=False)
+_s("_not_equal_scalar", lambda x, s: (x != s).astype(x.dtype),
+   differentiable=False)
+_s("_greater_scalar", lambda x, s: (x > s).astype(x.dtype),
+   differentiable=False)
+_s("_greater_equal_scalar", lambda x, s: (x >= s).astype(x.dtype),
+   differentiable=False)
+_s("_lesser_scalar", lambda x, s: (x < s).astype(x.dtype),
+   differentiable=False)
+_s("_lesser_equal_scalar", lambda x, s: (x <= s).astype(x.dtype),
+   differentiable=False)
+
+
+@register("clip", arg_names=("data",),
+          defaults={"a_min": 0.0, "a_max": 1.0})
+def _clip(x, a_min=0.0, a_max=1.0, **_):
+    return jnp.clip(x, a_min, a_max)
+
+
+@register("smooth_l1", arg_names=("data",), defaults={"scalar": 1.0})
+def _smooth_l1(x, scalar=1.0, **_):
+    s2 = scalar * scalar
+    absx = jnp.abs(x)
+    return jnp.where(absx < 1.0 / s2, 0.5 * s2 * x * x, absx - 0.5 / s2)

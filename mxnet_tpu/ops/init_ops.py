@@ -1,0 +1,58 @@
+"""Creation ops (no tensor inputs): _zeros, _ones, _arange, *_like.
+
+Reference: src/operator/tensor/init_op.* (SURVEY.md N11).
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..base import np_dtype
+from .registry import register
+
+
+@register("_zeros", arg_names=(), differentiable=False,
+          defaults={"shape": (), "dtype": "float32", "ctx": None})
+def _zeros(shape=(), dtype="float32", **_):
+    shape = (shape,) if isinstance(shape, int) else tuple(shape)
+    return jnp.zeros(shape, np_dtype(dtype))
+
+
+@register("_ones", arg_names=(), differentiable=False,
+          defaults={"shape": (), "dtype": "float32", "ctx": None})
+def _ones(shape=(), dtype="float32", **_):
+    shape = (shape,) if isinstance(shape, int) else tuple(shape)
+    return jnp.ones(shape, np_dtype(dtype))
+
+
+@register("_full", arg_names=(), differentiable=False,
+          defaults={"shape": (), "dtype": "float32", "value": 0.0,
+                    "ctx": None})
+def _full(shape=(), dtype="float32", value=0.0, **_):
+    shape = (shape,) if isinstance(shape, int) else tuple(shape)
+    return jnp.full(shape, value, np_dtype(dtype))
+
+
+@register("_arange", arg_names=(), differentiable=False,
+          defaults={"start": 0.0, "stop": None, "step": 1.0, "repeat": 1,
+                    "dtype": "float32", "ctx": None})
+def _arange(start=0.0, stop=None, step=1.0, repeat=1, dtype="float32", **_):
+    out = jnp.arange(start, stop, step, dtype=np_dtype(dtype))
+    if repeat > 1:
+        out = jnp.repeat(out, repeat)
+    return out
+
+
+@register("zeros_like", arg_names=("data",), differentiable=False)
+def _zeros_like(x, **_):
+    return jnp.zeros_like(x)
+
+
+@register("ones_like", arg_names=("data",), differentiable=False)
+def _ones_like(x, **_):
+    return jnp.ones_like(x)
+
+
+@register("_eye", arg_names=(), differentiable=False,
+          defaults={"N": 0, "M": 0, "k": 0, "dtype": "float32", "ctx": None})
+def _eye(N=0, M=0, k=0, dtype="float32", **_):
+    return jnp.eye(N, M or None, k=k, dtype=np_dtype(dtype))

@@ -1,0 +1,94 @@
+"""Linear-algebra ops — reference src/operator/tensor/la_op.* (SURVEY.md
+N11): _linalg_{gemm, gemm2, potrf, potri, trmm, trsm, syrk, gelqf,
+sumlogdiag}. Batched via jnp broadcasting / vmap-free matmul semantics.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax import lax
+import jax
+
+from .registry import register
+
+
+def _t(x, transpose):
+    return jnp.swapaxes(x, -1, -2) if transpose else x
+
+
+@register("_linalg_gemm", arg_names=("A", "B", "C"), aliases=("linalg_gemm",),
+          defaults={"transpose_a": False, "transpose_b": False,
+                    "alpha": 1.0, "beta": 1.0})
+def _gemm(A, B, C, transpose_a=False, transpose_b=False, alpha=1.0,
+          beta=1.0, **_):
+    return alpha * jnp.matmul(_t(A, transpose_a), _t(B, transpose_b)) + \
+        beta * C
+
+
+@register("_linalg_gemm2", arg_names=("A", "B"), aliases=("linalg_gemm2",),
+          defaults={"transpose_a": False, "transpose_b": False,
+                    "alpha": 1.0})
+def _gemm2(A, B, transpose_a=False, transpose_b=False, alpha=1.0, **_):
+    return alpha * jnp.matmul(_t(A, transpose_a), _t(B, transpose_b))
+
+
+@register("_linalg_potrf", arg_names=("A",), aliases=("linalg_potrf",))
+def _potrf(A, **_):
+    return jnp.linalg.cholesky(A)
+
+
+@register("_linalg_potri", arg_names=("A",), aliases=("linalg_potri",))
+def _potri(A, **_):
+    """Inverse of a SPD matrix given its Cholesky factor A (lower)."""
+    ident = jnp.broadcast_to(jnp.eye(A.shape[-1], dtype=A.dtype), A.shape)
+    linv = lax.linalg.triangular_solve(A, ident, left_side=True, lower=True)
+    return jnp.matmul(jnp.swapaxes(linv, -1, -2), linv)
+
+
+@register("_linalg_trmm", arg_names=("A", "B"), aliases=("linalg_trmm",),
+          defaults={"transpose": False, "rightside": False, "alpha": 1.0})
+def _trmm(A, B, transpose=False, rightside=False, alpha=1.0, **_):
+    tri = _t(jnp.tril(A), transpose)  # A assumed lower-triangular
+    if rightside:
+        return alpha * jnp.matmul(B, tri)
+    return alpha * jnp.matmul(tri, B)
+
+
+@register("_linalg_trsm", arg_names=("A", "B"), aliases=("linalg_trsm",),
+          defaults={"transpose": False, "rightside": False, "alpha": 1.0})
+def _trsm(A, B, transpose=False, rightside=False, alpha=1.0, **_):
+    out = lax.linalg.triangular_solve(
+        jnp.tril(A), alpha * B, left_side=not rightside, lower=True,
+        transpose_a=transpose)
+    return out
+
+
+@register("_linalg_syrk", arg_names=("A",), aliases=("linalg_syrk",),
+          defaults={"transpose": False, "alpha": 1.0})
+def _syrk(A, transpose=False, alpha=1.0, **_):
+    At = _t(A, True)
+    if transpose:
+        return alpha * jnp.matmul(At, A)
+    return alpha * jnp.matmul(A, At)
+
+
+@register("_linalg_gelqf", arg_names=("A",), aliases=("linalg_gelqf",))
+def _gelqf(A, **_):
+    """LQ factorization: A = L Q with Q orthonormal rows."""
+    q, r = jnp.linalg.qr(jnp.swapaxes(A, -1, -2))
+    return jnp.swapaxes(r, -1, -2), jnp.swapaxes(q, -1, -2)
+
+
+@register("_linalg_sumlogdiag", arg_names=("A",),
+          aliases=("linalg_sumlogdiag",))
+def _sumlogdiag(A, **_):
+    diag = jnp.diagonal(A, axis1=-2, axis2=-1)
+    return jnp.sum(jnp.log(diag), axis=-1)
+
+
+@register("khatri_rao", arg_names=None, aliases=("_khatri_rao",))
+def _khatri_rao(*args, **_):
+    """Column-wise Khatri-Rao product (reference contrib krprod.h)."""
+    out = args[0]
+    for b in args[1:]:
+        out = (out[:, None, :] * b[None, :, :]).reshape(-1, out.shape[-1])
+    return out

@@ -1,0 +1,167 @@
+"""Output/loss ops with custom backward semantics.
+
+Reference: src/operator/softmax_output*.cc, regression_output*.cc,
+make_loss.cc, svm_output.cc. These ops' backward passes are NOT the vjp of
+their forward (SoftmaxOutput forwards softmax but backprops cross-entropy
+gradient) — implemented with ``jax.custom_vjp`` so both the eager tape and
+jitted executors get the reference semantics.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import nn as jnn
+
+from .registry import register
+
+
+def _norm_factor(normalization, label, valid_mask=None):
+    if normalization == "batch":
+        return float(label.shape[0]) if label.ndim else 1.0
+    if normalization == "valid" and valid_mask is not None:
+        return jnp.maximum(jnp.sum(valid_mask), 1.0)
+    if normalization == "valid":
+        return float(label.size)
+    return 1.0
+
+
+@register("SoftmaxOutput", arg_names=("data", "label"), nondiff_inputs=(1,),
+          aliases=("Softmax",),
+          defaults={"grad_scale": 1.0, "ignore_label": -1.0,
+                    "multi_output": False, "use_ignore": False,
+                    "preserve_shape": False, "normalization": "null",
+                    "out_grad": False, "smooth_alpha": 0.0})
+def _softmax_output(data, label, grad_scale=1.0, ignore_label=-1.0,
+                    multi_output=False, use_ignore=False,
+                    preserve_shape=False, normalization="null",
+                    out_grad=False, smooth_alpha=0.0, **_):
+    axis = 1 if multi_output else -1
+
+    @jax.custom_vjp
+    def f(d, l):
+        return jnn.softmax(d, axis=axis)
+
+    def fwd(d, l):
+        p = jnn.softmax(d, axis=axis)
+        return p, (p, l)
+
+    def bwd(res, g):
+        p, l = res
+        li = l.astype(jnp.int32)
+        nclass = p.shape[axis]
+        if multi_output:
+            onehot = jnp.moveaxis(
+                jnn.one_hot(li, nclass, dtype=p.dtype), -1, 1)
+        else:
+            onehot = jnn.one_hot(li, nclass, dtype=p.dtype)
+            if onehot.shape != p.shape:
+                onehot = onehot.reshape(p.shape)
+        if smooth_alpha:
+            onehot = onehot * (1 - smooth_alpha) + smooth_alpha / nclass
+        grad = p - onehot
+        valid = None
+        if use_ignore:
+            keep = (l != ignore_label).astype(p.dtype)
+            valid = keep
+            kshape = list(l.shape)
+            if multi_output:
+                keep_b = jnp.expand_dims(keep, 1)
+            else:
+                keep_b = keep.reshape(kshape + [1] * (p.ndim - l.ndim))
+            grad = grad * keep_b
+        grad = grad * (grad_scale / _norm_factor(normalization, l, valid))
+        return grad.astype(p.dtype), jnp.zeros_like(l)
+
+    f.defvjp(fwd, bwd)
+    return f(data, label)
+
+
+def _regression(name, fwd_fn, grad_fn):
+    @register(name, arg_names=("data", "label"), nondiff_inputs=(1,),
+              defaults={"grad_scale": 1.0})
+    def _f(data, label, grad_scale=1.0, **_):
+        @jax.custom_vjp
+        def f(d, l):
+            return fwd_fn(d)
+
+        def fwd(d, l):
+            return fwd_fn(d), (fwd_fn(d), l)
+
+        def bwd(res, g):
+            out, l = res
+            grad = grad_fn(out, l.reshape(out.shape)) * grad_scale
+            return grad.astype(out.dtype), jnp.zeros_like(l)
+
+        f.defvjp(fwd, bwd)
+        return f(data, label)
+    return _f
+
+
+_regression("LinearRegressionOutput", lambda d: d, lambda o, l: o - l)
+_regression("MAERegressionOutput", lambda d: d, lambda o, l: jnp.sign(o - l))
+_regression("LogisticRegressionOutput", jnn.sigmoid, lambda o, l: o - l)
+
+
+@register("MakeLoss", arg_names=("data",),
+          defaults={"grad_scale": 1.0, "valid_thresh": 0.0,
+                    "normalization": "null"})
+def _make_loss(data, grad_scale=1.0, valid_thresh=0.0,
+               normalization="null", **_):
+    @jax.custom_vjp
+    def f(d):
+        return d
+
+    def fwd(d):
+        return d, d
+
+    def bwd(d, g):
+        if normalization == "batch":
+            scale = grad_scale / d.shape[0]
+        elif normalization == "valid":
+            scale = grad_scale / jnp.maximum(
+                jnp.sum((d > valid_thresh).astype(d.dtype)), 1.0)
+        else:
+            scale = grad_scale
+        return (jnp.full_like(d, 1.0) * scale,)
+
+    f.defvjp(fwd, bwd)
+    return f(data)
+
+
+@register("SVMOutput", arg_names=("data", "label"), nondiff_inputs=(1,),
+          defaults={"margin": 1.0, "regularization_coefficient": 1.0,
+                    "use_linear": False})
+def _svm_output(data, label, margin=1.0, regularization_coefficient=1.0,
+                use_linear=False, **_):
+    @jax.custom_vjp
+    def f(d, l):
+        return d
+
+    def fwd(d, l):
+        return d, (d, l)
+
+    def bwd(res, g):
+        d, l = res
+        li = l.astype(jnp.int32)
+        nclass = d.shape[-1]
+        onehot = jnn.one_hot(li, nclass, dtype=d.dtype)
+        score_y = jnp.take_along_axis(d, li[:, None], axis=-1)
+        viol = (margin - (score_y - d)) > 0
+        viol = viol & (onehot == 0)
+        if use_linear:
+            grad = viol.astype(d.dtype)
+        else:
+            grad = 2 * jnp.maximum(margin - (score_y - d), 0) * \
+                viol.astype(d.dtype)
+        grad = grad - onehot * jnp.sum(grad, axis=-1, keepdims=True)
+        return grad * regularization_coefficient, jnp.zeros_like(l)
+
+    f.defvjp(fwd, bwd)
+    return f(data, label)
+
+
+@register("IdentityAttachKLSparseReg", arg_names=("data",),
+          defaults={"sparseness_target": 0.1, "penalty": 0.001,
+                    "momentum": 0.9})
+def _identity_kl(data, **_):
+    return data

@@ -1,0 +1,261 @@
+"""Shape-manipulation + matrix ops.
+
+Reference: src/operator/tensor/matrix_op.* (SURVEY.md N11): reshape,
+transpose, slice, clip, repeat, tile, flip, dot, concat, stack, split, pad,
+swapaxes, expand_dims, where, cast_storage.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import register
+
+
+@register("reshape", arg_names=("data",), aliases=("Reshape",),
+          defaults={"shape": (), "reverse": False})
+def _reshape(x, shape=(), reverse=False, **_):
+    shape = tuple(shape)
+    if not shape:
+        return x
+    # MXNet special codes: 0 copy dim, -1 infer, -2 copy rest, -3 merge two,
+    # -4 split (src/operator/tensor/matrix_op-inl.h InferReshapeShape)
+    src = list(x.shape[::-1]) if reverse else list(x.shape)
+    out = []
+    i = 0
+    it = iter(range(len(shape)))
+    shp = list(shape[::-1]) if reverse else list(shape)
+    k = 0
+    while k < len(shp):
+        s = shp[k]
+        if s == 0:
+            out.append(src[i]); i += 1
+        elif s == -1:
+            out.append(-1); i += 1
+        elif s == -2:
+            out.extend(src[i:]); i = len(src)
+        elif s == -3:
+            out.append(src[i] * src[i + 1]); i += 2
+        elif s == -4:
+            a, b = shp[k + 1], shp[k + 2]
+            if a == -1:
+                a = src[i] // b
+            if b == -1:
+                b = src[i] // a
+            out.extend([a, b]); i += 1; k += 2
+        else:
+            out.append(s)
+            if i < len(src):
+                i += 1
+        k += 1
+    if reverse:
+        out = out[::-1]
+    return x.reshape(tuple(out))
+
+
+@register("Flatten", arg_names=("data",), aliases=("flatten",))
+def _flatten(x, **_):
+    return x.reshape(x.shape[0], -1)
+
+
+@register("transpose", arg_names=("data",), defaults={"axes": ()})
+def _transpose(x, axes=(), **_):
+    return jnp.transpose(x, tuple(axes) if axes else None)
+
+
+@register("SwapAxis", arg_names=("data",), aliases=("swapaxes",),
+          defaults={"dim1": 0, "dim2": 0})
+def _swapaxes(x, dim1=0, dim2=0, **_):
+    return jnp.swapaxes(x, dim1, dim2)
+
+
+@register("expand_dims", arg_names=("data",), defaults={"axis": 0})
+def _expand_dims(x, axis=0, **_):
+    return jnp.expand_dims(x, axis)
+
+
+@register("squeeze", arg_names=("data",), defaults={"axis": None})
+def _squeeze(x, axis=None, **_):
+    return jnp.squeeze(x, axis=axis)
+
+
+@register("slice", arg_names=("data",), aliases=("crop",),
+          defaults={"begin": (), "end": (), "step": None})
+def _slice(x, begin=(), end=(), step=None, **_):
+    begin = (begin,) if isinstance(begin, int) else tuple(begin)
+    end = (end,) if isinstance(end, int) else tuple(end)
+    step = tuple(step) if step else (None,) * len(begin)
+    idx = []
+    for b, e, s in zip(begin, end, step):
+        idx.append(slice(b, e, s))
+    return x[tuple(idx)]
+
+
+@register("slice_axis", arg_names=("data",),
+          defaults={"axis": 0, "begin": 0, "end": None})
+def _slice_axis(x, axis=0, begin=0, end=None, **_):
+    idx = [slice(None)] * x.ndim
+    idx[axis] = slice(begin, end)
+    return x[tuple(idx)]
+
+
+@register("slice_like", arg_names=("data", "shape_like"), nondiff_inputs=(1,),
+          defaults={"axes": ()})
+def _slice_like(x, ref, axes=(), **_):
+    axes = tuple(axes) if axes else tuple(range(min(x.ndim, ref.ndim)))
+    idx = [slice(None)] * x.ndim
+    for a in axes:
+        idx[a] = slice(0, ref.shape[a])
+    return x[tuple(idx)]
+
+
+@register("_index", arg_names=("data",), defaults={"index": ()})
+def _index_op(x, index=(), **_):
+    from ..ndarray.ndarray import _unwrap_index
+    return x[_unwrap_index(index)]
+
+
+@register("_slice_assign", arg_names=("lhs", "rhs"),
+          defaults={"begin": (), "end": (), "step": None})
+def _slice_assign(lhs, rhs, begin=(), end=(), step=None, **_):
+    idx = tuple(slice(b, e) for b, e in zip(begin, end))
+    return lhs.at[idx].set(rhs)
+
+
+@register("_crop_assign_scalar", arg_names=("data",),
+          defaults={"begin": (), "end": (), "scalar": 0.0})
+def _crop_assign_scalar(x, begin=(), end=(), scalar=0.0, **_):
+    idx = tuple(slice(b, e) for b, e in zip(begin, end))
+    return x.at[idx].set(scalar)
+
+
+@register("repeat", arg_names=("data",),
+          defaults={"repeats": 1, "axis": None})
+def _repeat(x, repeats=1, axis=None, **_):
+    if axis is None:
+        return jnp.repeat(x.reshape(-1), repeats)
+    return jnp.repeat(x, repeats, axis=axis)
+
+
+@register("tile", arg_names=("data",), defaults={"reps": ()})
+def _tile(x, reps=(), **_):
+    return jnp.tile(x, tuple(reps))
+
+
+@register("reverse", arg_names=("data",), aliases=("flip",),
+          defaults={"axis": ()})
+def _reverse(x, axis=(), **_):
+    axis = (axis,) if isinstance(axis, int) else tuple(axis)
+    return jnp.flip(x, axis=axis)
+
+
+@register("stack", arg_names=None, defaults={"axis": 0, "num_args": 0})
+def _stack(*args, axis=0, **_):
+    return jnp.stack(args, axis=axis)
+
+
+@register("Concat", arg_names=None, aliases=("concat",),
+          defaults={"dim": 1, "num_args": 0})
+def _concat(*args, dim=1, **_):
+    return jnp.concatenate(args, axis=dim)
+
+
+@register("SliceChannel", arg_names=("data",), aliases=("split",),
+          defaults={"num_outputs": 1, "axis": 1, "squeeze_axis": False})
+def _slice_channel(x, num_outputs=1, axis=1, squeeze_axis=False, **_):
+    parts = jnp.split(x, num_outputs, axis=axis)
+    if squeeze_axis:
+        parts = [jnp.squeeze(p, axis=axis) for p in parts]
+    return tuple(parts)
+
+
+@register("where", arg_names=("condition", "x", "y"), nondiff_inputs=(0,))
+def _where(cond, x, y, **_):
+    if cond.shape != x.shape and cond.ndim == 1:
+        cond = cond.reshape((-1,) + (1,) * (x.ndim - 1))
+    return jnp.where(cond != 0, x, y)
+
+
+@register("Pad", arg_names=("data",), aliases=("pad",),
+          defaults={"mode": "constant", "pad_width": (), "constant_value": 0.0})
+def _pad(x, mode="constant", pad_width=(), constant_value=0.0, **_):
+    pw = tuple(pad_width)
+    pairs = tuple((pw[2 * i], pw[2 * i + 1]) for i in range(len(pw) // 2))
+    if mode == "constant":
+        return jnp.pad(x, pairs, constant_values=constant_value)
+    if mode == "edge":
+        return jnp.pad(x, pairs, mode="edge")
+    if mode == "reflect":
+        return jnp.pad(x, pairs, mode="reflect")
+    raise ValueError("unknown pad mode %r" % mode)
+
+
+@register("dot", arg_names=("lhs", "rhs"),
+          defaults={"transpose_a": False, "transpose_b": False})
+def _dot(lhs, rhs, transpose_a=False, transpose_b=False, **_):
+    if transpose_a:
+        lhs = lhs.T if lhs.ndim == 2 else jnp.moveaxis(lhs, 0, -1)
+    if transpose_b:
+        rhs = rhs.T if rhs.ndim == 2 else jnp.moveaxis(rhs, -1, 0)
+    if lhs.ndim == 1 and rhs.ndim == 1:
+        return jnp.dot(lhs, rhs).reshape((1,))
+    return jnp.tensordot(lhs, rhs, axes=([lhs.ndim - 1], [0]))
+
+
+@register("batch_dot", arg_names=("lhs", "rhs"),
+          defaults={"transpose_a": False, "transpose_b": False})
+def _batch_dot(lhs, rhs, transpose_a=False, transpose_b=False, **_):
+    if transpose_a:
+        lhs = jnp.swapaxes(lhs, -1, -2)
+    if transpose_b:
+        rhs = jnp.swapaxes(rhs, -1, -2)
+    return jnp.matmul(lhs, rhs)
+
+
+@register("cast_storage", arg_names=("data",), defaults={"stype": "default"})
+def _cast_storage(x, stype="default", **_):
+    # dense compute path: storage casting is a metadata-level operation
+    # handled by ndarray.sparse; within jit everything is dense.
+    return x
+
+
+# -- ordering ---------------------------------------------------------------
+
+@register("topk", arg_names=("data",), differentiable=False,
+          defaults={"axis": -1, "k": 1, "ret_typ": "indices",
+                    "is_ascend": False})
+def _topk(x, axis=-1, k=1, ret_typ="indices", is_ascend=False, **_):
+    axis = axis % x.ndim if axis is not None else x.ndim - 1
+    xm = jnp.moveaxis(x, axis, -1)
+    vals, idx = lax.top_k(-xm if is_ascend else xm, k)
+    if is_ascend:
+        vals = -vals
+    vals = jnp.moveaxis(vals, -1, axis)
+    idx = jnp.moveaxis(idx, -1, axis).astype(jnp.float32)
+    if ret_typ == "indices":
+        return idx
+    if ret_typ == "value":
+        return vals
+    if ret_typ == "both":
+        return (vals, idx)
+    if ret_typ == "mask":
+        onehot = jnp.sum(jnp.eye(xm.shape[-1], dtype=x.dtype)[
+            jnp.moveaxis(idx, axis, -1).astype(jnp.int32)], axis=-2)
+        return jnp.moveaxis(onehot, -1, axis).reshape(x.shape)
+    raise ValueError("unknown ret_typ %r" % ret_typ)
+
+
+@register("sort", arg_names=("data",), differentiable=False,
+          defaults={"axis": -1, "is_ascend": True})
+def _sort(x, axis=-1, is_ascend=True, **_):
+    out = jnp.sort(x, axis=axis)
+    return out if is_ascend else jnp.flip(out, axis=axis)
+
+
+@register("argsort", arg_names=("data",), differentiable=False,
+          defaults={"axis": -1, "is_ascend": True})
+def _argsort(x, axis=-1, is_ascend=True, **_):
+    out = jnp.argsort(x, axis=axis)
+    if not is_ascend:
+        out = jnp.flip(out, axis=axis)
+    return out.astype(jnp.float32)

@@ -1,0 +1,141 @@
+"""Fused optimizer update ops — reference src/operator/optimizer_op.*
+(SURVEY.md N12): each Python Optimizer step is ONE fused op. Under XLA the
+whole update fuses into a single elementwise kernel per parameter (and can
+further fuse into the training step when jitted) — the TPU analogue of the
+reference's single engine push per update.
+
+Calling convention mirrors the reference: ``sgd_update(w, g, out=w)``
+in-place on the weight; optimizer state tensors (momentum, adam mean/var)
+are declared ``state_inputs`` so they are updated in place too.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .registry import register
+
+
+def _prep(grad, wd, weight, rescale_grad, clip_gradient):
+    g = grad * rescale_grad
+    if clip_gradient is not None and clip_gradient > 0:
+        g = jnp.clip(g, -clip_gradient, clip_gradient)
+    return g + wd * weight
+
+
+_COMMON = {"lr": 0.01, "wd": 0.0, "rescale_grad": 1.0,
+           "clip_gradient": -1.0}
+
+
+@register("sgd_update", arg_names=("weight", "grad"), differentiable=False,
+          defaults=_COMMON)
+def _sgd_update(weight, grad, lr=0.01, wd=0.0, rescale_grad=1.0,
+                clip_gradient=-1.0, **_):
+    g = _prep(grad, wd, weight, rescale_grad, clip_gradient)
+    return weight - lr * g
+
+
+@register("sgd_mom_update", arg_names=("weight", "grad", "mom"),
+          differentiable=False, state_inputs=(2,),
+          defaults={**_COMMON, "momentum": 0.0})
+def _sgd_mom_update(weight, grad, mom, lr=0.01, momentum=0.0, wd=0.0,
+                    rescale_grad=1.0, clip_gradient=-1.0, **_):
+    g = _prep(grad, wd, weight, rescale_grad, clip_gradient)
+    new_mom = momentum * mom - lr * g
+    return weight + new_mom, new_mom
+
+
+@register("mp_sgd_update", arg_names=("weight", "grad", "weight32"),
+          differentiable=False, state_inputs=(2,), defaults=_COMMON)
+def _mp_sgd_update(weight, grad, weight32, lr=0.01, wd=0.0,
+                   rescale_grad=1.0, clip_gradient=-1.0, **_):
+    g = _prep(grad.astype(jnp.float32), wd, weight32, rescale_grad,
+              clip_gradient)
+    new_w32 = weight32 - lr * g
+    return new_w32.astype(weight.dtype), new_w32
+
+
+@register("mp_sgd_mom_update",
+          arg_names=("weight", "grad", "mom", "weight32"),
+          differentiable=False, state_inputs=(2, 3),
+          defaults={**_COMMON, "momentum": 0.0})
+def _mp_sgd_mom_update(weight, grad, mom, weight32, lr=0.01, momentum=0.0,
+                       wd=0.0, rescale_grad=1.0, clip_gradient=-1.0, **_):
+    g = _prep(grad.astype(jnp.float32), wd, weight32, rescale_grad,
+              clip_gradient)
+    new_mom = momentum * mom - lr * g
+    new_w32 = weight32 + new_mom
+    return new_w32.astype(weight.dtype), new_mom, new_w32
+
+
+@register("adam_update", arg_names=("weight", "grad", "mean", "var"),
+          differentiable=False, state_inputs=(2, 3),
+          defaults={**_COMMON, "beta1": 0.9, "beta2": 0.999,
+                    "epsilon": 1e-8})
+def _adam_update(weight, grad, mean, var, lr=0.01, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, wd=0.0, rescale_grad=1.0,
+                 clip_gradient=-1.0, **_):
+    g = _prep(grad, wd, weight, rescale_grad, clip_gradient)
+    new_mean = beta1 * mean + (1 - beta1) * g
+    new_var = beta2 * var + (1 - beta2) * jnp.square(g)
+    new_w = weight - lr * new_mean / (jnp.sqrt(new_var) + epsilon)
+    return new_w, new_mean, new_var
+
+
+@register("rmsprop_update", arg_names=("weight", "grad", "n"),
+          differentiable=False, state_inputs=(2,),
+          defaults={**_COMMON, "gamma1": 0.95, "epsilon": 1e-8,
+                    "clip_weights": -1.0})
+def _rmsprop_update(weight, grad, n, lr=0.01, gamma1=0.95, epsilon=1e-8,
+                    wd=0.0, rescale_grad=1.0, clip_gradient=-1.0,
+                    clip_weights=-1.0, **_):
+    g = _prep(grad, wd, weight, rescale_grad, clip_gradient)
+    new_n = (1 - gamma1) * jnp.square(g) + gamma1 * n
+    new_w = weight - lr * g / jnp.sqrt(new_n + epsilon)
+    if clip_weights is not None and clip_weights > 0:
+        new_w = jnp.clip(new_w, -clip_weights, clip_weights)
+    return new_w, new_n
+
+
+@register("rmspropalex_update", arg_names=("weight", "grad", "n", "g",
+                                           "delta"),
+          differentiable=False, state_inputs=(2, 3, 4),
+          defaults={**_COMMON, "gamma1": 0.95, "gamma2": 0.9,
+                    "epsilon": 1e-8, "clip_weights": -1.0})
+def _rmspropalex_update(weight, grad, n, g, delta, lr=0.01, gamma1=0.95,
+                        gamma2=0.9, epsilon=1e-8, wd=0.0, rescale_grad=1.0,
+                        clip_gradient=-1.0, clip_weights=-1.0, **_):
+    gr = _prep(grad, wd, weight, rescale_grad, clip_gradient)
+    new_n = (1 - gamma1) * jnp.square(gr) + gamma1 * n
+    new_g = (1 - gamma1) * gr + gamma1 * g
+    new_delta = gamma2 * delta - lr * gr / \
+        jnp.sqrt(new_n - jnp.square(new_g) + epsilon)
+    new_w = weight + new_delta
+    if clip_weights is not None and clip_weights > 0:
+        new_w = jnp.clip(new_w, -clip_weights, clip_weights)
+    return new_w, new_n, new_g, new_delta
+
+
+@register("ftrl_update", arg_names=("weight", "grad", "z", "n"),
+          differentiable=False, state_inputs=(2, 3),
+          defaults={**_COMMON, "lamda1": 0.01, "beta": 1.0})
+def _ftrl_update(weight, grad, z, n, lr=0.01, lamda1=0.01, beta=1.0,
+                 wd=0.0, rescale_grad=1.0, clip_gradient=-1.0, **_):
+    g = grad * rescale_grad
+    if clip_gradient is not None and clip_gradient > 0:
+        g = jnp.clip(g, -clip_gradient, clip_gradient)
+    new_n = n + jnp.square(g)
+    sigma = (jnp.sqrt(new_n) - jnp.sqrt(n)) / lr
+    new_z = z + g - sigma * weight
+    new_w = jnp.where(
+        jnp.abs(new_z) <= lamda1, 0.0,
+        -(new_z - jnp.sign(new_z) * lamda1) /
+        ((beta + jnp.sqrt(new_n)) / lr + wd))
+    return new_w, new_z, new_n
+
+
+@register("signsgd_update", arg_names=("weight", "grad"),
+          differentiable=False, defaults=_COMMON)
+def _signsgd_update(weight, grad, lr=0.01, wd=0.0, rescale_grad=1.0,
+                    clip_gradient=-1.0, **_):
+    g = _prep(grad, wd, weight, rescale_grad, clip_gradient)
+    return weight - lr * jnp.sign(g)

@@ -1,0 +1,168 @@
+"""Random samplers — reference src/operator/random/ (SURVEY.md N11).
+
+All take a traced PRNG key (needs_rng); eager calls split the global stream
+(mx.random.seed reproducibility), compiled executors thread an explicit key.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..base import np_dtype
+from .registry import register
+
+
+def _shape(shape):
+    if shape is None:
+        return ()
+    return (shape,) if isinstance(shape, int) else tuple(shape)
+
+
+def _rand(name, sampler, defaults, aliases=()):
+    @register(name, arg_names=(), differentiable=False, needs_rng=True,
+              aliases=aliases,
+              defaults={**defaults, "shape": None, "dtype": "float32",
+                        "ctx": None})
+    def _f(shape=None, dtype="float32", rng=None, **kw):
+        return sampler(rng, _shape(shape), np_dtype(dtype), kw)
+    return _f
+
+
+_rand("_random_uniform",
+      lambda rng, s, dt, kw: jax.random.uniform(
+          rng, s, dt, minval=kw.get("low", 0.0), maxval=kw.get("high", 1.0)),
+      {"low": 0.0, "high": 1.0}, aliases=("uniform", "random_uniform"))
+
+_rand("_random_normal",
+      lambda rng, s, dt, kw: kw.get("loc", 0.0) + kw.get("scale", 1.0) *
+      jax.random.normal(rng, s, dt),
+      {"loc": 0.0, "scale": 1.0}, aliases=("normal", "random_normal",
+                                           "randn"))
+
+_rand("_random_exponential",
+      lambda rng, s, dt, kw: jax.random.exponential(rng, s, dt) /
+      kw.get("lam", 1.0),
+      {"lam": 1.0}, aliases=("random_exponential", "exponential"))
+
+_rand("_random_gamma",
+      lambda rng, s, dt, kw: jax.random.gamma(
+          rng, kw.get("alpha", 1.0), s, dt) * kw.get("beta", 1.0),
+      {"alpha": 1.0, "beta": 1.0}, aliases=("random_gamma",))
+
+_rand("_random_poisson",
+      lambda rng, s, dt, kw: jax.random.poisson(
+          rng, kw.get("lam", 1.0), s).astype(dt),
+      {"lam": 1.0}, aliases=("random_poisson", "poisson"))
+
+_rand("_random_negative_binomial",
+      lambda rng, s, dt, kw: _neg_binomial(rng, kw.get("k", 1),
+                                           kw.get("p", 1.0), s).astype(dt),
+      {"k": 1, "p": 1.0}, aliases=("random_negative_binomial",
+                                   "negative_binomial"))
+
+_rand("_random_generalized_negative_binomial",
+      lambda rng, s, dt, kw: _gen_neg_binomial(
+          rng, kw.get("mu", 1.0), kw.get("alpha", 1.0), s).astype(dt),
+      {"mu": 1.0, "alpha": 1.0},
+      aliases=("random_generalized_negative_binomial",
+               "generalized_negative_binomial"))
+
+
+def _neg_binomial(rng, k, p, shape):
+    k1, k2 = jax.random.split(rng)
+    lam = jax.random.gamma(k1, k, shape) * ((1 - p) / p)
+    return jax.random.poisson(k2, lam, shape)
+
+
+def _gen_neg_binomial(rng, mu, alpha, shape):
+    k1, k2 = jax.random.split(rng)
+    r = 1.0 / alpha
+    p = r / (r + mu)
+    lam = jax.random.gamma(k1, r, shape) * ((1 - p) / p)
+    return jax.random.poisson(k2, lam, shape)
+
+
+@register("sample_multinomial", arg_names=("data",), differentiable=False,
+          needs_rng=True, aliases=("_sample_multinomial",),
+          defaults={"shape": None, "get_prob": False, "dtype": "int32"})
+def _sample_multinomial(data, shape=None, get_prob=False, dtype="int32",
+                        rng=None, **_):
+    n = 1
+    if shape:
+        n = int(jnp.prod(jnp.asarray(_shape(shape))))
+    logits = jnp.log(jnp.maximum(data, 1e-20))
+    if data.ndim == 1:
+        samples = jax.random.categorical(rng, logits, shape=(n,))
+        out = samples if shape else samples[0]
+    else:
+        samples = jax.random.categorical(rng, logits[:, None, :], axis=-1,
+                                         shape=(data.shape[0], n))
+        out = samples if shape else samples[:, 0]
+    out = out.astype(np_dtype(dtype))
+    if get_prob:
+        if data.ndim == 1:
+            lp = jnp.log(jnp.maximum(data[out.astype(jnp.int32)], 1e-20))
+        else:
+            lp = jnp.log(jnp.maximum(jnp.take_along_axis(
+                data, out.astype(jnp.int32).reshape(data.shape[0], -1),
+                axis=-1), 1e-20)).reshape(out.shape)
+        return out, lp
+    return out
+
+
+def _sample_vec(name, sampler):
+    """`_sample_*` ops: per-distribution-parameter draws (reference
+    src/operator/random/sample_op.cc multi-distribution samplers)."""
+    @register(name, arg_names=None, differentiable=False, needs_rng=True,
+              defaults={"shape": None, "dtype": "float32"})
+    def _f(*params, shape=None, dtype="float32", rng=None, **_):
+        s = _shape(shape)
+        dt = np_dtype(dtype)
+        p0 = params[0]
+        full = p0.shape + s
+        draws = sampler(rng, [jnp.broadcast_to(
+            p.reshape(p.shape + (1,) * len(s)), full) for p in params],
+            full, dt)
+        return draws.astype(dt)
+    return _f
+
+
+_sample_vec("_sample_uniform",
+            lambda rng, ps, s, dt: jax.random.uniform(rng, s, dt) *
+            (ps[1] - ps[0]) + ps[0])
+_sample_vec("_sample_normal",
+            lambda rng, ps, s, dt: ps[0] +
+            ps[1] * jax.random.normal(rng, s, dt))
+_sample_vec("_sample_exponential",
+            lambda rng, ps, s, dt: jax.random.exponential(rng, s, dt) / ps[0])
+_sample_vec("_sample_gamma",
+            lambda rng, ps, s, dt: jax.random.gamma(rng, ps[0], s, dt) *
+            ps[1])
+_sample_vec("_sample_poisson",
+            lambda rng, ps, s, dt: jax.random.poisson(
+                rng, ps[0], s).astype(dt))
+_sample_vec("_sample_negative_binomial",
+            lambda rng, ps, s, dt: _neg_binomial_arr(rng, ps[0], ps[1], s))
+_sample_vec("_sample_generalized_negative_binomial",
+            lambda rng, ps, s, dt: _gen_neg_binomial_arr(rng, ps[0], ps[1],
+                                                         s))
+
+
+def _neg_binomial_arr(rng, k, p, shape):
+    k1, k2 = jax.random.split(rng)
+    lam = jax.random.gamma(k1, k, shape) * ((1 - p) / p)
+    return jax.random.poisson(k2, lam, shape)
+
+
+def _gen_neg_binomial_arr(rng, mu, alpha, shape):
+    k1, k2 = jax.random.split(rng)
+    r = 1.0 / alpha
+    p = r / (r + mu)
+    lam = jax.random.gamma(k1, r, shape) * ((1 - p) / p)
+    return jax.random.poisson(k2, lam, shape)
+
+
+@register("shuffle", arg_names=("data",), differentiable=False,
+          needs_rng=True, aliases=("_shuffle",))
+def _shuffle(data, rng=None, **_):
+    return jax.random.permutation(rng, data, axis=0)

@@ -1,0 +1,149 @@
+"""Reductions + broadcasting helpers + softmax family.
+
+Reference: src/operator/tensor/broadcast_reduce_op.* (SURVEY.md N11).
+MXNet reduce semantics: ``axis=None`` reduces everything to shape (1,);
+``keepdims`` keeps reduced dims; ``exclude`` inverts the axis set.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax import lax, nn as jnn
+
+from .registry import register
+
+
+def _axes(x, axis, exclude=False):
+    if axis is None or axis == ():
+        axes = tuple(range(x.ndim))
+    elif isinstance(axis, int):
+        axes = (axis % x.ndim,)
+    else:
+        axes = tuple(a % x.ndim for a in axis)
+    if exclude:
+        axes = tuple(a for a in range(x.ndim) if a not in axes)
+    return axes
+
+
+def _reduce(name, fn, differentiable=True, aliases=()):
+    @register(name, arg_names=("data",), differentiable=differentiable,
+              aliases=aliases,
+              defaults={"axis": None, "keepdims": False, "exclude": False})
+    def _f(x, axis=None, keepdims=False, exclude=False, **_):
+        axes = _axes(x, axis, exclude)
+        out = fn(x, axes, keepdims)
+        if axis is None and not keepdims:
+            out = out.reshape((1,)) if out.ndim == 0 else out
+        return out
+    return _f
+
+
+_reduce("sum", lambda x, a, k: jnp.sum(x, axis=a, keepdims=k),
+        aliases=("sum_axis",))
+_reduce("mean", lambda x, a, k: jnp.mean(x, axis=a, keepdims=k))
+_reduce("prod", lambda x, a, k: jnp.prod(x, axis=a, keepdims=k))
+_reduce("nansum", lambda x, a, k: jnp.nansum(x, axis=a, keepdims=k))
+_reduce("nanprod", lambda x, a, k: jnp.nanprod(x, axis=a, keepdims=k))
+_reduce("max", lambda x, a, k: jnp.max(x, axis=a, keepdims=k),
+        aliases=("max_axis",))
+_reduce("min", lambda x, a, k: jnp.min(x, axis=a, keepdims=k),
+        aliases=("min_axis",))
+
+
+@register("argmax", arg_names=("data",), differentiable=False,
+          defaults={"axis": None, "keepdims": False})
+def _argmax(x, axis=None, keepdims=False, **_):
+    out = jnp.argmax(x.reshape(-1) if axis is None else x, axis=0 if axis is None else axis)
+    out = out.astype(jnp.float32)
+    if keepdims and axis is not None:
+        out = jnp.expand_dims(out, axis)
+    return out
+
+
+@register("argmin", arg_names=("data",), differentiable=False,
+          defaults={"axis": None, "keepdims": False})
+def _argmin(x, axis=None, keepdims=False, **_):
+    out = jnp.argmin(x.reshape(-1) if axis is None else x, axis=0 if axis is None else axis)
+    out = out.astype(jnp.float32)
+    if keepdims and axis is not None:
+        out = jnp.expand_dims(out, axis)
+    return out
+
+
+@register("argmax_channel", arg_names=("data",), differentiable=False)
+def _argmax_channel(x, **_):
+    return jnp.argmax(x, axis=-1).astype(jnp.float32)
+
+
+@register("norm", arg_names=("data",),
+          defaults={"ord": 2, "axis": None, "keepdims": False})
+def _norm(x, ord=2, axis=None, keepdims=False, **_):
+    if axis is None:
+        out = jnp.sqrt(jnp.sum(jnp.square(x)))
+        return out.reshape((1,))
+    return jnp.linalg.norm(x, ord=ord, axis=axis, keepdims=keepdims)
+
+
+@register("L2Normalization", arg_names=("data",),
+          defaults={"eps": 1e-10, "mode": "instance"})
+def _l2norm(x, eps=1e-10, mode="instance", **_):
+    if mode == "instance":
+        n = jnp.sqrt(jnp.sum(jnp.square(x.reshape(x.shape[0], -1)),
+                             axis=1) + eps)
+        return x / n.reshape((-1,) + (1,) * (x.ndim - 1))
+    if mode == "channel":
+        n = jnp.sqrt(jnp.sum(jnp.square(x), axis=1, keepdims=True) + eps)
+        return x / n
+    if mode == "spatial":
+        axes = tuple(range(2, x.ndim))
+        n = jnp.sqrt(jnp.sum(jnp.square(x), axis=axes, keepdims=True) + eps)
+        return x / n
+    raise ValueError("unknown mode %r" % mode)
+
+
+@register("broadcast_axis", arg_names=("data",), aliases=("broadcast_axes",),
+          defaults={"axis": (), "size": ()})
+def _broadcast_axis(x, axis=(), size=(), **_):
+    axis = (axis,) if isinstance(axis, int) else tuple(axis)
+    size = (size,) if isinstance(size, int) else tuple(size)
+    shape = list(x.shape)
+    for a, s in zip(axis, size):
+        shape[a] = s
+    return jnp.broadcast_to(x, tuple(shape))
+
+
+@register("broadcast_to", arg_names=("data",), defaults={"shape": ()})
+def _broadcast_to(x, shape=(), **_):
+    tgt = tuple(s if s != 0 else x.shape[i] for i, s in enumerate(shape))
+    return jnp.broadcast_to(x, tgt)
+
+
+@register("broadcast_like", arg_names=("lhs", "rhs"), nondiff_inputs=(1,))
+def _broadcast_like(lhs, rhs, **_):
+    return jnp.broadcast_to(lhs, rhs.shape)
+
+
+# -- softmax family ----------------------------------------------------------
+
+@register("softmax", arg_names=("data",),
+          defaults={"axis": -1, "temperature": None})
+def _softmax(x, axis=-1, temperature=None, **_):
+    if temperature is not None and temperature != 1.0:
+        x = x / temperature
+    return jnn.softmax(x, axis=axis)
+
+
+@register("log_softmax", arg_names=("data",),
+          defaults={"axis": -1, "temperature": None})
+def _log_softmax(x, axis=-1, temperature=None, **_):
+    if temperature is not None and temperature != 1.0:
+        x = x / temperature
+    return jnn.log_softmax(x, axis=axis)
+
+
+@register("softmax_cross_entropy", arg_names=("data", "label"),
+          nondiff_inputs=(1,))
+def _softmax_xent(data, label, **_):
+    logp = jnn.log_softmax(data, axis=-1)
+    idx = label.astype(jnp.int32)
+    picked = jnp.take_along_axis(logp, idx[:, None], axis=-1)
+    return -jnp.sum(picked).reshape((1,))

@@ -1,0 +1,254 @@
+"""The operator registry — the TPU-native analogue of MXNet's NNVM op registry
+plus the imperative dispatch path.
+
+Reference architecture being replaced (see SURVEY.md N1/N6/N7/N17):
+  * ``NNVM_REGISTER_OP`` + ``FCompute`` kernels (include/mxnet/op_attr_types.h)
+  * ``MXImperativeInvoke`` eager dispatch (src/c_api/c_api_ndarray.cc:491-556)
+  * the ThreadedEngine async scheduler (src/engine/threaded_engine.cc)
+
+TPU-native design: every op is ONE pure JAX function ``fn(*arrays, **attrs)``.
+Eager calls dispatch through a per-(op, attrs) ``jax.jit`` cache — JAX's async
+dispatch *is* the dependency engine (XLA orders work by data dependence, just
+as ThreadedVar queues did, but on-device). The same registry entry also backs
+the deferred ``Symbol`` graph and autograd, so — exactly like the reference —
+imperative and symbolic modes share every kernel.
+
+Each registered op materializes as ``mx.nd.<name>`` and ``mx.sym.<name>``
+(reference: python/mxnet/base.py:381 auto-generation).
+"""
+from __future__ import annotations
+
+import ast
+import functools
+from dataclasses import dataclass, field
+from typing import Callable, Optional, Sequence
+
+import jax
+import numpy as np
+
+__all__ = ["OpDef", "register", "get_op", "list_ops", "invoke_eager",
+           "canon_attrs", "jitted_op"]
+
+_OP_REGISTRY: dict[str, "OpDef"] = {}
+_ALIASES: dict[str, str] = {}
+
+
+@dataclass
+class OpDef:
+    """One operator.
+
+    fn: pure function ``(*jax_arrays, **attrs) -> array | tuple``. When
+        ``needs_rng`` it must also accept a traced ``rng`` keyword (a JAX
+        PRNG key); when ``takes_is_train`` it receives ``is_train: bool``
+        as a *static* attr.
+    arg_names: tensor-input names in order; None => variadic (add_n, Concat).
+    num_visible: user-facing outputs (BatchNorm computes 5, exposes 3 —
+        mirroring num_visible_outputs in the reference's nnvm registration).
+    state_inputs: input indices that receive the trailing fn outputs as
+        in-place updates (aux states: BN moving_mean/var; optimizer weight).
+    """
+    name: str
+    fn: Callable
+    arg_names: Optional[tuple] = None
+    differentiable: bool = True
+    needs_rng: bool = False
+    takes_is_train: bool = False
+    num_visible: Optional[int] = None
+    state_inputs: tuple = ()
+    nondiff_inputs: tuple = ()   # input indices with no gradient (e.g. indices)
+    aliases: Sequence[str] = field(default_factory=tuple)
+    defaults: dict = field(default_factory=dict)
+    doc: str = ""
+
+    @property
+    def num_state(self):
+        return len(self.state_inputs)
+
+
+def register(name, *, arg_names=None, differentiable=True, needs_rng=False,
+             takes_is_train=False, num_visible=None, state_inputs=(),
+             nondiff_inputs=(), aliases=(), defaults=None, doc=""):
+    """Decorator: register a pure-jax fn as an operator."""
+    def deco(fn):
+        op = OpDef(name=name, fn=fn,
+                   arg_names=tuple(arg_names) if arg_names is not None else None,
+                   differentiable=differentiable, needs_rng=needs_rng,
+                   takes_is_train=takes_is_train, num_visible=num_visible,
+                   state_inputs=tuple(state_inputs),
+                   nondiff_inputs=tuple(nondiff_inputs),
+                   aliases=tuple(aliases), defaults=dict(defaults or {}),
+                   doc=doc or fn.__doc__ or "")
+        if name in _OP_REGISTRY:
+            raise ValueError("duplicate op registration %r" % name)
+        _OP_REGISTRY[name] = op
+        for a in op.aliases:
+            _ALIASES[a] = name
+        return fn
+    return deco
+
+
+def get_op(name) -> OpDef:
+    if name in _OP_REGISTRY:
+        return _OP_REGISTRY[name]
+    if name in _ALIASES:
+        return _OP_REGISTRY[_ALIASES[name]]
+    raise KeyError("operator %r is not registered" % (name,))
+
+
+def list_ops():
+    return sorted(set(_OP_REGISTRY) | set(_ALIASES))
+
+
+# ---------------------------------------------------------------------------
+# attr canonicalization — attrs arrive as python values or strings (symbol
+# JSON round-trip, reference dmlc::Parameter string parsing).
+# ---------------------------------------------------------------------------
+
+def _parse_attr_value(v):
+    if isinstance(v, str):
+        s = v.strip()
+        low = s.lower()
+        if low in ("true", "false"):
+            return low == "true"
+        if low in ("none", "null"):
+            return None
+        try:
+            return ast.literal_eval(s)
+        except (ValueError, SyntaxError):
+            return v
+    return v
+
+
+def _hashable(v):
+    if isinstance(v, (list, tuple)):
+        return tuple(_hashable(x) for x in v)
+    if isinstance(v, dict):
+        return tuple(sorted((k, _hashable(x)) for k, x in v.items()))
+    if isinstance(v, np.ndarray):
+        return tuple(v.ravel().tolist()) if v.size < 64 else v.tobytes()
+    if isinstance(v, np.generic):
+        return v.item()
+    return v
+
+
+def canon_attrs(opdef, attrs):
+    """Merge defaults, parse string values, make everything hashable."""
+    out = dict(opdef.defaults)
+    for k, v in attrs.items():
+        if v is None and k not in opdef.defaults:
+            out[k] = None
+            continue
+        out[k] = _hashable(_parse_attr_value(v))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# jit cache: one compiled callable per (op, static attrs); jax.jit itself
+# then caches per input shape/dtype. This is the whole "engine".
+# ---------------------------------------------------------------------------
+
+@functools.lru_cache(maxsize=None)
+def _jitted(name, attr_items, with_rng):
+    opdef = get_op(name)
+    attrs = dict(attr_items)
+    if with_rng:
+        def call(rng, *arrays):
+            return opdef.fn(*arrays, rng=rng, **attrs)
+    else:
+        def call(*arrays):
+            return opdef.fn(*arrays, **attrs)
+    return jax.jit(call)
+
+
+def jitted_op(opdef, attrs):
+    """Compiled callable for (op, attrs). attrs must be canonicalized."""
+    return _jitted(opdef.name, tuple(sorted(attrs.items())), opdef.needs_rng)
+
+
+# ---------------------------------------------------------------------------
+# eager dispatch
+# ---------------------------------------------------------------------------
+
+def invoke_eager(opdef, nd_inputs, attrs, out=None):
+    """Imperative invoke (analogue of ImperativeInvokeImpl,
+    src/c_api/c_api_ndarray.cc:491): unwrap NDArrays, run the jitted kernel
+    (recording an autograd tape node when grad recording is on), wrap
+    outputs, apply aux-state writebacks and the ``out=`` destination."""
+    from ..ndarray.ndarray import NDArray, _wrap, array  # late: avoid cycle
+    from .. import autograd
+    from .. import random as mx_random
+
+    arrays = []
+    for x in nd_inputs:
+        if isinstance(x, NDArray):
+            arrays.append(x._data)
+        else:
+            arrays.append(array(x)._data)
+
+    attrs = canon_attrs(opdef, attrs)
+    if opdef.takes_is_train and "is_train" not in attrs:
+        attrs["is_train"] = autograd.is_training()
+
+    recording = autograd.is_recording() and opdef.differentiable
+
+    if opdef.needs_rng:
+        rng = mx_random.next_key()
+        call_args = (rng,) + tuple(arrays)
+    else:
+        call_args = tuple(arrays)
+
+    if recording:
+        # vjp at record time: residuals are saved on-device, backward is a
+        # direct call of the linearized fn (analogue of AutogradRuntime
+        # RecordOp, src/ndarray/autograd.cc — but the "re-symbolized graph"
+        # is jax's linearization).
+        fixed = dict(attrs)
+        if opdef.needs_rng:
+            def pure(rng_, *xs):
+                return opdef.fn(*xs, rng=rng_, **fixed)
+        else:
+            def pure(*xs):
+                return opdef.fn(*xs, **fixed)
+        raw_out, vjp_fn = jax.vjp(pure, *call_args)
+    else:
+        raw_out = jitted_op(opdef, attrs)(*call_args)
+        vjp_fn = None
+
+    outs = list(raw_out) if isinstance(raw_out, (tuple, list)) else [raw_out]
+    raw_shapes = tuple(o.shape for o in outs)
+    raw_dtypes = tuple(o.dtype for o in outs)
+    raw_is_tuple = isinstance(raw_out, (tuple, list))
+
+    # aux-state writeback (BatchNorm moving stats, fused optimizer updates)
+    n_state = opdef.num_state
+    if n_state:
+        state_outs = outs[-n_state:]
+        outs = outs[:-n_state]
+        for idx, val in zip(opdef.state_inputs, state_outs):
+            tgt = nd_inputs[idx]
+            if isinstance(tgt, NDArray):
+                tgt._set_data(val)
+
+    n_vis = opdef.num_visible if opdef.num_visible is not None else len(outs)
+    visible = outs[:n_vis]
+
+    nd_outs = [_wrap(o) for o in visible]
+
+    if recording:
+        autograd._record_op(opdef, nd_inputs, nd_outs, vjp_fn,
+                            raw_shapes=raw_shapes, raw_dtypes=raw_dtypes,
+                            raw_is_tuple=raw_is_tuple,
+                            rng_offset=1 if opdef.needs_rng else 0)
+
+    if out is not None:
+        out_list = out if isinstance(out, (list, tuple)) else [out]
+        for dst, src in zip(out_list, nd_outs):
+            dst._set_data(src._data)
+            # rebind (or clear) the tape entry so a stale node from an
+            # earlier recording can't be traversed against new data
+            dst._ag_entry = src._ag_entry if recording else None
+        return out
+
+    if len(nd_outs) == 1:
+        return nd_outs[0]
+    return nd_outs

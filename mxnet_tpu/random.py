@@ -1,0 +1,46 @@
+"""Global PRNG state — analogue of mxnet.random / per-device mshadow PRNG
+(reference: src/resource.cc kRandom pools, python/mxnet/random.py).
+
+MXNet keeps hidden per-device RNG state seeded by ``mx.random.seed``. JAX is
+functional, so we keep ONE host-side key and split it per eager op call;
+compiled executors thread an explicit key input instead (see
+symbol/executor.py) so jitted step functions stay pure and cacheable.
+"""
+from __future__ import annotations
+
+import threading
+
+import jax
+
+__all__ = ["seed", "next_key", "fork_key"]
+
+_state = threading.local()
+_DEFAULT_SEED = 0
+
+
+def _key():
+    if not hasattr(_state, "key"):
+        _state.key = jax.random.PRNGKey(_DEFAULT_SEED)
+    return _state.key
+
+
+def seed(seed_state):
+    """Seed all random generators (reference: python/mxnet/random.py seed)."""
+    global _DEFAULT_SEED
+    _DEFAULT_SEED = int(seed_state)
+    _state.key = jax.random.PRNGKey(int(seed_state))
+
+
+def next_key():
+    """Split off a fresh PRNG key from the global stream."""
+    k = _key()
+    _state.key, sub = jax.random.split(k)
+    return sub
+
+
+def fork_key(n):
+    """n independent keys."""
+    k = _key()
+    keys = jax.random.split(k, n + 1)
+    _state.key = keys[0]
+    return keys[1:]

@@ -1,0 +1,118 @@
+"""Testing helpers — reference python/mxnet/test_utils.py (1472 LoC):
+assert_almost_equal, numeric gradient checking, random arrays,
+eager-vs-jit consistency (the TPU analogue of the reference's CPU-vs-GPU
+``check_consistency``).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from .ndarray.ndarray import NDArray, array
+
+_rng = np.random.RandomState(0)
+
+
+def default_context():
+    from .context import current_context
+    return current_context()
+
+
+def set_default_context(ctx):
+    from .context import Context
+    Context._default_ctx.value = ctx
+
+
+def assert_almost_equal(a, b, rtol=1e-5, atol=1e-20, names=("a", "b")):
+    a = a.asnumpy() if isinstance(a, NDArray) else np.asarray(a)
+    b = b.asnumpy() if isinstance(b, NDArray) else np.asarray(b)
+    np.testing.assert_allclose(a, b, rtol=rtol, atol=atol,
+                               err_msg="%s vs %s" % names)
+
+
+def almost_equal(a, b, rtol=1e-5, atol=1e-20):
+    a = a.asnumpy() if isinstance(a, NDArray) else np.asarray(a)
+    b = b.asnumpy() if isinstance(b, NDArray) else np.asarray(b)
+    return np.allclose(a, b, rtol=rtol, atol=atol)
+
+
+def rand_ndarray(shape, stype="default", density=None, dtype=np.float32):
+    data = _rng.uniform(-1, 1, size=shape).astype(dtype)
+    if stype == "default":
+        return array(data)
+    if density is not None:
+        mask = _rng.uniform(0, 1, size=(shape[0],) + (1,) * (len(shape) - 1))
+        data = np.where(mask < density, data, 0).astype(dtype)
+    from .ndarray import sparse
+    if stype == "row_sparse":
+        return sparse.row_sparse_array(data)
+    if stype == "csr":
+        return sparse.csr_matrix(data)
+    raise ValueError(stype)
+
+
+def rand_shape_2d(dim0=10, dim1=10):
+    return (_rng.randint(1, dim0 + 1), _rng.randint(1, dim1 + 1))
+
+
+def rand_shape_nd(ndim, dim=10):
+    return tuple(_rng.randint(1, dim + 1, size=ndim))
+
+
+def check_numeric_gradient(f, inputs, grads=None, eps=1e-3, rtol=1e-2,
+                           atol=1e-4):
+    """Finite-difference check of an eager differentiable function.
+
+    f: callable(list of NDArray) -> scalar-able NDArray (loss)
+    inputs: list of NDArray leaves (will have grads attached)
+    """
+    from . import autograd
+    from .ndarray.ndarray import zeros_like
+
+    for x in inputs:
+        x.attach_grad()
+    with autograd.record():
+        out = f(inputs)
+        out.backward()
+    analytic = [x.grad.asnumpy().copy() for x in inputs]
+
+    for xi, x in enumerate(inputs):
+        base_np = np.ascontiguousarray(x.asnumpy(), dtype=np.float64)
+        num = np.zeros_like(base_np)
+        for idx in np.ndindex(*base_np.shape):
+            orig = base_np[idx]
+            base_np[idx] = orig + eps
+            x._set_data(base_np.astype(np.float32))
+            fp = float(f(inputs).asnumpy().sum())
+            base_np[idx] = orig - eps
+            x._set_data(base_np.astype(np.float32))
+            fm = float(f(inputs).asnumpy().sum())
+            base_np[idx] = orig
+            x._set_data(base_np.astype(np.float32))
+            num[idx] = (fp - fm) / (2 * eps)
+        np.testing.assert_allclose(analytic[xi], num, rtol=rtol, atol=atol,
+                                   err_msg="gradient mismatch for input %d"
+                                   % xi)
+
+
+def check_consistency(fn, inputs, rtol=1e-4, atol=1e-6):
+    """Eager vs jit-compiled consistency — the TPU analogue of the
+    reference's CPU-vs-GPU check (test_utils.py check_consistency)."""
+    import jax
+
+    eager = fn(*inputs)
+    jit_out = jax.jit(fn)(*inputs)
+    e = eager.asnumpy() if isinstance(eager, NDArray) else np.asarray(eager)
+    j = jit_out.asnumpy() if isinstance(jit_out, NDArray) else \
+        np.asarray(jit_out)
+    np.testing.assert_allclose(e, j, rtol=rtol, atol=atol)
+    return eager
+
+
+def simple_forward(sym, ctx=None, is_train=False, **inputs):
+    from . import nd
+    arrays = {k: array(v) if not isinstance(v, NDArray) else v
+              for k, v in inputs.items()}
+    exe = sym.bind(ctx or default_context(), arrays)
+    outs = exe.forward(is_train=is_train)
+    outs = [o.asnumpy() for o in outs]
+    return outs[0] if len(outs) == 1 else outs

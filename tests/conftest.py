@@ -1,0 +1,15 @@
+"""Test env: run everything on a virtual 8-device CPU mesh so sharding
+semantics (kvstore/parallel tests) are exercised without TPU hardware
+(SURVEY.md §4: multi-process-on-one-host is the reference's distributed-test
+pattern; virtual devices are the JAX analogue)."""
+import os
+
+# Hard override: the image presets JAX_PLATFORMS=axon (the one real TPU
+# chip); tests must run on the virtual CPU mesh for determinism + sharding.
+# Set MXNET_TEST_ON_TPU=1 to run the suite against the real chip instead.
+if not os.environ.get("MXNET_TEST_ON_TPU"):
+    os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()

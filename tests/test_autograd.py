@@ -1,0 +1,174 @@
+"""Autograd — mirrors reference tests/python/unittest/test_autograd.py."""
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, nd
+
+
+def test_simple_grad():
+    x = nd.array([1.0, 2.0, 3.0])
+    x.attach_grad()
+    with autograd.record():
+        y = x * x
+    y.backward()
+    np.testing.assert_allclose(x.grad.asnumpy(), [2, 4, 6])
+
+
+def test_chain():
+    x = nd.array([[1.0, 2.0], [3.0, 4.0]])
+    x.attach_grad()
+    with autograd.record():
+        y = nd.exp(x) * 2
+        z = y.sum()
+    z.backward()
+    np.testing.assert_allclose(x.grad.asnumpy(),
+                               2 * np.exp([[1, 2], [3, 4]]), rtol=1e-5)
+
+
+def test_multi_input():
+    a = nd.array([1.0, 2.0])
+    b = nd.array([3.0, 4.0])
+    a.attach_grad()
+    b.attach_grad()
+    with autograd.record():
+        c = a * b + a
+    c.backward()
+    np.testing.assert_allclose(a.grad.asnumpy(), [4, 5])  # b + 1
+    np.testing.assert_allclose(b.grad.asnumpy(), [1, 2])  # a
+
+
+def test_head_grads():
+    x = nd.array([1.0, 2.0])
+    x.attach_grad()
+    with autograd.record():
+        y = 3 * x
+    y.backward(nd.array([10.0, 100.0]))
+    np.testing.assert_allclose(x.grad.asnumpy(), [30, 300])
+
+
+def test_grad_req_add():
+    x = nd.array([1.0])
+    x.attach_grad(grad_req="add")
+    for _ in range(3):
+        with autograd.record():
+            y = 2 * x
+        y.backward()
+    np.testing.assert_allclose(x.grad.asnumpy(), [6.0])
+
+
+def test_pause():
+    x = nd.array([1.0, 2.0])
+    x.attach_grad()
+    with autograd.record():
+        y = x * x
+        with autograd.pause():
+            z = y * 2  # not recorded
+        w = y + 1
+    w.backward()
+    np.testing.assert_allclose(x.grad.asnumpy(), [2, 4])
+
+
+def test_training_modes():
+    assert not autograd.is_training()
+    with autograd.record():
+        assert autograd.is_training()
+        assert autograd.is_recording()
+        with autograd.predict_mode():
+            assert not autograd.is_training()
+    assert not autograd.is_recording()
+    with autograd.train_mode():
+        assert autograd.is_training()
+
+
+def test_detach():
+    x = nd.array([2.0])
+    x.attach_grad()
+    with autograd.record():
+        y = x * x
+        z = y.detach() * x
+    z.backward()
+    np.testing.assert_allclose(x.grad.asnumpy(), [4.0])  # y treated const
+
+
+def test_matmul_grad():
+    a = nd.array(np.random.rand(3, 4).astype(np.float32))
+    w = nd.array(np.random.rand(5, 4).astype(np.float32))
+    w.attach_grad()
+    with autograd.record():
+        out = nd.FullyConnected(a, w, no_bias=True, num_hidden=5)
+        loss = out.sum()
+    loss.backward()
+    expected = np.ones((3, 5)).T @ a.asnumpy()
+    np.testing.assert_allclose(w.grad.asnumpy(), expected, rtol=1e-5)
+
+
+def test_softmax_output_grad():
+    data = nd.array(np.random.rand(4, 3).astype(np.float32))
+    label = nd.array([0.0, 1.0, 2.0, 1.0])
+    data.attach_grad()
+    with autograd.record():
+        out = nd.SoftmaxOutput(data, label)
+    out.backward()
+    p = np.exp(data.asnumpy())
+    p /= p.sum(axis=1, keepdims=True)
+    onehot = np.eye(3)[[0, 1, 2, 1]]
+    np.testing.assert_allclose(data.grad.asnumpy(), p - onehot, rtol=1e-5,
+                               atol=1e-6)
+
+
+def test_mark_variables():
+    x = nd.array([1.0, 2.0])
+    g = nd.zeros((2,))
+    autograd.mark_variables([x], [g])
+    with autograd.record():
+        y = (x * x).sum()
+    y.backward()
+    np.testing.assert_allclose(g.asnumpy(), [2, 4])
+
+
+def test_grad_function():
+    x = nd.array([1.0, 2.0, 3.0])
+    grads = autograd.grad_fn = None
+    x2 = nd.array([3.0])
+
+    class Sigmoid(autograd.Function):
+        def forward(self, x):
+            y = 1 / (1 + nd.exp(-x))
+            self.save = y
+            return y
+
+        def backward(self, dy):
+            y = self.save
+            return dy * y * (1 - y)
+
+    f = Sigmoid()
+    inp = nd.array([0.0])
+    inp.attach_grad()
+    with autograd.record():
+        out = f(inp)
+    out.backward()
+    np.testing.assert_allclose(inp.grad.asnumpy(), [0.25], rtol=1e-5)
+
+
+def test_numeric_gradient_helper():
+    from mxnet_tpu.test_utils import check_numeric_gradient
+    x = nd.array(np.random.rand(3, 2).astype(np.float32))
+
+    def f(inputs):
+        return (inputs[0] * inputs[0] + 2 * inputs[0]).sum()
+    check_numeric_gradient(f, [x])
+
+
+def test_batchnorm_aux_update():
+    data = nd.array(np.random.rand(4, 3, 2, 2).astype(np.float32) + 5)
+    gamma = nd.ones((3,))
+    beta = nd.zeros((3,))
+    mm = nd.zeros((3,))
+    mv = nd.ones((3,))
+    with autograd.record():
+        out = nd.BatchNorm(data, gamma, beta, mm, mv, fix_gamma=False,
+                           momentum=0.9)
+    # moving stats updated in-place toward batch stats
+    assert mm.asnumpy().mean() > 0.1
+    # out is normalized
+    assert abs(out.asnumpy().mean()) < 1e-3
