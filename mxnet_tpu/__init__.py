@@ -40,3 +40,14 @@ from . import random
 from . import random as rnd
 
 from . import autograd
+
+from . import name
+from . import attribute
+from .attribute import AttrScope
+
+from . import symbol
+from . import symbol as sym
+from .symbol import Symbol
+
+from . import executor
+from .executor import Executor

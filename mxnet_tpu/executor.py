@@ -1,0 +1,303 @@
+"""Executor — runs a bound Symbol graph.
+
+Reference: src/executor/graph_executor.cc + python/mxnet/executor.py.
+The reference's bind pipeline (gradient pass, device placement, shape
+inference, memory planning, op fusion into engine segments) collapses here
+into: lower the Symbol to ONE pure JAX function, `jax.jit` it (XLA does
+placement/planning/fusion), and get the backward pass from `jax.vjp` of that
+same function — the whole-graph analogue of the reference's symbolic
+Gradient pass.
+
+Aux states (BatchNorm moving stats) are threaded functionally through the
+compiled fn and written back to their NDArrays after each forward — the
+reference mutated them in-place from inside kernels.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .base import MXNetError, np_dtype
+from .context import current_context
+from .ndarray import ndarray as _nd
+from .ndarray.ndarray import NDArray, _wrap
+
+__all__ = ["Executor"]
+
+
+def _graph_eval_fn(symbol):
+    """Build the pure function evaluating `symbol`'s graph.
+
+    Returns fn(arg_vals: dict name->array, aux_vals: dict, rng, is_train)
+      -> (tuple outputs, dict new_aux)."""
+    from .symbol.symbol import _topo_order
+
+    entries = symbol._entries
+    order = _topo_order(entries)
+    node_uid = {id(n): i for i, n in enumerate(order)}
+
+    def eval_fn(arg_vals, aux_vals, rng, is_train):
+        env = {}
+        aux_out = dict(aux_vals)
+        for node in order:
+            if node.op is None:
+                if node.is_aux:
+                    env[id(node)] = [aux_out[node.name]]
+                else:
+                    env[id(node)] = [arg_vals[node.name]]
+                continue
+            xs = [env[id(m)][i] for (m, i) in node.inputs]
+            attrs = dict(node.attrs)
+            if node.op.takes_is_train:
+                attrs["is_train"] = is_train
+            kw = {}
+            if node.op.needs_rng:
+                kw["rng"] = jax.random.fold_in(rng, node_uid[id(node)])
+            raw = node.op.fn(*xs, **kw, **attrs)
+            outs = list(raw) if isinstance(raw, (tuple, list)) else [raw]
+            n_state = node.op.num_state
+            if n_state:
+                state_outs = outs[-n_state:]
+                outs = outs[:-n_state]
+                # state_inputs index the FULL signature; node.inputs holds
+                # only the active (arg_select-filtered) args — map by name
+                active = node.op.active_args(node.attrs)
+                for slot, val in zip(node.op.state_inputs, state_outs):
+                    sname = node.op.arg_names[slot]
+                    if sname not in active:
+                        continue
+                    m, _i = node.inputs[active.index(sname)]
+                    if m.op is None and m.is_aux:
+                        aux_out[m.name] = val
+            env[id(node)] = outs
+        outputs = tuple(env[id(n)][i] for (n, i) in entries)
+        return outputs, aux_out
+
+    return eval_fn
+
+
+class Executor:
+    """Executor over a lowered symbol graph (reference graph_executor.h:57)."""
+
+    def __init__(self, symbol, ctx=None, args=None, args_grad=None,
+                 grad_req="write", aux_states=None, group2ctx=None):
+        self._symbol = symbol
+        self._ctx = ctx if ctx is not None else current_context()
+        self._group2ctx = group2ctx or {}
+        self._monitor_callback = None
+        self._step = 0
+
+        arg_names = symbol.list_arguments()
+        aux_names = symbol.list_auxiliary_states()
+        self._arg_names = arg_names
+        self._aux_names = aux_names
+
+        self.arg_arrays = self._align("args", args, arg_names)
+        self.aux_arrays = self._align("aux_states", aux_states, aux_names,
+                                      allow_missing=not aux_names)
+
+        if isinstance(grad_req, str):
+            self._grad_req = {n: grad_req for n in arg_names}
+        elif isinstance(grad_req, (list, tuple)):
+            self._grad_req = dict(zip(arg_names, grad_req))
+        else:
+            self._grad_req = {n: grad_req.get(n, "null") for n in arg_names}
+
+        if args_grad is None:
+            self.grad_arrays = [
+                _nd.zeros_like(a) if self._grad_req[n] != "null" else None
+                for n, a in zip(arg_names, self.arg_arrays)]
+        else:
+            self.grad_arrays = self._align("args_grad", args_grad, arg_names,
+                                           allow_missing=True)
+            for i, n in enumerate(arg_names):
+                if self.grad_arrays[i] is None and \
+                        self._grad_req[n] != "null":
+                    self._grad_req[n] = "null"
+
+        self._eval_fn = _graph_eval_fn(symbol)
+        self._jit_fwd = jax.jit(self._eval_fn, static_argnums=(3,))
+        self._grad_names = [n for n in arg_names
+                            if self._grad_req[n] != "null"]
+        self._jit_bwd = jax.jit(self._bwd_impl)
+        self.outputs = []
+        self._fwd_inputs = None
+
+    # -- construction helpers ----------------------------------------------
+    def _align(self, what, values, names, allow_missing=False):
+        if values is None:
+            if allow_missing:
+                return [None] * len(names)
+            raise MXNetError("%s must be provided for %r" % (what, names))
+        if isinstance(values, dict):
+            out = []
+            for n in names:
+                if n in values:
+                    v = values[n]
+                    out.append(v if isinstance(v, NDArray) or v is None
+                               else _nd.array(v))
+                elif allow_missing:
+                    out.append(None)
+                else:
+                    raise MXNetError("%s: missing entry for %r" % (what, n))
+            return out
+        values = list(values)
+        if len(values) != len(names):
+            raise MXNetError("%s: length %d != expected %d"
+                             % (what, len(values), len(names)))
+        return [v if isinstance(v, NDArray) or v is None else _nd.array(v)
+                for v in values]
+
+    @staticmethod
+    def _simple_bind(symbol, ctx=None, grad_req="write", type_dict=None,
+                     group2ctx=None, **kwargs):
+        arg_shapes, _, aux_shapes = symbol.infer_shape(**kwargs)
+        arg_names = symbol.list_arguments()
+        aux_names = symbol.list_auxiliary_states()
+        type_dict = type_dict or {}
+        arg_types, _, aux_types = symbol.infer_type(**type_dict)
+        args = [_nd.zeros(s, dtype=t) for s, t in zip(arg_shapes, arg_types)]
+        aux = [_nd.zeros(s, dtype=t) for s, t in zip(aux_shapes, aux_types)]
+        return Executor(symbol, ctx, args=args, grad_req=grad_req,
+                        aux_states=aux, group2ctx=group2ctx)
+
+    # -- dict views ----------------------------------------------------------
+    @property
+    def arg_dict(self):
+        return dict(zip(self._arg_names, self.arg_arrays))
+
+    @property
+    def grad_dict(self):
+        return dict(zip(self._arg_names, self.grad_arrays))
+
+    @property
+    def aux_dict(self):
+        return dict(zip(self._aux_names, self.aux_arrays))
+
+    @property
+    def output_dict(self):
+        return dict(zip(self._symbol.list_outputs(), self.outputs))
+
+    def copy_params_from(self, arg_params, aux_params=None,
+                         allow_extra_params=False):
+        for name, arr in (arg_params or {}).items():
+            if name in self.arg_dict:
+                self.arg_dict[name]._set_data(
+                    jnp.asarray(arr.asnumpy() if isinstance(arr, NDArray)
+                                else arr,
+                                self.arg_dict[name]._data.dtype))
+            elif not allow_extra_params:
+                raise MXNetError("Found name %r not in arguments" % name)
+        for name, arr in (aux_params or {}).items():
+            if name in self.aux_dict:
+                self.aux_dict[name]._set_data(
+                    jnp.asarray(arr.asnumpy() if isinstance(arr, NDArray)
+                                else arr,
+                                self.aux_dict[name]._data.dtype))
+            elif not allow_extra_params:
+                raise MXNetError("Found name %r not in aux states" % name)
+
+    def set_monitor_callback(self, callback, monitor_all=False):
+        self._monitor_callback = callback
+
+    # -- execution -----------------------------------------------------------
+    def _current_rng(self):
+        from . import random as mx_random
+        return mx_random.next_key()
+
+    def forward(self, is_train=False, **kwargs):
+        """Run forward (reference MXExecutorForward →
+        GraphExecutor::Forward). kwargs update named input arrays."""
+        for k, v in kwargs.items():
+            if k not in self.arg_dict:
+                raise MXNetError("unknown forward argument %r" % k)
+            dst = self.arg_dict[k]
+            src = v._data if isinstance(v, NDArray) else jnp.asarray(v)
+            dst._set_data(src.astype(dst._data.dtype)
+                          if src.dtype != dst._data.dtype else src)
+
+        arg_vals = {n: a._data for n, a in zip(self._arg_names,
+                                               self.arg_arrays)}
+        aux_vals = {n: a._data for n, a in zip(self._aux_names,
+                                               self.aux_arrays)}
+        rng = self._current_rng()
+        outs, new_aux = self._jit_fwd(arg_vals, aux_vals, rng, bool(is_train))
+        if is_train:
+            for n, a in zip(self._aux_names, self.aux_arrays):
+                a._set_data(new_aux[n])
+            self._fwd_inputs = (arg_vals, aux_vals, rng)
+        else:
+            # a non-train forward invalidates the training residuals so a
+            # later backward() cannot silently use stale inputs
+            self._fwd_inputs = None
+        self.outputs = [_wrap(o) for o in outs]
+        if self._monitor_callback is not None:
+            for name, out in zip(self._symbol.list_outputs(), self.outputs):
+                self._monitor_callback(name, out)
+        return self.outputs
+
+    def _bwd_impl(self, arg_vals, aux_vals, rng, head_grads):
+        wrt = tuple(arg_vals[n] for n in self._grad_names)
+
+        def f(wrt_vals):
+            merged = dict(arg_vals)
+            merged.update(dict(zip(self._grad_names, wrt_vals)))
+            outs, _ = self._eval_fn(merged, aux_vals, rng, True)
+            return outs
+
+        outs, vjp = jax.vjp(f, wrt)
+        grads = vjp(tuple(head_grads))[0]
+        return dict(zip(self._grad_names, grads))
+
+    def backward(self, out_grads=None, is_train=True):
+        """Backprop through the bound graph (reference MXExecutorBackwardEx).
+
+        With no `out_grads`, each head receives an all-ones cotangent —
+        matching the reference where loss-layer ops (SoftmaxOutput, MakeLoss)
+        ignore the incoming head gradient entirely."""
+        if self._fwd_inputs is None:
+            raise MXNetError("backward() requires a prior "
+                             "forward(is_train=True)")
+        arg_vals, aux_vals, rng = self._fwd_inputs
+        if out_grads is None:
+            head_grads = [jnp.ones(o.shape, o._data.dtype)
+                          for o in self.outputs]
+        else:
+            if isinstance(out_grads, (NDArray, jax.Array, np.ndarray)):
+                out_grads = [out_grads]
+            head_grads = [g._data if isinstance(g, NDArray)
+                          else jnp.asarray(g) for g in out_grads]
+        grads = self._jit_bwd(arg_vals, aux_vals, rng, tuple(head_grads))
+        for n, gbuf in zip(self._arg_names, self.grad_arrays):
+            if gbuf is None or self._grad_req[n] == "null":
+                continue
+            if self._grad_req[n] == "add":
+                gbuf._set_data(gbuf._data + grads[n])
+            else:
+                gbuf._set_data(grads[n])
+        return [self.grad_dict[n] for n in self._grad_names]
+
+    def reshape(self, partial_shaping=False, allow_up_sizing=False, **kwargs):
+        """Return a new executor with new input shapes (reference
+        executor.py:reshape). jit recompiles per shape automatically, so this
+        just reallocates the data arrays."""
+        arg_shapes, _, aux_shapes = self._symbol.infer_shape(**kwargs)
+        new_args = []
+        for n, a, s in zip(self._arg_names, self.arg_arrays, arg_shapes):
+            if tuple(a.shape) == tuple(s):
+                new_args.append(a)
+            else:
+                new_args.append(_nd.zeros(s, dtype=a.dtype))
+        new_aux = []
+        for n, a, s in zip(self._aux_names, self.aux_arrays, aux_shapes):
+            new_aux.append(a if tuple(a.shape) == tuple(s)
+                           else _nd.zeros(s, dtype=a.dtype))
+        return Executor(self._symbol, self._ctx, args=new_args,
+                        grad_req={n: r for n, r in self._grad_req.items()},
+                        aux_states=new_aux, group2ctx=self._group2ctx)
+
+    def debug_str(self):
+        return self._symbol.debug_str()

@@ -17,3 +17,4 @@ from . import loss          # noqa: F401
 from . import random_ops    # noqa: F401
 from . import linalg        # noqa: F401
 from . import optimizer_ops  # noqa: F401
+from . import shape_hooks   # noqa: F401  (must come after all registrations)

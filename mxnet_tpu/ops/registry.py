@@ -27,7 +27,7 @@ import jax
 import numpy as np
 
 __all__ = ["OpDef", "register", "get_op", "list_ops", "invoke_eager",
-           "canon_attrs", "jitted_op"]
+           "canon_attrs", "jitted_op", "set_arg_select", "set_param_shapes"]
 
 _OP_REGISTRY: dict[str, "OpDef"] = {}
 _ALIASES: dict[str, str] = {}
@@ -59,10 +59,35 @@ class OpDef:
     aliases: Sequence[str] = field(default_factory=tuple)
     defaults: dict = field(default_factory=dict)
     doc: str = ""
+    # symbolic-composition hooks (set post-registration, see set_arg_select /
+    # set_param_shapes). Reference analogues: OperatorProperty::ListArguments
+    # (arg list depends on params, e.g. no_bias drops "bias") and backward
+    # shape inference (InferShape fills weight shapes from data shape).
+    arg_select: Optional[Callable] = None     # attrs -> tuple of active arg names
+    param_shapes: Optional[Callable] = None   # (in_shapes list, attrs) -> list
 
     @property
     def num_state(self):
         return len(self.state_inputs)
+
+    def active_args(self, attrs):
+        """Tensor-argument names active under these attrs."""
+        if self.arg_names is None:
+            return None
+        if self.arg_select is not None:
+            return tuple(self.arg_select(attrs))
+        return self.arg_names
+
+
+def set_arg_select(name, fn):
+    """Install the ListArguments-style hook: fn(attrs) -> active arg names."""
+    get_op(name).arg_select = fn
+
+
+def set_param_shapes(name, fn):
+    """Install backward shape inference: fn(in_shapes, attrs) -> full list of
+    input shapes (in_shapes has None for unknown entries)."""
+    get_op(name).param_shapes = fn
 
 
 def register(name, *, arg_names=None, differentiable=True, needs_rng=False,

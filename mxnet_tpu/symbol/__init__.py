@@ -1,0 +1,7 @@
+"""Symbol API (reference: python/mxnet/symbol/)."""
+from .symbol import Symbol, Variable, var, Group, load, load_json
+from .op import *          # noqa: F401,F403 — generated op namespace
+from . import op           # noqa: F401
+
+# creation helpers mirroring mx.sym.zeros/ones
+from .op import _zeros as zeros, _ones as ones, _arange as arange  # noqa: F401,E501

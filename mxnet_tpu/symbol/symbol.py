@@ -1,0 +1,713 @@
+"""Symbol — the deferred computation graph.
+
+Reference: python/mxnet/symbol/symbol.py (2535 LoC) + the NNVM graph IR
+(SURVEY.md N23): compose, infer_shape/type, tojson/save/load, bind/simple_bind.
+
+TPU-native design: a Symbol is a lightweight DAG of registry ops. There is no
+separate graph compiler — ``bind`` lowers the whole graph to ONE pure JAX
+function which jax.jit compiles (XLA plays the role of the reference's
+GraphExecutor passes: memory planning, fusion, scheduling). Shape/type
+inference runs the same graph abstractly (jax.eval_shape) with per-op
+backward-inference hooks filling parameter shapes, which is what the
+reference's InferShape pass did (src/executor/infer_graph_attr_pass.cc).
+"""
+from __future__ import annotations
+
+import json
+
+import numpy as np
+
+from .. import attribute, name as _name_mod
+from ..base import MXNetError, np_dtype, numeric_types
+from ..ops import registry as _reg
+
+__all__ = ["Symbol", "Variable", "var", "Group", "load", "load_json"]
+
+
+class _Node:
+    """One graph node: an op application or a variable (op is None)."""
+
+    __slots__ = ("op", "name", "attrs", "inputs", "is_aux", "misc_attrs",
+                 "__weakref__")
+
+    def __init__(self, op, name, attrs=None, inputs=(), is_aux=False,
+                 misc_attrs=None):
+        self.op = op                # OpDef | None (variable)
+        self.name = name
+        self.attrs = dict(attrs or {})        # canonical op attrs
+        self.inputs = list(inputs)            # list[(node, out_idx)]
+        self.is_aux = is_aux                  # variable feeding a state slot
+        self.misc_attrs = dict(misc_attrs or {})  # user attrs (__ctx_group__…)
+
+    def num_outputs(self):
+        if self.op is None:
+            return 1
+        return _num_outputs(self.op, self.attrs)
+
+
+def _num_outputs(opdef, attrs):
+    """Visible output count for an op under given attrs (reference:
+    nnvm num_outputs/num_visible_outputs registration)."""
+    name = opdef.name
+    if name == "SliceChannel":
+        return int(attrs.get("num_outputs", 1))
+    if name in ("BatchNorm", "LayerNorm"):
+        return 3 if attrs.get("output_mean_var") else 1
+    if name == "_linalg_gelqf":
+        return 2
+    if opdef.num_visible is not None:
+        return opdef.num_visible
+    return 1
+
+
+def _topo_order(entries):
+    """Post-order DFS over the graph feeding `entries` (deterministic)."""
+    order, seen = [], set()
+    stack = [(e[0], False) for e in reversed(entries)]
+    while stack:
+        node, done = stack.pop()
+        if done:
+            order.append(node)
+            continue
+        if id(node) in seen:
+            continue
+        seen.add(id(node))
+        stack.append((node, True))
+        for (n, _i) in reversed(node.inputs):
+            if id(n) not in seen:
+                stack.append((n, False))
+    return order
+
+
+class Symbol:
+    """Symbol is the basic building block of the deferred graph."""
+
+    __slots__ = ("_entries", "__weakref__")
+
+    def __init__(self, entries):
+        self._entries = list(entries)
+
+    # -- identity / composition --------------------------------------------
+    @property
+    def name(self):
+        if len(self._entries) == 1:
+            return self._entries[0][0].name
+        return None
+
+    def __repr__(self):
+        if len(self._entries) == 1:
+            return "<Symbol %s>" % self._entries[0][0].name
+        return "<Symbol group [%s]>" % ", ".join(
+            e[0].name for e in self._entries)
+
+    def __iter__(self):
+        return (Symbol([e]) for e in self._entries)
+
+    def __len__(self):
+        return len(self._entries)
+
+    def __getitem__(self, index):
+        if isinstance(index, str):
+            outs = self.list_outputs()
+            matches = [i for i, n in enumerate(outs)
+                       if n == index or n == index + "_output"]
+            if len(matches) != 1:
+                raise ValueError("cannot resolve output %r (candidates %r)"
+                                 % (index, outs))
+            index = matches[0]
+        if isinstance(index, slice):
+            return Symbol(self._entries[index])
+        return Symbol([self._entries[index]])
+
+    def __call__(self, *args, **kwargs):
+        """Compose: bind this symbol's free variables to other symbols
+        (reference symbol.py Symbol.__call__/_compose)."""
+        s = self._deepcopy()
+        s._compose(*args, **kwargs)
+        return s
+
+    def _deepcopy(self):
+        mapping = {}
+        for node in _topo_order(self._entries):
+            new = _Node(node.op, node.name, node.attrs,
+                        [(mapping[id(n)], i) for (n, i) in node.inputs],
+                        node.is_aux, node.misc_attrs)
+            mapping[id(node)] = new
+        return Symbol([(mapping[id(n)], i) for (n, i) in self._entries])
+
+    def __copy__(self):
+        return self._deepcopy()
+
+    def __deepcopy__(self, memo):
+        return self._deepcopy()
+
+    def _compose(self, *args, **kwargs):
+        kwargs.pop("name", None)
+        by_name = {}
+        for node in _topo_order(self._entries):
+            if node.op is None:
+                by_name[node.name] = node
+        if args and kwargs:
+            raise TypeError("compose only accepts input Symbols "
+                            "either as positional or keyword arguments")
+        if args:
+            free = [n for n in _topo_order(self._entries) if n.op is None]
+            if len(args) > len(free):
+                raise TypeError("too many positional compose args")
+            kwargs = {n.name: a for n, a in zip(free, args)}
+        replace = {}
+        for k, v in kwargs.items():
+            if not isinstance(v, Symbol) or len(v._entries) != 1:
+                raise TypeError("compose expects single-output Symbols")
+            if k not in by_name:
+                raise ValueError("no variable named %r in symbol" % k)
+            replace[id(by_name[k])] = v._entries[0]
+        for node in _topo_order(self._entries):
+            node.inputs = [replace.get(id(n), (n, i)) for (n, i) in
+                           node.inputs]
+        self._entries = [replace.get(id(n), (n, i)) for (n, i) in
+                         self._entries]
+
+    # -- attributes ---------------------------------------------------------
+    def attr(self, key):
+        if len(self._entries) == 1:
+            return self._entries[0][0].misc_attrs.get(key)
+        return None
+
+    def list_attr(self):
+        if len(self._entries) == 1:
+            return dict(self._entries[0][0].misc_attrs)
+        return {}
+
+    def attr_dict(self):
+        out = {}
+        for node in _topo_order(self._entries):
+            d = dict(node.misc_attrs)
+            if node.op is not None:
+                d.update({k: str(v) for k, v in node.attrs.items()})
+            if d:
+                out[node.name] = d
+        return out
+
+    def _set_attr(self, **kwargs):
+        if len(self._entries) != 1:
+            raise ValueError("_set_attr only supports single-output symbols")
+        self._entries[0][0].misc_attrs.update(kwargs)
+
+    # -- introspection -------------------------------------------------------
+    def list_arguments(self):
+        return [n.name for n in _topo_order(self._entries)
+                if n.op is None and not n.is_aux]
+
+    def list_auxiliary_states(self):
+        return [n.name for n in _topo_order(self._entries)
+                if n.op is None and n.is_aux]
+
+    def list_outputs(self):
+        outs = []
+        for (node, idx) in self._entries:
+            n_out = node.num_outputs()
+            if node.op is None:
+                outs.append(node.name)
+            elif n_out == 1:
+                outs.append(node.name + "_output")
+            else:
+                outs.append("%s_output%d" % (node.name, idx))
+        return outs
+
+    def list_inputs(self):
+        return [n.name for n in _topo_order(self._entries) if n.op is None]
+
+    def get_internals(self):
+        entries = []
+        for node in _topo_order(self._entries):
+            for i in range(node.num_outputs()):
+                entries.append((node, i))
+        return Symbol(entries)
+
+    def get_children(self):
+        nodes = {id(e[0]) for e in self._entries}
+        children = []
+        for e in self._entries:
+            for inp in e[0].inputs:
+                children.append(inp)
+        return Symbol(children) if children else None
+
+    # -- shape / type inference ---------------------------------------------
+    def infer_shape(self, *args, **kwargs):
+        res = self._infer_shape_impl(False, *args, **kwargs)
+        if res[0] is not None and any(
+                s is None for s in res[0]):
+            unknown = [n for n, s in zip(self.list_arguments(), res[0])
+                       if s is None]
+            raise MXNetError("cannot infer shapes for arguments %r — provide "
+                             "their shapes" % (unknown,))
+        return res
+
+    def infer_shape_partial(self, *args, **kwargs):
+        return self._infer_shape_impl(True, *args, **kwargs)
+
+    def _infer_shape_impl(self, partial, *args, **kwargs):
+        if args:
+            arg_names = self.list_arguments()
+            kwargs = dict(kwargs)
+            for n, s in zip(arg_names, args):
+                if s is not None:
+                    kwargs[n] = s
+        known = {k: tuple(int(d) for d in v) for k, v in kwargs.items()
+                 if v is not None}
+        shapes, _ = _infer_graph(self._entries, known, {}, partial=partial)
+        if shapes is None:
+            return None, None, None
+        arg_shapes = [shapes["var", n] for n in self.list_arguments()]
+        aux_shapes = [shapes["var", n] for n in self.list_auxiliary_states()]
+        out_shapes = [shapes["out", id(nd), i] for (nd, i) in self._entries]
+        return arg_shapes, out_shapes, aux_shapes
+
+    def infer_type(self, *args, **kwargs):
+        """Same-dtype propagation through the graph (reference: InferType
+        pass). Shapes are not needed: dtype flows forward (first known input
+        dtype wins, Cast overrides) then fills unknown variables backward."""
+        if args:
+            for n, t in zip(self.list_arguments(), args):
+                if t is not None:
+                    kwargs[n] = t
+        known_t = {k: np_dtype(v) for k, v in kwargs.items() if v is not None}
+        from .symbol import _topo_order as _topo  # self-module (clarity)
+        order = _topo(self._entries)
+        dt = {}
+        for node in order:
+            if node.op is None:
+                d = known_t.get(node.name)
+                if d is None and node.misc_attrs.get("__dtype__"):
+                    d = np_dtype(node.misc_attrs["__dtype__"])
+                dt[id(node)] = d
+        for _ in range(2):  # forward then backward fill, then re-forward
+            for node in order:
+                if node.op is None:
+                    continue
+                in_dts = [dt.get(id(m)) for (m, _i) in node.inputs]
+                base = next((d for d in in_dts if d is not None), None)
+                if node.op.name == "Cast":
+                    dt[id(node)] = np_dtype(node.attrs.get("dtype",
+                                                           "float32"))
+                elif base is not None:
+                    dt[id(node)] = base
+                if base is not None:
+                    for (m, _i) in node.inputs:
+                        if dt.get(id(m)) is None:
+                            dt[id(m)] = base
+        default = np.dtype("float32")
+        name2node = {n.name: n for n in order if n.op is None}
+        arg_t = [dt.get(id(name2node[n])) or default
+                 for n in self.list_arguments()]
+        aux_t = [dt.get(id(name2node[n])) or default
+                 for n in self.list_auxiliary_states()]
+        out_t = [dt.get(id(nd)) or default for (nd, _i) in self._entries]
+        return arg_t, out_t, aux_t
+
+    # -- serialization -------------------------------------------------------
+    def tojson(self):
+        nodes = _topo_order(self._entries)
+        nid = {id(n): i for i, n in enumerate(nodes)}
+        jnodes = []
+        arg_nodes = []
+        for i, n in enumerate(nodes):
+            if n.op is None:
+                arg_nodes.append(i)
+                entry = {"op": "null", "name": n.name, "inputs": []}
+                if n.is_aux:
+                    entry.setdefault("attrs", {})["__is_aux__"] = "True"
+            else:
+                entry = {"op": n.op.name, "name": n.name,
+                         "inputs": [[nid[id(m)], oi, 0]
+                                    for (m, oi) in n.inputs]}
+                if n.attrs:
+                    entry["attrs"] = {k: json.dumps(v) if not
+                                      isinstance(v, str) else v
+                                      for k, v in n.attrs.items()}
+            if n.misc_attrs:
+                entry.setdefault("attrs", {}).update(
+                    {k: str(v) for k, v in n.misc_attrs.items()})
+            jnodes.append(entry)
+        heads = [[nid[id(nd)], i, 0] for (nd, i) in self._entries]
+        return json.dumps({"nodes": jnodes, "arg_nodes": arg_nodes,
+                           "heads": heads,
+                           "attrs": {"mxnet_version": ["int", 1100]}},
+                          indent=2)
+
+    def save(self, fname):
+        with open(fname, "w") as f:
+            f.write(self.tojson())
+
+    def debug_str(self):
+        lines = []
+        for n in _topo_order(self._entries):
+            if n.op is None:
+                lines.append("Variable:%s" % n.name)
+            else:
+                ins = ", ".join("%s[%d]" % (m.name, i) for m, i in n.inputs)
+                lines.append("Op:%s, Name=%s\nInputs:\n\t%s"
+                             % (n.op.name, n.name, ins))
+        return "\n".join(lines)
+
+    # -- evaluation helpers --------------------------------------------------
+    def bind(self, ctx=None, args=None, args_grad=None, grad_req="write",
+             aux_states=None, group2ctx=None, shared_exec=None):
+        from ..executor import Executor
+        return Executor(self, ctx, args=args, args_grad=args_grad,
+                        grad_req=grad_req, aux_states=aux_states,
+                        group2ctx=group2ctx)
+
+    def simple_bind(self, ctx=None, grad_req="write", type_dict=None,
+                    group2ctx=None, shared_arg_names=None, shared_exec=None,
+                    shared_buffer=None, **kwargs):
+        from ..executor import Executor
+        return Executor._simple_bind(self, ctx, grad_req=grad_req,
+                                     type_dict=type_dict,
+                                     group2ctx=group2ctx, **kwargs)
+
+    def eval(self, ctx=None, **kwargs):
+        ex = self.bind(ctx, kwargs, grad_req="null")
+        return ex.forward()
+
+    def gradient(self, wrt):  # pragma: no cover - compat
+        raise NotImplementedError(
+            "symbolic gradient graphs are not materialized; gradients are "
+            "computed by the executor via jax.vjp (Executor.backward)")
+
+    # -- arithmetic ----------------------------------------------------------
+    def __add__(self, other):
+        return _sym_binary("broadcast_add", "_plus_scalar", self, other)
+
+    def __radd__(self, other):
+        return self.__add__(other)
+
+    def __sub__(self, other):
+        return _sym_binary("broadcast_sub", "_minus_scalar", self, other)
+
+    def __rsub__(self, other):
+        return _sym_scalar("_rminus_scalar", self, other)
+
+    def __mul__(self, other):
+        return _sym_binary("broadcast_mul", "_mul_scalar", self, other)
+
+    def __rmul__(self, other):
+        return self.__mul__(other)
+
+    def __truediv__(self, other):
+        return _sym_binary("broadcast_div", "_div_scalar", self, other)
+
+    def __rtruediv__(self, other):
+        return _sym_scalar("_rdiv_scalar", self, other)
+
+    __div__ = __truediv__
+    __rdiv__ = __rtruediv__
+
+    def __mod__(self, other):
+        return _sym_binary("broadcast_mod", "_mod_scalar", self, other)
+
+    def __pow__(self, other):
+        return _sym_binary("broadcast_power", "_power_scalar", self, other)
+
+    def __neg__(self):
+        return _sym_invoke(_reg.get_op("negative"), [self], {}, None)
+
+    def __abs__(self):
+        return _sym_invoke(_reg.get_op("abs"), [self], {}, None)
+
+    def __eq__(self, other):
+        return _sym_binary("broadcast_equal", "_equal_scalar", self, other)
+
+    def __ne__(self, other):
+        return _sym_binary("broadcast_not_equal", "_not_equal_scalar", self,
+                           other)
+
+    def __gt__(self, other):
+        return _sym_binary("broadcast_greater", "_greater_scalar", self,
+                           other)
+
+    def __ge__(self, other):
+        return _sym_binary("broadcast_greater_equal",
+                           "_greater_equal_scalar", self, other)
+
+    def __lt__(self, other):
+        return _sym_binary("broadcast_lesser", "_lesser_scalar", self, other)
+
+    def __le__(self, other):
+        return _sym_binary("broadcast_lesser_equal", "_lesser_equal_scalar",
+                           self, other)
+
+    def __hash__(self):
+        return id(self)
+
+    # -- generic op-method fallback (x.sum(), x.reshape(...), ...) ----------
+    def __getattr__(self, name):
+        if name.startswith("_"):
+            raise AttributeError(name)
+        try:
+            opdef = _reg.get_op(name)
+        except KeyError:
+            raise AttributeError(
+                "'Symbol' object has no attribute %r" % (name,)) from None
+
+        def method(*args, **kw):
+            sym_name = kw.pop("name", None)
+            inputs = [self] + [a for a in args if isinstance(a, Symbol)]
+            scalars = [a for a in args if not isinstance(a, Symbol)]
+            attrs = {k: v for k, v in kw.items() if not isinstance(v, Symbol)}
+            for k, v in kw.items():
+                if isinstance(v, Symbol):
+                    inputs.append(v)
+            if scalars:
+                free = [k for k in opdef.defaults if k not in attrs]
+                for k, v in zip(free, scalars):
+                    attrs[k] = v
+            return _sym_invoke(opdef, inputs, attrs, sym_name)
+        return method
+
+
+# ---------------------------------------------------------------------------
+# composition internals
+# ---------------------------------------------------------------------------
+
+def _sym_binary(tensor_op, scalar_op, lhs, rhs):
+    if isinstance(rhs, Symbol):
+        return _sym_invoke(_reg.get_op(tensor_op), [lhs, rhs], {}, None)
+    if isinstance(rhs, numeric_types):
+        return _sym_invoke(_reg.get_op(scalar_op), [lhs],
+                           {"scalar": float(rhs)}, None)
+    raise TypeError("unsupported operand type %s" % type(rhs))
+
+
+def _sym_scalar(scalar_op, lhs, rhs):
+    if isinstance(rhs, numeric_types):
+        return _sym_invoke(_reg.get_op(scalar_op), [lhs],
+                           {"scalar": float(rhs)}, None)
+    raise TypeError("unsupported operand type %s" % type(rhs))
+
+
+def _sym_invoke(opdef, inputs, attrs, name, kw_inputs=None):
+    """Create a graph node applying `opdef`, auto-creating variables for
+    missing parameter inputs (reference: compose with auto var creation)."""
+    attrs = _reg.canon_attrs(opdef, attrs)
+    hint = opdef.name.lower().lstrip("_")
+    name = _name_mod.current().get(name, hint)
+    misc = attribute.current().get(None)
+
+    entries = []
+    if opdef.arg_names is None:
+        for s in inputs:
+            if len(s._entries) != 1:
+                entries.extend(s._entries)
+            else:
+                entries.append(s._entries[0])
+    else:
+        active = list(opdef.active_args(attrs))
+        kw_inputs = kw_inputs or {}
+        for k in kw_inputs:
+            if k not in active:
+                raise TypeError(
+                    "%s: input %r is not active under attrs %r (active "
+                    "args: %r)" % (opdef.name, k, attrs, active))
+        provided = list(inputs)
+        full_names = list(opdef.arg_names)
+        aux_idx = set(opdef.state_inputs)
+        slot_syms = {}
+        pos = 0
+        for an in active:
+            if an in kw_inputs:
+                slot_syms[an] = kw_inputs[an]
+            elif pos < len(provided):
+                slot_syms[an] = provided[pos]
+                pos += 1
+            else:
+                slot_syms[an] = None
+        if pos < len(provided):
+            raise TypeError("%s: too many symbol inputs (%d given, active "
+                            "args %r)" % (opdef.name, len(provided), active))
+        for an in active:
+            s = slot_syms[an]
+            if s is None:
+                is_aux = full_names.index(an) in aux_idx
+                node = _Node(None, "%s_%s" % (name, an), is_aux=is_aux,
+                             misc_attrs=misc)
+                entries.append((node, 0))
+            else:
+                if not isinstance(s, Symbol):
+                    raise TypeError("%s: input %r must be a Symbol, got %s"
+                                    % (opdef.name, an, type(s)))
+                if len(s._entries) != 1:
+                    raise TypeError("%s: input %r must be single-output"
+                                    % (opdef.name, an))
+                ent = s._entries[0]
+                if ent[0].op is None and full_names.index(an) in aux_idx:
+                    ent[0].is_aux = True
+                entries.append(ent)
+
+    node = _Node(opdef, name, attrs, entries, misc_attrs=misc)
+    n_out = node.num_outputs()
+    return Symbol([(node, i) for i in range(n_out)]) if n_out > 1 \
+        else Symbol([(node, 0)])
+
+
+def Variable(name, attr=None, shape=None, lr_mult=None, wd_mult=None,
+             dtype=None, init=None, stype=None, **kwargs):
+    """Create a symbolic variable (reference symbol.py `var`)."""
+    if not isinstance(name, str):
+        raise TypeError("Expect a string for variable name")
+    misc = attribute.current().get(attr or {})
+    if shape is not None:
+        misc["__shape__"] = str(tuple(shape))
+    if dtype is not None:
+        misc["__dtype__"] = str(np_dtype(dtype).name if dtype else "")
+    if lr_mult is not None:
+        misc["__lr_mult__"] = str(lr_mult)
+    if wd_mult is not None:
+        misc["__wd_mult__"] = str(wd_mult)
+    if init is not None:
+        misc["__init__"] = init if isinstance(init, str) else \
+            init.dumps()
+    for k, v in kwargs.items():
+        if k.startswith("__") and k.endswith("__"):
+            misc[k] = str(v)
+    node = _Node(None, name, misc_attrs=misc)
+    return Symbol([(node, 0)])
+
+
+var = Variable
+
+
+def Group(symbols):
+    entries = []
+    for s in symbols:
+        entries.extend(s._entries)
+    return Symbol(entries)
+
+
+def load_json(json_str):
+    data = json.loads(json_str)
+    nodes = []
+    for jn in data["nodes"]:
+        attrs = jn.get("attrs", jn.get("param", {})) or {}
+        misc = {k: v for k, v in attrs.items()
+                if k.startswith("__") and k.endswith("__")}
+        op_attrs = {k: v for k, v in attrs.items() if k not in misc}
+        if jn["op"] == "null":
+            node = _Node(None, jn["name"],
+                         is_aux=misc.pop("__is_aux__", "False") == "True",
+                         misc_attrs=misc)
+        else:
+            opdef = _reg.get_op(jn["op"])
+            node = _Node(opdef, jn["name"],
+                         _reg.canon_attrs(opdef, op_attrs),
+                         [(nodes[i], oi) for (i, oi, *_v) in jn["inputs"]],
+                         misc_attrs=misc)
+        nodes.append(node)
+    heads = data.get("heads") or [[len(nodes) - 1, 0, 0]]
+    return Symbol([(nodes[i], oi) for (i, oi, *_v) in heads])
+
+
+def load(fname):
+    with open(fname) as f:
+        return load_json(f.read())
+
+
+# ---------------------------------------------------------------------------
+# shape/type inference over the graph
+# ---------------------------------------------------------------------------
+
+def _infer_graph(entries, known_shapes, known_dtypes, partial=False):
+    """Propagate shapes+dtypes through the graph.
+
+    Returns (shapes, dtypes): shapes maps ("var", name) and
+    ("out", id(node), i) to tuples (or None if unknown)."""
+    import jax
+
+    shapes = {}
+    dtypes = {}
+    order = _topo_order(entries)
+    for node in order:
+        if node.op is None:
+            shp = known_shapes.get(node.name)
+            if shp is None and "__shape__" in node.misc_attrs:
+                import ast
+                shp = tuple(ast.literal_eval(node.misc_attrs["__shape__"]))
+            shapes["var", node.name] = shp
+            dt = known_dtypes.get(node.name)
+            if dt is None and node.misc_attrs.get("__dtype__"):
+                dt = np_dtype(node.misc_attrs["__dtype__"])
+            dtypes["var", node.name] = dt
+            shapes["out", id(node), 0] = shp
+            dtypes["out", id(node), 0] = dt
+            continue
+
+        in_shapes = []
+        in_dtypes = []
+        for (m, i) in node.inputs:
+            in_shapes.append(shapes.get(("out", id(m), i)))
+            in_dtypes.append(dtypes.get(("out", id(m), i)))
+
+        if node.op.param_shapes is not None and any(
+                s is None for s in in_shapes):
+            try:
+                filled = node.op.param_shapes(list(in_shapes), node.attrs)
+            except Exception:
+                filled = in_shapes
+            for (m, i), s_old, s_new in zip(node.inputs, in_shapes, filled):
+                if s_old is None and s_new is not None:
+                    s_new = tuple(int(d) for d in s_new)
+                    shapes["out", id(m), i] = s_new
+                    if m.op is None:
+                        shapes["var", m.name] = s_new
+            in_shapes = [shapes.get(("out", id(m), i))
+                         for (m, i) in node.inputs]
+
+        if any(s is None for s in in_shapes):
+            if not partial:
+                missing = [m.name for (m, _i), s in
+                           zip(node.inputs, in_shapes) if s is None]
+                raise MXNetError(
+                    "infer_shape: inputs %r of op %s(%s) have unknown "
+                    "shapes" % (missing, node.op.name, node.name))
+            for i in range(node.num_outputs()):
+                shapes["out", id(node), i] = None
+                dtypes["out", id(node), i] = None
+            continue
+
+        # abstract evaluation of this single node
+        base_dt = next((d for d in in_dtypes if d is not None), None) \
+            or np.dtype("float32")
+        structs = [jax.ShapeDtypeStruct(s, d if d is not None else base_dt)
+                   for s, d in zip(in_shapes, in_dtypes)]
+        # backfill inferred dtypes onto variables
+        for (m, i), d in zip(node.inputs, in_dtypes):
+            if d is None:
+                dtypes["out", id(m), i] = base_dt
+                if m.op is None and dtypes.get(("var", m.name)) is None:
+                    dtypes["var", m.name] = base_dt
+        attrs = dict(node.attrs)
+        if node.op.takes_is_train:
+            attrs["is_train"] = True
+
+        def apply_fn(*xs):
+            kw = {}
+            if node.op.needs_rng:
+                kw["rng"] = jax.random.PRNGKey(0)
+            return node.op.fn(*xs, **kw, **attrs)
+
+        try:
+            out = jax.eval_shape(apply_fn, *structs)
+        except Exception as e:
+            raise MXNetError(
+                "infer_shape failed at op %s(%s) with input shapes %r: %s"
+                % (node.op.name, node.name, in_shapes, e)) from None
+        outs = list(out) if isinstance(out, (tuple, list)) else [out]
+        n_state = node.op.num_state
+        if n_state:
+            outs = outs[:-n_state]
+        for i, o in enumerate(outs):
+            shapes["out", id(node), i] = tuple(o.shape)
+            dtypes["out", id(node), i] = np.dtype(o.dtype) \
+                if o.dtype != jax.numpy.bfloat16 else jax.numpy.bfloat16
+    return shapes, dtypes

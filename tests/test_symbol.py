@@ -1,0 +1,155 @@
+"""Symbol compose / infer_shape / JSON round-trip / executor fwd+bwd.
+
+Modeled on the reference's tests/python/unittest/test_symbol.py and
+test_infer_shape.py (SURVEY.md §4).
+"""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import sym
+
+
+def _mlp():
+    data = sym.Variable("data")
+    fc1 = sym.FullyConnected(data, name="fc1", num_hidden=64)
+    act = sym.Activation(fc1, name="relu1", act_type="relu")
+    fc2 = sym.FullyConnected(act, name="fc2", num_hidden=10)
+    return sym.SoftmaxOutput(fc2, name="softmax")
+
+
+def test_compose_and_arguments():
+    net = _mlp()
+    args = net.list_arguments()
+    assert args == ["data", "fc1_weight", "fc1_bias", "fc2_weight",
+                    "fc2_bias", "softmax_label"]
+    assert net.list_outputs() == ["softmax_output"]
+
+
+def test_infer_shape_backward_params():
+    net = _mlp()
+    arg_shapes, out_shapes, aux_shapes = net.infer_shape(data=(32, 100))
+    d = dict(zip(net.list_arguments(), arg_shapes))
+    assert d["fc1_weight"] == (64, 100)
+    assert d["fc1_bias"] == (64,)
+    assert d["fc2_weight"] == (10, 64)
+    assert out_shapes == [(32, 10)]
+    assert aux_shapes == []
+
+
+def test_infer_shape_conv():
+    data = sym.Variable("data")
+    conv = sym.Convolution(data, name="conv1", kernel=(3, 3), num_filter=8,
+                           pad=(1, 1))
+    bn = sym.BatchNorm(conv, name="bn1")
+    pool = sym.Pooling(bn, kernel=(2, 2), stride=(2, 2), pool_type="max")
+    arg_shapes, out_shapes, aux_shapes = pool.infer_shape(data=(2, 3, 8, 8))
+    d = dict(zip(pool.list_arguments(), arg_shapes))
+    assert d["conv1_weight"] == (8, 3, 3, 3)
+    assert d["conv1_bias"] == (8,)
+    assert d["bn1_gamma"] == (8,)
+    assert out_shapes == [(2, 8, 4, 4)]
+    assert aux_shapes == [(8,), (8,)]
+    assert pool.list_auxiliary_states() == ["bn1_moving_mean",
+                                            "bn1_moving_var"]
+
+
+def test_json_roundtrip():
+    net = _mlp()
+    js = net.tojson()
+    net2 = mx.sym.load_json(js)
+    assert net2.list_arguments() == net.list_arguments()
+    assert net2.list_outputs() == net.list_outputs()
+    a1, o1, _ = net.infer_shape(data=(4, 20))
+    a2, o2, _ = net2.infer_shape(data=(4, 20))
+    assert a1 == a2 and o1 == o2
+
+
+def test_group_and_internals():
+    data = sym.Variable("data")
+    fc1 = sym.FullyConnected(data, name="fc1", num_hidden=4)
+    act = sym.Activation(fc1, name="act1", act_type="tanh")
+    grp = mx.sym.Group([fc1, act])
+    assert grp.list_outputs() == ["fc1_output", "act1_output"]
+    internals = act.get_internals()
+    assert "fc1_output" in internals.list_outputs()
+    fc1_again = internals["fc1_output"]
+    assert fc1_again.list_outputs() == ["fc1_output"]
+
+
+def test_simple_bind_forward_backward():
+    np.random.seed(0)
+    net = _mlp()
+    ex = net.simple_bind(mx.cpu(), data=(8, 100))
+    for name, arr in ex.arg_dict.items():
+        if name not in ("data", "softmax_label"):
+            arr[:] = np.random.uniform(-0.1, 0.1, arr.shape)
+    x = np.random.uniform(-1, 1, (8, 100)).astype(np.float32)
+    y = np.random.randint(0, 10, (8,)).astype(np.float32)
+    outs = ex.forward(is_train=True, data=x, softmax_label=y)
+    p = outs[0].asnumpy()
+    assert p.shape == (8, 10)
+    np.testing.assert_allclose(p.sum(axis=1), np.ones(8), rtol=1e-5)
+    ex.backward()
+    gw = ex.grad_dict["fc2_weight"].asnumpy()
+    assert np.abs(gw).sum() > 0
+    # SoftmaxOutput grad at data: p - onehot
+    gd = ex.grad_dict["data"].asnumpy()
+    assert gd.shape == x.shape
+
+
+def test_executor_grad_req_add_and_null():
+    data = mx.sym.Variable("data")
+    w = mx.sym.Variable("w")
+    out = data * w
+    ex = out.bind(mx.cpu(),
+                  args={"data": mx.nd.array([1.0, 2.0]),
+                        "w": mx.nd.array([3.0, 4.0])},
+                  grad_req={"data": "null", "w": "add"})
+    ex.forward(is_train=True)
+    ex.backward(mx.nd.array([1.0, 1.0]))
+    ex.forward(is_train=True)
+    ex.backward(mx.nd.array([1.0, 1.0]))
+    np.testing.assert_allclose(ex.grad_dict["w"].asnumpy(), [2.0, 4.0])
+    assert ex.grad_dict["data"] is None
+
+
+def test_batchnorm_aux_update_in_executor():
+    data = sym.Variable("data")
+    bn = sym.BatchNorm(data, name="bn", momentum=0.5, fix_gamma=False)
+    ex = bn.simple_bind(mx.cpu(), data=(4, 3))
+    ex.arg_dict["bn_gamma"][:] = 1.0
+    ex.aux_dict["bn_moving_var"][:] = 1.0
+    x = np.random.RandomState(1).normal(3.0, 2.0, (4, 3)).astype(np.float32)
+    ex.forward(is_train=True, data=x)
+    mm = ex.aux_dict["bn_moving_mean"].asnumpy()
+    np.testing.assert_allclose(mm, 0.5 * x.mean(axis=0), rtol=1e-5)
+    # inference path uses (unchanged) moving stats
+    ex2_out = ex.forward(is_train=False, data=x)[0].asnumpy()
+    expect = (x - mm) / np.sqrt(
+        ex.aux_dict["bn_moving_var"].asnumpy() + 1e-3)
+    np.testing.assert_allclose(ex2_out, expect, rtol=1e-4)
+
+
+def test_attr_scope_ctx_group():
+    with mx.AttrScope(ctx_group="dev1"):
+        data = sym.Variable("data")
+        fc = sym.FullyConnected(data, name="fc", num_hidden=3)
+    assert fc.attr("__ctx_group__") == "dev1"
+
+
+def test_symbol_arith_and_methods():
+    a = sym.Variable("a")
+    b = sym.Variable("b")
+    c = (a + b * 2.0).sum()
+    ex = c.bind(mx.cpu(), args={"a": mx.nd.array([1.0, 2.0]),
+                                "b": mx.nd.array([3.0, 4.0])})
+    out = ex.forward()[0].asnumpy()
+    np.testing.assert_allclose(out, 17.0)
+
+
+def test_variable_shape_attr_used_in_infer():
+    data = sym.Variable("data", shape=(5, 7))
+    fc = sym.FullyConnected(data, num_hidden=2)
+    arg_shapes, out_shapes, _ = fc.infer_shape()
+    assert out_shapes == [(5, 2)]
