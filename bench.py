@@ -1,0 +1,72 @@
+"""Headline benchmark: ResNet-50 training throughput (img/s) on one chip.
+
+Baseline: reference MXNet trains ResNet-50 at 109 img/s (batch 32) on one
+K80 (BASELINE.md; example/image-classification/README.md:147-155). Same
+workload here: full fwd+bwd+SGD-momentum update, synthetic ImageNet batch
+(the reference's own benchmark mode, train_imagenet.py --benchmark 1).
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+"""
+import json
+import os
+import sys
+import time
+
+# MXU-friendly matmul precision for the perf path (see mxnet_tpu/__init__)
+os.environ.setdefault("MXNET_MATMUL_PRECISION", "default")
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+import numpy as np  # noqa: E402
+
+BASELINE_IMG_S = 109.0  # reference ResNet-50, 1x K80, batch 32
+
+
+def main():
+    import jax
+    import mxnet_tpu as mx
+    from mxnet_tpu.models import resnet
+    from mxnet_tpu.parallel import make_train_step
+    from mxnet_tpu.initializer import Xavier
+
+    batch = int(os.environ.get("BENCH_BATCH", "64"))
+    image = 224
+    sym = resnet.get_symbol(num_classes=1000, num_layers=50,
+                            image_shape=(3, image, image))
+
+    step = make_train_step(sym, optimizer="sgd",
+                           optimizer_params={"momentum": 0.9, "wd": 1e-4,
+                                             "rescale_grad": 1.0 / batch})
+    state = step.init_state(Xavier(factor_type="in", magnitude=2.0),
+                            {"data": (batch, 3, image, image),
+                             "softmax_label": (batch,)})
+
+    rng = jax.random.PRNGKey(0)
+    x = np.random.RandomState(0).standard_normal(
+        (batch, 3, image, image)).astype(np.float32)
+    y = np.random.RandomState(1).randint(0, 1000, (batch,)).astype(
+        np.float32)
+    batch_vals = {"data": x, "softmax_label": y}
+
+    # warmup/compile
+    for _ in range(2):
+        state, outs = step(state, batch_vals, 0.1, rng)
+    jax.block_until_ready(outs)
+
+    iters = int(os.environ.get("BENCH_ITERS", "20"))
+    t0 = time.time()
+    for _ in range(iters):
+        state, outs = step(state, batch_vals, 0.1, rng)
+    jax.block_until_ready(outs)
+    dt = time.time() - t0
+
+    img_s = batch * iters / dt
+    print(json.dumps({
+        "metric": "resnet50_train_throughput",
+        "value": round(img_s, 2),
+        "unit": "img/s",
+        "vs_baseline": round(img_s / BASELINE_IMG_S, 3)}))
+
+
+if __name__ == "__main__":
+    main()

@@ -51,3 +51,23 @@ from .symbol import Symbol
 
 from . import executor
 from .executor import Executor
+
+from . import registry
+from . import io
+from . import initializer
+from . import initializer as init
+from . import lr_scheduler
+from . import optimizer
+from . import optimizer as opt
+from .optimizer import Optimizer
+from . import metric
+from . import kvstore
+from . import kvstore as kv
+from . import callback
+from . import monitor
+from .monitor import Monitor
+from . import model
+from .model import FeedForward
+from . import module
+from . import module as mod
+from .module import Module

@@ -1,0 +1,215 @@
+"""KVStore — the data-parallel parameter store (reference:
+python/mxnet/kvstore.py 570 LoC; native src/kvstore/kvstore_local.h,
+comm.h, kvstore_dist.h; SURVEY.md N15/N16/P6).
+
+TPU-native design
+-----------------
+The reference's KVStore is a communication tree: device grads are staged to
+CPU (CommCPU) or reduced P2P (CommDevice), an optional `updater` runs on the
+merged copy, and results broadcast back. On TPU the same semantics collapse
+onto XLA collectives:
+
+* `local`/`device`: per-key reduce = `jnp.sum` over the device copies'
+  stacked axis — executed as ONE jitted reduction; when the copies live on a
+  mesh this lowers to an ICI all-reduce (psum). The merged value lives
+  replicated (the analogue of the CPU merge buffer).
+* `dist_*`: the parameter-server worker/server/scheduler triad is replaced
+  by jax.distributed (coordinator) + the same collective step — see
+  mxnet_tpu.parallel. `dist_async` has no XLA analogue (documented drop;
+  SURVEY.md §2.3).
+
+The push/pull/row_sparse_pull/updater API is preserved exactly so
+Module/Gluon training loops are unchanged.
+"""
+from __future__ import annotations
+
+import pickle
+
+from .base import string_types
+from . import ndarray
+from .ndarray import NDArray
+from . import optimizer as opt
+
+__all__ = ["KVStore", "create"]
+
+
+def _key_list(keys):
+    if isinstance(keys, (int, str)):
+        return [keys], True
+    assert isinstance(keys, (list, tuple))
+    return list(keys), False
+
+
+def _value_list(vals, n):
+    """Group values per key: accepts NDArray, list-of-NDArray (one key),
+    or list-of-(NDArray|list) aligned with keys."""
+    if isinstance(vals, NDArray):
+        return [[vals]]
+    assert isinstance(vals, (list, tuple))
+    if n == 1 and (not vals or isinstance(vals[0], NDArray)):
+        return [list(vals)]
+    out = []
+    for v in vals:
+        out.append([v] if isinstance(v, NDArray) else list(v))
+    assert len(out) == n
+    return out
+
+
+class KVStore:
+    """In-process key-value store with reference semantics (reference
+    include/mxnet/kvstore.h:45-372, kvstore_local.h)."""
+
+    def __init__(self, kv_type="local"):
+        self.type = kv_type
+        self._store = {}          # key -> merged NDArray (replicated copy)
+        self._updater = None
+        self._optimizer = None
+        self._barrier_before_exit = True
+
+    # -- identity ----------------------------------------------------------
+    @property
+    def rank(self):
+        """Worker rank (reference kvstore.py:rank). In-process: 0; the
+        multi-host path reports jax.process_index() via parallel.dist."""
+        try:
+            import jax
+            return jax.process_index()
+        except Exception:
+            return 0
+
+    @property
+    def num_workers(self):
+        try:
+            import jax
+            return jax.process_count()
+        except Exception:
+            return 1
+
+    # -- init/push/pull ----------------------------------------------------
+    def init(self, key, value):
+        """Initialize key(s) once (reference kvstore.py:init). Values are
+        the initial (replicated) weights."""
+        keys, _ = _key_list(key)
+        vals = _value_list(value, len(keys))
+        for k, vlist in zip(keys, vals):
+            if k in self._store:
+                raise ValueError("duplicate init of key %r" % (k,))
+            self._store[k] = vlist[0].copy()
+
+    def push(self, key, value, priority=0):
+        """Push (sum-reduce device copies, then apply updater if set) —
+        reference kvstore.py:push / comm.h Reduce."""
+        keys, _ = _key_list(key)
+        vals = _value_list(value, len(keys))
+        for k, vlist in zip(keys, vals):
+            if k not in self._store:
+                raise KeyError("key %r has not been initialized" % (k,))
+            if len(vlist) == 1:
+                merged = vlist[0]
+            else:
+                # one fused reduction op; on a sharded mesh this is the
+                # all-reduce (reference: CommCPU::Reduce OMP tree sum)
+                merged = ndarray.add_n(*vlist)
+            if self._updater is not None:
+                # updater mutates the stored weight in place
+                self._updater(k, merged, self._store[k])
+            else:
+                # no updater: the store holds the reduced push value
+                # (reference KVStoreLocal: CopyFromTo(merged, &local))
+                self._store[k] = merged.copy()
+
+    def pull(self, key, out=None, priority=0):
+        """Pull merged value into out array(s) (reference
+        kvstore.py:pull / comm.h Broadcast)."""
+        assert out is not None
+        keys, _ = _key_list(key)
+        outs = _value_list(out, len(keys))
+        for k, olist in zip(keys, outs):
+            if k not in self._store:
+                raise KeyError("key %r has not been initialized" % (k,))
+            src = self._store[k]
+            for o in olist:
+                o._set_data(src._data.astype(o._data.dtype)
+                            if o.dtype != src.dtype else src._data)
+
+    def row_sparse_pull(self, key, out=None, priority=0, row_ids=None):
+        """Pull only the rows in row_ids (reference
+        kvstore.py:row_sparse_pull). Dense-gather emulation: XLA is
+        dense-first; the sparse path gathers rows then scatters on update."""
+        assert out is not None and row_ids is not None
+        keys, _ = _key_list(key)
+        outs = _value_list(out, len(keys))
+        rids = row_ids if isinstance(row_ids, (list, tuple)) else [row_ids]
+        if len(rids) == 1 and len(outs) > 1:
+            rids = rids * len(outs)
+        for k, olist, rid in zip(keys, outs, rids):
+            src = self._store[k]
+            taken = ndarray.take(src, rid)
+            for o in olist:
+                o._set_data(taken._data)
+
+    # -- updater/optimizer -------------------------------------------------
+    def set_updater(self, updater):
+        """Set the merge-time updater (reference kvstore.py:_set_updater)."""
+        self._updater = updater
+
+    _set_updater = set_updater
+
+    def set_optimizer(self, optimizer):
+        """Run this optimizer on the (logical) server (reference
+        kvstore.py:set_optimizer; server side kvstore_dist_server.h:233).
+        In-process and on-mesh this installs the fused-update updater."""
+        self._optimizer = optimizer
+        self.set_updater(opt.get_updater(optimizer))
+
+    # -- gradient compression (reference has none in 0.11; no-op hook) -----
+    def set_gradient_compression(self, compression_params):
+        raise NotImplementedError(
+            "gradient compression is not part of the 0.11 reference surface")
+
+    # -- optimizer state IO (reference kvstore.py:save/load_optimizer_states)
+    def save_optimizer_states(self, fname, dump_optimizer=False):
+        assert self._updater is not None, "Cannot save states for distributed training"
+        with open(fname, "wb") as fout:
+            fout.write(self._updater.get_states(dump_optimizer))
+
+    def load_optimizer_states(self, fname):
+        assert self._updater is not None, "Cannot load states for distributed training"
+        with open(fname, "rb") as fin:
+            self._updater.set_states(fin.read())
+
+    # -- cluster control surface (reference kvstore.py:barrier etc.) -------
+    def barrier(self):
+        """Global sync barrier across workers. In-process: no-op; multihost
+        uses the coordinator (parallel.dist)."""
+        if self.num_workers > 1:
+            import jax
+            from jax.experimental import multihost_utils
+            multihost_utils.sync_global_devices("mxnet_tpu_kv_barrier")
+
+    def _send_command_to_servers(self, head, body):
+        pass
+
+    def __del__(self):
+        pass
+
+
+def create(name="local"):
+    """Factory (reference kvstore.py:create + kvstore.cc:34-61): types
+    local | device | dist_sync | dist_device_sync | dist_async.
+
+    `device` differs from `local` only in where reduction runs; with XLA
+    both lower to the same fused reduction, so one class serves both.
+    dist types require multi-process jax.distributed init (see
+    mxnet_tpu.parallel.dist); used single-process they behave as local with
+    num_workers==1 (the reference's tests run exactly this way via the
+    `local` dmlc_tracker launcher)."""
+    if not isinstance(name, string_types):
+        raise TypeError("name must be a string")
+    valid = ("local", "device", "local_allreduce_cpu",
+             "local_allreduce_device", "dist_sync", "dist_device_sync",
+             "dist_async", "dist")
+    if name not in valid:
+        raise ValueError("Unknown KVStore type %r. Valid: %r"
+                         % (name, valid))
+    return KVStore(name)

@@ -1,0 +1,6 @@
+"""Model zoo (symbolic builders) — reference:
+example/image-classification/symbols/ (resnet, alexnet, vgg, inception,
+lenet, mlp). Gluon model_zoo lives in mxnet_tpu.gluon.model_zoo."""
+from . import resnet
+from . import lenet
+from . import mlp

@@ -1,0 +1,8 @@
+"""Module API — intermediate/high-level symbolic training interface
+(reference: python/mxnet/module/, SURVEY.md P4)."""
+from .base_module import BaseModule
+from .executor_group import DataParallelExecutorGroup
+from .module import Module
+from .bucketing_module import BucketingModule
+from .sequential_module import SequentialModule
+from .python_module import PythonModule, PythonLossModule

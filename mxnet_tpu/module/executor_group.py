@@ -1,0 +1,291 @@
+"""DataParallelExecutorGroup — TPU-native data parallelism.
+
+Reference: python/mxnet/module/executor_group.py (600 LoC): slices each
+batch across contexts (`decide_slices` :233), binds one executor per
+device (:586-600), reduces grads via KVStore.
+
+TPU-native redesign (SURVEY.md §2.3 row 1): do NOT slice the batch in
+Python. One executor computes the whole batch; when multiple contexts are
+given, a 1-D `jax.sharding.Mesh` over those devices is built and input
+batches are placed with `NamedSharding(P('data'))` while parameters stay
+replicated (`P()`). GSPMD then partitions the compiled step across devices
+and inserts the grad all-reduce on ICI — the collective that replaces the
+reference's CommCPU/CommDevice reduction trees. Because the vjp of the
+batch-summed loss already aggregates across the data axis, the grads this
+group exposes are the *reduced* grads (kvstore push over them is then a
+pure optimizer step, preserving the update-path API).
+"""
+from __future__ import annotations
+
+import logging
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from .. import context as ctx_mod
+from .. import io
+from ..base import MXNetError
+from ..executor import Executor
+from ..ndarray import NDArray, zeros, _wrap
+from ..ndarray import ndarray as _nd
+
+
+def _merge_multi_context(outputs, major_axis):
+    """Kept for API parity: with a single sharded executor the outputs are
+    already merged (reference executor_group.py:_merge_multi_context)."""
+    return outputs
+
+
+class DataParallelExecutorGroup:
+    """Group managing the (single, sharded) executor for data-parallel
+    training (reference executor_group.py:99)."""
+
+    def __init__(self, symbol, contexts, workload, data_shapes, label_shapes,
+                 param_names, for_training, inputs_need_grad,
+                 shared_group=None, logger=logging, fixed_param_names=None,
+                 grad_req="write", state_names=None):
+        self.param_names = param_names
+        self.arg_names = symbol.list_arguments()
+        self.aux_names = symbol.list_auxiliary_states()
+        self.symbol = symbol
+        self.contexts = contexts
+        self.workload = workload  # unused: XLA load-balances the mesh
+        self.for_training = for_training
+        self.inputs_need_grad = inputs_need_grad
+        self.fixed_param_names = fixed_param_names or []
+        self.state_names = state_names or []
+        self.logger = logger
+
+        if shared_group is not None:
+            # shared storage between bucketing executors: jit constant-folds
+            # & caches per shape; arrays are shared by reference
+            self.shared_data_arrays = shared_group.shared_data_arrays
+        else:
+            self.shared_data_arrays = {}
+
+        if grad_req != "null" and for_training:
+            self.grad_req = {}
+            for k in self.arg_names:
+                if k in self.param_names:
+                    self.grad_req[k] = ("null" if k in self.fixed_param_names
+                                        else grad_req)
+                elif k in [d[0] for d in data_shapes]:
+                    self.grad_req[k] = grad_req if inputs_need_grad else "null"
+                else:
+                    self.grad_req[k] = "null"
+        else:
+            self.grad_req = {k: "null" for k in self.arg_names}
+
+        self._mesh = self._build_mesh(contexts)
+        self._total_exec_bytes = 0
+        self.batch_size = None
+        self.execs = []       # kept 1-long for API parity
+        self.data_arrays = None
+        self.label_arrays = None
+        self.param_arrays = None
+        self.grad_arrays = None
+        self.aux_arrays = None
+        self.input_grad_arrays = None
+        self.data_shapes = None
+        self.label_shapes = None
+        self.data_layouts = None
+        self.label_layouts = None
+        self.output_layouts = None
+        self.num_outputs = None
+
+        self.bind_exec(data_shapes, label_shapes, shared_group)
+
+    @staticmethod
+    def _build_mesh(contexts):
+        """1-D 'data' mesh over the contexts' devices; None for 1 ctx
+        (single-chip path needs no partitioning)."""
+        if len(contexts) <= 1:
+            return None
+        devices = []
+        for c in contexts:
+            d = c.jax_device()
+            if d in devices:
+                raise MXNetError(
+                    "duplicate device %r in contexts %r — each data-parallel "
+                    "context must map to a distinct device" % (d, contexts))
+            devices.append(d)
+        return Mesh(np.array(devices), ("data",))
+
+    # -- binding -----------------------------------------------------------
+    def bind_exec(self, data_shapes, label_shapes, shared_group=None,
+                  reshape=False):
+        """Bind the sharded executor (reference
+        executor_group.py:bind_exec)."""
+        self.batch_size = data_shapes[0].shape[0] \
+            if isinstance(data_shapes[0], io.DataDesc) \
+            else data_shapes[0][1][0]
+        if self._mesh is not None:
+            n_dev = len(self.contexts)
+            if self.batch_size % n_dev != 0:
+                raise MXNetError(
+                    "batch size %d must be divisible by the number of "
+                    "devices %d (mesh data-parallel)" %
+                    (self.batch_size, n_dev))
+
+        self.data_shapes = [x if isinstance(x, io.DataDesc)
+                            else io.DataDesc(*x) for x in data_shapes]
+        self.label_shapes = [x if isinstance(x, io.DataDesc)
+                             else io.DataDesc(*x) for x in label_shapes] \
+            if label_shapes is not None else None
+        self.data_names = [x.name for x in self.data_shapes]
+        self.label_names = [x.name for x in self.label_shapes] \
+            if self.label_shapes is not None else []
+
+        input_shapes = {d.name: d.shape for d in self.data_shapes}
+        if self.label_shapes is not None:
+            input_shapes.update({l.name: l.shape
+                                 for l in self.label_shapes})
+        input_types = {d.name: d.dtype for d in self.data_shapes}
+        if self.label_shapes is not None:
+            input_types.update({l.name: l.dtype for l in self.label_shapes})
+
+        arg_shapes, _, aux_shapes = self.symbol.infer_shape(**input_shapes)
+        arg_types, _, aux_types = self.symbol.infer_type(**input_types)
+
+        # param/aux arrays from a previous bind (batch-shape reshape) must
+        # be carried over — rebuilding them as zeros would silently wipe
+        # trained weights mid-training
+        prev_args = self.execs[0].arg_dict if self.execs else {}
+        prev_aux = self.execs[0].aux_dict if self.execs else {}
+
+        args = {}
+        for name, shape, dtype in zip(self.arg_names, arg_shapes, arg_types):
+            if name in self.param_names and name in prev_args and \
+                    tuple(prev_args[name].shape) == tuple(shape):
+                args[name] = prev_args[name]
+            elif name in self.shared_data_arrays and \
+                    tuple(self.shared_data_arrays[name].shape) == \
+                    tuple(shape):
+                args[name] = self.shared_data_arrays[name]
+            else:
+                args[name] = zeros(shape, dtype=dtype)
+                if name not in self.param_names:
+                    self.shared_data_arrays[name] = args[name]
+        aux = [prev_aux[n] if n in prev_aux and
+               tuple(prev_aux[n].shape) == tuple(s) else zeros(s, dtype=t)
+               for n, s, t in zip(self.aux_names, aux_shapes, aux_types)]
+
+        executor = Executor(self.symbol, ctx=self.contexts[0],
+                            args=[args[n] for n in self.arg_names],
+                            grad_req=self.grad_req, aux_states=aux)
+        self.execs = [executor]
+
+        # views, kept in reference shapes: list (over params) of list
+        # (over devices — length 1: grads are already reduced on-mesh)
+        self.param_arrays = [[executor.arg_dict[n]]
+                             for n in self.param_names]
+        self.grad_arrays = [[executor.grad_dict[n]]
+                            if self.grad_req.get(n, "null") != "null"
+                            else [None]
+                            for n in self.param_names]
+        self.aux_arrays = [[a] for a in executor.aux_arrays]
+        self.data_arrays = [[(slice(0, self.batch_size),
+                              executor.arg_dict[n])]
+                            for n in self.data_names]
+        self.label_arrays = [[(slice(0, self.batch_size),
+                               executor.arg_dict[n])]
+                             for n in self.label_names]
+        self.input_grad_arrays = [[executor.grad_dict[n]]
+                                  for n in self.data_names] \
+            if self.inputs_need_grad else None
+        self.num_outputs = len(self.symbol.list_outputs())
+
+    def reshape(self, data_shapes, label_shapes):
+        """Rebind for new shapes (jit recompiles per shape; arrays are
+        reallocated) — reference executor_group.py:reshape."""
+        if data_shapes == self.data_shapes and \
+                label_shapes == self.label_shapes:
+            return
+        self.bind_exec(data_shapes, label_shapes, reshape=True)
+
+    # -- params ------------------------------------------------------------
+    def set_params(self, arg_params, aux_params, allow_extra=False):
+        """Copy params into the bound executor (reference
+        executor_group.py:set_params)."""
+        self.execs[0].copy_params_from(arg_params, aux_params,
+                                       allow_extra_params=allow_extra)
+
+    def get_params(self, arg_params, aux_params):
+        """Copy current params out into the given dicts (reference
+        executor_group.py:get_params)."""
+        for name in self.param_names:
+            arg_params[name] = self.execs[0].arg_dict[name].copy()
+        for name in self.aux_names:
+            aux_params[name] = self.execs[0].aux_dict[name].copy()
+
+    # -- compute -----------------------------------------------------------
+    def _shard(self, array_data, batch_axis=0):
+        """Place a batch array on the mesh, sharded along the data axis."""
+        if self._mesh is None:
+            return array_data
+        spec = [None] * array_data.ndim
+        if array_data.ndim > 0:
+            spec[batch_axis] = "data"
+        return jax.device_put(array_data,
+                              NamedSharding(self._mesh, P(*spec)))
+
+    def forward(self, data_batch, is_train=None):
+        """Split (=shard) and load data, run forward (reference
+        executor_group.py:forward)."""
+        if is_train is None:
+            is_train = self.for_training
+
+        executor = self.execs[0]
+        feeds = {}
+        for name, arr in zip(self.data_names, data_batch.data):
+            data = arr._data if isinstance(arr, NDArray) else \
+                _nd.array(arr)._data
+            feeds[name] = _wrap(self._shard(data))
+        if is_train or (data_batch.label is not None and self.label_names):
+            if data_batch.label is not None:
+                for name, arr in zip(self.label_names, data_batch.label):
+                    data = arr._data if isinstance(arr, NDArray) else \
+                        _nd.array(arr)._data
+                    feeds[name] = _wrap(self._shard(data))
+        executor.forward(is_train=is_train, **feeds)
+
+    def backward(self, out_grads=None):
+        """Backward over the sharded graph; the resulting param grads are
+        globally reduced by GSPMD (reference
+        executor_group.py:backward)."""
+        assert self.for_training, "re-bind with for_training=True to run " \
+            "backward"
+        self.execs[0].backward(out_grads=out_grads)
+
+    def get_outputs(self, merge_multi_context=True):
+        outs = [[o] for o in self.execs[0].outputs]
+        if merge_multi_context:
+            return [o[0] for o in outs]
+        return outs
+
+    def get_input_grads(self, merge_multi_context=True):
+        assert self.inputs_need_grad
+        grads = [[self.execs[0].grad_dict[n]] for n in self.data_names]
+        if merge_multi_context:
+            return [g[0] for g in grads]
+        return grads
+
+    def get_states(self, merge_multi_context=True):
+        assert not merge_multi_context or True
+        return []
+
+    def set_states(self, states=None, value=None):
+        assert not states and not value
+
+    def update_metric(self, eval_metric, labels):
+        """Update metric with current outputs (reference
+        executor_group.py:update_metric)."""
+        labels_ = {name: l for name, l in zip(self.label_names, labels or [])}
+        preds = dict(zip(self.symbol.list_outputs(),
+                         self.execs[0].outputs))
+        eval_metric.update_dict(labels_, preds)
+
+    def install_monitor(self, mon):
+        for exe in self.execs:
+            mon.install(exe)

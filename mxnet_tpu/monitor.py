@@ -1,0 +1,105 @@
+"""Monitor — per-op output/weight statistics for debugging (reference:
+python/mxnet/monitor.py, 143 LoC; native hook ExecuteMonCallback,
+src/executor/graph_executor.h:200).
+
+TPU-native: outputs are captured from executor forward results (XLA fusion
+means interior values are not individually materialized; the monitor sees
+graph heads and, via `monitor_all`, the per-node values recomputed in
+interpret mode — the debugging analogue of the reference's per-op engine
+callback)."""
+from __future__ import annotations
+
+import logging
+import re
+from math import sqrt
+
+from .ndarray import NDArray, op as _op
+
+__all__ = ["Monitor"]
+
+
+class Monitor:
+    """Installable statistics monitor (reference monitor.py:Monitor)."""
+
+    def __init__(self, interval, stat_func=None, pattern=".*", sort=False):
+        if stat_func is None:
+            def asum_stat(x):
+                """returns |x|/size(x), async execution."""
+                return _op.norm(x) / sqrt(x.size)
+            stat_func = asum_stat
+        self.stat_func = stat_func
+        self.interval = interval
+        self.activated = False
+        self.queue = []
+        self.step = 0
+        self.exes = []
+        self.re_prog = re.compile(pattern)
+        self.sort = sort
+
+        def stat_helper(name, array):
+            if not self.activated or not self.re_prog.match(name):
+                return
+            self.queue.append((self.step, name, self.stat_func(array)))
+        self.stat_helper = stat_helper
+
+    def install(self, exe, monitor_all=False):
+        """Attach to an executor (reference monitor.py:install)."""
+        exe.set_monitor_callback(self.stat_helper, monitor_all)
+        self.exes.append(exe)
+
+    def tic(self):
+        """Start collecting for this step if due (reference
+        monitor.py:tic)."""
+        if self.step % self.interval == 0:
+            for exe in self.exes:
+                for array in exe.arg_arrays:
+                    array.wait_to_read()
+                for array in exe.aux_arrays:
+                    array.wait_to_read()
+            self.queue = []
+            self.activated = True
+        self.step += 1
+
+    def toc(self):
+        """Finish the step; gather stats incl. args/aux (reference
+        monitor.py:toc)."""
+        if not self.activated:
+            return []
+        for exe in self.exes:
+            for array in exe.arg_arrays:
+                array.wait_to_read()
+            for array in exe.aux_arrays:
+                array.wait_to_read()
+        for exe in self.exes:
+            for name, array in zip(exe._arg_names, exe.arg_arrays):
+                if self.re_prog.match(name):
+                    self.queue.append((self.step, name,
+                                       self.stat_func(array)))
+            for name, array in zip(exe._aux_names, exe.aux_arrays):
+                if self.re_prog.match(name):
+                    self.queue.append((self.step, name,
+                                       self.stat_func(array)))
+        self.activated = False
+        res = []
+        if self.sort:
+            self.queue.sort(key=lambda x: x[1])
+        for n, k, v_list in self.queue:
+            if isinstance(v_list, NDArray):
+                v_list = [v_list]
+            assert isinstance(v_list, list)
+            s = ""
+            for v in v_list:
+                assert isinstance(v, NDArray)
+                if v.shape == (1,) or v.shape == ():
+                    s += str(v.asscalar()) + "\t"
+                else:
+                    s += str(v.asnumpy()) + "\t"
+            res.append((n, k, s))
+        self.queue = []
+        return res
+
+    def toc_print(self):
+        """toc + log (reference monitor.py:toc_print)."""
+        res = self.toc()
+        for n, k, v in res:
+            logging.info("Batch: %7d %30s %s", n, k, v)

@@ -1,0 +1,776 @@
+"""Optimizers (reference: python/mxnet/optimizer.py, 1085 LoC).
+
+API-faithful: registry + per-param lr/wd multipliers, `create_state`,
+`update`, `Updater` for KVStore, `get_updater`. TPU-native: each update is
+one fused registry op (ops/optimizer_ops.py — the analogue of the
+reference's src/operator/optimizer_op.cc fused kernels); XLA fuses the whole
+elementwise chain, and when an update runs inside a jitted step function it
+fuses into the step itself. Multi-precision (mp_*) holds a float32 master
+copy next to bf16/f16 weights — the TPU mixed-precision recipe.
+"""
+from __future__ import annotations
+
+import logging
+import math
+import pickle
+import warnings
+
+import numpy as np
+
+from .base import numeric_types, string_types
+from .ndarray import NDArray, zeros, op as _op
+from .ndarray.ndarray import array as _array
+
+__all__ = ["Optimizer", "SGD", "Signum", "NAG", "SGLD", "DCASGD", "ccSGD",
+           "Adam", "AdaGrad", "RMSProp", "AdaDelta", "Ftrl", "Adamax",
+           "Nadam", "LAMB", "Test", "Updater", "get_updater", "create",
+           "register", "opt_registry"]
+
+
+class Optimizer:
+    """Base optimizer (reference optimizer.py:Optimizer)."""
+
+    opt_registry = {}
+
+    @staticmethod
+    def register(klass):
+        """Register an optimizer class by (lowercased) name."""
+        assert isinstance(klass, type)
+        name = klass.__name__.lower()
+        if name in Optimizer.opt_registry:
+            warnings.warn("WARNING: New optimizer %s.%s is overriding "
+                          "existing optimizer %s.%s" % (
+                              klass.__module__, klass.__name__,
+                              Optimizer.opt_registry[name].__module__,
+                              Optimizer.opt_registry[name].__name__))
+        Optimizer.opt_registry[name] = klass
+        return klass
+
+    @staticmethod
+    def create_optimizer(name, **kwargs):
+        """Instantiate by registered name (reference
+        optimizer.py:create_optimizer)."""
+        if name.lower() in Optimizer.opt_registry:
+            return Optimizer.opt_registry[name.lower()](**kwargs)
+        raise ValueError("Cannot find optimizer %s" % name)
+
+    def __init__(self, rescale_grad=1.0, param_idx2name=None, wd=0.0,
+                 clip_gradient=None, learning_rate=0.01, lr_scheduler=None,
+                 sym=None, begin_num_update=0, param_dict=None):
+        self.rescale_grad = rescale_grad
+        self.lr = learning_rate
+        self.lr_scheduler = lr_scheduler
+        if lr_scheduler is not None:
+            self.lr_scheduler.base_lr = learning_rate
+
+        self.wd = wd
+        self.lr_mult = {}
+        self.wd_mult = {}
+        self.begin_num_update = begin_num_update
+        self.num_update = begin_num_update
+        self._index_update_count = {}
+        self.clip_gradient = clip_gradient
+        self.multi_precision = False
+
+        if param_idx2name is None:
+            param_idx2name = {}
+        assert isinstance(param_idx2name, dict), \
+            "param_idx2name should be a dict of param indexes to names."
+        self.idx2name = param_idx2name.copy()
+        self.sym_info = (sym.attr_dict(), sym.list_arguments()) \
+            if sym is not None else ()
+        self.param_dict = param_dict if param_dict else {}
+
+        self.set_lr_mult({})
+        self.set_wd_mult({})
+
+    def create_state(self, index, weight):
+        """Create optimizer state (momentum etc.) for one weight."""
+        return None
+
+    def create_state_multi_precision(self, index, weight):
+        """State incl. the float32 master weight when multi-precision is on
+        (reference optimizer.py:create_state_multi_precision)."""
+        weight_master_copy = None
+        if self.multi_precision and weight.dtype == np.float16:
+            weight_master_copy = weight.astype(np.float32)
+            return (weight_master_copy, self.create_state(index,
+                                                          weight_master_copy))
+        if weight.dtype == np.float16 and not self.multi_precision:
+            warnings.warn("Accumulating with float16 in optimizer can lead "
+                          "to poor accuracy or slow convergence. Consider "
+                          "using multi_precision=True option of the optimizer")
+        return self.create_state(index, weight)
+
+    def update(self, index, weight, grad, state):
+        """Apply one update. Subclasses override."""
+        raise NotImplementedError()
+
+    def update_multi_precision(self, index, weight, grad, state):
+        if self.multi_precision and weight.dtype == np.float16:
+            weight_master_copy, original_state = state
+            grad32 = grad.astype(np.float32)
+            self.update(index, weight_master_copy, grad32, original_state)
+            weight[:] = weight_master_copy.astype(weight.dtype)
+        else:
+            self.update(index, weight, grad, state)
+
+    def set_learning_rate(self, lr):
+        if self.lr_scheduler is not None:
+            raise UserWarning("LRScheduler of the optimizer has already been "
+                              "defined. Note that set_learning_rate can mutate "
+                              "the value of the learning rate of the optimizer "
+                              "only when the LRScheduler of the optimizer is "
+                              "undefined.")
+        self.lr = lr
+
+    def set_lr_scale(self, args_lrscale):  # pragma: no cover - deprecated
+        raise DeprecationWarning("Use set_lr_mult instead.")
+
+    def set_lr_mult(self, args_lr_mult):
+        """Per-param lr multipliers; also pulls ``__lr_mult__`` symbol attrs
+        (reference optimizer.py:set_lr_mult)."""
+        self.lr_mult = {}
+        if self.sym_info:
+            attr, arg_names = self.sym_info
+            for name in arg_names:
+                if name in attr and "__lr_mult__" in attr[name]:
+                    self.lr_mult[name] = float(attr[name]["__lr_mult__"])
+        self.lr_mult.update(args_lr_mult)
+
+    def set_wd_mult(self, args_wd_mult):
+        """Per-param wd multipliers. As in the reference, params whose name
+        does not end in _weight or _gamma default to wd_mult=0 (no decay
+        on biases/betas)."""
+        self.wd_mult = {}
+        for n in self.idx2name.values():
+            if not (n.endswith("_weight") or n.endswith("_gamma")):
+                self.wd_mult[n] = 0.0
+        if self.sym_info:
+            attr, arg_names = self.sym_info
+            for name in arg_names:
+                if name in attr and "__wd_mult__" in attr[name]:
+                    self.wd_mult[name] = float(attr[name]["__wd_mult__"])
+        self.wd_mult.update(args_wd_mult)
+
+    def _update_count(self, index):
+        if index not in self._index_update_count:
+            self._index_update_count[index] = self.begin_num_update
+        self._index_update_count[index] += 1
+        self.num_update = max(self._index_update_count[index],
+                              self.num_update)
+
+    def _get_lr(self, index):
+        if self.lr_scheduler is not None:
+            lr = self.lr_scheduler(self.num_update)
+        else:
+            lr = self.lr
+
+        if index in self.param_dict:
+            lr *= self.param_dict[index].lr_mult
+        elif index in self.lr_mult:
+            lr *= self.lr_mult[index]
+        elif index in self.idx2name:
+            lr *= self.lr_mult.get(self.idx2name[index], 1.0)
+        return lr
+
+    def _get_wd(self, index):
+        wd = self.wd
+        if index in self.param_dict:
+            wd *= self.param_dict[index].wd_mult
+        elif index in self.wd_mult:
+            wd *= self.wd_mult[index]
+        elif index in self.idx2name:
+            wd *= self.wd_mult.get(self.idx2name[index], 1.0)
+        return wd
+
+    def __getstate__(self):
+        ret = self.__dict__.copy()
+        del ret["lr_scheduler"]
+        return ret
+
+    def __setstate__(self, state):
+        self.__dict__ = state
+        self.lr_scheduler = None
+
+
+register = Optimizer.register
+create = Optimizer.create_optimizer
+opt_registry = Optimizer.opt_registry
+
+
+def _clip_attr(clip_gradient):
+    return -1.0 if clip_gradient is None else clip_gradient
+
+
+@register
+class SGD(Optimizer):
+    """SGD with momentum + optional multi-precision
+    (reference optimizer.py:SGD; fused kernels sgd_update/sgd_mom_update/
+    mp_sgd_* from src/operator/optimizer_op.cc)."""
+
+    def __init__(self, momentum=0.0, multi_precision=False, **kwargs):
+        super().__init__(**kwargs)
+        self.momentum = momentum
+        self.multi_precision = multi_precision
+
+    def create_state(self, index, weight):
+        if self.momentum == 0.0:
+            return None
+        return zeros(weight.shape, dtype=weight.dtype)
+
+    def create_state_multi_precision(self, index, weight):
+        weight_master_copy = None
+        if self.multi_precision and weight.dtype == np.float16:
+            weight_master_copy = weight.astype(np.float32)
+            return (self.create_state(index, weight_master_copy),
+                    weight_master_copy)
+        if weight.dtype == np.float16 and not self.multi_precision:
+            warnings.warn("Accumulating with float16 in optimizer can lead "
+                          "to poor accuracy or slow convergence. Consider "
+                          "using multi_precision=True option of the SGD "
+                          "optimizer")
+        return self.create_state(index, weight)
+
+    def _update_impl(self, index, weight, grad, state, multi_precision=False):
+        assert isinstance(weight, NDArray)
+        assert isinstance(grad, NDArray)
+        self._update_count(index)
+        lr = self._get_lr(index)
+        wd = self._get_wd(index)
+
+        kwargs = dict(lr=lr, wd=wd, rescale_grad=self.rescale_grad,
+                      clip_gradient=_clip_attr(self.clip_gradient))
+        if not multi_precision:
+            if state is not None:
+                _op.sgd_mom_update(weight, grad, state, out=weight,
+                                   momentum=self.momentum, **kwargs)
+            else:
+                _op.sgd_update(weight, grad, out=weight, **kwargs)
+        else:
+            if state[0] is not None:
+                _op.mp_sgd_mom_update(weight, grad, state[0], state[1],
+                                      out=weight, momentum=self.momentum,
+                                      **kwargs)
+            else:
+                _op.mp_sgd_update(weight, grad, state[1], out=weight,
+                                  **kwargs)
+
+    def update(self, index, weight, grad, state):
+        self._update_impl(index, weight, grad, state, multi_precision=False)
+
+    def update_multi_precision(self, index, weight, grad, state):
+        use_mp = self.multi_precision and weight.dtype == np.float16
+        self._update_impl(index, weight, grad, state,
+                          multi_precision=use_mp)
+
+
+@register
+class Signum(Optimizer):
+    """SignSGD / Signum (fused signsgd_update; later-reference optimizer
+    kept because the fused kernel exists here)."""
+
+    def __init__(self, learning_rate=0.01, momentum=0.9, wd_lh=0.0, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.momentum = momentum
+        self.wd_lh = wd_lh
+
+    def create_state(self, index, weight):
+        if self.momentum != 0.0:
+            return zeros(weight.shape, dtype=weight.dtype)
+        return None
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr = self._get_lr(index)
+        wd = self._get_wd(index)
+        if state is not None:
+            g = grad * self.rescale_grad
+            if self.clip_gradient is not None:
+                g = _op.clip(g, -self.clip_gradient, self.clip_gradient)
+            state[:] = self.momentum * state - (1 - self.momentum) * \
+                (g + wd * weight)
+            weight[:] = weight + lr * _op.sign(state) - \
+                lr * self.wd_lh * weight
+        else:
+            _op.signsgd_update(weight, grad, out=weight, lr=lr, wd=wd,
+                               rescale_grad=self.rescale_grad,
+                               clip_gradient=_clip_attr(self.clip_gradient))
+
+
+@register
+class DCASGD(Optimizer):
+    """Delay-compensated async SGD (reference optimizer.py:DCASGD)."""
+
+    def __init__(self, momentum=0.0, lamda=0.04, **kwargs):
+        super().__init__(**kwargs)
+        self.momentum = momentum
+        self.weight_previous = {}
+        self.lamda = lamda
+
+    def create_state(self, index, weight):
+        if self.momentum == 0.0:
+            return (None, weight.copy())
+        return (zeros(weight.shape, dtype=weight.dtype), weight.copy())
+
+    def update(self, index, weight, grad, state):
+        assert isinstance(weight, NDArray)
+        assert isinstance(grad, NDArray)
+        self._update_count(index)
+        lr = self._get_lr(index)
+        wd = self._get_wd(index)
+
+        grad = grad * self.rescale_grad
+        if self.clip_gradient is not None:
+            grad = _op.clip(grad, -self.clip_gradient, self.clip_gradient)
+
+        mom, previous_weight = state
+        if mom is not None:
+            mom[:] *= self.momentum
+            mom[:] += -lr * (grad + wd * weight + self.lamda *
+                             grad * grad * (weight - previous_weight))
+        else:
+            assert self.momentum == 0.0
+            mom = -lr * (grad + wd * weight + self.lamda *
+                         grad * grad * (weight - previous_weight))
+            state = (None, previous_weight)
+        previous_weight[:] = weight
+        weight[:] += mom
+
+
+@register
+class NAG(SGD):
+    """Nesterov accelerated SGD (reference optimizer.py:NAG)."""
+
+    def __init__(self, **kwargs):
+        super().__init__(**kwargs)
+
+    def update(self, index, weight, grad, state):
+        assert isinstance(weight, NDArray)
+        assert isinstance(grad, NDArray)
+        self._update_count(index)
+        lr = self._get_lr(index)
+        wd = self._get_wd(index)
+
+        grad = grad * self.rescale_grad
+        if self.clip_gradient is not None:
+            grad = _op.clip(grad, -self.clip_gradient, self.clip_gradient)
+
+        if state is not None:
+            mom = state
+            mom[:] *= self.momentum
+            grad += wd * weight
+            mom[:] += grad
+            grad[:] += self.momentum * mom
+            weight[:] += -lr * grad
+        else:
+            assert self.momentum == 0.0
+            weight[:] += -lr * (grad + wd * weight)
+
+
+@register
+class SGLD(Optimizer):
+    """Stochastic Gradient Langevin Dynamics (reference
+    optimizer.py:SGLD)."""
+
+    def __init__(self, **kwargs):
+        super().__init__(**kwargs)
+
+    def create_state(self, index, weight):
+        return None
+
+    def update(self, index, weight, grad, state):
+        assert isinstance(weight, NDArray)
+        assert isinstance(grad, NDArray)
+        self._update_count(index)
+        lr = self._get_lr(index)
+        wd = self._get_wd(index)
+
+        grad = grad * self.rescale_grad
+        if self.clip_gradient is not None:
+            grad = _op.clip(grad, -self.clip_gradient, self.clip_gradient)
+        from . import random as _rnd
+        import jax
+        noise = _array(np.asarray(
+            jax.random.normal(_rnd.next_key(), weight.shape)) *
+            math.sqrt(lr))
+        weight[:] += -lr / 2 * (grad + wd * weight) + noise
+
+
+@register
+class ccSGD(SGD):  # pylint: disable=invalid-name
+    """Deprecated alias of SGD (reference optimizer.py:ccSGD)."""
+
+    def __init__(self, *args, **kwargs):
+        super().__init__(*args, **kwargs)
+
+
+@register
+class Adam(Optimizer):
+    """Adam (reference optimizer.py:Adam; fused adam_update)."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.beta1 = beta1
+        self.beta2 = beta2
+        self.epsilon = epsilon
+
+    def create_state(self, index, weight):
+        return (zeros(weight.shape, dtype=weight.dtype),   # mean
+                zeros(weight.shape, dtype=weight.dtype))   # variance
+
+    def update(self, index, weight, grad, state):
+        assert isinstance(weight, NDArray)
+        assert isinstance(grad, NDArray)
+        self._update_count(index)
+        lr = self._get_lr(index)
+        wd = self._get_wd(index)
+
+        t = self._index_update_count[index]
+        coef1 = 1. - self.beta1 ** t
+        coef2 = 1. - self.beta2 ** t
+        lr *= math.sqrt(coef2) / coef1
+
+        mean, var = state
+        _op.adam_update(weight, grad, mean, var, out=weight, lr=lr, wd=wd,
+                        beta1=self.beta1, beta2=self.beta2,
+                        epsilon=self.epsilon,
+                        rescale_grad=self.rescale_grad,
+                        clip_gradient=_clip_attr(self.clip_gradient))
+
+
+@register
+class AdaGrad(Optimizer):
+    """AdaGrad (reference optimizer.py:AdaGrad)."""
+
+    def __init__(self, eps=1e-7, **kwargs):
+        super().__init__(**kwargs)
+        self.float_stable_eps = eps
+
+    def create_state(self, index, weight):
+        return zeros(weight.shape, dtype=weight.dtype)  # history
+
+    def update(self, index, weight, grad, state):
+        assert isinstance(weight, NDArray)
+        assert isinstance(grad, NDArray)
+        self._update_count(index)
+        lr = self._get_lr(index)
+        wd = self._get_wd(index)
+
+        grad = grad * self.rescale_grad
+        if self.clip_gradient is not None:
+            grad = _op.clip(grad, -self.clip_gradient, self.clip_gradient)
+        history = state
+        history[:] += grad * grad
+        weight[:] += -lr * (grad / _op.sqrt(history + self.float_stable_eps)
+                            + wd * weight)
+
+
+@register
+class RMSProp(Optimizer):
+    """RMSProp, Tieleman (centered=False) / Graves (centered=True) variants
+    (reference optimizer.py:RMSProp; fused rmsprop/rmspropalex kernels)."""
+
+    def __init__(self, learning_rate=0.001, gamma1=0.9, gamma2=0.9,
+                 epsilon=1e-8, centered=False, clip_weights=None, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.gamma1 = gamma1
+        self.gamma2 = gamma2
+        self.centered = centered
+        self.epsilon = epsilon
+        self.clip_weights = clip_weights
+
+    def create_state(self, index, weight):
+        if self.centered:
+            return (zeros(weight.shape, dtype=weight.dtype),  # n
+                    zeros(weight.shape, dtype=weight.dtype),  # g
+                    zeros(weight.shape, dtype=weight.dtype))  # delta
+        return (zeros(weight.shape, dtype=weight.dtype),)     # n
+
+    def update(self, index, weight, grad, state):
+        assert isinstance(weight, NDArray)
+        assert isinstance(grad, NDArray)
+        self._update_count(index)
+        lr = self._get_lr(index)
+        wd = self._get_wd(index)
+
+        kwargs = dict(lr=lr, wd=wd, gamma1=self.gamma1,
+                      epsilon=self.epsilon,
+                      rescale_grad=self.rescale_grad,
+                      clip_gradient=_clip_attr(self.clip_gradient),
+                      clip_weights=(self.clip_weights
+                                    if self.clip_weights else -1.0))
+        if not self.centered:
+            (n,) = state
+            _op.rmsprop_update(weight, grad, n, out=weight, **kwargs)
+        else:
+            n, g, delta = state
+            _op.rmspropalex_update(weight, grad, n, g, delta, out=weight,
+                                   gamma2=self.gamma2, **kwargs)
+
+
+@register
+class AdaDelta(Optimizer):
+    """AdaDelta (reference optimizer.py:AdaDelta)."""
+
+    def __init__(self, rho=0.90, epsilon=1e-5, **kwargs):
+        super().__init__(**kwargs)
+        self.rho = rho
+        self.epsilon = epsilon
+
+    def create_state(self, index, weight):
+        return (zeros(weight.shape, dtype=weight.dtype),  # E[g^2]
+                zeros(weight.shape, dtype=weight.dtype))  # E[dx^2]
+
+    def update(self, index, weight, grad, state):
+        assert isinstance(weight, NDArray)
+        assert isinstance(grad, NDArray)
+        self._update_count(index)
+        wd = self._get_wd(index)
+
+        grad = grad * self.rescale_grad
+        if self.clip_gradient is not None:
+            grad = _op.clip(grad, -self.clip_gradient, self.clip_gradient)
+
+        acc_g, acc_delta = state
+        acc_g[:] = self.rho * acc_g + (1. - self.rho) * grad * grad
+        current_delta = (_op.sqrt(acc_delta + self.epsilon) /
+                         _op.sqrt(acc_g + self.epsilon)) * grad
+        acc_delta[:] = self.rho * acc_delta + \
+            (1. - self.rho) * current_delta * current_delta
+        weight[:] -= current_delta + wd * weight
+
+
+@register
+class Ftrl(Optimizer):
+    """FTRL-proximal (reference optimizer.py:Ftrl; fused ftrl_update)."""
+
+    def __init__(self, lamda1=0.01, learning_rate=0.1, beta=1, **kwargs):
+        super().__init__(**kwargs)
+        self.lamda1 = lamda1
+        self.beta = beta
+        self.lr = learning_rate
+
+    def create_state(self, index, weight):
+        return (zeros(weight.shape, dtype=weight.dtype),  # z
+                zeros(weight.shape, dtype=weight.dtype))  # n
+
+    def update(self, index, weight, grad, state):
+        assert isinstance(weight, NDArray)
+        assert isinstance(grad, NDArray)
+        self._update_count(index)
+        wd = self._get_wd(index)
+        lr = self._get_lr(index)
+
+        z, n = state
+        _op.ftrl_update(weight, grad, z, n, out=weight, lr=lr, wd=wd,
+                        lamda1=self.lamda1, beta=self.beta,
+                        rescale_grad=self.rescale_grad,
+                        clip_gradient=_clip_attr(self.clip_gradient))
+
+
+@register
+class Adamax(Optimizer):
+    """AdaMax, infinity-norm Adam variant (reference
+    optimizer.py:Adamax)."""
+
+    def __init__(self, learning_rate=0.002, beta1=0.9, beta2=0.999,
+                 **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.beta1 = beta1
+        self.beta2 = beta2
+
+    def create_state(self, index, weight):
+        return (zeros(weight.shape, dtype=weight.dtype),  # mean
+                zeros(weight.shape, dtype=weight.dtype))  # variance
+
+    def update(self, index, weight, grad, state):
+        assert isinstance(weight, NDArray)
+        assert isinstance(grad, NDArray)
+        self._update_count(index)
+        lr = self._get_lr(index)
+        wd = self._get_wd(index)
+
+        t = self._index_update_count[index]
+        lr /= (1. - self.beta1 ** t)
+
+        grad = grad * self.rescale_grad + wd * weight
+        if self.clip_gradient is not None:
+            grad = _op.clip(grad, -self.clip_gradient, self.clip_gradient)
+
+        m_t, u_t = state
+        m_t[:] = self.beta1 * m_t + (1. - self.beta1) * grad
+        u_t[:] = _op.maximum(self.beta2 * u_t, _op.abs(grad))
+        weight[:] -= lr * m_t / u_t
+
+
+@register
+class Nadam(Optimizer):
+    """Nesterov Adam (reference optimizer.py:Nadam)."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, schedule_decay=0.004, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.beta1 = beta1
+        self.beta2 = beta2
+        self.epsilon = epsilon
+        self.schedule_decay = schedule_decay
+        self.m_schedule = 1.
+
+    def create_state(self, index, weight):
+        return (zeros(weight.shape, dtype=weight.dtype),  # mean
+                zeros(weight.shape, dtype=weight.dtype))  # variance
+
+    def update(self, index, weight, grad, state):
+        assert isinstance(weight, NDArray)
+        assert isinstance(grad, NDArray)
+        self._update_count(index)
+        lr = self._get_lr(index)
+        wd = self._get_wd(index)
+
+        t = self._index_update_count[index]
+
+        grad = grad * self.rescale_grad + wd * weight
+        if self.clip_gradient is not None:
+            grad = _op.clip(grad, -self.clip_gradient, self.clip_gradient)
+
+        momentum_t = self.beta1 * (1. - 0.5 * 0.96 ** (
+            t * self.schedule_decay))
+        momentum_t_1 = self.beta1 * (1. - 0.5 * 0.96 ** (
+            (t + 1) * self.schedule_decay))
+        self.m_schedule = self.m_schedule * momentum_t
+        m_schedule_next = self.m_schedule * momentum_t_1
+
+        m_t, v_t = state
+        m_t[:] = self.beta1 * m_t + (1. - self.beta1) * grad
+        v_t[:] = self.beta2 * v_t + (1. - self.beta2) * grad * grad
+
+        grad_prime = grad / (1. - self.m_schedule)
+        m_t_prime = m_t / (1. - m_schedule_next)
+        v_t_prime = v_t / (1. - self.beta2 ** t)
+        m_t_bar = (1. - momentum_t) * grad_prime + \
+            momentum_t_1 * m_t_prime
+
+        weight[:] -= lr * m_t_bar / (_op.sqrt(v_t_prime) + self.epsilon)
+
+
+@register
+class LAMB(Optimizer):
+    """Layer-wise adaptive Adam for large-batch TPU training (extension:
+    the reference predates LAMB; included because large-batch data parallel
+    is the TPU scaling mode — You et al. 2019)."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-6, lower_bound=1e-3, upper_bound=10.0, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.beta1 = beta1
+        self.beta2 = beta2
+        self.epsilon = epsilon
+        self.lower_bound = lower_bound
+        self.upper_bound = upper_bound
+
+    def create_state(self, index, weight):
+        return (zeros(weight.shape, dtype=weight.dtype),
+                zeros(weight.shape, dtype=weight.dtype))
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr = self._get_lr(index)
+        wd = self._get_wd(index)
+        t = self._index_update_count[index]
+
+        grad = grad * self.rescale_grad
+        if self.clip_gradient is not None:
+            grad = _op.clip(grad, -self.clip_gradient, self.clip_gradient)
+
+        m, v = state
+        m[:] = self.beta1 * m + (1. - self.beta1) * grad
+        v[:] = self.beta2 * v + (1. - self.beta2) * grad * grad
+        m_hat = m / (1. - self.beta1 ** t)
+        v_hat = v / (1. - self.beta2 ** t)
+        update = m_hat / (_op.sqrt(v_hat) + self.epsilon) + wd * weight
+        # trust ratio computed on-device: no host sync in the update path
+        w_norm = _op.norm(weight)
+        u_norm = _op.norm(update)
+        ratio = _op.where(w_norm * u_norm > 0,
+                          _op.clip(w_norm / (u_norm + 1e-30),
+                                   self.lower_bound, self.upper_bound),
+                          _op.ones_like(w_norm))
+        weight[:] -= lr * ratio * update
+
+
+@register
+class Test(Optimizer):
+    """Mock optimizer for update-path tests (reference
+    optimizer.py:1002)."""
+
+    def __init__(self, **kwargs):
+        super().__init__(**kwargs)
+
+    def create_state(self, index, weight):
+        return zeros(weight.shape, dtype=weight.dtype)
+
+    def update(self, index, weight, grad, state):
+        weight[:] += grad * self.rescale_grad
+        state[:] = weight
+
+
+class Updater:
+    """KVStore updater closure over an Optimizer (reference
+    optimizer.py:1019 get_updater/Updater): lazily creates per-key state on
+    first update; states picklable via get_states/set_states."""
+
+    def __init__(self, optimizer):
+        self.optimizer = optimizer
+        self.states = {}
+        self.states_synced = {}
+
+    def __call__(self, index, grad, weight):
+        if index not in self.states:
+            self.states[index] = \
+                self.optimizer.create_state_multi_precision(index, weight)
+            self.states_synced[index] = True
+        elif not self.states_synced[index]:
+            self.states[index] = \
+                self.sync_state_context(self.states[index], weight.context)
+            self.states_synced[index] = True
+        self.optimizer.update_multi_precision(index, weight, grad,
+                                              self.states[index])
+
+    def sync_state_context(self, state, context):
+        if isinstance(state, NDArray):
+            return state.as_in_context(context)
+        if isinstance(state, np.ndarray):  # revived from get_states pickle
+            return _array(state, ctx=context)
+        if isinstance(state, (tuple, list)):
+            return type(state)(
+                self.sync_state_context(i, context) for i in state)
+        return state
+
+    def set_states(self, states):
+        """Load pickled states (reference Updater.set_states)."""
+        states = pickle.loads(states)
+        if isinstance(states, tuple) and len(states) == 2:
+            self.states, self.optimizer = states
+        else:
+            self.states = states
+        self.states_synced = dict.fromkeys(self.states.keys(), False)
+
+    def get_states(self, dump_optimizer=False):
+        """Pickle states (+ optionally the optimizer itself)."""
+        def to_np(s):
+            if isinstance(s, NDArray):
+                return s.asnumpy()
+            if isinstance(s, (tuple, list)):
+                return type(s)(to_np(i) for i in s)
+            return s
+        states = {k: to_np(v) for k, v in self.states.items()}
+        return pickle.dumps((states, self.optimizer) if dump_optimizer
+                            else states)
+
+
+def get_updater(optimizer):
+    """Wrap an optimizer as a kvstore updater fn (reference
+    optimizer.py:get_updater)."""
+    return Updater(optimizer)

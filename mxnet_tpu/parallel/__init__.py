@@ -1,0 +1,14 @@
+"""Parallel/distributed execution (SURVEY.md §2.3).
+
+The reference's entire distribution stack — DataParallelExecutorGroup batch
+slicing, KVStore comm trees (comm.h), ps-lite parameter server
+(kvstore_dist.h) — collapses on TPU into ONE compiled SPMD program over a
+`jax.sharding.Mesh`: shardings annotate where tensors live, XLA inserts the
+collectives (psum/all-gather/reduce-scatter) on ICI/DCN, and the optimizer
+update runs sharded next to the gradients (the analogue of
+update_on_kvstore server-side updates).
+"""
+from .trainer import make_train_step, TrainStep
+from .sharding import (data_parallel_mesh, make_mesh, param_sharding,
+                       batch_sharding)
+from . import dist

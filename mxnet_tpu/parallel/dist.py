@@ -1,0 +1,58 @@
+"""Multi-host distributed init — the replacement for the reference's
+ps-lite scheduler/tracker (SURVEY.md N16/N25, tools/launch.py).
+
+The reference cluster: a scheduler node + N workers + M servers wired by
+env vars (DMLC_ROLE, DMLC_PS_ROOT_URI...). TPU-native: every host runs the
+SAME SPMD program; `jax.distributed.initialize` (coordinator address +
+process id) replaces the scheduler; the global device mesh spans hosts over
+DCN and collectives replace push/pull. `dist_async` (server applies updates
+as they arrive) has no XLA analogue and is a documented drop — use
+`dist_sync` semantics (the reference's recommended mode for convergence).
+
+Env compat shims: DMLC_* vars map onto the JAX coordinator so reference
+launch scripts keep working.
+"""
+from __future__ import annotations
+
+import os
+
+import jax
+
+__all__ = ["init", "rank", "size", "is_initialized"]
+
+_initialized = False
+
+
+def init(coordinator_address=None, num_processes=None, process_id=None):
+    """Initialize multi-host JAX from args or DMLC_*/JAX env vars."""
+    global _initialized
+    if _initialized:
+        return
+    if coordinator_address is None:
+        uri = os.environ.get("DMLC_PS_ROOT_URI")
+        port = os.environ.get("DMLC_PS_ROOT_PORT", "9000")
+        if uri:
+            coordinator_address = "%s:%s" % (uri, port)
+    if num_processes is None:
+        nw = os.environ.get("DMLC_NUM_WORKER")
+        num_processes = int(nw) if nw else None
+    if process_id is None:
+        pid = os.environ.get("DMLC_WORKER_ID")
+        process_id = int(pid) if pid else None
+    if coordinator_address is not None:
+        jax.distributed.initialize(coordinator_address=coordinator_address,
+                                   num_processes=num_processes,
+                                   process_id=process_id)
+    _initialized = True
+
+
+def is_initialized():
+    return _initialized
+
+
+def rank():
+    return jax.process_index()
+
+
+def size():
+    return jax.process_count()

@@ -1,0 +1,79 @@
+"""Mesh + sharding rules.
+
+Reference mapping (SURVEY.md §2.3): contexts -> mesh axes. The reference
+placed whole layers on devices (group2ctx + PlaceDevice inserting
+_CrossDeviceCopy); here placement is a sharding annotation and XLA inserts
+the transfers/collectives.
+
+Axes convention (scaling-book style):
+  data  — batch dimension (DP). Grad all-reduce rides this axis.
+  model — hidden dimension (TP). Matmul partials psum over this axis.
+More axes (pipe, seq, expert) are added by the specific parallel modules.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+__all__ = ["make_mesh", "data_parallel_mesh", "param_sharding",
+           "batch_sharding", "replicated"]
+
+
+def make_mesh(axis_sizes, devices=None):
+    """Build a Mesh from {'data': N, 'model': M, ...}. Sizes must multiply
+    to the device count (pass -1 for one axis to infer)."""
+    names = tuple(axis_sizes.keys())
+    sizes = list(axis_sizes.values())
+    if devices is None:
+        devices = jax.devices()
+    n = len(devices)
+    if -1 in sizes:
+        known = int(np.prod([s for s in sizes if s != -1]))
+        sizes[sizes.index(-1)] = n // known
+    assert int(np.prod(sizes)) == n, \
+        "mesh axes %r don't multiply to %d devices" % (sizes, n)
+    arr = np.asarray(devices).reshape(sizes)
+    return Mesh(arr, names)
+
+
+def data_parallel_mesh(devices=None):
+    """1-D data mesh over all (or given) devices."""
+    if devices is None:
+        devices = jax.devices()
+    return Mesh(np.asarray(devices), ("data",))
+
+
+def replicated(mesh):
+    return NamedSharding(mesh, P())
+
+
+def batch_sharding(mesh, ndim, batch_axis=0):
+    """Batch arrays: shard the batch axis over 'data' (+ nothing else)."""
+    spec = [None] * ndim
+    spec[batch_axis] = "data"
+    return NamedSharding(mesh, P(*spec))
+
+
+def param_sharding(mesh, name, shape):
+    """Default tensor-parallel rule for a parameter.
+
+    FullyConnected weights are (num_hidden, in); sharding dim 0 over
+    'model' makes the matmul column-parallel (Megatron-style) — XLA
+    all-gathers activations / psums partials as needed. Conv weights are
+    (O,I,H,W); shard O. Anything not divisible stays replicated. This is
+    the round-1 heuristic surface; per-layer annotations (ctx_group
+    analogue) override via Symbol attrs `__shard__`.
+    """
+    if "model" not in mesh.axis_names:
+        return NamedSharding(mesh, P())
+    msize = mesh.shape["model"]
+    if len(shape) >= 2 and shape[0] % msize == 0 and (
+            name.endswith("_weight") or name.endswith("weight")):
+        spec = ["model"] + [None] * (len(shape) - 1)
+        return NamedSharding(mesh, P(*spec))
+    if len(shape) == 1 and shape[0] % msize == 0 and \
+            name.endswith("_bias"):
+        return NamedSharding(mesh, P("model"))
+    return NamedSharding(mesh, P())

@@ -1,0 +1,172 @@
+"""The compiled SPMD training step.
+
+This is the TPU-native replacement for the reference's whole training hot
+path: GraphExecutor::Forward/Backward + KVStore push/pull + fused
+optimizer_op, all inside ONE `jax.jit`. XLA fuses forward, backward and the
+parameter update, overlaps the grad all-reduce with backprop (the same
+overlap the reference achieved by pushing KVStore reductions onto
+prioritized engine queues, comm.h:109-178), and donates parameter buffers
+so updates are in-place in HBM.
+
+Reference call stack being replaced: SURVEY.md §3.1 (fit loop internals).
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ..executor import _graph_eval_fn
+from ..ops.registry import get_op
+from . import sharding as shd
+
+__all__ = ["make_train_step", "TrainStep"]
+
+# fused optimizer ops: name -> (#state tensors, op name)
+_OPT_OPS = {
+    "sgd": (1, "sgd_mom_update"),       # momentum (0.0 => plain sgd math)
+    "adam": (2, "adam_update"),
+    "rmsprop": (1, "rmsprop_update"),
+    "ftrl": (2, "ftrl_update"),
+    "signum": (0, "signsgd_update"),
+}
+
+
+class TrainStep:
+    """A compiled train step over an optional mesh.
+
+    state = (params: dict, opt_state: dict name->tuple, aux: dict)
+    step(state, batch, lr, rng) -> (state, outputs)
+    """
+
+    def __init__(self, symbol, data_names=("data",),
+                 label_names=("softmax_label",), optimizer="sgd",
+                 optimizer_params=None, mesh=None, donate=True):
+        self.symbol = symbol
+        self.mesh = mesh
+        self.data_names = list(data_names)
+        self.label_names = list(label_names)
+        self.arg_names = symbol.list_arguments()
+        self.aux_names = symbol.list_auxiliary_states()
+        self.input_names = self.data_names + self.label_names
+        self.param_names = [n for n in self.arg_names
+                            if n not in self.input_names]
+        self.opt_name = optimizer
+        self.opt_params = dict(optimizer_params or {})
+        if optimizer not in _OPT_OPS:
+            raise ValueError("TrainStep supports fused optimizers %r"
+                             % sorted(_OPT_OPS))
+        self._n_state, self._opt_op = _OPT_OPS[optimizer]
+        self._eval_fn = _graph_eval_fn(symbol)
+
+        step = self._build_step()
+        if mesh is not None:
+            param_shd = {}  # filled at init_state; jit infers from inputs
+            self._jit_step = jax.jit(
+                step, donate_argnums=(0, 1, 2) if donate else ())
+        else:
+            self._jit_step = jax.jit(
+                step, donate_argnums=(0, 1, 2) if donate else ())
+
+    # -- state -------------------------------------------------------------
+    def init_state(self, initializer, batch_shapes, batch_dtypes=None,
+                   dtype=None):
+        """Initialize (params, opt_state, aux) with mesh placement.
+
+        initializer: mxnet_tpu.initializer.Initializer applied host-side
+        (reference init path), then placed per the sharding rules."""
+        from ..initializer import InitDesc
+        from ..ndarray import zeros as nd_zeros
+
+        input_shapes = dict(batch_shapes)
+        arg_shapes, _, aux_shapes = self.symbol.infer_shape(**input_shapes)
+        name2shape = dict(zip(self.arg_names, arg_shapes))
+        aux2shape = dict(zip(self.aux_names, aux_shapes))
+
+        params, opt_state, aux = {}, {}, {}
+        for n in self.param_names:
+            arr = nd_zeros(name2shape[n])
+            initializer(InitDesc(n), arr)
+            v = arr._data if dtype is None else arr._data.astype(dtype)
+            params[n] = self._place_param(n, v)
+            opt_state[n] = tuple(
+                self._place_param(n, jnp.zeros_like(params[n]))
+                for _ in range(self._n_state))
+        for n in self.aux_names:
+            init_v = jnp.ones(aux2shape[n], jnp.float32) \
+                if n.endswith("var") else jnp.zeros(aux2shape[n],
+                                                    jnp.float32)
+            aux[n] = self._place_rep(init_v)
+        return params, opt_state, aux
+
+    def _place_param(self, name, value):
+        if self.mesh is None:
+            return value
+        return jax.device_put(
+            value, shd.param_sharding(self.mesh, name, value.shape))
+
+    def _place_rep(self, value):
+        if self.mesh is None:
+            return value
+        return jax.device_put(value, shd.replicated(self.mesh))
+
+    def place_batch(self, batch):
+        """Shard batch arrays along the data axis."""
+        if self.mesh is None:
+            return batch
+        return {k: jax.device_put(
+            v, shd.batch_sharding(self.mesh, np.ndim(v)))
+            for k, v in batch.items()}
+
+    # -- the step ----------------------------------------------------------
+    def _build_step(self):
+        eval_fn = self._eval_fn
+        param_names = self.param_names
+        opt_attrs = dict(self.opt_params)
+        opt_fn = get_op(self._opt_op).fn
+        n_state = self._n_state
+
+        def step(params, opt_state, aux, batch, lr, rng):
+            def fwd(p):
+                outs, new_aux = eval_fn({**batch, **p}, aux, rng, True)
+                return outs, new_aux
+
+            outs, vjp, new_aux = jax.vjp(fwd, params, has_aux=True)
+            # loss heads (SoftmaxOutput & co) define custom vjps that
+            # ignore the incoming cotangent — ones matches the reference's
+            # head-grad convention (Executor.backward)
+            cot = tuple(jnp.ones_like(o) for o in outs)
+            grads = vjp(cot)[0]
+
+            new_params, new_opt = {}, {}
+            for n in param_names:
+                res = opt_fn(params[n], grads[n], *opt_state[n],
+                             lr=lr, **opt_attrs)
+                if n_state:
+                    new_params[n] = res[0]
+                    new_opt[n] = tuple(res[1:])
+                else:
+                    new_params[n] = res
+                    new_opt[n] = ()
+            return (new_params, new_opt, new_aux), outs
+
+        return step
+
+    def __call__(self, state, batch, lr, rng):
+        params, opt_state, aux = state
+        return self._jit_step(params, opt_state, aux, batch,
+                              jnp.asarray(lr, jnp.float32), rng)
+
+    def lower(self, state, batch, lr, rng):
+        """Lower (for AOT compile checks) without executing."""
+        params, opt_state, aux = state
+        return self._jit_step.lower(params, opt_state, aux, batch,
+                                    jnp.asarray(lr, jnp.float32), rng)
+
+
+def make_train_step(symbol, **kwargs):
+    """Factory: TrainStep (see class docs)."""
+    return TrainStep(symbol, **kwargs)

@@ -12,10 +12,20 @@ import threading
 
 import jax
 
-__all__ = ["seed", "next_key", "fork_key"]
+__all__ = ["seed", "next_key", "fork_key", "numpy_rng"]
 
 _state = threading.local()
 _DEFAULT_SEED = 0
+
+
+def numpy_rng():
+    """Host-side numpy Generator tied to the same seed stream — used by
+    initializers (host-side fills; reference seeds mshadow CPU PRNG from the
+    same global seed)."""
+    import numpy as np
+    if not hasattr(_state, "np_rng"):
+        _state.np_rng = np.random.default_rng(_DEFAULT_SEED)
+    return _state.np_rng
 
 
 def _key():
@@ -29,6 +39,8 @@ def seed(seed_state):
     global _DEFAULT_SEED
     _DEFAULT_SEED = int(seed_state)
     _state.key = jax.random.PRNGKey(int(seed_state))
+    import numpy as np
+    _state.np_rng = np.random.default_rng(int(seed_state))
 
 
 def next_key():

@@ -1,0 +1,105 @@
+"""Generic class registry with name/alias lookup and JSON round-trip.
+
+Reference: python/mxnet/registry.py — backs the Optimizer, Initializer,
+EvalMetric, ... registries via register/alias/create function factories.
+"""
+from __future__ import annotations
+
+import json
+import warnings
+
+from .base import string_types
+
+_REGISTRY = {}
+
+
+def get_registry(base_class):
+    """name -> class mapping registered under ``base_class``."""
+    return dict(_REGISTRY.get(base_class, {}))
+
+
+def get_register_func(base_class, nickname):
+    """Build the @register decorator for a base class
+    (reference registry.py:get_register_func)."""
+    if base_class not in _REGISTRY:
+        _REGISTRY[base_class] = {}
+    registry = _REGISTRY[base_class]
+
+    def register(klass, name=None):
+        assert issubclass(klass, base_class) or base_class is object, \
+            "Can only register subclass of %s" % base_class.__name__
+        if name is None:
+            name = klass.__name__
+        name = name.lower()
+        if name in registry:
+            warnings.warn(
+                "\033[91mNew %s %s.%s registered with name %s is overriding "
+                "existing %s %s.%s\033[0m" % (
+                    nickname, klass.__module__, klass.__name__, name,
+                    nickname, registry[name].__module__,
+                    registry[name].__name__), UserWarning)
+        registry[name] = klass
+        return klass
+
+    register.__doc__ = "Register %s to the %s factory" % (
+        nickname, nickname)
+    return register
+
+
+def get_alias_func(base_class, nickname):
+    """Build the @alias(*names) decorator
+    (reference registry.py:get_alias_func)."""
+    register = get_register_func(base_class, nickname)
+
+    def alias(*aliases):
+        def reg(klass):
+            for name in aliases:
+                register(klass, name)
+            return klass
+        return reg
+    return alias
+
+
+def get_create_func(base_class, nickname):
+    """Build create(name_or_instance, **kwargs) factory
+    (reference registry.py:get_create_func)."""
+    if base_class not in _REGISTRY:
+        _REGISTRY[base_class] = {}
+    registry = _REGISTRY[base_class]
+
+    def create(*args, **kwargs):
+        if len(args):
+            name = args[0]
+            args = args[1:]
+        else:
+            name = kwargs.pop(nickname)
+
+        if isinstance(name, base_class):
+            assert len(args) == 0 and len(kwargs) == 0, \
+                "%s is already an instance. Additional arguments are " \
+                "invalid" % nickname
+            return name
+
+        if isinstance(name, dict):
+            return create(**name)
+
+        assert isinstance(name, string_types), \
+            "%s must be of string type" % nickname
+
+        if name.startswith("["):
+            assert not args and not kwargs
+            name, kwargs = json.loads(name)
+            return create(name, **kwargs)
+        elif name.startswith("{"):
+            assert not args and not kwargs
+            kwargs = json.loads(name)
+            return create(**kwargs)
+
+        name = name.lower()
+        assert name in registry, \
+            "%s is not registered. Please register with %s.register first" \
+            % (name, nickname)
+        return registry[name](*args, **kwargs)
+
+    create.__doc__ = "Create a %s instance from config" % nickname
+    return create

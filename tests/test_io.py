@@ -1,0 +1,68 @@
+"""IO tests — reference: tests/python/unittest/test_io.py (NDArrayIter
+shuffle/pad/discard semantics)."""
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import io
+
+
+def test_ndarrayiter_basic():
+    data = np.arange(30).reshape(10, 3).astype(np.float32)
+    label = np.arange(10).astype(np.float32)
+    it = io.NDArrayIter(data, label, batch_size=5)
+    batches = list(it)
+    assert len(batches) == 2
+    assert batches[0].data[0].shape == (5, 3)
+    np.testing.assert_allclose(batches[0].data[0].asnumpy(), data[:5])
+    np.testing.assert_allclose(batches[1].label[0].asnumpy(), label[5:])
+    # reset + reiterate
+    it.reset()
+    assert len(list(it)) == 2
+
+
+def test_ndarrayiter_pad():
+    data = np.arange(21).reshape(7, 3).astype(np.float32)
+    it = io.NDArrayIter(data, None, batch_size=4, last_batch_handle="pad")
+    batches = list(it)
+    assert len(batches) == 2
+    assert batches[0].pad == 0
+    assert batches[1].pad == 1
+    # padded part wraps to the beginning
+    np.testing.assert_allclose(batches[1].data[0].asnumpy()[-1], data[0])
+
+
+def test_ndarrayiter_discard():
+    data = np.arange(21).reshape(7, 3).astype(np.float32)
+    it = io.NDArrayIter(data, None, batch_size=4,
+                        last_batch_handle="discard")
+    assert len(list(it)) == 1
+
+
+def test_ndarrayiter_dict_inputs():
+    it = io.NDArrayIter({"a": np.zeros((8, 2)), "b": np.ones((8, 3))},
+                        np.arange(8), batch_size=4)
+    assert sorted(d.name for d in it.provide_data) == ["a", "b"]
+    batch = next(it)
+    assert batch.data[0].shape in ((4, 2), (4, 3))
+
+
+def test_resize_iter():
+    data = np.zeros((10, 2))
+    it = io.ResizeIter(io.NDArrayIter(data, batch_size=5), size=5)
+    assert len(list(it)) == 5
+
+
+def test_prefetching_iter():
+    data = np.arange(40).reshape(20, 2).astype(np.float32)
+    base = io.NDArrayIter(data, np.arange(20), batch_size=5)
+    it = io.PrefetchingIter(base)
+    batches = list(it)
+    assert len(batches) == 4
+    it.reset()
+    assert len(list(it)) == 4
+
+
+def test_data_desc_layout():
+    d = io.DataDesc("data", (32, 3, 224, 224), layout="NCHW")
+    assert io.DataDesc.get_batch_axis(d.layout) == 0
+    assert io.DataDesc.get_batch_axis("TNC") == 1

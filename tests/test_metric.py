@@ -1,0 +1,68 @@
+"""Metric tests — reference: tests/python/unittest/test_metric.py."""
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import metric
+
+
+def test_accuracy():
+    m = metric.create("acc")
+    pred = mx.nd.array([[0.3, 0.7], [0.9, 0.1], [0.4, 0.6]])
+    label = mx.nd.array([1, 0, 0])
+    m.update([label], [pred])
+    name, value = m.get()
+    assert name == "accuracy"
+    assert abs(value - 2.0 / 3) < 1e-6
+
+
+def test_top_k():
+    m = metric.create("top_k_accuracy", top_k=2)
+    pred = mx.nd.array([[0.1, 0.5, 0.4], [0.6, 0.3, 0.1]])
+    label = mx.nd.array([2, 1])
+    m.update([label], [pred])
+    _, value = m.get()
+    assert abs(value - 1.0) < 1e-6  # both labels in top-2
+
+
+def test_mse_mae_rmse():
+    label = mx.nd.array([1.0, 2.0])
+    pred = mx.nd.array([1.5, 1.0])
+    for name, expect in [("mse", (0.25 + 1.0) / 2),
+                         ("mae", (0.5 + 1.0) / 2),
+                         ("rmse", np.sqrt((0.25 + 1.0) / 2))]:
+        m = metric.create(name)
+        m.update([label], [pred])
+        assert abs(m.get()[1] - expect) < 1e-6, name
+
+
+def test_perplexity():
+    m = metric.create("perplexity", ignore_label=None)
+    pred = mx.nd.array([[0.5, 0.5], [0.9, 0.1]])
+    label = mx.nd.array([0, 0])
+    m.update([label], [pred])
+    expected = np.exp(-(np.log(0.5) + np.log(0.9)) / 2)
+    assert abs(m.get()[1] - expected) < 1e-5
+
+
+def test_composite_and_custom():
+    m = metric.create(["acc", "ce"])
+    pred = mx.nd.array([[0.3, 0.7], [0.9, 0.1]])
+    label = mx.nd.array([1, 0])
+    m.update([label], [pred])
+    names, values = m.get()
+    assert names == ["accuracy", "cross-entropy"]
+
+    def feval(label, pred):
+        return float(np.abs(label - pred.argmax(axis=1)).sum())
+    cm = metric.np(feval, name="absdiff")
+    cm.update([label], [pred])
+    assert cm.get()[1] == 0.0
+
+
+def test_f1():
+    m = metric.create("f1")
+    pred = mx.nd.array([[0.2, 0.8], [0.8, 0.2], [0.3, 0.7]])
+    label = mx.nd.array([1, 0, 0])
+    m.update([label], [pred])
+    # tp=1 fp=1 fn=0 -> p=0.5 r=1 -> f1=2/3
+    assert abs(m.get()[1] - 2.0 / 3) < 1e-6

@@ -1,0 +1,217 @@
+"""Module API tests — reference: tests/python/unittest/test_module.py (681
+LoC) + tests/python/train/test_mlp.py convergence gate."""
+import os
+import tempfile
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import io
+
+
+def _mlp_sym(num_hidden=32, num_classes=2):
+    data = mx.sym.Variable("data")
+    net = mx.sym.Flatten(data=data)
+    net = mx.sym.FullyConnected(data=net, name="fc1",
+                                num_hidden=num_hidden)
+    net = mx.sym.Activation(data=net, act_type="relu")
+    net = mx.sym.FullyConnected(data=net, name="fc2",
+                                num_hidden=num_classes)
+    return mx.sym.SoftmaxOutput(data=net, name="softmax")
+
+
+def _toy_data(n=256, seed=0):
+    rng = np.random.default_rng(seed)
+    X = rng.standard_normal((n, 1, 8, 8)).astype(np.float32)
+    w = rng.standard_normal(64)
+    y = (X.reshape(n, -1) @ w > 0).astype(np.float32)
+    return X, y
+
+
+def test_module_input_names_validation():
+    sym = _mlp_sym()
+    with pytest.raises(ValueError):
+        mx.mod.Module(sym, data_names=["wrong_name"])
+
+
+def test_module_bind_forward_shapes():
+    sym = _mlp_sym()
+    mod = mx.mod.Module(sym, context=mx.cpu())
+    mod.bind(data_shapes=[("data", (4, 1, 8, 8))],
+             label_shapes=[("softmax_label", (4,))])
+    mod.init_params()
+    batch = io.DataBatch(data=[mx.nd.ones((4, 1, 8, 8))],
+                         label=[mx.nd.zeros((4,))])
+    mod.forward(batch, is_train=False)
+    out = mod.get_outputs()[0]
+    assert out.shape == (4, 2)
+    np.testing.assert_allclose(out.asnumpy().sum(axis=1),
+                               np.ones(4), rtol=1e-5)
+
+
+def test_module_train_convergence():
+    """End-to-end convergence gate (reference
+    tests/python/train/test_mlp.py asserts final accuracy)."""
+    X, y = _toy_data()
+    mx.random.seed(0)
+    train = io.NDArrayIter(X, y, batch_size=32, shuffle=True)
+    mod = mx.mod.Module(_mlp_sym(), context=mx.cpu())
+    mod.fit(train, num_epoch=5, optimizer="sgd",
+            optimizer_params={"learning_rate": 0.5, "momentum": 0.9},
+            eval_metric="acc")
+    score = mod.score(train, "acc")
+    assert score[0][1] > 0.95, score
+
+
+def test_module_multi_device_matches_single():
+    """Data-parallel mesh (4 virtual devices) reaches the same training
+    result as single device — the TPU analogue of the reference's
+    multi_lenet.py multi-GPU parity test."""
+    X, y = _toy_data(n=128)
+
+    def run(ctxs, kvstore):
+        mx.random.seed(42)
+        np.random.seed(42)
+        train = io.NDArrayIter(X, y, batch_size=32)
+        mod = mx.mod.Module(_mlp_sym(), context=ctxs)
+        mod.fit(train, num_epoch=3, optimizer="sgd",
+                optimizer_params={"learning_rate": 0.5},
+                kvstore=kvstore, eval_metric="acc",
+                initializer=mx.init.Xavier())
+        args, _ = mod.get_params()
+        return {k: v.asnumpy() for k, v in args.items()}
+
+    single = run(mx.cpu(), "local")
+    multi = run([mx.cpu(i) for i in range(4)], "device")
+    for k in single:
+        np.testing.assert_allclose(single[k], multi[k], rtol=1e-4,
+                                   atol=1e-5, err_msg=k)
+
+
+def test_module_checkpoint_roundtrip():
+    X, y = _toy_data(n=64)
+    train = io.NDArrayIter(X, y, batch_size=32)
+    mod = mx.mod.Module(_mlp_sym(), context=mx.cpu())
+    mod.fit(train, num_epoch=1, optimizer="sgd")
+    with tempfile.TemporaryDirectory() as tmp:
+        prefix = os.path.join(tmp, "model")
+        mod.save_checkpoint(prefix, 1, save_optimizer_states=True)
+        assert os.path.exists(prefix + "-symbol.json")
+        assert os.path.exists(prefix + "-0001.params")
+
+        mod2 = mx.mod.Module.load(prefix, 1, context=mx.cpu())
+        mod2.bind(data_shapes=train.provide_data,
+                  label_shapes=train.provide_label)
+        args1, _ = mod.get_params()
+        args2, _ = mod2.get_params()
+        for k in args1:
+            np.testing.assert_allclose(args1[k].asnumpy(),
+                                       args2[k].asnumpy(), err_msg=k)
+
+
+def test_module_predict_and_score():
+    X, y = _toy_data(n=64)
+    train = io.NDArrayIter(X, y, batch_size=16)
+    mod = mx.mod.Module(_mlp_sym(), context=mx.cpu())
+    mod.fit(train, num_epoch=1)
+    preds = mod.predict(train)
+    assert preds.shape == (64, 2)
+    res = mod.score(train, ["acc", "ce"])
+    assert len(res) == 2
+
+
+def test_module_input_grads():
+    sym = _mlp_sym()
+    mod = mx.mod.Module(sym, context=mx.cpu())
+    mod.bind(data_shapes=[("data", (4, 1, 8, 8))],
+             label_shapes=[("softmax_label", (4,))],
+             inputs_need_grad=True)
+    mod.init_params()
+    batch = io.DataBatch(data=[mx.nd.ones((4, 1, 8, 8))],
+                         label=[mx.nd.zeros((4,))])
+    mod.forward(batch, is_train=True)
+    mod.backward()
+    ig = mod.get_input_grads()[0]
+    assert ig.shape == (4, 1, 8, 8)
+    assert float(mx.nd.abs(ig).sum().asscalar()) > 0
+
+
+def test_module_batch_size_reshape():
+    """Forward with a different batch size re-specializes (reference
+    module.py:forward reshape path)."""
+    mod = mx.mod.Module(_mlp_sym(), context=mx.cpu())
+    mod.bind(data_shapes=[("data", (4, 1, 8, 8))],
+             label_shapes=[("softmax_label", (4,))])
+    mod.init_params()
+    batch = io.DataBatch(data=[mx.nd.ones((2, 1, 8, 8))],
+                         label=[mx.nd.zeros((2,))])
+    mod.forward(batch, is_train=False)
+    assert mod.get_outputs()[0].shape == (2, 2)
+
+
+def test_kvstore_push_pull():
+    """reference tests/python/unittest/test_kvstore.py semantics."""
+    kv = mx.kv.create("local")
+    kv.init(3, mx.nd.ones((2, 3)))
+    out = mx.nd.zeros((2, 3))
+    kv.pull(3, out=out)
+    np.testing.assert_allclose(out.asnumpy(), np.ones((2, 3)))
+    # push list -> sum-reduce
+    kv.push(3, [mx.nd.ones((2, 3))] * 4)
+    kv.pull(3, out=out)
+    np.testing.assert_allclose(out.asnumpy(), 4 * np.ones((2, 3)))
+    # updater path
+    kv2 = mx.kv.create("local")
+    kv2.init("w", mx.nd.zeros((2,)))
+
+    def updater(key, grad, weight):
+        weight += grad * 2
+    kv2.set_updater(updater)
+    kv2.push("w", mx.nd.ones((2,)))
+    o = mx.nd.zeros((2,))
+    kv2.pull("w", out=o)
+    np.testing.assert_allclose(o.asnumpy(), [2.0, 2.0])
+
+
+def test_sequential_module():
+    from mxnet_tpu.module import SequentialModule
+    net1 = mx.sym.FullyConnected(mx.sym.Variable("data"), name="fc1",
+                                 num_hidden=8)
+    net2 = mx.sym.SoftmaxOutput(
+        mx.sym.FullyConnected(mx.sym.Variable("fc1_output"), name="fc2",
+                              num_hidden=2), name="softmax")
+    seq = SequentialModule()
+    seq.add(mx.mod.Module(net1, label_names=None, context=mx.cpu()),
+            auto_wiring=True)
+    seq.add(mx.mod.Module(net2, data_names=["fc1_output"],
+                          context=mx.cpu()), take_labels=True,
+            auto_wiring=True)
+    seq.bind(data_shapes=[("data", (4, 16))],
+             label_shapes=[("softmax_label", (4,))])
+    seq.init_params()
+    seq.init_optimizer(kvstore=None)
+    batch = io.DataBatch(data=[mx.nd.ones((4, 16))],
+                         label=[mx.nd.zeros((4,))])
+    seq.forward(batch, is_train=True)
+    out = seq.get_outputs()[0]
+    assert out.shape == (4, 2)
+    seq.backward()
+    seq.update()
+
+
+def test_reshape_preserves_params():
+    """Regression: batch-shape reshape must NOT wipe trained params."""
+    mod = mx.mod.Module(_mlp_sym(), context=mx.cpu())
+    mod.bind(data_shapes=[("data", (4, 1, 8, 8))],
+             label_shapes=[("softmax_label", (4,))])
+    mod.init_params(mx.init.Uniform(0.5))
+    before = {k: v.asnumpy() for k, v in mod.get_params()[0].items()}
+    batch = io.DataBatch(data=[mx.nd.ones((2, 1, 8, 8))],
+                         label=[mx.nd.zeros((2,))])
+    mod.forward(batch, is_train=False)
+    out_small = mod.get_outputs()[0].asnumpy()
+    assert np.abs(out_small).sum() > 0
+    after = {k: v.asnumpy() for k, v in mod.get_params()[0].items()}
+    for k in before:
+        np.testing.assert_allclose(before[k], after[k], err_msg=k)

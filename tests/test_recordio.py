@@ -1,0 +1,84 @@
+"""RecordIO tests — reference: tests/python/unittest/test_recordio.py."""
+import os
+import struct
+import tempfile
+
+import numpy as np
+import pytest
+
+from mxnet_tpu import recordio
+
+
+def test_recordio_roundtrip():
+    with tempfile.TemporaryDirectory() as tmp:
+        path = os.path.join(tmp, "x.rec")
+        w = recordio.MXRecordIO(path, "w")
+        payloads = [b"hello", b"x" * 1000, b"", b"abc\x00def"]
+        for p in payloads:
+            w.write(p)
+        w.close()
+        r = recordio.MXRecordIO(path, "r")
+        for p in payloads:
+            assert r.read() == p
+        assert r.read() is None
+        r.reset()
+        assert r.read() == payloads[0]
+        r.close()
+
+
+def test_recordio_embedded_magic():
+    """Payload containing the aligned magic word must round-trip (the
+    split/rejoin continuation-flag path)."""
+    magic = struct.pack("<I", 0xced7230a)
+    payload = b"abcd" + magic + b"efgh" + magic + magic + b"zz"
+    with tempfile.TemporaryDirectory() as tmp:
+        path = os.path.join(tmp, "m.rec")
+        w = recordio.MXRecordIO(path, "w")
+        w.write(payload)
+        w.write(b"next")
+        w.close()
+        r = recordio.MXRecordIO(path, "r")
+        assert r.read() == payload
+        assert r.read() == b"next"
+
+
+def test_indexed_recordio():
+    with tempfile.TemporaryDirectory() as tmp:
+        rec = os.path.join(tmp, "x.rec")
+        idx = os.path.join(tmp, "x.idx")
+        w = recordio.MXIndexedRecordIO(idx, rec, "w")
+        for i in range(10):
+            w.write_idx(i, b"rec%d" % i)
+        w.close()
+        r = recordio.MXIndexedRecordIO(idx, rec, "r")
+        assert r.keys == list(range(10))
+        assert r.read_idx(7) == b"rec7"
+        assert r.read_idx(2) == b"rec2"
+
+
+def test_irheader_pack_unpack():
+    h = recordio.IRHeader(0, 3.0, 42, 0)
+    s = recordio.pack(h, b"payload")
+    h2, payload = recordio.unpack(s)
+    assert h2.label == 3.0 and h2.id == 42
+    assert payload == b"payload"
+    # array label (detection)
+    h = recordio.IRHeader(0, np.array([1.0, 2.0, 3.0], np.float32), 7, 0)
+    s = recordio.pack(h, b"img")
+    h2, payload = recordio.unpack(s)
+    np.testing.assert_allclose(h2.label, [1, 2, 3])
+    assert payload == b"img"
+
+
+def test_pack_img_roundtrip():
+    img = (np.random.RandomState(0).rand(32, 32, 3) * 255).astype(np.uint8)
+    # png is lossless -> exact roundtrip
+    s = recordio.pack_img(recordio.IRHeader(0, 1.0, 0, 0), img,
+                          img_fmt=".png")
+    h, img2 = recordio.unpack_img(s, iscolor=1)
+    assert h.label == 1.0
+    np.testing.assert_array_equal(img, img2)
+    # jpeg path decodes to the right shape
+    s = recordio.pack_img(recordio.IRHeader(0, 2.0, 0, 0), img)
+    h, img3 = recordio.unpack_img(s, iscolor=1)
+    assert h.label == 2.0 and img3.shape == (32, 32, 3)
