@@ -71,3 +71,8 @@ from .model import FeedForward
 from . import module
 from . import module as mod
 from .module import Module
+
+from . import recordio
+from . import gluon
+from . import models
+from . import parallel

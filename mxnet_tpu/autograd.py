@@ -142,6 +142,28 @@ def _record_op(opdef, nd_inputs, nd_outputs, vjp_fn, raw_shapes, raw_dtypes,
         o._ag_entry = (node, i)
 
 
+def _record_cached(nd_inputs, nd_outputs, vjp, n_inputs):
+    """Record one fused CachedOp node (gluon hybridized graph) on the tape.
+
+    vjp: jax.vjp of pure(ins_list, params_list) -> outs tuple; the tape
+    contract flattens its two cotangent lists back onto the input order
+    nd_inputs = inputs + params."""
+    in_entries = [_entry_of(x) for x in nd_inputs]
+    if not any(e is not None for e in in_entries):
+        return
+
+    def vjp_fn(raw_ct):
+        ct_ins, ct_ps = vjp(raw_ct)
+        return tuple(ct_ins) + tuple(ct_ps)
+
+    raw_shapes = tuple(o.shape for o in nd_outputs)
+    raw_dtypes = tuple(o._data.dtype for o in nd_outputs)
+    node = _TapeNode(vjp_fn, in_entries, 0, raw_shapes, raw_dtypes, True,
+                     "CachedOp")
+    for i, o in enumerate(nd_outputs):
+        o._ag_entry = (node, i)
+
+
 def mark_variables(variables, gradients, grad_reqs="write"):
     """Attach grad buffers (reference autograd.py:196 / autograd.cc:79)."""
     from .base import _as_list

@@ -1,0 +1,12 @@
+"""Gluon — the imperative high-level API (reference: python/mxnet/gluon/,
+SURVEY.md P5): Parameter/Block/HybridBlock/Trainer + nn/rnn layers, losses,
+data pipeline and model zoo."""
+from .parameter import Parameter, ParameterDict, DeferredInitializationError
+from .block import Block, HybridBlock, SymbolBlock
+from .trainer import Trainer
+from . import nn
+from . import rnn
+from . import loss
+from . import data
+from . import utils
+from . import model_zoo

@@ -1,0 +1,414 @@
+"""Gluon Parameter / ParameterDict (reference:
+python/mxnet/gluon/parameter.py, 606 LoC).
+
+TPU-native notes: the reference keeps one copy of each parameter per context
+(`_init_impl` broadcasts, gradients reduce via kvstore). Here a parameter
+owns ONE array; multi-device placement is a sharding of that array over
+the mesh (Trainer/TrainStep annotate it), so `list_ctx` degenerates to the
+single logical placement — the reference API is preserved.
+"""
+from __future__ import annotations
+
+import warnings
+
+import numpy as np
+
+from .. import autograd
+from .. import initializer as init_mod
+from ..base import MXNetError, string_types
+from ..context import Context, cpu, current_context
+from ..ndarray import NDArray
+from .. import ndarray as nd
+
+__all__ = ["Parameter", "ParameterDict", "DeferredInitializationError"]
+
+
+class DeferredInitializationError(MXNetError):
+    """Error for unfinished deferred initialization (reference
+    parameter.py:DeferredInitializationError)."""
+
+
+class Parameter:
+    """A Block parameter (reference parameter.py:Parameter).
+
+    Supports deferred initialization: shape may contain 0s until the first
+    forward infers them."""
+
+    def __init__(self, name, grad_req="write", shape=None, dtype=np.float32,
+                 lr_mult=1.0, wd_mult=1.0, init=None, allow_deferred_init=False,
+                 differentiable=True):
+        self.name = name
+        self.shape = tuple(shape) if shape is not None else None
+        self.dtype = dtype
+        self.lr_mult = lr_mult
+        self.wd_mult = wd_mult
+        self.init = init
+        self.allow_deferred_init = allow_deferred_init
+        self._differentiable = differentiable
+        self._data = None
+        self._grad = None
+        self._deferred_init = ()
+        self._ctx = None
+        self._grad_req = None
+        self.grad_req = grad_req
+
+    def __repr__(self):
+        s = "Parameter {name} (shape={shape}, dtype={dtype})"
+        return s.format(name=self.name, shape=self.shape, dtype=self.dtype)
+
+    @property
+    def grad_req(self):
+        return self._grad_req
+
+    @grad_req.setter
+    def grad_req(self, req):
+        assert req in ["write", "add", "null"], \
+            "grad_req must be one of write, add, or null, but got %s" % req
+        if not self._differentiable:
+            req = "null"
+        if self._grad_req == req:
+            return
+        self._grad_req = req
+        if req == "null":
+            self._grad = None
+        elif self._data is not None and self._grad is None:
+            self._init_grad()
+
+    def _check_initialized(self, ctx=None):
+        if self._data is not None:
+            return
+        if self._deferred_init:
+            raise DeferredInitializationError(
+                "Parameter %s has not been initialized yet because "
+                "initialization was deferred. Actual initialization happens "
+                "during the first forward pass. Please pass one batch of "
+                "data through the network before accessing Parameters." %
+                self.name)
+        raise RuntimeError(
+            "Parameter %s has not been initialized. Note that you should "
+            "initialize parameters and create Trainer with "
+            "Block.collect_params() instead of Block.params because the "
+            "later does not include Parameters of nested child Blocks" %
+            self.name)
+
+    def _load_init(self, data, ctx):
+        """Initialize from loaded data (reference
+        parameter.py:_load_init)."""
+        if self.shape:
+            for self_dim, data_dim in zip(self.shape, data.shape):
+                assert self_dim == 0 or self_dim == data_dim, \
+                    "Failed loading Parameter %s from saved params: " \
+                    "shape incompatible expected %s vs saved %s" % (
+                        self.name, str(self.shape), str(data.shape))
+        if self.dtype and np.dtype(self.dtype) != np.dtype(data.dtype):
+            data = data.astype(self.dtype)
+        if self._data is None:
+            self._init_impl(data, ctx)
+        else:
+            self.set_data(data)
+        self._deferred_init = ()
+
+    def _finish_deferred_init(self):
+        """Finish deferred init (reference
+        parameter.py:_finish_deferred_init)."""
+        if not self._deferred_init:
+            return
+        init, ctx, default_init = self._deferred_init
+        self._deferred_init = ()
+        assert self.shape is not None and np.prod(self.shape) > 0, \
+            "Cannot initialize Parameter %s because it has invalid shape: " \
+            "%s. Please specify in_units, in_channels, etc for `Block`s." % (
+                self.name, str(self.shape))
+
+        with autograd.pause():
+            data = nd.zeros(self.shape, dtype=self.dtype)
+            init_mod.create(default_init)(
+                init_mod.InitDesc(self.name,
+                                  {"__init__": init}), data)
+            self._init_impl(data, ctx)
+
+    def _init_impl(self, data, ctx_list):
+        """Set data (single logical copy; mesh placement is the TPU
+        multi-ctx analogue)."""
+        if not isinstance(data, NDArray):
+            data = nd.array(data, dtype=self.dtype)
+        self._data = data
+        self._ctx = ctx_list
+        self.shape = tuple(data.shape)
+        if self._grad_req != "null":
+            self._init_grad()
+
+    def _init_grad(self):
+        self._grad = nd.zeros_like(self._data)
+        autograd.mark_variables([self._data], [self._grad],
+                                grad_reqs=self._grad_req)
+
+    def initialize(self, init=None, ctx=None, default_init=None,
+                   force_reinit=False):
+        """Initialize data+grad (reference parameter.py:initialize)."""
+        if default_init is None:
+            default_init = init_mod.Uniform()
+        if self._data is not None and not force_reinit:
+            warnings.warn("Parameter %s is already initialized, ignoring. "
+                          "Set force_reinit=True to re-initialize." %
+                          self.name, stacklevel=2)
+            return
+        self._data = self._grad = None
+
+        if ctx is None:
+            ctx = [current_context()]
+        if isinstance(ctx, Context):
+            ctx = [ctx]
+        if init is None:
+            init = default_init if self.init is None else self.init
+        if self.shape is None or np.prod(self.shape) <= 0:
+            if self.allow_deferred_init:
+                self._deferred_init = (init, ctx, default_init)
+                return
+            raise ValueError(
+                "Cannot initialize Parameter %s because it has invalid "
+                "shape: %s." % (self.name, str(self.shape)))
+
+        self._deferred_init = (init, ctx, default_init)
+        self._finish_deferred_init()
+
+    def reset_ctx(self, ctx):
+        """Re-place on new context(s) (reference
+        parameter.py:reset_ctx)."""
+        if isinstance(ctx, Context):
+            ctx = [ctx]
+        if self._data is not None:
+            self._data = self._data.as_in_context(ctx[0])
+            self._ctx = ctx
+        elif self._deferred_init:
+            init, _, default_init = self._deferred_init
+            self._deferred_init = (init, ctx, default_init)
+        else:
+            raise ValueError("Cannot reset context for Parameter %s "
+                             "because it has not been initialized." %
+                             self.name)
+
+    def set_data(self, data):
+        """Assign new data (reference parameter.py:set_data)."""
+        assert self._data is not None, \
+            "Parameter %s has not been initialized" % self.name
+        src = data._data if isinstance(data, NDArray) else \
+            nd.array(data)._data
+        self._data._set_data(src.astype(self._data._data.dtype)
+                             if src.dtype != self._data._data.dtype
+                             else src)
+
+    def data(self, ctx=None):
+        """The data array (reference parameter.py:data)."""
+        self._check_initialized(ctx)
+        return self._data
+
+    def list_data(self):
+        self._check_initialized()
+        return [self._data]
+
+    def grad(self, ctx=None):
+        """The gradient buffer (reference parameter.py:grad)."""
+        if self._data is not None and self._grad is None:
+            raise RuntimeError(
+                "Cannot get gradient array for Parameter %s because "
+                "grad_req='null'" % self.name)
+        self._check_initialized(ctx)
+        return self._grad
+
+    def list_grad(self):
+        self._check_initialized()
+        assert self._grad is not None, \
+            "Parameter %s does not have gradients because grad_req='null'" \
+            % self.name
+        return [self._grad]
+
+    def list_ctx(self):
+        if self._data is None:
+            if self._deferred_init:
+                return self._deferred_init[1]
+            raise RuntimeError("Parameter %s has not been initialized" %
+                               self.name)
+        return self._ctx or [current_context()]
+
+    def zero_grad(self):
+        """Zero the gradient buffer (reference parameter.py:zero_grad)."""
+        if self._grad is None:
+            return
+        self._grad._set_data(nd.zeros_like(self._grad)._data)
+
+    def var(self):
+        """Symbol of this parameter (reference parameter.py:var)."""
+        from .. import symbol
+        return symbol.var(self.name, shape=self.shape, dtype=self.dtype,
+                          lr_mult=self.lr_mult, wd_mult=self.wd_mult,
+                          init=self.init)
+
+    def cast(self, dtype):
+        """Cast data/grad to a new dtype (reference
+        parameter.py:cast)."""
+        self.dtype = dtype
+        if self._data is None:
+            return
+        with autograd.pause():
+            self._data = self._data.astype(dtype)
+            if self._grad is not None:
+                self._grad = self._grad.astype(dtype)
+                autograd.mark_variables([self._data], [self._grad],
+                                        grad_reqs=self._grad_req)
+
+
+class ParameterDict:
+    """Dict of Parameters with prefix + shared-dict lookup (reference
+    parameter.py:ParameterDict)."""
+
+    def __init__(self, prefix="", shared=None):
+        self._prefix = prefix
+        self._params = {}  # insertion-ordered
+        self._shared = shared
+
+    def __repr__(self):
+        s = "{name}(\n{content}\n)"
+        name = self._prefix + " " if self._prefix else ""
+        return s.format(name=name, content="\n".join(
+            [repr(v).replace("\n", "\n  ") for v in self.values()]))
+
+    def __getitem__(self, key):
+        return self._params[key]
+
+    def __iter__(self):
+        return iter(self._params)
+
+    def items(self):
+        return self._params.items()
+
+    def keys(self):
+        return self._params.keys()
+
+    def values(self):
+        return self._params.values()
+
+    @property
+    def prefix(self):
+        return self._prefix
+
+    def _get_impl(self, name):
+        if name in self._params:
+            return self._params[name]
+        if self._shared is not None and name in self._shared._params:
+            self._params[name] = self._shared._params[name]
+            return self._shared._params[name]
+        return None
+
+    def get(self, name, **kwargs):
+        """Get or create parameter `prefix+name` (reference
+        parameter.py:get)."""
+        name = self.prefix + name
+        param = self._get_impl(name)
+        if param is None:
+            param = Parameter(name, **kwargs)
+            self._params[name] = param
+        else:
+            for k, v in kwargs.items():
+                if hasattr(param, k) and getattr(param, k) is not None:
+                    existing = getattr(param, k)
+                    if k == "shape" and v is not None and \
+                            len(v) == len(existing):
+                        inferred_shape = []
+                        matched = True
+                        for dim1, dim2 in zip(v, existing):
+                            if dim1 != dim2 and dim1 * dim2 != 0:
+                                matched = False
+                                break
+                            elif dim1 == dim2:
+                                inferred_shape.append(dim1)
+                            elif dim1 == 0:
+                                inferred_shape.append(dim2)
+                            else:
+                                inferred_shape.append(dim1)
+                        if matched:
+                            param.shape = tuple(inferred_shape)
+                            continue
+                    elif k == "dtype" and np.dtype(v) == np.dtype(existing):
+                        continue
+                    assert v is None or v == existing or \
+                        (k == "shape" and existing is None), \
+                        "Cannot retrieve Parameter %s because desired " \
+                        "attribute does not match with stored for " \
+                        "attribute %s: desired %s vs stored %s" % (
+                            name, k, str(v), str(getattr(param, k)))
+                else:
+                    setattr(param, k, v)
+        return param
+
+    def update(self, other):
+        """Merge another ParameterDict (reference
+        parameter.py:update)."""
+        for k, v in other.items():
+            if k in self._params:
+                assert self._params[k] is v, \
+                    "Cannot update self with other because they have " \
+                    "different Parameters with the same name %s" % k
+            else:
+                self._params[k] = v
+
+    def initialize(self, init=None, ctx=None, verbose=False,
+                   force_reinit=False):
+        """Initialize all (reference parameter.py:initialize)."""
+        if init is None:
+            init = init_mod.Uniform()
+        if verbose:
+            init.set_verbosity(verbose=verbose)
+        for _, v in self.items():
+            v.initialize(None, ctx, init, force_reinit=force_reinit)
+
+    def zero_grad(self):
+        for v in self.values():
+            v.zero_grad()
+
+    def reset_ctx(self, ctx):
+        for v in self.values():
+            v.reset_ctx(ctx)
+
+    def setattr(self, name, value):
+        """Set an attribute on all parameters (reference
+        parameter.py:setattr)."""
+        for v in self.values():
+            setattr(v, name, value)
+
+    def save(self, filename, strip_prefix=""):
+        """Save to .params file (reference parameter.py:save)."""
+        arg_dict = {}
+        for param in self.values():
+            weight = param.data()
+            if not param.name.startswith(strip_prefix):
+                raise ValueError(
+                    "Prefix %s is to be striped before saving, but "
+                    "Parameter %s does not start with %s." % (
+                        strip_prefix, param.name, strip_prefix))
+            arg_dict[param.name[len(strip_prefix):]] = weight
+        nd.save(filename, arg_dict)
+
+    def load(self, filename, ctx=None, allow_missing=False,
+             ignore_extra=False, restore_prefix=""):
+        """Load from .params file (reference parameter.py:load)."""
+        if restore_prefix:
+            for name in self.keys():
+                assert name.startswith(restore_prefix), \
+                    "restore_prefix is %s but Parameter name %s does not " \
+                    "start with it" % (restore_prefix, name)
+        lprefix = len(restore_prefix)
+        arg_dict = {restore_prefix + k: v
+                    for k, v in nd.load(filename).items()}
+        if not allow_missing:
+            for name in self.keys():
+                assert name in arg_dict, \
+                    "Parameter %s is missing in file %s" % (
+                        name[lprefix:], filename)
+        for name in arg_dict:
+            if name not in self._params:
+                assert ignore_extra, \
+                    "Parameter %s loaded from file %s is not present in " \
+                    "ParameterDict" % (name[lprefix:], filename)
+                continue
+            self[name]._load_init(arg_dict[name], ctx)

@@ -633,6 +633,10 @@ def _infer_graph(entries, known_shapes, known_dtypes, partial=False):
             if shp is None and "__shape__" in node.misc_attrs:
                 import ast
                 shp = tuple(ast.literal_eval(node.misc_attrs["__shape__"]))
+            if shp is not None and any(int(d) == 0 for d in shp):
+                # reference convention: 0 dims mean unknown (gluon
+                # deferred init) — let the param_shapes hooks fill them
+                shp = None
             shapes["var", node.name] = shp
             dt = known_dtypes.get(node.name)
             if dt is None and node.misc_attrs.get("__dtype__"):
