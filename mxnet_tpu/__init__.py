@@ -73,6 +73,8 @@ from . import module as mod
 from .module import Module
 
 from . import recordio
+from . import image
+from . import image as img
 from . import gluon
 from . import models
 from . import parallel

@@ -1,0 +1,5 @@
+"""Image IO + augmentation (reference: python/mxnet/image/ and the C++
+pipeline src/io/iter_image_recordio_2.cc)."""
+from .image import *
+from . import image
+from .detection import ImageDetIter, CreateDetAugmenter

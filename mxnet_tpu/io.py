@@ -9,6 +9,7 @@ analogue of the reference's dmlc::ThreadedIter double-buffering
 """
 from __future__ import annotations
 
+import os
 import threading
 from collections import namedtuple
 
@@ -420,8 +421,80 @@ class NDArrayIter(DataIter):
         return 0
 
 
-# The reference's MXDataIter wraps registered C++ iterators
-# (ImageRecordIter etc., io.py:740). The TPU-native equivalents are the
-# Python/record pipeline in mxnet_tpu.recordio + mxnet_tpu.image; the
-# `ImageRecordIter` factory lives there and is re-exported by the package
-# __init__ so user-facing kwargs stay compatible.
+class MNISTIter(NDArrayIter):
+    """MNIST idx-ubyte iterator (reference: registered C++ 'MNISTIter',
+    src/io/iter_mnist.cc:259 — same file format, same kwargs)."""
+
+    def __init__(self, image="train-images-idx3-ubyte",
+                 label="train-labels-idx1-ubyte", batch_size=128,
+                 shuffle=True, flat=False, silent=False, seed=0,
+                 data_name="data", label_name="softmax_label", **kwargs):
+        import gzip
+        import struct
+
+        def _open(p):
+            if os.path.exists(p):
+                return open(p, "rb")
+            if os.path.exists(p + ".gz"):
+                return gzip.open(p + ".gz", "rb")
+            raise IOError("MNIST file %s not found" % p)
+
+        with _open(label) as fin:
+            _magic, _n = struct.unpack(">II", fin.read(8))
+            y = np.frombuffer(fin.read(), dtype=np.uint8).astype(
+                np.float32)
+        with _open(image) as fin:
+            _magic, n, rows, cols = struct.unpack(">IIII", fin.read(16))
+            x = np.frombuffer(fin.read(), dtype=np.uint8).astype(
+                np.float32) / 255.0
+            x = x.reshape(n, rows * cols) if flat else \
+                x.reshape(n, 1, rows, cols)
+        if shuffle:
+            rng = np.random.RandomState(seed)
+            idx = rng.permutation(n)
+            x, y = x[idx], y[idx]
+        super().__init__(data={data_name: x}, label={label_name: y},
+                         batch_size=batch_size,
+                         last_batch_handle="discard")
+
+
+class CSVIter(NDArrayIter):
+    """CSV iterator (reference: registered C++ 'CSVIter',
+    src/io/iter_csv.cc:150)."""
+
+    def __init__(self, data_csv, data_shape, label_csv=None,
+                 label_shape=(1,), batch_size=128, round_batch=True,
+                 **kwargs):
+        data = np.loadtxt(data_csv, delimiter=",",
+                          dtype=np.float32, ndmin=2)
+        data = data.reshape((-1,) + tuple(data_shape))
+        label = None
+        if label_csv is not None:
+            label = np.loadtxt(label_csv, delimiter=",",
+                               dtype=np.float32, ndmin=1)
+            if tuple(label_shape) != (1,):
+                label = label.reshape((-1,) + tuple(label_shape))
+        super().__init__(data, label, batch_size=batch_size,
+                         last_batch_handle="pad" if round_batch
+                         else "discard")
+
+
+def _lazy_image_iters():
+    """ImageRecordIter / ImageDetRecordIter live in mxnet_tpu.image (the
+    reference registers them from C++, src/io/iter_image_recordio_2.cc);
+    re-exported here so `mx.io.ImageRecordIter(...)` keeps working."""
+    from .image import ImageRecordIter as _iri
+    from .image.detection import ImageDetIter as _idi
+    return _iri, _idi
+
+
+def ImageRecordIter(*args, **kwargs):
+    from .image import ImageRecordIter as _impl
+    return _impl(*args, **kwargs)
+
+
+def ImageDetRecordIter(*args, **kwargs):
+    from .image.detection import ImageDetIter as _impl
+    kwargs.pop("prefetch_buffer", None)
+    kwargs.pop("preprocess_threads", None)
+    return _impl(*args, **kwargs)

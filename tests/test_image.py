@@ -1,0 +1,186 @@
+"""Image pipeline tests — reference: tests/python/unittest/test_image.py
++ test_io.py ImageRecordIter coverage."""
+import os
+import subprocess
+import sys
+import tempfile
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import image as img_mod
+from mxnet_tpu import recordio
+
+
+def _make_img(h=40, w=48, seed=0):
+    rng = np.random.RandomState(seed)
+    # smooth gradient + noise so jpeg survives roughly
+    yy, xx = np.mgrid[0:h, 0:w]
+    base = np.stack([yy * 255 // h, xx * 255 // w,
+                     (yy + xx) * 255 // (h + w)], axis=2)
+    return np.clip(base + rng.randint(0, 20, (h, w, 3)), 0,
+                   255).astype(np.uint8)
+
+
+def _encode(arr):
+    from io import BytesIO
+    from PIL import Image
+    buf = BytesIO()
+    Image.fromarray(arr).save(buf, format="PNG")
+    return buf.getvalue()
+
+
+def test_imdecode_imresize():
+    arr = _make_img()
+    decoded = img_mod.imdecode(_encode(arr))
+    np.testing.assert_array_equal(decoded.asnumpy(), arr)
+    small = img_mod.imresize(decoded, 16, 12)
+    assert small.shape == (12, 16, 3)
+
+
+def test_crops():
+    arr = mx.nd.array(_make_img(), dtype=np.uint8)
+    out, rect = img_mod.center_crop(arr, (24, 24))
+    assert out.shape == (24, 24, 3)
+    out, rect = img_mod.random_crop(arr, (24, 24))
+    assert out.shape == (24, 24, 3)
+    out = img_mod.resize_short(arr, 20)
+    assert min(out.shape[:2]) == 20
+
+
+def test_augmenter_list():
+    augs = img_mod.CreateAugmenter((3, 24, 24), rand_crop=True,
+                                   rand_mirror=True, mean=True, std=True,
+                                   brightness=0.1)
+    arr = mx.nd.array(_make_img(), dtype=np.uint8)
+    data = arr
+    for aug in augs:
+        data = aug(data)[0]
+    assert data.shape == (24, 24, 3)
+    assert data.dtype == np.float32
+
+
+def _write_rec(tmp, n=12):
+    rec = os.path.join(tmp, "data.rec")
+    idx = os.path.join(tmp, "data.idx")
+    w = recordio.MXIndexedRecordIO(idx, rec, "w")
+    for i in range(n):
+        packed = recordio.pack_img(
+            recordio.IRHeader(0, float(i % 3), i, 0),
+            _make_img(seed=i), img_fmt=".png")
+        w.write_idx(i, packed)
+    w.close()
+    return rec
+
+
+def test_image_iter_rec():
+    with tempfile.TemporaryDirectory() as tmp:
+        rec = _write_rec(tmp)
+        it = img_mod.ImageIter(batch_size=4, data_shape=(3, 24, 24),
+                               path_imgrec=rec, shuffle=True,
+                               rand_crop=True, rand_mirror=True)
+        batch = next(it)
+        assert batch.data[0].shape == (4, 3, 24, 24)
+        assert batch.label[0].shape == (4,)
+        n = 1 + sum(1 for _ in it)
+        assert n == 3
+        it.reset()
+        assert sum(1 for _ in it) == 3
+
+
+def test_image_record_iter_factory():
+    with tempfile.TemporaryDirectory() as tmp:
+        rec = _write_rec(tmp)
+        it = mx.io.ImageRecordIter(
+            path_imgrec=rec, data_shape=(3, 24, 24), batch_size=4,
+            shuffle=False, rand_mirror=True, mean_r=123, mean_g=117,
+            mean_b=104, preprocess_threads=2)
+        batch = next(it)
+        assert batch.data[0].shape == (4, 3, 24, 24)
+
+
+def test_det_iter():
+    with tempfile.TemporaryDirectory() as tmp:
+        rec = os.path.join(tmp, "det.rec")
+        idx = os.path.join(tmp, "det.idx")
+        w = recordio.MXIndexedRecordIO(idx, rec, "w")
+        for i in range(8):
+            # label: header_width=2, obj_width=5, one object
+            label = np.array([2, 5, i % 3, 0.1, 0.2, 0.8, 0.9],
+                             np.float32)
+            packed = recordio.pack_img(
+                recordio.IRHeader(0, label, i, 0), _make_img(seed=i),
+                img_fmt=".png")
+            w.write_idx(i, packed)
+        w.close()
+        it = img_mod.ImageDetIter(batch_size=4, data_shape=(3, 24, 24),
+                                  path_imgrec=rec, rand_mirror=True)
+        batch = next(it)
+        assert batch.data[0].shape == (4, 3, 24, 24)
+        assert batch.label[0].shape == (4, 16, 5)
+        lbl = batch.label[0].asnumpy()
+        valid = lbl[lbl[:, :, 0] >= 0]
+        assert valid.shape[0] >= 4  # one object per image survived
+
+
+def test_im2rec_roundtrip():
+    from PIL import Image
+    with tempfile.TemporaryDirectory() as tmp:
+        root = os.path.join(tmp, "imgs")
+        for cls in ["a", "b"]:
+            os.makedirs(os.path.join(root, cls))
+            for i in range(3):
+                Image.fromarray(_make_img(seed=i)).save(
+                    os.path.join(root, cls, "%d.jpg" % i))
+        prefix = os.path.join(tmp, "pack")
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        env = dict(os.environ, JAX_PLATFORMS="cpu",
+                   PYTHONPATH=repo)
+        subprocess.run([sys.executable,
+                        os.path.join(repo, "tools", "im2rec.py"),
+                        prefix, root, "--list"], check=True, env=env)
+        subprocess.run([sys.executable,
+                        os.path.join(repo, "tools", "im2rec.py"),
+                        prefix, root], check=True, env=env)
+        assert os.path.exists(prefix + ".rec")
+        it = img_mod.ImageIter(batch_size=2, data_shape=(3, 24, 24),
+                               path_imgrec=prefix + ".rec")
+        batch = next(it)
+        assert batch.data[0].shape == (2, 3, 24, 24)
+
+
+def test_mnist_iter_synthetic():
+    """MNISTIter reads idx-ubyte files (write synthetic ones)."""
+    import gzip
+    import struct
+    with tempfile.TemporaryDirectory() as tmp:
+        img_p = os.path.join(tmp, "train-images-idx3-ubyte")
+        lbl_p = os.path.join(tmp, "train-labels-idx1-ubyte")
+        n = 20
+        imgs = (np.random.RandomState(0).rand(n, 28, 28) * 255).astype(
+            np.uint8)
+        lbls = np.arange(n, dtype=np.uint8) % 10
+        with open(img_p, "wb") as f:
+            f.write(struct.pack(">IIII", 2051, n, 28, 28))
+            f.write(imgs.tobytes())
+        with open(lbl_p, "wb") as f:
+            f.write(struct.pack(">II", 2049, n))
+            f.write(lbls.tobytes())
+        it = mx.io.MNISTIter(image=img_p, label=lbl_p, batch_size=5,
+                             shuffle=False)
+        batch = next(it)
+        assert batch.data[0].shape == (5, 1, 28, 28)
+        assert float(batch.data[0].asnumpy().max()) <= 1.0
+
+
+def test_csv_iter():
+    with tempfile.TemporaryDirectory() as tmp:
+        data_csv = os.path.join(tmp, "d.csv")
+        label_csv = os.path.join(tmp, "l.csv")
+        np.savetxt(data_csv, np.arange(24).reshape(8, 3), delimiter=",")
+        np.savetxt(label_csv, np.arange(8), delimiter=",")
+        it = mx.io.CSVIter(data_csv=data_csv, data_shape=(3,),
+                           label_csv=label_csv, batch_size=4)
+        batch = next(it)
+        assert batch.data[0].shape == (4, 3)

@@ -1,0 +1,162 @@
+#!/usr/bin/env python
+"""im2rec — pack an image folder / .lst file into RecordIO shards
+(reference: tools/im2rec.py + tools/im2rec.cc, multi-threaded OpenCV
+there; thread-pool PIL here).
+
+Usage (same CLI surface as the reference):
+  python tools/im2rec.py prefix image_root --list    # make .lst
+  python tools/im2rec.py prefix image_root           # pack .rec from .lst
+"""
+from __future__ import annotations
+
+import argparse
+import concurrent.futures
+import os
+import random
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from mxnet_tpu import recordio  # noqa: E402
+
+
+_EXTS = (".jpg", ".jpeg", ".png", ".bmp")
+
+
+def list_image(root, recursive=True):
+    """Yield (index, relpath, label) walking root (reference
+    im2rec.py:list_image)."""
+    i = 0
+    cat = {}
+    for path, dirs, files in sorted(os.walk(root, followlinks=True)):
+        dirs.sort()
+        files.sort()
+        for fname in files:
+            fpath = os.path.join(path, fname)
+            suffix = os.path.splitext(fname)[1].lower()
+            if os.path.isfile(fpath) and suffix in _EXTS:
+                if path not in cat:
+                    cat[path] = len(cat)
+                yield (i, os.path.relpath(fpath, root), cat[path])
+                i += 1
+        if not recursive:
+            break
+
+
+def write_list(path_out, image_list):
+    with open(path_out, "w") as fout:
+        for i, item in enumerate(image_list):
+            line = "%d\t" % item[0]
+            for j in item[2:]:
+                line += "%f\t" % j
+            line += "%s\n" % item[1]
+            fout.write(line)
+
+
+def read_list(path_in):
+    with open(path_in) as fin:
+        for line in fin:
+            line = [i.strip() for i in line.strip().split("\t")]
+            line_len = len(line)
+            if line_len < 3:
+                continue
+            item = [int(line[0])] + [line[-1]] + \
+                [float(i) for i in line[1:-1]]
+            yield item
+
+
+def _encode_image(args, item, root):
+    from PIL import Image
+    import io as _io
+    import numpy as np
+    fullpath = os.path.join(root, item[1])
+    try:
+        img = Image.open(fullpath).convert("RGB")
+    except Exception as e:  # unreadable image -> skip
+        print("imread error, skipping %s: %s" % (fullpath, e))
+        return None
+    if args.center_crop:
+        w, h = img.size
+        s = min(w, h)
+        img = img.crop(((w - s) // 2, (h - s) // 2,
+                        (w + s) // 2, (h + s) // 2))
+    if args.resize:
+        w, h = img.size
+        if min(w, h) != args.resize:
+            if w < h:
+                nw, nh = args.resize, int(h * args.resize / w)
+            else:
+                nw, nh = int(w * args.resize / h), args.resize
+            img = img.resize((nw, nh), Image.BILINEAR)
+    buf = _io.BytesIO()
+    img.save(buf, format="JPEG", quality=args.quality)
+    header = recordio.IRHeader(0, item[2] if len(item) == 3
+                               else item[2:], item[0], 0)
+    return recordio.pack(header, buf.getvalue())
+
+
+def make_rec(args, image_list, root, prefix):
+    rec_path = prefix + ".rec"
+    idx_path = prefix + ".idx"
+    record = recordio.MXIndexedRecordIO(idx_path, rec_path, "w")
+    with concurrent.futures.ThreadPoolExecutor(args.num_thread) as pool:
+        futures = [(item[0], pool.submit(_encode_image, args, item, root))
+                   for item in image_list]
+        count = 0
+        for idx, fut in futures:
+            packed = fut.result()
+            if packed is None:
+                continue
+            record.write_idx(idx, packed)
+            count += 1
+            if count % 1000 == 0:
+                print("processed %d images" % count)
+    record.close()
+    print("wrote %d records to %s" % (count, rec_path))
+
+
+def main():
+    parser = argparse.ArgumentParser(
+        description="Create an image list / RecordIO file")
+    parser.add_argument("prefix", help="prefix of .lst/.rec files")
+    parser.add_argument("root", help="image root folder")
+    parser.add_argument("--list", action="store_true",
+                        help="create image list instead of record")
+    parser.add_argument("--recursive", action="store_true", default=True)
+    parser.add_argument("--shuffle", type=bool, default=True)
+    parser.add_argument("--train-ratio", type=float, default=1.0)
+    parser.add_argument("--test-ratio", type=float, default=0)
+    parser.add_argument("--resize", type=int, default=0)
+    parser.add_argument("--center-crop", action="store_true")
+    parser.add_argument("--quality", type=int, default=95)
+    parser.add_argument("--num-thread", type=int, default=4)
+    args = parser.parse_args()
+
+    if args.list:
+        image_list = list(list_image(args.root, args.recursive))
+        if args.shuffle:
+            random.seed(100)
+            random.shuffle(image_list)
+        N = len(image_list)
+        n_train = int(N * args.train_ratio)
+        n_test = int(N * args.test_ratio)
+        if args.train_ratio < 1.0:
+            write_list(args.prefix + "_train.lst", image_list[:n_train])
+            if n_test:
+                write_list(args.prefix + "_test.lst",
+                           image_list[n_train:n_train + n_test])
+            write_list(args.prefix + "_val.lst",
+                       image_list[n_train + n_test:])
+        else:
+            write_list(args.prefix + ".lst", image_list)
+    else:
+        lst = args.prefix + ".lst"
+        assert os.path.isfile(lst), \
+            "%s not found; run with --list first" % lst
+        image_list = list(read_list(lst))
+        make_rec(args, image_list, args.root, args.prefix)
+
+
+if __name__ == "__main__":
+    main()
