@@ -1,0 +1,183 @@
+"""Fused multi-layer RNN op — the TPU replacement for the reference's
+cuDNN-only RNN operator (src/operator/rnn-inl.h:124; CPU path fatals,
+src/operator/rnn.cc:32 — here every backend works).
+
+Design: one `lax.scan` per layer/direction — the XLA-native fused
+recurrence (compiler unrolls + pipelines the gate matmuls onto the MXU;
+weights stay resident in HBM across steps). Parameter blob layout matches
+the reference's cuDNN packing so FusedRNNCell pack/unpack and trained
+checkpoints are interchangeable:
+
+  all weights (layer-major, direction-inner): W_i2h(G*H, in), W_h2h(G*H, H)
+  then all biases: b_i2h(G*H), b_h2h(G*H)
+
+Gate order: lstm [i, f, c, o], gru [r, z, n] (cuDNN order, equal to the
+unfused cells')."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import register
+
+_GATES = {"rnn_relu": 1, "rnn_tanh": 1, "lstm": 4, "gru": 3}
+
+
+def _layer_param_sizes(mode, input_size, state_size, num_layers,
+                       bidirectional):
+    """Per-(layer, direction) weight/bias sizes in blob order."""
+    gates = _GATES[mode]
+    dirs = 2 if bidirectional else 1
+    sizes = []
+    for layer in range(num_layers):
+        isz = input_size if layer == 0 else state_size * dirs
+        for _d in range(dirs):
+            sizes.append(("w_i2h", gates * state_size * isz,
+                          (gates * state_size, isz)))
+            sizes.append(("w_h2h", gates * state_size * state_size,
+                          (gates * state_size, state_size)))
+    for layer in range(num_layers):
+        for _d in range(dirs):
+            sizes.append(("b_i2h", gates * state_size,
+                          (gates * state_size,)))
+            sizes.append(("b_h2h", gates * state_size,
+                          (gates * state_size,)))
+    return sizes
+
+
+def rnn_param_size(mode, input_size, state_size, num_layers,
+                   bidirectional):
+    """Total packed parameter count (FusedRNNCell needs this)."""
+    return sum(s for _, s, _ in _layer_param_sizes(
+        mode, input_size, state_size, num_layers, bidirectional))
+
+
+def _unpack_params(params, mode, input_size, state_size, num_layers,
+                   bidirectional):
+    """Split flat blob into {(layer, dir): dict of arrays}."""
+    sizes = _layer_param_sizes(mode, input_size, state_size, num_layers,
+                               bidirectional)
+    dirs = 2 if bidirectional else 1
+    out = {}
+    pos = 0
+    # weights
+    i = 0
+    for layer in range(num_layers):
+        for d in range(dirs):
+            w_i2h_sz, w_i2h_shape = sizes[i][1], sizes[i][2]
+            w_h2h_sz, w_h2h_shape = sizes[i + 1][1], sizes[i + 1][2]
+            i += 2
+            out[(layer, d)] = {
+                "w_i2h": params[pos:pos + w_i2h_sz].reshape(w_i2h_shape)}
+            pos += w_i2h_sz
+            out[(layer, d)]["w_h2h"] = \
+                params[pos:pos + w_h2h_sz].reshape(w_h2h_shape)
+            pos += w_h2h_sz
+    for layer in range(num_layers):
+        for d in range(dirs):
+            sz = _GATES[mode] * state_size
+            out[(layer, d)]["b_i2h"] = params[pos:pos + sz]
+            pos += sz
+            out[(layer, d)]["b_h2h"] = params[pos:pos + sz]
+            pos += sz
+    return out
+
+
+def _cell_step(mode, state_size):
+    """One-step transition fn for lax.scan: (h[,c]), x_t -> new state,
+    output."""
+    if mode in ("rnn_relu", "rnn_tanh"):
+        act = jnp.tanh if mode == "rnn_tanh" else \
+            (lambda v: jnp.maximum(v, 0))
+
+        def step(p, carry, x_t):
+            (h,) = carry
+            pre = x_t @ p["w_i2h"].T + p["b_i2h"] + \
+                h @ p["w_h2h"].T + p["b_h2h"]
+            h2 = act(pre)
+            return (h2,), h2
+        return step
+    if mode == "lstm":
+        def step(p, carry, x_t):
+            h, c = carry
+            pre = x_t @ p["w_i2h"].T + p["b_i2h"] + \
+                h @ p["w_h2h"].T + p["b_h2h"]
+            i_g, f_g, c_g, o_g = jnp.split(pre, 4, axis=-1)
+            i_g = jax.nn.sigmoid(i_g)
+            f_g = jax.nn.sigmoid(f_g)
+            c_g = jnp.tanh(c_g)
+            o_g = jax.nn.sigmoid(o_g)
+            c2 = f_g * c + i_g * c_g
+            h2 = o_g * jnp.tanh(c2)
+            return (h2, c2), h2
+        return step
+    if mode == "gru":
+        def step(p, carry, x_t):
+            (h,) = carry
+            xi = x_t @ p["w_i2h"].T + p["b_i2h"]
+            hh = h @ p["w_h2h"].T + p["b_h2h"]
+            xr, xz, xn = jnp.split(xi, 3, axis=-1)
+            hr, hz, hn = jnp.split(hh, 3, axis=-1)
+            r = jax.nn.sigmoid(xr + hr)
+            z = jax.nn.sigmoid(xz + hz)
+            n = jnp.tanh(xn + r * hn)
+            h2 = (1 - z) * n + z * h
+            return (h2,), h2
+        return step
+    raise ValueError("unknown RNN mode %r" % mode)
+
+
+@register("RNN", arg_names=("data", "parameters", "state", "state_cell"),
+          takes_is_train=True, needs_rng=True,
+          defaults={"state_size": 0, "num_layers": 1,
+                    "bidirectional": False, "mode": "lstm", "p": 0.0,
+                    "state_outputs": False, "lstm_state_clip_min": None,
+                    "lstm_state_clip_max": None})
+def _rnn_op(data, parameters, state, state_cell=None, state_size=0,
+            num_layers=1, bidirectional=False, mode="lstm", p=0.0,
+            state_outputs=False, is_train=False, rng=None, **_):
+    """data: (T, N, input); state: (L*D, N, H); lstm also state_cell."""
+    seq_len, batch, input_size = data.shape
+    dirs = 2 if bidirectional else 1
+    params = _unpack_params(parameters, mode, input_size, state_size,
+                            num_layers, bidirectional)
+    step = _cell_step(mode, state_size)
+
+    x = data
+    out_h = []
+    out_c = []
+    for layer in range(num_layers):
+        layer_outs = []
+        for d in range(dirs):
+            p_ld = params[(layer, d)]
+            sidx = layer * dirs + d
+            h0 = state[sidx]
+            carry = (h0, state_cell[sidx]) if mode == "lstm" else (h0,)
+            xs = x[::-1] if d == 1 else x
+
+            def scan_fn(carry, x_t, _p=p_ld):
+                return step(_p, carry, x_t)
+
+            final, ys = lax.scan(scan_fn, carry, xs)
+            if d == 1:
+                ys = ys[::-1]
+            layer_outs.append(ys)
+            out_h.append(final[0])
+            if mode == "lstm":
+                out_c.append(final[1])
+        x = jnp.concatenate(layer_outs, axis=-1) if dirs == 2 \
+            else layer_outs[0]
+        if is_train and p > 0 and layer < num_layers - 1 and \
+                rng is not None:
+            keep = 1.0 - p
+            mask = jax.random.bernoulli(
+                jax.random.fold_in(rng, layer), keep, x.shape)
+            x = jnp.where(mask, x / keep, 0).astype(x.dtype)
+
+    outputs = [x]
+    if state_outputs:
+        outputs.append(jnp.stack(out_h))
+        if mode == "lstm":
+            outputs.append(jnp.stack(out_c))
+    return tuple(outputs) if len(outputs) > 1 else outputs[0]
