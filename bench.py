@@ -5,12 +5,15 @@ K80 (BASELINE.md; example/image-classification/README.md:147-155). Same
 workload here: full fwd+bwd+SGD-momentum update, synthetic ImageNet batch
 (the reference's own benchmark mode, train_imagenet.py --benchmark 1).
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"} (plus
+step-time / MFU diagnostics). On backend failure prints a diagnostic JSON
+line instead of a stack trace, still rc!=0 so the driver records the error.
 """
 import json
 import os
 import sys
 import time
+import traceback
 
 # MXU-friendly matmul precision for the perf path (see mxnet_tpu/__init__)
 os.environ.setdefault("MXNET_MATMUL_PRECISION", "default")
@@ -21,38 +24,108 @@ import numpy as np  # noqa: E402
 
 BASELINE_IMG_S = 109.0  # reference ResNet-50, 1x K80, batch 32
 
+# bf16/fp32 peak FLOP/s per chip by device kind, for the MFU estimate.
+# (TPU v4/v5e/v5p/v6e public numbers; fp32 host fallback is a nominal 1e12.)
+_PEAK_FLOPS = {
+    "TPU v4": 275e12,
+    "TPU v5 lite": 197e12,
+    "TPU v5e": 197e12,
+    "TPU v5p": 459e12,
+    "TPU v5": 459e12,
+    "TPU v6 lite": 918e12,
+    "TPU v6e": 918e12,
+}
+
+
+def _fail(stage, err):
+    print(json.dumps({
+        "metric": "resnet50_train_throughput", "value": None, "unit": "img/s",
+        "vs_baseline": None, "error_stage": stage,
+        "error": "".join(traceback.format_exception_only(type(err), err))
+                 .strip()[:500]}))
+    sys.exit(1)
+
 
 def main():
-    import jax
-    import mxnet_tpu as mx
-    from mxnet_tpu.models import resnet
-    from mxnet_tpu.parallel import make_train_step
-    from mxnet_tpu.initializer import Xavier
+    # --- stage 1: backend probe, before building anything -----------------
+    # A dead TPU tunnel HANGS inside (GIL-holding) backend init rather
+    # than raising — a signal-based watchdog cannot interrupt it. Probe in
+    # a SUBPROCESS with a hard timeout so a hang becomes a diagnostic JSON
+    # (not rc=124 with no output) before this process touches the backend.
+    import subprocess
 
-    batch = int(os.environ.get("BENCH_BATCH", "64"))
-    image = 224
-    sym = resnet.get_symbol(num_classes=1000, num_layers=50,
-                            image_shape=(3, image, image))
+    timeout_s = int(os.environ.get("BENCH_BACKEND_TIMEOUT", "180"))
+    probe_src = (
+        "import jax, os\n"
+        "p = os.environ.get('BENCH_PLATFORM')\n"
+        "if p: jax.config.update('jax_platforms', p)\n"
+        "jax.block_until_ready(jax.numpy.zeros((8, 8)) + 1.0)\n"
+        "print('kind:', jax.devices()[0].device_kind)\n")
+    try:
+        r = subprocess.run([sys.executable, "-c", probe_src],
+                           timeout=timeout_s, capture_output=True,
+                           text=True)
+        if r.returncode != 0:
+            raise RuntimeError("backend probe failed: %s"
+                               % r.stderr.strip()[-400:])
+    except subprocess.TimeoutExpired:
+        _fail("backend_init", TimeoutError(
+            "backend init hung for %ds (TPU tunnel down or unresponsive)"
+            % timeout_s))
+    except Exception as e:  # noqa: BLE001
+        _fail("backend_init", e)
 
-    step = make_train_step(sym, optimizer="sgd",
-                           optimizer_params={"momentum": 0.9, "wd": 1e-4,
-                                             "rescale_grad": 1.0 / batch})
-    state = step.init_state(Xavier(factor_type="in", magnitude=2.0),
-                            {"data": (batch, 3, image, image),
-                             "softmax_label": (batch,)})
+    try:
+        import jax
+        if os.environ.get("BENCH_PLATFORM"):
+            jax.config.update("jax_platforms",
+                              os.environ["BENCH_PLATFORM"])
+        devices = jax.devices()
+        dev = devices[0]
+        jax.block_until_ready(jax.numpy.zeros((8, 8)) + 1.0)
+    except Exception as e:  # noqa: BLE001
+        _fail("backend_init", e)
 
-    rng = jax.random.PRNGKey(0)
-    x = np.random.RandomState(0).standard_normal(
-        (batch, 3, image, image)).astype(np.float32)
-    y = np.random.RandomState(1).randint(0, 1000, (batch,)).astype(
-        np.float32)
-    batch_vals = {"data": x, "softmax_label": y}
+    # --- stage 2: build model + step fn on host (no device work) ----------
+    try:
+        from mxnet_tpu.models import resnet
+        from mxnet_tpu.parallel import make_train_step
+        from mxnet_tpu.initializer import Xavier
 
-    # warmup/compile
-    for _ in range(2):
-        state, outs = step(state, batch_vals, 0.1, rng)
-    jax.block_until_ready(outs)
+        batch = int(os.environ.get("BENCH_BATCH", "64"))
+        image = 224
+        sym = resnet.get_symbol(num_classes=1000, num_layers=50,
+                                image_shape=(3, image, image))
+        step = make_train_step(
+            sym, optimizer="sgd",
+            optimizer_params={"momentum": 0.9, "wd": 1e-4,
+                              "rescale_grad": 1.0 / batch})
+        x = np.random.RandomState(0).standard_normal(
+            (batch, 3, image, image)).astype(np.float32)
+        y = np.random.RandomState(1).randint(0, 1000, (batch,)).astype(
+            np.float32)
+        batch_vals = {"data": x, "softmax_label": y}
+    except Exception as e:  # noqa: BLE001
+        _fail("graph_build", e)
 
+    # --- stage 3: init params on device ------------------------------------
+    try:
+        state = step.init_state(Xavier(factor_type="in", magnitude=2.0),
+                                {"data": (batch, 3, image, image),
+                                 "softmax_label": (batch,)})
+        rng = jax.random.PRNGKey(0)
+    except Exception as e:  # noqa: BLE001
+        _fail("param_init", e)
+
+    # --- stage 4: compile + warmup -----------------------------------------
+    try:
+        for _ in range(2):
+            state, outs = step(state, batch_vals, 0.1, rng)
+        jax.block_until_ready(outs)
+    except Exception as e:  # noqa: BLE001
+        _fail("compile_warmup", e)
+
+    # --- stage 5: timed loop ------------------------------------------------
     iters = int(os.environ.get("BENCH_ITERS", "20"))
     t0 = time.time()
     for _ in range(iters):
@@ -61,11 +134,31 @@ def main():
     dt = time.time() - t0
 
     img_s = batch * iters / dt
+    step_ms = dt / iters * 1e3
+
+    # MFU: actual FLOPs of the compiled step (XLA cost analysis) over the
+    # chip's peak. Falls back to a 3x-forward analytic estimate.
+    step_flops = None
+    try:
+        cost = step.cost_analysis(state, batch_vals, 0.1, rng)
+        if cost and cost.get("flops"):
+            step_flops = float(cost["flops"])
+    except Exception:  # noqa: BLE001
+        pass
+    if not step_flops:
+        step_flops = 3 * 2 * 3.86e9 * batch  # 3.86 GMACs fwd / 224px image
+    peak = _PEAK_FLOPS.get(getattr(dev, "device_kind", ""), None)
+    mfu = (step_flops / (dt / iters)) / peak if peak else None
+
     print(json.dumps({
         "metric": "resnet50_train_throughput",
         "value": round(img_s, 2),
         "unit": "img/s",
-        "vs_baseline": round(img_s / BASELINE_IMG_S, 3)}))
+        "vs_baseline": round(img_s / BASELINE_IMG_S, 3),
+        "step_time_ms": round(step_ms, 2),
+        "batch": batch,
+        "device_kind": getattr(dev, "device_kind", "unknown"),
+        "mfu": round(mfu, 4) if mfu is not None else None}))
 
 
 if __name__ == "__main__":

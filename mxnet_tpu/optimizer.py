@@ -184,16 +184,6 @@ class Optimizer:
             wd *= self.wd_mult.get(self.idx2name[index], 1.0)
         return wd
 
-    def __getstate__(self):
-        ret = self.__dict__.copy()
-        del ret["lr_scheduler"]
-        return ret
-
-    def __setstate__(self, state):
-        self.__dict__ = state
-        self.lr_scheduler = None
-
-
 register = Optimizer.register
 create = Optimizer.create_optimizer
 opt_registry = Optimizer.opt_registry

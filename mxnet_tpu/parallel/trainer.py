@@ -63,13 +63,8 @@ class TrainStep:
         self._eval_fn = _graph_eval_fn(symbol)
 
         step = self._build_step()
-        if mesh is not None:
-            param_shd = {}  # filled at init_state; jit infers from inputs
-            self._jit_step = jax.jit(
-                step, donate_argnums=(0, 1, 2) if donate else ())
-        else:
-            self._jit_step = jax.jit(
-                step, donate_argnums=(0, 1, 2) if donate else ())
+        self._jit_step = jax.jit(
+            step, donate_argnums=(0, 1, 2) if donate else ())
 
     # -- state -------------------------------------------------------------
     def init_state(self, initializer, batch_shapes, batch_dtypes=None,
@@ -128,8 +123,27 @@ class TrainStep:
         opt_attrs = dict(self.opt_params)
         opt_fn = get_op(self._opt_op).fn
         n_state = self._n_state
+        mesh = self.mesh
+        data_names = self.data_names
 
         def step(params, opt_state, aux, batch, lr, rng):
+            # Module.init_optimizer defaults rescale_grad=1/batch; match
+            # that here so the SPMD path's effective lr does not scale with
+            # global batch unless the caller overrides (ADVICE r1). Local
+            # copy: batch size is a static trace-time value, and mutating
+            # the closed-over dict would leak across retraces.
+            attrs = dict(opt_attrs)
+            if "rescale_grad" not in attrs and data_names:
+                attrs["rescale_grad"] = 1.0 / batch[
+                    data_names[0]].shape[0]
+            if mesh is not None and "data" in mesh.axis_names:
+                # pin batch layout so sharding does not rest only on input
+                # propagation; params keep their init_state placement
+                # (meshes without a data axis replicate the batch)
+                batch = {k: jax.lax.with_sharding_constraint(
+                    v, shd.batch_sharding(mesh, jnp.ndim(v)))
+                    for k, v in batch.items()}
+
             def fwd(p):
                 outs, new_aux = eval_fn({**batch, **p}, aux, rng, True)
                 return outs, new_aux
@@ -144,7 +158,7 @@ class TrainStep:
             new_params, new_opt = {}, {}
             for n in param_names:
                 res = opt_fn(params[n], grads[n], *opt_state[n],
-                             lr=lr, **opt_attrs)
+                             lr=lr, **attrs)
                 if n_state:
                     new_params[n] = res[0]
                     new_opt[n] = tuple(res[1:])
@@ -165,6 +179,15 @@ class TrainStep:
         params, opt_state, aux = state
         return self._jit_step.lower(params, opt_state, aux, batch,
                                     jnp.asarray(lr, jnp.float32), rng)
+
+    def cost_analysis(self, state, batch, lr, rng):
+        """XLA cost analysis (flops, bytes) of the step — used by bench.py
+        for the MFU estimate. Reads it off the lowered module (trace cost
+        only); .compile() here would redo the whole XLA compilation."""
+        ca = self.lower(state, batch, lr, rng).cost_analysis()
+        if isinstance(ca, (list, tuple)):
+            ca = ca[0] if ca else {}
+        return dict(ca or {})
 
 
 def make_train_step(symbol, **kwargs):

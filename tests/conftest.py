@@ -24,9 +24,3 @@ if not os.environ.get("MXNET_TEST_ON_TPU"):
     # authoritative override even if jax was already imported
     import jax
     jax.config.update("jax_platforms", "cpu")
-    if "xla_force_host_platform_device_count" not in flags:
-        # XLA_FLAGS is read at backend init; ensure it is in place before
-        # the first jax.devices() call
-        pass
-else:
-    flags = os.environ.get("XLA_FLAGS", "")

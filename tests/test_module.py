@@ -55,6 +55,7 @@ def test_module_train_convergence():
     tests/python/train/test_mlp.py asserts final accuracy)."""
     X, y = _toy_data()
     mx.random.seed(0)
+    np.random.seed(0)  # NDArrayIter shuffles via the global numpy RNG
     train = io.NDArrayIter(X, y, batch_size=32, shuffle=True)
     mod = mx.mod.Module(_mlp_sym(), context=mx.cpu())
     mod.fit(train, num_epoch=5, optimizer="sgd",
