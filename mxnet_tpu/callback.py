@@ -1,4 +1,9 @@
-"""Training callbacks (reference: python/mxnet/callback.py, 214 LoC)."""
+"""Training callbacks: checkpointing, metric logging, throughput.
+
+Capability parity with the reference callback module
+(python/mxnet/callback.py): epoch-end checkpoint factories and batch-end
+logging callbacks used by Module.fit.
+"""
 from __future__ import annotations
 
 import logging
@@ -9,102 +14,109 @@ __all__ = ["module_checkpoint", "do_checkpoint", "log_train_metric",
            "Speedometer", "ProgressBar", "LogValidationMetricsCallback"]
 
 
-def module_checkpoint(mod, prefix, period=1, save_optimizer_states=False):
-    """Epoch-end checkpoint callback bound to a module (reference
-    callback.py:27)."""
-    period = int(max(1, period))
+def _every(period):
+    """Normalize a period and return a due-predicate over epoch index."""
+    period = max(1, int(period))
+    return lambda epoch: (epoch + 1) % period == 0
 
-    def _callback(iter_no, sym=None, arg=None, aux=None):
-        if (iter_no + 1) % period == 0:
-            mod.save_checkpoint(prefix, iter_no + 1, save_optimizer_states)
+
+def module_checkpoint(mod, prefix, period=1, save_optimizer_states=False):
+    """Epoch-end callback saving `mod` (symbol+params[+optimizer]) every
+    `period` epochs."""
+    due = _every(period)
+
+    def _callback(epoch_no, sym=None, arg=None, aux=None):
+        if due(epoch_no):
+            mod.save_checkpoint(prefix, epoch_no + 1,
+                                save_optimizer_states)
     return _callback
 
 
 def do_checkpoint(prefix, period=1):
-    """Epoch-end checkpoint callback (reference callback.py:55)."""
+    """Epoch-end callback writing prefix-symbol.json + prefix-NNNN.params
+    every `period` epochs."""
     from .model import save_checkpoint
-    period = int(max(1, period))
+    due = _every(period)
 
-    def _callback(iter_no, sym, arg, aux):
-        if (iter_no + 1) % period == 0:
-            save_checkpoint(prefix, iter_no + 1, sym, arg, aux)
+    def _callback(epoch_no, sym, arg, aux):
+        if due(epoch_no):
+            save_checkpoint(prefix, epoch_no + 1, sym, arg, aux)
     return _callback
 
 
 def log_train_metric(period, auto_reset=False):
-    """Log metric every `period` batches (reference callback.py:85)."""
+    """Batch-end callback logging the running training metric every
+    `period` batches."""
     def _callback(param):
-        if param.nbatch % period == 0 and param.eval_metric is not None:
-            name_value = param.eval_metric.get_name_value()
-            for name, value in name_value:
+        metric = param.eval_metric
+        if metric is not None and param.nbatch % period == 0:
+            for name, value in metric.get_name_value():
                 logging.info("Iter[%d] Batch[%d] Train-%s=%f",
                              param.epoch, param.nbatch, name, value)
             if auto_reset:
-                param.eval_metric.reset()
+                metric.reset()
     return _callback
 
 
 class Speedometer:
-    """samples/sec logger (reference callback.py:Speedometer)."""
+    """Batch-end callback logging samples/sec (and the running metric)
+    every `frequent` batches."""
 
     def __init__(self, batch_size, frequent=50, auto_reset=True):
         self.batch_size = batch_size
         self.frequent = frequent
-        self.init = False
-        self.tic = 0
-        self.last_count = 0
         self.auto_reset = auto_reset
+        self._last_time = None
+        self._last_count = 0
 
     def __call__(self, param):
         count = param.nbatch
-        if self.last_count > count:
-            self.init = False
-        self.last_count = count
+        if count < self._last_count:
+            self._last_time = None       # new epoch: restart the clock
+        self._last_count = count
 
-        if self.init:
-            if count % self.frequent == 0:
-                speed = self.frequent * self.batch_size / \
-                    (time.time() - self.tic)
-                if param.eval_metric is not None:
-                    name_value = param.eval_metric.get_name_value()
-                    if self.auto_reset:
-                        param.eval_metric.reset()
-                    msg = "Epoch[%d] Batch [%d]\tSpeed: %.2f samples/sec"
-                    msg += "\t%s=%f" * len(name_value)
-                    logging.info(msg, param.epoch, count, speed,
-                                 *sum(name_value, ()))
-                else:
-                    logging.info("Iter[%d] Batch [%d]\tSpeed: %.2f "
-                                 "samples/sec", param.epoch, count, speed)
-                self.tic = time.time()
+        if self._last_time is None:
+            self._last_time = time.time()
+            return
+        if count % self.frequent != 0:
+            return
+
+        elapsed = time.time() - self._last_time
+        speed = self.frequent * self.batch_size / elapsed if elapsed else 0.0
+        metric = param.eval_metric
+        if metric is not None:
+            pairs = metric.get_name_value()
+            if self.auto_reset:
+                metric.reset()
+            text = "".join("\t%s=%f" % pair for pair in pairs)
+            logging.info("Epoch[%d] Batch [%d]\tSpeed: %.2f samples/sec%s",
+                         param.epoch, count, speed, text)
         else:
-            self.init = True
-            self.tic = time.time()
+            logging.info("Iter[%d] Batch [%d]\tSpeed: %.2f samples/sec",
+                         param.epoch, count, speed)
+        self._last_time = time.time()
 
 
 class ProgressBar:
-    """ASCII progress bar per batch (reference callback.py:ProgressBar)."""
+    """Batch-end callback drawing an ASCII progress bar."""
 
     def __init__(self, total, length=80):
         self.bar_len = length
         self.total = total
 
     def __call__(self, param):
-        count = param.nbatch
-        filled_len = int(round(self.bar_len * count / float(self.total)))
-        percents = math.ceil(100.0 * count / float(self.total))
-        prog_bar = "=" * filled_len + "-" * (self.bar_len - filled_len)
-        logging.info("[%s] %s%s\r", prog_bar, percents, "%")
+        frac = param.nbatch / float(self.total)
+        filled = int(round(self.bar_len * frac))
+        bar = "=" * filled + "-" * (self.bar_len - filled)
+        logging.info("[%s] %s%s\r", bar, math.ceil(100.0 * frac), "%")
 
 
 class LogValidationMetricsCallback:
-    """Log eval metrics at end of epoch (reference
-    callback.py:LogValidationMetricsCallback)."""
+    """Score-end callback logging each validation metric."""
 
     def __call__(self, param):
         if not param.eval_metric:
             return
-        name_value = param.eval_metric.get_name_value()
-        for name, value in name_value:
+        for name, value in param.eval_metric.get_name_value():
             logging.info("Epoch[%d] Validation-%s=%f", param.epoch, name,
                          value)

@@ -8,31 +8,94 @@ placement/planning/fusion), and get the backward pass from `jax.vjp` of that
 same function — the whole-graph analogue of the reference's symbolic
 Gradient pass.
 
+Training forwards run a FUSED fwd+vjp program: one XLA executable computes
+outputs, updated aux state and parameter gradients together, so the
+Module.fit hot path pays forward FLOPs once (the reference reused forward
+activations from its executor memory plan; XLA shares them inside the one
+program).
+
+Device placement (the reference's PlaceDevice pass over `ctx_group`
+attributes, graph_executor.cc:309-410) maps to GSPMD sharding constraints:
+nodes annotated `__shard__="data,model"` (or `__ctx_group__=g` with a
+group2ctx entry naming a spec) get `with_sharding_constraint` applied to
+their outputs when the executor runs over a mesh.
+
 Aux states (BatchNorm moving stats) are threaded functionally through the
 compiled fn and written back to their NDArrays after each forward — the
 reference mutated them in-place from inside kernels.
 """
 from __future__ import annotations
 
-import functools
-
 import jax
 import jax.numpy as jnp
 import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
 
-from .base import MXNetError, np_dtype
-from .context import current_context
+from .base import MXNetError
+from .context import Context, current_context
 from .ndarray import ndarray as _nd
 from .ndarray.ndarray import NDArray, _wrap
 
 __all__ = ["Executor"]
 
 
-def _graph_eval_fn(symbol):
+def _parse_pspec(spec):
+    """'data,model' / '(data, None)' / 'model' -> tuple for PartitionSpec.
+    None/'None'/'' entries mean unsharded dims."""
+    if isinstance(spec, (tuple, list)):
+        parts = list(spec)
+    else:
+        parts = [p.strip() for p in
+                 str(spec).strip().strip("()").split(",")]
+    return tuple(None if p in (None, "", "None", "none") else str(p)
+                 for p in parts)
+
+
+def _shard_constraint(mesh, spec, val):
+    """Apply a sharding constraint to one node output, validating the spec
+    against the mesh and the value's shape."""
+    parts = _parse_pspec(spec)
+    if len(parts) > np.ndim(val):
+        return val  # annotation written for a different-rank tensor
+    for dim, axis in enumerate(parts):
+        if axis is None:
+            continue
+        if axis not in mesh.axis_names:
+            raise MXNetError(
+                "__shard__ axis %r not in mesh axes %r"
+                % (axis, mesh.axis_names))
+        if val.shape[dim] % mesh.shape[axis] != 0:
+            raise MXNetError(
+                "__shard__=%r: dim %d of shape %r not divisible by mesh "
+                "axis %r (size %d)" % (spec, dim, tuple(val.shape), axis,
+                                       mesh.shape[axis]))
+    return jax.lax.with_sharding_constraint(
+        val, NamedSharding(mesh, P(*parts)))
+
+
+def _node_shard_spec(node, group2spec):
+    """The sharding annotation of a node, if any: explicit __shard__ wins,
+    else its ctx_group's entry in group2spec."""
+    attrs = node.misc_attrs
+    spec = attrs.get("__shard__")
+    if spec is not None:
+        return spec
+    group = attrs.get("__ctx_group__") or attrs.get("ctx_group")
+    if group is not None and group2spec:
+        return group2spec.get(group)
+    return None
+
+
+def _graph_eval_fn(symbol, mesh=None, group2spec=None, capture=None):
     """Build the pure function evaluating `symbol`'s graph.
 
     Returns fn(arg_vals: dict name->array, aux_vals: dict, rng, is_train)
-      -> (tuple outputs, dict new_aux)."""
+      -> (tuple outputs, dict new_aux).
+
+    mesh/group2spec: lower ctx_group/__shard__ annotations to sharding
+    constraints (the PlaceDevice analogue). capture: debugging hook called
+    with (node_name, [outputs]) for every op node — only useful un-jitted
+    (Monitor path)."""
     from .symbol.symbol import _topo_order
 
     entries = symbol._entries
@@ -48,6 +111,8 @@ def _graph_eval_fn(symbol):
                     env[id(node)] = [aux_out[node.name]]
                 else:
                     env[id(node)] = [arg_vals[node.name]]
+                if capture is not None:
+                    capture(node.name, env[id(node)])
                 continue
             xs = [env[id(m)][i] for (m, i) in node.inputs]
             attrs = dict(node.attrs)
@@ -72,6 +137,12 @@ def _graph_eval_fn(symbol):
                     m, _i = node.inputs[active.index(sname)]
                     if m.op is None and m.is_aux:
                         aux_out[m.name] = val
+            if mesh is not None:
+                spec = _node_shard_spec(node, group2spec)
+                if spec is not None:
+                    outs = [_shard_constraint(mesh, spec, o) for o in outs]
+            if capture is not None:
+                capture(node.name, outs)
             env[id(node)] = outs
         outputs = tuple(env[id(n)][i] for (n, i) in entries)
         return outputs, aux_out
@@ -83,12 +154,14 @@ class Executor:
     """Executor over a lowered symbol graph (reference graph_executor.h:57)."""
 
     def __init__(self, symbol, ctx=None, args=None, args_grad=None,
-                 grad_req="write", aux_states=None, group2ctx=None):
+                 grad_req="write", aux_states=None, group2ctx=None,
+                 mesh=None):
         self._symbol = symbol
         self._ctx = ctx if ctx is not None else current_context()
         self._group2ctx = group2ctx or {}
+        self._mesh = mesh
         self._monitor_callback = None
-        self._step = 0
+        self._monitor_all = False
 
         arg_names = symbol.list_arguments()
         aux_names = symbol.list_auxiliary_states()
@@ -118,13 +191,26 @@ class Executor:
                         self._grad_req[n] != "null":
                     self._grad_req[n] = "null"
 
-        self._eval_fn = _graph_eval_fn(symbol)
+        # group2ctx: entries whose value is a partition-spec string (or
+        # P tuple) become sharding constraints; Context values (reference
+        # device placement) have no single-program analogue and replicate
+        self._group2spec = {g: v for g, v in self._group2ctx.items()
+                            if not isinstance(v, Context)}
+        self._eval_fn = _graph_eval_fn(symbol, mesh=mesh,
+                                       group2spec=self._group2spec)
         self._jit_fwd = jax.jit(self._eval_fn, static_argnums=(3,))
         self._grad_names = [n for n in arg_names
                             if self._grad_req[n] != "null"]
+        self._jit_fwd_bwd = jax.jit(self._fwd_bwd_impl)
         self._jit_bwd = jax.jit(self._bwd_impl)
         self.outputs = []
         self._fwd_inputs = None
+        self._cached_grads = None
+        # adaptive: fused fwd+grads is only worth it when backward() takes
+        # the default ones-cotangent path; a backward with explicit
+        # out_grads (e.g. SequentialModule interior stages) flips this off
+        # so later forwards don't compute grads that get thrown away
+        self._prefer_fused = True
 
     # -- construction helpers ----------------------------------------------
     def _align(self, what, values, names, allow_missing=False):
@@ -201,16 +287,48 @@ class Executor:
                 raise MXNetError("Found name %r not in aux states" % name)
 
     def set_monitor_callback(self, callback, monitor_all=False):
+        """Install a per-op value callback (reference ExecuteMonCallback,
+        graph_executor.h:200). Fires for every graph node's outputs;
+        monitor_all additionally fires for variable (arg/aux) nodes."""
         self._monitor_callback = callback
+        self._monitor_all = monitor_all
 
     # -- execution -----------------------------------------------------------
     def _current_rng(self):
         from . import random as mx_random
         return mx_random.next_key()
 
+    def _monitor_active(self):
+        if self._monitor_callback is None:
+            return False
+        mon = getattr(self._monitor_callback, "mon", None)
+        return bool(getattr(mon, "activated", True))
+
+    def _run_monitored(self, arg_vals, aux_vals, rng, is_train):
+        """Un-jitted graph evaluation with a per-node capture hook — the
+        Monitor debugging path (intermediate tensors are materialized,
+        which jit+fusion would never do)."""
+        cb = self._monitor_callback
+        want_vars = self._monitor_all
+        var_names = set(self._arg_names) | set(self._aux_names)
+
+        def capture(name, outs):
+            if not want_vars and name in var_names:
+                return
+            for i, o in enumerate(outs):
+                label = name if len(outs) == 1 else "%s_out%d" % (name, i)
+                cb(label, _wrap(jnp.asarray(o)))
+
+        fn = _graph_eval_fn(self._symbol, mesh=self._mesh,
+                            group2spec=self._group2spec, capture=capture)
+        return fn(arg_vals, aux_vals, rng, is_train)
+
     def forward(self, is_train=False, **kwargs):
         """Run forward (reference MXExecutorForward →
-        GraphExecutor::Forward). kwargs update named input arrays."""
+        GraphExecutor::Forward). kwargs update named input arrays.
+
+        Training forwards with gradients requested run the fused
+        fwd+vjp executable and cache the gradients for backward()."""
         for k, v in kwargs.items():
             if k not in self.arg_dict:
                 raise MXNetError("unknown forward argument %r" % k)
@@ -224,7 +342,18 @@ class Executor:
         aux_vals = {n: a._data for n, a in zip(self._aux_names,
                                                self.aux_arrays)}
         rng = self._current_rng()
-        outs, new_aux = self._jit_fwd(arg_vals, aux_vals, rng, bool(is_train))
+
+        self._cached_grads = None
+        if self._monitor_active():
+            outs, new_aux = self._run_monitored(arg_vals, aux_vals, rng,
+                                                bool(is_train))
+        elif is_train and self._grad_names and self._prefer_fused:
+            outs, new_aux, grads = self._jit_fwd_bwd(arg_vals, aux_vals,
+                                                     rng)
+            self._cached_grads = grads
+        else:
+            outs, new_aux = self._jit_fwd(arg_vals, aux_vals, rng,
+                                          bool(is_train))
         if is_train:
             for n, a in zip(self._aux_names, self.aux_arrays):
                 a._set_data(new_aux[n])
@@ -234,12 +363,27 @@ class Executor:
             # later backward() cannot silently use stale inputs
             self._fwd_inputs = None
         self.outputs = [_wrap(o) for o in outs]
-        if self._monitor_callback is not None:
-            for name, out in zip(self._symbol.list_outputs(), self.outputs):
-                self._monitor_callback(name, out)
         return self.outputs
 
+    def _fwd_bwd_impl(self, arg_vals, aux_vals, rng):
+        """One XLA program: outputs + new aux + grads (ones cotangent —
+        the reference's head-grad convention, where loss heads ignore the
+        incoming cotangent)."""
+        wrt = {n: arg_vals[n] for n in self._grad_names}
+
+        def f(wrt_vals):
+            merged = dict(arg_vals)
+            merged.update(wrt_vals)
+            outs, new_aux = self._eval_fn(merged, aux_vals, rng, True)
+            return outs, new_aux
+
+        outs, vjp, new_aux = jax.vjp(f, wrt, has_aux=True)
+        cots = tuple(jnp.ones(o.shape, o.dtype) for o in outs)
+        grads = vjp(cots)[0]
+        return outs, new_aux, grads
+
     def _bwd_impl(self, arg_vals, aux_vals, rng, head_grads):
+        """Re-derivation path for explicit head gradients."""
         wrt = tuple(arg_vals[n] for n in self._grad_names)
 
         def f(wrt_vals):
@@ -257,20 +401,30 @@ class Executor:
 
         With no `out_grads`, each head receives an all-ones cotangent —
         matching the reference where loss-layer ops (SoftmaxOutput, MakeLoss)
-        ignore the incoming head gradient entirely."""
+        ignore the incoming head gradient entirely. In that default case the
+        gradients were already produced by the fused forward program and
+        this only writes them out."""
         if self._fwd_inputs is None:
             raise MXNetError("backward() requires a prior "
                              "forward(is_train=True)")
         arg_vals, aux_vals, rng = self._fwd_inputs
         if out_grads is None:
-            head_grads = [jnp.ones(o.shape, o._data.dtype)
-                          for o in self.outputs]
+            self._prefer_fused = True
+            if self._cached_grads is not None:
+                grads = self._cached_grads
+            else:
+                head_grads = [jnp.ones(o.shape, o._data.dtype)
+                              for o in self.outputs]
+                grads = self._jit_bwd(arg_vals, aux_vals, rng,
+                                      tuple(head_grads))
         else:
+            self._prefer_fused = False
             if isinstance(out_grads, (NDArray, jax.Array, np.ndarray)):
                 out_grads = [out_grads]
             head_grads = [g._data if isinstance(g, NDArray)
                           else jnp.asarray(g) for g in out_grads]
-        grads = self._jit_bwd(arg_vals, aux_vals, rng, tuple(head_grads))
+            grads = self._jit_bwd(arg_vals, aux_vals, rng,
+                                  tuple(head_grads))
         for n, gbuf in zip(self._arg_names, self.grad_arrays):
             if gbuf is None or self._grad_req[n] == "null":
                 continue
@@ -297,7 +451,8 @@ class Executor:
                            else _nd.zeros(s, dtype=a.dtype))
         return Executor(self._symbol, self._ctx, args=new_args,
                         grad_req={n: r for n, r in self._grad_req.items()},
-                        aux_states=new_aux, group2ctx=self._group2ctx)
+                        aux_states=new_aux, group2ctx=self._group2ctx,
+                        mesh=self._mesh)
 
     def debug_str(self):
         return self._symbol.debug_str()

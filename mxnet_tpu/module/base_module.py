@@ -1,5 +1,13 @@
-"""BaseModule — the abstract high-level training interface (reference:
-python/mxnet/module/base_module.py, 1074 LoC; fit loop at :376-515)."""
+"""BaseModule — the abstract high-level training interface.
+
+Capability parity with the reference's module layer (its fit loop and
+predict/score surface live in python/mxnet/module/base_module.py). The
+implementation here is re-derived for the single-sharded-executor design:
+state checks go through one `_require` helper, batch evaluation is one
+generator shared by score/predict/iter_predict, and subclasses that merely
+steer an inner module inherit `DelegatingModule` instead of re-declaring
+the whole computation interface.
+"""
 from __future__ import annotations
 
 import logging
@@ -9,66 +17,61 @@ import numpy as np
 
 from .. import metric as metric_mod
 from .. import io
-from ..base import string_types, _as_list
+from ..base import _as_list
 from ..model import BatchEndParam
 from ..initializer import Uniform
-from ..ndarray import NDArray
 
 
 def _check_input_names(symbol, names, typename, throw):
-    """Validate user-given names against the symbol's inputs (reference
-    base_module.py:_check_input_names)."""
-    args = symbol.list_arguments()
+    """Ensure each user-given input name exists among the symbol's
+    arguments; suggest likely candidates otherwise."""
+    known = set(symbol.list_arguments())
+    suffixes = ("_weight", "_bias", "_gamma", "_beta")
     for name in names:
-        if name in args:
+        if name in known:
             continue
-        candidates = [arg for arg in args if
-                      not arg.endswith("_weight") and
-                      not arg.endswith("_bias") and
-                      not arg.endswith("_gamma") and
-                      not arg.endswith("_beta")]
-        msg = "\033[91mYou created Module with Module(..., %s_names=%s) but " \
-              "input with name '%s' is not found in symbol.list_arguments(). " \
-              "Did you mean one of:\n\t%s\033[0m" % (
-                  typename, str(names), name, "\n\t".join(candidates))
+        likely = [a for a in known if not a.endswith(suffixes)]
+        msg = ("\033[91mYou created Module with Module(..., %s_names=%s) but "
+               "input with name '%s' is not found in "
+               "symbol.list_arguments(). Did you mean one of:\n\t%s\033[0m"
+               % (typename, names, name, "\n\t".join(sorted(likely))))
         if throw:
             raise ValueError(msg)
         logging.warning(msg)
 
 
 def _check_names_match(data_names, data_shapes, name, throw):
-    """Check that input names match data descriptors (reference
-    base_module.py:_check_names_match)."""
-    actual = [x[0] for x in data_shapes]
-    if sorted(data_names) != sorted(actual):
-        msg = "Data provided by %s_shapes don't match names specified by " \
-              "%s_names (%s vs. %s)" % (name, name, str(data_shapes),
-                                        str(data_names))
+    """data_shapes' names must cover exactly data_names."""
+    given = sorted(d[0] for d in data_shapes)
+    if given != sorted(data_names):
+        msg = ("Data provided by %s_shapes don't match names specified by "
+               "%s_names (%s vs. %s)"
+               % (name, name, data_shapes, data_names))
         if throw:
             raise ValueError(msg)
         logging.warning(msg)
 
 
 def _parse_data_desc(data_names, label_names, data_shapes, label_shapes):
-    """Normalize to DataDesc (reference base_module.py:_parse_data_desc)."""
-    data_shapes = [x if isinstance(x, io.DataDesc) else io.DataDesc(*x)
-                   for x in data_shapes]
+    """Normalize (name, shape) pairs to io.DataDesc and validate them."""
+    def to_descs(shapes):
+        return [s if isinstance(s, io.DataDesc) else io.DataDesc(*s)
+                for s in shapes]
+
+    data_shapes = to_descs(data_shapes)
     _check_names_match(data_names, data_shapes, "data", True)
-    if label_shapes is not None:
-        label_shapes = [x if isinstance(x, io.DataDesc) else io.DataDesc(*x)
-                        for x in label_shapes]
-        _check_names_match(label_names, label_shapes, "label", False)
-    else:
+    if label_shapes is None:
         _check_names_match(label_names, [], "label", False)
+    else:
+        label_shapes = to_descs(label_shapes)
+        _check_names_match(label_names, label_shapes, "label", False)
     return data_shapes, label_shapes
 
 
 class BaseModule:
-    """The base class of a module (reference base_module.py:BaseModule).
-
-    A module has: bound state, parameters, optimizer; and supports
-    forward/backward/update plus the high-level fit/predict/score loops.
-    """
+    """Abstract module: bound state + parameters + optimizer, with
+    forward/backward/update primitives and fit/predict/score loops on
+    top. Subclasses implement the computation interface."""
 
     def __init__(self, logger=logging):
         self.logger = logger
@@ -80,106 +83,94 @@ class BaseModule:
         self._symbol = None
         self._total_exec_bytes = 0
 
+    # -- shared bookkeeping ------------------------------------------------
+    def _require(self, params=True, optimizer=False, inputs_grad=False):
+        """One place for the bound/initialized preconditions the reference
+        re-asserts at the top of every method."""
+        assert self.binded, "call bind() first"
+        if params:
+            assert self.params_initialized, "call init_params() first"
+        if optimizer:
+            assert self.optimizer_initialized, "call init_optimizer() first"
+        if inputs_grad:
+            assert self.inputs_need_grad, \
+                "bind with inputs_need_grad=True to get input gradients"
+
+    def _eval_batches(self, eval_data, num_batch=None, reset=True):
+        """Yield (nbatch, batch, unpadded_outputs) over an iterator in
+        inference mode — the engine behind predict/iter_predict/score."""
+        self._require()
+        if reset:
+            eval_data.reset()
+        for nbatch, batch in enumerate(eval_data):
+            if num_batch is not None and nbatch >= num_batch:
+                return
+            self.forward(batch, is_train=False)
+            keep = None if not batch.pad else -batch.pad
+            yield nbatch, batch, [o[:keep] if keep else o
+                                  for o in self.get_outputs()]
+
     # -- high-level interface ----------------------------------------------
     def forward_backward(self, data_batch):
-        """forward + backward (reference base_module.py:189)."""
+        """One training forward+backward."""
         self.forward(data_batch, is_train=True)
         self.backward()
 
     def score(self, eval_data, eval_metric, num_batch=None,
               batch_end_callback=None, score_end_callback=None, reset=True,
               epoch=0):
-        """Evaluate on eval_data (reference base_module.py:score)."""
-        assert self.binded and self.params_initialized
-
-        if reset:
-            eval_data.reset()
-
-        if not isinstance(eval_metric, metric_mod.EvalMetric):
-            eval_metric = metric_mod.create(eval_metric)
-
+        """Run inference over eval_data, accumulating eval_metric."""
+        eval_metric = metric_mod.create(eval_metric) \
+            if not isinstance(eval_metric, metric_mod.EvalMetric) \
+            else eval_metric
         eval_metric.reset()
-        actual_num_batch = 0
 
-        for nbatch, eval_batch in enumerate(eval_data):
-            if num_batch is not None and nbatch == num_batch:
-                break
-
-            self.forward(eval_batch, is_train=False)
-            self.update_metric(eval_metric, eval_batch.label)
-
+        seen = 0
+        for nbatch, batch, _ in self._eval_batches(eval_data, num_batch,
+                                                   reset):
+            self.update_metric(eval_metric, batch.label)
+            seen = nbatch + 1
             if batch_end_callback is not None:
-                batch_end_params = BatchEndParam(epoch=epoch, nbatch=nbatch,
-                                                 eval_metric=eval_metric,
-                                                 locals=locals())
-                for callback in _as_list(batch_end_callback):
-                    callback(batch_end_params)
-            actual_num_batch += 1
-
-        if score_end_callback:
-            params = BatchEndParam(epoch=epoch, nbatch=actual_num_batch,
-                                   eval_metric=eval_metric, locals=locals())
-            for callback in _as_list(score_end_callback):
-                callback(params)
-
+                info = BatchEndParam(epoch=epoch, nbatch=nbatch,
+                                     eval_metric=eval_metric,
+                                     locals=locals())
+                for cb in _as_list(batch_end_callback):
+                    cb(info)
+        if score_end_callback is not None:
+            info = BatchEndParam(epoch=epoch, nbatch=seen,
+                                 eval_metric=eval_metric, locals=locals())
+            for cb in _as_list(score_end_callback):
+                cb(info)
         return eval_metric.get_name_value()
 
     def iter_predict(self, eval_data, num_batch=None, reset=True):
-        """Iterate over (pred, i_batch, batch) (reference
-        base_module.py:iter_predict)."""
-        assert self.binded and self.params_initialized
-
-        if reset:
-            eval_data.reset()
-
-        for nbatch, eval_batch in enumerate(eval_data):
-            if num_batch is not None and nbatch == num_batch:
-                break
-            self.forward(eval_batch, is_train=False)
-            pad = eval_batch.pad
-            outputs = [out[0:out.shape[0] - pad] for out in
-                       self.get_outputs()]
-            yield (outputs, nbatch, eval_batch)
+        """Yield (outputs, i_batch, batch) in inference mode."""
+        for nbatch, batch, outs in self._eval_batches(eval_data, num_batch,
+                                                      reset):
+            yield outs, nbatch, batch
 
     def predict(self, eval_data, num_batch=None, merge_batches=True,
                 reset=True, always_output_list=False):
-        """Run prediction, collecting outputs (reference
-        base_module.py:predict)."""
-        assert self.binded and self.params_initialized
+        """Collect predictions; merged across batches by default."""
+        from ..ndarray import array
 
-        if reset:
-            eval_data.reset()
+        collected = [outs for _, _, outs in
+                     self._eval_batches(eval_data, num_batch, reset)]
+        if not collected:
+            return collected
+        if not merge_batches:
+            return collected
 
-        output_list = []
-
-        for nbatch, eval_batch in enumerate(eval_data):
-            if num_batch is not None and nbatch == num_batch:
-                break
-            self.forward(eval_batch, is_train=False)
-            pad = eval_batch.pad
-            outputs = [out[0:out.shape[0] - pad].copy()
-                       for out in self.get_outputs()]
-            output_list.append(outputs)
-
-        if len(output_list) == 0:
-            return output_list
-
-        if merge_batches:
-            num_outputs = len(output_list[0])
-            for out in output_list:
-                assert len(out) == num_outputs, \
-                    "Cannot merge batches, as num of outputs is not the " \
-                    "same in mini-batches. Maybe bucketing is used?"
-            output_list2 = [
-                np.concatenate([out[i].asnumpy() for out in output_list])
-                for i in range(num_outputs)]
-            from ..ndarray import array
-            output_list2 = [array(x) for x in output_list2]
-            if num_outputs == 1 and not always_output_list:
-                return output_list2[0]
-            return output_list2
-
-        return output_list
+        width = {len(outs) for outs in collected}
+        assert len(width) == 1, \
+            "Cannot merge batches, as num of outputs is not the same " \
+            "in mini-batches. Maybe bucketing is used?"
+        merged = [array(np.concatenate([outs[i].asnumpy()
+                                        for outs in collected]))
+                  for i in range(width.pop())]
+        if len(merged) == 1 and not always_output_list:
+            return merged[0]
+        return merged
 
     def fit(self, train_data, eval_data=None, eval_metric="acc",
             epoch_end_callback=None, batch_end_callback=None,
@@ -190,7 +181,7 @@ class BaseModule:
             allow_missing=False, force_rebind=False, force_init=False,
             begin_epoch=0, num_epoch=None, validation_metric=None,
             monitor=None):
-        """The training loop (reference base_module.py:376-515)."""
+        """The training loop: bind, init, then per-epoch train+eval."""
         assert num_epoch is not None, "please specify number of epochs"
 
         self.bind(data_shapes=train_data.provide_data,
@@ -204,75 +195,61 @@ class BaseModule:
         self.init_optimizer(kvstore=kvstore, optimizer=optimizer,
                             optimizer_params=optimizer_params)
 
-        if validation_metric is None:
-            validation_metric = eval_metric
         if not isinstance(eval_metric, metric_mod.EvalMetric):
             eval_metric = metric_mod.create(eval_metric)
+        validation_metric = validation_metric or eval_metric
 
-        ################################################################
-        # training loop
-        ################################################################
         for epoch in range(begin_epoch, num_epoch):
             tic = time.time()
             eval_metric.reset()
-            nbatch = 0
-            data_iter = iter(train_data)
-            end_of_batch = False
-            next_data_batch = next(data_iter)
-            while not end_of_batch:
-                data_batch = next_data_batch
-                if monitor is not None:
-                    monitor.tic()
-                self.forward_backward(data_batch)
-                self.update()
-                try:
-                    # pre-fetch next batch: overlaps host IO with the
-                    # async device step (reference prefetch semantics)
-                    next_data_batch = next(data_iter)
-                    self.prepare(next_data_batch)
-                except StopIteration:
-                    end_of_batch = True
-
-                self.update_metric(eval_metric, data_batch.label)
-
-                if monitor is not None:
-                    monitor.toc_print()
-
-                if batch_end_callback is not None:
-                    batch_end_params = BatchEndParam(
-                        epoch=epoch, nbatch=nbatch,
-                        eval_metric=eval_metric, locals=locals())
-                    for callback in _as_list(batch_end_callback):
-                        callback(batch_end_params)
-                nbatch += 1
-
-            # one epoch of training is finished
+            self._fit_epoch(train_data, epoch, eval_metric,
+                            batch_end_callback, monitor)
             for name, val in eval_metric.get_name_value():
                 self.logger.info("Epoch[%d] Train-%s=%f", epoch, name, val)
-            toc = time.time()
-            self.logger.info("Epoch[%d] Time cost=%.3f", epoch, (toc - tic))
+            self.logger.info("Epoch[%d] Time cost=%.3f", epoch,
+                             time.time() - tic)
 
-            # sync aux params across devices
-            arg_params_, aux_params_ = self.get_params()
-            self.set_params(arg_params_, aux_params_)
+            # pull trained values host-side (also re-syncs aux stats)
+            arg_now, aux_now = self.get_params()
+            self.set_params(arg_now, aux_now)
+            for cb in _as_list(epoch_end_callback or []):
+                cb(epoch, self.symbol, arg_now, aux_now)
 
-            if epoch_end_callback is not None:
-                for callback in _as_list(epoch_end_callback):
-                    callback(epoch, self.symbol, arg_params_, aux_params_)
-
-            # ----------------------------------------
-            # evaluation on validation set
-            if eval_data:
-                res = self.score(eval_data, validation_metric,
-                                 score_end_callback=eval_end_callback,
-                                 batch_end_callback=eval_batch_end_callback,
-                                 epoch=epoch)
-                for name, val in res:
+            if eval_data is not None:
+                for name, val in self.score(
+                        eval_data, validation_metric, epoch=epoch,
+                        batch_end_callback=eval_batch_end_callback,
+                        score_end_callback=eval_end_callback):
                     self.logger.info("Epoch[%d] Validation-%s=%f", epoch,
                                      name, val)
-
-            # end of 1 epoch, reset the data-iter for another epoch
             train_data.reset()
+
+    def _fit_epoch(self, train_data, epoch, eval_metric,
+                   batch_end_callback, monitor):
+        """One epoch of the fit loop, with one-batch host prefetch so IO
+        overlaps the async device step."""
+        batches = iter(train_data)
+        pending = next(batches, None)
+        nbatch = 0
+        while pending is not None:
+            batch = pending
+            if monitor is not None:
+                monitor.tic()
+            self.forward_backward(batch)
+            self.update()
+            pending = next(batches, None)
+            if pending is not None:
+                self.prepare(pending)
+            self.update_metric(eval_metric, batch.label)
+            if monitor is not None:
+                monitor.toc_print()
+            if batch_end_callback is not None:
+                info = BatchEndParam(epoch=epoch, nbatch=nbatch,
+                                     eval_metric=eval_metric,
+                                     locals=locals())
+                for cb in _as_list(batch_end_callback):
+                    cb(info)
+            nbatch += 1
 
     # -- symbol/params accessors -------------------------------------------
     @property
@@ -283,60 +260,51 @@ class BaseModule:
         raise NotImplementedError()
 
     def init_params(self, initializer=Uniform(0.01), arg_params=None,
-                    aux_params=None, allow_missing=False, force_init=False,
-                    allow_extra=False):
+                    aux_params=None, allow_missing=False,
+                    force_init=False, allow_extra=False):
         raise NotImplementedError()
 
-    def set_params(self, arg_params, aux_params, allow_missing=False,
-                   force_init=True, allow_extra=False):
-        """Assign parameters (reference base_module.py:set_params)."""
+    def set_params(self, arg_params, aux_params,
+                   allow_missing=False, force_init=True,
+                   allow_extra=False):
+        """Assign parameter values (init_params with explicit sources)."""
         self.init_params(initializer=None, arg_params=arg_params,
                          aux_params=aux_params, allow_missing=allow_missing,
                          force_init=force_init, allow_extra=allow_extra)
 
     def save_params(self, fname):
-        """Save params to file (reference base_module.py:save_params)."""
-        from ..context import cpu
-        arg_params, aux_params = self.get_params()
-        save_dict = {("arg:%s" % k): v.as_in_context(cpu())
-                     for k, v in arg_params.items()}
-        save_dict.update({("aux:%s" % k): v for k, v in aux_params.items()})
+        """Write all parameters to an ndarray file with arg:/aux: tags."""
         from ..ndarray import save
-        save(fname, save_dict)
+        arg_params, aux_params = self.get_params()
+        blob = {"arg:" + k: v for k, v in arg_params.items()}
+        blob.update(("aux:" + k, v) for k, v in aux_params.items())
+        save(fname, blob)
 
     def load_params(self, fname):
-        """Load params from file (reference base_module.py:load_params)."""
+        """Read parameters written by save_params."""
         from ..ndarray import load
-        save_dict = load(fname)
-        arg_params = {}
-        aux_params = {}
-        for k, value in save_dict.items():
-            arg_type, name = k.split(":", 1)
-            if arg_type == "arg":
-                arg_params[name] = value
-            elif arg_type == "aux":
-                aux_params[name] = value
-            else:
+        groups = {"arg": {}, "aux": {}}
+        for key, value in load(fname).items():
+            kind, _, name = key.partition(":")
+            if kind not in groups or not name:
                 raise ValueError("Invalid param file " + fname)
-        self.set_params(arg_params, aux_params)
+            groups[kind][name] = value
+        self.set_params(groups["arg"], groups["aux"])
 
     def get_states(self, merge_multi_context=True):
-        """States of stateful modules (RNN hidden); default none
-        (reference base_module.py:get_states)."""
-        assert self.binded and self.params_initialized
-        assert not merge_multi_context or True
+        """Stateful-module states (RNN hidden); none by default."""
+        self._require()
         return []
 
     def set_states(self, states=None, value=None):
-        assert self.binded and self.params_initialized
+        self._require()
         assert not states and not value
 
     def install_monitor(self, mon):
         raise NotImplementedError()
 
     def prepare(self, data_batch):
-        """Prepare for processing a data batch (default no-op; reference
-        base_module.py:prepare)."""
+        """Hook called on the upcoming batch (default no-op)."""
 
     # -- computation interface ---------------------------------------------
     def forward(self, data_batch, is_train=None):
@@ -345,10 +313,10 @@ class BaseModule:
     def backward(self, out_grads=None):
         raise NotImplementedError()
 
-    def get_outputs(self, merge_multi_context=True):
+    def get_outputs(self, merge_multi_context=True):  # noqa: D102
         raise NotImplementedError()
 
-    def get_input_grads(self, merge_multi_context=True):
+    def get_input_grads(self, merge_multi_context=True):  # noqa: D102
         raise NotImplementedError()
 
     def update(self):
@@ -359,13 +327,13 @@ class BaseModule:
 
     # -- bind/optimizer ----------------------------------------------------
     def bind(self, data_shapes, label_shapes=None, for_training=True,
-             inputs_need_grad=False, force_rebind=False, shared_module=None,
-             grad_req="write"):
+             inputs_need_grad=False, force_rebind=False,
+             shared_module=None, grad_req="write"):
         raise NotImplementedError()
 
     def init_optimizer(self, kvstore="local", optimizer="sgd",
-                       optimizer_params=(("learning_rate", 0.01),),
-                       force_init=False):
+                       optimizer_params=(("learning_rate",
+                                          0.01),), force_init=False):
         raise NotImplementedError()
 
     # -- shapes ------------------------------------------------------------
@@ -388,3 +356,61 @@ class BaseModule:
     @property
     def output_shapes(self):
         raise NotImplementedError()
+
+
+class DelegatingModule(BaseModule):
+    """Base for modules that steer one active inner module (bucketing).
+
+    The whole computation interface forwards to `_active_module()`;
+    subclasses manage which module is active and how parameters move
+    between them."""
+
+    def _active_module(self):
+        raise NotImplementedError()
+
+    def forward(self, data_batch, is_train=None):
+        self._require()
+        self._active_module().forward(data_batch, is_train=is_train)
+
+    def backward(self, out_grads=None):
+        self._require()
+        self._active_module().backward(out_grads=out_grads)
+
+    def update(self):
+        self._require(optimizer=True)
+        self._active_module().update()
+
+    def get_outputs(self, merge_multi_context=True):  # noqa: D102
+        self._require()
+        return self._active_module().get_outputs(merge_multi_context)
+
+    def get_input_grads(self, merge_multi_context=True):  # noqa: D102
+        self._require(inputs_grad=True)
+        return self._active_module().get_input_grads(merge_multi_context)
+
+    def get_states(self, merge_multi_context=True):
+        self._require()
+        return self._active_module().get_states(merge_multi_context)
+
+    def set_states(self, states=None, value=None):
+        self._require()
+        self._active_module().set_states(states, value)
+
+    def update_metric(self, eval_metric, labels):
+        self._require()
+        self._active_module().update_metric(eval_metric, labels)
+
+    @property
+    def data_shapes(self):
+        assert self.binded
+        return self._active_module().data_shapes
+
+    @property
+    def label_shapes(self):
+        assert self.binded
+        return self._active_module().label_shapes
+
+    @property
+    def output_shapes(self):
+        assert self.binded
+        return self._active_module().output_shapes

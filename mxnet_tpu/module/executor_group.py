@@ -173,7 +173,8 @@ class DataParallelExecutorGroup:
 
         executor = Executor(self.symbol, ctx=self.contexts[0],
                             args=[args[n] for n in self.arg_names],
-                            grad_req=self.grad_req, aux_states=aux)
+                            grad_req=self.grad_req, aux_states=aux,
+                            mesh=self._mesh)
         self.execs = [executor]
 
         # views, kept in reference shapes: list (over params) of list

@@ -1,5 +1,12 @@
-"""Module — symbolic training over a data-parallel executor group
-(reference: python/mxnet/module/module.py, 635 LoC)."""
+"""Module — symbolic training on one sharded executor.
+
+Capability parity with the reference Module (python/mxnet/module/module.py):
+bind/init_params/init_optimizer/forward/backward/update plus checkpointing.
+Re-derived for this framework's design: there is a single GSPMD-sharded
+executor rather than per-device executor copies, so the update path never
+slices or reduces in Python — grads come out of the executor already
+mesh-reduced and the kvstore step is a pure optimizer application.
+"""
 from __future__ import annotations
 
 import logging
@@ -7,20 +14,17 @@ import warnings
 
 from .. import context as ctx_mod
 from .. import optimizer as opt
-from ..base import MXNetError, string_types, _as_list
-from ..context import Context, cpu
+from ..context import Context
 from ..initializer import Uniform, InitDesc
-from ..model import (BatchEndParam, _create_kvstore, _initialize_kvstore,
-                     _update_params, _update_params_on_kvstore,
-                     load_checkpoint, save_checkpoint)
-from ..ndarray import NDArray, zeros
+from ..model import (_create_kvstore, _initialize_kvstore, _update_params,
+                     _update_params_on_kvstore, load_checkpoint,
+                     save_checkpoint)
 from .base_module import BaseModule, _check_input_names, _parse_data_desc
 from .executor_group import DataParallelExecutorGroup
 
 
 class Module(BaseModule):
-    """Intermediate-level module wrapping a Symbol (reference
-    module.py:Module)."""
+    """Intermediate-level module wrapping a Symbol."""
 
     def __init__(self, symbol, data_names=("data",),
                  label_names=("softmax_label",), logger=logging,
@@ -28,196 +32,144 @@ class Module(BaseModule):
                  state_names=None):
         super().__init__(logger=logger)
 
-        if context is None:
-            context = ctx_mod.current_context()
-        if isinstance(context, Context):
-            context = [context]
-        self._context = context
-        if work_load_list is None:
-            work_load_list = [1] * len(self._context)
-        assert len(work_load_list) == len(self._context)
-        self._work_load_list = work_load_list
+        ctxs = context if context is not None else ctx_mod.current_context()
+        self._context = [ctxs] if isinstance(ctxs, Context) else list(ctxs)
+        self._work_load_list = (list(work_load_list) if work_load_list
+                                else [1] * len(self._context))
+        assert len(self._work_load_list) == len(self._context)
 
         self._symbol = symbol
+        names = {
+            "data": list(data_names or []),
+            "label": list(label_names or []),
+            "state": list(state_names or []),
+            "fixed_param": list(fixed_param_names or []),
+        }
+        for kind, ns in names.items():
+            _check_input_names(symbol, ns, kind, throw=(kind != "label"))
 
-        data_names = list(data_names) if data_names is not None else []
-        label_names = list(label_names) if label_names is not None else []
-        state_names = list(state_names) if state_names is not None else []
-        fixed_param_names = list(fixed_param_names) \
-            if fixed_param_names is not None else []
-
-        _check_input_names(symbol, data_names, "data", True)
-        _check_input_names(symbol, label_names, "label", False)
-        _check_input_names(symbol, state_names, "state", True)
-        _check_input_names(symbol, fixed_param_names, "fixed_param", True)
-
-        arg_names = symbol.list_arguments()
-        input_names = data_names + label_names + state_names
-        self._param_names = [x for x in arg_names if x not in input_names]
-        self._fixed_param_names = fixed_param_names
+        self._data_names = names["data"]
+        self._label_names = names["label"]
+        self._state_names = names["state"]
+        self._fixed_param_names = names["fixed_param"]
         self._aux_names = symbol.list_auxiliary_states()
-        self._data_names = data_names
-        self._label_names = label_names
-        self._state_names = state_names
         self._output_names = symbol.list_outputs()
+        inputs = set(self._data_names + self._label_names +
+                     self._state_names)
+        self._param_names = [a for a in symbol.list_arguments()
+                             if a not in inputs]
 
-        self._arg_params = None
-        self._aux_params = None
+        # host param copies / optimizer plumbing / bound-executor state,
+        # all unset until init_params / init_optimizer / bind
+        for attr in ("_arg_params", "_aux_params", "_optimizer",
+                     "_kvstore", "_update_on_kvstore", "_updater",
+                     "_preload_opt_states", "_grad_req", "_exec_group",
+                     "_data_shapes", "_label_shapes"):
+            setattr(self, attr, None)
         self._params_dirty = False
 
-        self._optimizer = None
-        self._kvstore = None
-        self._update_on_kvstore = None
-        self._updater = None
-        self._preload_opt_states = None
-        self._grad_req = None
-
-        self._exec_group = None
-        self._data_shapes = None
-        self._label_shapes = None
-
+    # -- checkpointing -----------------------------------------------------
     @staticmethod
     def load(prefix, epoch, load_optimizer_states=False, **kwargs):
-        """Create a module from a saved checkpoint (reference
-        module.py:load)."""
-        sym, args, auxs = load_checkpoint(prefix, epoch)
-        mod = Module(symbol=sym, **kwargs)
-        mod._arg_params = args
-        mod._aux_params = auxs
-        mod.params_initialized = True
+        """Rebuild a Module from prefix-symbol.json + prefix-NNNN.params."""
+        loaded_sym, arg_params, aux_params = load_checkpoint(prefix, epoch)
+        module = Module(symbol=loaded_sym, **kwargs)
+        module._arg_params, module._aux_params = arg_params, aux_params
+        module.params_initialized = True
         if load_optimizer_states:
-            mod._preload_opt_states = "%s-%04d.states" % (prefix, epoch)
-        return mod
+            module._preload_opt_states = "%s-%04d.states" % (prefix, epoch)
+        return module
 
     def save_checkpoint(self, prefix, epoch, save_optimizer_states=False):
-        """Save symbol + params (+ optimizer states) (reference
-        module.py:save_checkpoint)."""
-        self._symbol.save("%s-symbol.json" % prefix)
-        param_name = "%s-%04d.params" % (prefix, epoch)
-        self.save_params(param_name)
-        logging.info("Saved checkpoint to \"%s\"", param_name)
+        """Write symbol JSON + params (+ optimizer states)."""
+        arg_params, aux_params = self.get_params()
+        save_checkpoint(prefix, epoch, self._symbol, arg_params, aux_params)
         if save_optimizer_states:
             state_name = "%s-%04d.states" % (prefix, epoch)
             self.save_optimizer_states(state_name)
             logging.info("Saved optimizer state to \"%s\"", state_name)
 
-    def _reset_bind(self):
-        self.binded = False
-        self._exec_group = None
-        self._data_shapes = None
-        self._label_shapes = None
-
-    @property
-    def data_names(self):
-        return self._data_names
-
-    @property
-    def label_names(self):
-        return self._label_names
-
-    @property
-    def output_names(self):
-        return self._output_names
-
-    @property
-    def data_shapes(self):
-        assert self.binded
-        return self._data_shapes
-
-    @property
-    def label_shapes(self):
-        assert self.binded
-        return self._label_shapes
-
+    # -- shape surface (simple accessors defined after the class body) ----
     @property
     def output_shapes(self):
         assert self.binded
-        return [(name, tuple(o.shape)) for name, o in
-                zip(self._output_names,
-                    self._exec_group.execs[0].outputs)] \
-            if self._exec_group.execs[0].outputs else \
-            self._infer_output_shapes()
-
-    def _infer_output_shapes(self):
-        input_shapes = {d.name: d.shape for d in self._data_shapes}
-        if self._label_shapes:
-            input_shapes.update({l.name: l.shape
-                                 for l in self._label_shapes})
-        _, out_shapes, _ = self._symbol.infer_shape(**input_shapes)
+        exe = self._exec_group.execs[0]
+        if exe.outputs:
+            return [(n, tuple(o.shape))
+                    for n, o in zip(self._output_names, exe.outputs)]
+        feed = {d.name: d.shape for d in self._data_shapes}
+        for l in self._label_shapes or []:
+            feed[l.name] = l.shape
+        _, out_shapes, _ = self._symbol.infer_shape(**feed)
         return list(zip(self._output_names, out_shapes))
 
     # -- parameters --------------------------------------------------------
     def get_params(self):
-        """(arg_params, aux_params) synced from the device (reference
-        module.py:get_params)."""
-        assert self.binded and self.params_initialized
+        """Host-synced (arg_params, aux_params)."""
+        self._require()
         if self._params_dirty:
             self._sync_params_from_devices()
-        return (self._arg_params, self._aux_params)
+        return self._arg_params, self._aux_params
 
     def init_params(self, initializer=Uniform(0.01), arg_params=None,
-                    aux_params=None, allow_missing=False, force_init=False,
-                    allow_extra=False):
-        """Initialize parameters (reference module.py:init_params)."""
-        if self.params_initialized and not force_init:
-            warnings.warn("Parameters already initialized and force_init=False. "
-                          "init_params call ignored.", stacklevel=2)
+                    aux_params=None, allow_missing=False,
+                    force_init=False, allow_extra=False):
+        """Fill parameters from given dicts and/or the initializer, then
+        push them to the executor."""
+        if not force_init and self.params_initialized:
+            warnings.warn("Parameters already initialized and "
+                          "force_init=False. init_params call ignored.",
+                          stacklevel=2)
             return
         assert self.binded, "call bind before initializing the parameters"
 
         if self._arg_params is None:
-            param_arrays = [x[0] for x in self._exec_group.param_arrays]
-            self._arg_params = {name: arr.copy() for name, arr in
-                                zip(self._param_names, param_arrays)}
+            self._arg_params = {n: vals[0].copy() for n, vals in
+                                zip(self._param_names,
+                                    self._exec_group.param_arrays)}
         if self._aux_params is None:
-            aux_arrays = [x[0] for x in self._exec_group.aux_arrays]
-            self._aux_params = {name: arr.copy() for name, arr in
-                                zip(self._aux_names, aux_arrays)}
+            self._aux_params = {n: vals[0].copy() for n, vals in
+                                zip(self._aux_names,
+                                    self._exec_group.aux_arrays)}
 
         attrs = self._symbol.attr_dict()
 
-        def _impl(name, arr, cache):
-            """Internal helper for parameter initialization."""
-            if cache is not None:
-                if name in cache:
-                    cache_arr = cache[name]
-                    if cache_arr is not arr:
-                        cache_arr.copyto(arr)
-                else:
-                    if not allow_missing:
-                        raise RuntimeError("%s is not presented" % name)
-                    if initializer is not None:
-                        initializer(InitDesc(name, attrs.get(name, {})), arr)
-            else:
-                if initializer is not None:
+        def fill(target, source):
+            for name in sorted(target):
+                arr = target[name]
+                given = None if source is None else source.get(name)
+                if given is not None:
+                    if given is not arr:
+                        given.copyto(arr)
+                elif source is not None and not allow_missing:
+                    raise RuntimeError("%s is not presented" % name)
+                elif initializer is not None:
                     initializer(InitDesc(name, attrs.get(name, {})), arr)
 
-        for name, arr in sorted(self._arg_params.items()):
-            desc = InitDesc(name, attrs.get(name, {}))
-            _impl(desc, arr, arg_params)
-
-        for name, arr in sorted(self._aux_params.items()):
-            desc = InitDesc(name, attrs.get(name, {}))
-            _impl(desc, arr, aux_params)
+        fill(self._arg_params, arg_params)
+        fill(self._aux_params, aux_params)
 
         self.params_initialized = True
         self._params_dirty = False
         self._exec_group.set_params(self._arg_params, self._aux_params,
                                     allow_extra=allow_extra)
 
-    def set_params(self, arg_params, aux_params, allow_missing=False,
-                   force_init=True, allow_extra=False):
-        """Assign parameter/aux values (reference module.py:set_params)."""
+    def set_params(self, arg_params, aux_params,
+                   allow_missing=False, force_init=True,
+                   allow_extra=False):
+        """Assign parameter values directly."""
         if not allow_missing:
             self.init_params(initializer=None, arg_params=arg_params,
-                             aux_params=aux_params,
-                             allow_missing=allow_missing,
+                             aux_params=aux_params, allow_missing=False,
                              force_init=force_init, allow_extra=allow_extra)
             return
-        if self.params_initialized and not force_init:
-            warnings.warn("Parameters already initialized and force_init=False. "
-                          "set_params call ignored.", stacklevel=2)
+        if not force_init and self.params_initialized:
+            warnings.warn("Parameters already initialized and "
+                          "force_init=False. set_params call ignored.",
+                          stacklevel=2)
             return
+        # partial assignment straight to the device copies; host dicts are
+        # stale until the next get_params sync
         self._exec_group.set_params(arg_params, aux_params,
                                     allow_extra=allow_extra)
         self._params_dirty = True
@@ -225,33 +177,30 @@ class Module(BaseModule):
 
     # -- bind --------------------------------------------------------------
     def bind(self, data_shapes, label_shapes=None, for_training=True,
-             inputs_need_grad=False, force_rebind=False, shared_module=None,
-             grad_req="write"):
-        """Bind executors (reference module.py:bind, :351)."""
+             inputs_need_grad=False, force_rebind=False,
+             shared_module=None, grad_req="write"):
+        """Create the sharded executor group for the given shapes."""
         if force_rebind:
             self._reset_bind()
-
         if self.binded:
             self.logger.warning("Already bound, ignoring bind()")
             return
-
-        self.for_training = for_training
-        self.inputs_need_grad = inputs_need_grad
-        self.binded = True
-        self._grad_req = grad_req
-
         if not for_training:
             assert not inputs_need_grad
+
+        self.for_training, self.inputs_need_grad = \
+            for_training, inputs_need_grad
+        self._grad_req = grad_req
+        self.binded = True
 
         self._data_shapes, self._label_shapes = _parse_data_desc(
             self.data_names, self.label_names, data_shapes, label_shapes)
 
+        shared_group = None
         if shared_module is not None:
             assert isinstance(shared_module, Module) and \
                 shared_module.binded and shared_module.params_initialized
             shared_group = shared_module._exec_group
-        else:
-            shared_group = None
 
         self._exec_group = DataParallelExecutorGroup(
             self._symbol, self._context, self._work_load_list,
@@ -262,67 +211,66 @@ class Module(BaseModule):
         self._total_exec_bytes = self._exec_group._total_exec_bytes
 
         if shared_module is not None:
-            self.params_initialized = True
+            # bucketing: all buckets view one parameter set
             self._arg_params = shared_module._arg_params
             self._aux_params = shared_module._aux_params
+            self.params_initialized = True
+            if shared_module.optimizer_initialized:
+                self.borrow_optimizer(shared_module)
         elif self.params_initialized:
-            # if the parameters are already initialized, we are re-binding
-            # so automatically copy the already initialized params
+            # re-bind of a trained module: push existing values down
             self._exec_group.set_params(self._arg_params, self._aux_params)
-        else:
-            assert self._arg_params is None and self._aux_params is None
 
-        if shared_module is not None and shared_module.optimizer_initialized:
-            self.borrow_optimizer(shared_module)
+    def _reset_bind(self):
+        self.binded = False
+        self._exec_group = None
+        self._data_shapes = None
+        self._label_shapes = None
 
     def reshape(self, data_shapes, label_shapes=None):
-        """Reshape for new batch shapes (reference module.py:reshape)."""
-        assert self.binded
+        """Re-bind the executor for new batch shapes (new jit
+        specialization; parameters are carried over)."""
+        self._require(params=False)
         self._data_shapes, self._label_shapes = _parse_data_desc(
             self.data_names, self.label_names, data_shapes, label_shapes)
         self._exec_group.reshape(self._data_shapes, self._label_shapes)
 
     # -- optimizer ---------------------------------------------------------
     def init_optimizer(self, kvstore="local", optimizer="sgd",
-                       optimizer_params=(("learning_rate", 0.01),),
-                       force_init=False):
-        """Install optimizer + kvstore (reference module.py:460)."""
-        assert self.binded and self.params_initialized
-
-        if self.optimizer_initialized and not force_init:
+                       optimizer_params=(("learning_rate",
+                                          0.01),), force_init=False):
+        """Create the optimizer + kvstore pair for update()."""
+        self._require()
+        if not force_init and self.optimizer_initialized:
             self.logger.warning("optimizer already initialized, ignoring...")
             return
-
         if self._params_dirty:
             self._sync_params_from_devices()
 
-        (kvstore, update_on_kvstore) = _create_kvstore(
+        kvstore, update_on_kvstore = _create_kvstore(
             kvstore, len(self._context), self._arg_params)
 
-        batch_size = self._exec_group.batch_size
-        if kvstore and "dist" in kvstore.type and \
-                "_sync" in kvstore.type:
-            batch_size *= kvstore.num_workers
-        rescale_grad = 1.0 / batch_size
+        # reference convention: grads are rescaled by the global batch size
+        global_batch = self._exec_group.batch_size
+        if kvstore and "dist" in kvstore.type and "_sync" in kvstore.type:
+            global_batch *= kvstore.num_workers
 
         if isinstance(optimizer, str):
-            # single sharded executor: the idx->name mapping is the same
-            # for both update paths
-            idx2name = dict(enumerate(self._exec_group.param_names))
-            optimizer_params = dict(optimizer_params)
-            if "rescale_grad" not in optimizer_params:
-                optimizer_params["rescale_grad"] = rescale_grad
-            optimizer = opt.create(optimizer, sym=self.symbol,
-                                   param_idx2name=idx2name,
-                                   **optimizer_params)
+            settings = dict(optimizer_params)
+            settings.setdefault("rescale_grad", 1.0 / global_batch)
+            optimizer = opt.create(
+                optimizer, sym=self.symbol,
+                param_idx2name=dict(enumerate(self._param_names)),
+                **settings)
         else:
             assert isinstance(optimizer, opt.Optimizer)
-            if optimizer.rescale_grad != rescale_grad:
+            if optimizer.rescale_grad != 1.0 / global_batch:
                 warnings.warn(
                     "Optimizer created manually outside Module but "
                     "rescale_grad is not normalized to 1.0/batch_size/"
                     "num_workers (%s vs. %s). Is this intended?"
-                    % (optimizer.rescale_grad, rescale_grad), stacklevel=2)
+                    % (optimizer.rescale_grad, 1.0 / global_batch),
+                    stacklevel=2)
 
         self._optimizer = optimizer
         self._kvstore = kvstore
@@ -330,7 +278,6 @@ class Module(BaseModule):
         self._updater = None
 
         if kvstore:
-            # copy initialized local parameters to kvstore
             _initialize_kvstore(kvstore=kvstore,
                                 param_arrays=self._exec_group.param_arrays,
                                 arg_params=self._arg_params,
@@ -340,7 +287,6 @@ class Module(BaseModule):
             kvstore.set_optimizer(self._optimizer)
         else:
             self._updater = opt.get_updater(optimizer)
-
         self.optimizer_initialized = True
 
         if self._preload_opt_states is not None:
@@ -348,121 +294,123 @@ class Module(BaseModule):
             self._preload_opt_states = None
 
     def borrow_optimizer(self, shared_module):
-        """Borrow optimizer from a shared module (reference
-        module.py:borrow_optimizer)."""
+        """Share the optimizer of another module (bucketing)."""
         assert shared_module.optimizer_initialized
-        self._optimizer = shared_module._optimizer
-        self._kvstore = shared_module._kvstore
-        self._update_on_kvstore = shared_module._update_on_kvstore
-        self._updater = shared_module._updater
+        for attr in ("_optimizer", "_kvstore", "_update_on_kvstore",
+                     "_updater"):
+            setattr(self, attr, getattr(shared_module, attr))
         self.optimizer_initialized = True
 
     # -- compute -----------------------------------------------------------
     def forward(self, data_batch, is_train=None):
-        """Forward (reference module.py:forward). Reshapes on batch-shape
-        change like the reference (new jit specialization per shape)."""
-        assert self.binded and self.params_initialized
+        """Run forward; transparently re-binds if the incoming batch has a
+        new shape (new jit specialization, like the reference's reshape)."""
+        self._require()
 
-        curr_data_shapes = tuple(i.shape for i in self._data_shapes)
-        new_data_shapes = tuple(i.shape for i in data_batch.data)
-        if curr_data_shapes != new_data_shapes:
-            if hasattr(data_batch, "provide_data") and \
-                    data_batch.provide_data:
-                new_dshape = data_batch.provide_data
-            else:
-                new_dshape = [
-                    type(i)(i.name, new_data_shapes[k])
-                    if hasattr(i, "name") else (i[0], new_data_shapes[k])
-                    for k, i in enumerate(self._data_shapes)]
-            if hasattr(data_batch, "provide_label") and \
-                    data_batch.provide_label:
-                new_lshape = data_batch.provide_label
-            elif hasattr(data_batch, "label") and data_batch.label:
-                new_lshape = [
-                    type(i)(i.name, data_batch.label[k].shape)
-                    if hasattr(i, "name")
-                    else (i[0], data_batch.label[k].shape)
-                    for k, i in enumerate(self._label_shapes)]
-            else:
-                new_lshape = None
-            self.reshape(new_dshape, new_lshape)
-
+        bound = tuple(d.shape for d in self._data_shapes)
+        incoming = tuple(arr.shape for arr in data_batch.data)
+        if bound != incoming:
+            self.reshape(*self._shapes_of(data_batch, incoming))
         self._exec_group.forward(data_batch, is_train)
 
+    def _shapes_of(self, data_batch, incoming):
+        """Derive (data_shapes, label_shapes) for a shape-changing batch."""
+        if getattr(data_batch, "provide_data", None):
+            dshapes = data_batch.provide_data
+        else:
+            dshapes = [(d.name, shp) for d, shp in
+                       zip(self._data_shapes, incoming)]
+        if getattr(data_batch, "provide_label", None):
+            lshapes = data_batch.provide_label
+        elif getattr(data_batch, "label", None):
+            lshapes = [(l.name, arr.shape) for l, arr in
+                       zip(self._label_shapes, data_batch.label)]
+        else:
+            lshapes = None
+        return dshapes, lshapes
+
     def backward(self, out_grads=None):
-        """Backward (reference module.py:backward)."""
-        assert self.binded and self.params_initialized
+        self._require()
         self._exec_group.backward(out_grads=out_grads)
 
     def update(self):
-        """Apply optimizer to gradients (reference module.py:615)."""
-        assert self.binded and self.params_initialized and \
-            self.optimizer_initialized
-
+        """Apply the optimizer to the mesh-reduced gradients."""
+        self._require(optimizer=True)
         self._params_dirty = True
         if self._update_on_kvstore:
             _update_params_on_kvstore(self._exec_group.param_arrays,
                                       self._exec_group.grad_arrays,
                                       self._kvstore,
-                                      self._exec_group.param_names)
+                                      self._param_names)
         else:
             _update_params(self._exec_group.param_arrays,
                            self._exec_group.grad_arrays,
                            updater=self._updater,
                            num_device=1,  # grads already mesh-reduced
                            kvstore=self._kvstore,
-                           param_names=self._exec_group.param_names)
+                           param_names=self._param_names)
 
-    def get_outputs(self, merge_multi_context=True):
-        assert self.binded and self.params_initialized
+    def get_outputs(self, merge_multi_context=True):  # noqa: D102
+        self._require()
         return self._exec_group.get_outputs(merge_multi_context)
 
-    def get_input_grads(self, merge_multi_context=True):
-        assert self.binded and self.params_initialized and \
-            self.inputs_need_grad
+    def get_input_grads(self, merge_multi_context=True):  # noqa: D102
+        self._require(inputs_grad=True)
         return self._exec_group.get_input_grads(merge_multi_context)
 
     def get_states(self, merge_multi_context=True):
-        assert self.binded and self.params_initialized
+        self._require()
         return self._exec_group.get_states(merge_multi_context)
 
     def set_states(self, states=None, value=None):
-        assert self.binded and self.params_initialized
+        self._require()
         self._exec_group.set_states(states, value)
 
     def update_metric(self, eval_metric, labels):
         self._exec_group.update_metric(eval_metric, labels)
 
     def _sync_params_from_devices(self):
-        """Pull current device params into _arg/_aux_params (reference
-        module.py:_sync_params_from_devices)."""
         self._exec_group.get_params(self._arg_params, self._aux_params)
         self._params_dirty = False
 
+    # -- optimizer state io ------------------------------------------------
     def save_optimizer_states(self, fname):
-        """Save optimizer (updater) state (reference
-        module.py:save_optimizer_states)."""
-        assert self.optimizer_initialized
-        if self._update_on_kvstore:
-            self._kvstore.save_optimizer_states(fname)
-        else:
-            with open(fname, "wb") as fout:
-                fout.write(self._updater.get_states())
+        self._opt_state_io(fname, save=True)
 
     def load_optimizer_states(self, fname):
-        """Load optimizer (updater) state (reference
-        module.py:load_optimizer_states)."""
+        self._opt_state_io(fname, save=False)
+
+    def _opt_state_io(self, fname, save):
         assert self.optimizer_initialized
         if self._update_on_kvstore:
-            self._kvstore.load_optimizer_states(fname)
+            method = (self._kvstore.save_optimizer_states if save
+                      else self._kvstore.load_optimizer_states)
+            method(fname)
+        elif save:
+            with open(fname, "wb") as fout:
+                fout.write(self._updater.get_states())
         else:
             with open(fname, "rb") as fin:
                 self._updater.set_states(fin.read())
 
     def install_monitor(self, mon):
-        assert self.binded
+        self._require(params=False)
         self._exec_group.install_monitor(mon)
 
     def prepare(self, data_batch):
-        """No-op; jit specializations handle shape changes (reference
-        module.py:prepare)."""
+        """No-op: jit specializations are created on demand in forward."""
+
+
+def _view(attr, needs_bind=False):
+    def get(self):
+        if needs_bind:
+            assert self.binded
+        return getattr(self, attr)
+    return property(get)
+
+
+Module.data_names = _view("_data_names")
+Module.label_names = _view("_label_names")
+Module.output_names = _view("_output_names")
+Module.data_shapes = _view("_data_shapes", needs_bind=True)
+Module.label_shapes = _view("_label_shapes", needs_bind=True)

@@ -1,10 +1,13 @@
-"""PythonModule — modules implemented directly in Python, bypassing the
-symbolic executor (reference: python/mxnet/module/python_module.py)."""
+"""PythonModule — modules written directly in Python, no symbolic graph.
+
+Capability parity with the reference PythonModule/PythonLossModule
+(python/mxnet/module/python_module.py): a BaseModule subclass whose
+forward/backward the user supplies in numpy/NDArray code, used for custom
+loss heads and glue stages inside SequentialModule chains.
+"""
 from __future__ import annotations
 
 import logging
-
-import numpy as np
 
 from .. import ndarray as nd
 from ..initializer import Uniform
@@ -12,153 +15,124 @@ from .base_module import BaseModule
 
 
 class PythonModule(BaseModule):
-    """Subclass-friendly module with trivial/no parameters (reference
-    python_module.py:PythonModule)."""
+    """A module with no (or externally-managed) parameters whose compute
+    is plain Python. Subclasses override forward/backward and
+    _compute_output_shapes."""
 
-    def __init__(self, data_names, label_names, output_names, logger=logging):
+    def __init__(self, data_names, label_names, output_names,
+                 logger=logging):
         super().__init__(logger=logger)
-
-        if isinstance(data_names, tuple):
-            data_names = list(data_names)
-        if isinstance(label_names, tuple):
-            label_names = list(label_names)
-
-        self._data_names = data_names
-        self._label_names = label_names
+        self._data_names = list(data_names)
+        self._label_names = list(label_names) \
+            if label_names is not None else None
         self._output_names = output_names
+        self._data_shapes = self._label_shapes = self._output_shapes = None
 
-        self._data_shapes = None
-        self._label_shapes = None
-        self._output_shapes = None
+    # read-only views over the recorded names/shapes (defined after the
+    # class body; the surface matches BaseModule's abstract properties)
 
-    @property
-    def data_names(self):
-        return self._data_names
-
-    @property
-    def output_names(self):
-        return self._output_names
-
-    @property
-    def data_shapes(self):
-        return self._data_shapes
-
-    @property
-    def label_shapes(self):
-        return self._label_shapes
-
-    @property
-    def output_shapes(self):
-        return self._output_shapes
-
-    # -- parameters: empty by default --------------------------------------
+    # -- parameters: none --------------------------------------------------
     def get_params(self):
-        return (dict(), dict())
+        return {}, {}
 
     def init_params(self, initializer=Uniform(0.01), arg_params=None,
-                    aux_params=None, allow_missing=False, force_init=False,
-                    allow_extra=False):
+                    aux_params=None, allow_missing=False,
+                    force_init=False, allow_extra=False):
         self.params_initialized = True
 
+    def init_optimizer(self, kvstore="local", optimizer="sgd",
+                       optimizer_params=(("learning_rate",
+                                          0.01),), force_init=False):
+        """Nothing to optimize by default."""
+        self.optimizer_initialized = True
+
     def update(self):
-        pass
+        """No parameters, no update."""
 
     def update_metric(self, eval_metric, labels):
-        if self._label_shapes is None:
-            # since we do not need labels, we are probably not a loss
-            # module
-            return
-        eval_metric.update(labels, self.get_outputs())
+        """Only meaningful when this module consumes labels (i.e. is a
+        loss stage)."""
+        if self._label_shapes is not None:
+            eval_metric.update(labels, self.get_outputs())
 
+    # -- bind --------------------------------------------------------------
     def bind(self, data_shapes, label_shapes=None, for_training=True,
-             inputs_need_grad=False, force_rebind=False, shared_module=None,
-             grad_req="write"):
-        """Bind: record shapes, compute output shapes (reference
-        python_module.py:bind)."""
+             inputs_need_grad=False, force_rebind=False,
+             shared_module=None, grad_req="write"):
+        """Record shapes and derive output shapes; no executor needed."""
         if self.binded and not force_rebind:
             self.logger.warning("Already bound, ignoring bind()")
             return
-
-        self.for_training = for_training
-        self.inputs_need_grad = inputs_need_grad
-
         assert grad_req == "write"
-
-        self._data_shapes = data_shapes
-        self._label_shapes = label_shapes
+        self.for_training, self.inputs_need_grad = \
+            for_training, inputs_need_grad
+        self._data_shapes, self._label_shapes = data_shapes, label_shapes
         self._output_shapes = self._compute_output_shapes()
         self.binded = True
 
     def _compute_output_shapes(self):
         raise NotImplementedError()
 
-    def init_optimizer(self, kvstore="local", optimizer="sgd",
-                       optimizer_params=(("learning_rate", 0.01),),
-                       force_init=False):
-        pass
-
     def install_monitor(self, mon):
-        pass
+        """Nothing to monitor by default."""
+
+
+for _pub, _priv in (("data_names", "_data_names"),
+                    ("output_names", "_output_names"),
+                    ("data_shapes", "_data_shapes"),
+                    ("label_shapes", "_label_shapes"),
+                    ("output_shapes", "_output_shapes")):
+    setattr(PythonModule, _pub,
+            property(lambda self, a=_priv: getattr(self, a)))
 
 
 class PythonLossModule(PythonModule):
-    """A convenient module for custom loss heads (reference
-    python_module.py:PythonLossModule)."""
+    """A pass-through loss head: forward stores the incoming scores, and
+    backward produces d(loss)/d(scores) via a user grad_func."""
 
     def __init__(self, name="pyloss", data_names=("data",),
                  label_names=("softmax_label",), logger=logging,
                  grad_func=None):
+        assert len(data_names) == 1 and len(label_names) == 1
         super().__init__(data_names, label_names, [name + "_output"],
                          logger=logger)
         self._name = name
-        assert len(data_names) == 1
-        assert len(label_names) == 1
-
-        self._scores = None
-        self._labels = None
-        self._scores_grad = None
-
-        if grad_func is not None:
-            assert callable(grad_func)
+        self._scores = self._labels = self._scores_grad = None
+        if grad_func is not None and not callable(grad_func):
+            raise TypeError("grad_func must be callable")
         self._grad_func = grad_func
 
     def _compute_output_shapes(self):
-        """Output shape = data shape (loss passes scores through)."""
+        # scores pass through unchanged
         return [(self._name + "_output", self._data_shapes[0][1])]
 
     def forward(self, data_batch, is_train=None):
         self._scores = data_batch.data[0]
-
         if is_train is None:
             is_train = self.for_training
-
         if is_train:
             self._labels = data_batch.label[0]
 
-    def get_outputs(self, merge_multi_context=True):
-        assert merge_multi_context is True
+    def get_outputs(self, merge_multi_context=True):  # noqa: D102
+        assert merge_multi_context
         return [self._scores]
 
     def backward(self, out_grads=None):
-        assert out_grads is None, "For a loss module, out_grads should be " \
-            "None"
+        assert out_grads is None, \
+            "For a loss module, out_grads should be None"
         assert self.for_training
-
         self._backward_impl()
 
     def _backward_impl(self):
-        """Actual gradient computation (reference
-        python_module.py:_backward_impl)."""
-        if self._grad_func is not None:
-            grad = self._grad_func(self._scores, self._labels)
-            if not isinstance(grad, nd.NDArray):
-                grad = nd.array(grad)
-            self._scores_grad = grad
-        else:
-            raise NotImplementedError()
+        if self._grad_func is None:
+            raise NotImplementedError(
+                "supply grad_func or override _backward_impl")
+        grad = self._grad_func(self._scores, self._labels)
+        self._scores_grad = grad if isinstance(grad, nd.NDArray) \
+            else nd.array(grad)
 
-    def get_input_grads(self, merge_multi_context=True):
-        assert merge_multi_context is True
+    def get_input_grads(self, merge_multi_context=True):  # noqa: D102
+        assert merge_multi_context
         return [self._scores_grad]
 
     def install_monitor(self, mon):

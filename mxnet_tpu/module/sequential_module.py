@@ -1,5 +1,10 @@
-"""SequentialModule — chain of modules, each consuming the previous one's
-outputs (reference: python/mxnet/module/sequential_module.py)."""
+"""SequentialModule — a chain of modules, each feeding the next.
+
+Capability parity with the reference SequentialModule
+(python/mxnet/module/sequential_module.py): add() with take_labels /
+auto_wiring metas, chained bind/forward, reversed backward with gradient
+hand-off, per-module optimizers.
+"""
 from __future__ import annotations
 
 import logging
@@ -9,48 +14,44 @@ from .base_module import BaseModule
 
 
 class SequentialModule(BaseModule):
-    """Container chaining several modules (reference
-    sequential_module.py:SequentialModule)."""
+    """Container chaining several modules head-to-tail."""
 
     META_TAKE_LABELS = "take_labels"
     META_AUTO_WIRING = "auto_wiring"
+    _KNOWN_METAS = frozenset({META_TAKE_LABELS, META_AUTO_WIRING})
 
     def __init__(self, logger=logging):
         super().__init__(logger=logger)
         self._modules = []
         self._metas = []
         self._label_shapes = None
-        self._data_shapes = None
-        self._meta_keys = set([getattr(SequentialModule, x)
-                               for x in dir(SequentialModule)
-                               if x.startswith("META_")])
+        self._meta_keys = set(self._KNOWN_METAS)  # kept for API parity
 
     def add(self, module, **kwargs):
-        """Append a module (+meta flags take_labels/auto_wiring)
-        (reference sequential_module.py:add)."""
+        """Append a module. Metas: take_labels (this module consumes the
+        chain's labels), auto_wiring (rename incoming data to this
+        module's data_names)."""
+        unknown = set(kwargs) - self._KNOWN_METAS
+        assert not unknown, "Unknown meta %s, a typo?" % sorted(unknown)
         self._modules.append(module)
-        for key in kwargs:
-            assert key in self._meta_keys, \
-                "Unknown meta \"%s\", a typo?" % key
-        self._metas.append(kwargs)
-
-        # after addition, the diagram is changed
+        self._metas.append(dict(kwargs))
+        # topology changed: all derived state is stale
         self.binded = False
         self.params_initialized = False
         self.optimizer_initialized = False
-        return self  # for easier chaining
+        return self
 
+    def _takes_labels(self, i):
+        return bool(self._metas[i].get(self.META_TAKE_LABELS))
+
+    # -- shape/name surface ------------------------------------------------
     @property
     def data_names(self):
-        if len(self._modules) > 0:
-            return self._modules[0].data_names
-        return []
+        return self._modules[0].data_names if self._modules else []
 
     @property
     def output_names(self):
-        if len(self._modules) > 0:
-            return self._modules[-1].output_names
-        return []
+        return self._modules[-1].output_names if self._modules else []
 
     @property
     def data_shapes(self):
@@ -67,19 +68,20 @@ class SequentialModule(BaseModule):
         assert self.binded
         return self._modules[-1].output_shapes
 
+    # -- params ------------------------------------------------------------
     def get_params(self):
-        assert self.binded and self.params_initialized
-        arg_params = dict()
-        aux_params = dict()
+        """Union of every chained module's parameters."""
+        self._require()
+        arg_all, aux_all = {}, {}
         for module in self._modules:
-            arg, aux = module.get_params()
-            arg_params.update(arg)
-            aux_params.update(aux)
-        return (arg_params, aux_params)
+            args, auxs = module.get_params()
+            arg_all.update(args)
+            aux_all.update(auxs)
+        return arg_all, aux_all
 
     def init_params(self, initializer=Uniform(0.01), arg_params=None,
-                    aux_params=None, allow_missing=False, force_init=False,
-                    allow_extra=False):
+                    aux_params=None, allow_missing=False,
+                    force_init=False, allow_extra=False):
         if self.params_initialized and not force_init:
             return
         assert self.binded, "call bind before initializing the parameters"
@@ -91,159 +93,129 @@ class SequentialModule(BaseModule):
                                allow_missing=allow_missing,
                                force_init=force_init,
                                allow_extra=allow_extra)
-
-        # make sure we do not have duplicated parameter names
-        def _check_name(known_names, new_names, modules, i):
-            assert len(new_names) == len(set(new_names)), \
-                "Duplicated parameter names: " + \
-                ("in layers %s" % str(new_names))
-            for name in new_names:
-                assert not name in known_names, \
-                    "Duplicated parameter name: %s in layer %d (%s) and " \
-                    "one of the previous layers" % \
-                    (name, i, type(modules[i]))
-                known_names[name] = True
-
-        arg_names = dict()
-        aux_names = dict()
-        for i_layer, module in enumerate(self._modules):
-            arg_params, aux_params = module.get_params()
-            _check_name(arg_names, list(arg_params.keys()), self._modules,
-                        i_layer)
-            _check_name(aux_names, list(aux_params.keys()), self._modules,
-                        i_layer)
-
+        self._assert_unique_param_names()
         self.params_initialized = True
 
+    def _assert_unique_param_names(self):
+        """A name claimed by two chained modules would silently alias."""
+        seen_arg, seen_aux = {}, {}
+        for i, module in enumerate(self._modules):
+            args, auxs = module.get_params()
+            for seen, names in ((seen_arg, args), (seen_aux, auxs)):
+                for name in names:
+                    assert name not in seen, (
+                        "Duplicated parameter name: %s in layer %d (%s) "
+                        "and in layer %d" % (name, i,
+                                             type(module).__name__,
+                                             seen[name]))
+                    seen[name] = i
+
+    # -- bind --------------------------------------------------------------
     def bind(self, data_shapes, label_shapes=None, for_training=True,
-             inputs_need_grad=False, force_rebind=False, shared_module=None,
-             grad_req="write"):
-        """Bind all chained modules, wiring shapes through (reference
-        sequential_module.py:bind)."""
+             inputs_need_grad=False, force_rebind=False,
+             shared_module=None, grad_req="write"):
+        """Bind each module, wiring output shapes into the next one."""
         if self.binded and not force_rebind:
             self.logger.warning("Already bound, ignoring bind()")
             return
-
         if inputs_need_grad:
             assert for_training
-
         assert shared_module is None, "Shared module is not supported"
-        assert len(self._modules) > 0, "Attempting to bind an empty " \
-            "SequentialModule"
+        assert self._modules, "Attempting to bind an empty SequentialModule"
 
         self.binded = True
-        self.for_training = for_training
-        self.inputs_need_grad = inputs_need_grad
-
-        # the same label shapes are used for all chained modules
+        self.for_training, self.inputs_need_grad = \
+            for_training, inputs_need_grad
         self._label_shapes = label_shapes
 
-        my_data_shapes = data_shapes
-        anybody_ever_needs_label = False
-        for i_layer, module in enumerate(self._modules):
-            meta = self._metas[i_layer]
-            if SequentialModule.META_TAKE_LABELS in meta and \
-                    meta[SequentialModule.META_TAKE_LABELS]:
-                my_label_shapes = label_shapes
-                anybody_ever_needs_label = True
-            else:
-                my_label_shapes = None
+        flowing = data_shapes
+        label_used = False
+        for i, module in enumerate(self._modules):
+            if self._metas[i].get(self.META_AUTO_WIRING):
+                names = module.data_names
+                assert len(names) == len(flowing)
+                flowing = [(new, shape) for new, (_, shape) in
+                           zip(names, flowing)]
+            module.bind(
+                data_shapes=flowing,
+                label_shapes=label_shapes if self._takes_labels(i)
+                else None,
+                for_training=for_training,
+                # interior modules always need input grads to pass back
+                inputs_need_grad=bool(for_training and
+                                      (inputs_need_grad or i > 0)),
+                force_rebind=force_rebind, shared_module=None,
+                grad_req=grad_req)
+            label_used = label_used or self._takes_labels(i)
+            flowing = module.output_shapes
 
-            my_inputs_need_grad = bool(for_training and (
-                inputs_need_grad or i_layer > 0))
-
-            if meta.get(SequentialModule.META_AUTO_WIRING, False):
-                data_names = module.data_names
-                assert len(data_names) == len(my_data_shapes)
-                my_data_shapes = [(new_name, shape) for (new_name, (_, shape))
-                                  in zip(data_names, my_data_shapes)]
-
-            module.bind(data_shapes=my_data_shapes,
-                        label_shapes=my_label_shapes,
-                        for_training=for_training,
-                        inputs_need_grad=my_inputs_need_grad,
-                        force_rebind=force_rebind, shared_module=None,
-                        grad_req=grad_req)
-
-            # the output of the previous module is the data of the next
-            my_data_shapes = module.output_shapes
-
-        if not anybody_ever_needs_label:
-            # then I do not need label either
+        if not label_used:
             self._label_shapes = None
 
+    # -- optimizer ---------------------------------------------------------
     def init_optimizer(self, kvstore="local", optimizer="sgd",
-                       optimizer_params=(("learning_rate", 0.01),),
-                       force_init=False):
-        assert self.binded and self.params_initialized
+                       optimizer_params=(("learning_rate",
+                                          0.01),), force_init=False):
+        self._require()
         if self.optimizer_initialized and not force_init:
             self.logger.warning("optimizer already initialized, ignoring.")
             return
-
         for module in self._modules:
             module.init_optimizer(kvstore=kvstore, optimizer=optimizer,
                                   optimizer_params=optimizer_params,
                                   force_init=force_init)
-
         self.optimizer_initialized = True
 
+    # -- compute -----------------------------------------------------------
     def forward(self, data_batch, is_train=None):
-        assert self.binded and self.params_initialized
-
+        """Run the chain, rebatching each module's outputs as the next
+        module's data."""
         from .. import io
-        # make a shallow copy, just to maintain necessary properties (if
-        # any) like bucket_key, pad, etc.
-        data_batch = io.DataBatch(data=data_batch.data,
-                                  label=data_batch.label,
-                                  pad=data_batch.pad,
-                                  index=data_batch.index,
-                                  bucket_key=data_batch.bucket_key,
-                                  provide_data=data_batch.provide_data,
-                                  provide_label=data_batch.provide_label)
+        self._require()
 
-        for i_layer, module in enumerate(self._modules):
-            module.forward(data_batch, is_train=is_train)
-
-            if i_layer + 1 == len(self._modules):
+        # shallow clone so bucket_key/pad/index survive while data is
+        # swapped stage to stage
+        batch = io.DataBatch(data=data_batch.data, label=data_batch.label,
+                             pad=data_batch.pad, index=data_batch.index,
+                             bucket_key=data_batch.bucket_key,
+                             provide_data=data_batch.provide_data,
+                             provide_label=data_batch.provide_label)
+        last = len(self._modules) - 1
+        for i, module in enumerate(self._modules):
+            module.forward(batch, is_train=is_train)
+            if i == last:
                 break
-
-            data_batch.data = module.get_outputs()
-            if hasattr(data_batch, "provide_data"):
-                data_names = [x[0] for x in module.output_shapes]
-                assert len(data_names) == len(data_batch.data)
-                data_batch.provide_data = [
-                    (name, x.shape) for name, x in
-                    zip(data_names, data_batch.data)]
+            batch.data = module.get_outputs()
+            batch.provide_data = [(name, out.shape) for (name, _), out in
+                                  zip(module.output_shapes, batch.data)]
 
     def backward(self, out_grads=None):
-        assert self.binded and self.params_initialized
-
-        for i_layer, module in reversed(list(enumerate(self._modules))):
-            module.backward(out_grads=out_grads)
-            if i_layer == 0:
+        """Reverse pass: each module's input grads become the previous
+        module's head grads."""
+        self._require()
+        for i in range(len(self._modules) - 1, -1, -1):
+            self._modules[i].backward(out_grads=out_grads)
+            if i == 0:
                 break
-            out_grads = module.get_input_grads()
+            out_grads = self._modules[i].get_input_grads()
 
     def update(self):
-        assert self.binded and self.params_initialized and \
-            self.optimizer_initialized
+        self._require(optimizer=True)
         for module in self._modules:
             module.update()
 
-    def get_outputs(self, merge_multi_context=True):
-        assert self.binded and self.params_initialized
+    def get_outputs(self, merge_multi_context=True):  # noqa: D102
+        self._require()
         return self._modules[-1].get_outputs(merge_multi_context)
 
-    def get_input_grads(self, merge_multi_context=True):
-        assert self.binded and self.params_initialized and \
-            self.inputs_need_grad
+    def get_input_grads(self, merge_multi_context=True):  # noqa: D102
+        self._require(inputs_grad=True)
         return self._modules[0].get_input_grads(merge_multi_context)
 
     def update_metric(self, eval_metric, labels):
-        assert self.binded and self.params_initialized
-        for meta, module in zip(self._metas, self._modules):
-            if SequentialModule.META_TAKE_LABELS in meta and \
-                    meta[SequentialModule.META_TAKE_LABELS]:
+        self._require()
+        for i, module in enumerate(self._modules):
+            if self._takes_labels(i):
                 module.update_metric(eval_metric, labels)
 
     def install_monitor(self, mon):

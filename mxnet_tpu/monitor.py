@@ -1,12 +1,13 @@
-"""Monitor — per-op output/weight statistics for debugging (reference:
-python/mxnet/monitor.py, 143 LoC; native hook ExecuteMonCallback,
-src/executor/graph_executor.h:200).
+"""Monitor — per-op tensor statistics for debugging.
 
-TPU-native: outputs are captured from executor forward results (XLA fusion
-means interior values are not individually materialized; the monitor sees
-graph heads and, via `monitor_all`, the per-node values recomputed in
-interpret mode — the debugging analogue of the reference's per-op engine
-callback)."""
+Capability parity with the reference Monitor (python/mxnet/monitor.py,
+backed natively by ExecuteMonCallback in graph_executor.h:200). Here the
+executor provides a per-node capture hook: on monitored steps the graph is
+evaluated un-jitted so every intermediate tensor is materialized and fed
+to the stat function — under jit+XLA fusion those values never exist, so
+the debugging path trades speed for visibility exactly like the
+reference's monitored engine pushes did.
+"""
 from __future__ import annotations
 
 import logging
@@ -18,88 +19,88 @@ from .ndarray import NDArray, op as _op
 __all__ = ["Monitor"]
 
 
-class Monitor:
-    """Installable statistics monitor (reference monitor.py:Monitor)."""
+def _default_stat(x):
+    """Mean absolute scale: |x|_2 / sqrt(size)."""
+    return _op.norm(x) / sqrt(max(x.size, 1))
 
-    def __init__(self, interval, stat_func=None, pattern=".*", sort=False):
-        if stat_func is None:
-            def asum_stat(x):
-                """returns |x|/size(x), async execution."""
-                return _op.norm(x) / sqrt(x.size)
-            stat_func = asum_stat
-        self.stat_func = stat_func
+
+class Monitor:
+    """Collects (step, tensor_name, stat) rows every `interval` steps.
+
+    interval: sampling period in steps (tic/toc pairs).
+    stat_func: NDArray -> NDArray statistic (default: scaled L2 norm).
+    pattern: regex; only matching tensor names are recorded.
+    sort: sort rows by tensor name in toc().
+    monitor_all: also record variable (arg/aux input) nodes, not just op
+    outputs."""
+
+    def __init__(self, interval, stat_func=None, pattern=".*", sort=False,
+                 monitor_all=False):
         self.interval = interval
+        self.stat_func = stat_func or _default_stat
         self.activated = False
         self.queue = []
         self.step = 0
         self.exes = []
         self.re_prog = re.compile(pattern)
         self.sort = sort
+        self._monitor_all = monitor_all
 
         def stat_helper(name, array):
-            if not self.activated or not self.re_prog.match(name):
-                return
-            self.queue.append((self.step, name, self.stat_func(array)))
+            if self.activated and self.re_prog.match(name):
+                self.queue.append((self.step, name, self.stat_func(array)))
+        # let the executor skip the (slow) capture path on steps where
+        # this monitor is dormant
+        stat_helper.mon = self
         self.stat_helper = stat_helper
 
-    def install(self, exe, monitor_all=False):
-        """Attach to an executor (reference monitor.py:install)."""
-        exe.set_monitor_callback(self.stat_helper, monitor_all)
+    def install(self, exe, monitor_all=None):
+        """Attach to an executor's per-node callback."""
+        exe.set_monitor_callback(
+            self.stat_helper,
+            self._monitor_all if monitor_all is None else monitor_all)
         self.exes.append(exe)
 
+    # -- step protocol -----------------------------------------------------
     def tic(self):
-        """Start collecting for this step if due (reference
-        monitor.py:tic)."""
+        """Begin a step; activates collection when the step is due."""
         if self.step % self.interval == 0:
-            for exe in self.exes:
-                for array in exe.arg_arrays:
-                    array.wait_to_read()
-                for array in exe.aux_arrays:
-                    array.wait_to_read()
             self.queue = []
             self.activated = True
         self.step += 1
 
     def toc(self):
-        """Finish the step; gather stats incl. args/aux (reference
-        monitor.py:toc)."""
+        """End a step: append param/aux stats, return collected rows as
+        (step, name, formatted_value) tuples."""
         if not self.activated:
             return []
         for exe in self.exes:
-            for array in exe.arg_arrays:
-                array.wait_to_read()
-            for array in exe.aux_arrays:
-                array.wait_to_read()
-        for exe in self.exes:
             for name, array in zip(exe._arg_names, exe.arg_arrays):
                 if self.re_prog.match(name):
-                    self.queue.append((self.step, name,
-                                       self.stat_func(array)))
+                    self.queue.append(
+                        (self.step, name, self.stat_func(array)))
             for name, array in zip(exe._aux_names, exe.aux_arrays):
                 if self.re_prog.match(name):
-                    self.queue.append((self.step, name,
-                                       self.stat_func(array)))
+                    self.queue.append(
+                        (self.step, name, self.stat_func(array)))
         self.activated = False
-        res = []
+
         if self.sort:
-            self.queue.sort(key=lambda x: x[1])
-        for n, k, v_list in self.queue:
-            if isinstance(v_list, NDArray):
-                v_list = [v_list]
-            assert isinstance(v_list, list)
-            s = ""
-            for v in v_list:
+            self.queue.sort(key=lambda row: row[1])
+        rows = []
+        for step, name, value in self.queue:
+            values = value if isinstance(value, list) else [value]
+            rendered = ""
+            for v in values:
                 assert isinstance(v, NDArray)
-                if v.shape == (1,) or v.shape == ():
-                    s += str(v.asscalar()) + "\t"
-                else:
-                    s += str(v.asnumpy()) + "\t"
-            res.append((n, k, s))
+                scalar = v.shape in ((), (1,))
+                rendered += (str(v.asscalar()) if scalar
+                             else str(v.asnumpy())) + "\t"
+            rows.append((step, name, rendered))
         self.queue = []
-        return res
+        return rows
 
     def toc_print(self):
-        """toc + log (reference monitor.py:toc_print)."""
-        res = self.toc()
-        for n, k, v in res:
-            logging.info("Batch: %7d %30s %s", n, k, v)
+        """toc() and log each row."""
+        for step, name, value in self.toc():
+            logging.info("Batch: %7d %30s %s", step, name, value)

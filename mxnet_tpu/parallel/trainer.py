@@ -60,7 +60,9 @@ class TrainStep:
             raise ValueError("TrainStep supports fused optimizers %r"
                              % sorted(_OPT_OPS))
         self._n_state, self._opt_op = _OPT_OPS[optimizer]
-        self._eval_fn = _graph_eval_fn(symbol)
+        # mesh passed through so __shard__/ctx_group annotations lower to
+        # sharding constraints inside the step
+        self._eval_fn = _graph_eval_fn(symbol, mesh=mesh)
 
         step = self._build_step()
         self._jit_step = jax.jit(

@@ -1,0 +1,148 @@
+"""Executor round-2 features: fused fwd+bwd, per-op Monitor capture, and
+ctx_group/__shard__ lowering to sharding constraints (VERDICT r1 weaks
+#5, #6, #8)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import sym as S
+from mxnet_tpu import nd
+
+
+def _mlp():
+    x = S.Variable("data")
+    h = S.FullyConnected(x, name="fc1", num_hidden=16)
+    a = S.Activation(h, name="act1", act_type="relu")
+    o = S.FullyConnected(a, name="fc2", num_hidden=4)
+    return S.SoftmaxOutput(o, name="softmax")
+
+
+def test_train_forward_caches_grads():
+    """forward(is_train=True) runs the fused fwd+vjp program, so the
+    default backward() needs no re-evaluation (no 2x forward)."""
+    sym = _mlp()
+    exe = sym.simple_bind(ctx=mx.cpu(), data=(8, 10),
+                          softmax_label=(8,))
+    exe.forward(is_train=True,
+                data=np.random.randn(8, 10).astype(np.float32),
+                softmax_label=np.zeros(8, np.float32))
+    assert exe._cached_grads is not None
+    cached = {n: np.asarray(v) for n, v in exe._cached_grads.items()}
+    exe.backward()
+    # backward must have written exactly the fused-cache values
+    for n, v in cached.items():
+        np.testing.assert_array_equal(v, exe.grad_dict[n].asnumpy())
+    # cross-check against the explicit head-grad path (re-derivation)
+    ones = [np.ones(o.shape, np.float32) for o in exe.outputs]
+    exe2 = sym.simple_bind(ctx=mx.cpu(), data=(8, 10),
+                           softmax_label=(8,))
+    exe2.copy_params_from(exe.arg_dict)
+    exe2.forward(is_train=True)
+    exe2.backward(out_grads=ones)
+    for n in exe.grad_dict:
+        if exe.grad_dict[n] is None:
+            continue
+        np.testing.assert_allclose(exe.grad_dict[n].asnumpy(),
+                                   exe2.grad_dict[n].asnumpy(),
+                                   rtol=1e-5, atol=1e-6)
+
+
+def test_inference_forward_does_not_pay_grads():
+    sym = _mlp()
+    exe = sym.simple_bind(ctx=mx.cpu(), data=(4, 10),
+                          softmax_label=(4,))
+    exe.forward(is_train=False,
+                data=np.zeros((4, 10), np.float32))
+    assert exe._cached_grads is None
+
+
+def test_monitor_sees_intermediate_tensors():
+    """The Monitor must observe interior op outputs (fc1, act1), not just
+    the graph heads — reference ExecuteMonCallback semantics."""
+    from mxnet_tpu.monitor import Monitor
+
+    sym = _mlp()
+    exe = sym.simple_bind(ctx=mx.cpu(), data=(4, 10),
+                          softmax_label=(4,))
+    mon = Monitor(interval=1)
+    mon.install(exe)
+    mon.tic()
+    exe.forward(is_train=True,
+                data=np.random.randn(4, 10).astype(np.float32),
+                softmax_label=np.zeros(4, np.float32))
+    rows = mon.toc()
+    names = {name for _, name, _ in rows}
+    assert any("fc1" in n for n in names), names
+    assert any("act1" in n for n in names), names
+    # arg stats appended by toc
+    assert any(n.endswith("_weight") for n in names), names
+
+
+def test_monitor_inactive_steps_use_jit_path():
+    from mxnet_tpu.monitor import Monitor
+
+    sym = _mlp()
+    exe = sym.simple_bind(ctx=mx.cpu(), data=(4, 10),
+                          softmax_label=(4,))
+    mon = Monitor(interval=5)
+    mon.install(exe)
+    mon.tic()      # step 0: active
+    exe.forward(is_train=False, data=np.zeros((4, 10), np.float32))
+    mon.toc()
+    mon.tic()      # step 1: dormant -> fast path
+    assert not mon.activated
+    exe.forward(is_train=False, data=np.zeros((4, 10), np.float32))
+    assert mon.toc() == []
+
+
+def test_shard_annotation_lowers_to_collectives():
+    """A __shard__ annotation over a 'model' mesh axis must show up as a
+    sharding constraint: the compiled HLO of the train step contains
+    all-reduce collectives beyond the data-parallel grad reduction."""
+    import jax
+    from mxnet_tpu.parallel import make_mesh, make_train_step
+    from mxnet_tpu.initializer import Xavier
+
+    if len(jax.devices()) < 4:
+        pytest.skip("needs 4 virtual devices")
+
+    x = S.Variable("data")
+    h = S.FullyConnected(x, name="fc1", num_hidden=8)
+    h._set_attr(__shard__="None,model")   # activations sharded over model
+    a = S.Activation(h, name="act1", act_type="relu")
+    o = S.FullyConnected(a, name="fc2", num_hidden=4)
+    sym = S.SoftmaxOutput(o, name="softmax")
+
+    mesh = make_mesh({"data": 2, "model": 2},
+                     devices=jax.devices()[:4])
+    step = make_train_step(sym, optimizer="sgd", mesh=mesh)
+    state = step.init_state(Xavier(), {"data": (8, 10),
+                                       "softmax_label": (8,)})
+    batch = step.place_batch({
+        "data": np.zeros((8, 10), np.float32),
+        "softmax_label": np.zeros((8,), np.float32)})
+    import jax.numpy as jnp
+    txt = step.lower(state, batch, 0.1,
+                     jax.random.PRNGKey(0)).compile().as_text()
+    assert "all-reduce" in txt or "all-gather" in txt or \
+        "reduce-scatter" in txt, "no collectives in compiled HLO"
+    # and the step still runs
+    state, outs = step(state, batch, 0.1, jax.random.PRNGKey(0))
+    jax.block_until_ready(outs)
+
+
+def test_shard_annotation_bad_axis_raises():
+    from mxnet_tpu.executor import _shard_constraint
+    from mxnet_tpu.base import MXNetError
+    import jax
+    from mxnet_tpu.parallel import make_mesh
+
+    if len(jax.devices()) < 2:
+        pytest.skip("needs 2 virtual devices")
+    mesh = make_mesh({"data": 2}, devices=jax.devices()[:2])
+    import jax.numpy as jnp
+    with pytest.raises(MXNetError):
+        _shard_constraint(mesh, "bogus_axis", jnp.zeros((4, 4)))
+    with pytest.raises(MXNetError):
+        # not divisible: 3 % 2
+        _shard_constraint(mesh, "data", jnp.zeros((3, 4)))
