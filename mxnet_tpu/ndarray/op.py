@@ -40,16 +40,30 @@ def _make_nd_function(opdef):
             pos = [a for a in args if isinstance(a, _ARRAY_LIKE)]
             scalars = [a for a in args if not isinstance(a, _ARRAY_LIKE)]
             inputs = [_to_nd(a) for a in pos]
-            n = len(inputs)
-            for an in opdef.arg_names[n:]:
-                if an in kwargs and isinstance(kwargs[an], _ARRAY_LIKE):
-                    inputs.append(_to_nd(kwargs.pop(an)))
-                elif an in kwargs and kwargs[an] is None:
-                    kwargs.pop(an)
-                    break
+            # split named tensor inputs from attrs, then append them in the
+            # op's active-argument order (arg_select-aware, so optional
+            # inputs like CTCLoss data_lengths resolve even when earlier
+            # optional inputs are absent)
+            tensor_kw, attrs = {}, {}
+            arg_set = set(opdef.arg_names)
+            for k, v in kwargs.items():
+                if k in arg_set and isinstance(v, _ARRAY_LIKE):
+                    tensor_kw[k] = v
+                elif k in arg_set and v is None:
+                    pass
                 else:
-                    break
-            attrs = kwargs
+                    attrs[k] = v
+            if tensor_kw:
+                names = opdef.active_args(
+                    _reg.canon_attrs(opdef, attrs)) or opdef.arg_names
+                for an in names[len(inputs):]:
+                    if an in tensor_kw:
+                        inputs.append(_to_nd(tensor_kw.pop(an)))
+                    else:
+                        break
+                if tensor_kw:
+                    raise TypeError("%s: unexpected tensor arguments %r"
+                                    % (opdef.name, sorted(tensor_kw)))
             if scalars:
                 # positional attrs map onto parameter declaration order
                 # (reference: dmlc::Parameter ordering in generated sigs)

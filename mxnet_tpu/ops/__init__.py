@@ -17,4 +17,7 @@ from . import loss          # noqa: F401
 from . import random_ops    # noqa: F401
 from . import linalg        # noqa: F401
 from . import optimizer_ops  # noqa: F401
+from . import ctc           # noqa: F401
+from . import rnn_op        # noqa: F401
+from . import detection_ops  # noqa: F401
 from . import shape_hooks   # noqa: F401  (must come after all registrations)

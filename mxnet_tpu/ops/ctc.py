@@ -1,0 +1,76 @@
+"""Connectionist Temporal Classification loss.
+
+Reference semantics: src/operator/contrib/ctc_loss.cc (warp-ctc backed):
+  data (T, B, C) activations (softmax applied internally), label (B, L)
+  integer matrix, optional data_lengths/label_lengths (B,) inputs, and
+  blank_label in {"first", "last"}:
+    first: channel 0 is blank, labels use 1..C-1, label padding value 0
+    last:  channel C-1 is blank, labels use 0..C-2, label padding value -1
+  output: per-example negative log likelihood (B,).
+
+TPU-native implementation: the alpha-recursion dynamic program runs as a
+`lax.scan` inside optax.ctc_loss — fixed shapes, fully differentiable via
+autodiff, no host callbacks.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import optax
+
+from .registry import register, set_arg_select
+
+
+@register("CTCLoss",
+          arg_names=("data", "label", "data_lengths", "label_lengths"),
+          aliases=("ctc_loss", "_contrib_CTCLoss", "_contrib_ctc_loss"),
+          nondiff_inputs=(1, 2, 3),
+          defaults={"use_data_lengths": False, "use_label_lengths": False,
+                    "blank_label": "first"})
+def _ctc_loss(data, label, *lens, use_data_lengths=False,
+              use_label_lengths=False, blank_label="first", **_):
+    # optional length inputs arrive positionally in active-arg order
+    # (arg_select below drops the inactive ones from the signature)
+    lens = list(lens)
+    data_lengths = lens.pop(0) if use_data_lengths and lens else None
+    label_lengths = lens.pop(0) if use_label_lengths and lens else None
+    T, B, C = data.shape
+    logits = jnp.transpose(data, (1, 0, 2))          # (B, T, C)
+
+    if use_data_lengths and data_lengths is not None:
+        steps = jnp.arange(T)[None, :]
+        logit_pad = (steps >= data_lengths[:, None].astype(jnp.int32)
+                     ).astype(logits.dtype)
+    else:
+        logit_pad = jnp.zeros((B, T), logits.dtype)
+
+    lab = label.astype(jnp.int32)
+    if blank_label == "first":
+        blank_id = 0
+        pad_mask_src = lab == 0
+    else:
+        blank_id = C - 1
+        pad_mask_src = lab < 0
+        lab = jnp.maximum(lab, 0)
+
+    if use_label_lengths and label_lengths is not None:
+        pos = jnp.arange(lab.shape[1])[None, :]
+        label_pad = (pos >= label_lengths[:, None].astype(jnp.int32)
+                     ).astype(logits.dtype)
+    else:
+        label_pad = pad_mask_src.astype(logits.dtype)
+    lab = jnp.where(label_pad > 0, 0, lab)
+
+    return optax.ctc_loss(logits, logit_pad, lab, label_pad,
+                          blank_id=blank_id)
+
+
+def _ctc_args(attrs):
+    names = ["data", "label"]
+    if attrs.get("use_data_lengths"):
+        names.append("data_lengths")
+    if attrs.get("use_label_lengths"):
+        names.append("label_lengths")
+    return tuple(names)
+
+
+set_arg_select("CTCLoss", _ctc_args)

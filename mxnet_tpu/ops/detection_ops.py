@@ -1,0 +1,350 @@
+"""SSD detection op stack: MultiBoxPrior / MultiBoxTarget /
+MultiBoxDetection + ROIPooling.
+
+Reference semantics:
+  src/operator/contrib/multibox_prior.cc:35-71 (anchor generation),
+  src/operator/contrib/multibox_target.cc:30-280 (bipartite + threshold
+    matching, hard-negative mining, loc encoding),
+  src/operator/contrib/multibox_detection.cc:44-168 (decode + NMS),
+  src/operator/roi_pooling.cc:40-110 (max pooling over ROI bins).
+
+TPU-native design notes: everything is fixed-shape and jittable. The
+reference's data-dependent loops become:
+  * bipartite matching -> lax.fori_loop over the (static) max-gt count,
+    each step a vectorized argmax over the masked IoU matrix;
+  * compaction of valid detections -> a full sort by (validity, score);
+  * NMS -> lax.fori_loop over sorted rows with a vectorized suppression
+    mask per step (O(A) work per step instead of the reference's nested
+    scalar loops).
+One deliberate deviation: with nms_topk set, rows beyond topk are
+suppressed (-1) rather than left holding stale pre-sort content as the
+reference's buffer-reuse does.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+import numpy as np
+
+from .registry import register
+
+_F = jnp.float32
+
+
+def _box_iou_corner(a, b):
+    """IoU between two sets of corner boxes: a (..., Na, 4), b (..., Nb, 4)
+    -> (..., Na, Nb). Matches CalculateOverlap (multibox_detection.cc:74)."""
+    ax1, ay1, ax2, ay2 = jnp.split(a, 4, axis=-1)           # (..., Na, 1)
+    bx1, by1, bx2, by2 = [v[..., None, :, 0] for v in
+                          jnp.split(b, 4, axis=-1)]          # (..., 1, Nb)
+    iw = jnp.maximum(0.0, jnp.minimum(ax2, bx2) - jnp.maximum(ax1, bx1))
+    ih = jnp.maximum(0.0, jnp.minimum(ay2, by2) - jnp.maximum(ay1, by1))
+    inter = iw * ih
+    union = (ax2 - ax1) * (ay2 - ay1) + \
+        (bx2 - bx1) * (by2 - by1) - inter
+    return jnp.where(union <= 0, 0.0, inter / jnp.maximum(union, 1e-12))
+
+
+# ---------------------------------------------------------------------------
+# MultiBoxPrior
+# ---------------------------------------------------------------------------
+@register("_contrib_MultiBoxPrior", arg_names=("data",),
+          differentiable=False,
+          aliases=("MultiBoxPrior", "_contrib_multibox_prior"),
+          defaults={"sizes": (1.0,), "ratios": (1.0,), "clip": False,
+                    "steps": (-1.0, -1.0), "offsets": (0.5, 0.5)})
+def _multibox_prior(data, sizes=(1.0,), ratios=(1.0,), clip=False,
+                    steps=(-1.0, -1.0), offsets=(0.5, 0.5), **_):
+    """Anchors from a feature map: (1, H*W*num_anchors, 4) corner boxes in
+    [0,1] image coordinates; num_anchors = len(sizes)-1+len(ratios)."""
+    h, w = data.shape[2], data.shape[3]
+    sizes = np.atleast_1d(np.asarray(sizes, np.float32))
+    ratios = np.atleast_1d(np.asarray(ratios, np.float32))
+    step_y = steps[0] if steps[0] > 0 else 1.0 / h
+    step_x = steps[1] if steps[1] > 0 else 1.0 / w
+
+    cy = (np.arange(h, dtype=np.float32) + offsets[0]) * step_y
+    cx = (np.arange(w, dtype=np.float32) + offsets[1]) * step_x
+
+    # per-location half-extents, reference order: all sizes at ratio 1,
+    # then ratios[1:] at sizes[0]
+    ws, hs = [], []
+    for s in sizes:
+        ws.append(s * h / w / 2.0)
+        hs.append(s / 2.0)
+    for r in ratios[1:]:
+        sr = np.sqrt(r)
+        ws.append(sizes[0] * h / w * sr / 2.0)
+        hs.append(sizes[0] / sr / 2.0)
+    ws = np.asarray(ws, np.float32)     # (K,)
+    hs = np.asarray(hs, np.float32)
+
+    cyg, cxg = np.meshgrid(cy, cx, indexing="ij")     # (h, w)
+    cxg = cxg[:, :, None]
+    cyg = cyg[:, :, None]
+    boxes = np.stack([cxg - ws, cyg - hs, cxg + ws, cyg + hs],
+                     axis=-1)                         # (h, w, K, 4)
+    boxes = boxes.reshape(1, -1, 4)
+    if clip:
+        boxes = np.clip(boxes, 0.0, 1.0)
+    return jnp.asarray(boxes)
+
+
+# ---------------------------------------------------------------------------
+# MultiBoxTarget
+# ---------------------------------------------------------------------------
+def _encode_loc(anchors, gt_boxes, variances):
+    """(gx-ax)/aw/vx ... per multibox_target.cc:30-54. anchors (A,4),
+    gt_boxes (A,4) matched per anchor."""
+    vx, vy, vw, vh = variances
+    aw = anchors[:, 2] - anchors[:, 0]
+    ah = anchors[:, 3] - anchors[:, 1]
+    ax = (anchors[:, 0] + anchors[:, 2]) * 0.5
+    ay = (anchors[:, 1] + anchors[:, 3]) * 0.5
+    gw = gt_boxes[:, 2] - gt_boxes[:, 0]
+    gh = gt_boxes[:, 3] - gt_boxes[:, 1]
+    gx = (gt_boxes[:, 0] + gt_boxes[:, 2]) * 0.5
+    gy = (gt_boxes[:, 1] + gt_boxes[:, 3]) * 0.5
+    # reference quirk kept: x offset divides by aw, y offset by ah
+    tx = (gx - ax) / jnp.maximum(aw, 1e-12) / vx
+    ty = (gy - ay) / jnp.maximum(ah, 1e-12) / vy
+    tw = jnp.log(jnp.maximum(gw / jnp.maximum(aw, 1e-12), 1e-12)) / vw
+    th = jnp.log(jnp.maximum(gh / jnp.maximum(ah, 1e-12), 1e-12)) / vh
+    return jnp.stack([tx, ty, tw, th], axis=1)
+
+
+def _target_one(anchors, labels, cls_preds, overlap_threshold,
+                ignore_label, negative_mining_ratio,
+                negative_mining_thresh, minimum_negative_samples,
+                variances):
+    """Targets for ONE batch element. anchors (A,4), labels (L,W),
+    cls_preds (C, A)."""
+    A = anchors.shape[0]
+    L = labels.shape[0]
+
+    # valid gt prefix: first column == -1 terminates
+    invalid = labels[:, 0] < 0
+    num_valid = jnp.argmax(jnp.concatenate(
+        [invalid, jnp.array([True])]).astype(jnp.int32))
+    gt_valid = jnp.arange(L) < num_valid                   # (L,)
+
+    ious = _box_iou_corner(anchors, labels[:, 1:5])        # (A, L)
+    ious = jnp.where(gt_valid[None, :], ious, -1.0)
+
+    # phase 1: greedy bipartite matching, one gt per iteration
+    def bip_step(_i, st):
+        match_iou, match_gt, a_flag, g_flag = st
+        masked = jnp.where((a_flag[:, None] != 1) & (~g_flag[None, :]),
+                           ious, -1.0)
+        flat = jnp.argmax(masked)
+        bi, bk = flat // L, flat % L
+        ok = masked[bi, bk] > 1e-6
+        match_iou = jnp.where(ok, match_iou.at[bi].set(masked[bi, bk]),
+                              match_iou)
+        match_gt = jnp.where(ok, match_gt.at[bi].set(bk), match_gt)
+        a_flag = jnp.where(ok, a_flag.at[bi].set(1), a_flag)
+        g_flag = jnp.where(ok, g_flag.at[bk].set(True), g_flag)
+        return match_iou, match_gt, a_flag, g_flag
+
+    st = (jnp.full((A,), -1.0), jnp.full((A,), -1, jnp.int32),
+          jnp.full((A,), -1, jnp.int32), jnp.zeros((L,), bool))
+    match_iou, match_gt, a_flag, _ = lax.fori_loop(0, L, bip_step, st)
+
+    # phase 2: per-anchor best gt; positive where iou > threshold
+    best_gt = jnp.argmax(ious, axis=1)
+    best_iou = jnp.max(ious, axis=1)
+    un = a_flag != 1
+    has_any = best_iou > -1.0
+    match_iou = jnp.where(un & has_any, best_iou, match_iou)
+    match_gt = jnp.where(un & has_any, best_gt, match_gt)
+    if overlap_threshold > 0:
+        pos2 = un & (best_iou > overlap_threshold)
+        a_flag = jnp.where(pos2, 1, a_flag)
+
+    positive = a_flag == 1
+    num_positive = positive.sum()
+
+    if negative_mining_ratio > 0:
+        # hard negatives: highest background prob among candidates
+        prob_bg = jax.nn.softmax(cls_preds, axis=0)[0]     # (A,)
+        cand = (~positive) & (match_iou < negative_mining_thresh)
+        score = jnp.where(cand, -prob_bg, -jnp.inf)        # descend: -prob
+        order = jnp.argsort(-score)                        # best first
+        rank = jnp.argsort(order)
+        num_neg = jnp.minimum(
+            (num_positive * negative_mining_ratio).astype(jnp.int32),
+            A - num_positive)
+        num_neg = jnp.maximum(num_neg, minimum_negative_samples)
+        negative = cand & (rank < num_neg)
+        a_flag = jnp.where(negative, 0, a_flag)
+    else:
+        a_flag = jnp.where(positive, 1, 0)
+
+    # targets
+    safe_gt = jnp.maximum(match_gt, 0)
+    gt_cls = labels[safe_gt, 0]
+    cls_target = jnp.full((A,), float(ignore_label))
+    cls_target = jnp.where(a_flag == 0, 0.0, cls_target)
+    cls_target = jnp.where(a_flag == 1, gt_cls + 1.0, cls_target)
+
+    loc = _encode_loc(anchors, labels[safe_gt, 1:5], variances)   # (A,4)
+    loc_mask = (a_flag == 1).astype(_F)[:, None] * jnp.ones((1, 4), _F)
+    loc_target = loc * loc_mask
+
+    # no valid gt: everything stays at init (loc 0, mask 0, cls ignore)
+    none = num_valid == 0
+    cls_target = jnp.where(none, float(ignore_label), cls_target)
+    loc_target = jnp.where(none, 0.0, loc_target)
+    loc_mask = jnp.where(none, 0.0, loc_mask)
+    return (loc_target.reshape(-1), loc_mask.reshape(-1), cls_target)
+
+
+@register("_contrib_MultiBoxTarget",
+          arg_names=("anchor", "label", "cls_pred"),
+          differentiable=False, num_visible=3,
+          aliases=("MultiBoxTarget", "_contrib_multibox_target"),
+          defaults={"overlap_threshold": 0.5, "ignore_label": -1.0,
+                    "negative_mining_ratio": -1.0,
+                    "negative_mining_thresh": 0.5,
+                    "minimum_negative_samples": 0,
+                    "variances": (0.1, 0.1, 0.2, 0.2)})
+def _multibox_target(anchor, label, cls_pred, overlap_threshold=0.5,
+                     ignore_label=-1.0, negative_mining_ratio=-1.0,
+                     negative_mining_thresh=0.5,
+                     minimum_negative_samples=0,
+                     variances=(0.1, 0.1, 0.2, 0.2), **_):
+    """anchor (1,A,4), label (B,L,W>=5), cls_pred (B,C,A) ->
+    loc_target (B,A*4), loc_mask (B,A*4), cls_target (B,A)."""
+    anchors = anchor.reshape(-1, 4)
+    f = lambda lab, cp: _target_one(
+        anchors, lab, cp, overlap_threshold, ignore_label,
+        negative_mining_ratio, negative_mining_thresh,
+        minimum_negative_samples, variances)
+    loc_t, loc_m, cls_t = jax.vmap(f)(label, cls_pred)
+    return loc_t, loc_m, cls_t
+
+
+# ---------------------------------------------------------------------------
+# MultiBoxDetection
+# ---------------------------------------------------------------------------
+def _decode_boxes(anchors, loc_pred, variances, clip):
+    """TransformLocations (multibox_detection.cc:44-70). anchors (A,4),
+    loc_pred (A,4) -> corner boxes (A,4)."""
+    vx, vy, vw, vh = variances
+    aw = anchors[:, 2] - anchors[:, 0]
+    ah = anchors[:, 3] - anchors[:, 1]
+    ax = (anchors[:, 0] + anchors[:, 2]) * 0.5
+    ay = (anchors[:, 1] + anchors[:, 3]) * 0.5
+    ox = loc_pred[:, 0] * vx * aw + ax
+    oy = loc_pred[:, 1] * vy * ah + ay
+    ow = jnp.exp(loc_pred[:, 2] * vw) * aw * 0.5
+    oh = jnp.exp(loc_pred[:, 3] * vh) * ah * 0.5
+    boxes = jnp.stack([ox - ow, oy - oh, ox + ow, oy + oh], axis=1)
+    if clip:
+        boxes = jnp.clip(boxes, 0.0, 1.0)
+    return boxes
+
+
+def _detect_one(cls_prob, loc_pred, anchors, threshold, clip, variances,
+                nms_threshold, force_suppress, nms_topk):
+    """One batch element. cls_prob (C,A), loc_pred (A*4,), anchors (A,4)
+    -> (A, 6) rows [class_id, score, x1, y1, x2, y2], invalid rows -1."""
+    C, A = cls_prob.shape
+    scores = jnp.max(cls_prob[1:], axis=0)               # best non-bg
+    ids = jnp.argmax(cls_prob[1:], axis=0) + 1
+    valid = scores >= threshold
+
+    boxes = _decode_boxes(anchors, loc_pred.reshape(A, 4), variances,
+                          clip)
+    # sort: valid-by-score first (stable, score descending)
+    key = jnp.where(valid, scores, -1.0)
+    order = jnp.argsort(-key)
+    s_valid = valid[order]
+    s_rows = jnp.concatenate(
+        [(ids[order] - 1.0)[:, None], scores[order][:, None],
+         boxes[order]], axis=1)
+    s_rows = jnp.where(s_valid[:, None], s_rows, -1.0)
+
+    if nms_topk > 0:
+        s_valid = s_valid & (jnp.arange(A) < nms_topk)
+        s_rows = jnp.where(s_valid[:, None], s_rows, -1.0)
+
+    if not (0 < nms_threshold <= 1):
+        return s_rows
+
+    iou = _box_iou_corner(s_rows[:, 2:6], s_rows[:, 2:6])   # (A, A)
+    same_cls = s_rows[:, 0][:, None] == s_rows[:, 0][None, :]
+    sup_candidate = iou >= nms_threshold
+    if not force_suppress:
+        sup_candidate = sup_candidate & same_cls
+
+    def nms_step(i, keep):
+        row_alive = keep[i] & s_valid[i]
+        sup = sup_candidate[i] & (jnp.arange(A) > i) & row_alive
+        return keep & ~sup
+
+    keep = lax.fori_loop(0, A, nms_step, s_valid)
+    return jnp.where((keep & s_valid)[:, None], s_rows, -1.0)
+
+
+@register("_contrib_MultiBoxDetection",
+          arg_names=("cls_prob", "loc_pred", "anchor"),
+          differentiable=False,
+          aliases=("MultiBoxDetection", "_contrib_multibox_detection"),
+          defaults={"clip": True, "threshold": 0.01, "background_id": 0,
+                    "nms_threshold": 0.5, "force_suppress": False,
+                    "variances": (0.1, 0.1, 0.2, 0.2), "nms_topk": -1})
+def _multibox_detection(cls_prob, loc_pred, anchor, clip=True,
+                        threshold=0.01, background_id=0,
+                        nms_threshold=0.5, force_suppress=False,
+                        variances=(0.1, 0.1, 0.2, 0.2), nms_topk=-1, **_):
+    """cls_prob (B,C,A), loc_pred (B,A*4), anchor (1,A,4) -> (B,A,6)."""
+    anchors = anchor.reshape(-1, 4)
+    f = lambda cp, lp: _detect_one(cp, lp, anchors, threshold, clip,
+                                   variances, nms_threshold,
+                                   force_suppress, nms_topk)
+    return jax.vmap(f)(cls_prob, loc_pred)
+
+
+# ---------------------------------------------------------------------------
+# ROIPooling
+# ---------------------------------------------------------------------------
+@register("ROIPooling", arg_names=("data", "rois"), nondiff_inputs=(1,),
+          aliases=("_contrib_ROIPooling",),
+          defaults={"pooled_size": (1, 1), "spatial_scale": 1.0})
+def _roi_pooling(data, rois, pooled_size=(1, 1), spatial_scale=1.0, **_):
+    """data (B,C,H,W), rois (R,5) [batch_idx, x1, y1, x2, y2] in image
+    coords -> (R, C, ph, pw) max-pooled. Reference roi_pooling.cc:40-110
+    (round-to-int bin edges, empty bins produce 0)."""
+    B, C, H, W = data.shape
+    ph, pw = pooled_size
+
+    def one_roi(roi):
+        bidx = roi[0].astype(jnp.int32)
+        x1 = jnp.round(roi[1] * spatial_scale)
+        y1 = jnp.round(roi[2] * spatial_scale)
+        x2 = jnp.round(roi[3] * spatial_scale)
+        y2 = jnp.round(roi[4] * spatial_scale)
+        rw = jnp.maximum(x2 - x1 + 1.0, 1.0)
+        rh = jnp.maximum(y2 - y1 + 1.0, 1.0)
+        bin_h = rh / ph
+        bin_w = rw / pw
+        img = data[bidx]                                   # (C,H,W)
+
+        hs = jnp.arange(H, dtype=_F)[None, :]              # (1,H)
+        wsx = jnp.arange(W, dtype=_F)[None, :]             # (1,W)
+        py = jnp.arange(ph, dtype=_F)[:, None]             # (ph,1)
+        px = jnp.arange(pw, dtype=_F)[:, None]             # (pw,1)
+        hstart = jnp.clip(jnp.floor(py * bin_h) + y1, 0, H)
+        hend = jnp.clip(jnp.ceil((py + 1) * bin_h) + y1, 0, H)
+        wstart = jnp.clip(jnp.floor(px * bin_w) + x1, 0, W)
+        wend = jnp.clip(jnp.ceil((px + 1) * bin_w) + x1, 0, W)
+        hmask = (hs >= hstart) & (hs < hend)               # (ph,H)
+        wmask = (wsx >= wstart) & (wsx < wend)             # (pw,W)
+        mask = hmask[:, None, :, None] & wmask[None, :, None, :]
+        vals = jnp.where(mask[None], img[:, None, None, :, :], -jnp.inf)
+        pooled = vals.max((3, 4))                          # (C,ph,pw)
+        return jnp.where(jnp.isfinite(pooled), pooled, 0.0)
+
+    return jax.vmap(one_roi)(rois)

@@ -73,9 +73,10 @@ def _syrk(A, transpose=False, alpha=1.0, **_):
 
 @register("_linalg_gelqf", arg_names=("A",), aliases=("linalg_gelqf",))
 def _gelqf(A, **_):
-    """LQ factorization: A = L Q with Q orthonormal rows."""
+    """LQ factorization: A = L Q with Q orthonormal rows. Returns (Q, L)
+    in the reference's output order (la_op.cc:508 `Q, L = gelqf(A)`)."""
     q, r = jnp.linalg.qr(jnp.swapaxes(A, -1, -2))
-    return jnp.swapaxes(r, -1, -2), jnp.swapaxes(q, -1, -2)
+    return jnp.swapaxes(q, -1, -2), jnp.swapaxes(r, -1, -2)
 
 
 @register("_linalg_sumlogdiag", arg_names=("A",),

@@ -7,6 +7,7 @@ from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from ..base import np_dtype
 from .registry import register
@@ -89,7 +90,9 @@ def _sample_multinomial(data, shape=None, get_prob=False, dtype="int32",
                         rng=None, **_):
     n = 1
     if shape:
-        n = int(jnp.prod(jnp.asarray(_shape(shape))))
+        # static python arithmetic: jnp here would make `n` a tracer under
+        # jit and int() of it fails (found by the op sweep)
+        n = int(np.prod(_shape(shape)))
     logits = jnp.log(jnp.maximum(data, 1e-20))
     if data.ndim == 1:
         samples = jax.random.categorical(rng, logits, shape=(n,))

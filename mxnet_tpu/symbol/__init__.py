@@ -3,5 +3,12 @@ from .symbol import Symbol, Variable, var, Group, load, load_json
 from .op import *          # noqa: F401,F403 — generated op namespace
 from . import op           # noqa: F401
 
+# `import *` skips underscore-prefixed generated ops (_contrib_*,
+# _linalg_*, ...); surface them all, as the reference namespace does
+from ..ops import registry as _reg
+for _n in _reg.list_ops():
+    globals()[_n] = getattr(op, _n)
+del _n, _reg
+
 # creation helpers mirroring mx.sym.zeros/ones
 from .op import _zeros as zeros, _ones as ones, _arange as arange  # noqa: F401,E501

@@ -55,6 +55,14 @@ def _num_outputs(opdef, attrs):
         return 3 if attrs.get("output_mean_var") else 1
     if name == "_linalg_gelqf":
         return 2
+    if name == "RNN":
+        if not attrs.get("state_outputs"):
+            return 1
+        return 3 if attrs.get("mode", "lstm") == "lstm" else 2
+    if name == "topk" and attrs.get("ret_typ") == "both":
+        return 2
+    if name == "CTCLoss":
+        return 1
     if opdef.num_visible is not None:
         return opdef.num_visible
     return 1

@@ -1,0 +1,116 @@
+"""CTC loss vs a from-scratch numpy dynamic program (reference
+src/operator/contrib/ctc_loss.cc semantics)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+
+
+def np_ctc_loss(logits_tbc, labels, blank=0):
+    """Negative log likelihood of `labels` under CTC for ONE example.
+    logits_tbc: (T, C) unnormalized; labels: list of ints (no blanks)."""
+    T, C = logits_tbc.shape
+    e = np.exp(logits_tbc - logits_tbc.max(-1, keepdims=True))
+    probs = e / e.sum(-1, keepdims=True)
+    ext = [blank]
+    for l in labels:
+        ext += [l, blank]
+    S = len(ext)
+    alpha = np.zeros((T, S))
+    alpha[0, 0] = probs[0, ext[0]]
+    if S > 1:
+        alpha[0, 1] = probs[0, ext[1]]
+    for t in range(1, T):
+        for s in range(S):
+            a = alpha[t - 1, s]
+            if s >= 1:
+                a += alpha[t - 1, s - 1]
+            if s >= 2 and ext[s] != blank and ext[s] != ext[s - 2]:
+                a += alpha[t - 1, s - 2]
+            alpha[t, s] = a * probs[t, ext[s]]
+    p = alpha[T - 1, S - 1] + (alpha[T - 1, S - 2] if S > 1 else 0.0)
+    return -np.log(max(p, 1e-30))
+
+
+def test_ctc_loss_matches_numpy_blank_first():
+    rng = np.random.RandomState(0)
+    T, B, C = 6, 2, 5
+    data = rng.randn(T, B, C).astype(np.float32)
+    # blank_label="first": labels use 1..C-1, padding 0
+    label = np.array([[1, 3, 2], [4, 1, 0]], np.float32)
+    out = nd.CTCLoss(nd.array(data), nd.array(label)).asnumpy()
+    want = [np_ctc_loss(data[:, 0], [1, 3, 2], blank=0),
+            np_ctc_loss(data[:, 1], [4, 1], blank=0)]
+    np.testing.assert_allclose(out, want, rtol=1e-4, atol=1e-5)
+
+
+def test_ctc_loss_blank_last():
+    rng = np.random.RandomState(1)
+    T, B, C = 5, 1, 4
+    data = rng.randn(T, B, C).astype(np.float32)
+    label = np.array([[0, 2, -1]], np.float32)  # padding -1, blank C-1
+    out = nd.CTCLoss(nd.array(data), nd.array(label),
+                     blank_label="last").asnumpy()
+    want = [np_ctc_loss(data[:, 0], [0, 2], blank=C - 1)]
+    np.testing.assert_allclose(out, want, rtol=1e-4, atol=1e-5)
+
+
+def test_ctc_loss_explicit_lengths():
+    rng = np.random.RandomState(2)
+    T, B, C = 7, 2, 5
+    data = rng.randn(T, B, C).astype(np.float32)
+    label = np.array([[1, 3, 2], [4, 1, 2]], np.float32)
+    out = nd.CTCLoss(nd.array(data), nd.array(label),
+                     use_data_lengths=True, use_label_lengths=True,
+                     data_lengths=nd.array(np.array([5, 7], np.float32)),
+                     label_lengths=nd.array(np.array([2, 3], np.float32))
+                     ).asnumpy()
+    want = [np_ctc_loss(data[:5, 0], [1, 3], blank=0),
+            np_ctc_loss(data[:, 1], [4, 1, 2], blank=0)]
+    np.testing.assert_allclose(out, want, rtol=1e-4, atol=1e-5)
+
+
+def test_ctc_label_lengths_only():
+    """use_label_lengths without data lengths (the gluon kwarg path that
+    was dead in round 1)."""
+    rng = np.random.RandomState(3)
+    data = rng.randn(6, 1, 5).astype(np.float32)
+    label = np.array([[2, 1, 3]], np.float32)
+    out = nd.CTCLoss(nd.array(data), nd.array(label),
+                     use_label_lengths=True,
+                     label_lengths=nd.array(np.array([2], np.float32))
+                     ).asnumpy()
+    want = [np_ctc_loss(data[:, 0], [2, 1], blank=0)]
+    np.testing.assert_allclose(out, want, rtol=1e-4, atol=1e-5)
+
+
+def test_gluon_ctc_loss():
+    """gluon.loss.CTCLoss was DOA in round 1 (no CTCLoss op registered)."""
+    from mxnet_tpu.gluon.loss import CTCLoss
+
+    loss = CTCLoss()
+    rng = np.random.RandomState(4)
+    pred = nd.array(rng.randn(2, 6, 5).astype(np.float32))   # NTC
+    label = nd.array(np.array([[1, 3, 2], [4, 1, 0]], np.float32))
+    out = loss(pred, label).asnumpy()
+    assert out.shape == (2,)
+    assert np.all(np.isfinite(out)) and np.all(out > 0)
+
+
+def test_ctc_loss_gradient_descends():
+    """Gradient flows: a few SGD steps reduce the loss."""
+    from mxnet_tpu import autograd
+
+    rng = np.random.RandomState(5)
+    x = nd.array(rng.randn(6, 1, 4).astype(np.float32))
+    label = nd.array(np.array([[1, 2]], np.float32))
+    x.attach_grad()
+    losses = []
+    for _ in range(30):
+        with autograd.record():
+            l = nd.CTCLoss(x, label)
+        l.backward()
+        x._set_data(x._data - 0.5 * x.grad._data)
+        losses.append(float(l.asnumpy()[0]))
+    assert losses[-1] < losses[0] * 0.6, losses[::10]

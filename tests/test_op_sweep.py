@@ -1,0 +1,780 @@
+"""Per-op verification sweep (VERDICT r1 item 3) — the TPU analogue of the
+reference's tests/python/unittest/test_operator.py:
+
+  * forward vs a numpy oracle
+  * gradient vs central finite differences (differentiable ops)
+  * eager (un-jitted) vs jit-compiled consistency
+  * a completeness gate: >=90% of registered ops must carry a spec
+
+Specs keep shapes tiny: the finite-difference check evaluates the op
+twice per input element.
+"""
+import math
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+from mxnet_tpu.ops import registry
+from mxnet_tpu.test_utils import check_numeric_gradient
+
+R = np.random.RandomState(7)
+
+
+def f32(shape, lo=-1.0, hi=1.0):
+    return R.uniform(lo, hi, shape).astype(np.float32)
+
+
+# ---------------------------------------------------------------------------
+# spec table
+# ---------------------------------------------------------------------------
+# op -> list of cases; each case:
+#   inputs: list of np arrays (op tensor inputs, in order)
+#   attrs:  kwargs
+#   oracle: fn(*inputs, **attrs) -> np array / list (None: skip fwd check)
+#   grad_args: indices of `inputs` to finite-difference (default: none)
+#   rtol/atol: forward tolerance
+SPECS = {}
+
+
+def spec(name, inputs, attrs=None, oracle=None, grad_args=(),
+         rtol=1e-4, atol=1e-5, grad_rtol=1e-2, grad_atol=1e-3):
+    SPECS.setdefault(name, []).append(dict(
+        inputs=inputs, attrs=dict(attrs or {}), oracle=oracle,
+        grad_args=tuple(grad_args), rtol=rtol, atol=atol,
+        grad_rtol=grad_rtol, grad_atol=grad_atol))
+
+
+# -- unary math --------------------------------------------------------------
+_v = np.vectorize
+UNARY = {
+    # name: (numpy fn, (lo, hi), differentiable)
+    "abs": (np.abs, (0.2, 1.0), True),
+    "sign": (np.sign, (-1, 1), False),
+    "negative": (np.negative, (-1, 1), True),
+    "reciprocal": (lambda x: 1.0 / x, (0.5, 1.5), True),
+    "cbrt": (np.cbrt, (0.3, 2.0), True),
+    "rcbrt": (lambda x: 1.0 / np.cbrt(x), (0.5, 2.0), True),
+    "sqrt": (np.sqrt, (0.3, 2.0), True),
+    "rsqrt": (lambda x: 1.0 / np.sqrt(x), (0.5, 2.0), True),
+    "square": (np.square, (-1, 1), True),
+    "exp": (np.exp, (-1, 1), True),
+    "expm1": (np.expm1, (-1, 1), True),
+    "log": (np.log, (0.5, 2.0), True),
+    "log10": (np.log10, (0.5, 2.0), True),
+    "log1p": (np.log1p, (-0.5, 1.0), True),
+    "log2": (np.log2, (0.5, 2.0), True),
+    "sin": (np.sin, (-1, 1), True),
+    "cos": (np.cos, (-1, 1), True),
+    "tan": (np.tan, (-1, 1), True),
+    "sinh": (np.sinh, (-1, 1), True),
+    "cosh": (np.cosh, (-1, 1), True),
+    "tanh": (np.tanh, (-1, 1), True),
+    "arcsin": (np.arcsin, (-0.8, 0.8), True),
+    "arccos": (np.arccos, (-0.8, 0.8), True),
+    "arctan": (np.arctan, (-1, 1), True),
+    "arcsinh": (np.arcsinh, (-1, 1), True),
+    "arccosh": (np.arccosh, (1.2, 2.0), True),
+    "arctanh": (np.arctanh, (-0.8, 0.8), True),
+    "degrees": (np.degrees, (-1, 1), True),
+    "radians": (np.radians, (-1, 1), True),
+    "gamma": (_v(math.gamma), (1.2, 3.0), True),
+    "gammaln": (_v(math.lgamma), (1.2, 3.0), True),
+    "erf": (_v(math.erf), (-1, 1), True),
+    "relu": (lambda x: np.maximum(x, 0), (0.1, 1.0), True),
+    "sigmoid": (lambda x: 1 / (1 + np.exp(-x)), (-1, 1), True),
+    "softsign": (lambda x: x / (1 + np.abs(x)), (0.1, 1.0), True),
+    "ceil": (np.ceil, (-1, 1), False),
+    "floor": (np.floor, (-1, 1), False),
+    "rint": (np.rint, (-1, 1), False),
+    "round": (np.round, (-1, 1), False),
+    "fix": (np.fix, (-1, 1), False),
+    "trunc": (np.trunc, (-1, 1), False),
+    "logical_not": (lambda x: (x == 0).astype(np.float32), (-1, 1), False),
+}
+for name, (fn, dom, diff) in UNARY.items():
+    x = f32((2, 3), *dom)
+    spec(name, [x], oracle=lambda x, _fn=fn: _fn(x),
+         grad_args=(0,) if diff else (), rtol=1e-4, atol=1e-5)
+
+# -- binary broadcast --------------------------------------------------------
+BINARY = {
+    "broadcast_add": (np.add, True),
+    "broadcast_sub": (np.subtract, True),
+    "broadcast_mul": (np.multiply, True),
+    "broadcast_div": (np.divide, True),
+    "broadcast_mod": (np.fmod, False),
+    "broadcast_maximum": (np.maximum, False),
+    "broadcast_minimum": (np.minimum, False),
+    "broadcast_hypot": (np.hypot, True),
+    "broadcast_power": (np.power, True),
+    "broadcast_equal": (lambda a, b: (a == b).astype(np.float32), False),
+    "broadcast_not_equal": (lambda a, b: (a != b).astype(np.float32), False),
+    "broadcast_greater": (lambda a, b: (a > b).astype(np.float32), False),
+    "broadcast_greater_equal":
+        (lambda a, b: (a >= b).astype(np.float32), False),
+    "broadcast_lesser": (lambda a, b: (a < b).astype(np.float32), False),
+    "broadcast_lesser_equal":
+        (lambda a, b: (a <= b).astype(np.float32), False),
+    "broadcast_logical_and":
+        (lambda a, b: ((a != 0) & (b != 0)).astype(np.float32), False),
+    "broadcast_logical_or":
+        (lambda a, b: ((a != 0) | (b != 0)).astype(np.float32), False),
+    "broadcast_logical_xor":
+        (lambda a, b: ((a != 0) ^ (b != 0)).astype(np.float32), False),
+}
+for name, (fn, diff) in BINARY.items():
+    a, b = f32((2, 3), 0.5, 1.5), f32((1, 3), 0.5, 1.5)
+    spec(name, [a, b], oracle=fn, grad_args=(0, 1) if diff else ())
+
+spec("_grad_add", [f32((2, 3)), f32((2, 3))], oracle=np.add,
+     grad_args=(0, 1))
+spec("add_n", [f32((2, 3)), f32((2, 3)), f32((2, 3))],
+     oracle=lambda *xs: sum(xs), grad_args=(0, 1, 2))
+
+# -- scalar ops --------------------------------------------------------------
+SCALAR = {
+    "_plus_scalar": (lambda x, s: x + s, True),
+    "_minus_scalar": (lambda x, s: x - s, True),
+    "_rminus_scalar": (lambda x, s: s - x, True),
+    "_mul_scalar": (lambda x, s: x * s, True),
+    "_div_scalar": (lambda x, s: x / s, True),
+    "_rdiv_scalar": (lambda x, s: s / x, True),
+    "_mod_scalar": (lambda x, s: np.fmod(x, s), False),
+    "_rmod_scalar": (lambda x, s: np.fmod(s, x), False),
+    "_power_scalar": (lambda x, s: np.power(x, s), True),
+    "_rpower_scalar": (lambda x, s: np.power(s, x), True),
+    "_maximum_scalar": (lambda x, s: np.maximum(x, s), False),
+    "_minimum_scalar": (lambda x, s: np.minimum(x, s), False),
+    "_hypot_scalar": (lambda x, s: np.hypot(x, s), True),
+    "_equal_scalar": (lambda x, s: (x == s).astype(np.float32), False),
+    "_not_equal_scalar": (lambda x, s: (x != s).astype(np.float32), False),
+    "_greater_scalar": (lambda x, s: (x > s).astype(np.float32), False),
+    "_greater_equal_scalar":
+        (lambda x, s: (x >= s).astype(np.float32), False),
+    "_lesser_scalar": (lambda x, s: (x < s).astype(np.float32), False),
+    "_lesser_equal_scalar":
+        (lambda x, s: (x <= s).astype(np.float32), False),
+}
+for name, (fn, diff) in SCALAR.items():
+    x = f32((2, 3), 0.6, 1.6)
+    spec(name, [x], attrs={"scalar": 1.3},
+         oracle=lambda x, scalar, _fn=fn: _fn(x, scalar),
+         grad_args=(0,) if diff else ())
+
+spec("smooth_l1", [f32((2, 3), 0.3, 2.0)], attrs={"scalar": 1.0},
+     oracle=lambda x, scalar: np.where(
+         np.abs(x) < 1.0 / scalar**2,
+         0.5 * (scalar * x)**2, np.abs(x) - 0.5 / scalar**2),
+     grad_args=(0,))
+spec("clip", [f32((2, 3), -2, 2)], attrs={"a_min": -0.5, "a_max": 0.5},
+     oracle=lambda x, a_min, a_max: np.clip(x, a_min, a_max))
+
+# -- reductions --------------------------------------------------------------
+REDUCE = {
+    "sum": np.sum, "mean": np.mean, "prod": np.prod, "nansum": np.nansum,
+    "nanprod": np.nanprod, "max": np.max, "min": np.min,
+}
+for name, fn in REDUCE.items():
+    x = f32((2, 3, 2), 0.4, 1.4)
+    diff = name in ("sum", "mean", "max", "min")
+    spec(name, [x], oracle=lambda x, _fn=fn: _fn(x),
+         grad_args=(0,) if name in ("sum", "mean") else ())
+    spec(name, [x], attrs={"axis": 1},
+         oracle=lambda x, axis, _fn=fn: _fn(x, axis=axis))
+    spec(name, [x], attrs={"axis": (0, 2), "keepdims": True},
+         oracle=lambda x, axis, keepdims, _fn=fn:
+         _fn(x, axis=axis, keepdims=keepdims))
+
+spec("argmax", [f32((3, 4))], attrs={"axis": 1},
+     oracle=lambda x, axis: np.argmax(x, axis=axis).astype(np.float32))
+spec("argmin", [f32((3, 4))], attrs={"axis": 1},
+     oracle=lambda x, axis: np.argmin(x, axis=axis).astype(np.float32))
+spec("argmax_channel", [f32((3, 4))],
+     oracle=lambda x: np.argmax(x, axis=1).astype(np.float32))
+spec("norm", [f32((3, 4))],
+     oracle=lambda x: np.sqrt((x * x).sum())[None], grad_args=(0,))
+spec("_square_sum", [f32((3, 4))], attrs={"axis": 1},
+     oracle=lambda x, axis: (x * x).sum(axis=axis), grad_args=(0,))
+
+# -- softmax family ----------------------------------------------------------
+def _np_softmax(x, axis=-1):
+    e = np.exp(x - x.max(axis=axis, keepdims=True))
+    return e / e.sum(axis=axis, keepdims=True)
+
+spec("softmax", [f32((3, 4))], oracle=lambda x: _np_softmax(x),
+     grad_args=(0,))
+spec("log_softmax", [f32((3, 4))],
+     oracle=lambda x: np.log(_np_softmax(x)), grad_args=(0,))
+_xe_x, _xe_l = f32((3, 4)), np.array([0, 2, 1], np.float32)
+spec("softmax_cross_entropy", [_xe_x, _xe_l],
+     oracle=lambda x, l: np.array(
+         [-np.log(_np_softmax(x))[np.arange(3), l.astype(int)].sum()],
+         np.float32),
+     grad_args=(0,))
+
+# -- shape/matrix ops --------------------------------------------------------
+spec("reshape", [f32((2, 6))], attrs={"shape": (3, 4)},
+     oracle=lambda x, shape: x.reshape(shape), grad_args=(0,))
+spec("Flatten", [f32((2, 3, 2))],
+     oracle=lambda x: x.reshape(2, 6), grad_args=(0,))
+spec("transpose", [f32((2, 3, 4))], attrs={"axes": (2, 0, 1)},
+     oracle=lambda x, axes: x.transpose(axes), grad_args=(0,))
+spec("SwapAxis", [f32((2, 3, 4))], attrs={"dim1": 0, "dim2": 2},
+     oracle=lambda x, dim1, dim2: np.swapaxes(x, dim1, dim2),
+     grad_args=(0,))
+spec("expand_dims", [f32((2, 3))], attrs={"axis": 1},
+     oracle=lambda x, axis: np.expand_dims(x, axis), grad_args=(0,))
+spec("squeeze", [f32((2, 1, 3))], attrs={"axis": 1},
+     oracle=lambda x, axis: np.squeeze(x, axis))
+spec("slice", [f32((4, 5))], attrs={"begin": (1, 0), "end": (3, 4)},
+     oracle=lambda x, begin, end: x[1:3, 0:4], grad_args=(0,))
+spec("slice_axis", [f32((4, 5))], attrs={"axis": 1, "begin": 1, "end": 4},
+     oracle=lambda x, axis, begin, end: x[:, 1:4], grad_args=(0,))
+spec("slice_like", [f32((4, 5)), f32((2, 3))],
+     oracle=lambda x, ref: x[:2, :3])
+spec("_index", [f32((4, 5))], attrs={"index": (1,)},
+     oracle=lambda x, index: x[1])
+spec("_slice_assign", [f32((4, 4)), f32((2, 2))],
+     attrs={"begin": (1, 1), "end": (3, 3)},
+     oracle=lambda x, y, begin, end: _np_slice_assign(x, y))
+def _np_slice_assign(x, y):
+    out = x.copy()
+    out[1:3, 1:3] = y
+    return out
+spec("_crop_assign_scalar", [f32((4, 4))],
+     attrs={"begin": (1, 1), "end": (3, 3), "scalar": 7.0},
+     oracle=lambda x, begin, end, scalar: _np_crop_assign(x, scalar))
+def _np_crop_assign(x, s):
+    out = x.copy()
+    out[1:3, 1:3] = s
+    return out
+spec("repeat", [f32((2, 3))], attrs={"repeats": 2, "axis": 1},
+     oracle=lambda x, repeats, axis: np.repeat(x, repeats, axis),
+     grad_args=(0,))
+spec("tile", [f32((2, 3))], attrs={"reps": (2, 2)},
+     oracle=lambda x, reps: np.tile(x, reps), grad_args=(0,))
+spec("reverse", [f32((3, 4))], attrs={"axis": 1},
+     oracle=lambda x, axis: x[:, ::-1], grad_args=(0,))
+spec("stack", [f32((2, 3)), f32((2, 3))], attrs={"axis": 1},
+     oracle=lambda a, b, axis: np.stack([a, b], axis), grad_args=(0, 1))
+spec("Concat", [f32((2, 3)), f32((2, 2))], attrs={"dim": 1},
+     oracle=lambda a, b, dim: np.concatenate([a, b], dim),
+     grad_args=(0, 1))
+spec("SliceChannel", [f32((2, 6))], attrs={"num_outputs": 3, "axis": 1},
+     oracle=lambda x, num_outputs, axis:
+         [x[:, 0:2], x[:, 2:4], x[:, 4:6]], grad_args=(0,))
+_w_c = (R.uniform(size=(2, 3)) > 0.5).astype(np.float32)
+spec("where", [_w_c, f32((2, 3)), f32((2, 3))],
+     oracle=lambda c, x, y: np.where(c != 0, x, y), grad_args=(1, 2))
+spec("broadcast_axis", [f32((2, 1, 3))], attrs={"axis": 1, "size": 4},
+     oracle=lambda x, axis, size: np.broadcast_to(x, (2, 4, 3)),
+     grad_args=(0,))
+spec("broadcast_to", [f32((2, 1))], attrs={"shape": (2, 3)},
+     oracle=lambda x, shape: np.broadcast_to(x, shape), grad_args=(0,))
+spec("broadcast_like", [f32((2, 1)), f32((2, 3))],
+     oracle=lambda x, ref: np.broadcast_to(x, ref.shape))
+spec("Pad", [f32((1, 2, 3, 3))],
+     attrs={"mode": "constant",
+            "pad_width": (0, 0, 0, 0, 1, 1, 1, 1), "constant_value": 0.5},
+     oracle=lambda x, mode, pad_width, constant_value: np.pad(
+         x, ((0, 0), (0, 0), (1, 1), (1, 1)), mode="constant",
+         constant_values=constant_value), grad_args=(0,))
+spec("Crop", [f32((1, 2, 5, 5))], attrs={"offset": (1, 1), "h_w": (3, 3),
+                                         "num_args": 1},
+     oracle=lambda x, offset, h_w, num_args: x[:, :, 1:4, 1:4])
+spec("_copy", [f32((2, 3))], oracle=lambda x: x, grad_args=(0,))
+spec("BlockGrad", [f32((2, 3))], oracle=lambda x: x)
+spec("make_loss", [f32((2, 3))], oracle=lambda x: x, grad_args=(0,))
+spec("Cast", [f32((2, 3))], attrs={"dtype": "float64"},
+     oracle=lambda x, dtype: x.astype(np.float64))
+spec("_identity_with_attr_like_rhs", [f32((2, 3)), f32((2, 3))],
+     oracle=lambda x, r: x)
+spec("IdentityAttachKLSparseReg", [f32((2, 3))], oracle=lambda x: x)
+spec("zeros_like", [f32((2, 3))], oracle=np.zeros_like)
+spec("ones_like", [f32((2, 3))], oracle=np.ones_like)
+spec("shuffle", [f32((6, 2))],
+     oracle=None)  # checked separately: permutation property
+
+# -- dot/linalg --------------------------------------------------------------
+spec("dot", [f32((2, 3)), f32((3, 4))], oracle=np.dot, grad_args=(0, 1))
+spec("dot", [f32((3, 2)), f32((3, 4))], attrs={"transpose_a": True},
+     oracle=lambda a, b, transpose_a: a.T @ b, grad_args=(0, 1))
+spec("batch_dot", [f32((2, 2, 3)), f32((2, 3, 4))],
+     oracle=lambda a, b: np.einsum("bij,bjk->bik", a, b),
+     grad_args=(0, 1))
+spec("_linalg_gemm", [f32((2, 3)), f32((3, 4)), f32((2, 4))],
+     attrs={"alpha": 2.0, "beta": 0.5},
+     oracle=lambda a, b, c, alpha, beta: alpha * (a @ b) + beta * c,
+     grad_args=(0, 1, 2))
+spec("_linalg_gemm2", [f32((2, 3)), f32((3, 4))],
+     oracle=lambda a, b: a @ b, grad_args=(0, 1))
+_spd = np.array([[2.0, 0.5], [0.5, 1.5]], np.float32)
+_tri = np.array([[1.5, 0.0], [0.5, 2.0]], np.float32)
+spec("_linalg_potrf", [_spd],
+     oracle=lambda a: np.linalg.cholesky(a))
+spec("_linalg_potri", [_tri],
+     oracle=lambda a: np.linalg.inv(np.tril(a) @ np.tril(a).T),
+     rtol=1e-3, atol=1e-4)
+spec("_linalg_trmm", [_tri, f32((2, 2))],
+     oracle=lambda a, b: np.tril(a) @ b)
+spec("_linalg_trsm", [_tri, f32((2, 2))],
+     oracle=lambda a, b: np.linalg.solve(np.tril(a), b), rtol=1e-3)
+spec("_linalg_syrk", [f32((2, 3))],
+     oracle=lambda a: a @ a.T)
+spec("_linalg_sumlogdiag", [_spd],
+     oracle=lambda a: np.array([np.log(np.diag(a)).sum()], np.float32))
+spec("_linalg_gelqf", [f32((2, 3))], oracle=None)  # property-checked below
+spec("khatri_rao", [f32((2, 3)), f32((4, 3))],
+     oracle=lambda a, b: np.vstack([np.kron(a[:, j], b[:, j])
+                                    for j in range(3)]).T.reshape(8, 3)
+     if False else np.concatenate(
+         [(a[:, j][:, None] * b[:, j][None, :]).reshape(-1, 1)
+          for j in range(3)], axis=1))
+
+# -- indexing ----------------------------------------------------------------
+_emb_idx = np.array([0, 2, 1], np.float32)
+_emb_w = f32((3, 4))
+spec("Embedding", [_emb_idx, _emb_w],
+     attrs={"input_dim": 3, "output_dim": 4},
+     oracle=lambda i, w, input_dim, output_dim: w[i.astype(int)])
+spec("take", [f32((4, 3)), np.array([0, 3, 1], np.float32)],
+     oracle=lambda a, i: a[i.astype(int)])
+spec("batch_take", [f32((3, 4)), np.array([1, 0, 3], np.float32)],
+     oracle=lambda a, i: a[np.arange(3), i.astype(int)])
+spec("pick", [f32((3, 4)), np.array([1, 0, 3], np.float32)],
+     oracle=lambda a, i: a[np.arange(3), i.astype(int)])
+spec("one_hot", [np.array([0, 2, 1], np.float32)], attrs={"depth": 4},
+     oracle=lambda i, depth: np.eye(depth, dtype=np.float32)[
+         i.astype(int)])
+spec("gather_nd", [f32((3, 4)), np.array([[0, 2], [1, 3]], np.float32)],
+     oracle=lambda a, i: a[i[0].astype(int), i[1].astype(int)])
+spec("scatter_nd", [f32((2,)), np.array([[0, 2], [1, 3]], np.float32)],
+     attrs={"shape": (3, 4)},
+     oracle=lambda d, i, shape: _np_scatter(d, i, shape))
+def _np_scatter(d, i, shape):
+    out = np.zeros(shape, np.float32)
+    out[i[0].astype(int), i[1].astype(int)] = d
+    return out
+spec("_sparse_retain", [f32((4, 3)), np.array([0, 2], np.float32)],
+     oracle=lambda d, i: _np_retain(d, i))
+def _np_retain(d, i):
+    out = np.zeros_like(d)
+    out[i.astype(int)] = d[i.astype(int)]
+    return out
+
+# -- ordering ----------------------------------------------------------------
+spec("sort", [f32((3, 4))],
+     oracle=lambda x: np.sort(x, axis=-1))
+spec("sort", [f32((3, 4))], attrs={"is_ascend": False},
+     oracle=lambda x, is_ascend: -np.sort(-x, axis=-1))
+spec("argsort", [f32((3, 4))],
+     oracle=lambda x: np.argsort(x, axis=-1).astype(np.float32))
+spec("topk", [f32((3, 5))], attrs={"k": 2},
+     oracle=lambda x, k: np.argsort(-x, axis=-1)[:, :k].astype(
+         np.float32))
+spec("topk", [f32((3, 5))], attrs={"k": 2, "ret_typ": "value"},
+     oracle=lambda x, k, ret_typ: -np.sort(-x, axis=-1)[:, :k])
+
+# -- neural net --------------------------------------------------------------
+_fc_x, _fc_w, _fc_b = f32((3, 5)), f32((4, 5)), f32((4,))
+spec("FullyConnected", [_fc_x, _fc_w, _fc_b], attrs={"num_hidden": 4},
+     oracle=lambda x, w, b, num_hidden: x @ w.T + b,
+     grad_args=(0, 1, 2))
+
+
+def _np_conv(x, w, b, stride=1, pad=0):
+    n, ci, h, ww_ = x.shape
+    co, _, kh, kw = w.shape
+    xp = np.pad(x, ((0, 0), (0, 0), (pad, pad), (pad, pad)))
+    oh = (h + 2 * pad - kh) // stride + 1
+    ow = (ww_ + 2 * pad - kw) // stride + 1
+    out = np.zeros((n, co, oh, ow), np.float32)
+    for i in range(oh):
+        for j in range(ow):
+            patch = xp[:, :, i * stride:i * stride + kh,
+                       j * stride:j * stride + kw]
+            out[:, :, i, j] = np.einsum("ncij,ocij->no", patch, w)
+    return out + (b[None, :, None, None] if b is not None else 0)
+
+
+_cv_x, _cv_w, _cv_b = f32((2, 3, 5, 5)), f32((4, 3, 3, 3)), f32((4,))
+spec("Convolution", [_cv_x, _cv_w, _cv_b],
+     attrs={"kernel": (3, 3), "num_filter": 4, "pad": (1, 1)},
+     oracle=lambda x, w, b, kernel, num_filter, pad:
+         _np_conv(x, w, b, 1, 1),
+     grad_args=(1, 2), rtol=1e-3, atol=1e-4,
+     grad_rtol=5e-2, grad_atol=3e-3)
+spec("Convolution", [_cv_x, _cv_w, _cv_b],
+     attrs={"kernel": (3, 3), "num_filter": 4, "stride": (2, 2)},
+     oracle=lambda x, w, b, kernel, num_filter, stride:
+         _np_conv(x, w, b, 2, 0), rtol=1e-3, atol=1e-4)
+
+
+def _np_pool(x, k, stride, mode="max"):
+    n, c, h, w = x.shape
+    oh = (h - k) // stride + 1
+    ow = (w - k) // stride + 1
+    out = np.zeros((n, c, oh, ow), np.float32)
+    for i in range(oh):
+        for j in range(ow):
+            patch = x[:, :, i * stride:i * stride + k,
+                      j * stride:j * stride + k]
+            out[:, :, i, j] = patch.max((2, 3)) if mode == "max" \
+                else patch.mean((2, 3))
+    return out
+
+
+spec("Pooling", [f32((2, 3, 4, 4))],
+     attrs={"kernel": (2, 2), "stride": (2, 2), "pool_type": "max"},
+     oracle=lambda x, kernel, stride, pool_type: _np_pool(x, 2, 2, "max"))
+spec("Pooling", [f32((2, 3, 4, 4))],
+     attrs={"kernel": (2, 2), "stride": (2, 2), "pool_type": "avg"},
+     oracle=lambda x, kernel, stride, pool_type: _np_pool(x, 2, 2, "avg"))
+spec("Pooling", [f32((2, 3, 4, 4))],
+     attrs={"kernel": (2, 2), "global_pool": True, "pool_type": "max"},
+     oracle=lambda x, kernel, global_pool, pool_type:
+         x.max((2, 3), keepdims=True))
+
+_bn_x = f32((2, 3, 4, 4))
+_bn_g, _bn_b = f32((3,), 0.5, 1.5), f32((3,))
+_bn_mm, _bn_mv = np.zeros(3, np.float32), np.ones(3, np.float32)
+spec("BatchNorm", [_bn_x, _bn_g, _bn_b, _bn_mm, _bn_mv],
+     attrs={"is_train": False, "eps": 1e-3, "fix_gamma": False},
+     oracle=lambda x, g, b, mm, mv, is_train, eps, fix_gamma:
+         g[None, :, None, None] * (x - mm[None, :, None, None]) /
+         np.sqrt(mv[None, :, None, None] + eps) + b[None, :, None, None],
+     rtol=1e-3, atol=1e-4)
+spec("LayerNorm", [f32((3, 5)), f32((5,), 0.5, 1.5), f32((5,))],
+     oracle=lambda x, g, b: g * (x - x.mean(-1, keepdims=True)) /
+         np.sqrt(x.var(-1, keepdims=True) + 1e-5) + b,
+     rtol=1e-3, atol=1e-4)
+spec("InstanceNorm", [f32((2, 3, 4)), f32((3,), 0.5, 1.5), f32((3,))],
+     oracle=lambda x, g, b: g[None, :, None] *
+         (x - x.mean(-1, keepdims=True)) /
+         np.sqrt(x.var(-1, keepdims=True) + 1e-3) + b[None, :, None],
+     rtol=1e-3, atol=1e-4)
+spec("L2Normalization", [f32((2, 6))],
+     oracle=lambda x: x / np.sqrt((x * x).sum(1, keepdims=True) + 1e-10),
+     grad_args=(0,))
+spec("LRN", [f32((2, 5, 3, 3))], attrs={"nsize": 3},
+     oracle=None)  # formula checked via eager-vs-jit only
+spec("Activation", [f32((2, 3))], attrs={"act_type": "relu"},
+     oracle=lambda x, act_type: np.maximum(x, 0))
+spec("Activation", [f32((2, 3))], attrs={"act_type": "tanh"},
+     oracle=lambda x, act_type: np.tanh(x))
+spec("Activation", [f32((2, 3))], attrs={"act_type": "sigmoid"},
+     oracle=lambda x, act_type: 1 / (1 + np.exp(-x)))
+spec("Activation", [f32((2, 3))], attrs={"act_type": "softrelu"},
+     oracle=lambda x, act_type: np.log1p(np.exp(x)))
+spec("LeakyReLU", [f32((2, 3))], attrs={"act_type": "leaky",
+                                        "slope": 0.1},
+     oracle=lambda x, act_type, slope: np.where(x > 0, x, slope * x))
+spec("LeakyReLU", [f32((2, 3))], attrs={"act_type": "elu", "slope": 0.3},
+     oracle=lambda x, act_type, slope:
+         np.where(x > 0, x, slope * np.expm1(x)))
+spec("SoftmaxActivation", [f32((3, 4))],
+     oracle=lambda x: _np_softmax(x))
+spec("Dropout", [f32((2, 3))], attrs={"p": 0.0},
+     oracle=lambda x, p: x)
+spec("Dropout", [f32((2, 3))], attrs={"p": 0.5, "is_train": False},
+     oracle=lambda x, p, is_train: x)
+spec("UpSampling", [f32((1, 2, 2, 2))],
+     attrs={"scale": 2, "sample_type": "nearest", "num_args": 1},
+     oracle=lambda x, scale, sample_type, num_args:
+         x.repeat(2, 2).repeat(2, 3))
+
+_sq_data = f32((4, 2, 3))   # (seq, batch, feat)
+_sq_len = np.array([2, 4], np.float32)
+spec("SequenceMask", [_sq_data, _sq_len],
+     attrs={"use_sequence_length": True, "value": 0.0},
+     oracle=lambda d, l, use_sequence_length, value: _np_seq_mask(d, l))
+def _np_seq_mask(d, l):
+    out = d.copy()
+    for b, n in enumerate(l.astype(int)):
+        out[n:, b] = 0.0
+    return out
+spec("SequenceLast", [_sq_data, _sq_len],
+     attrs={"use_sequence_length": True},
+     oracle=lambda d, l, use_sequence_length:
+         np.stack([d[int(n) - 1, b] for b, n in enumerate(l)], 0))
+spec("SequenceReverse", [_sq_data, _sq_len],
+     attrs={"use_sequence_length": True},
+     oracle=lambda d, l, use_sequence_length: _np_seq_rev(d, l))
+def _np_seq_rev(d, l):
+    out = d.copy()
+    for b, n in enumerate(l.astype(int)):
+        out[:n, b] = d[:n, b][::-1]
+    return out
+
+# -- losses ------------------------------------------------------------------
+_lbl3 = np.array([0, 2, 1], np.float32)
+spec("SoftmaxOutput", [f32((3, 4)), _lbl3],
+     oracle=lambda x, l: _np_softmax(x))
+spec("LinearRegressionOutput", [f32((3, 2)), f32((3, 2))],
+     oracle=lambda x, l: x)
+spec("LogisticRegressionOutput", [f32((3, 2)), f32((3, 2))],
+     oracle=lambda x, l: 1 / (1 + np.exp(-x)))
+spec("MAERegressionOutput", [f32((3, 2)), f32((3, 2))],
+     oracle=lambda x, l: x)
+spec("MakeLoss", [f32((3, 2), 0.1, 1.0)], oracle=lambda x: x)
+spec("SVMOutput", [f32((3, 4)), _lbl3], oracle=lambda x, l: x)
+
+# -- optimizer updates -------------------------------------------------------
+_w0, _g0 = f32((3, 2)), f32((3, 2))
+spec("sgd_update", [_w0, _g0], attrs={"lr": 0.1, "wd": 0.01},
+     oracle=lambda w, g, lr, wd: w - lr * (g + wd * w))
+_m0 = f32((3, 2))
+spec("sgd_mom_update", [_w0, _g0, _m0],
+     attrs={"lr": 0.1, "momentum": 0.9, "wd": 0.01},
+     oracle=lambda w, g, m, lr, momentum, wd: _np_sgd_mom(w, g, m)[0])
+def _np_sgd_mom(w, g, m, lr=0.1, mom=0.9, wd=0.01):
+    m2 = mom * m - lr * (g + wd * w)
+    return w + m2, m2
+spec("signsgd_update", [_w0, _g0], attrs={"lr": 0.1},
+     oracle=lambda w, g, lr: w - lr * np.sign(g))
+_mean0, _var0 = f32((3, 2), 0.0, 0.1), f32((3, 2), 0.0, 0.1)
+spec("adam_update", [_w0, _g0, _mean0, _var0],
+     attrs={"lr": 0.1, "beta1": 0.9, "beta2": 0.999, "epsilon": 1e-8},
+     oracle=lambda w, g, m, v, lr, beta1, beta2, epsilon:
+         w - lr * (beta1 * m + (1 - beta1) * g) /
+         (np.sqrt(beta2 * v + (1 - beta2) * g * g) + epsilon))
+_n0 = f32((3, 2), 0.0, 0.1)
+spec("rmsprop_update", [_w0, _g0, _n0],
+     attrs={"lr": 0.1, "gamma1": 0.95, "epsilon": 1e-8},
+     oracle=lambda w, g, n, lr, gamma1, epsilon:
+         w - lr * g / np.sqrt(gamma1 * n + (1 - gamma1) * g * g + epsilon))
+spec("rmspropalex_update",
+     [_w0, _g0, _n0, f32((3, 2), 0.0, 0.1), f32((3, 2), 0.0, 0.1)],
+     attrs={"lr": 0.1}, oracle=None)
+spec("ftrl_update", [_w0, _g0, f32((3, 2), 0.0, 0.1),
+                     f32((3, 2), 0.0, 0.1)],
+     attrs={"lr": 0.1}, oracle=None)
+spec("mp_sgd_update", [_w0, _g0, _w0.astype(np.float32)],
+     attrs={"lr": 0.1, "wd": 0.01},
+     oracle=lambda w, g, w32, lr, wd: (w32 - lr * (g + wd * w32)))
+spec("mp_sgd_mom_update", [_w0, _g0, _m0, _w0.astype(np.float32)],
+     attrs={"lr": 0.1, "momentum": 0.9, "wd": 0.01}, oracle=None)
+
+# -- init ops (no tensor inputs) --------------------------------------------
+spec("_zeros", [], attrs={"shape": (2, 3)},
+     oracle=lambda shape: np.zeros(shape, np.float32))
+spec("_ones", [], attrs={"shape": (2, 3)},
+     oracle=lambda shape: np.ones(shape, np.float32))
+spec("_full", [], attrs={"shape": (2, 3), "value": 2.5},
+     oracle=lambda shape, value: np.full(shape, value, np.float32))
+spec("_arange", [], attrs={"start": 1.0, "stop": 7.0, "step": 2.0},
+     oracle=lambda start, stop, step: np.arange(1.0, 7.0, 2.0,
+                                                dtype=np.float32))
+spec("_eye", [], attrs={"N": 3, "M": 4, "k": 1},
+     oracle=lambda N, M, k: np.eye(N, M, k, dtype=np.float32))
+
+# -- random samplers: moment checks ------------------------------------------
+RANDOM_MOMENTS = {
+    # name, attrs, expected mean, sd of estimator bound
+    "_random_uniform": ({"low": 0.0, "high": 1.0, "shape": (4000,)}, 0.5,
+                        0.05),
+    "_random_normal": ({"loc": 1.0, "scale": 1.0, "shape": (4000,)}, 1.0,
+                       0.08),
+    "_random_exponential": ({"lam": 2.0, "shape": (4000,)}, 0.5, 0.05),
+    "_random_gamma": ({"alpha": 2.0, "beta": 1.0, "shape": (4000,)}, 2.0,
+                      0.15),
+    "_random_poisson": ({"lam": 3.0, "shape": (4000,)}, 3.0, 0.15),
+    "_random_negative_binomial": ({"k": 4, "p": 0.5, "shape": (4000,)},
+                                  4.0, 0.3),
+    "_random_generalized_negative_binomial":
+        ({"mu": 2.0, "alpha": 0.3, "shape": (4000,)}, 2.0, 0.3),
+}
+
+SAMPLE_VEC = {
+    "_sample_uniform": ([np.array([0.0, 1.0], np.float32),
+                         np.array([1.0, 3.0], np.float32)],
+                        np.array([0.5, 2.0])),
+    "_sample_normal": ([np.array([0.0, 2.0], np.float32),
+                        np.array([1.0, 0.5], np.float32)],
+                       np.array([0.0, 2.0])),
+    "_sample_exponential": ([np.array([1.0, 4.0], np.float32)],
+                            np.array([1.0, 0.25])),
+    "_sample_gamma": ([np.array([2.0, 3.0], np.float32),
+                       np.array([1.0, 2.0], np.float32)],
+                      np.array([2.0, 6.0])),
+    "_sample_poisson": ([np.array([2.0, 5.0], np.float32)],
+                        np.array([2.0, 5.0])),
+    "_sample_negative_binomial": ([np.array([4.0, 2.0], np.float32),
+                                   np.array([0.5, 0.5], np.float32)],
+                                  np.array([4.0, 2.0])),
+    "_sample_generalized_negative_binomial":
+        ([np.array([2.0, 3.0], np.float32),
+          np.array([0.2, 0.2], np.float32)], np.array([2.0, 3.0])),
+}
+
+# ops verified by their own dedicated tests elsewhere / not point-testable
+EXEMPT = {
+    "Deconvolution",       # covered in test_ops_nn
+    "Dropout",             # train-mode distribution checked below
+    "Embedding",
+    "sample_multinomial",  # distribution checked below
+    "shuffle",             # permutation checked below
+    "cast_storage",        # sparse tests
+    "_linalg_gelqf",       # property checked below
+    "LRN",                 # eager-vs-jit only
+    "CTCLoss",             # tests/test_ctc.py
+    "RNN",                 # tests/test_rnn_op.py
+}
+
+
+# ---------------------------------------------------------------------------
+# the tests
+# ---------------------------------------------------------------------------
+ALL_CASES = [(name, i) for name, cases in sorted(SPECS.items())
+             for i in range(len(cases))]
+
+
+ALL_IDS = ["%s-%d" % p for p in ALL_CASES]
+
+
+@pytest.mark.parametrize("name,idx", ALL_CASES, ids=ALL_IDS)
+def test_forward_vs_numpy(name, idx):
+    case = SPECS[name][idx]
+    if case["oracle"] is None:
+        pytest.skip("no oracle")
+    ins = [nd.array(x) for x in case["inputs"]]
+    out = getattr(nd, name)(*ins, **case["attrs"])
+    want = case["oracle"](*case["inputs"], **case["attrs"])
+    outs = out if isinstance(out, list) else [out]
+    wants = want if isinstance(want, list) else [want]
+    assert len(outs) >= len(wants)
+    for o, w in zip(outs, wants):
+        np.testing.assert_allclose(o.asnumpy(), np.asarray(w),
+                                   rtol=case["rtol"], atol=case["atol"],
+                                   err_msg=name)
+
+
+@pytest.mark.parametrize("name,idx", ALL_CASES, ids=ALL_IDS)
+def test_eager_vs_jit(name, idx):
+    """Interpret-mode vs jit-compiled output of the raw kernel."""
+    case = SPECS[name][idx]
+    op = registry.get_op(name)
+    attrs = registry.canon_attrs(op, case["attrs"])
+    if op.takes_is_train and "is_train" not in attrs:
+        attrs["is_train"] = False
+    arrays = [jnp.asarray(x) for x in case["inputs"]]
+    if op.needs_rng:
+        key = jax.random.PRNGKey(3)
+        with jax.disable_jit():
+            eager = op.fn(*arrays, rng=key, **attrs)
+        jitted = registry.jitted_op(op, attrs)(key, *arrays)
+    else:
+        with jax.disable_jit():
+            eager = op.fn(*arrays, **attrs)
+        jitted = registry.jitted_op(op, attrs)(*arrays)
+    flat_e = jax.tree_util.tree_leaves(eager)
+    flat_j = jax.tree_util.tree_leaves(jitted)
+    assert len(flat_e) == len(flat_j)
+    for e, j in zip(flat_e, flat_j):
+        np.testing.assert_allclose(np.asarray(e), np.asarray(j),
+                                   rtol=1e-4, atol=1e-5, err_msg=name)
+
+
+GRAD_CASES = [(name, i) for name, cases in sorted(SPECS.items())
+              for i, c in enumerate(cases) if c["grad_args"]]
+
+
+@pytest.mark.parametrize("name,idx", GRAD_CASES,
+                         ids=["%s-%d" % p for p in GRAD_CASES])
+def test_gradient_vs_finite_difference(name, idx):
+    case = SPECS[name][idx]
+    op = registry.get_op(name)
+    assert op.differentiable, "%s spec requests grad but op is nondiff" \
+        % name
+    wrt = list(case["grad_args"])
+    fixed = {i: nd.array(x) for i, x in enumerate(case["inputs"])
+             if i not in wrt}
+    attrs = case["attrs"]
+
+    def f(free):
+        args = []
+        it = iter(free)
+        for i in range(len(case["inputs"])):
+            args.append(next(it) if i in wrt else fixed[i])
+        out = getattr(nd, name)(*args, **attrs)
+        if isinstance(out, list):
+            out = out[0]
+        return out
+
+    check_numeric_gradient(
+        f, [nd.array(case["inputs"][i]) for i in wrt],
+        rtol=case["grad_rtol"], atol=case["grad_atol"])
+
+
+@pytest.mark.parametrize("name", sorted(RANDOM_MOMENTS), ids=str)
+def test_random_moments(name):
+    attrs, want_mean, tol = RANDOM_MOMENTS[name]
+    mx.random.seed(5)
+    out = getattr(nd, name)(**attrs).asnumpy()
+    assert out.shape == attrs["shape"]
+    assert abs(out.mean() - want_mean) < 3 * tol, \
+        (name, out.mean(), want_mean)
+
+
+@pytest.mark.parametrize("name", sorted(SAMPLE_VEC), ids=str)
+def test_sample_vec_moments(name):
+    params, want_means = SAMPLE_VEC[name]
+    mx.random.seed(6)
+    out = getattr(nd, name)(*[nd.array(p) for p in params],
+                            shape=(3000,)).asnumpy()
+    assert out.shape == (len(want_means), 3000)
+    got = out.mean(axis=1)
+    np.testing.assert_allclose(got, want_means, rtol=0.25, atol=0.15)
+
+
+def test_shuffle_is_permutation():
+    x = np.arange(12, dtype=np.float32).reshape(6, 2)
+    mx.random.seed(3)
+    y = nd.shuffle(nd.array(x)).asnumpy()
+    np.testing.assert_array_equal(
+        np.sort(y.ravel()), np.sort(x.ravel()))
+
+
+def test_sample_multinomial_distribution():
+    probs = np.array([[0.8, 0.1, 0.1], [0.1, 0.1, 0.8]], np.float32)
+    mx.random.seed(4)
+    out = nd.sample_multinomial(nd.array(probs), shape=(500,)).asnumpy()
+    assert out.shape == (2, 500)
+    assert (out[0] == 0).mean() > 0.6
+    assert (out[1] == 2).mean() > 0.6
+
+
+def test_dropout_train_mode_scales():
+    x = np.ones((50, 50), np.float32)
+    from mxnet_tpu import autograd
+    mx.random.seed(11)
+    with autograd.train_mode():
+        y = nd.Dropout(nd.array(x), p=0.5).asnumpy()
+    kept = y != 0
+    assert 0.35 < kept.mean() < 0.65
+    np.testing.assert_allclose(y[kept], 2.0, rtol=1e-6)
+
+
+def test_gelqf_property():
+    a = f32((2, 3))
+    q, l = nd._linalg_gelqf(nd.array(a))   # reference order: Q, L
+    lq = l.asnumpy() @ q.asnumpy()
+    np.testing.assert_allclose(lq, a, rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(q.asnumpy() @ q.asnumpy().T, np.eye(2),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_sweep_coverage():
+    """>=90% of registered primary ops must carry a spec or be exempt
+    (exempt ops are verified by dedicated tests)."""
+    primary = set(registry._OP_REGISTRY)
+    covered = set(SPECS) | set(RANDOM_MOMENTS) | set(SAMPLE_VEC) | EXEMPT
+    missing = sorted(primary - covered)
+    frac = 1.0 - len(missing) / len(primary)
+    assert frac >= 0.90, "op sweep coverage %.1f%% — missing: %s" % (
+        100 * frac, missing)

@@ -1,0 +1,84 @@
+"""Fused RNN op vs numpy step loops (the cuDNN-RNN replacement,
+reference src/operator/rnn-inl.h semantics; cuDNN packed-blob layout)."""
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+from mxnet_tpu.ops.rnn_op import rnn_param_size
+
+
+def _sigmoid(x):
+    return 1.0 / (1.0 + np.exp(-x))
+
+
+def test_rnn_tanh_single_layer():
+    T, N, I, H = 4, 2, 3, 5
+    rng = np.random.RandomState(0)
+    x = rng.randn(T, N, I).astype(np.float32)
+    n_par = rnn_param_size("rnn_tanh", I, H, 1, False)
+    par = rng.uniform(-0.3, 0.3, n_par).astype(np.float32)
+    h0 = np.zeros((1, N, H), np.float32)
+
+    out = nd.RNN(nd.array(x), nd.array(par), nd.array(h0),
+                 state_size=H, num_layers=1, mode="rnn_tanh").asnumpy()
+
+    # unpack blob: w_i2h (H,I), w_h2h (H,H), then b_i2h (H,), b_h2h (H,)
+    pos = 0
+    w_i2h = par[pos:pos + H * I].reshape(H, I); pos += H * I
+    w_h2h = par[pos:pos + H * H].reshape(H, H); pos += H * H
+    b_i2h = par[pos:pos + H]; pos += H
+    b_h2h = par[pos:pos + H]
+    h = np.zeros((N, H), np.float32)
+    want = []
+    for t in range(T):
+        h = np.tanh(x[t] @ w_i2h.T + b_i2h + h @ w_h2h.T + b_h2h)
+        want.append(h)
+    np.testing.assert_allclose(out, np.stack(want), rtol=1e-5, atol=1e-5)
+
+
+def test_lstm_single_layer_states():
+    T, N, I, H = 3, 2, 4, 3
+    rng = np.random.RandomState(1)
+    x = rng.randn(T, N, I).astype(np.float32)
+    n_par = rnn_param_size("lstm", I, H, 1, False)
+    par = rng.uniform(-0.3, 0.3, n_par).astype(np.float32)
+    h0 = np.zeros((1, N, H), np.float32)
+    c0 = np.zeros((1, N, H), np.float32)
+
+    outs = nd.RNN(nd.array(x), nd.array(par), nd.array(h0), nd.array(c0),
+                  state_size=H, num_layers=1, mode="lstm",
+                  state_outputs=True)
+    y, hT, cT = [o.asnumpy() for o in outs]
+
+    pos = 0
+    w_i2h = par[pos:pos + 4 * H * I].reshape(4 * H, I); pos += 4 * H * I
+    w_h2h = par[pos:pos + 4 * H * H].reshape(4 * H, H); pos += 4 * H * H
+    b_i2h = par[pos:pos + 4 * H]; pos += 4 * H
+    b_h2h = par[pos:pos + 4 * H]
+    h = np.zeros((N, H), np.float32)
+    c = np.zeros((N, H), np.float32)
+    want = []
+    for t in range(T):
+        g = x[t] @ w_i2h.T + b_i2h + h @ w_h2h.T + b_h2h
+        i_g, f_g, g_g, o_g = np.split(g, 4, axis=1)
+        i_g, f_g, o_g = _sigmoid(i_g), _sigmoid(f_g), _sigmoid(o_g)
+        c = f_g * c + i_g * np.tanh(g_g)
+        h = o_g * np.tanh(c)
+        want.append(h)
+    np.testing.assert_allclose(y, np.stack(want), rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(hT[0], h, rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(cT[0], c, rtol=1e-5, atol=1e-5)
+
+
+def test_bidirectional_output_width():
+    T, N, I, H = 3, 2, 4, 3
+    rng = np.random.RandomState(2)
+    x = rng.randn(T, N, I).astype(np.float32)
+    n_par = rnn_param_size("gru", I, H, 2, True)
+    par = rng.uniform(-0.3, 0.3, n_par).astype(np.float32)
+    h0 = np.zeros((4, N, H), np.float32)  # L*D = 2*2
+    out = nd.RNN(nd.array(x), nd.array(par), nd.array(h0),
+                 state_size=H, num_layers=2, bidirectional=True,
+                 mode="gru").asnumpy()
+    assert out.shape == (T, N, 2 * H)
+    assert np.all(np.isfinite(out))

@@ -1,0 +1,140 @@
+"""SSD workload smoke (BASELINE config #5 shape): mini SSD trains
+end-to-end — ImageDetIter -> MultiBoxPrior/Target heads -> Module-style
+forward/backward/update — and the training loss decreases.
+
+Reference: example/ssd/train/train_net.py + symbol/symbol_builder.py.
+"""
+import os
+import tempfile
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import io, nd, recordio
+from mxnet_tpu import sym as S
+from mxnet_tpu import image as img_mod
+
+
+def _mini_ssd_symbol(num_classes=3, num_anchor_shapes=3):
+    """Tiny SSD train graph: one feature map, one anchor set."""
+    data = S.Variable("data")
+    label = S.Variable("label")
+
+    c1 = S.Activation(S.Convolution(data, name="c1", num_filter=8,
+                                    kernel=(3, 3), stride=(2, 2),
+                                    pad=(1, 1)), act_type="relu")
+    feat = S.Activation(S.Convolution(c1, name="c2", num_filter=16,
+                                      kernel=(3, 3), stride=(2, 2),
+                                      pad=(1, 1)), act_type="relu")
+
+    K, C = num_anchor_shapes, num_classes + 1
+    cls_head = S.Convolution(feat, name="cls_head", num_filter=K * C,
+                             kernel=(3, 3), pad=(1, 1))
+    loc_head = S.Convolution(feat, name="loc_head", num_filter=K * 4,
+                             kernel=(3, 3), pad=(1, 1))
+
+    # (B, K*C, H, W) -> (B, C, A): channel-last flatten then class split
+    cls_pred = S.transpose(cls_head, axes=(0, 2, 3, 1))
+    cls_pred = S.reshape(S.Flatten(cls_pred), shape=(0, -1, C))
+    cls_pred = S.transpose(cls_pred, axes=(0, 2, 1))
+    loc_pred = S.Flatten(S.transpose(loc_head, axes=(0, 2, 3, 1)))
+
+    anchors = S._contrib_MultiBoxPrior(feat, sizes=(0.3, 0.6),
+                                       ratios=(1.0, 2.0), clip=True)
+    tgt = S._contrib_MultiBoxTarget(anchors, label, cls_pred,
+                                    overlap_threshold=0.4,
+                                    negative_mining_ratio=3.0)
+    loc_target, loc_mask, cls_target = tgt[0], tgt[1], tgt[2]
+
+    cls_prob = S.SoftmaxOutput(cls_pred, cls_target, multi_output=True,
+                               use_ignore=True, ignore_label=-1.0,
+                               normalization="valid", name="cls_prob")
+    loc_diff = loc_mask * (loc_pred - loc_target)
+    loc_loss = S.MakeLoss(S.smooth_l1(loc_diff, scalar=1.0),
+                          grad_scale=1.0, normalization="valid",
+                          name="loc_loss")
+    return S.Group([cls_prob, loc_loss, S.BlockGrad(cls_target)])
+
+
+def _make_det_rec(tmp, n=16, size=32):
+    rec = os.path.join(tmp, "ssd.rec")
+    idx = os.path.join(tmp, "ssd.idx")
+    w = recordio.MXIndexedRecordIO(idx, rec, "w")
+    rng = np.random.RandomState(0)
+    for i in range(n):
+        cls = i % 3
+        img = np.full((size, size, 3), 30 * (cls + 1), np.uint8)
+        img += rng.randint(0, 20, img.shape).astype(np.uint8)
+        # one box per image, class-dependent position
+        box = [0.1 + 0.2 * cls, 0.2, 0.4 + 0.2 * cls, 0.7]
+        label = np.array([2, 5, cls, *box], np.float32)
+        packed = recordio.pack_img(recordio.IRHeader(0, label, i, 0),
+                                   img, img_fmt=".png")
+        w.write_idx(i, packed)
+    w.close()
+    return rec
+
+
+def test_ssd_trains_end_to_end():
+    batch = 8
+    with tempfile.TemporaryDirectory() as tmp:
+        rec = _make_det_rec(tmp, n=16)
+        it = img_mod.ImageDetIter(batch_size=batch,
+                                  data_shape=(3, 32, 32),
+                                  path_imgrec=rec)
+        train_sym = _mini_ssd_symbol()
+
+        mod = mx.mod.Module(train_sym, data_names=("data",),
+                            label_names=("label",), context=mx.cpu())
+        first = next(it)
+        it.reset()
+        mod.bind(data_shapes=[("data", first.data[0].shape)],
+                 label_shapes=[("label", first.label[0].shape)])
+        mod.init_params(mx.initializer.Xavier())
+        mod.init_optimizer(optimizer="sgd",
+                           optimizer_params={"learning_rate": 0.1,
+                                             "momentum": 0.9})
+
+        losses = []
+        for epoch in range(6):
+            it.reset()
+            total, count = 0.0, 0
+            for b in it:
+                mod.forward(b, is_train=True)
+                cls_prob, loc_loss, cls_target = \
+                    [o.asnumpy() for o in mod.get_outputs()]
+                mod.backward()
+                mod.update()
+                # monitored loss: cls NLL over non-ignored anchors + loc
+                tgt = cls_target.astype(int)
+                valid = tgt >= 0
+                b_idx, a_idx = np.nonzero(valid)
+                p = cls_prob[b_idx, tgt[b_idx, a_idx], a_idx]
+                nll = -np.log(np.maximum(p, 1e-9)).mean()
+                total += nll + loc_loss.sum()
+                count += 1
+            losses.append(total / count)
+
+        assert np.isfinite(losses).all(), losses
+        assert losses[-1] < losses[0] * 0.7, losses
+
+        # detection inference path over the trained weights
+        arg, aux = mod.get_params()
+        infer_data = S.Variable("data")
+        # rebuild heads for inference reusing weights by name
+        test_sym = _mini_ssd_symbol()
+        # run detection from the train graph's pieces eagerly instead:
+        it.reset()
+        b = next(it)
+        mod.forward(b, is_train=False)
+        cls_prob = mod.get_outputs()[0]
+        feat_anchors = nd._contrib_MultiBoxPrior(
+            nd.zeros((1, 16, 8, 8)), sizes=(0.3, 0.6), ratios=(1.0, 2.0),
+            clip=True)
+        # loc_pred from a fresh forward of the loc head is inside the
+        # graph; use zeros to at least exercise the op end-to-end
+        det = nd._contrib_MultiBoxDetection(
+            cls_prob, nd.zeros((batch, feat_anchors.shape[1] * 4)),
+            feat_anchors, nms_threshold=0.45)
+        assert det.shape == (batch, feat_anchors.shape[1], 6)
