@@ -72,6 +72,9 @@ from . import module
 from . import module as mod
 from .module import Module
 
+from . import rnn
+from . import operator
+
 from . import recordio
 from . import image
 from . import image as img

@@ -382,10 +382,20 @@ class FusedRNN(Initializer):
                                      self._mode, self._bidirectional,
                                      forget_bias=self._forget_bias,
                                      prefix="")
+        init_fn = self._init or getattr(desc, "global_init", None)
+        if init_fn is None:
+            raise ValueError(
+                "FusedRNN(init=None) needs an InitDesc with global_init")
         args = cell.unpack_weights({"parameters": arr.copy()})
         for name in args:
-            desc_i = InitDesc(name, getattr(desc, "attrs", {}))
-            # only lstm has forget-gate bias baked by unpack; init others
-            if self._mode != "lstm" or not name.endswith("_f_bias"):
-                self._init(desc_i, args[name])
+            # fresh attrs: inheriting the parent's __init__ attr would
+            # re-dispatch back into this initializer
+            desc_i = InitDesc(name, global_init=getattr(
+                desc, "global_init", None))
+            if self._mode == "lstm" and name.endswith("_f_bias"):
+                # forget-gate bias lives in the i2h bias (same convention
+                # as LSTMCell + LSTMBias); h2h forget bias stays zero
+                args[name][:] = self._forget_bias if "i2h" in name else 0.0
+            else:
+                init_fn(desc_i, args[name])
         arr[:] = cell.pack_weights(args)["parameters"]

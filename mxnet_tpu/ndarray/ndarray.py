@@ -116,6 +116,9 @@ class NDArray:
         arr = np.asarray(jax.device_get(self._data))
         if self._data.dtype == jnp.bfloat16:
             arr = arr.astype(np.float32)
+        if not arr.flags.writeable:
+            # reference semantics: asnumpy returns a fresh, mutable copy
+            arr = arr.copy()
         return arr
 
     def asscalar(self):

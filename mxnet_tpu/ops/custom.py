@@ -1,0 +1,154 @@
+"""The ``Custom`` operator — user-defined Python ops inside the compiled
+graph.
+
+Reference: src/operator/custom/custom.cc + python/mxnet/operator.py. The
+reference marshals Python callbacks through the C ABI and runs them with
+``ExecType::kLocal``; the TPU-native path is ``jax.pure_callback`` (the
+XLA host-callback mechanism) wrapped in ``jax.custom_vjp`` so the user's
+``backward`` drives autograd. The callback is a host round-trip by
+construction — exactly like the reference, where Custom ops synchronize
+with the Python GIL — so it is an escape hatch, not a fast path.
+
+The user-facing classes (CustomOp/CustomOpProp/register) live in
+mxnet_tpu/operator.py; this module holds the prop registry and the
+registry-op glue so it exists before the nd/sym namespaces are stamped.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+
+from .registry import register
+
+_PROP_REGISTRY: dict[str, type] = {}
+_PROP_CACHE: dict[tuple, object] = {}
+
+
+def register_prop(reg_name, prop_cls):
+    _PROP_REGISTRY[reg_name] = prop_cls
+    for key in [k for k in _PROP_CACHE if k[0] == reg_name]:
+        del _PROP_CACHE[key]
+
+
+def create_prop(op_type, kwargs):
+    """Prop instance for (op_type, kwargs) — cached, since num_outputs /
+    shape-inference queries hit this several times per graph node."""
+    if op_type not in _PROP_REGISTRY:
+        raise KeyError(
+            "custom op type %r is not registered — decorate its "
+            "CustomOpProp with @mx.operator.register(%r)"
+            % (op_type, op_type))
+    try:
+        key = (op_type, tuple(sorted(kwargs.items())))
+        hash(key)
+    except TypeError:
+        return _PROP_REGISTRY[op_type](**kwargs)
+    if key not in _PROP_CACHE:
+        _PROP_CACHE[key] = _PROP_REGISTRY[op_type](**kwargs)
+    return _PROP_CACHE[key]
+
+
+def _infer(prop, in_shapes, in_dtypes):
+    """Run the prop's shape/type inference; returns (in_shapes,
+    out_shapes, in_dtypes, out_dtypes) as plain tuples."""
+    shape_res = prop.infer_shape([list(s) for s in in_shapes])
+    ishapes, oshapes = shape_res[0], shape_res[1]
+    aux = shape_res[2] if len(shape_res) > 2 else []
+    if aux:
+        raise NotImplementedError(
+            "auxiliary states on Custom ops are not supported on the TPU "
+            "backend (the functional compiled graph has no mutable slots "
+            "for host-managed aux); thread such state through explicit "
+            "outputs instead")
+    type_res = prop.infer_type(list(in_dtypes))
+    itypes, otypes = type_res[0], type_res[1]
+    return ([tuple(int(d) for d in s) for s in ishapes],
+            [tuple(int(d) for d in s) for s in oshapes],
+            list(itypes), list(otypes))
+
+
+@register("Custom", arg_names=None, takes_is_train=True,
+          defaults={"op_type": None})
+def _custom(*inputs, op_type=None, is_train=False, **kwargs):
+    """Lower one Custom node: forward and backward both run the user's
+    Python through pure_callback; custom_vjp stitches them into AD."""
+    from .. import ndarray as nd
+
+    prop = create_prop(op_type, kwargs)
+    n_out = len(prop.list_outputs())
+    in_shapes = [tuple(int(d) for d in x.shape) for x in inputs]
+    in_dtypes = [np.dtype(jax.dtypes.canonicalize_dtype(x.dtype))
+                 for x in inputs]
+    _ishapes, oshapes, itypes, otypes = _infer(prop, in_shapes, in_dtypes)
+    out_structs = tuple(jax.ShapeDtypeStruct(s, np.dtype(t))
+                        for s, t in zip(oshapes, otypes))
+    in_structs = tuple(jax.ShapeDtypeStruct(s, np.dtype(t))
+                       for s, t in zip(in_shapes, itypes))
+    cop = prop.create_operator(None, _ishapes, itypes)
+
+    def host_forward(*np_ins):
+        in_data = [nd.array(a) for a in np_ins]
+        out_data = [nd.zeros(s.shape, dtype=s.dtype) for s in out_structs]
+        cop.forward(is_train, ["write"] * n_out, in_data, out_data, [])
+        return tuple(o.asnumpy().astype(s.dtype, copy=False)
+                     for o, s in zip(out_data, out_structs))
+
+    @jax.custom_vjp
+    def run(*ins):
+        return jax.pure_callback(host_forward, out_structs, *ins,
+                                 vmap_method="sequential")
+
+    def run_fwd(*ins):
+        outs = run(*ins)
+        return outs, (ins, outs)
+
+    def run_bwd(res, gouts):
+        ins, outs = res
+
+        def host_backward(*flat):
+            np_ins = flat[:len(in_structs)]
+            np_outs = flat[len(in_structs):len(in_structs) + n_out]
+            np_gouts = flat[len(in_structs) + n_out:]
+            in_data = [nd.array(a) for a in np_ins]
+            out_data = [nd.array(a) for a in np_outs]
+            out_grad = [nd.array(a) for a in np_gouts]
+            in_grad = [nd.zeros(s.shape, dtype=s.dtype)
+                       for s in in_structs]
+            cop.backward(["write"] * len(in_structs), out_grad, in_data,
+                         out_data, in_grad, [])
+            return tuple(g.asnumpy().astype(s.dtype, copy=False)
+                         for g, s in zip(in_grad, in_structs))
+
+        gins = jax.pure_callback(host_backward, in_structs,
+                                 *ins, *outs, *gouts,
+                                 vmap_method="sequential")
+        return tuple(gins)
+
+    run.defvjp(run_fwd, run_bwd)
+    outs = run(*inputs)
+    return tuple(outs) if n_out > 1 else outs[0]
+
+
+from .registry import set_param_shapes  # noqa: E402  (after registration)
+
+
+def custom_num_outputs(attrs):
+    """Output count of a Custom node (Symbol num_outputs hook)."""
+    kwargs = {k: v for k, v in attrs.items() if k != "op_type"}
+    return len(create_prop(attrs.get("op_type"), kwargs).list_outputs())
+
+
+def custom_param_shapes(shapes, attrs):
+    """Backward shape inference: let the prop fill unknown input shapes
+    (e.g. an auto-created label variable)."""
+    kwargs = {k: v for k, v in attrs.items() if k != "op_type"}
+    prop = create_prop(attrs.get("op_type"), kwargs)
+    known = [list(s) if s is not None else None for s in shapes]
+    if known and known[0] is not None:
+        res = prop.infer_shape(known)
+        return [tuple(s) if s is not None else None for s in res[0]]
+    return shapes
+
+
+set_param_shapes("Custom", custom_param_shapes)

@@ -247,12 +247,18 @@ def _decode_boxes(anchors, loc_pred, variances, clip):
 
 
 def _detect_one(cls_prob, loc_pred, anchors, threshold, clip, variances,
-                nms_threshold, force_suppress, nms_topk):
+                nms_threshold, force_suppress, nms_topk, background_id):
     """One batch element. cls_prob (C,A), loc_pred (A*4,), anchors (A,4)
-    -> (A, 6) rows [class_id, score, x1, y1, x2, y2], invalid rows -1."""
+    -> (A, 6) rows [class_id, score, x1, y1, x2, y2], invalid rows -1.
+    Output ids renumber foreground classes with background_id skipped
+    (the reference CPU kernel, multibox_detection.cc:107, hardcodes
+    background to class 0; honouring background_id generalizes that)."""
     C, A = cls_prob.shape
-    scores = jnp.max(cls_prob[1:], axis=0)               # best non-bg
-    ids = jnp.argmax(cls_prob[1:], axis=0) + 1
+    fg = jnp.arange(C) != background_id
+    masked = jnp.where(fg[:, None], cls_prob, -jnp.inf)
+    scores = jnp.max(masked, axis=0)                     # best non-bg
+    ids = jnp.argmax(masked, axis=0)
+    out_ids = jnp.where(ids > background_id, ids - 1, ids)
     valid = scores >= threshold
 
     boxes = _decode_boxes(anchors, loc_pred.reshape(A, 4), variances,
@@ -262,8 +268,8 @@ def _detect_one(cls_prob, loc_pred, anchors, threshold, clip, variances,
     order = jnp.argsort(-key)
     s_valid = valid[order]
     s_rows = jnp.concatenate(
-        [(ids[order] - 1.0)[:, None], scores[order][:, None],
-         boxes[order]], axis=1)
+        [out_ids[order].astype(cls_prob.dtype)[:, None],
+         scores[order][:, None], boxes[order]], axis=1)
     s_rows = jnp.where(s_valid[:, None], s_rows, -1.0)
 
     if nms_topk > 0:
@@ -303,7 +309,8 @@ def _multibox_detection(cls_prob, loc_pred, anchor, clip=True,
     anchors = anchor.reshape(-1, 4)
     f = lambda cp, lp: _detect_one(cp, lp, anchors, threshold, clip,
                                    variances, nms_threshold,
-                                   force_suppress, nms_topk)
+                                   force_suppress, nms_topk,
+                                   background_id)
     return jax.vmap(f)(cls_prob, loc_pred)
 
 

@@ -152,8 +152,16 @@ def _rnn_op(data, parameters, state, state_cell=None, state_size=0,
         for d in range(dirs):
             p_ld = params[(layer, d)]
             sidx = layer * dirs + d
-            h0 = state[sidx]
-            carry = (h0, state_cell[sidx]) if mode == "lstm" else (h0,)
+            # initial states may carry a broadcast batch dim of 1 (the
+            # symbolic RNN toolkit's begin_state zeros) — expand so the
+            # scan carry shape is static
+            def _full_batch(s):
+                if s.shape[0] != batch:
+                    return jnp.broadcast_to(s, (batch,) + s.shape[1:])
+                return s
+            h0 = _full_batch(state[sidx])
+            carry = (h0, _full_batch(state_cell[sidx])) \
+                if mode == "lstm" else (h0,)
             xs = x[::-1] if d == 1 else x
 
             def scan_fn(carry, x_t, _p=p_ld):

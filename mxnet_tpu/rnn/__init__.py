@@ -1,0 +1,6 @@
+"""Symbolic RNN toolkit (reference: python/mxnet/rnn/)."""
+from . import rnn_cell
+from .rnn_cell import (BaseRNNCell, RNNParams, RNNCell, LSTMCell, GRUCell,
+                       FusedRNNCell, SequentialRNNCell, BidirectionalCell,
+                       DropoutCell, ModifierCell, ZoneoutCell, ResidualCell)
+from .io import BucketSentenceIter, encode_sentences

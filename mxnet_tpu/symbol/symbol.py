@@ -63,6 +63,9 @@ def _num_outputs(opdef, attrs):
         return 2
     if name == "CTCLoss":
         return 1
+    if name == "Custom":
+        from ..ops.custom import custom_num_outputs
+        return custom_num_outputs(attrs)
     if opdef.num_visible is not None:
         return opdef.num_visible
     return 1

@@ -208,6 +208,24 @@ def test_multibox_detection_force_suppress_and_topk():
     assert (out2[:, 0] >= 0).sum() == 1
 
 
+def test_multibox_detection_background_id():
+    # class 2 is background: anchor 0's best foreground is class 0,
+    # anchor 1's is class 1 (renumbered to 1 — below background, so kept)
+    cls_prob = np.array([[[0.9, 0.1],
+                          [0.05, 0.6],
+                          [0.05, 0.3]]], np.float32)  # (1,3,2)
+    anchors = np.array([[[0.1, 0.1, 0.4, 0.4],
+                         [0.6, 0.6, 0.9, 0.9]]], np.float32)
+    loc_pred = np.zeros((1, 8), np.float32)
+    out = nd.MultiBoxDetection(
+        nd.array(cls_prob), nd.array(loc_pred), nd.array(anchors),
+        background_id=2, threshold=0.01,
+        nms_threshold=0.0).asnumpy()[0]
+    kept = out[out[:, 0] >= 0]
+    assert set(kept[:, 0].astype(int)) == {0, 1}
+    np.testing.assert_allclose(sorted(kept[:, 1]), [0.6, 0.9], rtol=1e-5)
+
+
 def test_roi_pooling_vs_numpy():
     rng = np.random.RandomState(1)
     data = rng.randn(2, 3, 8, 8).astype(np.float32)

@@ -1,0 +1,252 @@
+"""Symbolic RNN toolkit tests (reference: tests/python/unittest/test_rnn.py
+and the lstm_bucketing example, example/rnn/lstm_bucketing.py)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+
+
+def _bind_run(outputs, data, **extra):
+    ex = outputs.simple_bind(data=data.shape)
+    for name, arr in ex.arg_dict.items():
+        if name != "data" and name not in extra:
+            arr[:] = np.random.uniform(-0.2, 0.2, arr.shape)
+    for name, arr in extra.items():
+        ex.arg_dict[name][:] = arr
+    return ex, ex.forward(data=data)
+
+
+class TestCells:
+    def test_rnn_cell_shapes(self):
+        cell = mx.rnn.RNNCell(10, prefix="rnn_")
+        outputs, states = cell.unroll(3, mx.sym.Variable("data"),
+                                      merge_outputs=True)
+        assert sorted(outputs.list_arguments()) == sorted(
+            ["data", "rnn_i2h_weight", "rnn_i2h_bias", "rnn_h2h_weight",
+             "rnn_h2h_bias"])
+        _, outs = _bind_run(outputs, np.zeros((2, 3, 4), "float32"))
+        assert outs[0].shape == (2, 3, 10)
+
+    def test_lstm_cell_shapes(self):
+        cell = mx.rnn.LSTMCell(10, prefix="lstm_")
+        outputs, states = cell.unroll(3, mx.sym.Variable("data"),
+                                      merge_outputs=True)
+        assert len(states) == 2
+        _, outs = _bind_run(outputs, np.zeros((2, 3, 4), "float32"))
+        assert outs[0].shape == (2, 3, 10)
+
+    def test_gru_cell_shapes(self):
+        cell = mx.rnn.GRUCell(10)
+        outputs, _ = cell.unroll(3, mx.sym.Variable("data"),
+                                 merge_outputs=True)
+        _, outs = _bind_run(outputs, np.zeros((2, 3, 4), "float32"))
+        assert outs[0].shape == (2, 3, 10)
+
+    def test_unroll_list_inputs(self):
+        cell = mx.rnn.RNNCell(6)
+        ins = [mx.sym.Variable("t%d" % i) for i in range(3)]
+        outputs, _ = cell.unroll(3, ins)
+        assert isinstance(outputs, list) and len(outputs) == 3
+
+    def test_stacked(self):
+        stack = mx.rnn.SequentialRNNCell()
+        stack.add(mx.rnn.LSTMCell(8, prefix="l0_"))
+        stack.add(mx.rnn.LSTMCell(8, prefix="l1_"))
+        outputs, states = stack.unroll(3, mx.sym.Variable("data"),
+                                       merge_outputs=True)
+        assert len(states) == 4
+        _, outs = _bind_run(outputs, np.zeros((2, 3, 4), "float32"))
+        assert outs[0].shape == (2, 3, 8)
+
+    def test_bidirectional(self):
+        cell = mx.rnn.BidirectionalCell(mx.rnn.GRUCell(5, prefix="l_"),
+                                        mx.rnn.GRUCell(5, prefix="r_"))
+        outputs, _ = cell.unroll(3, mx.sym.Variable("data"),
+                                 merge_outputs=True)
+        _, outs = _bind_run(outputs, np.zeros((2, 3, 4), "float32"))
+        assert outs[0].shape == (2, 3, 10)
+
+    def test_residual(self):
+        cell = mx.rnn.ResidualCell(mx.rnn.GRUCell(4, prefix="res_"))
+        outputs, _ = cell.unroll(3, mx.sym.Variable("data"),
+                                 merge_outputs=True)
+        _, outs = _bind_run(outputs, np.zeros((2, 3, 4), "float32"))
+        assert outs[0].shape == (2, 3, 4)
+
+    def test_zoneout_and_dropout(self):
+        cell = mx.rnn.ZoneoutCell(mx.rnn.RNNCell(6, prefix="z_"), 0.3, 0.3)
+        outputs, _ = cell.unroll(3, mx.sym.Variable("data"),
+                                 merge_outputs=True)
+        _, outs = _bind_run(outputs, np.zeros((2, 3, 4), "float32"))
+        assert outs[0].shape == (2, 3, 6)
+
+        stack = mx.rnn.SequentialRNNCell()
+        stack.add(mx.rnn.LSTMCell(6, prefix="d0_"))
+        stack.add(mx.rnn.DropoutCell(0.5))
+        outputs, _ = stack.unroll(3, mx.sym.Variable("data"),
+                                  merge_outputs=True)
+        _, outs = _bind_run(outputs, np.zeros((2, 3, 4), "float32"))
+        assert outs[0].shape == (2, 3, 6)
+
+
+class TestFused:
+    @pytest.mark.parametrize("mode,bidir", [("lstm", False), ("gru", False),
+                                            ("rnn_tanh", False),
+                                            ("lstm", True), ("gru", True)])
+    def test_fused_matches_unfused(self, mode, bidir):
+        np.random.seed(0)
+        T, N, C, H = 4, 2, 3, 5
+        x = np.random.randn(N, T, C).astype("float32")
+        fused = mx.rnn.FusedRNNCell(H, num_layers=2, mode=mode,
+                                    bidirectional=bidir, prefix="f_")
+        fo, _ = fused.unroll(T, mx.sym.Variable("data"), layout="NTC",
+                             merge_outputs=True)
+        ex = fo.simple_bind(data=(N, T, C))
+        blob = np.random.uniform(-0.5, 0.5,
+                                 ex.arg_dict["f_parameters"].shape
+                                 ).astype("float32")
+        y_fused = ex.forward(data=x, f_parameters=blob)[0].asnumpy()
+
+        stack = fused.unfuse()
+        uo, _ = stack.unroll(T, mx.sym.Variable("data"), layout="NTC",
+                             merge_outputs=True)
+        cellargs = stack.pack_weights(fused.unpack_weights(
+            {"f_parameters": mx.nd.array(blob)}))
+        ex2 = uo.simple_bind(data=(N, T, C))
+        for k, v in cellargs.items():
+            ex2.arg_dict[k][:] = v.asnumpy()
+        y_unfused = ex2.forward(data=x)[0].asnumpy()
+        np.testing.assert_allclose(y_fused, y_unfused, rtol=1e-5, atol=1e-6)
+
+    def test_pack_roundtrip(self):
+        fused = mx.rnn.FusedRNNCell(5, num_layers=3, mode="lstm",
+                                    bidirectional=True, prefix="p_")
+        size = mx.ops.rnn_op.rnn_param_size("lstm", 7, 5, 3, True)
+        blob = np.random.randn(size).astype("float32")
+        unpacked = fused.unpack_weights({"p_parameters": mx.nd.array(blob)})
+        repacked = fused.pack_weights(unpacked)
+        np.testing.assert_array_equal(repacked["p_parameters"].asnumpy(),
+                                      blob)
+
+    def test_fused_state_outputs(self):
+        fused = mx.rnn.FusedRNNCell(6, num_layers=2, mode="lstm",
+                                    get_next_state=True, prefix="s_")
+        outputs, states = fused.unroll(3, mx.sym.Variable("data"),
+                                       layout="NTC", merge_outputs=True)
+        assert len(states) == 2
+        group = mx.sym.Group([outputs] + states)
+        ex = group.simple_bind(data=(2, 3, 4))
+        outs = ex.forward(
+            data=np.zeros((2, 3, 4), "float32"),
+            s_parameters=np.random.randn(
+                *ex.arg_dict["s_parameters"].shape).astype("float32"))
+        assert outs[0].shape == (2, 3, 6)
+        assert outs[1].shape == (2, 2, 6)
+        assert outs[2].shape == (2, 2, 6)
+
+
+class TestFusedInit:
+    def test_module_init_fused_blob(self):
+        """Module.init_params routes the fused blob through the FusedRNN
+        initializer (attr-driven), baking the lstm forget bias."""
+        fused = mx.rnn.FusedRNNCell(4, num_layers=1, mode="lstm",
+                                    prefix="f_")
+        out, _ = fused.unroll(2, mx.sym.Variable("data"),
+                              merge_outputs=True)
+        out = mx.sym.MakeLoss(mx.sym.sum(out))
+        mod = mx.mod.Module(out, ("data",), None)
+        mod.bind([mx.io.DataDesc("data", (2, 2, 3))], None)
+        mod.init_params(mx.init.Xavier())
+        blob = mod.get_params()[0]["f_parameters"]
+        unp = fused.unpack_weights({"f_parameters": blob})
+        np.testing.assert_array_equal(
+            unp["f_l0_i2h_f_bias"].asnumpy(), np.ones(4, "float32"))
+        assert unp["f_l0_i2h_i_weight"].asnumpy().std() > 0
+
+
+class TestBucketIO:
+    def test_encode_sentences(self):
+        sents = [["a", "b", "c"], ["b", "c"]]
+        enc, vocab = mx.rnn.encode_sentences(sents, start_label=1)
+        assert enc[0] == [vocab["a"], vocab["b"], vocab["c"]]
+        assert enc[1] == [vocab["b"], vocab["c"]]
+        assert min(v for k, v in vocab.items() if k != "\n") == 1
+
+    def test_bucket_sentence_iter(self):
+        np.random.seed(0)
+        sents = [[1] * int(n) for n in
+                 np.random.randint(1, 9, size=100)]
+        it = mx.rnn.BucketSentenceIter(sents, batch_size=4,
+                                       buckets=[4, 8], invalid_label=0)
+        seen = 0
+        for batch in it:
+            assert batch.bucket_key in (4, 8)
+            assert batch.data[0].shape == (4, batch.bucket_key)
+            assert batch.provide_data[0].shape == (4, batch.bucket_key)
+            seen += 1
+        assert seen > 0
+        it.reset()
+        assert sum(1 for _ in it) == seen
+
+    def test_label_is_shifted(self):
+        sents = [[5, 6, 7, 8]] * 4
+        it = mx.rnn.BucketSentenceIter(sents, batch_size=4, buckets=[4],
+                                       invalid_label=0)
+        batch = next(it)
+        np.testing.assert_array_equal(batch.data[0].asnumpy()[0],
+                                      [5, 6, 7, 8])
+        np.testing.assert_array_equal(batch.label[0].asnumpy()[0],
+                                      [6, 7, 8, 0])
+
+
+class TestPTBShapedTraining:
+    """Workload parity config #4 (SURVEY Appendix B): bucketed LSTM LM via
+    BucketingModule, perplexity decreasing."""
+
+    def test_bucketing_lstm_lm(self):
+        np.random.seed(0)
+        vocab = 16
+        # synthetic deterministic corpus: next token = (t + 1) % vocab
+        sents = []
+        for _ in range(60):
+            ln = np.random.choice([4, 6])
+            start = np.random.randint(0, vocab)
+            sents.append([(start + i) % vocab for i in range(ln)])
+        train = mx.rnn.BucketSentenceIter(sents, batch_size=8,
+                                          buckets=[4, 6], invalid_label=-1)
+
+        def sym_gen(seq_len):
+            data = mx.sym.Variable("data")
+            label = mx.sym.Variable("softmax_label")
+            embed = mx.sym.Embedding(data, input_dim=vocab, output_dim=8,
+                                     name="embed")
+            stack = mx.rnn.SequentialRNNCell()
+            stack.add(mx.rnn.LSTMCell(16, prefix="lstm_l0_"))
+            outputs, _ = stack.unroll(seq_len, embed, layout="NTC",
+                                      merge_outputs=True)
+            pred = mx.sym.Reshape(outputs, shape=(-1, 16))
+            pred = mx.sym.FullyConnected(pred, num_hidden=vocab, name="pred")
+            label = mx.sym.Reshape(label, shape=(-1,))
+            return (mx.sym.SoftmaxOutput(pred, label, name="softmax"),
+                    ("data",), ("softmax_label",))
+
+        mod = mx.mod.BucketingModule(sym_gen,
+                                     default_bucket_key=train.
+                                     default_bucket_key)
+        mod.bind(train.provide_data, train.provide_label)
+        mod.init_params(mx.init.Xavier())
+        mod.init_optimizer(optimizer="adam",
+                           optimizer_params=(("learning_rate", 0.05),))
+        metric = mx.metric.Perplexity(-1)
+
+        perps = []
+        for _epoch in range(8):
+            train.reset()
+            metric.reset()
+            for batch in train:
+                mod.forward(batch, is_train=True)
+                mod.backward()
+                mod.update()
+                mod.update_metric(metric, batch.label)
+            perps.append(metric.get()[1])
+        assert perps[-1] < perps[0] / 2, perps
