@@ -74,6 +74,9 @@ from .module import Module
 
 from . import rnn
 from . import operator
+from . import profiler
+from . import predictor
+from .predictor import Predictor
 
 from . import recordio
 from . import image

@@ -343,17 +343,21 @@ class Executor:
                                                self.aux_arrays)}
         rng = self._current_rng()
 
+        from . import profiler
+
         self._cached_grads = None
-        if self._monitor_active():
-            outs, new_aux = self._run_monitored(arg_vals, aux_vals, rng,
-                                                bool(is_train))
-        elif is_train and self._grad_names and self._prefer_fused:
-            outs, new_aux, grads = self._jit_fwd_bwd(arg_vals, aux_vals,
-                                                     rng)
-            self._cached_grads = grads
-        else:
-            outs, new_aux = self._jit_fwd(arg_vals, aux_vals, rng,
-                                          bool(is_train))
+        with profiler.scope("executor_forward%s" %
+                            ("_train" if is_train else ""), "executor"):
+            if self._monitor_active():
+                outs, new_aux = self._run_monitored(arg_vals, aux_vals,
+                                                    rng, bool(is_train))
+            elif is_train and self._grad_names and self._prefer_fused:
+                outs, new_aux, grads = self._jit_fwd_bwd(arg_vals,
+                                                         aux_vals, rng)
+                self._cached_grads = grads
+            else:
+                outs, new_aux = self._jit_fwd(arg_vals, aux_vals, rng,
+                                              bool(is_train))
         if is_train:
             for n, a in zip(self._aux_names, self.aux_arrays):
                 a._set_data(new_aux[n])

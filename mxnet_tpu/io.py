@@ -20,7 +20,7 @@ from . import ndarray
 from .ndarray import NDArray, array
 
 __all__ = ["DataDesc", "DataBatch", "DataIter", "NDArrayIter", "ResizeIter",
-           "PrefetchingIter"]
+           "PrefetchingIter", "LibSVMIter"]
 
 
 class DataDesc(namedtuple("DataDesc", ["name", "shape"])):
@@ -498,3 +498,112 @@ def ImageDetRecordIter(*args, **kwargs):
     kwargs.pop("prefetch_buffer", None)
     kwargs.pop("preprocess_threads", None)
     return _impl(*args, **kwargs)
+
+
+class LibSVMIter(DataIter):
+    """Batched reader of LibSVM-format text (``label idx:val ...``) as
+    csr batches (reference src/io/iter_libsvm.cc:200). The feature
+    matrix stays compressed end-to-end: each batch is a CSRNDArray slice
+    of the parsed corpus — no dense (batch, num_features) buffer unless
+    the consumer casts. Wrap-around padding matches round_batch=1.
+    """
+
+    def __init__(self, data_libsvm, data_shape, batch_size,
+                 label_libsvm=None, label_shape=(1,), round_batch=True,
+                 **_kwargs):
+        super().__init__(batch_size)
+        self._data_shape = (int(data_shape[0]) if not
+                            isinstance(data_shape, int) else
+                            int(data_shape),)
+        self._label_shape = ((int(label_shape),) if
+                             isinstance(label_shape, int)
+                             else tuple(int(d) for d in label_shape))
+        vals, cols, indptr, labels = self._parse(data_libsvm)
+        self._vals, self._cols, self._indptr = vals, cols, indptr
+        self._num = len(indptr) - 1
+        if label_libsvm is not None:
+            # separate libsvm-format label file: densify each sparse
+            # label row to label_shape (reference iter_libsvm.cc
+            # label_libsvm + label_shape)
+            lv, lc, lptr, _ = self._parse(label_libsvm)
+            width = 1
+            for d in self._label_shape:
+                width *= d
+            dense = np.zeros((len(lptr) - 1, width), np.float32)
+            for r in range(len(lptr) - 1):
+                seg = slice(lptr[r], lptr[r + 1])
+                dense[r, lc[seg]] = lv[seg]
+            labels = dense.reshape((-1,) + self._label_shape)
+        elif self._label_shape not in ((), (1,)):
+            raise ValueError("label_shape %r needs a label_libsvm file "
+                             "(the data file's leading token is a single "
+                             "scalar label)" % (self._label_shape,))
+        self._labels = labels
+        self._round_batch = round_batch
+        self.data_name, self.label_name = "data", "label"
+        self.reset()
+
+    @staticmethod
+    def _parse(path):
+        vals, cols, indptr, labels = [], [], [0], []
+        with open(path) as f:
+            for line in f:
+                parts = line.split()
+                if not parts:
+                    continue
+                labels.append(float(parts[0]))
+                for tok in parts[1:]:
+                    c, v = tok.split(":")
+                    cols.append(int(c))
+                    vals.append(float(v))
+                indptr.append(len(cols))
+        return (np.asarray(vals, np.float32), np.asarray(cols, np.int64),
+                np.asarray(indptr, np.int64),
+                np.asarray(labels, np.float32))
+
+    @property
+    def provide_data(self):
+        return [DataDesc(self.data_name,
+                         (self.batch_size,) + self._data_shape)]
+
+    @property
+    def provide_label(self):
+        shape = (self.batch_size,)
+        if self._label_shape not in ((), (1,)):
+            shape += self._label_shape
+        return [DataDesc(self.label_name, shape)]
+
+    def reset(self):
+        self._cursor = 0
+
+    def _rows(self, ids):
+        """CSR batch for the given row ids (host slicing of the parsed
+        corpus, device arrays in the result)."""
+        from .ndarray import sparse
+        counts = self._indptr[ids + 1] - self._indptr[ids]
+        indptr = np.concatenate([[0], np.cumsum(counts)])
+        take = np.concatenate(
+            [np.arange(self._indptr[i], self._indptr[i + 1])
+             for i in ids]) if len(ids) else np.zeros((0,), np.int64)
+        return sparse.CSRNDArray(
+            self._vals[take], self._cols[take], indptr,
+            (len(ids), self._data_shape[0]))
+
+    def next(self):
+        if self._cursor >= self._num:
+            raise StopIteration
+        end = self._cursor + self.batch_size
+        ids = np.arange(self._cursor, min(end, self._num))
+        pad = 0
+        if end > self._num:
+            if not self._round_batch:
+                raise StopIteration
+            pad = end - self._num
+            ids = np.concatenate([ids, np.arange(pad)])
+        self._cursor = end
+        from .ndarray import array as _arr
+        return DataBatch(
+            data=[self._rows(ids)],
+            label=[_arr(self._labels[ids])], pad=pad,
+            provide_data=self.provide_data,
+            provide_label=self.provide_label)

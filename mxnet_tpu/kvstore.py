@@ -106,6 +106,12 @@ class KVStore:
                 raise KeyError("key %r has not been initialized" % (k,))
             if len(vlist) == 1:
                 merged = vlist[0]
+            elif isinstance(vlist[0], ndarray.sparse.RowSparseNDArray):
+                # sparse reduce: union of touched rows, never densified
+                # (reference: CommCPU::ReduceRowSparse)
+                merged = vlist[0]
+                for v in vlist[1:]:
+                    merged = ndarray.sparse.add(merged, v)
             else:
                 # one fused reduction op; on a sharded mesh this is the
                 # all-reduce (reference: CommCPU::Reduce OMP tree sum)
@@ -124,29 +130,50 @@ class KVStore:
         assert out is not None
         keys, _ = _key_list(key)
         outs = _value_list(out, len(keys))
+        sparse = ndarray.sparse
         for k, olist in zip(keys, outs):
             if k not in self._store:
                 raise KeyError("key %r has not been initialized" % (k,))
             src = self._store[k]
             for o in olist:
+                if isinstance(src, sparse.BaseSparseNDArray):
+                    # sparse store + plain pull: broadcast densified copy
+                    # (sparse-to-sparse goes through row_sparse_pull)
+                    src.copyto(o)
+                    continue
                 o._set_data(src._data.astype(o._data.dtype)
                             if o.dtype != src.dtype else src._data)
 
     def row_sparse_pull(self, key, out=None, priority=0, row_ids=None):
         """Pull only the rows in row_ids (reference
-        kvstore.py:row_sparse_pull). Dense-gather emulation: XLA is
-        dense-first; the sparse path gathers rows then scatters on update."""
+        kvstore.py:row_sparse_pull): a row-sparse ``out`` receives
+        (values, indices) for exactly those rows — the dense weight is
+        never shipped; a dense ``out`` gets the gathered rows (the
+        comm win of the sparse path, kvstore_dist.h row_sparse)."""
         assert out is not None and row_ids is not None
         keys, _ = _key_list(key)
         outs = _value_list(out, len(keys))
         rids = row_ids if isinstance(row_ids, (list, tuple)) else [row_ids]
         if len(rids) == 1 and len(outs) > 1:
             rids = rids * len(outs)
+        sparse = ndarray.sparse
         for k, olist, rid in zip(keys, outs, rids):
             src = self._store[k]
-            taken = ndarray.take(src, rid)
+            src_sparse = isinstance(src, sparse.RowSparseNDArray)
             for o in olist:
-                o._set_data(taken._data)
+                if isinstance(o, sparse.RowSparseNDArray):
+                    if src_sparse:
+                        sparse.retain(src, rid).copyto(o)
+                    else:
+                        ids = rid.asnumpy().astype("int64") \
+                            if isinstance(rid, NDArray) else rid
+                        sparse.RowSparseNDArray(
+                            ndarray.take(src, rid)._data, ids,
+                            src.shape).copyto(o)
+                elif src_sparse:
+                    o._set_data(sparse._gather_rows(src, rid))
+                else:
+                    o._set_data(ndarray.take(src, rid)._data)
 
     # -- updater/optimizer -------------------------------------------------
     def set_updater(self, updater):

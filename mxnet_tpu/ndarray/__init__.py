@@ -20,3 +20,7 @@ from ..ops import registry as _reg
 for _name in _reg.list_ops():
     globals()[_name] = getattr(op, _name)
 del _name
+
+# sparse-aware dispatch over the generated entry points (the analogue of
+# the reference's FComputeEx storage-type dispatch)
+sparse._install_sparse_dispatch(globals(), op)

@@ -1,138 +1,591 @@
-"""Sparse NDArray storage types: row_sparse and csr.
+"""Sparse NDArray storage types: ``row_sparse`` and ``csr``.
 
-Reference: python/mxnet/ndarray/sparse.py + src/ndarray (stype kDefault/
-kRowSparse/kCSR). XLA/TPU is dense-first (SURVEY.md §7 hard part (c)), so
-the TPU-native design keeps a dense device buffer as the compute
-representation and materializes indices/indptr views on demand — sparse
-semantics (e.g. sparse_update, retain, row_sparse_pull) are expressed as
-gather/scatter which XLA lowers natively. This preserves the reference API
-while keeping every op on the MXU-friendly dense path.
+Reference: python/mxnet/ndarray/sparse.py (1014 LoC), storage types in
+include/mxnet/ndarray.h:82-87, sparse kernels in
+src/operator/tensor/dot-inl.h and cast_storage-inl.h.
+
+TPU-native design: sparse arrays CARRY their index structure —
+``RowSparseNDArray`` holds (values(nnz, ...), indices(nnz,)) and
+``CSRNDArray`` holds (values(nnz,), indices(nnz,), indptr(rows+1,)) as
+device arrays; the logical dense shape is metadata. Compute stays
+XLA-friendly because every sparse kernel here is a static-shape
+gather/segment_sum/scatter over the nnz axis (the MXU-relevant products,
+e.g. csr @ dense, become gather + segment-sum — no (rows, cols) dense
+buffer is ever materialized). Only *storage casting from dense* needs the
+data-dependent nnz and therefore runs on host, exactly where the
+reference synchronizes too (cast_storage allocates after counting).
+
+Inside ``jit``-compiled Symbol/Module graphs everything remains dense
+(XLA's static-shape discipline); this module is the imperative sparse
+surface — embedding-gradient updates, kvstore row_sparse_pull — which is
+also where the reference's FComputeEx sparse path lived.
 """
 from __future__ import annotations
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 
-from .ndarray import NDArray, _wrap, array
+from .ndarray import NDArray, _wrap
 
 __all__ = ["BaseSparseNDArray", "CSRNDArray", "RowSparseNDArray",
-           "csr_matrix", "row_sparse_array", "tostype", "zeros"]
+           "csr_matrix", "row_sparse_array", "tostype", "cast_storage",
+           "zeros", "empty", "array", "dot", "retain", "add",
+           "take_grad"]
+
+
+def _as_jnp(x, dtype=None):
+    if isinstance(x, NDArray):
+        x = x._data
+    out = jnp.asarray(x)
+    return out.astype(dtype) if dtype is not None else out
 
 
 class BaseSparseNDArray(NDArray):
-    __slots__ = ()
+    """Common behaviour: ``_data`` holds the *values* buffer; the logical
+    shape lives in ``_sshape``. Dense-only NDArray operations are
+    refused rather than silently run on the values buffer."""
 
-    def asdense(self):
-        return NDArray(self._data)
+    __slots__ = ("_sshape",)
+
+    # -- logical geometry ---------------------------------------------------
+    @property
+    def shape(self):
+        return self._sshape
+
+    @property
+    def size(self):
+        out = 1
+        for d in self._sshape:
+            out *= int(d)
+        return out
+
+    @property
+    def ndim(self):
+        return len(self._sshape)
+
+    @property
+    def data(self):
+        """The values array (reference sparse.py: .data)."""
+        return _wrap(self._data)
+
+    @property
+    def nnz(self):
+        return int(self._data.shape[0])
+
+    def asnumpy(self):
+        return self.todense().asnumpy()
+
+    def tostype(self, stype):
+        return tostype(self, stype)
 
     def __repr__(self):
-        shape_info = "x".join(str(s) for s in self.shape)
+        shape_info = "x".join(str(s) for s in self._sshape)
         return "\n<%s %s @%s>" % (type(self).__name__, shape_info,
                                   self.context)
 
+    def _deny(self, what):
+        raise TypeError("%s is not supported on %s — convert with "
+                        "tostype('default') first"
+                        % (what, type(self).__name__))
+
+    def __getitem__(self, key):
+        self._deny("indexing")
+
+    def __setitem__(self, key, value):
+        self._deny("assignment")
+
+    def attach_grad(self, grad_req="write", stype=None):
+        self._deny("attach_grad")
+
+    def __iter__(self):
+        self._deny("iteration")
+
+    # arithmetic: only what has a genuinely sparse meaning
+    def __mul__(self, other):
+        from ..base import numeric_types
+        if isinstance(other, numeric_types):
+            return self._with_values(self._data * other)
+        self._deny("multiplication by a non-scalar")
+
+    __rmul__ = __mul__
+
+    def __truediv__(self, other):
+        from ..base import numeric_types
+        if isinstance(other, numeric_types):
+            return self._with_values(self._data / other)
+        self._deny("division by a non-scalar")
+
+    def __neg__(self):
+        return self._with_values(-self._data)
+
+    def copy(self):
+        return self._with_values(self._data)
+
+    def astype(self, dtype, copy=True):
+        from ..base import np_dtype
+        return self._with_values(self._data.astype(np_dtype(dtype)))
+
 
 class RowSparseNDArray(BaseSparseNDArray):
-    """Rows mostly zero; ``indices`` lists the non-zero rows."""
-    __slots__ = ()
+    """Mostly-zero rows: values (nnz, *row_shape) + sorted row ``indices``
+    (nnz,). The representation of embedding gradients and
+    row_sparse_pull results (reference sparse.py:RowSparseNDArray)."""
 
-    def __init__(self, data, ctx=None):
-        super().__init__(data, ctx=ctx, stype="row_sparse")
+    __slots__ = ("_indices",)
+
+    def __init__(self, values, indices, shape, ctx=None):
+        values = _as_jnp(values)
+        indices = _as_jnp(indices, jnp.int32)
+        if indices.shape[0] > 1:
+            order = jnp.argsort(indices)
+            indices = indices[order]
+            values = values[order]
+        super().__init__(values, ctx=ctx, stype="row_sparse")
+        self._indices = indices
+        self._sshape = tuple(int(d) for d in shape)
 
     @property
     def indices(self):
-        nz = np.nonzero(np.any(self.asnumpy() != 0,
-                               axis=tuple(range(1, self.ndim))))[0]
-        return array(nz.astype(np.int64), dtype=np.int64)
+        return _wrap(self._indices)
 
-    @property
-    def data(self):
-        idx = self.indices.asnumpy().astype(np.int64)
-        return _wrap(self._data[idx])
+    def _with_values(self, values):
+        out = RowSparseNDArray.__new__(RowSparseNDArray)
+        NDArray.__init__(out, values, stype="row_sparse")
+        out._indices = self._indices
+        out._sshape = self._sshape
+        return out
 
-    def tostype(self, stype):
-        return tostype(self, stype)
+    def todense(self):
+        dense = jnp.zeros(self._sshape, self._data.dtype)
+        if self.nnz:
+            dense = dense.at[self._indices].set(self._data)
+        return _wrap(dense)
+
+    def retain(self, row_ids):
+        return retain(self, row_ids)
+
+    def __add__(self, other):
+        if isinstance(other, RowSparseNDArray):
+            return add(self, other)
+        self._deny("addition with %s" % type(other).__name__)
+
+    def copyto(self, other):
+        if isinstance(other, RowSparseNDArray):
+            other._set_data(self._data)
+            other._indices = self._indices
+            other._sshape = self._sshape
+            return other
+        if isinstance(other, NDArray):
+            other._set_data(self.todense()._data)
+            return other
+        raise TypeError("copyto does not support %r" % (other,))
 
 
 class CSRNDArray(BaseSparseNDArray):
-    """2D compressed-sparse-row array."""
-    __slots__ = ()
+    """2D compressed-sparse-row: values (nnz,), column ``indices`` (nnz,),
+    ``indptr`` (rows+1,)."""
 
-    def __init__(self, data, ctx=None):
-        super().__init__(data, ctx=ctx, stype="csr")
+    __slots__ = ("_indices", "_indptr")
 
-    @property
-    def indptr(self):
-        a = self.asnumpy()
-        counts = (a != 0).sum(axis=1)
-        return array(np.concatenate([[0], np.cumsum(counts)]).astype(
-            np.int64), dtype=np.int64)
+    def __init__(self, values, indices, indptr, shape, ctx=None):
+        super().__init__(_as_jnp(values), ctx=ctx, stype="csr")
+        self._indices = _as_jnp(indices, jnp.int32)
+        self._indptr = _as_jnp(indptr, jnp.int32)
+        self._sshape = tuple(int(d) for d in shape)
+        if len(self._sshape) != 2:
+            raise ValueError("csr storage requires a 2D shape")
 
     @property
     def indices(self):
-        a = self.asnumpy()
-        return array(np.nonzero(a)[1].astype(np.int64), dtype=np.int64)
+        return _wrap(self._indices)
 
     @property
-    def data(self):
-        a = self.asnumpy()
-        return array(a[np.nonzero(a)])
+    def indptr(self):
+        return _wrap(self._indptr)
 
-    def tostype(self, stype):
-        return tostype(self, stype)
+    @property
+    def _rows(self):
+        """Row id per stored value (static-shape expansion of indptr)."""
+        nnz = self._data.shape[0]
+        return jnp.searchsorted(self._indptr, jnp.arange(nnz),
+                                side="right") - 1
+
+    def _with_values(self, values):
+        out = CSRNDArray.__new__(CSRNDArray)
+        NDArray.__init__(out, values, stype="csr")
+        out._indices = self._indices
+        out._indptr = self._indptr
+        out._sshape = self._sshape
+        return out
+
+    def todense(self):
+        dense = jnp.zeros(self._sshape, self._data.dtype)
+        if self.nnz:
+            dense = dense.at[self._rows, self._indices].set(self._data)
+        return _wrap(dense)
+
+    def __getitem__(self, key):
+        """Row slicing (reference csr supports it); returns csr."""
+        if isinstance(key, slice):
+            start, stop, step = key.indices(self._sshape[0])
+            if step != 1:
+                self._deny("strided slicing")
+            ptr = np.asarray(self._indptr)
+            lo, hi = int(ptr[start]), int(ptr[stop])
+            return CSRNDArray(self._data[lo:hi], self._indices[lo:hi],
+                              self._indptr[start:stop + 1] - lo,
+                              (stop - start, self._sshape[1]))
+        self._deny("indexing")
+
+    def copyto(self, other):
+        if isinstance(other, CSRNDArray):
+            other._set_data(self._data)
+            other._indices = self._indices
+            other._indptr = self._indptr
+            other._sshape = self._sshape
+            return other
+        if isinstance(other, NDArray):
+            other._set_data(self.todense()._data)
+            return other
+        raise TypeError("copyto does not support %r" % (other,))
 
 
-def tostype(arr, stype):
-    if stype in (None, "default"):
-        return NDArray(arr._data)
-    if stype == "row_sparse":
-        return RowSparseNDArray(arr._data)
-    if stype == "csr":
-        if arr.ndim != 2:
-            raise ValueError("csr requires 2D")
-        return CSRNDArray(arr._data)
-    raise ValueError("unknown stype %r" % stype)
+# ---------------------------------------------------------------------------
+# creation
+# ---------------------------------------------------------------------------
+
+def row_sparse_array(arg1, shape=None, ctx=None, dtype=None):
+    """From (data, indices) — zero-copy sparse build — or any dense
+    source (host cast)."""
+    if isinstance(arg1, tuple) and all(
+            isinstance(d, (int, np.integer)) for d in arg1):
+        return zeros("row_sparse", arg1, ctx=ctx, dtype=dtype)
+    if isinstance(arg1, tuple) and len(arg1) == 2:
+        values, indices = arg1
+        values = _as_jnp(values, dtype)
+        indices = np.asarray(
+            indices.asnumpy() if isinstance(indices, NDArray) else indices,
+            np.int64)
+        if shape is None:
+            top = int(indices.max()) + 1 if indices.size else 0
+            shape = (top,) + tuple(values.shape[1:])
+        return RowSparseNDArray(values, indices, shape, ctx=ctx)
+    return cast_storage(_dense_source(arg1, dtype), "row_sparse", ctx=ctx)
 
 
 def csr_matrix(arg1, shape=None, ctx=None, dtype=None):
-    """Create a CSRNDArray from (data, indices, indptr) or dense source."""
+    """From (data, indices, indptr) or any dense source."""
     if isinstance(arg1, tuple) and len(arg1) == 3:
-        data, indices, indptr = arg1
-        data = np.asarray(data)
-        indices = np.asarray(indices, dtype=np.int64)
-        indptr = np.asarray(indptr, dtype=np.int64)
-        dense = np.zeros(shape, dtype or data.dtype)
-        for r in range(shape[0]):
-            for k in range(indptr[r], indptr[r + 1]):
-                dense[r, indices[k]] = data[k]
-        return CSRNDArray(jnp.asarray(dense), ctx=ctx)
-    src = arg1.asnumpy() if isinstance(arg1, NDArray) else np.asarray(arg1)
-    if dtype is not None:
-        src = src.astype(dtype)
-    return CSRNDArray(jnp.asarray(src), ctx=ctx)
+        values, indices, indptr = arg1
+        if shape is None:
+            indptr_np = np.asarray(
+                indptr.asnumpy() if isinstance(indptr, NDArray) else indptr)
+            idx_np = np.asarray(
+                indices.asnumpy() if isinstance(indices, NDArray)
+                else indices)
+            shape = (len(indptr_np) - 1,
+                     int(idx_np.max()) + 1 if idx_np.size else 0)
+        return CSRNDArray(_as_jnp(values, dtype), indices, indptr, shape,
+                          ctx=ctx)
+    return cast_storage(_dense_source(arg1, dtype), "csr", ctx=ctx)
 
 
-def row_sparse_array(arg1, shape=None, ctx=None, dtype=None):
-    """Create a RowSparseNDArray from (data, indices) or dense source."""
-    if isinstance(arg1, tuple) and len(arg1) == 2:
-        data, indices = arg1
-        data = np.asarray(data)
-        indices = np.asarray(indices, dtype=np.int64)
-        full = (shape if shape is not None
-                else (int(indices.max()) + 1,) + data.shape[1:])
-        dense = np.zeros(full, dtype or data.dtype)
-        dense[indices] = data
-        return RowSparseNDArray(jnp.asarray(dense), ctx=ctx)
-    src = arg1.asnumpy() if isinstance(arg1, NDArray) else np.asarray(arg1)
-    if dtype is not None:
-        src = src.astype(dtype)
-    return RowSparseNDArray(jnp.asarray(src), ctx=ctx)
+def _dense_source(arg1, dtype=None):
+    if isinstance(arg1, BaseSparseNDArray):
+        arg1 = arg1.todense()
+    if isinstance(arg1, NDArray):
+        return arg1 if dtype is None else arg1.astype(dtype)
+    src = np.asarray(arg1, dtype)
+    return _wrap(jnp.asarray(src))
 
 
 def zeros(stype, shape, ctx=None, dtype=None):
     shape = (shape,) if isinstance(shape, int) else tuple(shape)
-    dense = jnp.zeros(shape, dtype or jnp.float32)
+    dtype = dtype or np.float32
     if stype == "row_sparse":
-        return RowSparseNDArray(dense, ctx=ctx)
+        return RowSparseNDArray(jnp.zeros((0,) + shape[1:], dtype),
+                                jnp.zeros((0,), jnp.int32), shape, ctx=ctx)
     if stype == "csr":
-        return CSRNDArray(dense, ctx=ctx)
-    return NDArray(dense, ctx=ctx)
+        return CSRNDArray(jnp.zeros((0,), dtype),
+                          jnp.zeros((0,), jnp.int32),
+                          jnp.zeros((shape[0] + 1,), jnp.int32), shape,
+                          ctx=ctx)
+    if stype == "default":
+        return _wrap(jnp.zeros(shape, dtype))
+    raise ValueError("unknown stype %r" % stype)
+
+
+empty = zeros
+
+
+def array(source_array, ctx=None, dtype=None):
+    """Sparse-preserving array(): sparse in, same-stype copy out."""
+    if isinstance(source_array, BaseSparseNDArray):
+        return source_array.copy()
+    raise ValueError("sparse.array expects a sparse input; use "
+                     "nd.array for dense sources")
+
+
+# ---------------------------------------------------------------------------
+# storage casting
+# ---------------------------------------------------------------------------
+
+def cast_storage(arr, stype, ctx=None):
+    """Storage conversion (reference cast_storage-inl.h). dense->sparse
+    counts nnz on host — the same sync point the reference pays."""
+    if stype in (None, "default"):
+        if isinstance(arr, BaseSparseNDArray):
+            return arr.todense()
+        return _wrap(arr._data)
+    if isinstance(arr, BaseSparseNDArray):
+        if arr.stype == stype:
+            return arr.copy()
+        arr = arr.todense()
+    a = arr.asnumpy()
+    if stype == "row_sparse":
+        nz_rows = np.nonzero(
+            np.any(a.reshape(a.shape[0], -1) != 0, axis=1))[0]
+        return RowSparseNDArray(jnp.asarray(a[nz_rows]),
+                                nz_rows.astype(np.int64), a.shape, ctx=ctx)
+    if stype == "csr":
+        if a.ndim != 2:
+            raise ValueError("csr requires 2D")
+        rows, cols = np.nonzero(a)
+        counts = np.bincount(rows, minlength=a.shape[0])
+        indptr = np.concatenate([[0], np.cumsum(counts)])
+        return CSRNDArray(jnp.asarray(a[rows, cols]),
+                          cols.astype(np.int64), indptr.astype(np.int64),
+                          a.shape, ctx=ctx)
+    raise ValueError("unknown stype %r" % stype)
+
+
+def tostype(arr, stype):
+    return cast_storage(arr, stype)
+
+
+# ---------------------------------------------------------------------------
+# sparse kernels (static-shape device code over the nnz axis)
+# ---------------------------------------------------------------------------
+
+def dot(lhs, rhs, transpose_a=False, transpose_b=False):
+    """csr @ dense (and csr.T @ dense) without densifying lhs — the
+    reference's SpMV/SpMM path (dot-inl.h). Lowered as gather +
+    segment_sum, both MXU/VPU-native."""
+    if not isinstance(lhs, CSRNDArray) or isinstance(rhs,
+                                                     BaseSparseNDArray):
+        raise TypeError("sparse.dot supports csr @ dense")
+    if transpose_b:
+        raise NotImplementedError("transpose_b on the sparse dot")
+    vals, cols, rows = lhs._data, lhs._indices, lhs._rows
+    dense = rhs._data
+    extra = dense.shape[1:]
+    if not transpose_a:
+        contrib = vals.reshape((-1,) + (1,) * len(extra)) * dense[cols]
+        out = jax.ops.segment_sum(contrib, rows,
+                                  num_segments=lhs.shape[0])
+    else:
+        contrib = vals.reshape((-1,) + (1,) * len(extra)) * dense[rows]
+        out = jax.ops.segment_sum(contrib, cols,
+                                  num_segments=lhs.shape[1])
+    return _wrap(out)
+
+
+def _gather_rows(arr, ids):
+    """Values of ``arr`` (row-sparse) at ``ids``, in ids order; absent
+    rows are zeros. Static shape (len(ids), ...)."""
+    ids = _as_jnp(ids, jnp.int32)
+    nnz = arr._data.shape[0]
+    if nnz == 0:
+        return jnp.zeros((ids.shape[0],) + arr._data.shape[1:],
+                         arr._data.dtype)
+    pos = jnp.clip(jnp.searchsorted(arr._indices, ids), 0, nnz - 1)
+    found = arr._indices[pos] == ids
+    return jnp.where(
+        found.reshape((-1,) + (1,) * (arr._data.ndim - 1)),
+        arr._data[pos], 0)
+
+
+def retain(arr, row_ids):
+    """Keep only ``row_ids`` rows (reference _sparse_retain): output
+    indices are exactly the requested ids; absent rows become zeros.
+    Static output shape (len(row_ids), ...) — the kernel row_sparse_pull
+    is built on."""
+    if not isinstance(arr, RowSparseNDArray):
+        raise TypeError("retain expects a RowSparseNDArray")
+    ids = jnp.sort(_as_jnp(row_ids, jnp.int32))
+    return RowSparseNDArray(_gather_rows(arr, ids), ids, arr.shape)
+
+
+def add(lhs, rhs):
+    """row_sparse + row_sparse -> row_sparse over the index union
+    (host-side union: the output nnz is data-dependent, the same
+    allocation sync the reference pays in FComputeEx)."""
+    if not (isinstance(lhs, RowSparseNDArray) and
+            isinstance(rhs, RowSparseNDArray)):
+        raise TypeError("sparse.add expects two RowSparseNDArrays, got "
+                        "%s + %s" % (type(lhs).__name__,
+                                     type(rhs).__name__))
+    if lhs.shape != rhs.shape:
+        raise ValueError("shape mismatch %s vs %s" % (lhs.shape,
+                                                      rhs.shape))
+    li = np.asarray(jax.device_get(lhs._indices))
+    ri = np.asarray(jax.device_get(rhs._indices))
+    union = np.union1d(li, ri)
+    lpos = np.searchsorted(union, li)
+    rpos = np.searchsorted(union, ri)
+    vals = jnp.zeros((len(union),) + lhs._data.shape[1:],
+                     lhs._data.dtype)
+    vals = vals.at[jnp.asarray(lpos)].add(lhs._data)
+    vals = vals.at[jnp.asarray(rpos)].add(rhs._data)
+    return RowSparseNDArray(vals, union.astype(np.int64), lhs.shape)
+
+
+def take_grad(indices, ograd, num_rows):
+    """Row-sparse gradient of an Embedding/take forward: scatter-free
+    segment-sum of ``ograd`` rows by looked-up index. The dense
+    (num_rows, dim) gradient is never materialized — this is the
+    embedding path the reference runs through rowsparse FComputeEx."""
+    idx_arr = np.asarray(
+        indices.asnumpy() if isinstance(indices, NDArray) else indices
+    ).astype(np.int64)
+    idx = idx_arr.ravel()
+    og = _as_jnp(ograd)
+    row_shape = tuple(og.shape[idx_arr.ndim:])
+    og = og.reshape((idx.shape[0],) + row_shape)
+    rows, inverse = np.unique(idx, return_inverse=True)
+    vals = jax.ops.segment_sum(og, jnp.asarray(inverse),
+                               num_segments=len(rows))
+    shape = (int(num_rows),) + tuple(og.shape[1:])
+    return RowSparseNDArray(vals, rows, shape)
+
+
+# ---------------------------------------------------------------------------
+# sparse (lazy) optimizer updates — reference optimizer_op.cc rowsparse
+# kernels: only rows present in the gradient are touched (weight decay
+# included), everything else keeps its value AND its state untouched.
+# ---------------------------------------------------------------------------
+
+def _prep_grad(grad, rescale_grad, clip_gradient):
+    g = grad._data * rescale_grad
+    if clip_gradient is not None and clip_gradient > 0:
+        g = jnp.clip(g, -clip_gradient, clip_gradient)
+    return g
+
+
+def sgd_update(weight, grad, out=None, lr=0.01, wd=0.0, rescale_grad=1.0,
+               clip_gradient=None, **_):
+    idx = grad._indices
+    g = _prep_grad(grad, rescale_grad, clip_gradient)
+    rows = weight._data[idx]
+    new_rows = rows - lr * (g + wd * rows)
+    dst = weight if out is None else out
+    dst._set_data(weight._data.at[idx].set(new_rows))
+    return dst
+
+
+def sgd_mom_update(weight, grad, mom, out=None, lr=0.01, momentum=0.0,
+                   wd=0.0, rescale_grad=1.0, clip_gradient=None, **_):
+    idx = grad._indices
+    g = _prep_grad(grad, rescale_grad, clip_gradient)
+    w_rows = weight._data[idx]
+    m_rows = momentum * mom._data[idx] - lr * (g + wd * w_rows)
+    mom._set_data(mom._data.at[idx].set(m_rows))
+    dst = weight if out is None else out
+    dst._set_data(weight._data.at[idx].set(w_rows + m_rows))
+    return dst
+
+
+def adam_update(weight, grad, mean, var, out=None, lr=0.01, beta1=0.9,
+                beta2=0.999, epsilon=1e-8, wd=0.0, rescale_grad=1.0,
+                clip_gradient=None, **_):
+    idx = grad._indices
+    g = _prep_grad(grad, rescale_grad, clip_gradient)
+    w_rows = weight._data[idx]
+    g = g + wd * w_rows
+    m_rows = beta1 * mean._data[idx] + (1 - beta1) * g
+    v_rows = beta2 * var._data[idx] + (1 - beta2) * jnp.square(g)
+    mean._set_data(mean._data.at[idx].set(m_rows))
+    var._set_data(var._data.at[idx].set(v_rows))
+    new_rows = w_rows - lr * m_rows / (jnp.sqrt(v_rows) + epsilon)
+    dst = weight if out is None else out
+    dst._set_data(weight._data.at[idx].set(new_rows))
+    return dst
+
+
+_SPARSE_UPDATES = {"sgd_update": sgd_update,
+                   "sgd_mom_update": sgd_mom_update,
+                   "adam_update": adam_update}
+
+
+def _install_sparse_dispatch(pkg_globals, op_module):
+    """Wrap the generated nd.* entry points so sparse inputs route to the
+    kernels above (the analogue of FComputeEx dispatch,
+    c_api_ndarray.cc:521-549). Dense calls fall through untouched."""
+    def wrap(name, choose):
+        dense_fn = getattr(op_module, name)
+
+        def dispatch(*args, **kwargs):
+            fn = choose(args, kwargs)
+            if fn is None:
+                return dense_fn(*args, **kwargs)
+            return fn(*args, **kwargs)
+        dispatch.__name__ = name
+        dispatch.__doc__ = dense_fn.__doc__
+        setattr(op_module, name, dispatch)
+        pkg_globals[name] = dispatch
+
+    wrap("dot", lambda a, kw: dot if a and isinstance(a[0], CSRNDArray)
+         else None)
+
+    def _cast_choose(args, kwargs):
+        if not args or not isinstance(args[0], NDArray):
+            return None
+        stype = kwargs.get("stype")
+        if stype is None:
+            pos_str = [x for x in args[1:] if isinstance(x, str)]
+            stype = pos_str[0] if pos_str else "default"
+        if not (isinstance(args[0], BaseSparseNDArray) or
+                stype not in (None, "default")):
+            return None    # dense->default: generated op handles out=
+
+        def _do(data, *_a, **kw):
+            res = cast_storage(data, stype)
+            out = kw.get("out")
+            if out is None:
+                return res
+            res.copyto(out)
+            return out
+        return _do
+    wrap("cast_storage", _cast_choose)
+
+    wrap("_sparse_retain",
+         lambda a, kw: (lambda data, indices, **_kw: retain(data, indices))
+         if a and isinstance(a[0], RowSparseNDArray) else None)
+    wrap("_square_sum",
+         lambda a, kw: (lambda data, **_kw: _wrap(
+             jnp.sum(jnp.square(data._data)).reshape((1,))))
+         if a and isinstance(a[0], BaseSparseNDArray) else None)
+
+    def _eadd_choose(args, kwargs):
+        if len(args) < 2:
+            return None
+        l_rs = isinstance(args[0], RowSparseNDArray)
+        r_rs = isinstance(args[1], RowSparseNDArray)
+        if l_rs and r_rs:
+            return lambda l, r, **_kw: add(l, r)
+        if l_rs or r_rs:
+            # mixed rsp + dense -> dense (reference elemwise_add
+            # FComputeEx fallback densifies the sparse side)
+            def _mixed(l, r, **_kw):
+                ld = l.todense() if isinstance(l, BaseSparseNDArray) else l
+                rd = r.todense() if isinstance(r, BaseSparseNDArray) else r
+                return _wrap(ld._data + rd._data)
+            return _mixed
+        return None
+    wrap("elemwise_add", _eadd_choose)
+
+    for upd in _SPARSE_UPDATES:
+        wrap(upd, lambda a, kw, _u=upd: _SPARSE_UPDATES[_u]
+             if len(a) > 1 and isinstance(a[1], RowSparseNDArray)
+             else None)

@@ -277,3 +277,19 @@ def invoke_eager(opdef, nd_inputs, attrs, out=None):
     if len(nd_outs) == 1:
         return nd_outs[0]
     return nd_outs
+
+
+def _timed_invoke(fn):
+    """Profile hook: in 'all' mode every eager dispatch is timed into the
+    host timeline (reference: engine profiler kAllOperator mode)."""
+    @functools.wraps(fn)
+    def wrapper(opdef, nd_inputs, attrs, out=None):
+        from .. import profiler
+        if profiler.is_running() and profiler.mode() == "all":
+            with profiler.scope(opdef.name, "operator"):
+                return fn(opdef, nd_inputs, attrs, out=out)
+        return fn(opdef, nd_inputs, attrs, out=out)
+    return wrapper
+
+
+invoke_eager = _timed_invoke(invoke_eager)

@@ -1,0 +1,149 @@
+"""Spatial warping / matching ops: GridGenerator, BilinearSampler,
+SpatialTransformer, Correlation.
+
+Reference: src/operator/grid_generator-inl.h, bilinear_sampler-inl.h,
+spatial_transformer-inl.h, correlation-inl.h (cuDNN-backed on GPU there;
+pure gather/window arithmetic here — XLA fuses the interpolation weights
+into the gathers, and gradients w.r.t. both data and grid come from
+jax autodiff instead of hand-written backward kernels).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import register
+
+
+# ---------------------------------------------------------------------------
+# GridGenerator
+# ---------------------------------------------------------------------------
+
+def _affine_grid(theta, H, W):
+    """theta (B, 6) row-major 2x3 -> sampling grid (B, 2, H, W) of
+    normalized [-1, 1] (x, y) target->source coords."""
+    ys = jnp.linspace(-1.0, 1.0, H)
+    xs = jnp.linspace(-1.0, 1.0, W)
+    gy, gx = jnp.meshgrid(ys, xs, indexing="ij")
+    ones = jnp.ones_like(gx)
+    base = jnp.stack([gx.ravel(), gy.ravel(), ones.ravel()])  # (3, H*W)
+    mat = theta.reshape(-1, 2, 3)
+    out = mat @ base                                          # (B, 2, H*W)
+    return out.reshape(-1, 2, H, W)
+
+
+@register("GridGenerator", arg_names=("data",),
+          defaults={"transform_type": "affine", "target_shape": (0, 0)})
+def _grid_generator(data, transform_type="affine", target_shape=(0, 0),
+                    **_):
+    if transform_type == "affine":
+        H, W = int(target_shape[0]), int(target_shape[1])
+        return _affine_grid(data, H, W)
+    if transform_type == "warp":
+        # data (B, 2, H, W) pixel-offset flow -> normalized abs coords
+        B, _two, H, W = data.shape
+        gy, gx = jnp.meshgrid(jnp.arange(H), jnp.arange(W), indexing="ij")
+        x = data[:, 0] + gx
+        y = data[:, 1] + gy
+        xn = 2.0 * x / max(W - 1, 1) - 1.0
+        yn = 2.0 * y / max(H - 1, 1) - 1.0
+        return jnp.stack([xn, yn], axis=1)
+    raise ValueError("unknown transform_type %r" % transform_type)
+
+
+# ---------------------------------------------------------------------------
+# BilinearSampler
+# ---------------------------------------------------------------------------
+
+def _bilinear_sample_one(img, grid):
+    """img (C, H, W), grid (2, Ho, Wo) normalized -> (C, Ho, Wo); points
+    outside [-1,1] contribute zero (reference bilinear_sampler-inl.h
+    between() boundary handling)."""
+    C, H, W = img.shape
+    x = (grid[0] + 1.0) * (W - 1) / 2.0
+    y = (grid[1] + 1.0) * (H - 1) / 2.0
+    x0 = jnp.floor(x)
+    y0 = jnp.floor(y)
+    dx = x - x0
+    dy = y - y0
+
+    def corner(yc, xc, w):
+        inside = (xc >= 0) & (xc <= W - 1) & (yc >= 0) & (yc <= H - 1)
+        xi = jnp.clip(xc, 0, W - 1).astype(jnp.int32)
+        yi = jnp.clip(yc, 0, H - 1).astype(jnp.int32)
+        val = img[:, yi, xi]                       # (C, Ho, Wo)
+        return val * (w * inside)[None]
+
+    out = (corner(y0, x0, (1 - dx) * (1 - dy)) +
+           corner(y0, x0 + 1, dx * (1 - dy)) +
+           corner(y0 + 1, x0, (1 - dx) * dy) +
+           corner(y0 + 1, x0 + 1, dx * dy))
+    return out
+
+
+@register("BilinearSampler", arg_names=("data", "grid"))
+def _bilinear_sampler(data, grid, **_):
+    return jax.vmap(_bilinear_sample_one)(data, grid)
+
+
+@register("SpatialTransformer", arg_names=("data", "loc"),
+          defaults={"target_shape": (0, 0), "transform_type": "affine",
+                    "sampler_type": "bilinear"})
+def _spatial_transformer(data, loc, target_shape=(0, 0),
+                         transform_type="affine",
+                         sampler_type="bilinear", **_):
+    """Affine grid + bilinear sampling fused (reference
+    spatial_transformer-inl.h); loc is the (B, 6) localisation output."""
+    assert transform_type == "affine" and sampler_type == "bilinear"
+    H, W = int(target_shape[0]), int(target_shape[1])
+    grid = _affine_grid(loc, H, W)
+    return jax.vmap(_bilinear_sample_one)(data, grid)
+
+
+# ---------------------------------------------------------------------------
+# Correlation (FlowNet cost volume)
+# ---------------------------------------------------------------------------
+
+@register("Correlation", arg_names=("data1", "data2"),
+          defaults={"kernel_size": 1, "max_displacement": 1, "stride1": 1,
+                    "stride2": 1, "pad_size": 0, "is_multiply": True})
+def _correlation(data1, data2, kernel_size=1, max_displacement=1,
+                 stride1=1, stride2=1, pad_size=0, is_multiply=True, **_):
+    """Cost volume between two feature maps (correlation-inl.h): for each
+    displacement (dy, dx) on the stride2 grid, mean over a kernel_size
+    patch and channels of data1 * shifted(data2) (or |a-b| when
+    is_multiply=False). Output (B, D*D, Ho, Wo)."""
+    B, C, H, W = data1.shape
+    K = int(kernel_size)
+    rad = (K - 1) // 2
+    md, s1, s2, pad = (int(max_displacement), int(stride1), int(stride2),
+                      int(pad_size))
+    d_grid = 2 * (md // s2) + 1
+    border = md + rad
+    pH, pW = H + 2 * pad, W + 2 * pad
+    Ho = -((pH - 2 * border) // -s1)
+    Wo = -((pW - 2 * border) // -s1)
+
+    p1 = jnp.pad(data1, ((0, 0), (0, 0), (pad, pad), (pad, pad)))
+    p2 = jnp.pad(data2, ((0, 0), (0, 0), (pad, pad), (pad, pad)))
+
+    maps = []
+    for i in range(d_grid):
+        for j in range(d_grid):
+            dy = (i - d_grid // 2) * s2
+            dx = (j - d_grid // 2) * s2
+            shifted = jnp.roll(p2, shift=(-dy, -dx), axis=(2, 3))
+            prod = p1 * shifted if is_multiply else jnp.abs(p1 - shifted)
+            summed = prod.sum(axis=1, keepdims=True)        # (B,1,pH,pW)
+            if K > 1:
+                summed = lax.reduce_window(
+                    summed, 0.0, lax.add, (1, 1, K, K), (1, 1, 1, 1),
+                    "SAME")
+            maps.append(summed[:, 0])
+    vol = jnp.stack(maps, axis=1)                           # (B,D²,pH,pW)
+    # crop the valid region and apply stride1
+    ys = border + jnp.arange(Ho) * s1
+    xs = border + jnp.arange(Wo) * s1
+    vol = vol[:, :, ys][:, :, :, xs]
+    return vol / (K * K * C)

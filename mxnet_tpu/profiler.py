@@ -1,0 +1,152 @@
+"""Profiler — per-op host timeline + XLA device traces (``mx.profiler``).
+
+Reference: src/engine/profiler.{h,cc} (engine-integrated op stats, Chrome
+trace-event JSON dump, profiler.h:122-127) and python/mxnet/profiler.py
+(profiler_set_config / profiler_set_state / dump_profile).
+
+TPU-native mapping, two layers:
+- **Host timeline** (this module): eager dispatch and executor runs are
+  timed around their dispatch sites and dumped as Chrome trace-event JSON
+  — open in chrome://tracing or Perfetto, like the reference's dump.
+  Durations are host-side dispatch+sync costs; JAX dispatch is async, so
+  a step's device time shows up on the op that blocks (the analogue of
+  the reference's WaitToRead attribution).
+- **Device traces**: when a trace dir is configured (``xplane_dir`` or
+  MXNET_PROFILER_XPLANE), start/stop also drive ``jax.profiler`` which
+  records XLA/TPU activity as TensorBoard xplane + trace.json.gz — the
+  ground-truth per-kernel timeline.
+
+Env parity (docs/how_to/env_var.md:97-108): MXNET_PROFILER_AUTOSTART,
+MXNET_PROFILER_MODE (0 => symbolic-only, 1 => all ops).
+"""
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+
+__all__ = ["profiler_set_config", "profiler_set_state", "dump_profile",
+           "set_config", "set_state", "dump", "State", "record_event",
+           "scope", "is_running", "mode"]
+
+
+class _ProfilerState:
+    def __init__(self):
+        self.mode = "symbolic"            # 'symbolic' | 'all'
+        self.filename = "profile.json"
+        self.xplane_dir = None
+        self.running = False
+        self.events = []
+        self.lock = threading.Lock()
+        self._tracing = False
+
+
+_P = _ProfilerState()
+
+
+class State:
+    stop = "stop"
+    run = "run"
+
+
+def profiler_set_config(mode="symbolic", filename="profile.json",
+                        xplane_dir=None, **_kwargs):
+    """Configure the profiler (reference profiler.py:profiler_set_config;
+    modes 'symbolic' = executor runs only, 'all' = every eager op too)."""
+    if mode not in ("symbolic", "all"):
+        raise ValueError("mode must be 'symbolic' or 'all'")
+    _P.mode = mode
+    _P.filename = filename
+    _P.xplane_dir = xplane_dir or os.environ.get("MXNET_PROFILER_XPLANE")
+
+
+def profiler_set_state(state="stop"):
+    """Start/stop collection (reference profiler_set_state)."""
+    if state not in (State.stop, State.run):
+        raise ValueError("state must be 'run' or 'stop'")
+    was = _P.running
+    _P.running = state == State.run
+    if _P.running and not was:
+        with _P.lock:
+            _P.events = []
+        if _P.xplane_dir:
+            import jax
+            jax.profiler.start_trace(_P.xplane_dir)
+            _P._tracing = True
+    elif was and not _P.running and _P._tracing:
+        import jax
+        jax.profiler.stop_trace()
+        _P._tracing = False
+
+
+def is_running():
+    return _P.running
+
+
+def mode():
+    return _P.mode
+
+
+def record_event(name, category, start_us, dur_us, tid=0, args=None):
+    """Append one complete ('X') trace event; called by the dispatch
+    sites (ops/registry.py, executor.py)."""
+    if not _P.running:
+        return
+    ev = {"name": name, "cat": category, "ph": "X",
+          "ts": start_us, "dur": dur_us, "pid": 0, "tid": tid}
+    if args:
+        ev["args"] = args
+    with _P.lock:
+        _P.events.append(ev)
+
+
+class scope:
+    """Context manager timing one region into the profile (and, when a
+    device trace is live, into the xplane timeline via TraceAnnotation)."""
+
+    def __init__(self, name, category="op"):
+        self.name = name
+        self.category = category
+        self._jax_ctx = None
+
+    def __enter__(self):
+        self._start = time.perf_counter_ns()
+        if _P._tracing:
+            import jax
+            self._jax_ctx = jax.profiler.TraceAnnotation(self.name)
+            self._jax_ctx.__enter__()
+        return self
+
+    def __exit__(self, *exc):
+        if self._jax_ctx is not None:
+            self._jax_ctx.__exit__(*exc)
+        end = time.perf_counter_ns()
+        record_event(self.name, self.category, self._start // 1000,
+                     max((end - self._start) // 1000, 1))
+        return False
+
+
+def dump_profile(filename=None):
+    """Write the collected events as Chrome trace-event JSON (reference
+    profiler.h:122-127 DumpProfile)."""
+    path = filename or _P.filename
+    with _P.lock:
+        events = list(_P.events)
+    payload = {"traceEvents": events, "displayTimeUnit": "ms"}
+    with open(path, "w") as f:
+        json.dump(payload, f)
+    return path
+
+
+# modern-surface aliases (later-reference profiler.py names)
+set_config = profiler_set_config
+set_state = profiler_set_state
+dump = dump_profile
+
+
+if os.environ.get("MXNET_PROFILER_AUTOSTART", "0") == "1":
+    profiler_set_config(
+        mode="all" if os.environ.get("MXNET_PROFILER_MODE", "0") == "1"
+        else "symbolic")
+    profiler_set_state(State.run)

@@ -318,10 +318,11 @@ class GRUCell(BaseRNNCell):
         return next_h, [next_h]
 
 
-_FUSED_BASE = {"rnn_relu": lambda h, p, pa: RNNCell(h, "relu", p, pa),
-               "rnn_tanh": lambda h, p, pa: RNNCell(h, "tanh", p, pa),
-               "lstm": lambda h, p, pa: LSTMCell(h, p, pa),
-               "gru": lambda h, p, pa: GRUCell(h, p, pa)}
+_FUSED_BASE = {
+    "rnn_relu": lambda h, p, pa, fb: RNNCell(h, "relu", p, pa),
+    "rnn_tanh": lambda h, p, pa, fb: RNNCell(h, "tanh", p, pa),
+    "lstm": lambda h, p, pa, fb: LSTMCell(h, p, pa, forget_bias=fb),
+    "gru": lambda h, p, pa, fb: GRUCell(h, p, pa)}
 
 
 class FusedRNNCell(BaseRNNCell):
@@ -486,17 +487,18 @@ class FusedRNNCell(BaseRNNCell):
         weight names (for stepping / debugging)."""
         stack = SequentialRNNCell()
         make = _FUSED_BASE[self._mode]
+        fb = self._forget_bias
         for layer in range(self._num_layers):
             if self._bidirectional:
                 stack.add(BidirectionalCell(
                     make(self._num_hidden,
-                         self._cell_prefix(layer, 0), None),
+                         self._cell_prefix(layer, 0), None, fb),
                     make(self._num_hidden,
-                         self._cell_prefix(layer, 1), None),
+                         self._cell_prefix(layer, 1), None, fb),
                     output_prefix="%sbi_%d_" % (self._prefix, layer)))
             else:
                 stack.add(make(self._num_hidden,
-                               self._cell_prefix(layer, 0), None))
+                               self._cell_prefix(layer, 0), None, fb))
             if self._dropout > 0 and layer != self._num_layers - 1:
                 stack.add(DropoutCell(
                     self._dropout, prefix="%s_dropout%d_" % (self._prefix,

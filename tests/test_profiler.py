@@ -1,0 +1,80 @@
+"""Profiler tests (reference: src/engine/profiler.h chrome-trace dump +
+python/mxnet/profiler.py control surface)."""
+import json
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, profiler
+
+
+def _stop():
+    profiler.profiler_set_state("stop")
+
+
+def test_eager_op_timeline(tmp_path):
+    out = str(tmp_path / "profile.json")
+    profiler.profiler_set_config(mode="all", filename=out)
+    profiler.profiler_set_state("run")
+    try:
+        a = nd.ones((8, 8))
+        b = nd.dot(a, a)
+        (b + 1).wait_to_read()
+    finally:
+        _stop()
+    path = profiler.dump_profile()
+    trace = json.load(open(path))
+    names = [e["name"] for e in trace["traceEvents"]]
+    assert "dot" in names
+    assert any(n in names for n in ("_plus_scalar", "broadcast_add"))
+    ev = trace["traceEvents"][0]
+    assert ev["ph"] == "X" and ev["dur"] >= 1
+
+
+def test_symbolic_mode_records_executor_only(tmp_path):
+    out = str(tmp_path / "profile_sym.json")
+    profiler.profiler_set_config(mode="symbolic", filename=out)
+    profiler.profiler_set_state("run")
+    try:
+        x = mx.sym.Variable("data")
+        y = mx.sym.FullyConnected(x, num_hidden=4, name="fc")
+        ex = y.simple_bind(data=(2, 3))
+        for name, arr in ex.arg_dict.items():
+            if name != "data":
+                arr[:] = np.ones(arr.shape, "float32")
+        ex.forward(data=np.ones((2, 3), "float32"))
+        nd.ones((4,)).wait_to_read()   # eager op: must NOT be recorded
+    finally:
+        _stop()
+    trace = json.load(open(profiler.dump_profile()))
+    cats = {e["cat"] for e in trace["traceEvents"]}
+    names = [e["name"] for e in trace["traceEvents"]]
+    assert "executor" in cats
+    assert "executor_forward" in names
+    assert "_ones" not in names
+
+
+def test_stop_clears_collection_on_restart(tmp_path):
+    profiler.profiler_set_config(mode="all",
+                                 filename=str(tmp_path / "p.json"))
+    profiler.profiler_set_state("run")
+    nd.ones((2,)).wait_to_read()
+    _stop()
+    profiler.profiler_set_state("run")
+    _stop()
+    trace = json.load(open(profiler.dump_profile()))
+    assert trace["traceEvents"] == []
+
+
+def test_scope_nesting(tmp_path):
+    profiler.profiler_set_config(mode="all",
+                                 filename=str(tmp_path / "s.json"))
+    profiler.profiler_set_state("run")
+    try:
+        with profiler.scope("outer", "user"):
+            (nd.ones((2,)) + 1).wait_to_read()
+    finally:
+        _stop()
+    trace = json.load(open(profiler.dump_profile()))
+    names = [e["name"] for e in trace["traceEvents"]]
+    assert "outer" in names and "_plus_scalar" in names

@@ -164,6 +164,18 @@ class TestFusedInit:
         assert unp["f_l0_i2h_i_weight"].asnumpy().std() > 0
 
 
+class TestUnfuseForgetBias:
+    def test_forget_bias_propagates(self):
+        fused = mx.rnn.FusedRNNCell(4, num_layers=1, mode="lstm",
+                                    forget_bias=2.5, prefix="fb_")
+        stack = fused.unfuse()
+        cell = stack._cells[0]
+        import json
+        klass, kwargs = json.loads(cell._iB.attr("__init__"))
+        assert klass.lower() == "lstmbias"
+        assert kwargs["forget_bias"] == 2.5
+
+
 class TestBucketIO:
     def test_encode_sentences(self):
         sents = [["a", "b", "c"], ["b", "c"]]

@@ -1,0 +1,302 @@
+"""Sparse storage tests (reference: tests/python/unittest/
+test_sparse_ndarray.py + test_sparse_operator.py, abridged to the
+TPU-native surface: index-carrying representations, csr dot, retain,
+sparse optimizer updates, embedding-gradient path, kvstore pull)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+from mxnet_tpu.ndarray import sparse
+
+
+def _rand_rs(rows, cols, nnz_rows, seed=0):
+    rng = np.random.RandomState(seed)
+    idx = np.sort(rng.choice(rows, nnz_rows, replace=False))
+    vals = rng.randn(nnz_rows, cols).astype("float32")
+    return sparse.row_sparse_array((vals, idx), shape=(rows, cols)), \
+        idx, vals
+
+
+class TestRepresentation:
+    def test_row_sparse_carries_indices(self):
+        rs, idx, vals = _rand_rs(100, 4, 5)
+        assert rs.stype == "row_sparse"
+        assert rs.shape == (100, 4)
+        assert rs.nnz == 5
+        # the values buffer is (nnz, cols) — NOT a dense (100, 4) costume
+        assert rs.data.shape == (5, 4)
+        np.testing.assert_array_equal(rs.indices.asnumpy(), idx)
+        dense = rs.asnumpy()
+        assert dense.shape == (100, 4)
+        np.testing.assert_allclose(dense[idx], vals)
+        assert np.all(dense[np.setdiff1d(np.arange(100), idx)] == 0)
+
+    def test_csr_carries_structure(self):
+        a = np.array([[0, 1, 0], [2, 0, 3], [0, 0, 0]], "float32")
+        csr = sparse.csr_matrix(a)
+        np.testing.assert_array_equal(csr.indptr.asnumpy(), [0, 1, 3, 3])
+        np.testing.assert_array_equal(csr.indices.asnumpy(), [1, 0, 2])
+        np.testing.assert_array_equal(csr.data.asnumpy(), [1, 2, 3])
+        np.testing.assert_array_equal(csr.asnumpy(), a)
+
+    def test_csr_from_components(self):
+        csr = sparse.csr_matrix(([1., 2.], [0, 1], [0, 1, 2]),
+                                shape=(2, 3))
+        np.testing.assert_array_equal(
+            csr.asnumpy(), [[1, 0, 0], [0, 2, 0]])
+
+    def test_cast_storage_roundtrip(self):
+        rng = np.random.RandomState(0)
+        a = rng.randn(6, 3).astype("float32")
+        a[[0, 2, 5]] = 0
+        x = nd.array(a)
+        rs = x.tostype("row_sparse")
+        assert rs.nnz == 3
+        np.testing.assert_allclose(rs.tostype("default").asnumpy(), a)
+        csr = x.tostype("csr")
+        np.testing.assert_allclose(csr.tostype("default").asnumpy(), a)
+        # nd.cast_storage dispatches too
+        rs2 = nd.cast_storage(x, stype="row_sparse")
+        assert rs2.stype == "row_sparse" and rs2.nnz == 3
+
+    def test_unsorted_indices_canonicalized(self):
+        rs = sparse.row_sparse_array(
+            (np.array([[2.], [1.]], "float32"), [5, 1]), shape=(8, 1))
+        np.testing.assert_array_equal(rs.indices.asnumpy(), [1, 5])
+        np.testing.assert_array_equal(rs.data.asnumpy(), [[1.], [2.]])
+
+    def test_csr_row_slice(self):
+        a = np.array([[0, 1, 0], [2, 0, 3], [4, 0, 0]], "float32")
+        s = sparse.csr_matrix(a)[1:3]
+        assert s.stype == "csr" and s.shape == (2, 3)
+        np.testing.assert_array_equal(s.asnumpy(), a[1:3])
+
+    def test_zeros_and_scalar_math(self):
+        z = sparse.zeros("row_sparse", (10, 2))
+        assert z.nnz == 0 and z.asnumpy().sum() == 0
+        rs, idx, vals = _rand_rs(10, 2, 3)
+        np.testing.assert_allclose((rs * 2.0).asnumpy(), rs.asnumpy() * 2,
+                                   rtol=1e-6)
+        np.testing.assert_allclose((-rs).asnumpy(), -rs.asnumpy())
+
+    def test_dense_ops_refused(self):
+        rs, _, _ = _rand_rs(10, 2, 3)
+        with pytest.raises(TypeError):
+            rs[0]
+        with pytest.raises(TypeError):
+            rs + nd.zeros((10, 2))
+
+
+class TestKernels:
+    def test_csr_dot_dense(self):
+        rng = np.random.RandomState(1)
+        a = rng.randn(5, 7).astype("float32")
+        a[rng.rand(5, 7) < 0.6] = 0
+        b = rng.randn(7, 3).astype("float32")
+        csr = sparse.csr_matrix(a)
+        out = nd.dot(csr, nd.array(b))
+        np.testing.assert_allclose(out.asnumpy(), a @ b, rtol=1e-5)
+
+    def test_csr_dot_transpose(self):
+        rng = np.random.RandomState(2)
+        a = rng.randn(5, 7).astype("float32")
+        a[rng.rand(5, 7) < 0.6] = 0
+        b = rng.randn(5, 3).astype("float32")
+        csr = sparse.csr_matrix(a)
+        out = nd.dot(csr, nd.array(b), transpose_a=True)
+        np.testing.assert_allclose(out.asnumpy(), a.T @ b, rtol=1e-5)
+
+    def test_retain(self):
+        rs, idx, vals = _rand_rs(50, 3, 8, seed=3)
+        keep = np.array([int(idx[0]), 17, int(idx[-1])])
+        assert 17 not in idx
+        out = nd._sparse_retain(rs, nd.array(np.sort(keep)))
+        assert out.stype == "row_sparse"
+        dense = out.asnumpy()
+        np.testing.assert_allclose(dense[idx[0]], vals[0], rtol=1e-6)
+        np.testing.assert_allclose(dense[idx[-1]], vals[-1], rtol=1e-6)
+        assert dense.sum() == pytest.approx(
+            vals[0].sum() + vals[-1].sum(), rel=1e-5)
+
+    def test_rs_add_union(self):
+        a = sparse.row_sparse_array(
+            (np.array([[1.], [2.]], "float32"), [0, 3]), shape=(6, 1))
+        b = sparse.row_sparse_array(
+            (np.array([[10.], [20.]], "float32"), [3, 5]), shape=(6, 1))
+        c = a + b
+        assert c.stype == "row_sparse" and c.nnz == 3
+        np.testing.assert_array_equal(
+            c.asnumpy().ravel(), [1, 0, 0, 12, 0, 20])
+
+    def test_square_sum(self):
+        rs, idx, vals = _rand_rs(20, 4, 5, seed=4)
+        out = nd._square_sum(rs)
+        np.testing.assert_allclose(out.asnumpy(),
+                                   [np.square(vals).sum()], rtol=1e-5)
+
+
+class TestOptimizerUpdates:
+    def test_sparse_sgd_lazy(self):
+        rng = np.random.RandomState(5)
+        w = rng.randn(40, 4).astype("float32")
+        weight = nd.array(w)
+        grad, idx, gvals = _rand_rs(40, 4, 6, seed=6)
+        nd.sgd_update(weight, grad, out=weight, lr=0.5, wd=0.1)
+        got = weight.asnumpy()
+        expect = w.copy()
+        expect[idx] -= 0.5 * (gvals + 0.1 * w[idx])
+        np.testing.assert_allclose(got, expect, rtol=1e-5)
+        # untouched rows saw neither grad nor weight decay (lazy update)
+        untouched = np.setdiff1d(np.arange(40), idx)
+        np.testing.assert_array_equal(got[untouched], w[untouched])
+
+    def test_sparse_adam_state_rows_only(self):
+        rng = np.random.RandomState(7)
+        w = rng.randn(30, 2).astype("float32")
+        weight = nd.array(w)
+        mean, var = nd.zeros((30, 2)), nd.zeros((30, 2))
+        grad, idx, _ = _rand_rs(30, 2, 4, seed=8)
+        nd.adam_update(weight, grad, mean, var, out=weight, lr=0.1)
+        touched = np.zeros(30, bool)
+        touched[idx] = True
+        assert np.all(mean.asnumpy()[~touched] == 0)
+        assert np.any(mean.asnumpy()[touched] != 0)
+        assert np.all(weight.asnumpy()[~touched] == w[~touched])
+
+    def test_optimizer_class_routes_sparse(self):
+        opt = mx.optimizer.SGD(learning_rate=0.5, momentum=0.9,
+                               rescale_grad=1.0)
+        w = nd.ones((20, 3))
+        state = opt.create_state(0, w)
+        grad, idx, gvals = _rand_rs(20, 3, 4, seed=9)
+        before = w.asnumpy()
+        opt.update(0, w, grad, state)
+        after = w.asnumpy()
+        untouched = np.setdiff1d(np.arange(20), idx)
+        assert np.all(after[untouched] == before[untouched])
+        assert np.all(after[idx] != before[idx])
+
+
+class TestEmbeddingGradientPath:
+    def test_take_grad_matches_dense(self):
+        rng = np.random.RandomState(10)
+        vocab, dim = 50, 8
+        tokens = rng.randint(0, vocab, size=(4, 6))
+        ograd = rng.randn(4, 6, dim).astype("float32")
+        rs = sparse.take_grad(tokens, nd.array(ograd), vocab)
+        dense = np.zeros((vocab, dim), "float32")
+        np.add.at(dense, tokens.ravel(),
+                  ograd.reshape(-1, dim))
+        np.testing.assert_allclose(rs.asnumpy(), dense, rtol=1e-5)
+
+    def test_never_densifies(self):
+        """The embedding gradient for a big vocab stays O(nnz): the
+        row-sparse grad + sparse update never allocate (vocab, dim)."""
+        vocab, dim = 200_000, 32
+        tokens = np.random.RandomState(11).randint(0, vocab, size=256)
+        ograd = nd.ones((256, dim))
+        rs = sparse.take_grad(tokens, ograd, vocab)
+        n_unique = len(np.unique(tokens))
+        assert rs.nnz == n_unique
+        # values buffer is ~nnz*dim*4 bytes — 3 orders below vocab*dim*4
+        assert rs.data.size * 4 <= n_unique * dim * 4
+        assert rs.data.size * 4 < vocab * dim * 4 / 500
+
+        weight = nd.zeros((vocab, dim))
+        nd.sgd_update(weight, rs, out=weight, lr=1.0)
+        touched = weight.asnumpy()[np.unique(tokens)]
+        assert np.all(touched != 0)
+
+    def test_end_to_end_embedding_training_step(self):
+        """Forward gather + sparse backward + lazy update — the
+        row_sparse embedding recipe (reference sparse embedding flow)."""
+        vocab, dim = 1000, 4
+        rng = np.random.RandomState(12)
+        weight = nd.array(rng.randn(vocab, dim).astype("float32"))
+        tokens = np.array([3, 99, 3, 512])
+        emb = nd.take(weight, nd.array(tokens.astype("float32")))
+        ograd = nd.ones((4, dim))
+        gw = sparse.take_grad(tokens, ograd, vocab)
+        before = weight.asnumpy()
+        nd.sgd_update(weight, gw, out=weight, lr=0.1)
+        after = weight.asnumpy()
+        np.testing.assert_allclose(after[3], before[3] - 0.2,
+                                   rtol=1e-5)  # token 3 appears twice
+        np.testing.assert_allclose(after[99], before[99] - 0.1, rtol=1e-5)
+        assert np.all(after[0] == before[0])
+
+
+class TestDispatchEdges:
+    def test_cast_storage_dense_out_kwarg(self):
+        o = nd.zeros((2, 2))
+        nd.cast_storage(nd.ones((2, 2)), stype="default", out=o)
+        np.testing.assert_array_equal(o.asnumpy(), np.ones((2, 2)))
+
+    def test_cast_storage_sparse_with_out(self):
+        o = sparse.zeros("row_sparse", (3, 2))
+        src = np.array([[1, 1], [0, 0], [2, 2]], "float32")
+        nd.cast_storage(nd.array(src), stype="row_sparse", out=o)
+        assert o.nnz == 2
+        np.testing.assert_array_equal(o.asnumpy(), src)
+
+    def test_elemwise_add_mixed(self):
+        rs = sparse.row_sparse_array(
+            (np.ones((1, 2), "float32"), [1]), shape=(3, 2))
+        dense = nd.ones((3, 2))
+        for out in (nd.elemwise_add(rs, dense),
+                    nd.elemwise_add(dense, rs)):
+            assert out.stype == "default"
+            np.testing.assert_array_equal(
+                out.asnumpy(), [[1, 1], [2, 2], [1, 1]])
+
+
+class TestKVStore:
+    def test_plain_pull_densifies_sparse_store(self):
+        kv = mx.kv.create("local")
+        kv.init("w", nd.zeros((4, 2)))
+        g = sparse.row_sparse_array(
+            (np.ones((1, 2), "float32"), [2]), shape=(4, 2))
+        kv.push("w", g)   # no updater: store holds the sparse reduction
+        out = nd.zeros((4, 2))
+        kv.pull("w", out=out)
+        assert out.shape == (4, 2)
+        np.testing.assert_array_equal(
+            out.asnumpy(), [[0, 0], [0, 0], [1, 1], [0, 0]])
+
+    def test_row_sparse_pull_dense_out_from_sparse_store(self):
+        kv = mx.kv.create("local")
+        kv.init("w", sparse.row_sparse_array(
+            (np.full((2, 2), 3.0, "float32"), [1, 3]), shape=(5, 2)))
+        out = nd.zeros((2, 2))
+        kv.row_sparse_pull("w", out=out,
+                           row_ids=nd.array(np.array([3., 0.])))
+        np.testing.assert_array_equal(out.asnumpy(), [[3, 3], [0, 0]])
+
+    def test_row_sparse_pull_from_dense(self):
+        kv = mx.kv.create("local")
+        w = np.random.RandomState(13).randn(30, 4).astype("float32")
+        kv.init("emb", nd.array(w))
+        out = sparse.zeros("row_sparse", (30, 4))
+        rows = nd.array(np.array([2., 7., 19.]))
+        kv.row_sparse_pull("emb", out=out, row_ids=rows)
+        assert out.stype == "row_sparse" and out.nnz == 3
+        np.testing.assert_allclose(out.asnumpy()[[2, 7, 19]],
+                                   w[[2, 7, 19]], rtol=1e-6)
+
+    def test_sparse_push_reduces_union(self):
+        kv = mx.kv.create("local")
+        kv.init("g", sparse.zeros("row_sparse", (10, 2)))
+        a = sparse.row_sparse_array(
+            (np.ones((1, 2), "float32"), [1]), shape=(10, 2))
+        b = sparse.row_sparse_array(
+            (np.full((1, 2), 2.0, "float32"), [1]), shape=(10, 2))
+        c = sparse.row_sparse_array(
+            (np.full((1, 2), 5.0, "float32"), [4]), shape=(10, 2))
+        kv.push("g", [a, b, c])
+        out = sparse.zeros("row_sparse", (10, 2))
+        kv.row_sparse_pull("g", out=out,
+                           row_ids=nd.array(np.array([1., 4.])))
+        dense = out.asnumpy()
+        np.testing.assert_array_equal(dense[1], [3, 3])
+        np.testing.assert_array_equal(dense[4], [5, 5])

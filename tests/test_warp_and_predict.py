@@ -1,0 +1,206 @@
+"""Tests for the spatial-warp op family, LibSVMIter, and the
+predict/export path (reference: test_operator.py bilinear/spatial/
+correlation blocks, iter_libsvm.cc, c_predict_api.cc)."""
+import os
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+
+
+class TestGridGenerator:
+    def test_identity_affine(self):
+        theta = nd.array(np.array([[1, 0, 0, 0, 1, 0]], "float32"))
+        grid = nd.GridGenerator(theta, transform_type="affine",
+                                target_shape=(3, 4)).asnumpy()
+        assert grid.shape == (1, 2, 3, 4)
+        np.testing.assert_allclose(grid[0, 0, 0], [-1, -1 / 3, 1 / 3, 1],
+                                   atol=1e-6)
+        np.testing.assert_allclose(grid[0, 1, :, 0], [-1, 0, 1],
+                                   atol=1e-6)
+
+    def test_warp_zero_flow_is_identity_grid(self):
+        flow = nd.zeros((1, 2, 3, 3))
+        grid = nd.GridGenerator(flow, transform_type="warp").asnumpy()
+        np.testing.assert_allclose(grid[0, 0, 0], [-1, 0, 1], atol=1e-6)
+
+
+class TestBilinearSampler:
+    def test_identity_grid_reproduces_input(self):
+        data = np.random.RandomState(0).randn(2, 3, 5, 4).astype("float32")
+        theta = np.tile(np.array([[1, 0, 0, 0, 1, 0]], "float32"), (2, 1))
+        grid = nd.GridGenerator(nd.array(theta), transform_type="affine",
+                                target_shape=(5, 4))
+        out = nd.BilinearSampler(nd.array(data), grid).asnumpy()
+        np.testing.assert_allclose(out, data, rtol=1e-5, atol=1e-5)
+
+    def test_outside_samples_are_zero(self):
+        data = nd.ones((1, 1, 2, 2))
+        grid = nd.array(np.full((1, 2, 2, 2), 5.0, "float32"))
+        out = nd.BilinearSampler(data, grid).asnumpy()
+        assert np.all(out == 0)
+
+    def test_interpolation_midpoint(self):
+        data = nd.array(np.array([[[[0., 1.], [2., 3.]]]], "float32"))
+        grid = nd.array(np.zeros((1, 2, 1, 1), "float32"))  # center
+        out = nd.BilinearSampler(data, grid).asnumpy()
+        np.testing.assert_allclose(out[0, 0, 0, 0], 1.5, rtol=1e-6)
+
+    def test_gradients_flow(self):
+        data = nd.array(np.random.randn(1, 2, 4, 4).astype("float32"))
+        grid = nd.array(
+            np.random.uniform(-0.9, 0.9, (1, 2, 3, 3)).astype("float32"))
+        data.attach_grad()
+        grid.attach_grad()
+        with mx.autograd.record():
+            out = nd.BilinearSampler(data, grid)
+        out.backward()
+        assert np.abs(data.grad.asnumpy()).sum() > 0
+        assert grid.grad is not None
+
+
+class TestSpatialTransformer:
+    def test_matches_grid_plus_sampler(self):
+        rng = np.random.RandomState(1)
+        data = rng.randn(2, 3, 6, 6).astype("float32")
+        theta = rng.uniform(-1, 1, (2, 6)).astype("float32")
+        st = nd.SpatialTransformer(
+            nd.array(data), nd.array(theta), target_shape=(4, 5),
+            transform_type="affine", sampler_type="bilinear").asnumpy()
+        grid = nd.GridGenerator(nd.array(theta), transform_type="affine",
+                                target_shape=(4, 5))
+        two = nd.BilinearSampler(nd.array(data), grid).asnumpy()
+        np.testing.assert_allclose(st, two, rtol=1e-6)
+
+
+class TestCorrelation:
+    def test_self_correlation_zero_displacement(self):
+        rng = np.random.RandomState(2)
+        a = rng.randn(1, 4, 6, 6).astype("float32")
+        out = nd.Correlation(nd.array(a), nd.array(a), kernel_size=1,
+                             max_displacement=1, stride1=1, stride2=1,
+                             pad_size=1).asnumpy()
+        assert out.shape == (1, 9, 6, 6)
+        # center channel (zero displacement) == mean over C of a*a
+        center = out[0, 4]
+        np.testing.assert_allclose(center, (a[0] ** 2).mean(0), rtol=1e-5)
+
+    def test_displacement_picks_up_shift(self):
+        a = np.zeros((1, 1, 5, 5), "float32")
+        b = np.zeros((1, 1, 5, 5), "float32")
+        a[0, 0, 2, 2] = 1.0
+        b[0, 0, 2, 3] = 1.0   # b is a shifted right by 1
+        out = nd.Correlation(nd.array(a), nd.array(b), kernel_size=1,
+                             max_displacement=1, pad_size=1).asnumpy()
+        # displacement (dy=0, dx=+1) is channel 5 in the 3x3 grid
+        assert out[0, 5, 2, 2] == 1.0
+        assert out[0, 4].max() == 0.0
+
+
+class TestLibSVMIter:
+    def test_reads_and_batches(self, tmp_path):
+        path = str(tmp_path / "train.libsvm")
+        with open(path, "w") as f:
+            f.write("1 0:1.5 3:2.0\n")
+            f.write("0 1:1.0\n")
+            f.write("1 2:3.0 3:4.0\n")
+        it = mx.io.LibSVMIter(data_libsvm=path, data_shape=(4,),
+                              batch_size=2)
+        batches = list(it)
+        assert len(batches) == 2
+        b0 = batches[0]
+        assert b0.data[0].stype == "csr"
+        np.testing.assert_allclose(
+            b0.data[0].asnumpy(),
+            [[1.5, 0, 0, 2.0], [0, 1.0, 0, 0]])
+        np.testing.assert_array_equal(b0.label[0].asnumpy(), [1, 0])
+        # wrap-around padding on the last batch
+        b1 = batches[1]
+        assert b1.pad == 1
+        np.testing.assert_allclose(
+            b1.data[0].asnumpy(),
+            [[0, 0, 3.0, 4.0], [1.5, 0, 0, 2.0]])
+
+    def test_label_libsvm_multidim(self, tmp_path):
+        data = str(tmp_path / "d.libsvm")
+        lab = str(tmp_path / "l.libsvm")
+        with open(data, "w") as f:
+            f.write("0 0:1.0\n0 1:1.0\n")
+        with open(lab, "w") as f:
+            f.write("0 0:1.0 2:5.0\n")
+            f.write("0 1:2.0\n")
+        it = mx.io.LibSVMIter(data_libsvm=data, data_shape=(2,),
+                              batch_size=2, label_libsvm=lab,
+                              label_shape=(3,))
+        assert it.provide_label[0].shape == (2, 3)
+        batch = next(it)
+        np.testing.assert_allclose(batch.label[0].asnumpy(),
+                                   [[1, 0, 5], [0, 2, 0]])
+
+    def test_label_shape_without_file_rejected(self, tmp_path):
+        data = str(tmp_path / "d2.libsvm")
+        with open(data, "w") as f:
+            f.write("0 0:1.0\n")
+        with pytest.raises(ValueError):
+            mx.io.LibSVMIter(data_libsvm=data, data_shape=(2,),
+                             batch_size=1, label_shape=(3,))
+
+    def test_sparse_dot_training_flow(self, tmp_path):
+        """csr batch drives a linear model through sparse dot."""
+        rng = np.random.RandomState(3)
+        path = str(tmp_path / "w.libsvm")
+        w_true = rng.randn(10).astype("float32")
+        with open(path, "w") as f:
+            for _ in range(8):
+                cols = np.sort(rng.choice(10, 3, replace=False))
+                vals = rng.randn(3)
+                label = float((vals * w_true[cols]).sum() > 0)
+                f.write("%d %s\n" % (label, " ".join(
+                    "%d:%.4f" % (c, v) for c, v in zip(cols, vals))))
+        it = mx.io.LibSVMIter(data_libsvm=path, data_shape=(10,),
+                              batch_size=4)
+        batch = next(it)
+        w = nd.array(rng.randn(10, 1).astype("float32"))
+        out = nd.dot(batch.data[0], w)
+        assert out.shape == (4, 1)
+
+
+class TestPredictor:
+    def _train_tiny(self, tmp_path):
+        np.random.seed(0)
+        X = np.random.randn(64, 6).astype("float32")
+        y = (X.sum(1) > 0).astype("float32")
+        net = mx.sym.SoftmaxOutput(
+            mx.sym.FullyConnected(mx.sym.Variable("data"), num_hidden=2,
+                                  name="fc"), name="softmax")
+        mod = mx.mod.Module(net, ("data",), ("softmax_label",))
+        train = mx.io.NDArrayIter(X, y, batch_size=16)
+        mod.fit(train, num_epoch=2, optimizer="sgd",
+                optimizer_params={"learning_rate": 0.5})
+        prefix = str(tmp_path / "m")
+        mod.save_checkpoint(prefix, 1)
+        return prefix, X
+
+    def test_checkpoint_predictor(self, tmp_path):
+        prefix, X = self._train_tiny(tmp_path)
+        pred = mx.predictor.load_checkpoint_predictor(prefix, 1)
+        out = pred.forward(data=X[:8])
+        assert out[0].shape == (8, 2)
+        np.testing.assert_allclose(out[0].asnumpy().sum(1), np.ones(8),
+                                   rtol=1e-5)
+
+    def test_export_and_headless_reload(self, tmp_path):
+        prefix, X = self._train_tiny(tmp_path)
+        pred = mx.predictor.load_checkpoint_predictor(prefix, 1)
+        want = pred.forward(data=X[:8])[0].asnumpy()
+
+        art = pred.export(str(tmp_path / "deploy"),
+                          {"data": (8, 6)})
+        assert os.path.exists(art)
+        loaded = mx.predictor.CompiledPredictor.load(
+            str(tmp_path / "deploy"))
+        got = loaded.forward(data=X[:8])[0].asnumpy()
+        np.testing.assert_allclose(got, want, rtol=1e-6)
+        assert loaded.output_names == ["softmax_output"]
