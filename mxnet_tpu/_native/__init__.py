@@ -1,0 +1,150 @@
+"""Native (C++) runtime components, built on demand with g++.
+
+The reference implements its data plane in C++ (dmlc-core recordio,
+src/io iterators); this package holds the TPU framework's native
+equivalents, compiled lazily into shared objects next to the sources
+(ctypes bindings — no pybind11 dependency). Every native path has a
+pure-Python fallback: absence of a toolchain degrades performance, not
+functionality.
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_LOCK = threading.Lock()
+_LIBS = {}
+
+
+def _build(name):
+    """Compile <name>.cc -> lib<name>.so if missing/stale; None on any
+    failure (callers fall back to Python)."""
+    src = os.path.join(_DIR, name + ".cc")
+    so = os.path.join(_DIR, "lib%s.so" % name)
+    try:
+        if (not os.path.exists(so) or
+                os.path.getmtime(so) < os.path.getmtime(src)):
+            # per-process temp name: concurrent first-use builds (e.g.
+            # multiprocessing loader workers) must not interleave writes
+            tmp = "%s.tmp.%d" % (so, os.getpid())
+            subprocess.run(
+                ["g++", "-O3", "-std=c++17", "-shared", "-fPIC", src,
+                 "-o", tmp],
+                check=True, capture_output=True, timeout=120)
+            os.replace(tmp, so)
+        return so
+    except Exception:
+        return None
+
+
+def load(name):
+    """ctypes handle for lib<name>.so (cached); None if unavailable."""
+    with _LOCK:
+        if name in _LIBS:
+            return _LIBS[name]
+        so = _build(name)
+        lib = None
+        if so is not None:
+            try:
+                lib = ctypes.CDLL(so)
+            except OSError:
+                lib = None
+        _LIBS[name] = lib
+        return lib
+
+
+_MAGIC_BYTES = b"\x0a\x23\xd7\xce"
+
+
+class NativeRecordFile:
+    """mmap-backed access to a .rec file: the C++ scanner builds the
+    record index once (recordio.cc); the exported offset table lets
+    reads slice a Python mmap directly — zero per-record FFI, one
+    memcpy per record. Raises ImportError when the native library is
+    unavailable — callers catch and fall back."""
+
+    def __init__(self, path):
+        import mmap as _mmap
+
+        import numpy as np
+
+        lib = load("recordio")
+        if lib is None:
+            raise ImportError("native recordio library unavailable")
+        lib.rio_open.restype = ctypes.c_void_p
+        lib.rio_open.argtypes = [ctypes.c_char_p]
+        lib.rio_count.restype = ctypes.c_long
+        lib.rio_count.argtypes = [ctypes.c_void_p]
+        lib.rio_num_parts.restype = ctypes.c_long
+        lib.rio_num_parts.argtypes = [ctypes.c_void_p]
+        lib.rio_export.argtypes = [ctypes.c_void_p] + \
+            [np.ctypeslib.ndpointer(np.int64)] * 4
+        lib.rio_close.argtypes = [ctypes.c_void_p]
+
+        handle = lib.rio_open(path.encode())
+        if not handle:
+            raise IOError("cannot open/scan %r" % path)
+        try:
+            count = lib.rio_count(handle)
+            n_parts = lib.rio_num_parts(handle)
+            rec_starts = np.empty(count + 1, np.int64)
+            part_offs = np.empty(max(n_parts, 1), np.int64)
+            part_lens = np.empty(max(n_parts, 1), np.int64)
+            hdr_offs = np.empty(max(count, 1), np.int64)
+            lib.rio_export(handle, rec_starts, part_offs, part_lens,
+                           hdr_offs)
+        finally:
+            lib.rio_close(handle)
+        # plain lists: scalar indexing in the per-record hot loop is
+        # ~3x faster than numpy item access
+        self._rec_starts = rec_starts.tolist()
+        self._part_ends = (part_offs + part_lens).tolist()
+        self._part_offs = part_offs.tolist()
+        self._hdr_offs = hdr_offs[:count]
+
+        self._count = count
+        self._file = open(path, "rb")
+        self._mm = _mmap.mmap(self._file.fileno(), 0,
+                              access=_mmap.ACCESS_READ)
+        self.path = path
+
+    def __len__(self):
+        return self._count
+
+    def read(self, i):
+        """Assembled payload bytes of record ``i``."""
+        if not 0 <= i < self._count:
+            raise IndexError(i)
+        lo, hi = self._rec_starts[i], self._rec_starts[i + 1]
+        if hi == lo + 1:                       # common case: one part
+            return self._mm[self._part_offs[lo]:self._part_ends[lo]]
+        parts = [self._mm[self._part_offs[p]:self._part_ends[p]]
+                 for p in range(lo, hi)]
+        return _MAGIC_BYTES.join(parts)
+
+    def find_offset(self, offset):
+        """Record ordinal whose header lives at byte ``offset`` (the
+        .idx sidecar stores these), or -1."""
+        import numpy as np
+        i = int(np.searchsorted(self._hdr_offs, offset))
+        if i < self._count and self._hdr_offs[i] == offset:
+            return i
+        return -1
+
+    def offset(self, i):
+        return int(self._hdr_offs[i]) if 0 <= i < self._count else -1
+
+    def close(self):
+        if getattr(self, "_mm", None) is not None:
+            self._mm.close()
+            self._file.close()
+            self._mm = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass

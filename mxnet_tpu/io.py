@@ -533,7 +533,10 @@ class LibSVMIter(DataIter):
             for r in range(len(lptr) - 1):
                 seg = slice(lptr[r], lptr[r + 1])
                 dense[r, lc[seg]] = lv[seg]
-            labels = dense.reshape((-1,) + self._label_shape)
+            if self._label_shape in ((), (1,)):
+                labels = dense.reshape(-1)   # matches provide_label (N,)
+            else:
+                labels = dense.reshape((-1,) + self._label_shape)
         elif self._label_shape not in ((), (1,)):
             raise ValueError("label_shape %r needs a label_libsvm file "
                              "(the data file's leading token is a single "
@@ -599,7 +602,7 @@ class LibSVMIter(DataIter):
             if not self._round_batch:
                 raise StopIteration
             pad = end - self._num
-            ids = np.concatenate([ids, np.arange(pad)])
+            ids = np.concatenate([ids, np.arange(pad) % self._num])
         self._cursor = end
         from .ndarray import array as _arr
         return DataBatch(

@@ -135,11 +135,22 @@ class KVStore:
             if k not in self._store:
                 raise KeyError("key %r has not been initialized" % (k,))
             src = self._store[k]
+            src_dense = None
+            if isinstance(src, sparse.BaseSparseNDArray):
+                # sparse store + plain pull: densify ONCE, broadcast to
+                # every device copy (sparse-to-sparse goes through
+                # row_sparse_pull)
+                if not all(isinstance(o, sparse.BaseSparseNDArray)
+                           for o in olist):
+                    src_dense = src.todense()._data
             for o in olist:
                 if isinstance(src, sparse.BaseSparseNDArray):
-                    # sparse store + plain pull: broadcast densified copy
-                    # (sparse-to-sparse goes through row_sparse_pull)
-                    src.copyto(o)
+                    if isinstance(o, sparse.BaseSparseNDArray):
+                        src.copyto(o)
+                    else:
+                        o._set_data(src_dense.astype(o._data.dtype)
+                                    if o.dtype != src.dtype
+                                    else src_dense)
                     continue
                 o._set_data(src._data.astype(o._data.dtype)
                             if o.dtype != src.dtype else src._data)

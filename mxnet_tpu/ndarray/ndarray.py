@@ -143,6 +143,11 @@ class NDArray:
 
     def copyto(self, other):
         if isinstance(other, NDArray):
+            if other.stype != "default":
+                raise TypeError(
+                    "cannot copy a dense array into %s storage — cast "
+                    "with tostype(%r) instead"
+                    % (type(other).__name__, other.stype))
             other._set_data(jax.device_put(self._data,
                                            other.context.jax_device()))
             return other

@@ -176,6 +176,10 @@ class RowSparseNDArray(BaseSparseNDArray):
             other._indices = self._indices
             other._sshape = self._sshape
             return other
+        if isinstance(other, BaseSparseNDArray):
+            raise TypeError("cannot copy row_sparse into %s — storage "
+                            "types must match (tostype first)"
+                            % type(other).__name__)
         if isinstance(other, NDArray):
             other._set_data(self.todense()._data)
             return other
@@ -245,6 +249,10 @@ class CSRNDArray(BaseSparseNDArray):
             other._indptr = self._indptr
             other._sshape = self._sshape
             return other
+        if isinstance(other, BaseSparseNDArray):
+            raise TypeError("cannot copy csr into %s — storage types "
+                            "must match (tostype first)"
+                            % type(other).__name__)
         if isinstance(other, NDArray):
             other._set_data(self.todense()._data)
             return other
@@ -522,14 +530,23 @@ def _install_sparse_dispatch(pkg_globals, op_module):
     """Wrap the generated nd.* entry points so sparse inputs route to the
     kernels above (the analogue of FComputeEx dispatch,
     c_api_ndarray.cc:521-549). Dense calls fall through untouched."""
-    def wrap(name, choose):
+    def wrap(name, choose, handles_out=False):
         dense_fn = getattr(op_module, name)
 
         def dispatch(*args, **kwargs):
             fn = choose(args, kwargs)
             if fn is None:
                 return dense_fn(*args, **kwargs)
-            return fn(*args, **kwargs)
+            if handles_out:
+                return fn(*args, **kwargs)
+            # generic out= support for the sparse routes (copyto raises
+            # on a storage-type mismatch rather than corrupting out)
+            out = kwargs.pop("out", None)
+            res = fn(*args, **kwargs)
+            if out is not None:
+                res.copyto(out)
+                return out
+            return res
         dispatch.__name__ = name
         dispatch.__doc__ = dense_fn.__doc__
         setattr(op_module, name, dispatch)
@@ -549,14 +566,7 @@ def _install_sparse_dispatch(pkg_globals, op_module):
                 stype not in (None, "default")):
             return None    # dense->default: generated op handles out=
 
-        def _do(data, *_a, **kw):
-            res = cast_storage(data, stype)
-            out = kw.get("out")
-            if out is None:
-                return res
-            res.copyto(out)
-            return out
-        return _do
+        return lambda data, *_a, **_kw: cast_storage(data, stype)
     wrap("cast_storage", _cast_choose)
 
     wrap("_sparse_retain",
@@ -588,4 +598,4 @@ def _install_sparse_dispatch(pkg_globals, op_module):
     for upd in _SPARSE_UPDATES:
         wrap(upd, lambda a, kw, _u=upd: _SPARSE_UPDATES[_u]
              if len(a) > 1 and isinstance(a[1], RowSparseNDArray)
-             else None)
+             else None, handles_out=True)

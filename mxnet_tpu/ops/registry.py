@@ -206,6 +206,14 @@ def invoke_eager(opdef, nd_inputs, attrs, out=None):
     arrays = []
     for x in nd_inputs:
         if isinstance(x, NDArray):
+            if x._stype != "default":
+                # dense kernels would silently read the (nnz, ...) values
+                # buffer; only the sparse-dispatch wrappers
+                # (ndarray/sparse.py) may route sparse storage
+                raise TypeError(
+                    "operator %r has no sparse implementation for a %s "
+                    "input — cast with tostype('default') first"
+                    % (opdef.name, x._stype))
             arrays.append(x._data)
         else:
             arrays.append(array(x)._data)

@@ -11,4 +11,5 @@ update_on_kvstore server-side updates).
 from .trainer import make_train_step, TrainStep
 from .sharding import (data_parallel_mesh, make_mesh, param_sharding,
                        batch_sharding)
+from .ring import ring_attention
 from . import dist

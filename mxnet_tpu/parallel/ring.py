@@ -1,0 +1,97 @@
+"""Ring attention — sequence/context parallelism over the device mesh.
+
+New TPU-native capability (SURVEY §2.3: the reference has NO sequence
+parallelism; its long-sequence story was bucketing + BPTT truncation).
+This is the standard ring schedule (Liu et al., Ring Attention, 2023):
+queries stay put, key/value blocks rotate around the mesh axis via
+``lax.ppermute`` (riding ICI neighbour links), and the flash-style
+online softmax merges each visiting block — every device holds only
+T/n of the sequence at any moment, so max context scales linearly with
+the mesh axis while compute stays MXU-dense per block.
+
+Compose with data/tensor parallel axes freely: q/k/v enter sharded
+(B, H, T, D) with T split over ``axis_name``; output keeps that
+sharding.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+try:                                     # jax>=0.6 moved shard_map up
+    from jax import shard_map as _shard_map
+except ImportError:                      # pragma: no cover
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+__all__ = ["ring_attention"]
+
+_NEG_INF = -1e30
+
+
+def _ring_local(q, k, v, *, axis_name, causal, scale):
+    """Per-device body: q (B,H,Tq,D) local; k/v local blocks that will
+    rotate n-1 times."""
+    n = lax.psum(1, axis_name)
+    me = lax.axis_index(axis_name)
+    B, H, Tq, D = q.shape
+    Tk = k.shape[2]
+
+    m0 = jnp.full((B, H, Tq, 1), _NEG_INF, jnp.float32)
+    l0 = jnp.zeros((B, H, Tq, 1), jnp.float32)
+    acc0 = jnp.zeros((B, H, Tq, D), jnp.float32)
+    # constants enter the loop carry device-varying (their updates vary
+    # over the ring axis; shard_map type-checks this)
+    m0, l0, acc0 = (lax.pvary(x, (axis_name,)) for x in (m0, l0, acc0))
+    perm = [(j, (j + 1) % n) for j in range(n)]
+
+    def attend(t, k_cur, v_cur, m, l, acc):
+        src = (me - t) % n               # global block id of k_cur
+        s = jnp.einsum("bhqd,bhkd->bhqk", q, k_cur,
+                       preferred_element_type=jnp.float32) * scale
+        if causal:
+            rows = me * Tq + jnp.arange(Tq)[:, None]
+            cols = src * Tk + jnp.arange(Tk)[None, :]
+            s = jnp.where(rows >= cols, s, _NEG_INF)
+        m_new = jnp.maximum(m, s.max(axis=-1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        alpha = jnp.exp(m - m_new)
+        l = l * alpha + p.sum(axis=-1, keepdims=True)
+        acc = acc * alpha + jnp.einsum(
+            "bhqk,bhkd->bhqd", p.astype(v_cur.dtype), v_cur,
+            preferred_element_type=jnp.float32)
+        return m_new, l, acc
+
+    def step(t, carry):
+        k_cur, v_cur, m, l, acc = carry
+        m, l, acc = attend(t, k_cur, v_cur, m, l, acc)
+        # rotate KV to the next neighbour (ICI hop), overlapping with
+        # the next block's compute under XLA's async collectives
+        k_nxt = lax.ppermute(k_cur, axis_name, perm)
+        v_nxt = lax.ppermute(v_cur, axis_name, perm)
+        return k_nxt, v_nxt, m, l, acc
+
+    # n-1 rotations visit every remote block; the final visiting block is
+    # consumed without a wasted last rotation (a collective in the loop
+    # tail cannot be DCE'd by XLA)
+    k_last, v_last, m, l, acc = lax.fori_loop(
+        0, n - 1, step, (k, v, m0, l0, acc0))
+    m, l, acc = attend(n - 1, k_last, v_last, m, l, acc)
+    return (acc / jnp.maximum(l, 1e-30)).astype(q.dtype)
+
+
+def ring_attention(q, k, v, mesh, axis_name="sp", causal=False,
+                   scale=None):
+    """Sequence-parallel attention: (B, H, T, D) inputs with T sharded
+    over ``mesh`` axis ``axis_name``; output sharded the same way."""
+    if scale is None:
+        scale = q.shape[-1] ** -0.5
+    spec = P(None, None, axis_name, None)
+    fn = _shard_map(
+        functools.partial(_ring_local, axis_name=axis_name,
+                          causal=causal, scale=float(scale)),
+        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec)
+    return fn(q, k, v)

@@ -61,6 +61,17 @@ class MXRecordIO:
         else:
             raise ValueError("Invalid flag %s" % self.flag)
         self.is_open = True
+        # native fast path: mmap'd C++ record index (one memcpy per
+        # record); MXNET_NATIVE_RECORDIO=0 forces the Python reader
+        self._native = None
+        self._cursor = 0
+        if (self.flag == "r" and
+                os.environ.get("MXNET_NATIVE_RECORDIO", "1") != "0"):
+            try:
+                from ._native import NativeRecordFile
+                self._native = NativeRecordFile(self.uri)
+            except Exception:
+                self._native = None
 
     def __del__(self):
         self.close()
@@ -72,6 +83,7 @@ class MXRecordIO:
         d = dict(self.__dict__)
         d["is_open"] = is_open
         d.pop("fp", None)
+        d.pop("_native", None)
         return d
 
     def __setstate__(self, d):
@@ -86,8 +98,18 @@ class MXRecordIO:
         if self.is_open and self.fp is not None:
             self.fp.close()
             self.is_open = False
+            if getattr(self, "_native", None) is not None:
+                self._native.close()
+                self._native = None
 
     def reset(self):
+        if (not self.writable and getattr(self, "_native", None)
+                is not None):
+            # keep the scanned index alive across epochs; a reset is
+            # just a rewind
+            self._cursor = 0
+            self.fp.seek(0)
+            return
         self.close()
         self.open()
 
@@ -125,6 +147,12 @@ class MXRecordIO:
     def read(self):
         """Read one (logical) record; None at EOF."""
         assert not self.writable
+        if self._native is not None:
+            if self._cursor >= len(self._native):
+                return None
+            rec = self._native.read(self._cursor)
+            self._cursor += 1
+            return rec
         out = None
         while True:
             head = self.fp.read(8)
@@ -188,7 +216,17 @@ class MXIndexedRecordIO(MXRecordIO):
 
     def seek(self, idx):
         assert not self.writable
-        self.fp.seek(self.idx[idx])
+        pos = self.idx[idx]
+        self.fp.seek(pos)
+        if self._native is not None:
+            ordinal = self._native.find_offset(pos)
+            if ordinal >= 0:
+                self._cursor = ordinal
+            else:
+                # index sidecar disagrees with the scan: distrust the
+                # native index for this file
+                self._native.close()
+                self._native = None
 
     def read_idx(self, idx):
         self.seek(idx)

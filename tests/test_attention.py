@@ -1,0 +1,120 @@
+"""Flash attention kernel + ring attention sequence parallelism tests.
+
+The Pallas kernel runs in interpreter mode on the CPU test mesh (same
+numerics as compiled TPU execution); ring attention runs as a real
+8-device shard_map program on the forced CPU mesh (tests/conftest.py).
+"""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+from mxnet_tpu.ops.attention import flash_attention, _attn_reference
+from mxnet_tpu.parallel import ring_attention
+
+
+def _qkv(B, T, D, seed=0, heads=None):
+    rng = np.random.RandomState(seed)
+    shape = (B, T, D) if heads is None else (B, heads, T, D)
+    return tuple(jnp.asarray(rng.randn(*shape).astype("float32"))
+                 for _ in range(3))
+
+
+class TestFlashKernel:
+    @pytest.mark.parametrize("causal", [False, True])
+    def test_matches_reference(self, causal):
+        q, k, v = _qkv(2, 64, 16)
+        out = flash_attention(q, k, v, causal=causal, block_q=32,
+                              block_k=32)
+        ref = _attn_reference(q, k, v, 16 ** -0.5, causal)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=2e-5, atol=2e-6)
+
+    def test_4d_and_cross_lengths(self):
+        q, _, _ = _qkv(2, 32, 16, heads=4)
+        _, k, v = _qkv(2, 48, 16, seed=1, heads=4)
+        out = flash_attention(q, k, v)
+        assert out.shape == (2, 4, 32, 16)
+        ref = _attn_reference(q.reshape(8, 32, 16), k.reshape(8, 48, 16),
+                              v.reshape(8, 48, 16), 16 ** -0.5, False)
+        np.testing.assert_allclose(np.asarray(out).reshape(8, 32, 16),
+                                   np.asarray(ref), rtol=2e-5, atol=2e-6)
+
+    @pytest.mark.parametrize("causal", [False, True])
+    def test_ragged_lengths(self, causal):
+        """T not a multiple of the block size: padded keys must not leak
+        into the softmax."""
+        q, _, _ = _qkv(2, 40, 16, seed=5)
+        _, k, v = _qkv(2, 40, 16, seed=6)
+        out = flash_attention(q, k, v, causal=causal, block_q=32,
+                              block_k=32)
+        ref = _attn_reference(q, k, v, 16 ** -0.5, causal)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=2e-5, atol=2e-6)
+
+    def test_gradients_match_reference(self):
+        q, k, v = _qkv(1, 32, 8, seed=2)
+
+        def loss_flash(q_, k_, v_):
+            return (flash_attention(q_, k_, v_, causal=True) ** 2).sum()
+
+        def loss_ref(q_, k_, v_):
+            return (_attn_reference(q_, k_, v_, 8 ** -0.5, True) ** 2).sum()
+
+        gf = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+        gr = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+        for a, b in zip(gf, gr):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=1e-4, atol=1e-5)
+
+    def test_registered_op(self):
+        q, k, v = _qkv(1, 16, 8, heads=2)
+        out = nd._contrib_FlashAttention(nd.array(np.asarray(q)),
+                                         nd.array(np.asarray(k)),
+                                         nd.array(np.asarray(v)),
+                                         causal=True)
+        assert out.shape == (1, 2, 16, 8)
+
+
+@pytest.mark.skipif(jax.device_count() < 8, reason="needs 8-device mesh")
+class TestRingAttention:
+    def _mesh(self):
+        return Mesh(np.array(jax.devices()[:8]), ("sp",))
+
+    @pytest.mark.parametrize("causal", [False, True])
+    def test_matches_single_device(self, causal):
+        mesh = self._mesh()
+        q, k, v = _qkv(2, 8 * 16, 32, heads=2)
+        shard = NamedSharding(mesh, P(None, None, "sp", None))
+        qs, ks, vs = (jax.device_put(x, shard) for x in (q, k, v))
+        out = ring_attention(qs, ks, vs, mesh, "sp", causal=causal)
+        B, H, T, D = q.shape
+        ref = _attn_reference(q.reshape(B * H, T, D),
+                              k.reshape(B * H, T, D),
+                              v.reshape(B * H, T, D), D ** -0.5, causal)
+        np.testing.assert_allclose(
+            np.asarray(out).reshape(B * H, T, D), np.asarray(ref),
+            rtol=2e-5, atol=2e-6)
+
+    def test_output_stays_sequence_sharded(self):
+        mesh = self._mesh()
+        q, k, v = _qkv(1, 8 * 8, 16, heads=1)
+        shard = NamedSharding(mesh, P(None, None, "sp", None))
+        qs, ks, vs = (jax.device_put(x, shard) for x in (q, k, v))
+        out = jax.jit(lambda a, b, c: ring_attention(
+            a, b, c, mesh, "sp"))(qs, ks, vs)
+        assert out.sharding.spec == P(None, None, "sp", None)
+
+    def test_collectives_in_hlo(self):
+        mesh = self._mesh()
+        q, k, v = _qkv(1, 8 * 8, 16, heads=1)
+        shard = NamedSharding(mesh, P(None, None, "sp", None))
+        qs, ks, vs = (jax.device_put(x, shard) for x in (q, k, v))
+        hlo = jax.jit(lambda a, b, c: ring_attention(
+            a, b, c, mesh, "sp")).lower(qs, ks, vs).compile()\
+            .as_text()
+        assert "collective-permute" in hlo

@@ -1,0 +1,143 @@
+"""Native C++ RecordIO reader tests (mxnet_tpu/_native/recordio.cc) —
+parity against the Python reader, including continuation-split records
+(payloads embedding the aligned magic word)."""
+import os
+import struct
+
+import numpy as np
+import pytest
+
+from mxnet_tpu import recordio
+from mxnet_tpu.recordio import MXIndexedRecordIO, MXRecordIO
+
+MAGIC = struct.pack("<I", 0xced7230a)
+
+
+def _write_corpus(path, n=50, seed=0):
+    rng = np.random.RandomState(seed)
+    rec = MXRecordIO(path, "w")
+    payloads = []
+    for i in range(n):
+        if i % 7 == 3:
+            # force the continuation-split path: magic embedded at a
+            # 4-byte-aligned position
+            payload = b"abcd" + MAGIC + rng.bytes(8) + MAGIC + b"tail"
+        else:
+            payload = rng.bytes(int(rng.randint(1, 64)))
+        payloads.append(payload)
+        rec.write(payload)
+    rec.close()
+    return payloads
+
+
+def _native_available():
+    from mxnet_tpu._native import load
+    return load("recordio") is not None
+
+
+pytestmark = pytest.mark.skipif(not _native_available(),
+                                reason="g++ toolchain unavailable")
+
+
+def test_native_reader_matches_writes(tmp_path):
+    path = str(tmp_path / "c.rec")
+    payloads = _write_corpus(path)
+    from mxnet_tpu._native import NativeRecordFile
+    f = NativeRecordFile(path)
+    assert len(f) == len(payloads)
+    for i, want in enumerate(payloads):
+        assert f.read(i) == want
+    f.close()
+
+
+def test_sequential_read_uses_native_and_matches_python(tmp_path,
+                                                        monkeypatch):
+    path = str(tmp_path / "c.rec")
+    payloads = _write_corpus(path)
+
+    rec = MXRecordIO(path, "r")
+    assert rec._native is not None
+    got_native = [rec.read() for _ in range(len(payloads))]
+    assert rec.read() is None
+    rec.close()
+
+    monkeypatch.setenv("MXNET_NATIVE_RECORDIO", "0")
+    rec = MXRecordIO(path, "r")
+    assert rec._native is None
+    got_python = [rec.read() for _ in range(len(payloads))]
+    rec.close()
+
+    assert got_native == got_python == payloads
+
+
+def test_indexed_read_via_native(tmp_path):
+    rec_path = str(tmp_path / "i.rec")
+    idx_path = str(tmp_path / "i.idx")
+    w = MXIndexedRecordIO(idx_path, rec_path, "w")
+    rng = np.random.RandomState(1)
+    payloads = {}
+    for key in range(30):
+        payload = rng.bytes(int(rng.randint(1, 40))) \
+            if key % 5 else b"xx" + MAGIC + MAGIC + b"yy"
+        payloads[key] = payload
+        w.write_idx(key, payload)
+    w.close()
+
+    r = MXIndexedRecordIO(idx_path, rec_path, "r")
+    assert r._native is not None
+    for key in (0, 29, 5, 17, 5, 0):
+        assert r.read_idx(key) == payloads[key]
+    r.close()
+
+
+def test_reset_restarts_native_cursor(tmp_path):
+    path = str(tmp_path / "r.rec")
+    payloads = _write_corpus(path, n=5)
+    rec = MXRecordIO(path, "r")
+    assert rec.read() == payloads[0]
+    rec.reset()
+    assert rec.read() == payloads[0]
+    rec.close()
+
+
+def test_seek_then_read(tmp_path):
+    """Public seek()+read() pattern must honour the seek position."""
+    rec_path = str(tmp_path / "s.rec")
+    idx_path = str(tmp_path / "s.idx")
+    w = MXIndexedRecordIO(idx_path, rec_path, "w")
+    for key in range(10):
+        w.write_idx(key, b"rec%03d" % key)
+    w.close()
+    r = MXIndexedRecordIO(idx_path, rec_path, "r")
+    assert r._native is not None
+    r.seek(7)
+    assert r.read() == b"rec007"
+    assert r.read() == b"rec008"   # cursor advanced past the seek point
+    r.close()
+
+
+def test_corrupt_file_falls_back_to_strict_reader(tmp_path):
+    path = str(tmp_path / "bad.rec")
+    payloads = _write_corpus(path, n=3)
+    blob = bytearray(open(path, "rb").read())
+    blob.extend(b"\x01\x02\x03\x04garbage!")     # torn tail
+    open(path, "wb").write(bytes(blob))
+    r = MXRecordIO(path, "r")
+    assert r._native is None      # native scanner refused the file
+    for want in payloads:
+        assert r.read() == want
+    with pytest.raises(AssertionError):
+        r.read()                  # strict reader raises at the tear
+    r.close()
+
+
+def test_pack_unpack_roundtrip_through_native(tmp_path):
+    path = str(tmp_path / "p.rec")
+    rec = MXRecordIO(path, "w")
+    header = recordio.IRHeader(0, 3.5, 7, 0)
+    rec.write(recordio.pack(header, b"payload"))
+    rec.close()
+    rec = MXRecordIO(path, "r")
+    got_header, blob = recordio.unpack(rec.read())
+    assert got_header.label == 3.5 and blob == b"payload"
+    rec.close()

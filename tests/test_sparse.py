@@ -240,6 +240,14 @@ class TestDispatchEdges:
         assert o.nnz == 2
         np.testing.assert_array_equal(o.asnumpy(), src)
 
+    def test_unrouted_dense_op_rejects_sparse(self):
+        csr = sparse.csr_matrix(
+            np.array([[1, 2, 3], [0, 0, 0]], "float32"))
+        with pytest.raises(TypeError):
+            nd.dot(nd.ones((2, 2)), csr)     # sparse rhs: no kernel
+        with pytest.raises(TypeError):
+            nd.broadcast_add(csr, nd.ones((2, 3)))
+
     def test_elemwise_add_mixed(self):
         rs = sparse.row_sparse_array(
             (np.ones((1, 2), "float32"), [1]), shape=(3, 2))
@@ -249,6 +257,28 @@ class TestDispatchEdges:
             assert out.stype == "default"
             np.testing.assert_array_equal(
                 out.asnumpy(), [[1, 1], [2, 2], [1, 1]])
+
+    def test_sparse_routes_honour_out(self):
+        rs = sparse.row_sparse_array(
+            (np.ones((1, 2), "float32"), [1]), shape=(3, 2))
+        o = nd.zeros((3, 2))
+        got = nd.elemwise_add(rs, nd.ones((3, 2)), out=o)
+        assert got is o
+        np.testing.assert_array_equal(o.asnumpy(),
+                                      [[1, 1], [2, 2], [1, 1]])
+        csr = sparse.csr_matrix(np.eye(3, dtype="float32"))
+        o2 = nd.zeros((3, 2))
+        nd.dot(csr, nd.ones((3, 2)), out=o2)
+        np.testing.assert_array_equal(o2.asnumpy(), np.ones((3, 2)))
+
+    def test_mismatched_copyto_refused(self):
+        rs = sparse.row_sparse_array(
+            (np.ones((1, 2), "float32"), [1]), shape=(3, 2))
+        csr = sparse.csr_matrix(np.eye(2, dtype="float32"))
+        with pytest.raises(TypeError):
+            rs.copyto(csr)
+        with pytest.raises(TypeError):
+            nd.ones((3, 2)).copyto(rs)
 
 
 class TestKVStore:
