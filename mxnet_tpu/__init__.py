@@ -77,6 +77,9 @@ from . import operator
 from . import profiler
 from . import predictor
 from .predictor import Predictor
+from . import rtc
+from . import visualization
+from . import visualization as viz
 
 from . import recordio
 from . import image

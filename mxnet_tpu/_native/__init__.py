@@ -109,6 +109,7 @@ class NativeRecordFile:
         self._file = open(path, "rb")
         self._mm = _mmap.mmap(self._file.fileno(), 0,
                               access=_mmap.ACCESS_READ)
+        self.size = self._mm.size()
         self.path = path
 
     def __len__(self):

@@ -107,34 +107,6 @@ long rio_count(void* h) {
   return static_cast<long>(f->starts.size()) - 1;
 }
 
-// assembled payload length of record i (-1 if out of range)
-long rio_len(void* h, long i) {
-  RioFile* f = static_cast<RioFile*>(h);
-  if (i < 0 || i + 1 >= static_cast<long>(f->starts.size())) return -1;
-  uint64_t total = 0;
-  uint64_t n_parts = f->starts[i + 1] - f->starts[i];
-  for (uint64_t p = f->starts[i]; p < f->starts[i + 1]; ++p)
-    total += f->parts[p].len;
-  return static_cast<long>(total + 4 * (n_parts - 1));  // rejoin magics
-}
-
-// copy assembled record i into dst (cap bytes); returns written length
-long rio_read(void* h, long i, uint8_t* dst, long cap) {
-  RioFile* f = static_cast<RioFile*>(h);
-  long need = rio_len(h, i);
-  if (need < 0 || cap < need) return -1;
-  uint8_t* out = dst;
-  for (uint64_t p = f->starts[i]; p < f->starts[i + 1]; ++p) {
-    if (p != f->starts[i]) {
-      std::memcpy(out, &kMagic, 4);
-      out += 4;
-    }
-    std::memcpy(out, f->base + f->parts[p].off, f->parts[p].len);
-    out += f->parts[p].len;
-  }
-  return need;
-}
-
 long rio_num_parts(void* h) {
   RioFile* f = static_cast<RioFile*>(h);
   return static_cast<long>(f->parts.size());
@@ -154,26 +126,6 @@ void rio_export(void* h, int64_t* rec_starts, int64_t* part_offs,
   }
   for (size_t i = 0; i < f->offsets.size(); ++i)
     hdr_offs[i] = static_cast<int64_t>(f->offsets[i]);
-}
-
-// ordinal of the record whose header starts at byte `offset` (-1: none)
-long rio_find(void* h, long offset) {
-  RioFile* f = static_cast<RioFile*>(h);
-  long lo = 0, hi = static_cast<long>(f->offsets.size()) - 1;
-  while (lo <= hi) {
-    long mid = (lo + hi) / 2;
-    if (static_cast<long>(f->offsets[mid]) == offset) return mid;
-    if (static_cast<long>(f->offsets[mid]) < offset) lo = mid + 1;
-    else hi = mid - 1;
-  }
-  return -1;
-}
-
-// byte offset of record i's header (-1 if out of range)
-long rio_offset(void* h, long i) {
-  RioFile* f = static_cast<RioFile*>(h);
-  if (i < 0 || i >= static_cast<long>(f->offsets.size())) return -1;
-  return static_cast<long>(f->offsets[i]);
 }
 
 void rio_close(void* h) {

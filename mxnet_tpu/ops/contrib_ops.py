@@ -1,0 +1,88 @@
+"""Contrib op tail: fft/ifft, count_sketch, quantize/dequantize.
+
+Reference: src/operator/contrib/{fft,ifft,count_sketch,quantize,
+dequantize}-inl.h. The cuFFT-backed ops become jnp.fft (XLA lowers to
+the TPU FFT implementation); count_sketch's scatter-add hashing becomes
+one segment_sum; quantization keeps the reference's affine uint8
+mapping and min/max plumbing.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .registry import register
+
+
+@register("_contrib_fft", arg_names=("data",),
+          aliases=("fft",), defaults={"compute_size": 128})
+def _fft(data, **_):
+    """Real input (..., d) -> (..., 2d) interleaved [re, im] along the
+    last axis (reference fft-inl.h layout)."""
+    out = jnp.fft.fft(data.astype(jnp.float32), axis=-1)
+    inter = jnp.stack([out.real, out.imag], axis=-1)
+    return inter.reshape(data.shape[:-1] + (2 * data.shape[-1],)) \
+        .astype(data.dtype)
+
+
+@register("_contrib_ifft", arg_names=("data",),
+          aliases=("ifft",), defaults={"compute_size": 128})
+def _ifft(data, **_):
+    """Interleaved (..., 2d) -> real (..., d). Like the reference (cuFFT
+    inverse), the result is NOT normalized: ifft(fft(x)) == d * x."""
+    d = data.shape[-1] // 2
+    pairs = data.reshape(data.shape[:-1] + (d, 2)).astype(jnp.float32)
+    comp = jax.lax.complex(pairs[..., 0], pairs[..., 1])
+    return (jnp.fft.ifft(comp, axis=-1).real * d).astype(data.dtype)
+
+
+@register("_contrib_count_sketch", arg_names=("data", "h", "s"),
+          nondiff_inputs=(1, 2),
+          defaults={"out_dim": 0, "processing_batch_size": 32})
+def _count_sketch(data, h, s, out_dim=0, **_):
+    """Count-sketch projection (reference count_sketch-inl.h):
+    out[..., h[j]] += s[j] * in[..., j]; h (1, in_dim) hash buckets,
+    s (1, in_dim) signs."""
+    in_dim = data.shape[-1]
+    hh = h.reshape(-1)[:in_dim].astype(jnp.int32)
+    ss = s.reshape(-1)[:in_dim].astype(data.dtype)
+    flat = data.reshape(-1, in_dim)
+    contrib = flat * ss[None, :]
+    out = jax.ops.segment_sum(contrib.T, hh,
+                              num_segments=int(out_dim)).T
+    return out.reshape(data.shape[:-1] + (int(out_dim),))
+
+
+@register("_contrib_quantize", arg_names=("data", "min_range", "max_range"),
+          differentiable=False, aliases=("quantize",),
+          defaults={"out_type": "uint8"})
+def _quantize(data, min_range, max_range, out_type="uint8", **_):
+    """Affine quantization to uint8/int8 (reference quantize-inl.h):
+    out = (in - min) * (limit_range / (max - min)) + 0.5; min/max pass
+    through as outputs 1/2."""
+    lo, hi = (0.0, 255.0) if out_type == "uint8" else (-127.0, 127.0)
+    dt = jnp.uint8 if out_type == "uint8" else jnp.int8
+    scale = (hi - lo) / (max_range - min_range)
+    # floor(v + 0.5): round-half-up on both signs (int8 negatives would
+    # truncate toward zero under a bare cast)
+    q = jnp.floor((data - min_range) * scale + lo + 0.5)
+    return (jnp.clip(q, lo, hi).astype(dt),
+            min_range.reshape(()).astype(jnp.float32),
+            max_range.reshape(()).astype(jnp.float32))
+
+
+@register("_contrib_dequantize", arg_names=("data", "min_range",
+                                            "max_range"),
+          differentiable=False, aliases=("dequantize",),
+          defaults={"out_type": "float32"})
+def _dequantize(data, min_range, max_range, out_type="float32", **_):
+    """Inverse of quantize (reference dequantize-inl.h): for uint8,
+    out = in * ((max - min) / 255) + min."""
+    if data.dtype == jnp.uint8:
+        lo, hi = 0.0, 255.0
+    else:                      # int8
+        lo, hi = -127.0, 127.0
+    scale = (max_range - min_range) / (hi - lo)
+    return ((data.astype(jnp.float32) - lo) * scale + min_range) \
+        .astype(np.dtype(out_type))

@@ -39,10 +39,13 @@ def _softmax_output(data, label, grad_scale=1.0, ignore_label=-1.0,
 
     @jax.custom_vjp
     def f(d, l):
-        return jnn.softmax(d, axis=axis)
+        # softmax statistics always in f32 (bf16 compute-dtype inputs
+        # would lose probability mass); output back in input dtype
+        return jnn.softmax(d.astype(jnp.float32),
+                           axis=axis).astype(d.dtype)
 
     def fwd(d, l):
-        p = jnn.softmax(d, axis=axis)
+        p = f(d, l)
         return p, (p, l)
 
     def bwd(res, g):

@@ -41,8 +41,12 @@ def _fully_connected(data, weight, bias=None, num_hidden=0, no_bias=False,
         x = data.reshape(data.shape[0], -1)
     else:
         x = data
-    out = jnp.dot(x, weight.T, preferred_element_type=jnp.float32)
-    out = out.astype(data.dtype)
+    if weight.dtype != x.dtype:
+        weight = weight.astype(x.dtype)
+    # no preferred_element_type: the MXU accumulates in f32 internally
+    # for bf16 operands anyway, and mixed-dtype conv/dot transpose rules
+    # reject an f32 cotangent against bf16 residuals
+    out = jnp.dot(x, weight.T)
     if not no_bias and bias is not None:
         out = out + bias
     return out
@@ -79,15 +83,16 @@ def _convolution(data, weight, bias=None, kernel=(), stride=(), dilate=(),
     dn_spec = ("NCHW", "OIHW", "NCHW") if nd == 2 else \
         ("NCDHW", "OIDHW", "NCDHW")
     dn = lax.conv_dimension_numbers(data.shape, weight.shape, dn_spec)
+    if weight.dtype != data.dtype:
+        # mixed-precision tolerance: compute in the activation dtype
+        weight = weight.astype(data.dtype)
     out = lax.conv_general_dilated(
         data, weight,
         window_strides=stride,
         padding=tuple((p, p) for p in pad),
         rhs_dilation=dilate,
         dimension_numbers=dn,
-        feature_group_count=num_group,
-        preferred_element_type=jnp.float32)
-    out = out.astype(data.dtype)
+        feature_group_count=num_group)
     if not no_bias and bias is not None:
         out = out + bias.reshape((1, -1) + (1,) * nd)
     return out
@@ -106,6 +111,9 @@ def _deconvolution(data, weight, bias=None, kernel=(), stride=(), dilate=(),
     dilate = _pair(dilate, nd) if dilate else (1,) * nd
     pad = _pair(pad, nd) if pad else (0,) * nd
     adj = _pair(adj, nd) if adj else (0,) * nd
+    if weight.dtype != data.dtype:
+        # mixed-precision tolerance (same as Convolution)
+        weight = weight.astype(data.dtype)
     # ConvTranspose = grad of conv w.r.t. input: lhs-dilated conv with
     # flipped kernel. weight layout: (in_c, out_c/g, kh, kw) like reference.
     w = jnp.flip(weight, axis=tuple(range(2, 2 + nd)))
@@ -126,8 +134,7 @@ def _deconvolution(data, weight, bias=None, kernel=(), stride=(), dilate=(),
     out = lax.conv_general_dilated(
         data, w, window_strides=(1,) * nd, padding=padding,
         lhs_dilation=stride, rhs_dilation=dilate, dimension_numbers=dn,
-        feature_group_count=num_group,
-        preferred_element_type=jnp.float32).astype(data.dtype)
+        feature_group_count=num_group)
     if not no_bias and bias is not None:
         out = out + bias.reshape((1, -1) + (1,) * nd)
     return out
@@ -204,20 +211,26 @@ def _batch_norm(data, gamma, beta, moving_mean, moving_var, eps=1e-3,
     bshape = tuple(data.shape[axis] if i == axis else 1
                    for i in range(data.ndim))
     g = jnp.ones_like(gamma) if fix_gamma else gamma
+    # statistics in float32 regardless of compute dtype (mixed-precision
+    # discipline: bf16 activations, f32 batch stats), output back in the
+    # input dtype so downstream convs see one dtype
+    xf = data.astype(jnp.float32)
     if is_train and not use_global_stats:
-        mean = jnp.mean(data, axis=red)
-        var = jnp.var(data, axis=red)
+        mean = jnp.mean(xf, axis=red)
+        var = jnp.var(xf, axis=red)
         new_mm = moving_mean * momentum + mean * (1 - momentum)
         new_mv = moving_var * momentum + var * (1 - momentum)
         use_mean, use_var = mean, var
     else:
-        mean = moving_mean
-        var = moving_var
+        mean = moving_mean.astype(jnp.float32)
+        var = moving_var.astype(jnp.float32)
         new_mm, new_mv = moving_mean, moving_var
-        use_mean, use_var = moving_mean, moving_var
+        use_mean, use_var = mean, var
     inv = lax.rsqrt(use_var.reshape(bshape) + eps)
-    out = (data - use_mean.reshape(bshape)) * inv * g.reshape(bshape) + \
-        beta.reshape(bshape)
+    out = (xf - use_mean.reshape(bshape)) * inv * \
+        g.reshape(bshape).astype(jnp.float32) + \
+        beta.reshape(bshape).astype(jnp.float32)
+    out = out.astype(data.dtype)
     if output_mean_var:
         return (out, use_mean, lax.rsqrt(use_var + eps),
                 lax.stop_gradient(new_mm), lax.stop_gradient(new_mv))

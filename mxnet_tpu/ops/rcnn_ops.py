@@ -1,0 +1,382 @@
+"""RCNN / R-FCN operator family: Proposal, MultiProposal, PSROIPooling,
+DeformableConvolution, DeformablePSROIPooling.
+
+Reference: src/operator/contrib/{proposal,multi_proposal,psroi_pooling,
+deformable_convolution,deformable_psroi_pooling}-inl.h. All kernels are
+reformulated static-shape:
+
+- Proposal keeps the reference's anchor arithmetic (proposal-inl.h
+  _Transform/_MakeAnchor, BBoxTransformInv in proposal.cc:40-90) but
+  emits a FIXED rpn_post_nms_top_n rois per image (greedy NMS as a
+  fori_loop over the sorted candidate set, padding by the best box) —
+  XLA-compatible where the reference reallocates per image.
+- PSROIPooling samples each bin on a sub-grid with bilinear taps (the
+  deformable_psroi formulation with zero offsets), keeping every shape
+  static; DeformablePSROIPooling adds the learned per-part offsets.
+- DeformableConvolution gathers one bilinear-sampled image per kernel
+  tap (deformable_im2col semantics) and contracts with the weights in
+  one einsum — the MXU does the heavy product.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import register
+
+
+# ---------------------------------------------------------------------------
+# anchors (host-side, static attrs only)
+# ---------------------------------------------------------------------------
+
+def _base_anchors(feature_stride, scales, ratios):
+    """(A, 4) corner anchors at cell (0, 0) — proposal-inl.h:213."""
+    base = np.array([0, 0, feature_stride - 1.0, feature_stride - 1.0])
+    w = base[2] - base[0] + 1.0
+    h = base[3] - base[1] + 1.0
+    x_ctr = base[0] + 0.5 * (w - 1.0)
+    y_ctr = base[1] + 0.5 * (h - 1.0)
+    size = w * h
+    out = []
+    for ratio in ratios:
+        size_ratio = np.floor(size / ratio)
+        new_w = np.floor(np.sqrt(size_ratio) + 0.5)
+        new_h = np.floor(new_w * ratio + 0.5)
+        for scale in scales:
+            ws, hs = new_w * scale, new_h * scale
+            out.append([x_ctr - 0.5 * (ws - 1), y_ctr - 0.5 * (hs - 1),
+                        x_ctr + 0.5 * (ws - 1), y_ctr + 0.5 * (hs - 1)])
+    return np.asarray(out, np.float32)
+
+
+def _shifted_anchors(H, W, feature_stride, scales, ratios):
+    """(H*W*A, 4) anchors in the reference's h-major, w, a order."""
+    base = _base_anchors(feature_stride, scales, ratios)      # (A, 4)
+    sx = np.arange(W) * feature_stride
+    sy = np.arange(H) * feature_stride
+    shift = np.stack(np.meshgrid(sy, sx, indexing="ij"), -1)  # (H, W, 2)
+    shift4 = np.concatenate([shift[..., 1:2], shift[..., 0:1]] * 2, -1)
+    all_anchors = shift4[:, :, None, :] + base[None, None, :, :]
+    return all_anchors.reshape(-1, 4).astype(np.float32)
+
+
+def _decode_rpn(anchors, deltas, im_h, im_w):
+    """BBoxTransformInv (proposal.cc:40-90): deltas (N, 4) on corner
+    anchors (N, 4), clipped to the image."""
+    widths = anchors[:, 2] - anchors[:, 0] + 1.0
+    heights = anchors[:, 3] - anchors[:, 1] + 1.0
+    ctr_x = anchors[:, 0] + 0.5 * (widths - 1.0)
+    ctr_y = anchors[:, 1] + 0.5 * (heights - 1.0)
+    pred_ctr_x = deltas[:, 0] * widths + ctr_x
+    pred_ctr_y = deltas[:, 1] * heights + ctr_y
+    pred_w = jnp.exp(deltas[:, 2]) * widths
+    pred_h = jnp.exp(deltas[:, 3]) * heights
+    x1 = jnp.clip(pred_ctr_x - 0.5 * (pred_w - 1.0), 0, im_w - 1.0)
+    y1 = jnp.clip(pred_ctr_y - 0.5 * (pred_h - 1.0), 0, im_h - 1.0)
+    x2 = jnp.clip(pred_ctr_x + 0.5 * (pred_w - 1.0), 0, im_w - 1.0)
+    y2 = jnp.clip(pred_ctr_y + 0.5 * (pred_h - 1.0), 0, im_h - 1.0)
+    return jnp.stack([x1, y1, x2, y2], axis=1)
+
+
+def _greedy_nms_keep(boxes, order_valid, threshold):
+    """keep mask over score-sorted boxes (same loop as
+    detection_ops._detect_one)."""
+    n = boxes.shape[0]
+    x1, y1, x2, y2 = (boxes[:, i] for i in range(4))
+    area = jnp.maximum(x2 - x1 + 1.0, 0) * jnp.maximum(y2 - y1 + 1.0, 0)
+    ix1 = jnp.maximum(x1[:, None], x1[None, :])
+    iy1 = jnp.maximum(y1[:, None], y1[None, :])
+    ix2 = jnp.minimum(x2[:, None], x2[None, :])
+    iy2 = jnp.minimum(y2[:, None], y2[None, :])
+    iw = jnp.maximum(ix2 - ix1 + 1.0, 0)
+    ih = jnp.maximum(iy2 - iy1 + 1.0, 0)
+    inter = iw * ih
+    iou = inter / jnp.maximum(area[:, None] + area[None, :] - inter,
+                              1e-12)
+    sup = iou > threshold
+
+    def step(i, keep):
+        alive = keep[i] & order_valid[i]
+        kill = sup[i] & (jnp.arange(n) > i) & alive
+        return keep & ~kill
+
+    return lax.fori_loop(0, n, step, order_valid)
+
+
+def _proposal_one(scores, deltas, im_info, anchors, pre_n, post_n,
+                  threshold, min_size, output_score):
+    """One image. scores (N,), deltas (N, 4), anchors (N, 4)."""
+    im_h, im_w, im_scale = im_info[0], im_info[1], im_info[2]
+    boxes = _decode_rpn(anchors, deltas, im_h, im_w)
+    ws = boxes[:, 2] - boxes[:, 0] + 1.0
+    hs = boxes[:, 3] - boxes[:, 1] + 1.0
+    ms = min_size * im_scale
+    valid = (ws >= ms) & (hs >= ms)
+    score = jnp.where(valid, scores, -jnp.inf)
+
+    n = score.shape[0]
+    k = min(int(pre_n), n)
+    top_score, top_idx = lax.top_k(score, k)
+    top_boxes = boxes[top_idx]
+    keep = _greedy_nms_keep(top_boxes, top_score > -jnp.inf, threshold)
+
+    # stable-select the first post_n kept rows; pad with the best box
+    # (also when post_n exceeds the candidate count k)
+    sel_key = jnp.where(keep, jnp.arange(k), k + jnp.arange(k))
+    order = jnp.argsort(sel_key)[jnp.clip(jnp.arange(post_n), 0, k - 1)]
+    n_keep = jnp.minimum(keep.sum(), k)
+    pad = jnp.arange(post_n) >= n_keep
+    rows = jnp.where(pad[:, None], top_boxes[0][None, :],
+                     top_boxes[order])
+    row_scores = jnp.where(pad, top_score[0], top_score[order])
+    return rows, row_scores
+
+
+def _multi_proposal(cls_prob, bbox_pred, im_info, rpn_pre_nms_top_n=6000,
+                    rpn_post_nms_top_n=300, threshold=0.7, rpn_min_size=16,
+                    scales=(4, 8, 16, 32), ratios=(0.5, 1, 2),
+                    feature_stride=16, output_score=False,
+                    iou_loss=False, **_):
+    B, twoA, H, W = cls_prob.shape
+    A = twoA // 2
+    anchors = jnp.asarray(_shifted_anchors(H, W, feature_stride,
+                                           tuple(scales), tuple(ratios)))
+    # reference ordering: index = h*(W*A) + w*A + a
+    scores = cls_prob[:, A:, :, :].transpose(0, 2, 3, 1).reshape(B, -1)
+    deltas = bbox_pred.reshape(B, A, 4, H, W).transpose(0, 3, 4, 1, 2) \
+        .reshape(B, -1, 4)
+
+    def one(s, d, info):
+        rows, row_scores = _proposal_one(
+            s, d, info, anchors, rpn_pre_nms_top_n, rpn_post_nms_top_n,
+            threshold, rpn_min_size, output_score)
+        return rows, row_scores
+
+    rows, row_scores = jax.vmap(one)(scores, deltas, im_info)
+    batch_idx = jnp.broadcast_to(
+        jnp.arange(B, dtype=rows.dtype)[:, None, None],
+        (B, rpn_post_nms_top_n, 1))
+    rois = jnp.concatenate([batch_idx, rows], axis=2) \
+        .reshape(B * rpn_post_nms_top_n, 5)
+    if output_score:
+        return rois, row_scores.reshape(-1, 1)
+    return rois
+
+
+register("_contrib_MultiProposal",
+         arg_names=("cls_prob", "bbox_pred", "im_info"),
+         differentiable=False,
+         aliases=("MultiProposal", "_contrib_multi_proposal"),
+         defaults={"rpn_pre_nms_top_n": 6000, "rpn_post_nms_top_n": 300,
+                   "threshold": 0.7, "rpn_min_size": 16,
+                   "scales": (4, 8, 16, 32), "ratios": (0.5, 1, 2),
+                   "feature_stride": 16, "output_score": False,
+                   "iou_loss": False})(_multi_proposal)
+
+register("_contrib_Proposal",
+         arg_names=("cls_prob", "bbox_pred", "im_info"),
+         differentiable=False,
+         aliases=("Proposal", "_contrib_proposal"),
+         defaults={"rpn_pre_nms_top_n": 6000, "rpn_post_nms_top_n": 300,
+                   "threshold": 0.7, "rpn_min_size": 16,
+                   "scales": (4, 8, 16, 32), "ratios": (0.5, 1, 2),
+                   "feature_stride": 16, "output_score": False,
+                   "iou_loss": False})(_multi_proposal)
+
+
+# ---------------------------------------------------------------------------
+# position-sensitive ROI pooling (R-FCN)
+# ---------------------------------------------------------------------------
+
+def _bilinear_tap(img, y, x):
+    """img (C, H, W) sampled at scalar grids y, x (...,) — zero padded."""
+    C, H, W = img.shape
+    y0 = jnp.floor(y)
+    x0 = jnp.floor(x)
+    dy = y - y0
+    dx = x - x0
+
+    def corner(yc, xc, w):
+        inside = (xc >= 0) & (xc <= W - 1) & (yc >= 0) & (yc <= H - 1)
+        yi = jnp.clip(yc, 0, H - 1).astype(jnp.int32)
+        xi = jnp.clip(xc, 0, W - 1).astype(jnp.int32)
+        return img[:, yi, xi] * (w * inside)[None]
+
+    return (corner(y0, x0, (1 - dy) * (1 - dx)) +
+            corner(y0, x0 + 1, (1 - dy) * dx) +
+            corner(y0 + 1, x0, dy * (1 - dx)) +
+            corner(y0 + 1, x0 + 1, dy * dx))
+
+
+def _psroi_one(data, roi, trans_row, spatial_scale, output_dim, pooled,
+               group_size, sample_per_part, trans_std, part_size):
+    """One roi over the whole batch's data (B, C, H, W) — roi[0] picks
+    the image; trans_row (2, part, part) holds THIS roi's learned
+    offsets (reference indexes bottom_trans by roi ordinal,
+    deformable_psroi_pooling-inl.h). Returns (output_dim, pooled,
+    pooled)."""
+    bidx = roi[0].astype(jnp.int32)
+    img = data[bidx]                                    # (C, H, W)
+    # deformable_psroi_pooling-inl.h: roi corners scaled with the 0.5
+    # offset, clamped min sizes
+    x1 = roi[1] * spatial_scale - 0.5
+    y1 = roi[2] * spatial_scale - 0.5
+    x2 = (roi[3] + 1.0) * spatial_scale - 0.5
+    y2 = (roi[4] + 1.0) * spatial_scale - 0.5
+    rw = jnp.maximum(x2 - x1, 0.1)
+    rh = jnp.maximum(y2 - y1, 0.1)
+    bin_w = rw / pooled
+    bin_h = rh / pooled
+    sub_w = bin_w / sample_per_part
+    sub_h = bin_h / sample_per_part
+
+    ph = jnp.arange(pooled)
+    pw = jnp.arange(pooled)
+    gh = jnp.minimum((ph * group_size) // pooled, group_size - 1)
+    gw = jnp.minimum((pw * group_size) // pooled, group_size - 1)
+
+    # per-part learned offsets (zero when trans_row is None)
+    if trans_row is not None:
+        part_h = jnp.minimum((ph * part_size) // pooled, part_size - 1)
+        part_w = jnp.minimum((pw * part_size) // pooled, part_size - 1)
+        off_y = trans_row[0][part_h[:, None],
+                             part_w[None, :]] * trans_std * rh
+        off_x = trans_row[1][part_h[:, None],
+                             part_w[None, :]] * trans_std * rw
+    else:
+        off_y = jnp.zeros((pooled, pooled))
+        off_x = jnp.zeros((pooled, pooled))
+
+    s = jnp.arange(sample_per_part) + 0.5
+    # (pooled, pooled, s, s) sample grids
+    yy = (y1 + ph[:, None, None, None] * bin_h +
+          s[None, None, :, None] * sub_h + off_y[:, :, None, None])
+    xx = (x1 + pw[None, :, None, None] * bin_w +
+          s[None, None, None, :] * sub_w + off_x[:, :, None, None])
+    yy = jnp.broadcast_to(yy, (pooled, pooled, sample_per_part,
+                               sample_per_part))
+    xx = jnp.broadcast_to(xx, (pooled, pooled, sample_per_part,
+                               sample_per_part))
+    sampled = _bilinear_tap(img, yy, xx)   # (C, P, P, s, s)
+    avg = sampled.mean(axis=(3, 4))        # (C, P, P)
+
+    # position-sensitive channel select: out[c, i, j] uses input channel
+    # c*G*G + gh[i]*G + gw[j]
+    C = img.shape[0]
+    chan = (jnp.arange(output_dim)[:, None, None] * group_size *
+            group_size + gh[None, :, None] * group_size +
+            gw[None, None, :])
+    ii = jnp.broadcast_to(jnp.arange(pooled)[None, :, None],
+                          chan.shape)
+    jj = jnp.broadcast_to(jnp.arange(pooled)[None, None, :],
+                          chan.shape)
+    return avg[chan, ii, jj]
+
+
+@register("_contrib_PSROIPooling", arg_names=("data", "rois"),
+          nondiff_inputs=(1,),
+          aliases=("PSROIPooling", "_contrib_psroipooling"),
+          defaults={"spatial_scale": 1.0, "output_dim": 0,
+                    "pooled_size": 0, "group_size": 0})
+def _psroi_pooling(data, rois, spatial_scale=1.0, output_dim=0,
+                   pooled_size=0, group_size=0, **_):
+    """data (B, output_dim*group², H, W), rois (R, 5) -> (R, output_dim,
+    pooled, pooled). psroi_pooling-inl.h via the sampled-bin
+    formulation (sample grid 4 per bin axis)."""
+    group_size = int(group_size) or int(pooled_size)
+    f = lambda roi: _psroi_one(data, roi, None, spatial_scale,
+                               int(output_dim), int(pooled_size),
+                               group_size, 4, 0.0, group_size)
+    return jax.vmap(f)(rois)
+
+
+@register("_contrib_DeformablePSROIPooling",
+          arg_names=("data", "rois", "trans"), nondiff_inputs=(1,),
+          aliases=("DeformablePSROIPooling",),
+          defaults={"spatial_scale": 1.0, "output_dim": 0,
+                    "pooled_size": 0, "group_size": 0, "part_size": 0,
+                    "sample_per_part": 4, "trans_std": 0.0,
+                    "no_trans": False})
+def _deformable_psroi_pooling(data, rois, trans=None, spatial_scale=1.0,
+                              output_dim=0, pooled_size=0, group_size=0,
+                              part_size=0, sample_per_part=4,
+                              trans_std=0.0, no_trans=False, **_):
+    group_size = int(group_size) or int(pooled_size)
+    part_size = int(part_size) or int(pooled_size)
+    use_trans = trans is not None and not no_trans
+    if use_trans:
+        # trans (R, 2, part, part): one offset grid per ROI
+        f = lambda roi, tr: _psroi_one(
+            data, roi, tr, spatial_scale, int(output_dim),
+            int(pooled_size), group_size, int(sample_per_part),
+            float(trans_std), part_size)
+        return jax.vmap(f)(rois, trans.reshape(
+            rois.shape[0], -1, part_size, part_size)[:, :2])
+    f = lambda roi: _psroi_one(
+        data, roi, None, spatial_scale, int(output_dim),
+        int(pooled_size), group_size, int(sample_per_part),
+        float(trans_std), part_size)
+    return jax.vmap(f)(rois)
+
+
+# ---------------------------------------------------------------------------
+# deformable convolution (v1)
+# ---------------------------------------------------------------------------
+
+@register("_contrib_DeformableConvolution",
+          arg_names=("data", "offset", "weight", "bias"),
+          aliases=("DeformableConvolution",),
+          defaults={"kernel": (), "stride": (), "dilate": (), "pad": (),
+                    "num_filter": 0, "num_group": 1,
+                    "num_deformable_group": 1, "no_bias": False,
+                    "workspace": 1024})
+def _deformable_convolution(data, offset, weight, bias=None, kernel=(),
+                            stride=(), dilate=(), pad=(), num_filter=0,
+                            num_group=1, num_deformable_group=1,
+                            no_bias=False, **_):
+    """deformable_im2col semantics (contrib/nn/deformable_im2col.h):
+    each kernel tap samples the input at its position + learned offset
+    (bilinear); offset channels [dg][2*(ki*kw+kj)] = dy, +1 = dx."""
+    B, C, H, W = data.shape
+    kh, kw = int(kernel[0]), int(kernel[1])
+    sh, sw = (int(stride[0]), int(stride[1])) if stride else (1, 1)
+    dh, dw = (int(dilate[0]), int(dilate[1])) if dilate else (1, 1)
+    ph, pw = (int(pad[0]), int(pad[1])) if pad else (0, 0)
+    Ho = (H + 2 * ph - dh * (kh - 1) - 1) // sh + 1
+    Wo = (W + 2 * pw - dw * (kw - 1) - 1) // sw + 1
+    dg = int(num_deformable_group)
+    cpg = C // dg
+
+    oy = jnp.arange(Ho) * sh - ph
+    ox = jnp.arange(Wo) * sw - pw
+
+    def sample_image(img, off):
+        """img (C, H, W), off (2*dg*kh*kw, Ho, Wo) ->
+        (C, kh*kw, Ho, Wo) sampled taps."""
+        taps = []
+        for t in range(kh * kw):
+            ki, kj = divmod(t, kw)
+            per_g = []
+            for g in range(dg):
+                dy = off[g * 2 * kh * kw + 2 * t]
+                dx = off[g * 2 * kh * kw + 2 * t + 1]
+                yy = oy[:, None] + ki * dh + dy
+                xx = ox[None, :] + kj * dw + dx
+                per_g.append(_bilinear_tap(
+                    img[g * cpg:(g + 1) * cpg], yy, xx))
+            taps.append(jnp.concatenate(per_g, axis=0))
+        return jnp.stack(taps, axis=1)      # (C, kh*kw, Ho, Wo)
+
+    patches = jax.vmap(sample_image)(data, offset)  # (B,C,K²,Ho,Wo)
+    O = int(num_filter)
+    g = int(num_group)
+    wg = weight.reshape(g, O // g, C // g, kh * kw)
+    pg = patches.reshape(B, g, C // g, kh * kw, Ho, Wo)
+    out = jnp.einsum("bgckhw,gock->bgohw", pg, wg)
+    out = out.reshape(B, O, Ho, Wo)
+    if not no_bias and bias is not None:
+        out = out + bias.reshape(1, -1, 1, 1)
+    return out

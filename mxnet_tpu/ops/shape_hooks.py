@@ -150,6 +150,35 @@ def _prelu_shapes(shapes, attrs):
 set_param_shapes("LeakyReLU", _prelu_shapes)
 
 
+# -- DeformableConvolution: weight/bias from data like Convolution ----------
+
+set_arg_select("_contrib_DeformableConvolution", lambda a: (
+    ("data", "offset", "weight") if a.get("no_bias")
+    else ("data", "offset", "weight", "bias")))
+
+
+def _deform_conv_shapes(shapes, attrs):
+    data = shapes[0]
+    if data is None:
+        return shapes
+    kernel = tuple(int(k) for k in attrs.get("kernel", ()))
+    nf = int(attrs.get("num_filter", 0))
+    ng = int(attrs.get("num_group", 1))
+    out = list(shapes)
+    if len(out) > 2 and out[2] is None:
+        out[2] = (nf, data[1] // ng) + kernel
+    if len(out) > 3 and out[3] is None:
+        out[3] = (nf,)
+    return out
+
+
+set_param_shapes("_contrib_DeformableConvolution", _deform_conv_shapes)
+
+set_arg_select("_contrib_DeformablePSROIPooling", lambda a: (
+    ("data", "rois") if a.get("no_trans")
+    else ("data", "rois", "trans")))
+
+
 # -- RNN (fused): parameters blob + state shapes from data ------------------
 # (reference: rnn-inl.h RNNProp::InferShape — param size is a function of
 # input size, state size, layers, directions)

@@ -44,9 +44,16 @@ class TrainStep:
 
     def __init__(self, symbol, data_names=("data",),
                  label_names=("softmax_label",), optimizer="sgd",
-                 optimizer_params=None, mesh=None, donate=True):
+                 optimizer_params=None, mesh=None, donate=True,
+                 compute_dtype=None):
+        """compute_dtype: cast params+data to this dtype for fwd/bwd
+        (e.g. 'bfloat16' for MXU-rate compute) while master weights,
+        gradients, optimizer state and BN statistics stay float32 — the
+        TPU mapping of the reference's multi-precision mp_sgd_* path."""
         self.symbol = symbol
         self.mesh = mesh
+        self.compute_dtype = (None if compute_dtype is None
+                              else jnp.dtype(compute_dtype))
         self.data_names = list(data_names)
         self.label_names = list(label_names)
         self.arg_names = symbol.list_arguments()
@@ -111,9 +118,12 @@ class TrainStep:
         return jax.device_put(value, shd.replicated(self.mesh))
 
     def place_batch(self, batch):
-        """Shard batch arrays along the data axis."""
+        """Move batch arrays to device once (sharded along the data axis
+        when a mesh is set) — call before the step loop so the H2D
+        transfer isn't repaid every iteration."""
         if self.mesh is None:
-            return batch
+            return {k: jax.device_put(jnp.asarray(v))
+                    for k, v in batch.items()}
         return {k: jax.device_put(
             v, shd.batch_sharding(self.mesh, np.ndim(v)))
             for k, v in batch.items()}
@@ -127,6 +137,7 @@ class TrainStep:
         n_state = self._n_state
         mesh = self.mesh
         data_names = self.data_names
+        cdt = self.compute_dtype
 
         def step(params, opt_state, aux, batch, lr, rng):
             # Module.init_optimizer defaults rescale_grad=1/batch; match
@@ -147,7 +158,19 @@ class TrainStep:
                     for k, v in batch.items()}
 
             def fwd(p):
-                outs, new_aux = eval_fn({**batch, **p}, aux, rng, True)
+                feed = dict(batch)
+                if cdt is not None:
+                    # compute-dtype cast: params + image data only (labels
+                    # carry class ids — bf16 would corrupt ids > 256);
+                    # the cast is linear so vjp returns float32 grads
+                    p = {k: v.astype(cdt) for k, v in p.items()}
+                    for k in data_names:
+                        feed[k] = feed[k].astype(cdt)
+                outs, new_aux = eval_fn({**feed, **p}, aux, rng, True)
+                if cdt is not None:
+                    # BN moving stats stay float32 master copies
+                    new_aux = {k: v.astype(aux[k].dtype)
+                               for k, v in new_aux.items()}
                 return outs, new_aux
 
             outs, vjp, new_aux = jax.vjp(fwd, params, has_aux=True)

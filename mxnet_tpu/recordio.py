@@ -175,6 +175,13 @@ class MXRecordIO:
                 return out + _MAGIC_BYTES + data
 
     def tell(self):
+        if getattr(self, "_native", None) is not None and \
+                not self.writable:
+            # virtual position: next record's header offset (no per-read
+            # fp.seek in the native hot loop)
+            if self._cursor < len(self._native):
+                return self._native.offset(self._cursor)
+            return self._native.size
         return self.fp.tell()
 
 

@@ -1,0 +1,58 @@
+"""Runtime kernel compilation (``mx.rtc``).
+
+Reference: python/mxnet/rtc.py + src/common/mxrtc.cc — user-supplied
+CUDA C compiled by NVRTC at runtime and launched on NDArrays. The
+TPU-native equivalent compiles user-supplied *Python* source through
+the same JIT that runs everything else: the source defines a function
+over jax.numpy arrays (Pallas available as ``pl``/``pltpu`` for real
+kernels), and ``push`` runs the jitted result on NDArrays. CUDA
+``threadIdx`` style sources are meaningless on TPU — grid/block dims
+are accepted for signature parity and ignored.
+"""
+from __future__ import annotations
+
+import jax
+
+__all__ = ["Rtc"]
+
+
+class Rtc:
+    """Compile ``kernel`` (python source) defining function ``name``
+    taking the input arrays and returning the output array(s)
+    (reference rtc.py:Rtc(name, inputs, outputs, kernel)).
+
+    inputs/outputs: sequences of names, kept for signature parity and
+    arity checking."""
+
+    def __init__(self, name, inputs, outputs, kernel):
+        import jax.numpy as jnp
+        from jax import lax
+        from jax.experimental import pallas as pl
+        from jax.experimental.pallas import tpu as pltpu
+
+        self.name = name
+        self._in_names = list(inputs)
+        self._out_names = list(outputs)
+        ns = {"jax": jax, "jnp": jnp, "lax": lax, "pl": pl,
+              "pltpu": pltpu}
+        exec(compile(kernel, "<mx.rtc:%s>" % name, "exec"), ns)
+        if name not in ns or not callable(ns[name]):
+            raise ValueError(
+                "kernel source must define a function named %r" % name)
+        self._fn = jax.jit(ns[name])
+
+    def push(self, ins, outs, grid_dims=None, block_dims=None):
+        """Run the kernel: results land in ``outs`` (reference
+        Rtc.push; grid/block dims ignored — XLA schedules)."""
+        if len(ins) != len(self._in_names):
+            raise ValueError("expected %d inputs" % len(self._in_names))
+        res = self._fn(*[x._data for x in ins])
+        if not isinstance(res, (tuple, list)):
+            res = (res,)
+        if len(res) != len(outs):
+            raise ValueError("kernel returned %d outputs, expected %d"
+                             % (len(res), len(outs)))
+        for o, r in zip(outs, res):
+            o._set_data(r.astype(o._data.dtype)
+                        if r.dtype != o._data.dtype else r)
+        return outs

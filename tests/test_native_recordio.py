@@ -131,6 +131,32 @@ def test_corrupt_file_falls_back_to_strict_reader(tmp_path):
     r.close()
 
 
+def test_tell_tracks_records_in_native_mode(tmp_path):
+    """The classic index-building loop: pos = tell(); read()."""
+    path = str(tmp_path / "t.rec")
+    payloads = _write_corpus(path, n=6)
+    import os as _os
+    _os.environ.pop("MXNET_NATIVE_RECORDIO", None)
+    r = MXRecordIO(path, "r")
+    assert r._native is not None
+    positions = []
+    while True:
+        pos = r.tell()
+        if r.read() is None:
+            break
+        positions.append(pos)
+    r.close()
+
+    # positions must let a strict python reader seek+read each record
+    r = MXRecordIO(path, "r")
+    r._native.close()
+    r._native = None
+    for pos, want in zip(positions, payloads):
+        r.fp.seek(pos)
+        assert r.read() == want
+    r.close()
+
+
 def test_pack_unpack_roundtrip_through_native(tmp_path):
     path = str(tmp_path / "p.rec")
     rec = MXRecordIO(path, "w")

@@ -5,6 +5,7 @@ import numpy as np
 import pytest
 
 import jax
+import jax.numpy as jnp
 
 import mxnet_tpu as mx
 from mxnet_tpu.initializer import Xavier
@@ -114,3 +115,20 @@ def test_dist_rank_size_single_process():
     from mxnet_tpu.parallel import dist
     assert dist.rank() == 0
     assert dist.size() == 1
+
+
+def test_train_step_bf16_compute_dtype():
+    """Mixed precision: bf16 fwd/bwd, f32 master weights + BN stats —
+    still converges on the toy problem (the mp_sgd semantics)."""
+    X, y = _toy()
+    step = make_train_step(_mlp(), optimizer="sgd",
+                           optimizer_params={"momentum": 0.9,
+                                             "rescale_grad": 1.0 / 64},
+                           compute_dtype="bfloat16")
+    state = step.init_state(Xavier(), {"data": X.shape,
+                                       "softmax_label": y.shape})
+    state, outs = _train(step, state, X, y)
+    params = state[0]
+    assert all(v.dtype == np.float32 for v in params.values())
+    assert np.asarray(outs[0]).dtype == jnp.bfloat16
+    assert _acc(outs, y) > 0.9

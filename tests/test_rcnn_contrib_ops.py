@@ -1,0 +1,262 @@
+"""Contrib tail + RCNN op family tests (reference:
+src/operator/contrib/*, tests/python/unittest/test_operator.py
+quantize/fft blocks and the rcnn example semantics)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+
+
+class TestFFT:
+    def test_fft_matches_numpy(self):
+        x = np.random.RandomState(0).randn(3, 8).astype("float32")
+        out = nd._contrib_fft(nd.array(x)).asnumpy()
+        ref = np.fft.fft(x, axis=-1)
+        inter = np.stack([ref.real, ref.imag], -1).reshape(3, 16)
+        np.testing.assert_allclose(out, inter, rtol=1e-4, atol=1e-4)
+
+    def test_ifft_unnormalized_roundtrip(self):
+        x = np.random.RandomState(1).randn(2, 8).astype("float32")
+        freq = nd._contrib_fft(nd.array(x))
+        back = nd._contrib_ifft(freq).asnumpy()
+        np.testing.assert_allclose(back, x * 8, rtol=1e-4, atol=1e-4)
+
+
+class TestCountSketch:
+    def test_matches_numpy(self):
+        rng = np.random.RandomState(2)
+        in_dim, out_dim = 10, 6
+        x = rng.randn(4, in_dim).astype("float32")
+        h = rng.randint(0, out_dim, (1, in_dim)).astype("float32")
+        s = rng.choice([-1.0, 1.0], (1, in_dim)).astype("float32")
+        out = nd._contrib_count_sketch(nd.array(x), nd.array(h),
+                                       nd.array(s),
+                                       out_dim=out_dim).asnumpy()
+        ref = np.zeros((4, out_dim), "float32")
+        for j in range(in_dim):
+            ref[:, int(h[0, j])] += s[0, j] * x[:, j]
+        np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-5)
+
+
+class TestQuantize:
+    def test_roundtrip(self):
+        x = np.random.RandomState(3).uniform(-2, 3, (4, 5)) \
+            .astype("float32")
+        q, qmin, qmax = nd._contrib_quantize(
+            nd.array(x), nd.array([-2.0]), nd.array([3.0]))
+        assert q.asnumpy().dtype == np.uint8
+        back = nd._contrib_dequantize(q, qmin, qmax).asnumpy()
+        np.testing.assert_allclose(back, x, atol=(3 + 2) / 255 + 1e-6)
+
+    def test_int8_roundtrip(self):
+        x = np.random.RandomState(4).uniform(-2, 3, (4, 5)) \
+            .astype("float32")
+        q, qmin, qmax = nd._contrib_quantize(
+            nd.array(x), nd.array([-2.0]), nd.array([3.0]),
+            out_type="int8")
+        qn = q.asnumpy()
+        assert qn.dtype == np.int8
+        assert qn.min() < 0 and qn.max() > 64   # both halves used
+        back = nd._contrib_dequantize(q, qmin, qmax,
+                                      out_type="float32").asnumpy()
+        np.testing.assert_allclose(back, x, atol=(3 + 2) / 254 + 1e-6)
+
+
+def _np_proposal_oracle(cls_prob, bbox_pred, im_info, fs, scales, ratios,
+                        pre_n, post_n, thr, min_size):
+    from mxnet_tpu.ops.rcnn_ops import _shifted_anchors
+    B, twoA, H, W = cls_prob.shape
+    A = twoA // 2
+    anchors = _shifted_anchors(H, W, fs, scales, ratios)
+    out = []
+    for b in range(B):
+        scores = cls_prob[b, A:].transpose(1, 2, 0).reshape(-1)
+        deltas = bbox_pred[b].reshape(A, 4, H, W).transpose(2, 3, 0, 1) \
+            .reshape(-1, 4)
+        w = anchors[:, 2] - anchors[:, 0] + 1
+        h = anchors[:, 3] - anchors[:, 1] + 1
+        cx = anchors[:, 0] + 0.5 * (w - 1)
+        cy = anchors[:, 1] + 0.5 * (h - 1)
+        pcx = deltas[:, 0] * w + cx
+        pcy = deltas[:, 1] * h + cy
+        pw = np.exp(deltas[:, 2]) * w
+        ph = np.exp(deltas[:, 3]) * h
+        boxes = np.stack([
+            np.clip(pcx - 0.5 * (pw - 1), 0, im_info[b, 1] - 1),
+            np.clip(pcy - 0.5 * (ph - 1), 0, im_info[b, 0] - 1),
+            np.clip(pcx + 0.5 * (pw - 1), 0, im_info[b, 1] - 1),
+            np.clip(pcy + 0.5 * (ph - 1), 0, im_info[b, 0] - 1)], 1)
+        ws = boxes[:, 2] - boxes[:, 0] + 1
+        hs = boxes[:, 3] - boxes[:, 1] + 1
+        ms = min_size * im_info[b, 2]
+        scores = np.where((ws >= ms) & (hs >= ms), scores, -np.inf)
+        order = np.argsort(-scores, kind="stable")[:pre_n]
+        sb, ss = boxes[order], scores[order]
+        keep = []
+        for i in range(len(sb)):
+            if ss[i] == -np.inf:
+                continue
+            ok = True
+            for j in keep:
+                ix1 = max(sb[i, 0], sb[j, 0])
+                iy1 = max(sb[i, 1], sb[j, 1])
+                ix2 = min(sb[i, 2], sb[j, 2])
+                iy2 = min(sb[i, 3], sb[j, 3])
+                iw = max(ix2 - ix1 + 1, 0)
+                ih = max(iy2 - iy1 + 1, 0)
+                inter = iw * ih
+                a_i = (sb[i, 2] - sb[i, 0] + 1) * (sb[i, 3] - sb[i, 1] + 1)
+                a_j = (sb[j, 2] - sb[j, 0] + 1) * (sb[j, 3] - sb[j, 1] + 1)
+                if inter / (a_i + a_j - inter) > thr:
+                    ok = False
+                    break
+            if ok:
+                keep.append(i)
+        rows = [np.concatenate([[b], sb[k]]) for k in keep[:post_n]]
+        while len(rows) < post_n:
+            rows.append(np.concatenate([[b], sb[0]]))
+        out.extend(rows)
+    return np.asarray(out, "float32")
+
+
+class TestProposal:
+    def test_matches_numpy_oracle(self):
+        rng = np.random.RandomState(4)
+        B, A, H, W = 2, 3, 4, 4
+        scales, ratios, fs = (8.0,), (0.5, 1.0, 2.0), 16
+        cls_prob = rng.uniform(0, 1, (B, 2 * A, H, W)).astype("float32")
+        bbox_pred = (rng.randn(B, 4 * A, H, W) * 0.1).astype("float32")
+        im_info = np.array([[64, 64, 1.0], [64, 64, 1.0]], "float32")
+        out = nd._contrib_MultiProposal(
+            nd.array(cls_prob), nd.array(bbox_pred), nd.array(im_info),
+            rpn_pre_nms_top_n=30, rpn_post_nms_top_n=8, threshold=0.7,
+            rpn_min_size=4, scales=scales, ratios=ratios,
+            feature_stride=fs).asnumpy()
+        ref = _np_proposal_oracle(cls_prob, bbox_pred, im_info, fs,
+                                  scales, ratios, 30, 8, 0.7, 4)
+        assert out.shape == (2 * 8, 5)
+        np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-3)
+
+    def test_post_nms_exceeds_candidates_pads(self):
+        """Default rpn_post_nms_top_n=300 on a tiny feature map must pad,
+        not crash."""
+        rng = np.random.RandomState(9)
+        cls_prob = rng.uniform(0, 1, (1, 6, 4, 4)).astype("float32")
+        bbox_pred = np.zeros((1, 12, 4, 4), "float32")
+        im_info = np.array([[64, 64, 1.0]], "float32")
+        out = nd._contrib_MultiProposal(
+            nd.array(cls_prob), nd.array(bbox_pred), nd.array(im_info),
+            scales=(8.0,), ratios=(0.5, 1.0, 2.0),
+            rpn_min_size=2).asnumpy()
+        assert out.shape == (300, 5)
+        assert np.isfinite(out).all()
+
+    def test_proposal_single_image_with_scores(self):
+        rng = np.random.RandomState(5)
+        cls_prob = rng.uniform(0, 1, (1, 6, 3, 3)).astype("float32")
+        bbox_pred = np.zeros((1, 12, 3, 3), "float32")
+        im_info = np.array([[48, 48, 1.0]], "float32")
+        rois, scores = nd._contrib_Proposal(
+            nd.array(cls_prob), nd.array(bbox_pred), nd.array(im_info),
+            rpn_pre_nms_top_n=20, rpn_post_nms_top_n=5,
+            rpn_min_size=2, scales=(4.0,), ratios=(0.5, 1.0, 2.0),
+            feature_stride=16, output_score=True)
+        assert rois.shape == (5, 5) and scores.shape == (5, 1)
+
+
+class TestPSROIPooling:
+    def test_group_channel_selection(self):
+        """Channel c*G²+k holds the constant (c*G²+k); bin (i,j) of
+        output channel c must read k = i*G + j exactly."""
+        out_dim, G = 2, 3
+        C = out_dim * G * G
+        data = np.zeros((1, C, 12, 12), "float32")
+        for c in range(C):
+            data[0, c] = c
+        rois = np.array([[0, 0, 0, 11, 11]], "float32")
+        out = nd._contrib_PSROIPooling(
+            nd.array(data), nd.array(rois), spatial_scale=1.0,
+            output_dim=out_dim, pooled_size=G).asnumpy()
+        assert out.shape == (1, out_dim, G, G)
+        for c in range(out_dim):
+            for i in range(G):
+                for j in range(G):
+                    assert out[0, c, i, j] == pytest.approx(
+                        c * G * G + i * G + j, abs=1e-4)
+
+    def test_deformable_zero_trans_matches_plain(self):
+        rng = np.random.RandomState(6)
+        data = rng.randn(1, 2 * 4, 8, 8).astype("float32")
+        rois = np.array([[0, 1, 1, 6, 6], [0, 0, 0, 7, 7]], "float32")
+        plain = nd._contrib_PSROIPooling(
+            nd.array(data), nd.array(rois), spatial_scale=0.5,
+            output_dim=2, pooled_size=2).asnumpy()
+        # trans is PER ROI: (R, 2, part, part)
+        trans = np.zeros((2, 2, 2, 2), "float32")
+        deform = nd._contrib_DeformablePSROIPooling(
+            nd.array(data), nd.array(rois), nd.array(trans),
+            spatial_scale=0.5, output_dim=2, pooled_size=2,
+            trans_std=0.1).asnumpy()
+        np.testing.assert_allclose(plain, deform, rtol=1e-5)
+
+    def test_per_roi_trans_offsets_differ(self):
+        """Each ROI reads its own offset grid (reference indexes
+        bottom_trans by roi ordinal, not image)."""
+        rng = np.random.RandomState(7)
+        data = rng.randn(1, 1 * 4, 8, 8).astype("float32")
+        rois = np.array([[0, 1, 1, 6, 6], [0, 1, 1, 6, 6]], "float32")
+        trans = np.zeros((2, 2, 2, 2), "float32")
+        trans[1] = 0.5           # only ROI 1 shifts
+        out = nd._contrib_DeformablePSROIPooling(
+            nd.array(data), nd.array(rois), nd.array(trans),
+            spatial_scale=1.0, output_dim=1, pooled_size=2,
+            trans_std=0.5).asnumpy()
+        assert not np.allclose(out[0], out[1])
+
+
+class TestDeformableConv:
+    def test_zero_offset_matches_convolution(self):
+        rng = np.random.RandomState(7)
+        data = rng.randn(2, 4, 7, 7).astype("float32")
+        weight = rng.randn(6, 4, 3, 3).astype("float32")
+        bias = rng.randn(6).astype("float32")
+        offset = np.zeros((2, 2 * 9, 7, 7), "float32")
+        out = nd._contrib_DeformableConvolution(
+            nd.array(data), nd.array(offset), nd.array(weight),
+            nd.array(bias), kernel=(3, 3), pad=(1, 1),
+            num_filter=6).asnumpy()
+        ref = nd.Convolution(nd.array(data), nd.array(weight),
+                             nd.array(bias), kernel=(3, 3), pad=(1, 1),
+                             num_filter=6).asnumpy()
+        np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-4)
+
+    def test_integer_offset_shifts_sampling(self):
+        data = np.zeros((1, 1, 5, 5), "float32")
+        data[0, 0, 2, 3] = 1.0
+        weight = np.ones((1, 1, 1, 1), "float32")
+        # offset dx=+1 everywhere: a 1x1 kernel reads position x+1
+        offset = np.zeros((1, 2, 5, 5), "float32")
+        offset[0, 1] = 1.0
+        out = nd._contrib_DeformableConvolution(
+            nd.array(data), nd.array(offset), nd.array(weight),
+            kernel=(1, 1), num_filter=1, no_bias=True).asnumpy()
+        assert out[0, 0, 2, 2] == 1.0
+        assert out[0, 0, 2, 3] == 0.0
+
+    def test_gradients_flow(self):
+        rng = np.random.RandomState(8)
+        data = nd.array(rng.randn(1, 2, 5, 5).astype("float32"))
+        offset = nd.array(
+            (rng.randn(1, 2 * 4, 4, 4) * 0.1).astype("float32"))
+        weight = nd.array(rng.randn(3, 2, 2, 2).astype("float32"))
+        for a in (data, offset, weight):
+            a.attach_grad()
+        with mx.autograd.record():
+            out = nd._contrib_DeformableConvolution(
+                data, offset, weight, kernel=(2, 2), num_filter=3,
+                no_bias=True)
+        out.backward()
+        assert np.abs(data.grad.asnumpy()).sum() > 0
+        assert np.abs(offset.grad.asnumpy()).sum() > 0
+        assert np.abs(weight.grad.asnumpy()).sum() > 0

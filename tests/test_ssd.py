@@ -77,6 +77,10 @@ def _make_det_rec(tmp, n=16, size=32):
 
 
 def test_ssd_trains_end_to_end():
+    # deterministic init regardless of suite order (the convergence gate
+    # is sensitive to the Xavier draw)
+    np.random.seed(0)
+    mx.random.seed(0)
     batch = 8
     with tempfile.TemporaryDirectory() as tmp:
         rec = _make_det_rec(tmp, n=16)

@@ -1,0 +1,72 @@
+"""mx.viz + mx.rtc tests (reference: visualization.py, rtc.py)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+
+
+def _lenet():
+    data = mx.sym.Variable("data")
+    c1 = mx.sym.Convolution(data, kernel=(3, 3), num_filter=8,
+                            name="conv1")
+    a1 = mx.sym.Activation(c1, act_type="relu", name="relu1")
+    p1 = mx.sym.Pooling(a1, kernel=(2, 2), stride=(2, 2),
+                        pool_type="max", name="pool1")
+    fc = mx.sym.FullyConnected(mx.sym.Flatten(p1), num_hidden=10,
+                               name="fc1")
+    return mx.sym.SoftmaxOutput(fc, name="softmax")
+
+
+class TestPrintSummary:
+    def test_totals_and_rows(self, capsys):
+        total = mx.viz.print_summary(_lenet(),
+                                     shape={"data": (1, 1, 28, 28)})
+        out = capsys.readouterr().out
+        assert "conv1 (Convolution)" in out
+        assert "fc1 (FullyConnected)" in out
+        # conv1: 8*1*3*3 + 8;  fc1: 10*(8*13*13) + 10
+        assert total == (8 * 9 + 8) + (10 * 8 * 13 * 13 + 10), total
+        assert "Total params: %d" % total in out
+
+    def test_without_shapes(self, capsys):
+        mx.viz.print_summary(_lenet())
+        assert "softmax (SoftmaxOutput)" in capsys.readouterr().out
+
+
+class TestPlotNetwork:
+    def test_digraph_or_skip(self):
+        pytest.importorskip("graphviz")
+        dot = mx.viz.plot_network(_lenet(),
+                                  shape={"data": (1, 1, 28, 28)})
+        src = dot.source
+        assert "conv1" in src and "softmax" in src
+
+
+class TestRtc:
+    def test_saxpy_kernel(self):
+        rtc = mx.rtc.Rtc("saxpy", ["x", "y"], ["out"], """
+def saxpy(x, y):
+    return 2.5 * x + y
+""")
+        x = nd.array(np.arange(6, dtype="float32"))
+        y = nd.ones((6,))
+        out = nd.zeros((6,))
+        rtc.push([x, y], [out])
+        np.testing.assert_allclose(out.asnumpy(),
+                                   2.5 * np.arange(6) + 1, rtol=1e-6)
+
+    def test_multi_output(self):
+        rtc = mx.rtc.Rtc("squares", ["x"], ["a", "b"], """
+def squares(x):
+    return x * x, x + x
+""")
+        x = nd.array(np.array([1.0, 2.0], "float32"))
+        a, b = nd.zeros((2,)), nd.zeros((2,))
+        rtc.push([x], [a, b])
+        np.testing.assert_allclose(a.asnumpy(), [1, 4])
+        np.testing.assert_allclose(b.asnumpy(), [2, 4])
+
+    def test_bad_source_rejected(self):
+        with pytest.raises(ValueError):
+            mx.rtc.Rtc("f", ["x"], ["y"], "g = 3")
