@@ -1,0 +1,62 @@
+"""Workload config #3: gluon imperative + hybridized training —
+reference example/gluon/image_classification.py (Trainer, autograd,
+net.hybridize()). Self-contained synthetic data:
+`python examples/gluon_image_classification.py`.
+"""
+import argparse
+import logging
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, gluon, nd
+
+
+def synthetic_cifar(n=512, classes=10):
+    rng = np.random.RandomState(0)
+    y = rng.randint(0, classes, n)
+    X = rng.randn(n, 3, 32, 32).astype(np.float32) * 0.1
+    for i in range(n):
+        X[i, y[i] % 3, (y[i] * 3) % 28:(y[i] * 3) % 28 + 4] += 1.0
+    return X, y.astype(np.float32)
+
+
+def main():
+    logging.basicConfig(level=logging.INFO)
+    p = argparse.ArgumentParser()
+    p.add_argument("--model", default="resnet18_v1")
+    p.add_argument("--batch-size", type=int, default=32)
+    p.add_argument("--epochs", type=int, default=3)
+    p.add_argument("--lr", type=float, default=0.05)
+    p.add_argument("--hybridize", action="store_true", default=True)
+    args = p.parse_args()
+
+    net = gluon.model_zoo.vision.get_model(args.model, classes=10)
+    net.initialize(mx.init.Xavier(magnitude=2.24))
+    if args.hybridize:
+        net.hybridize()
+
+    X, y = synthetic_cifar()
+    dataset = gluon.data.ArrayDataset(nd.array(X), nd.array(y))
+    loader = gluon.data.DataLoader(dataset,
+                                   batch_size=args.batch_size,
+                                   shuffle=True)
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": args.lr, "momentum": 0.9})
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    metric = mx.metric.Accuracy()
+
+    for epoch in range(args.epochs):
+        metric.reset()
+        for data, label in loader:
+            with autograd.record():
+                out = net(data)
+                loss = loss_fn(out, label)
+            loss.backward()
+            trainer.step(data.shape[0])
+            metric.update([label], [out])
+        logging.info("epoch %d train %s=%.4f", epoch, *metric.get())
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,77 @@
+"""Workload config #4: bucketed LSTM language model via BucketingModule
+— reference example/rnn/lstm_bucketing.py. Synthetic corpus fallback
+keeps it self-contained: `python examples/lstm_bucketing.py`.
+"""
+import argparse
+import logging
+
+import numpy as np
+
+import mxnet_tpu as mx
+
+
+def synthetic_corpus(n=400, vocab=64):
+    rng = np.random.RandomState(0)
+    sents = []
+    for _ in range(n):
+        ln = int(rng.choice([8, 12, 16]))
+        start = rng.randint(0, vocab)
+        step = rng.randint(1, 4)
+        sents.append([(start + i * step) % vocab for i in range(ln)])
+    return sents, vocab
+
+
+def main():
+    logging.basicConfig(level=logging.INFO)
+    p = argparse.ArgumentParser()
+    p.add_argument("--num-layers", type=int, default=2)
+    p.add_argument("--num-hidden", type=int, default=64)
+    p.add_argument("--num-embed", type=int, default=32)
+    p.add_argument("--batch-size", type=int, default=16)
+    p.add_argument("--num-epochs", type=int, default=5)
+    p.add_argument("--corpus", default=None,
+                   help="tokenized text file, one sentence per line "
+                        "(falls back to a synthetic corpus)")
+    args = p.parse_args()
+
+    if args.corpus:
+        with open(args.corpus) as f:
+            raw = [line.split() for line in f if line.strip()]
+        sents, vocab_map = mx.rnn.encode_sentences(raw, start_label=1)
+        vocab = len(vocab_map) + 1
+    else:
+        sents, vocab = synthetic_corpus()
+
+    buckets = [8, 12, 16, 24]
+    train = mx.rnn.BucketSentenceIter(sents, args.batch_size,
+                                      buckets=buckets, invalid_label=-1)
+
+    def sym_gen(seq_len):
+        data = mx.sym.Variable("data")
+        label = mx.sym.Variable("softmax_label")
+        embed = mx.sym.Embedding(data, input_dim=vocab,
+                                 output_dim=args.num_embed, name="embed")
+        stack = mx.rnn.SequentialRNNCell()
+        for i in range(args.num_layers):
+            stack.add(mx.rnn.LSTMCell(args.num_hidden,
+                                      prefix="lstm_l%d_" % i))
+        outputs, _ = stack.unroll(seq_len, embed, layout="NTC",
+                                  merge_outputs=True)
+        pred = mx.sym.Reshape(outputs, shape=(-1, args.num_hidden))
+        pred = mx.sym.FullyConnected(pred, num_hidden=vocab, name="pred")
+        label = mx.sym.Reshape(label, shape=(-1,))
+        net = mx.sym.SoftmaxOutput(pred, label, name="softmax")
+        return net, ("data",), ("softmax_label",)
+
+    mod = mx.mod.BucketingModule(sym_gen,
+                                 default_bucket_key=train.
+                                 default_bucket_key)
+    mod.fit(train, num_epoch=args.num_epochs,
+            eval_metric=mx.metric.Perplexity(-1), optimizer="adam",
+            optimizer_params={"learning_rate": 0.01},
+            batch_end_callback=mx.callback.Speedometer(args.batch_size,
+                                                       10))
+
+
+if __name__ == "__main__":
+    main()

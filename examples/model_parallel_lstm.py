@@ -1,0 +1,60 @@
+"""Model parallelism via ctx_group annotations lowered to sharding
+constraints — reference example/model-parallel-lstm/lstm.py:65-116
+(AttrScope(ctx_group=...) + group2ctx bind). On TPU the PlaceDevice
+pass becomes GSPMD: each group's tensors carry a sharding constraint
+on the mesh 'model' axis.
+
+`JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+   python examples/model_parallel_lstm.py`
+"""
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu.parallel import make_mesh, make_train_step
+
+
+def stacked_lstm_lm(vocab, num_hidden, seq_len, num_layers):
+    data = mx.sym.Variable("data")
+    label = mx.sym.Variable("softmax_label")
+    embed = mx.sym.Embedding(data, input_dim=vocab, output_dim=num_hidden,
+                             name="embed")
+    x = embed
+    for i in range(num_layers):
+        # alternate layers across model groups (the reference pins
+        # layers to GPUs; here groups map to mesh partitions)
+        with mx.AttrScope(ctx_group="dev%d" % (i % 2)):
+            cell = mx.rnn.LSTMCell(num_hidden, prefix="lstm_l%d_" % i)
+            x, _ = cell.unroll(seq_len, x, layout="NTC",
+                               merge_outputs=True)
+    pred = mx.sym.Reshape(x, shape=(-1, num_hidden))
+    pred = mx.sym.FullyConnected(pred, num_hidden=vocab, name="pred")
+    label_r = mx.sym.Reshape(label, shape=(-1,))
+    return mx.sym.SoftmaxOutput(pred, label_r, name="softmax")
+
+
+def main():
+    import jax
+    n_dev = len(jax.devices())
+    model_axis = 2 if n_dev >= 2 else 1
+    mesh = make_mesh({"data": n_dev // model_axis, "model": model_axis})
+
+    vocab, hidden, seq_len, batch = 32, 32, 8, 8
+    sym = stacked_lstm_lm(vocab, hidden, seq_len, num_layers=2)
+    step = make_train_step(sym, optimizer="adam", mesh=mesh)
+    state = step.init_state(mx.init.Xavier(),
+                            {"data": (batch, seq_len),
+                             "softmax_label": (batch, seq_len)})
+    rng = jax.random.PRNGKey(0)
+    rs = np.random.RandomState(0)
+    tokens = rs.randint(0, vocab, (batch, seq_len)).astype(np.float32)
+    labels = np.roll(tokens, -1, axis=1)
+    bv = step.place_batch({"data": tokens, "softmax_label": labels})
+    for i in range(10):
+        state, outs = step(state, bv, 0.01, rng)
+    print("trained 10 steps on a %s mesh; output" % (dict(
+        zip(mesh.axis_names, mesh.devices.shape)),),
+        np.asarray(jax.device_get(outs[0])).shape)
+
+
+if __name__ == "__main__":
+    main()

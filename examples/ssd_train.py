@@ -1,0 +1,114 @@
+"""Workload config #5: SSD-style detector training — reference
+example/ssd/train.py (multibox prior/target/detection stack over
+ImageDetIter). Synthesizes a tiny detection .rec so it is
+self-contained: `python examples/ssd_train.py`.
+"""
+import argparse
+import os
+import tempfile
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import image as img_mod, recordio
+
+
+def make_det_rec(tmp, n=32, size=32):
+    rec = os.path.join(tmp, "ssd.rec")
+    idx = os.path.join(tmp, "ssd.idx")
+    w = recordio.MXIndexedRecordIO(idx, rec, "w")
+    rng = np.random.RandomState(0)
+    for i in range(n):
+        cls = i % 3
+        im = np.full((size, size, 3), 30 * (cls + 1), np.uint8)
+        im += rng.randint(0, 20, im.shape).astype(np.uint8)
+        box = [0.1 + 0.2 * cls, 0.2, 0.4 + 0.2 * cls, 0.7]
+        label = np.array([2, 5, cls, *box], np.float32)
+        w.write_idx(i, recordio.pack_img(
+            recordio.IRHeader(0, label, i, 0), im, img_fmt=".png"))
+    w.close()
+    return rec
+
+
+def ssd_symbol(num_classes=3):
+    data = mx.sym.Variable("data")
+    label = mx.sym.Variable("label")
+    body = mx.sym.Activation(mx.sym.Convolution(
+        data, kernel=(3, 3), stride=(2, 2), pad=(1, 1), num_filter=16,
+        name="conv1"), act_type="relu")
+    body = mx.sym.Activation(mx.sym.Convolution(
+        body, kernel=(3, 3), stride=(2, 2), pad=(1, 1), num_filter=32,
+        name="conv2"), act_type="relu")
+    anchors = mx.sym.MultiBoxPrior(body, sizes=(0.3, 0.6),
+                                   ratios=(1.0, 2.0))
+    n_anchor_per_cell = 3
+    C = num_classes + 1
+    cls_head = mx.sym.Convolution(
+        body, kernel=(3, 3), pad=(1, 1),
+        num_filter=n_anchor_per_cell * C, name="cls_pred")
+    # (B, K*C, H, W) -> (B, C, A): class-major anchor predictions
+    cls_pred = mx.sym.transpose(cls_head, axes=(0, 2, 3, 1))
+    cls_pred = mx.sym.Reshape(mx.sym.Flatten(cls_pred), shape=(0, -1, C))
+    cls_pred = mx.sym.transpose(cls_pred, axes=(0, 2, 1))
+    loc_pred = mx.sym.Convolution(
+        body, kernel=(3, 3), pad=(1, 1),
+        num_filter=n_anchor_per_cell * 4, name="loc_pred")
+    loc_pred = mx.sym.Flatten(
+        mx.sym.transpose(loc_pred, axes=(0, 2, 3, 1)))
+
+    loc_target, loc_mask, cls_target = mx.sym.MultiBoxTarget(
+        anchors, label, cls_pred, overlap_threshold=0.5,
+        negative_mining_ratio=3, name="target")
+    cls_prob = mx.sym.SoftmaxOutput(
+        cls_pred, cls_target,
+        multi_output=True, use_ignore=True, ignore_label=-1,
+        normalization="valid", name="cls_prob")
+    loc_diff = loc_mask * (loc_pred - loc_target)
+    loc_loss = mx.sym.MakeLoss(mx.sym.smooth_l1(loc_diff, scalar=1.0),
+                               grad_scale=1.0, name="loc_loss")
+    return mx.sym.Group([cls_prob, loc_loss,
+                         mx.sym.BlockGrad(cls_target)])
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--batch-size", type=int, default=8)
+    p.add_argument("--epochs", type=int, default=5)
+    args = p.parse_args()
+
+    with tempfile.TemporaryDirectory() as tmp:
+        rec = make_det_rec(tmp)
+        it = img_mod.ImageDetIter(batch_size=args.batch_size,
+                                  data_shape=(3, 32, 32),
+                                  path_imgrec=rec)
+        mod = mx.mod.Module(ssd_symbol(), data_names=("data",),
+                            label_names=("label",))
+        first = next(it)
+        it.reset()
+        mod.bind(data_shapes=[("data", first.data[0].shape)],
+                 label_shapes=[("label", first.label[0].shape)])
+        mod.init_params(mx.init.Xavier())
+        mod.init_optimizer(optimizer="sgd",
+                           optimizer_params={"learning_rate": 0.1,
+                                             "momentum": 0.9})
+        for epoch in range(args.epochs):
+            it.reset()
+            total = count = 0
+            for b in it:
+                mod.forward(b, is_train=True)
+                cls_prob, loc_loss, cls_target = \
+                    [o.asnumpy() for o in mod.get_outputs()]
+                mod.backward()
+                mod.update()
+                tgt = cls_target.astype(int)
+                valid = tgt >= 0
+                bi, ai = np.nonzero(valid)
+                p_t = cls_prob[bi, tgt[bi, ai], ai]
+                total += -np.log(np.maximum(p_t, 1e-9)).mean() + \
+                    loc_loss.sum()
+                count += 1
+            print("epoch %d loss %.4f" % (epoch, total / count))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,82 @@
+"""Workload config #1 (SURVEY Appendix B): LeNet/MLP on MNIST via
+Module.fit — reference example/image-classification/train_mnist.py.
+
+Runs on synthetic MNIST-shaped data when no dataset path is given, so
+the script is self-contained: `python examples/train_mnist.py`.
+"""
+import argparse
+
+import numpy as np
+
+import mxnet_tpu as mx
+
+
+def lenet():
+    data = mx.sym.Variable("data")
+    c1 = mx.sym.Convolution(data, kernel=(5, 5), num_filter=20)
+    p1 = mx.sym.Pooling(mx.sym.Activation(c1, act_type="tanh"),
+                        pool_type="max", kernel=(2, 2), stride=(2, 2))
+    c2 = mx.sym.Convolution(p1, kernel=(5, 5), num_filter=50)
+    p2 = mx.sym.Pooling(mx.sym.Activation(c2, act_type="tanh"),
+                        pool_type="max", kernel=(2, 2), stride=(2, 2))
+    f = mx.sym.Flatten(p2)
+    fc1 = mx.sym.Activation(mx.sym.FullyConnected(f, num_hidden=500),
+                            act_type="tanh")
+    fc2 = mx.sym.FullyConnected(fc1, num_hidden=10)
+    return mx.sym.SoftmaxOutput(fc2, name="softmax")
+
+
+def mlp():
+    data = mx.sym.Flatten(mx.sym.Variable("data"))
+    h1 = mx.sym.Activation(mx.sym.FullyConnected(data, num_hidden=128),
+                           act_type="relu")
+    h2 = mx.sym.Activation(mx.sym.FullyConnected(h1, num_hidden=64),
+                           act_type="relu")
+    return mx.sym.SoftmaxOutput(mx.sym.FullyConnected(h2, num_hidden=10),
+                                name="softmax")
+
+
+def synthetic_mnist(n=2048):
+    """Class-separable 28x28 synthetic digits."""
+    rng = np.random.RandomState(42)
+    y = rng.randint(0, 10, n)
+    X = rng.randn(n, 1, 28, 28).astype(np.float32) * 0.1
+    for i in range(n):
+        d = y[i]
+        X[i, 0, d * 2:d * 2 + 6, d:d + 6] += 1.0     # class-coded patch
+    return X, y.astype(np.float32)
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--network", default="mlp", choices=["mlp", "lenet"])
+    p.add_argument("--batch-size", type=int, default=64)
+    p.add_argument("--num-epochs", type=int, default=5)
+    p.add_argument("--lr", type=float, default=0.05)
+    p.add_argument("--mnist-path", default=None,
+                   help="dir with train-images-idx3-ubyte etc. "
+                        "(falls back to synthetic data)")
+    args = p.parse_args()
+
+    if args.mnist_path:
+        train = mx.io.MNISTIter(
+            image="%s/train-images-idx3-ubyte" % args.mnist_path,
+            label="%s/train-labels-idx1-ubyte" % args.mnist_path,
+            batch_size=args.batch_size, shuffle=True)
+    else:
+        X, y = synthetic_mnist()
+        train = mx.io.NDArrayIter(X, y, batch_size=args.batch_size,
+                                  shuffle=True)
+
+    net = mlp() if args.network == "mlp" else lenet()
+    mod = mx.mod.Module(net, context=mx.cpu())
+    mod.fit(train, num_epoch=args.num_epochs, optimizer="sgd",
+            optimizer_params={"learning_rate": args.lr, "momentum": 0.9},
+            batch_end_callback=mx.callback.Speedometer(args.batch_size,
+                                                       20),
+            eval_metric="acc")
+    print("final train accuracy:", mod.score(train, "acc")[0][1])
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,66 @@
+"""KVStore server-role entry (reference: python/mxnet/kvstore_server.py
+— the worker launches a blocking server loop when DMLC_ROLE=server).
+
+TPU-native mapping: there IS no separate parameter-server process —
+the reference's server-side optimizer (`update_on_kvstore`, executed in
+KVStoreDistServer::ApplyUpdates, kvstore_dist_server.h:233-241) becomes
+the sharded optimizer update *inside* the compiled step function, and
+the scheduler/tracker role collapses into the JAX distributed
+coordinator (mxnet_tpu.parallel.dist). This module keeps the
+reference's process-entry surface so launcher scripts keep working:
+
+- a ``server`` role process simply joins the coordinator and waits
+  (XLA collectives do the reduction work; nothing to serve), mirroring
+  how the reference's server blocked in its request loop;
+- ``scheduler`` maps to hosting the coordinator endpoint;
+- ``worker`` returns immediately (training code runs).
+"""
+from __future__ import annotations
+
+import logging
+import os
+
+__all__ = ["KVStoreServer", "_init_kvstore_server_module"]
+
+
+class KVStoreServer:
+    """Role shim (reference kvstore_server.py:KVStoreServer). Holds the
+    kvstore whose optimizer would have run server-side; on TPU the
+    optimizer runs sharded in the step, so run() just parks the process
+    in the coordinator until the job ends."""
+
+    def __init__(self, kvstore):
+        self.kvstore = kvstore
+
+    def run(self):
+        """Park until the launcher tears the job down. The reference's
+        server blocked in its ZeroMQ request loop; on TPU there are no
+        requests to serve (reductions are in-step XLA collectives) and
+        the JAX coordinator is sized for the WORKER count only — a
+        server must NOT join it. Staying alive keeps ssh/mpi launchers
+        that expect long-lived server processes working."""
+        import signal
+        import time
+        logging.info(
+            "kvstore server role: parking (no parameter server exists "
+            "on TPU — reductions run as in-step XLA collectives; "
+            "waiting for the launcher to end the job)")
+        try:
+            while True:
+                signal.pause()
+        except (AttributeError, ValueError):   # non-main thread/platform
+            while True:
+                time.sleep(3600)
+
+
+def _init_kvstore_server_module():
+    """Start the server loop iff this process was launched with the
+    server role (reference kvstore_server.py:_init_kvstore_server_module
+    checks DMLC_ROLE)."""
+    role = os.environ.get("DMLC_ROLE", "worker")
+    if role == "server":
+        from . import kvstore
+        server = KVStoreServer(kvstore.create("dist"))
+        server.run()
+        return True
+    return False

@@ -116,3 +116,134 @@ def simple_forward(sym, ctx=None, is_train=False, **inputs):
     outs = exe.forward(is_train=is_train)
     outs = [o.asnumpy() for o in outs]
     return outs[0] if len(outs) == 1 else outs
+
+
+def check_symbolic_forward(sym, location, expected, rtol=1e-4, atol=1e-5,
+                           aux_states=None, ctx=None):
+    """Bind ``sym`` with ``location`` (list or dict of arrays) and check
+    each output against ``expected`` (reference
+    test_utils.py:check_symbolic_forward)."""
+    args = _as_arg_dict(sym, location)
+    exe = sym.bind(ctx or default_context(), args,
+                   aux_states={k: array(v) for k, v in
+                               (aux_states or {}).items()})
+    outs = exe.forward(is_train=False)
+    expected = expected if isinstance(expected, (list, tuple)) \
+        else [expected]
+    for o, e in zip(outs, expected):
+        np.testing.assert_allclose(o.asnumpy(), np.asarray(e),
+                                   rtol=rtol, atol=atol)
+    return [o.asnumpy() for o in outs]
+
+
+def check_symbolic_backward(sym, location, out_grads, expected,
+                            rtol=1e-4, atol=1e-5, grad_req="write",
+                            aux_states=None, ctx=None):
+    """Bind, run fwd+bwd with ``out_grads`` head gradients, check the
+    input gradients named in ``expected`` (reference
+    test_utils.py:check_symbolic_backward)."""
+    args = _as_arg_dict(sym, location)
+    grad_arrays = {k: array(np.zeros_like(v.asnumpy()))
+                   for k, v in args.items()}
+    exe = sym.bind(ctx or default_context(), args,
+                   args_grad=grad_arrays, grad_req=grad_req,
+                   aux_states={k: array(v) for k, v in
+                               (aux_states or {}).items()})
+    exe.forward(is_train=True)
+    ogs = [array(g) if not isinstance(g, NDArray) else g
+           for g in (out_grads if isinstance(out_grads, (list, tuple))
+                     else [out_grads])]
+    exe.backward(ogs)
+    if isinstance(expected, dict):
+        items = expected.items()
+    else:
+        items = zip(sym.list_arguments(), expected)
+    for name, e in items:
+        if e is None:
+            continue
+        np.testing.assert_allclose(
+            exe.grad_dict[name].asnumpy(), np.asarray(e),
+            rtol=rtol, atol=atol, err_msg="grad of %s" % name)
+    return {k: v.asnumpy() for k, v in exe.grad_dict.items()}
+
+
+def _as_arg_dict(sym, location):
+    names = sym.list_arguments()
+    if isinstance(location, dict):
+        return {k: array(v) if not isinstance(v, NDArray) else v
+                for k, v in location.items()}
+    return {n: array(v) if not isinstance(v, NDArray) else v
+            for n, v in zip(names, location)}
+
+
+def rand_sparse_ndarray(shape, stype, density=0.2, dtype=np.float32):
+    """(sparse_array, (values, indices[, indptr])) like the reference's
+    rand_sparse_ndarray."""
+    arr = rand_ndarray(shape, stype, density=density, dtype=dtype)
+    if stype == "row_sparse":
+        return arr, (arr.data.asnumpy(), arr.indices.asnumpy())
+    return arr, (arr.data.asnumpy(), arr.indices.asnumpy(),
+                 arr.indptr.asnumpy())
+
+
+def check_speed(sym=None, f=None, location=None, N=20, ctx=None,
+                typ="forward", grad_req="write"):
+    """Wall-clock seconds per run of a bound symbol or callable;
+    ``typ='whole'`` times forward+backward (reference
+    test_utils.py:check_speed)."""
+    import time
+
+    if typ not in ("forward", "whole"):
+        raise ValueError("typ must be 'forward' or 'whole'")
+    if f is None:
+        assert sym is not None
+        args = _as_arg_dict(sym, location or {})
+        if typ == "whole":
+            grads = {k: array(np.zeros_like(v.asnumpy()))
+                     for k, v in args.items()}
+            exe = sym.bind(ctx or default_context(), args,
+                           args_grad=grads, grad_req=grad_req)
+
+            def f():
+                exe.forward(is_train=True)
+                exe.backward()
+                return exe.grad_dict[sym.list_arguments()[0]]
+        else:
+            f = lambda: exe_f.forward()
+            exe_f = sym.bind(ctx or default_context(), args)
+    out = f()
+    if isinstance(out, NDArray):
+        out.wait_to_read()
+    tic = time.time()
+    for _ in range(N):
+        out = f()
+    if isinstance(out, NDArray):
+        out.asnumpy()
+    elif isinstance(out, (list, tuple)) and out and \
+            isinstance(out[0], NDArray):
+        out[0].asnumpy()
+    return (time.time() - tic) / N
+
+
+def same(a, b):
+    return np.array_equal(np.asarray(a), np.asarray(b))
+
+
+def discard_stderr():
+    """Context manager silencing stderr (reference test_utils)."""
+    import contextlib
+    import os as _os
+    import sys as _sys
+
+    @contextlib.contextmanager
+    def _cm():
+        fd = _sys.stderr.fileno()
+        saved = _os.dup(fd)
+        with open(_os.devnull, "w") as devnull:
+            _os.dup2(devnull.fileno(), fd)
+            try:
+                yield
+            finally:
+                _os.dup2(saved, fd)
+                _os.close(saved)
+    return _cm()

@@ -1,0 +1,76 @@
+"""test_utils surface tests (reference test_utils.py helpers)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, test_utils as tu
+
+
+def test_check_symbolic_forward_backward():
+    x = mx.sym.Variable("x")
+    y = x * 3.0 + 1.0
+    loc = {"x": np.ones((2, 2), "float32")}
+    tu.check_symbolic_forward(y, loc, [np.full((2, 2), 4.0)])
+    grads = tu.check_symbolic_backward(
+        y, loc, [np.ones((2, 2), "float32")],
+        {"x": np.full((2, 2), 3.0)})
+    assert "x" in grads
+
+
+def test_check_symbolic_backward_detects_mismatch():
+    x = mx.sym.Variable("x")
+    y = x * 3.0
+    with pytest.raises(AssertionError):
+        tu.check_symbolic_backward(
+            y, {"x": np.ones((2,), "float32")},
+            [np.ones((2,), "float32")],
+            {"x": np.full((2,), 99.0)})
+
+
+def test_rand_sparse_ndarray():
+    arr, (vals, idx) = tu.rand_sparse_ndarray((8, 3), "row_sparse",
+                                              density=0.5)
+    assert arr.stype == "row_sparse"
+    assert vals.shape[0] == idx.shape[0] == arr.nnz
+    arr, parts = tu.rand_sparse_ndarray((6, 4), "csr")
+    assert len(parts) == 3
+
+
+def test_check_speed_returns_positive():
+    x = mx.sym.Variable("x")
+    t = tu.check_speed(sym=x + 1.0,
+                       location={"x": np.ones((4, 4), "float32")}, N=3)
+    assert t > 0
+    t = tu.check_speed(sym=x * 2.0,
+                       location={"x": np.ones((4, 4), "float32")},
+                       N=2, typ="whole")
+    assert t > 0
+    with pytest.raises(ValueError):
+        tu.check_speed(sym=x, location={}, typ="wrong")
+
+
+def test_check_symbolic_backward_with_aux():
+    data = mx.sym.Variable("data")
+    bn = mx.sym.BatchNorm(data, name="bn", fix_gamma=False)
+    loc = {"data": np.random.RandomState(0).randn(4, 3).astype("f"),
+           "bn_gamma": np.ones(3, "float32"),
+           "bn_beta": np.zeros(3, "float32")}
+    aux = {"bn_moving_mean": np.zeros(3, "float32"),
+           "bn_moving_var": np.ones(3, "float32")}
+    grads = tu.check_symbolic_backward(
+        bn, loc, [np.ones((4, 3), "float32")], {}, aux_states=aux)
+    assert "data" in grads
+
+
+def test_same_and_discard_stderr():
+    assert tu.same([1, 2], np.array([1, 2]))
+    assert not tu.same([1], [2])
+    import sys
+    with tu.discard_stderr():
+        print("hidden", file=sys.stderr)
+
+
+def test_kvstore_server_role_shim(monkeypatch):
+    from mxnet_tpu import kvstore_server
+    monkeypatch.setenv("DMLC_ROLE", "worker")
+    assert kvstore_server._init_kvstore_server_module() is False
