@@ -28,7 +28,8 @@ def main():
     p.add_argument("--batch-size", type=int, default=32)
     p.add_argument("--epochs", type=int, default=3)
     p.add_argument("--lr", type=float, default=0.05)
-    p.add_argument("--hybridize", action="store_true", default=True)
+    p.add_argument("--hybridize", action=argparse.BooleanOptionalAction,
+                   default=True)
     args = p.parse_args()
 
     net = gluon.model_zoo.vision.get_model(args.model, classes=10)

@@ -45,6 +45,13 @@ DTYPE_NP_TO_MX = {
 DTYPE_MX_TO_NP = {v: k for k, v in DTYPE_NP_TO_MX.items()}
 
 
+def env_flag(name, default="0"):
+    """Boolean env var (reference dmlc::GetEnv bool parsing)."""
+    import os
+    return os.environ.get(name, default).strip().lower() in \
+        ("1", "true", "yes", "on")
+
+
 def np_dtype(dtype):
     """Normalize user dtype input (np dtype, str incl. 'bfloat16', type)."""
     if dtype is None:

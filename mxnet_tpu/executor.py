@@ -373,6 +373,7 @@ class Executor:
         """One XLA program: outputs + new aux + grads (ones cotangent —
         the reference's head-grad convention, where loss heads ignore the
         incoming cotangent)."""
+        from .base import env_flag
         wrt = {n: arg_vals[n] for n in self._grad_names}
 
         def f(wrt_vals):
@@ -381,6 +382,11 @@ class Executor:
             outs, new_aux = self._eval_fn(merged, aux_vals, rng, True)
             return outs, new_aux
 
+        if env_flag("MXNET_BACKWARD_DO_MIRROR"):
+            # gradient mirroring (reference graph_executor.cc:276-287,
+            # env_var.md memonger): trade forward recompute for
+            # activation memory — on TPU this is jax rematerialization
+            f = jax.checkpoint(f)
         outs, vjp, new_aux = jax.vjp(f, wrt, has_aux=True)
         cots = tuple(jnp.ones(o.shape, o.dtype) for o in outs)
         grads = vjp(cots)[0]
@@ -388,6 +394,7 @@ class Executor:
 
     def _bwd_impl(self, arg_vals, aux_vals, rng, head_grads):
         """Re-derivation path for explicit head gradients."""
+        from .base import env_flag
         wrt = tuple(arg_vals[n] for n in self._grad_names)
 
         def f(wrt_vals):
@@ -396,6 +403,8 @@ class Executor:
             outs, _ = self._eval_fn(merged, aux_vals, rng, True)
             return outs
 
+        if env_flag("MXNET_BACKWARD_DO_MIRROR"):
+            f = jax.checkpoint(f)
         outs, vjp = jax.vjp(f, wrt)
         grads = vjp(tuple(head_grads))[0]
         return dict(zip(self._grad_names, grads))

@@ -33,24 +33,32 @@ class KVStoreServer:
         self.kvstore = kvstore
 
     def run(self):
-        """Park until the launcher tears the job down. The reference's
-        server blocked in its ZeroMQ request loop; on TPU there are no
-        requests to serve (reductions are in-step XLA collectives) and
-        the JAX coordinator is sized for the WORKER count only — a
-        server must NOT join it. Staying alive keeps ssh/mpi launchers
-        that expect long-lived server processes working."""
+        """Park until the launcher signals job end (SIGTERM/SIGINT).
+        The reference's server blocked in its ZeroMQ request loop until
+        the scheduler signalled completion; on TPU there are no requests
+        to serve (reductions are in-step XLA collectives) and the JAX
+        coordinator is sized for the WORKER count only — a server must
+        NOT join it. SIGTERM/SIGINT return cleanly so launchers that
+        signal their children get an orderly exit."""
         import signal
+        import threading
         import time
-        logging.info(
-            "kvstore server role: parking (no parameter server exists "
-            "on TPU — reductions run as in-step XLA collectives; "
-            "waiting for the launcher to end the job)")
+        done = threading.Event()
+
+        def _stop(_sig, _frm):
+            done.set()
         try:
-            while True:
-                signal.pause()
-        except (AttributeError, ValueError):   # non-main thread/platform
-            while True:
-                time.sleep(3600)
+            signal.signal(signal.SIGTERM, _stop)
+            signal.signal(signal.SIGINT, _stop)
+        except ValueError:                     # non-main thread
+            pass
+        logging.info(
+            "kvstore %s role: parking (no parameter server exists on "
+            "TPU — reductions run as in-step XLA collectives; waiting "
+            "for the launcher's termination signal)",
+            os.environ.get("DMLC_ROLE", "server"))
+        while not done.is_set():
+            time.sleep(0.5)
 
 
 def _init_kvstore_server_module():
@@ -58,7 +66,7 @@ def _init_kvstore_server_module():
     server role (reference kvstore_server.py:_init_kvstore_server_module
     checks DMLC_ROLE)."""
     role = os.environ.get("DMLC_ROLE", "worker")
-    if role == "server":
+    if role in ("server", "scheduler"):
         from . import kvstore
         server = KVStoreServer(kvstore.create("dist"))
         server.run()

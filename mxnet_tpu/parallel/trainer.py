@@ -45,15 +45,24 @@ class TrainStep:
     def __init__(self, symbol, data_names=("data",),
                  label_names=("softmax_label",), optimizer="sgd",
                  optimizer_params=None, mesh=None, donate=True,
-                 compute_dtype=None):
+                 compute_dtype=None, remat=None):
         """compute_dtype: cast params+data to this dtype for fwd/bwd
         (e.g. 'bfloat16' for MXU-rate compute) while master weights,
         gradients, optimizer state and BN statistics stay float32 — the
-        TPU mapping of the reference's multi-precision mp_sgd_* path."""
+        TPU mapping of the reference's multi-precision mp_sgd_* path.
+
+        remat: rematerialize the forward in backward (gradient
+        mirroring, reference MXNET_BACKWARD_DO_MIRROR /
+        graph_executor.cc:276-287) — activation memory traded for
+        recompute FLOPs, the lever for long sequences / deep nets.
+        Default: the MXNET_BACKWARD_DO_MIRROR env var."""
+        from ..base import env_flag
         self.symbol = symbol
         self.mesh = mesh
         self.compute_dtype = (None if compute_dtype is None
                               else jnp.dtype(compute_dtype))
+        self.remat = bool(remat) if remat is not None else \
+            env_flag("MXNET_BACKWARD_DO_MIRROR")
         self.data_names = list(data_names)
         self.label_names = list(label_names)
         self.arg_names = symbol.list_arguments()
@@ -138,6 +147,7 @@ class TrainStep:
         mesh = self.mesh
         data_names = self.data_names
         cdt = self.compute_dtype
+        remat = self.remat
 
         def step(params, opt_state, aux, batch, lr, rng):
             # Module.init_optimizer defaults rescale_grad=1/batch; match
@@ -173,7 +183,8 @@ class TrainStep:
                                for k, v in new_aux.items()}
                 return outs, new_aux
 
-            outs, vjp, new_aux = jax.vjp(fwd, params, has_aux=True)
+            fwd_fn = jax.checkpoint(fwd) if remat else fwd
+            outs, vjp, new_aux = jax.vjp(fwd_fn, params, has_aux=True)
             # loss heads (SoftmaxOutput & co) define custom vjps that
             # ignore the incoming cotangent — ones matches the reference's
             # head-grad convention (Executor.backward)

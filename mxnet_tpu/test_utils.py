@@ -130,6 +130,9 @@ def check_symbolic_forward(sym, location, expected, rtol=1e-4, atol=1e-5,
     outs = exe.forward(is_train=False)
     expected = expected if isinstance(expected, (list, tuple)) \
         else [expected]
+    assert len(outs) == len(expected), \
+        "symbol has %d outputs, %d expectations given" % (len(outs),
+                                                          len(expected))
     for o, e in zip(outs, expected):
         np.testing.assert_allclose(o.asnumpy(), np.asarray(e),
                                    rtol=rtol, atol=atol)
@@ -157,7 +160,11 @@ def check_symbolic_backward(sym, location, out_grads, expected,
     if isinstance(expected, dict):
         items = expected.items()
     else:
-        items = zip(sym.list_arguments(), expected)
+        names = sym.list_arguments()
+        assert len(expected) == len(names), \
+            "%d expected grads for %d arguments" % (len(expected),
+                                                    len(names))
+        items = zip(names, expected)
     for name, e in items:
         if e is None:
             continue

@@ -1,0 +1,81 @@
+"""Multi-process-on-one-host distributed test — the SURVEY §4 pattern
+(reference tests/nightly/dist_sync_kvstore.py launched with the `local`
+dmlc_tracker): two local processes form a cluster via the DMLC_* env
+shim (parallel/dist.py) and run a real cross-process collective.
+"""
+import os
+import socket
+import subprocess
+import sys
+
+import pytest
+
+_WORKER_SRC = r"""
+import os, sys
+sys.path.insert(0, os.environ["REPO"])
+import numpy as np
+import mxnet_tpu as mx
+from mxnet_tpu.parallel import dist
+import jax, jax.numpy as jnp
+
+dist.init()
+assert dist.size() == 2, dist.size()
+rank = dist.rank()
+
+from jax.experimental import multihost_utils
+got = multihost_utils.process_allgather(jnp.array([rank + 10.0]))
+np.testing.assert_allclose(np.sort(np.asarray(got).ravel()),
+                           [10.0, 11.0])
+
+# kvstore reports cluster identity through the same plumbing
+kv = mx.kv.create("dist_sync")
+assert kv.num_workers == 2 and kv.rank == rank
+print("WORKER_OK", rank)
+"""
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+@pytest.mark.slow
+def test_two_process_cluster(tmp_path):
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    port = _free_port()
+    script = tmp_path / "worker.py"
+    script.write_text(_WORKER_SRC)
+
+    procs = []
+    for wid in range(2):
+        env = dict(os.environ)
+        env.update({
+            "REPO": repo,
+            "PYTHONPATH": repo,          # drop the axon plugin site
+            "JAX_PLATFORMS": "cpu",
+            "DMLC_PS_ROOT_URI": "127.0.0.1",
+            "DMLC_PS_ROOT_PORT": str(port),
+            "DMLC_NUM_WORKER": "2",
+            "DMLC_WORKER_ID": str(wid),
+            "DMLC_ROLE": "worker",
+        })
+        procs.append(subprocess.Popen(
+            [sys.executable, str(script)], env=env,
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True))
+
+    outs = []
+    try:
+        for p in procs:
+            out, _ = p.communicate(timeout=180)
+            outs.append(out)
+    except subprocess.TimeoutExpired:
+        for p in procs:
+            p.kill()
+        pytest.fail("cluster formation timed out:\n%s"
+                    % "\n".join(outs))
+    for wid, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, "worker %d failed:\n%s" % (wid, out)
+        assert "WORKER_OK %d" % wid in out

@@ -146,3 +146,43 @@ def test_shard_annotation_bad_axis_raises():
     with pytest.raises(MXNetError):
         # not divisible: 3 % 2
         _shard_constraint(mesh, "data", jnp.zeros((3, 4)))
+
+
+def test_backward_do_mirror_env_matches_plain(monkeypatch):
+    """MXNET_BACKWARD_DO_MIRROR=1 remats the fused fwd+bwd program with
+    identical gradients (reference memonger parity)."""
+    import numpy as np
+    import mxnet_tpu as mx
+
+    def build():
+        x = mx.sym.Variable("data")
+        h = mx.sym.Activation(mx.sym.FullyConnected(
+            x, num_hidden=8, name="fc1"), act_type="tanh")
+        return mx.sym.MakeLoss(mx.sym.sum(
+            mx.sym.FullyConnected(h, num_hidden=1, name="fc2")))
+
+    loc = {"data": np.random.RandomState(0).randn(4, 3).astype("f"),
+           "fc1_weight": np.random.RandomState(1).randn(8, 3).astype("f"),
+           "fc1_bias": np.zeros(8, "f"),
+           "fc2_weight": np.random.RandomState(2).randn(1, 8).astype("f"),
+           "fc2_bias": np.zeros(1, "f")}
+
+    def grads_with(env):
+        if env:
+            monkeypatch.setenv("MXNET_BACKWARD_DO_MIRROR", "1")
+        else:
+            monkeypatch.delenv("MXNET_BACKWARD_DO_MIRROR",
+                               raising=False)
+        sym = build()
+        args = {k: mx.nd.array(v) for k, v in loc.items()}
+        gbuf = {k: mx.nd.zeros(v.shape) for k, v in loc.items()}
+        ex = sym.bind(mx.cpu(), args, args_grad=gbuf)
+        ex.forward(is_train=True)
+        ex.backward()
+        return {k: v.asnumpy() for k, v in ex.grad_dict.items()}
+
+    plain = grads_with(False)
+    mirrored = grads_with(True)
+    for k in plain:
+        np.testing.assert_allclose(plain[k], mirrored[k], rtol=1e-5,
+                                   atol=1e-6, err_msg=k)
