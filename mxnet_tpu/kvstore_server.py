@@ -12,7 +12,9 @@ reference's process-entry surface so launcher scripts keep working:
 - a ``server`` role process simply joins the coordinator and waits
   (XLA collectives do the reduction work; nothing to serve), mirroring
   how the reference's server blocked in its request loop;
-- ``scheduler`` maps to hosting the coordinator endpoint;
+- ``scheduler`` parks the same way (the coordinator endpoint is
+  hosted by worker process 0 via jax.distributed, not by a dedicated
+  scheduler process);
 - ``worker`` returns immediately (training code runs).
 """
 from __future__ import annotations
@@ -67,8 +69,11 @@ def _init_kvstore_server_module():
     checks DMLC_ROLE)."""
     role = os.environ.get("DMLC_ROLE", "worker")
     if role in ("server", "scheduler"):
+        import sys
         from . import kvstore
         server = KVStoreServer(kvstore.create("dist"))
         server.run()
-        return True
+        # the reference exits after the server loop; returning would let
+        # the importing training script run as an uncoordinated worker
+        sys.exit(0)
     return False

@@ -24,3 +24,5 @@ del _name
 # sparse-aware dispatch over the generated entry points (the analogue of
 # the reference's FComputeEx storage-type dispatch)
 sparse._install_sparse_dispatch(globals(), op)
+
+from . import contrib  # noqa: E402,F401 (mx.nd.contrib)

@@ -618,29 +618,82 @@ def waitall():
 _SAVE_LIST_PREFIX = "__mx_list__:"
 
 
+_SPARSE_NS = "__mx_sparse__"
+
+
+def _save_entry(payload, manifest, key, v):
+    """Dense arrays store verbatim under their key; sparse arrays store
+    components under the reserved namespace with a manifest entry, so
+    arbitrary user keys never collide (reference NDArray::Save keeps
+    stype + aux arrays alongside the values)."""
+    from .sparse import BaseSparseNDArray, CSRNDArray
+    if key.startswith(_SPARSE_NS):
+        raise ValueError("array names must not start with %r (reserved "
+                         "for the sparse save format)" % _SPARSE_NS)
+    if isinstance(v, BaseSparseNDArray):
+        i = len(manifest)
+        entry = {"key": key, "stype": v.stype, "shape": list(v.shape)}
+        payload["%s.%d.data" % (_SPARSE_NS, i)] = v.data.asnumpy()
+        payload["%s.%d.indices" % (_SPARSE_NS, i)] = \
+            v.indices.asnumpy()
+        if isinstance(v, CSRNDArray):
+            payload["%s.%d.indptr" % (_SPARSE_NS, i)] = \
+                v.indptr.asnumpy()
+        manifest.append(entry)
+        return
+    payload[key] = v.asnumpy() if isinstance(v, NDArray) \
+        else np.asarray(v)
+
+
 def save(fname, data):
+    import json
+
     if isinstance(data, NDArray):
         data = [data]
+    payload = {}
+    manifest = []
     if isinstance(data, dict):
-        payload = {}
         for k, v in data.items():
-            arr = v.asnumpy() if isinstance(v, NDArray) else np.asarray(v)
-            payload[k] = arr
+            _save_entry(payload, manifest, k, v)
     elif isinstance(data, (list, tuple)):
-        payload = {}
         for i, v in enumerate(data):
-            arr = v.asnumpy() if isinstance(v, NDArray) else np.asarray(v)
-            payload[_SAVE_LIST_PREFIX + str(i)] = arr
+            _save_entry(payload, manifest, _SAVE_LIST_PREFIX + str(i), v)
     else:
         raise ValueError("data must be NDArray, list of NDArrays or dict")
+    if manifest:
+        payload[_SPARSE_NS + ".manifest"] = np.frombuffer(
+            json.dumps(manifest).encode(), np.uint8)
     with open(fname, "wb") as f:
         np.savez(f, **payload)
 
 
 def load(fname):
+    import json
+
+    from .sparse import CSRNDArray, RowSparseNDArray
+
     with np.load(fname, allow_pickle=False) as npz:
-        keys = list(npz.keys())
-        if keys and all(k.startswith(_SAVE_LIST_PREFIX) for k in keys):
-            idx = sorted(keys, key=lambda k: int(k[len(_SAVE_LIST_PREFIX):]))
-            return [array(npz[k]) for k in idx]
-        return {k: array(npz[k]) for k in keys}
+        entries = {}
+        for k in npz.files:
+            if not k.startswith(_SPARSE_NS):
+                entries[k] = array(npz[k])
+        mkey = _SPARSE_NS + ".manifest"
+        if mkey in npz.files:
+            manifest = json.loads(bytes(npz[mkey]).decode())
+            for i, meta in enumerate(manifest):
+                shape = tuple(int(d) for d in meta["shape"])
+                vals = npz["%s.%d.data" % (_SPARSE_NS, i)]
+                idx = npz["%s.%d.indices" % (_SPARSE_NS, i)]
+                if meta["stype"] == "csr":
+                    entries[meta["key"]] = CSRNDArray(
+                        vals, idx,
+                        npz["%s.%d.indptr" % (_SPARSE_NS, i)], shape)
+                else:
+                    entries[meta["key"]] = RowSparseNDArray(vals, idx,
+                                                            shape)
+        if entries and all(k.startswith(_SAVE_LIST_PREFIX)
+                           for k in entries):
+            order = sorted(entries,
+                           key=lambda k: int(k[len(_SAVE_LIST_PREFIX):]))
+            return [entries[k] for k in order]
+        return entries

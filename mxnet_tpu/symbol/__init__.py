@@ -12,3 +12,5 @@ del _n, _reg
 
 # creation helpers mirroring mx.sym.zeros/ones
 from .op import _zeros as zeros, _ones as ones, _arange as arange  # noqa: F401,E501
+
+from . import contrib  # noqa: E402,F401 (mx.sym.contrib)

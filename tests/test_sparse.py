@@ -330,3 +330,44 @@ class TestKVStore:
         dense = out.asnumpy()
         np.testing.assert_array_equal(dense[1], [3, 3])
         np.testing.assert_array_equal(dense[4], [5, 5])
+
+
+class TestSerialization:
+    def test_save_load_preserves_sparse(self, tmp_path):
+        path = str(tmp_path / "mixed.npz")
+        rs, idx, vals = _rand_rs(20, 3, 4, seed=20)
+        csr = sparse.csr_matrix(
+            np.array([[0, 1.5], [2.5, 0]], "float32"))
+        dense = nd.ones((2, 2))
+        nd.save(path, {"rs": rs, "csr": csr, "w": dense})
+        back = nd.load(path)
+        assert back["rs"].stype == "row_sparse"
+        assert back["rs"].nnz == 4
+        np.testing.assert_allclose(back["rs"].asnumpy(), rs.asnumpy())
+        assert back["csr"].stype == "csr"
+        np.testing.assert_allclose(back["csr"].asnumpy(), csr.asnumpy())
+        assert back["w"].stype == "default"
+
+    def test_save_load_sparse_list(self, tmp_path):
+        path = str(tmp_path / "list.npz")
+        rs, _, _ = _rand_rs(10, 2, 3, seed=21)
+        nd.save(path, [rs, nd.zeros((2,))])
+        back = nd.load(path)
+        assert back[0].stype == "row_sparse"
+        assert back[1].shape == (2,)
+
+    def test_reserved_suffix_keys_roundtrip(self, tmp_path):
+        """User keys that look like sparse components stay intact."""
+        path = str(tmp_path / "edge.npz")
+        nd.save(path, {"emb:data": nd.ones((2, 2)),
+                       "foo:stype": nd.zeros((1,)),
+                       "arg:indptr": nd.ones((3,))})
+        back = nd.load(path)
+        assert set(back) == {"emb:data", "foo:stype", "arg:indptr"}
+        np.testing.assert_array_equal(back["emb:data"].asnumpy(),
+                                      np.ones((2, 2)))
+
+    def test_reserved_namespace_rejected(self, tmp_path):
+        with pytest.raises(ValueError):
+            nd.save(str(tmp_path / "x.npz"),
+                    {"__mx_sparse__.0.data": nd.ones((1,))})
