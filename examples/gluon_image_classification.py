@@ -3,6 +3,12 @@ reference example/gluon/image_classification.py (Trainer, autograd,
 net.hybridize()). Self-contained synthetic data:
 `python examples/gluon_image_classification.py`.
 """
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
 import argparse
 import logging
 

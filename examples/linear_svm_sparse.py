@@ -3,8 +3,13 @@ linear model through the sparse dot kernel, gradients stay row-sparse
 (reference sparse examples + iter_libsvm.cc). Self-contained:
 `python examples/linear_svm_sparse.py`.
 """
-import argparse
 import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+import argparse
 import tempfile
 
 import numpy as np

@@ -8,6 +8,12 @@ linearly with the mesh.
 `JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
    python examples/long_context_ring_attention.py --seq-len 4096`
 """
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
 import argparse
 import time
 

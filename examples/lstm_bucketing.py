@@ -2,6 +2,12 @@
 — reference example/rnn/lstm_bucketing.py. Synthetic corpus fallback
 keeps it self-contained: `python examples/lstm_bucketing.py`.
 """
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
 import argparse
 import logging
 

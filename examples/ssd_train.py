@@ -3,8 +3,13 @@ example/ssd/train.py (multibox prior/target/detection stack over
 ImageDetIter). Synthesizes a tiny detection .rec so it is
 self-contained: `python examples/ssd_train.py`.
 """
-import argparse
 import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+import argparse
 import tempfile
 
 import numpy as np

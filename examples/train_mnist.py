@@ -4,6 +4,12 @@ Module.fit — reference example/image-classification/train_mnist.py.
 Runs on synthetic MNIST-shaped data when no dataset path is given, so
 the script is self-contained: `python examples/train_mnist.py`.
 """
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
 import argparse
 
 import numpy as np

@@ -4,6 +4,12 @@ weights) — the path bench.py measures. Runs on any device count:
 `JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
    python examples/train_resnet_spmd.py --num-devices 8`
 """
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
 import argparse
 
 import numpy as np

@@ -1,0 +1,94 @@
+"""Train the transformer LM (the long-context flagship) through the
+compiled SPMD TrainStep — causal flash attention on the MXU, bf16
+compute with f32 master weights. Self-contained synthetic corpus:
+
+`python examples/train_transformer_lm.py`
+(add XLA_FLAGS=--xla_force_host_platform_device_count=8 and
+ --num-devices 8 for a dp x tp mesh; see
+ examples/long_context_ring_attention.py for sequence parallelism)
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+import argparse
+import time
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu.models import transformer
+from mxnet_tpu.parallel import make_mesh, make_train_step
+
+
+def corpus(n, T, vocab, seed=0):
+    rng = np.random.RandomState(seed)
+    starts = rng.randint(0, vocab, n)
+    step = rng.randint(1, 5, n)
+    toks = (starts[:, None] + step[:, None] * np.arange(T)[None, :]) \
+        % vocab
+    labels = np.roll(toks, -1, axis=1).astype(np.float32)
+    labels[:, -1] = -1
+    return toks.astype(np.float32), labels
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--vocab", type=int, default=64)
+    p.add_argument("--seq-len", type=int, default=64)
+    p.add_argument("--layers", type=int, default=2)
+    p.add_argument("--heads", type=int, default=4)
+    p.add_argument("--dim", type=int, default=128)
+    p.add_argument("--batch-size", type=int, default=32)
+    p.add_argument("--steps", type=int, default=150)
+    p.add_argument("--lr", type=float, default=3e-3)
+    p.add_argument("--num-devices", type=int, default=1)
+    p.add_argument("--dtype", default="float32",
+                   choices=["float32", "bfloat16"])
+    args = p.parse_args()
+
+    import jax
+    mesh = None
+    if args.num_devices > 1:
+        model = 2 if args.num_devices % 2 == 0 else 1
+        mesh = make_mesh({"data": args.num_devices // model,
+                          "model": model},
+                         devices=jax.devices()[:args.num_devices])
+
+    sym = transformer.get_symbol(args.vocab, args.seq_len,
+                                 num_layers=args.layers,
+                                 num_heads=args.heads, dim=args.dim)
+    step = make_train_step(
+        sym, optimizer="adam", mesh=mesh,
+        compute_dtype=None if args.dtype == "float32" else args.dtype)
+    shapes = {"data": (args.batch_size, args.seq_len),
+              "softmax_label": (args.batch_size, args.seq_len)}
+    state = step.init_state(mx.init.Xavier(), shapes)
+    toks, labels = corpus(args.batch_size, args.seq_len, args.vocab)
+    bv = step.place_batch({"data": toks, "softmax_label": labels})
+    rng = jax.random.PRNGKey(0)
+
+    def nll(outs):
+        pr = np.asarray(jax.device_get(outs[0])).reshape(
+            args.batch_size, args.seq_len, args.vocab)
+        tgt = labels.astype(int)
+        bi, ti = np.nonzero(tgt >= 0)
+        return float(-np.log(np.maximum(
+            pr[bi, ti, tgt[bi, ti]], 1e-9)).mean())
+
+    state, outs = step(state, bv, args.lr, rng)
+    print("step 0 nll %.3f" % nll(outs))
+    t0 = time.time()
+    for i in range(1, args.steps + 1):
+        state, outs = step(state, bv, args.lr, rng)
+        if i % 50 == 0:
+            print("step %d nll %.3f" % (i, nll(outs)))
+    dt = (time.time() - t0) / args.steps
+    tok_s = args.batch_size * args.seq_len / dt
+    print("%.2f ms/step, %.0f tokens/s" % (dt * 1e3, tok_s))
+
+
+if __name__ == "__main__":
+    main()

@@ -4,3 +4,4 @@ lenet, mlp). Gluon model_zoo lives in mxnet_tpu.gluon.model_zoo."""
 from . import resnet
 from . import lenet
 from . import mlp
+from . import transformer

@@ -1,0 +1,90 @@
+"""Decoder-only transformer language model — the long-context flagship.
+
+A NEW model family beyond the 2017 reference (whose sequence stack was
+LSTM+bucketing): pre-norm GPT-style decoder built from the symbolic op
+catalog, with attention lowered to the Pallas flash kernel
+(ops/attention.py) and sequence parallelism available through
+parallel.ring for contexts beyond one chip's HBM.
+
+The symbol trains through every framework surface: Module.fit, the
+compiled SPMD TrainStep (dp/tp mesh, bf16 compute), and the
+predictor/AOT export path. Variable-length corpora bucket over seq_len
+exactly like the LSTM toolkit (one jit specialization per bucket).
+"""
+from __future__ import annotations
+
+from .. import symbol as sym
+
+
+def _attention_block(x, num_heads, dim, prefix):
+    """x: (B, T, C) -> (B, T, C); causal flash attention."""
+    H = num_heads
+    head_dim = dim // num_heads
+    qkv = sym.FullyConnected(x, num_hidden=3 * dim, flatten=False,
+                             name=prefix + "qkv")
+    # (B, T, 3C) -> (3, B, H, T, hd)
+    qkv = sym.reshape(qkv, shape=(0, 0, 3, H, head_dim))
+    qkv = sym.transpose(qkv, axes=(2, 0, 3, 1, 4))
+
+    def head(i):
+        part = sym.slice_axis(qkv, axis=0, begin=i, end=i + 1)
+        return sym.reshape(part, shape=(-3, -2))      # (B, H, T, hd)
+
+    att = sym.contrib.FlashAttention(head(0), head(1), head(2),
+                                     causal=True, name=prefix + "attn")
+    att = sym.transpose(att, axes=(0, 2, 1, 3))       # (B, T, H, hd)
+    att = sym.reshape(att, shape=(0, 0, -3))          # (B, T, C)
+    return sym.FullyConnected(att, num_hidden=dim, flatten=False,
+                              name=prefix + "proj")
+
+
+def _ffn_block(x, dim, hidden, prefix):
+    h = sym.FullyConnected(x, num_hidden=hidden, flatten=False,
+                           name=prefix + "fc1")
+    h = sym.Activation(h, act_type="relu")
+    return sym.FullyConnected(h, num_hidden=dim, flatten=False,
+                              name=prefix + "fc2")
+
+
+def get_symbol(vocab_size, seq_len, num_layers=2, num_heads=4, dim=128,
+               ffn_hidden=None, dropout=0.0, max_len=None):
+    """GPT-style causal LM symbol.
+
+    data: (B, T) token ids; softmax_label: (B, T) next-token targets
+    (ignore index -1). Output: softmax over vocab per position.
+
+    max_len: position-table capacity (>= seq_len). For BucketingModule,
+    pass the same max_len (e.g. the largest bucket) to every bucket's
+    get_symbol so the shared pos_embed parameter keeps one shape; each
+    bucket slices the first seq_len rows.
+    """
+    ffn_hidden = ffn_hidden or 4 * dim
+    max_len = max_len or seq_len
+    assert max_len >= seq_len
+    data = sym.Variable("data")
+    label = sym.Variable("softmax_label")
+
+    x = sym.Embedding(data, input_dim=vocab_size, output_dim=dim,
+                      name="tok_embed")
+    pos_table = sym.Variable("pos_embed_weight", shape=(max_len, dim))
+    pos = sym.slice_axis(pos_table, axis=0, begin=0, end=seq_len)
+    x = sym.broadcast_add(x, sym.expand_dims(pos, axis=0))
+
+    for i in range(num_layers):
+        p = "layer%d_" % i
+        a = sym.LayerNorm(x, name=p + "ln1")
+        x = x + _attention_block(a, num_heads, dim, p)
+        f = sym.LayerNorm(x, name=p + "ln2")
+        ff = _ffn_block(f, dim, ffn_hidden, p)
+        if dropout > 0:
+            ff = sym.Dropout(ff, p=dropout)
+        x = x + ff
+
+    x = sym.LayerNorm(x, name="ln_f")
+    logits = sym.FullyConnected(x, num_hidden=vocab_size, flatten=False,
+                                name="lm_head")
+    logits = sym.reshape(logits, shape=(-3, -2))      # (B*T, V)
+    label_r = sym.reshape(label, shape=(-1,))
+    return sym.SoftmaxOutput(logits, label_r, use_ignore=True,
+                             ignore_label=-1.0, normalization="valid",
+                             name="softmax")

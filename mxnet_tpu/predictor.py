@@ -34,7 +34,13 @@ def _as_jnp(x):
 class Predictor:
     """Forward-only executor with parameters baked in as constants
     (reference MXAPIPredictor). Inputs are positional by ``data_names``
-    or keyword; outputs are NDArrays."""
+    or keyword; outputs are NDArrays.
+
+    Loss-head label variables that feed the loss DIRECTLY are
+    auto-zero-filled via shape inference; labels that pass through
+    reshaping ops first are not inferable from data alone — declare
+    them in ``data_names`` and feed dummy arrays (loss heads ignore
+    labels outside training)."""
 
     def __init__(self, symbol, arg_params, aux_params=None,
                  data_names=("data",)):

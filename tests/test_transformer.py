@@ -1,0 +1,143 @@
+"""Transformer LM model-family tests: trains through TrainStep (SPMD)
+and Module, uses the flash-attention op, exports through the
+predictor."""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import mxnet_tpu as mx
+from mxnet_tpu.models import transformer
+from mxnet_tpu.parallel import make_mesh, make_train_step
+
+
+def _corpus(n, T, vocab, seed=0):
+    """Deterministic next-token task: t_{i+1} = (t_i + 3) % vocab."""
+    rng = np.random.RandomState(seed)
+    starts = rng.randint(0, vocab, n)
+    toks = (starts[:, None] + 3 * np.arange(T)[None, :]) % vocab
+    labels = np.roll(toks, -1, axis=1).astype(np.float32)
+    labels[:, -1] = -1
+    return toks.astype(np.float32), labels
+
+
+def test_trainstep_convergence():
+    vocab, T, B = 16, 12, 16
+    sym = transformer.get_symbol(vocab, T, num_layers=2, num_heads=2,
+                                 dim=32)
+    step = make_train_step(sym, optimizer="adam",
+                           optimizer_params={"learning_rate": 3e-3})
+    state = step.init_state(mx.init.Xavier(), {"data": (B, T),
+                                               "softmax_label": (B, T)})
+    toks, labels = _corpus(B, T, vocab)
+    bv = step.place_batch({"data": toks, "softmax_label": labels})
+    rng = jax.random.PRNGKey(0)
+
+    def nll(probs):
+        p = np.asarray(probs).reshape(B, T, vocab)
+        tgt = labels.astype(int)
+        mask = tgt >= 0
+        bi, ti = np.nonzero(mask)
+        return -np.log(np.maximum(
+            p[bi, ti, tgt[bi, ti]], 1e-9)).mean()
+
+    state, outs = step(state, bv, 3e-3, rng)
+    first = nll(jax.device_get(outs[0]))
+    for _ in range(60):
+        state, outs = step(state, bv, 3e-3, rng)
+    last = nll(jax.device_get(outs[0]))
+    assert last < first * 0.2, (first, last)
+
+
+def test_module_training():
+    vocab, T, B = 12, 8, 8
+    sym = transformer.get_symbol(vocab, T, num_layers=1, num_heads=2,
+                                 dim=16)
+    toks, labels = _corpus(64, T, vocab, seed=1)
+    it = mx.io.NDArrayIter(toks, labels, batch_size=B,
+                           label_name="softmax_label")
+    mod = mx.mod.Module(sym, ("data",), ("softmax_label",))
+    mod.fit(it, num_epoch=8, optimizer="adam",
+            optimizer_params={"learning_rate": 3e-3},
+            eval_metric=mx.metric.Perplexity(-1))
+    it.reset()
+    score = mod.score(it, mx.metric.Perplexity(-1))[0][1]
+    assert score < 4.0, score
+
+
+@pytest.mark.skipif(jax.device_count() < 8, reason="needs 8-device mesh")
+def test_trainstep_on_mesh_with_tp():
+    vocab, T, B = 16, 8, 16
+    mesh = make_mesh({"data": 4, "model": 2},
+                     devices=jax.devices()[:8])
+    sym = transformer.get_symbol(vocab, T, num_layers=1, num_heads=2,
+                                 dim=32)
+    step = make_train_step(sym, optimizer="adam", mesh=mesh,
+                           compute_dtype="bfloat16")
+    state = step.init_state(mx.init.Xavier(), {"data": (B, T),
+                                               "softmax_label": (B, T)})
+    toks, labels = _corpus(B, T, vocab, seed=2)
+    bv = step.place_batch({"data": toks, "softmax_label": labels})
+    state, outs = step(state, bv, 1e-3, jax.random.PRNGKey(0))
+    out = np.asarray(jax.device_get(outs[0]))
+    assert out.shape == (B * T, vocab)
+    assert np.isfinite(out).all()
+    # master weights stay f32 under bf16 compute
+    assert all(v.dtype == np.float32 for v in state[0].values())
+
+
+def test_bucketing_shares_pos_table():
+    """Buckets of different seq_len share one (max_len, dim) position
+    table (each slices its prefix)."""
+    vocab, B = 12, 8
+    buckets = [6, 10]
+
+    def sym_gen(T):
+        s = transformer.get_symbol(vocab, T, num_layers=1, num_heads=2,
+                                   dim=16, max_len=max(buckets))
+        return s, ("data",), ("softmax_label",)
+
+    mod = mx.mod.BucketingModule(sym_gen, default_bucket_key=10)
+    mod.bind([mx.io.DataDesc("data", (B, 10))],
+             [mx.io.DataDesc("softmax_label", (B, 10))])
+    mod.init_params(mx.init.Xavier())
+    mod.init_optimizer(optimizer="adam",
+                       optimizer_params={"learning_rate": 1e-3})
+    for T in (10, 6, 10, 6):
+        toks, labels = _corpus(B, T, vocab, seed=T)
+        batch = mx.io.DataBatch(
+            data=[mx.nd.array(toks)], label=[mx.nd.array(labels)],
+            bucket_key=T,
+            provide_data=[mx.io.DataDesc("data", (B, T))],
+            provide_label=[mx.io.DataDesc("softmax_label", (B, T))])
+        mod.forward(batch, is_train=True)
+        mod.backward()
+        mod.update()
+    params = mod.get_params()[0]
+    assert params["pos_embed_weight"].shape == (10, 16)
+
+
+def test_predictor_export(tmp_path):
+    vocab, T = 12, 8
+    sym = transformer.get_symbol(vocab, T, num_layers=1, num_heads=2,
+                                 dim=16)
+    step = make_train_step(sym, optimizer="adam")
+    state = step.init_state(mx.init.Xavier(), {"data": (2, T),
+                                               "softmax_label": (2, T)})
+    params = {k: np.asarray(v) for k, v in state[0].items()}
+    # the label routes through a reshape before the loss head, so its
+    # shape is not inferable from data alone — declare it as an input
+    # and feed dummies (SoftmaxOutput ignores labels at inference)
+    pred = mx.Predictor(sym, params,
+                        data_names=("data", "softmax_label"))
+    toks = np.zeros((2, T), np.float32)
+    dummy = np.zeros((2, T), np.float32)
+    out = pred.forward(data=toks, softmax_label=dummy)[0]
+    assert out.shape == (2 * T, vocab)
+
+    art = pred.export(str(tmp_path / "lm"),
+                      {"data": (2, T), "softmax_label": (2, T)})
+    loaded = mx.predictor.CompiledPredictor.load(str(tmp_path / "lm"))
+    got = loaded.forward(data=toks, softmax_label=dummy)[0].asnumpy()
+    np.testing.assert_allclose(got, out.asnumpy(), rtol=1e-5, atol=1e-6)
