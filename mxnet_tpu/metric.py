@@ -1,9 +1,15 @@
-"""Evaluation metrics (reference: python/mxnet/metric.py, 1132 LoC).
+"""Evaluation metrics (reference surface: python/mxnet/metric.py,
+1132 LoC; bodies re-derived, vectorized).
 
-Metrics run host-side on numpy — they sit outside the compiled step
+Metrics run host-side on numpy: they sit outside the compiled step
 function, so metric computation never forces a recompile and the device
-stays busy with the next step while the host reduces (the reference's
-update_metric ran on the engine's CPU workers similarly).
+keeps working on the next step while the host reduces (the reference's
+update_metric likewise ran on CPU engine workers).
+
+Design: every concrete metric implements ``_accumulate(label, pred)``
+over ONE numpy (label, pred) pair; the base class handles NDArray→numpy
+conversion, list pairing, and the running (sum, count) average. `get`
+may post-process the ratio (Perplexity exponentiates).
 """
 from __future__ import annotations
 
@@ -11,10 +17,9 @@ import math
 
 import numpy
 
-from .base import numeric_types, string_types
-from . import ndarray
-from .ndarray import NDArray
 from . import registry as _registry
+from .base import numeric_types, string_types
+from .ndarray import NDArray
 
 __all__ = ["EvalMetric", "CompositeEvalMetric", "Accuracy", "TopKAccuracy",
            "F1", "Perplexity", "MAE", "MSE", "RMSE", "CrossEntropy",
@@ -23,22 +28,24 @@ __all__ = ["EvalMetric", "CompositeEvalMetric", "Accuracy", "TopKAccuracy",
 
 
 def check_label_shapes(labels, preds, shape=0):
-    """Raise if label/pred list lengths mismatch (reference
-    metric.py:check_label_shapes)."""
-    if shape == 0:
-        label_shape, pred_shape = len(labels), len(preds)
-    else:
-        label_shape, pred_shape = labels.shape, preds.shape
-    if label_shape != pred_shape:
+    """Raise on label/pred arity (or shape, when shape=1) mismatch."""
+    a = len(labels) if shape == 0 else labels.shape
+    b = len(preds) if shape == 0 else preds.shape
+    if a != b:
         raise ValueError(
             "Shape of labels {} does not match shape of predictions {}"
-            .format(label_shape, pred_shape))
+            .format(a, b))
+
+
+def _np(x):
+    return x.asnumpy() if isinstance(x, NDArray) else numpy.asarray(x)
 
 
 class EvalMetric:
-    """Base metric (reference metric.py:EvalMetric)."""
+    """Base metric: running average of ``sum_metric / num_inst``."""
 
-    def __init__(self, name, output_names=None, label_names=None, **kwargs):
+    def __init__(self, name, output_names=None, label_names=None,
+                 **kwargs):
         self.name = str(name)
         self.output_names = output_names
         self.label_names = label_names
@@ -49,87 +56,81 @@ class EvalMetric:
         return "EvalMetric: {}".format(dict(self.get_name_value()))
 
     def get_config(self):
-        """Serializable config (reference metric.py:get_config)."""
-        config = self._kwargs.copy()
-        config.update({
-            "metric": self.__class__.__name__,
-            "name": self.name,
-            "output_names": self.output_names,
-            "label_names": self.label_names})
-        return config
-
-    def update_dict(self, label, pred):
-        """Update from {name: array} dicts, filtered by
-        output_names/label_names (reference metric.py:update_dict)."""
-        if self.output_names is not None:
-            pred = [pred[name] for name in self.output_names]
-        else:
-            pred = list(pred.values())
-        if self.label_names is not None:
-            label = [label[name] for name in self.label_names]
-        else:
-            label = list(label.values())
-        self.update(label, pred)
-
-    def update(self, labels, preds):
-        raise NotImplementedError()
+        """Serializable config (class + ctor kwargs)."""
+        cfg = dict(self._kwargs,
+                   metric=self.__class__.__name__, name=self.name,
+                   output_names=self.output_names,
+                   label_names=self.label_names)
+        return cfg
 
     def reset(self):
         self.num_inst = 0
         self.sum_metric = 0.0
 
+    # -- feeding -------------------------------------------------------------
+    def update_dict(self, label, pred):
+        """Update from {name: array} dicts, selecting the configured
+        output/label names (all values when unset)."""
+        def pick(d, names):
+            return list(d.values()) if names is None \
+                else [d[n] for n in names]
+        self.update(pick(label, self.label_names),
+                    pick(pred, self.output_names))
+
+    def update(self, labels, preds):
+        check_label_shapes(labels, preds)
+        for label, pred in zip(labels, preds):
+            self._accumulate(_np(label), _np(pred))
+
+    def _accumulate(self, label, pred):
+        raise NotImplementedError()
+
+    # -- reading -------------------------------------------------------------
     def get(self):
-        """(name, value) — NaN when no updates."""
+        """(name, value); NaN before any update."""
         if self.num_inst == 0:
             return (self.name, float("nan"))
-        return (self.name, self.sum_metric / self.num_inst)
+        return (self.name, self._finalize(self.sum_metric /
+                                          self.num_inst))
+
+    def _finalize(self, ratio):
+        return ratio
 
     def get_name_value(self):
         name, value = self.get()
-        if not isinstance(name, list):
-            name = [name]
-        if not isinstance(value, list):
-            value = [value]
-        return list(zip(name, value))
+        names = name if isinstance(name, list) else [name]
+        values = value if isinstance(value, list) else [value]
+        return list(zip(names, values))
 
 
-# registry
+# -- registry ---------------------------------------------------------------
 register = _registry.get_register_func(EvalMetric, "metric")
 alias = _registry.get_alias_func(EvalMetric, "metric")
 _create = _registry.get_create_func(EvalMetric, "metric")
 
 
 def create(metric, *args, **kwargs):
-    """Create from name / callable / list (reference metric.py:create)."""
+    """Metric from a name, callable (feval), or list (composite)."""
     if callable(metric):
         return CustomMetric(metric, *args, **kwargs)
     if isinstance(metric, list):
-        composite_metric = CompositeEvalMetric()
-        for child_metric in metric:
-            composite_metric.add(create(child_metric, *args, **kwargs))
-        return composite_metric
+        out = CompositeEvalMetric()
+        for m in metric:
+            out.add(create(m, *args, **kwargs))
+        return out
     return _create(metric, *args, **kwargs)
-
-
-def _to_numpy(x):
-    if isinstance(x, NDArray):
-        return x.asnumpy()
-    return numpy.asarray(x)
 
 
 @register
 @alias("composite")
 class CompositeEvalMetric(EvalMetric):
-    """Manages multiple metrics (reference
-    metric.py:CompositeEvalMetric)."""
+    """Fans updates out to child metrics and concatenates results."""
 
     def __init__(self, metrics=None, name="composite", output_names=None,
                  label_names=None):
         super().__init__(name, output_names=output_names,
                          label_names=label_names)
-        if metrics is None:
-            metrics = []
-        self.metrics = [create(i) for i in metrics]
+        self.metrics = [create(m) for m in (metrics or [])]
 
     def add(self, metric):
         self.metrics.append(create(metric))
@@ -143,48 +144,42 @@ class CompositeEvalMetric(EvalMetric):
 
     def update_dict(self, labels, preds):
         if self.label_names is not None:
-            labels = {name: label for name, label in labels.items()
-                      if name in self.label_names}
+            labels = {k: v for k, v in labels.items()
+                      if k in self.label_names}
         if self.output_names is not None:
-            preds = {name: pred for name, pred in preds.items()
-                     if name in self.output_names}
-        for metric in self.metrics:
-            metric.update_dict(labels, preds)
+            preds = {k: v for k, v in preds.items()
+                     if k in self.output_names}
+        for m in self.metrics:
+            m.update_dict(labels, preds)
 
     def update(self, labels, preds):
-        for metric in self.metrics:
-            metric.update(labels, preds)
+        for m in self.metrics:
+            m.update(labels, preds)
 
     def reset(self):
-        try:
-            for metric in self.metrics:
-                metric.reset()
-        except AttributeError:
-            pass
+        for m in getattr(self, "metrics", []):
+            m.reset()
 
     def get(self):
-        names = []
-        values = []
-        for metric in self.metrics:
-            name, value = metric.get()
-            if isinstance(name, string_types):
-                name = [name]
-            if isinstance(value, numeric_types):
-                value = [value]
-            names.extend(name)
-            values.extend(value)
+        names, values = [], []
+        for m in self.metrics:
+            name, value = m.get()
+            names.extend([name] if isinstance(name, string_types)
+                         else name)
+            values.extend([value] if isinstance(value, numeric_types)
+                          else value)
         return (names, values)
 
     def get_config(self):
-        config = super().get_config()
-        config.update({"metrics": [i.get_config() for i in self.metrics]})
-        return config
+        cfg = super().get_config()
+        cfg["metrics"] = [m.get_config() for m in self.metrics]
+        return cfg
 
 
 @register
 @alias("acc")
 class Accuracy(EvalMetric):
-    """Classification accuracy (reference metric.py:Accuracy)."""
+    """Fraction of argmax predictions equal to the label."""
 
     def __init__(self, axis=1, name="accuracy", output_names=None,
                  label_names=None):
@@ -192,276 +187,198 @@ class Accuracy(EvalMetric):
                          label_names=label_names)
         self.axis = axis
 
-    def update(self, labels, preds):
-        check_label_shapes(labels, preds)
-        for label, pred_label in zip(labels, preds):
-            pred_label = _to_numpy(pred_label)
-            label = _to_numpy(label)
-            if pred_label.shape != label.shape:
-                pred_label = numpy.argmax(pred_label, axis=self.axis)
-            pred_label = pred_label.astype("int32").ravel()
-            label = label.astype("int32").ravel()
-            check_label_shapes(label, pred_label, shape=1)
-            self.sum_metric += (pred_label == label).sum()
-            self.num_inst += len(pred_label)
+    def _accumulate(self, label, pred):
+        if pred.shape != label.shape:
+            pred = numpy.argmax(pred, axis=self.axis)
+        pred = pred.astype("int32").ravel()
+        label = label.astype("int32").ravel()
+        check_label_shapes(label, pred, shape=1)
+        self.sum_metric += int((pred == label).sum())
+        self.num_inst += pred.size
 
 
 @register
 @alias("top_k_accuracy", "top_k_acc")
 class TopKAccuracy(EvalMetric):
-    """Top-k accuracy (reference metric.py:TopKAccuracy)."""
+    """Label contained in the k highest-scoring classes."""
 
     def __init__(self, top_k=1, name="top_k_accuracy", output_names=None,
                  label_names=None):
         super().__init__(name, top_k=top_k, output_names=output_names,
                          label_names=label_names)
+        assert top_k > 1, "Please use Accuracy if top_k is no more than 1"
         self.top_k = top_k
-        assert self.top_k > 1, "Please use Accuracy if top_k is no more " \
-            "than 1"
-        self.name += "_%d" % self.top_k
+        self.name += "_%d" % top_k
 
-    def update(self, labels, preds):
-        check_label_shapes(labels, preds)
-        for label, pred_label in zip(labels, preds):
-            pred_label = _to_numpy(pred_label)
-            label = _to_numpy(label)
-            assert len(pred_label.shape) <= 2, \
-                "Predictions should be no more than 2 dims"
-            pred_label = numpy.argsort(pred_label.astype("float32"), axis=1)
-            label = label.astype("int32")
-            check_label_shapes(label, pred_label)
-            num_samples = pred_label.shape[0]
-            num_dims = len(pred_label.shape)
-            if num_dims == 1:
-                self.sum_metric += (pred_label.ravel() == label.ravel()).sum()
-            elif num_dims == 2:
-                num_classes = pred_label.shape[1]
-                top_k = min(num_classes, self.top_k)
-                for j in range(top_k):
-                    self.sum_metric += (
-                        pred_label[:, num_classes - 1 - j].ravel() ==
-                        label.ravel()).sum()
-            self.num_inst += num_samples
+    def _accumulate(self, label, pred):
+        assert pred.ndim <= 2, "Predictions should be no more than 2 dims"
+        label = label.astype("int32").ravel()
+        if pred.ndim == 1:
+            self.sum_metric += int((pred.astype("int32") == label).sum())
+        else:
+            k = min(self.top_k, pred.shape[1])
+            # k highest columns per row (unordered — membership suffices)
+            top = numpy.argpartition(pred.astype("float32"),
+                                     -k, axis=1)[:, -k:]
+            self.sum_metric += int((top == label[:, None]).any(1).sum())
+        self.num_inst += pred.shape[0]
 
 
 @register
 class F1(EvalMetric):
-    """Binary F1 score (reference metric.py:F1)."""
+    """Binary F1, averaged per update batch (reference convention)."""
 
     def __init__(self, name="f1", output_names=None, label_names=None):
         super().__init__(name, output_names=output_names,
                          label_names=label_names)
 
-    def update(self, labels, preds):
-        check_label_shapes(labels, preds)
-        for label, pred in zip(labels, preds):
-            pred = _to_numpy(pred)
-            label = _to_numpy(label).astype("int32")
-            pred_label = numpy.argmax(pred, axis=1)
-            check_label_shapes(label, pred)
-            if len(numpy.unique(label)) > 2:
-                raise ValueError("F1 currently only supports binary "
-                                 "classification.")
-            true_positives, false_positives, false_negatives = 0., 0., 0.
-            for y_pred, y_true in zip(pred_label, label):
-                if y_pred == 1 and y_true == 1:
-                    true_positives += 1.
-                elif y_pred == 1 and y_true == 0:
-                    false_positives += 1.
-                elif y_pred == 0 and y_true == 1:
-                    false_negatives += 1.
-            if true_positives + false_positives > 0:
-                precision = true_positives / (
-                    true_positives + false_positives)
-            else:
-                precision = 0.
-            if true_positives + false_negatives > 0:
-                recall = true_positives / (
-                    true_positives + false_negatives)
-            else:
-                recall = 0.
-            if precision + recall > 0:
-                f1_score = 2 * precision * recall / (precision + recall)
-            else:
-                f1_score = 0.
-            self.sum_metric += f1_score
-            self.num_inst += 1
+    def _accumulate(self, label, pred):
+        label = label.astype("int32").ravel()
+        pred_label = numpy.argmax(pred, axis=1)
+        if numpy.unique(label).size > 2:
+            raise ValueError("F1 currently only supports binary "
+                             "classification.")
+        tp = int(((pred_label == 1) & (label == 1)).sum())
+        fp = int(((pred_label == 1) & (label == 0)).sum())
+        fn = int(((pred_label == 0) & (label == 1)).sum())
+        precision = tp / (tp + fp) if tp + fp else 0.0
+        recall = tp / (tp + fn) if tp + fn else 0.0
+        f1 = (2 * precision * recall / (precision + recall)
+              if precision + recall else 0.0)
+        self.sum_metric += f1
+        self.num_inst += 1
 
 
 @register
 class Perplexity(EvalMetric):
-    """Perplexity for language models (reference
-    metric.py:Perplexity)."""
+    """exp(mean NLL) with an optional ignored label id."""
 
     def __init__(self, ignore_label, axis=-1, name="perplexity",
                  output_names=None, label_names=None):
         super().__init__(name, ignore_label=ignore_label, axis=axis,
-                         output_names=output_names, label_names=label_names)
+                         output_names=output_names,
+                         label_names=label_names)
         self.ignore_label = ignore_label
         self.axis = axis
 
-    def update(self, labels, preds):
-        assert len(labels) == len(preds)
-        loss = 0.
-        num = 0
-        for label, pred in zip(labels, preds):
-            label = _to_numpy(label)
-            pred = _to_numpy(pred)
-            assert label.size == pred.size / pred.shape[-1], \
-                "shape mismatch: %s vs. %s" % (label.shape, pred.shape)
-            label = label.reshape((label.size,)).astype("int32")
-            probs = pred.reshape(-1, pred.shape[-1])[
-                numpy.arange(label.size), label]
-            if self.ignore_label is not None:
-                ignore = (label == self.ignore_label).astype(probs.dtype)
-                num -= int(numpy.sum(ignore))
-                probs = probs * (1 - ignore) + ignore
-            loss -= numpy.sum(numpy.log(numpy.maximum(1e-10, probs)))
-            num += label.size
-        self.sum_metric += loss
-        self.num_inst += num
+    def _accumulate(self, label, pred):
+        flat = label.ravel().astype("int32")
+        assert flat.size == pred.size // pred.shape[-1], \
+            "shape mismatch: %s vs. %s" % (label.shape, pred.shape)
+        probs = pred.reshape(-1, pred.shape[-1])[
+            numpy.arange(flat.size), flat]
+        count = flat.size
+        if self.ignore_label is not None:
+            keep = flat != self.ignore_label
+            count = int(keep.sum())
+            probs = numpy.where(keep, probs, 1.0)
+        self.sum_metric += float(
+            -numpy.log(numpy.maximum(probs, 1e-10)).sum())
+        self.num_inst += count
 
-    def get(self):
-        if self.num_inst == 0:
-            return (self.name, float("nan"))
-        return (self.name, math.exp(self.sum_metric / self.num_inst))
+    def _finalize(self, ratio):
+        return math.exp(ratio)
+
+
+class _Regression(EvalMetric):
+    """Shared base for element-wise regression errors (per-batch
+    mean accumulated, matching the reference)."""
+
+    def _accumulate(self, label, pred):
+        if label.ndim == 1:
+            label = label[:, None]
+        if pred.ndim == 1:
+            pred = pred[:, None]
+        self.sum_metric += float(self._score(label, pred))
+        self.num_inst += 1
 
 
 @register
-class MAE(EvalMetric):
-    """Mean absolute error (reference metric.py:MAE)."""
-
+class MAE(_Regression):
     def __init__(self, name="mae", output_names=None, label_names=None):
         super().__init__(name, output_names=output_names,
                          label_names=label_names)
 
-    def update(self, labels, preds):
-        check_label_shapes(labels, preds)
-        for label, pred in zip(labels, preds):
-            label = _to_numpy(label)
-            pred = _to_numpy(pred)
-            if len(label.shape) == 1:
-                label = label.reshape(label.shape[0], 1)
-            if len(pred.shape) == 1:
-                pred = pred.reshape(pred.shape[0], 1)
-            self.sum_metric += numpy.abs(label - pred).mean()
-            self.num_inst += 1
+    @staticmethod
+    def _score(label, pred):
+        return numpy.abs(label - pred).mean()
 
 
 @register
-class MSE(EvalMetric):
-    """Mean squared error (reference metric.py:MSE)."""
-
+class MSE(_Regression):
     def __init__(self, name="mse", output_names=None, label_names=None):
         super().__init__(name, output_names=output_names,
                          label_names=label_names)
 
-    def update(self, labels, preds):
-        check_label_shapes(labels, preds)
-        for label, pred in zip(labels, preds):
-            label = _to_numpy(label)
-            pred = _to_numpy(pred)
-            if len(label.shape) == 1:
-                label = label.reshape(label.shape[0], 1)
-            if len(pred.shape) == 1:
-                pred = pred.reshape(pred.shape[0], 1)
-            self.sum_metric += ((label - pred) ** 2.0).mean()
-            self.num_inst += 1
+    @staticmethod
+    def _score(label, pred):
+        return numpy.square(label - pred).mean()
 
 
 @register
-class RMSE(EvalMetric):
-    """Root mean squared error (reference metric.py:RMSE)."""
-
+class RMSE(_Regression):
     def __init__(self, name="rmse", output_names=None, label_names=None):
         super().__init__(name, output_names=output_names,
                          label_names=label_names)
 
-    def update(self, labels, preds):
-        check_label_shapes(labels, preds)
-        for label, pred in zip(labels, preds):
-            label = _to_numpy(label)
-            pred = _to_numpy(pred)
-            if len(label.shape) == 1:
-                label = label.reshape(label.shape[0], 1)
-            if len(pred.shape) == 1:
-                pred = pred.reshape(pred.shape[0], 1)
-            self.sum_metric += numpy.sqrt(((label - pred) ** 2.0).mean())
-            self.num_inst += 1
+    @staticmethod
+    def _score(label, pred):
+        return numpy.sqrt(numpy.square(label - pred).mean())
+
+
+class _PickedNLL(EvalMetric):
+    """Mean -log p(label) over class-probability rows."""
+
+    def __init__(self, eps, name, output_names, label_names):
+        super().__init__(name, eps=eps, output_names=output_names,
+                         label_names=label_names)
+        self.eps = eps
+
+    def _accumulate(self, label, pred):
+        flat = label.ravel().astype("int64")
+        assert flat.shape[0] == pred.shape[0]
+        picked = pred[numpy.arange(flat.shape[0]), flat]
+        self.sum_metric += float(-numpy.log(picked + self.eps).sum())
+        self.num_inst += flat.shape[0]
 
 
 @register
 @alias("ce")
-class CrossEntropy(EvalMetric):
-    """Cross entropy over class probabilities (reference
-    metric.py:CrossEntropy)."""
-
-    def __init__(self, eps=1e-12, name="cross-entropy", output_names=None,
-                 label_names=None):
-        super().__init__(name, eps=eps, output_names=output_names,
-                         label_names=label_names)
-        self.eps = eps
-
-    def update(self, labels, preds):
-        check_label_shapes(labels, preds)
-        for label, pred in zip(labels, preds):
-            label = _to_numpy(label)
-            pred = _to_numpy(pred)
-            label = label.ravel()
-            assert label.shape[0] == pred.shape[0]
-            prob = pred[numpy.arange(label.shape[0]), numpy.int64(label)]
-            self.sum_metric += (-numpy.log(prob + self.eps)).sum()
-            self.num_inst += label.shape[0]
+class CrossEntropy(_PickedNLL):
+    def __init__(self, eps=1e-12, name="cross-entropy",
+                 output_names=None, label_names=None):
+        super().__init__(eps, name, output_names, label_names)
 
 
 @register
 @alias("nll_loss")
-class NegativeLogLikelihood(EvalMetric):
-    """NLL (reference metric.py:NegativeLogLikelihood, later refs; same
-    computation as CrossEntropy with explicit naming)."""
-
+class NegativeLogLikelihood(_PickedNLL):
     def __init__(self, eps=1e-12, name="nll-loss", output_names=None,
                  label_names=None):
-        super().__init__(name, eps=eps, output_names=output_names,
-                         label_names=label_names)
-        self.eps = eps
-
-    def update(self, labels, preds):
-        check_label_shapes(labels, preds)
-        for label, pred in zip(labels, preds):
-            label = _to_numpy(label).ravel()
-            pred = _to_numpy(pred)
-            num_examples = pred.shape[0]
-            assert label.shape[0] == num_examples
-            prob = pred[numpy.arange(num_examples, dtype=numpy.int64),
-                        numpy.int64(label)]
-            self.sum_metric += (-numpy.log(prob + self.eps)).sum()
-            self.num_inst += num_examples
+        super().__init__(eps, name, output_names, label_names)
 
 
 @register
 @alias("pearsonr")
 class PearsonCorrelation(EvalMetric):
-    """Pearson correlation (reference metric.py:PearsonCorrelation)."""
+    """Per-batch Pearson r, averaged over updates."""
 
-    def __init__(self, name="pearsonr", output_names=None, label_names=None):
+    def __init__(self, name="pearsonr", output_names=None,
+                 label_names=None):
         super().__init__(name, output_names=output_names,
                          label_names=label_names)
 
-    def update(self, labels, preds):
-        check_label_shapes(labels, preds)
-        for label, pred in zip(labels, preds):
-            check_label_shapes(label, pred, 1)
-            label = _to_numpy(label)
-            pred = _to_numpy(pred)
-            self.sum_metric += numpy.corrcoef(pred.ravel(), label.ravel())[0, 1]
-            self.num_inst += 1
+    def _accumulate(self, label, pred):
+        check_label_shapes(label, pred, 1)
+        self.sum_metric += float(
+            numpy.corrcoef(pred.ravel(), label.ravel())[0, 1])
+        self.num_inst += 1
 
 
 @register
 class Loss(EvalMetric):
-    """Dummy metric for mean of per-batch loss outputs (reference
-    metric.py:Loss)."""
+    """Mean of loss-op outputs; ignores labels entirely (update is
+    overridden — no label/pred pairing)."""
 
     def __init__(self, name="loss", output_names=None, label_names=None):
         super().__init__(name, output_names=output_names,
@@ -471,14 +388,14 @@ class Loss(EvalMetric):
         if isinstance(preds, NDArray):
             preds = [preds]
         for pred in preds:
-            pred = _to_numpy(pred)
-            self.sum_metric += pred.sum()
-            self.num_inst += pred.size
+            arr = _np(pred)
+            self.sum_metric += float(arr.sum())
+            self.num_inst += arr.size
 
 
 @register
 class Torch(Loss):
-    """Dummy metric for torch criterions (reference metric.py:Torch)."""
+    """Loss under the torch-plugin name (reference metric.py:Torch)."""
 
     def __init__(self, name="torch", output_names=None, label_names=None):
         super().__init__(name, output_names=output_names,
@@ -487,7 +404,7 @@ class Torch(Loss):
 
 @register
 class Caffe(Loss):
-    """Dummy metric for caffe criterions (reference metric.py:Caffe)."""
+    """Loss under the caffe-plugin name (reference metric.py:Caffe)."""
 
     def __init__(self, name="caffe", output_names=None, label_names=None):
         super().__init__(name, output_names=output_names,
@@ -496,18 +413,18 @@ class Caffe(Loss):
 
 @register
 class CustomMetric(EvalMetric):
-    """Metric from a feval function (reference
-    metric.py:CustomMetric)."""
+    """Wraps feval(label, pred) -> value | (sum, count)."""
 
     def __init__(self, feval, name=None, allow_extra_outputs=False,
                  output_names=None, label_names=None):
         if name is None:
             name = feval.__name__
-            if name.find("<") != -1:
+            if "<" in name:
                 name = "custom(%s)" % name
         super().__init__(name, feval=feval,
                          allow_extra_outputs=allow_extra_outputs,
-                         output_names=output_names, label_names=label_names)
+                         output_names=output_names,
+                         label_names=label_names)
         self._feval = feval
         self._allow_extra_outputs = allow_extra_outputs
 
@@ -515,16 +432,13 @@ class CustomMetric(EvalMetric):
         if not self._allow_extra_outputs:
             check_label_shapes(labels, preds)
         for pred, label in zip(preds, labels):
-            label = _to_numpy(label)
-            pred = _to_numpy(pred)
-            reval = self._feval(label, pred)
-            if isinstance(reval, tuple):
-                (sum_metric, num_inst) = reval
-                self.sum_metric += sum_metric
-                self.num_inst += num_inst
+            res = self._feval(_np(label), _np(pred))
+            if isinstance(res, tuple):
+                part, count = res
             else:
-                self.sum_metric += reval
-                self.num_inst += 1
+                part, count = res, 1
+            self.sum_metric += part
+            self.num_inst += count
 
     def get_config(self):
         raise NotImplementedError("CustomMetric cannot be serialized")
@@ -532,7 +446,7 @@ class CustomMetric(EvalMetric):
 
 # pylint: disable=invalid-name
 def np(numpy_feval, name=None, allow_extra_outputs=False):
-    """Wrap a numpy feval as a metric (reference metric.py:np)."""
+    """Metric from a bare numpy function (reference metric.py:np)."""
     def feval(label, pred):
         return numpy_feval(label, pred)
     feval.__name__ = numpy_feval.__name__

@@ -61,6 +61,9 @@ def get_symbol(vocab_size, seq_len, num_layers=2, num_heads=4, dim=128,
     ffn_hidden = ffn_hidden or 4 * dim
     max_len = max_len or seq_len
     assert max_len >= seq_len
+    if dim % num_heads:
+        raise ValueError("dim (%d) must be divisible by num_heads (%d)"
+                         % (dim, num_heads))
     data = sym.Variable("data")
     label = sym.Variable("softmax_label")
 

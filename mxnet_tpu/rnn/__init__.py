@@ -4,3 +4,5 @@ from .rnn_cell import (BaseRNNCell, RNNParams, RNNCell, LSTMCell, GRUCell,
                        FusedRNNCell, SequentialRNNCell, BidirectionalCell,
                        DropoutCell, ModifierCell, ZoneoutCell, ResidualCell)
 from .io import BucketSentenceIter, encode_sentences
+from .rnn import (save_rnn_checkpoint, load_rnn_checkpoint,
+                  do_rnn_checkpoint, rnn_unroll)
