@@ -4,9 +4,17 @@ Schedulers are host-side Python (called per `Optimizer.update` with the
 global update count); keeping them out of the compiled step fn means lr
 changes never trigger recompilation — lr enters jitted updates as a traced
 scalar operand.
+
+Unlike the reference (which walks a mutable counter forward on every call),
+these compute the lr in closed form from ``num_update`` alone.  That makes
+them safe to pickle mid-run, safe to query out of order (e.g. when resuming
+from a checkpoint at an arbitrary update count), and trivially correct
+under the data-parallel trainer where several workers replay the schedule
+independently.
 """
 from __future__ import annotations
 
+import bisect
 import logging
 import math
 
@@ -23,68 +31,57 @@ class LRScheduler:
     def __call__(self, num_update):
         raise NotImplementedError("must override this")
 
+    def _announce(self, num_update, lr):
+        # log once per distinct lr value, mirroring the reference's
+        # step-transition messages without replaying its counter walk
+        if getattr(self, "_last_logged", None) != lr:
+            self._last_logged = lr
+            logging.info("lr schedule: update %d -> %.5e", num_update, lr)
+
 
 class FactorScheduler(LRScheduler):
-    """lr *= factor every `step` updates
-    (reference lr_scheduler.py:FactorScheduler)."""
+    """lr = base_lr * factor^k, k = completed `step`-sized intervals,
+    floored at stop_factor_lr (reference lr_scheduler.py:FactorScheduler)."""
 
     def __init__(self, step, factor=1, stop_factor_lr=1e-8):
         super().__init__()
         if step < 1:
-            raise ValueError("Schedule step must be greater or equal than 1")
+            raise ValueError("step must be >= 1")
         if factor > 1.0:
-            raise ValueError("Factor must be no more than 1 to make lr reduce")
+            raise ValueError("factor must be <= 1 so the lr decays")
         self.step = step
         self.factor = factor
         self.stop_factor_lr = stop_factor_lr
-        self.count = 0
 
     def __call__(self, num_update):
-        while num_update > self.count + self.step:
-            self.count += self.step
-            self.base_lr *= self.factor
-            if self.base_lr < self.stop_factor_lr:
-                self.base_lr = self.stop_factor_lr
-                logging.info("Update[%d]: now learning rate arrived at %0.5e,"
-                             " will not change in the future", num_update,
-                             self.base_lr)
-            else:
-                logging.info("Update[%d]: Change learning rate to %0.5e",
-                             num_update, self.base_lr)
-        return self.base_lr
+        k = max(0, num_update - 1) // self.step
+        lr = max(self.base_lr * self.factor ** k, self.stop_factor_lr)
+        self._announce(num_update, lr)
+        return lr
 
 
 class MultiFactorScheduler(LRScheduler):
-    """lr *= factor at each listed step
+    """lr decays by `factor` at each boundary in the sorted `step` list
     (reference lr_scheduler.py:MultiFactorScheduler)."""
 
     def __init__(self, step, factor=1):
         super().__init__()
-        assert isinstance(step, list) and len(step) >= 1
-        for i, _step in enumerate(step):
-            if i != 0 and step[i] <= step[i - 1]:
-                raise ValueError("Schedule step must be an increasing list")
-            if _step < 1:
-                raise ValueError("Schedule step must be greater or equal "
-                                 "than 1")
+        if not isinstance(step, list) or not step:
+            raise ValueError("step must be a non-empty list of ints")
+        if any(s < 1 for s in step) or any(
+                b <= a for a, b in zip(step, step[1:])):
+            raise ValueError("step must be an increasing list of ints >= 1")
         if factor > 1.0:
-            raise ValueError("Factor must be no more than 1 to make lr reduce")
+            raise ValueError("factor must be <= 1 so the lr decays")
         self.step = step
-        self.cur_step_ind = 0
         self.factor = factor
-        self.count = 0
 
     def __call__(self, num_update):
-        while self.cur_step_ind <= len(self.step) - 1:
-            if num_update > self.step[self.cur_step_ind]:
-                self.count = self.step[self.cur_step_ind]
-                self.cur_step_ind += 1
-                self.base_lr *= self.factor
-                logging.info("Update[%d]: Change learning rate to %0.5e",
-                             num_update, self.base_lr)
-            else:
-                return self.base_lr
-        return self.base_lr
+        # boundaries crossed = how many entries are < num_update
+        k = bisect.bisect_left(self.step, num_update)
+        lr = self.base_lr * self.factor ** k
+        self._announce(num_update, lr)
+        return lr
 
 
 class PolyScheduler(LRScheduler):
@@ -93,28 +90,23 @@ class PolyScheduler(LRScheduler):
 
     def __init__(self, max_update, base_lr=0.01, pwr=2):
         super().__init__(base_lr)
-        assert isinstance(max_update, int)
-        if max_update < 1:
-            raise ValueError("maximum number of updates must be strictly "
-                             "positive")
-        self.base_lr_orig = self.base_lr
-        self.max_update = max_update
+        if int(max_update) < 1:
+            raise ValueError("max_update must be >= 1")
+        self.max_update = int(max_update)
         self.power = pwr
 
     def __call__(self, num_update):
-        if num_update <= self.max_update:
-            self.base_lr = self.base_lr_orig * pow(
-                1.0 - float(num_update) / float(self.max_update), self.power)
-        return self.base_lr
+        frac = min(float(num_update), self.max_update) / self.max_update
+        return self.base_lr * (1.0 - frac) ** self.power
 
 
 class CosineScheduler(LRScheduler):
-    """Cosine decay (TPU-era default for vision recipes; extension)."""
+    """Linear warmup then cosine decay (TPU-era default for vision
+    recipes; extension beyond the reference's catalog)."""
 
     def __init__(self, max_update, base_lr=0.01, final_lr=0.0,
                  warmup_steps=0, warmup_begin_lr=0.0):
         super().__init__(base_lr)
-        self.base_lr_orig = base_lr
         self.max_update = max_update
         self.final_lr = final_lr
         self.warmup_steps = warmup_steps
@@ -122,13 +114,10 @@ class CosineScheduler(LRScheduler):
 
     def __call__(self, num_update):
         if num_update < self.warmup_steps:
-            return self.warmup_begin_lr + (
-                self.base_lr_orig - self.warmup_begin_lr) * \
-                num_update / max(1, self.warmup_steps)
-        t = min(num_update - self.warmup_steps,
-                self.max_update - self.warmup_steps)
+            frac = num_update / max(1, self.warmup_steps)
+            return self.warmup_begin_lr + frac * (
+                self.base_lr - self.warmup_begin_lr)
         span = max(1, self.max_update - self.warmup_steps)
-        self.base_lr = self.final_lr + (
-            self.base_lr_orig - self.final_lr) * \
-            (1 + math.cos(math.pi * t / span)) / 2
-        return self.base_lr
+        t = min(num_update - self.warmup_steps, span)
+        cos = 0.5 * (1.0 + math.cos(math.pi * t / span))
+        return self.final_lr + cos * (self.base_lr - self.final_lr)
