@@ -37,10 +37,29 @@ class Initializer:
     """Base initializer (reference initializer.py:Initializer).
 
     Subclasses implement ``_init_weight``; dispatch by name pattern mirrors
-    the reference's ``__call__``."""
+    the reference's ``__call__``. Constructor kwargs are recorded for
+    ``dumps()`` and auto-assigned as attributes."""
+
+    # parameter-name suffix -> fill method; checked in order, first match
+    # wins (reference dispatches the same suffixes in its __call__)
+    _SUFFIX_FILLS = (
+        ("weight", "_init_weight"),
+        ("bias", "_init_bias"),
+        ("gamma", "_init_gamma"),
+        ("beta", "_init_beta"),
+        ("min", "_init_zero"),
+        ("max", "_init_one"),
+        ("moving_mean", "_init_zero"),
+        ("running_mean", "_init_zero"),
+        ("moving_var", "_init_one"),
+        ("running_var", "_init_one"),
+        ("moving_inv_var", "_init_zero"),
+        ("moving_avg", "_init_zero"),
+    )
 
     def __init__(self, **kwargs):
         self._kwargs = kwargs
+        self.__dict__.update(kwargs)
         self._verbose = False
         self._print_func = None
 
@@ -77,29 +96,13 @@ class Initializer:
             return
 
         name = desc.lower()
-        if name.endswith("weight"):
-            self._init_weight(desc, arr)
-            self._verbose_print(desc, "weight", arr)
-        elif name.endswith("bias"):
-            self._init_bias(desc, arr)
-        elif name.endswith("gamma"):
-            self._init_gamma(desc, arr)
-        elif name.endswith("beta"):
-            self._init_beta(desc, arr)
-        elif name.endswith("min"):
-            self._init_zero(desc, arr)
-        elif name.endswith("max"):
-            self._init_one(desc, arr)
-        elif name.endswith("moving_mean") or name.endswith("running_mean"):
-            self._init_zero(desc, arr)
-        elif name.endswith("moving_var") or name.endswith("running_var"):
-            self._init_one(desc, arr)
-        elif name.endswith("moving_inv_var"):
-            self._init_zero(desc, arr)
-        elif name.endswith("moving_avg"):
-            self._init_zero(desc, arr)
-        else:
-            self._init_default(desc, arr)
+        for suffix, meth in self._SUFFIX_FILLS:
+            if name.endswith(suffix):
+                getattr(self, meth)(desc, arr)
+                if suffix == "weight":
+                    self._verbose_print(desc, "weight", arr)
+                return
+        self._init_default(desc, arr)
 
     # -- fill helpers (each mutates the NDArray in place) -------------------
     @staticmethod
@@ -167,7 +170,6 @@ class One(Initializer):
 class Constant(Initializer):
     def __init__(self, value=0.0):
         super().__init__(value=value)
-        self.value = value
 
     def _init_weight(self, _, arr):
         self._set(arr, np.full(arr.shape, self.value, np.float32))
@@ -178,7 +180,6 @@ class Uniform(Initializer):
     """U(-scale, scale) — reference initializer.py:Uniform."""
     def __init__(self, scale=0.07):
         super().__init__(scale=scale)
-        self.scale = scale
 
     def _init_weight(self, _, arr):
         self._set(arr, _rand(arr.shape, lambda r, lo, hi, s:
@@ -190,7 +191,6 @@ class Normal(Initializer):
     """N(0, sigma) — reference initializer.py:Normal."""
     def __init__(self, sigma=0.01):
         super().__init__(sigma=sigma)
-        self.sigma = sigma
 
     def _init_weight(self, _, arr):
         self._set(arr, _rand(arr.shape,
@@ -204,8 +204,6 @@ class Orthogonal(Initializer):
     Saxe et al. / Exact solutions to nonlinear dynamics)."""
     def __init__(self, scale=1.414, rand_type="uniform"):
         super().__init__(scale=scale, rand_type=rand_type)
-        self.scale = scale
-        self.rand_type = rand_type
 
     def _init_weight(self, _, arr):
         nout = arr.shape[0]
@@ -223,32 +221,28 @@ class Orthogonal(Initializer):
 @register
 class Xavier(Initializer):
     """Xavier/Glorot (reference initializer.py:Xavier)."""
+
+    _FACTORS = {"avg": lambda fi, fo: (fi + fo) / 2.0,
+                "in": lambda fi, fo: fi,
+                "out": lambda fi, fo: fo}
+
     def __init__(self, rnd_type="uniform", factor_type="avg", magnitude=3):
         super().__init__(rnd_type=rnd_type, factor_type=factor_type,
-                         magnitude=magnitude)
-        self.rnd_type = rnd_type
-        self.factor_type = factor_type
-        self.magnitude = float(magnitude)
+                         magnitude=float(magnitude))
 
     def _init_weight(self, name, arr):
         shape = arr.shape
-        hw_scale = 1.0
         if len(shape) < 2:
             raise ValueError(
-                "Xavier initializer cannot be applied to vector %s. It "
-                "requires at least 2D." % name)
-        if len(shape) > 2:
-            hw_scale = np.prod(shape[2:])
-        fan_in, fan_out = shape[1] * hw_scale, shape[0] * hw_scale
-        factor = 1.0
-        if self.factor_type == "avg":
-            factor = (fan_in + fan_out) / 2.0
-        elif self.factor_type == "in":
-            factor = fan_in
-        elif self.factor_type == "out":
-            factor = fan_out
-        else:
-            raise ValueError("Incorrect factor type")
+                "Xavier needs a >=2D parameter, got %s for %s"
+                % (shape, name))
+        # fan counts over the receptive field for conv-style kernels
+        rfield = np.prod(shape[2:]) if len(shape) > 2 else 1.0
+        try:
+            factor = self._FACTORS[self.factor_type](shape[1] * rfield,
+                                                     shape[0] * rfield)
+        except KeyError:
+            raise ValueError("factor_type must be avg/in/out")
         scale = np.sqrt(self.magnitude / factor)
         rng = _random.numpy_rng()
         if self.rnd_type == "uniform":
@@ -256,7 +250,7 @@ class Xavier(Initializer):
         elif self.rnd_type == "gaussian":
             self._set(arr, rng.normal(0, scale, shape))
         else:
-            raise ValueError("Unknown random type")
+            raise ValueError("rnd_type must be uniform/gaussian")
 
 
 @register
@@ -305,36 +299,33 @@ class Load:
     """Init from a dict of arrays, falling back to ``default_init``
     (reference initializer.py:Load)."""
     def __init__(self, param, default_init=None, verbose=False):
-        self.param = {}
-        for name, arr in param.items():
-            if name.startswith("arg:") or name.startswith("aux:"):
-                name = name[4:]
-            self.param[name] = arr
+        # strip the nd.save "arg:"/"aux:" prefixes
+        self.param = {k.split(":", 1)[-1] if k[:4] in ("arg:", "aux:")
+                      else k: v for k, v in param.items()}
         self.default_init = default_init
         self.verbose = verbose
 
+    def _log(self, name, how):
+        if self.verbose:
+            import logging
+            logging.info("param %s initialized %s", name, how)
+
     def __call__(self, name, arr):
-        if name in self.param:
-            src = self.param[name]
-            src_shape = tuple(src.shape)
-            if tuple(arr.shape) != src_shape:
+        src = self.param.get(name)
+        if src is not None:
+            if tuple(arr.shape) != tuple(src.shape):
                 raise ValueError(
-                    "Parameter %s cannot be initialized from loading. "
-                    "Shape mismatch, target %s vs loaded %s"
-                    % (name, tuple(arr.shape), src_shape))
+                    "loaded shape %s does not match parameter %s shape %s"
+                    % (tuple(src.shape), name, tuple(arr.shape)))
             arr[:] = src
-            if self.verbose:
-                import logging
-                logging.info("Initialized %s by loading", name)
-        else:
-            if self.default_init is None:
-                raise ValueError(
-                    "Cannot Initialize parameter %s. Not found in loaded "
-                    "param and no default initializer provided." % name)
+            self._log(name, "from loaded params")
+        elif self.default_init is not None:
             self.default_init(name, arr)
-            if self.verbose:
-                import logging
-                logging.info("Initialized %s by default", name)
+            self._log(name, "by fallback initializer")
+        else:
+            raise ValueError(
+                "%s absent from loaded params and no default_init given"
+                % name)
 
 
 class Mixed:
@@ -342,17 +333,17 @@ class Mixed:
     initializer.py:Mixed)."""
     def __init__(self, patterns, initializers):
         if len(patterns) != len(initializers):
-            raise ValueError("patterns and initializers must match in length")
-        self.map = list(zip([re.compile(p) for p in patterns], initializers))
+            raise ValueError("need one initializer per pattern")
+        self.map = [(re.compile(p), init)
+                    for p, init in zip(patterns, initializers)]
 
     def __call__(self, name, arr):
-        for prog, init in self.map:
-            if prog.match(name):
-                init(name, arr)
-                return
-        raise ValueError(
-            "Parameter name %s did not match any pattern. Consider adding a "
-            '".*" pattern at the end with default Initializer.' % name)
+        init = next((i for prog, i in self.map if prog.match(name)), None)
+        if init is None:
+            raise ValueError(
+                'no pattern matched parameter %s (add a catch-all ".*" '
+                "pattern with a default initializer)" % name)
+        init(name, arr)
 
 
 @register

@@ -10,6 +10,7 @@ analogue of the reference's dmlc::ThreadedIter double-buffering
 from __future__ import annotations
 
 import os
+import queue
 import threading
 from collections import namedtuple
 
@@ -58,12 +59,10 @@ class DataBatch:
 
     def __init__(self, data, label=None, pad=None, index=None,
                  bucket_key=None, provide_data=None, provide_label=None):
-        if data is not None:
-            assert isinstance(data, (list, tuple)), \
-                "Data must be list of NDArrays"
-        if label is not None:
-            assert isinstance(label, (list, tuple)), \
-                "Label must be list of NDArrays"
+        for field, v in (("data", data), ("label", label)):
+            if v is not None and not isinstance(v, (list, tuple)):
+                raise TypeError("%s must be a list/tuple of NDArrays"
+                                % field)
         self.data = data
         self.label = label
         self.pad = pad
@@ -73,10 +72,9 @@ class DataBatch:
         self.provide_label = provide_label
 
     def __str__(self):
-        data_shapes = [d.shape for d in self.data]
-        label_shapes = [l.shape for l in self.label] if self.label else None
-        return "{}: data shapes: {} label shapes: {}".format(
-            self.__class__.__name__, data_shapes, label_shapes)
+        return "%s: data %s label %s" % (
+            self.__class__.__name__, [d.shape for d in self.data],
+            [l.shape for l in self.label] if self.label else None)
 
 
 class DataIter:
@@ -118,7 +116,31 @@ class DataIter:
         raise NotImplementedError()
 
 
-class ResizeIter(DataIter):
+class _BatchDelegate:
+    """Mixin for wrapper iterators whose getdata/getlabel/... just expose
+    fields of the wrapped iterator's last batch."""
+
+    current_batch = None
+
+    def next(self):
+        if self.iter_next():
+            return self.current_batch
+        raise StopIteration
+
+    def getdata(self):
+        return self.current_batch.data
+
+    def getlabel(self):
+        return self.current_batch.label
+
+    def getindex(self):
+        return self.current_batch.index
+
+    def getpad(self):
+        return self.current_batch.pad
+
+
+class ResizeIter(_BatchDelegate, DataIter):
     """Resize an iterator to `size` batches per epoch, optionally resetting
     the inner iterator on underflow (reference io.py:ResizeIter)."""
 
@@ -128,7 +150,6 @@ class ResizeIter(DataIter):
         self.size = size
         self.reset_internal = reset_internal
         self.cur = 0
-        self.current_batch = None
         self.provide_data = data_iter.provide_data
         self.provide_label = data_iter.provide_label
         self.batch_size = data_iter.batch_size
@@ -146,179 +167,149 @@ class ResizeIter(DataIter):
         try:
             self.current_batch = self.data_iter.next()
         except StopIteration:
+            # epoch underflow: restart the inner iterator mid-"epoch"
             self.data_iter.reset()
             self.current_batch = self.data_iter.next()
         self.cur += 1
         return True
 
-    def next(self):
-        if self.iter_next():
-            return self.current_batch
-        raise StopIteration
 
-    def getdata(self):
-        return self.current_batch.data
+class _PrefetchWorker(threading.Thread):
+    """One background thread per wrapped iterator: serves 'next'/'reset'
+    commands so batch assembly overlaps device compute."""
 
-    def getlabel(self):
-        return self.current_batch.label
+    def __init__(self, it):
+        super().__init__(daemon=True)
+        self.it = it
+        self.cmds = queue.Queue()
+        self.outs = queue.Queue()
+        self.start()
 
-    def getindex(self):
-        return self.current_batch.index
+    def run(self):
+        while True:
+            cmd = self.cmds.get()
+            if cmd == "stop":
+                return
+            if cmd == "reset":
+                self.it.reset()
+                self.outs.put(None)
+            else:  # "next"
+                try:
+                    self.outs.put(self.it.next())
+                except StopIteration:
+                    self.outs.put(StopIteration)
 
-    def getpad(self):
-        return self.current_batch.pad
 
-
-class PrefetchingIter(DataIter):
+class PrefetchingIter(_BatchDelegate, DataIter):
     """Thread-backed prefetcher over one or more iterators (reference
-    io.py:PrefetchingIter; C++ analogue iter_prefetcher.h). Overlaps host
-    batch assembly with device compute."""
+    io.py:PrefetchingIter; C++ analogue iter_prefetcher.h). One worker
+    thread per inner iterator; a 'next' command is always in flight so
+    the next batch is being assembled while the device computes."""
 
     def __init__(self, iters, rename_data=None, rename_label=None):
         super().__init__()
-        if not isinstance(iters, list):
-            iters = [iters]
-        self.n_iter = len(iters)
-        assert self.n_iter > 0
-        self.iters = iters
+        self.iters = iters if isinstance(iters, list) else [iters]
+        if not self.iters:
+            raise ValueError("need at least one iterator")
         self.rename_data = rename_data
         self.rename_label = rename_label
         self.batch_size = self.provide_data[0][1][0]
-        self.data_ready = [threading.Event() for _ in range(self.n_iter)]
-        self.data_taken = [threading.Event() for _ in range(self.n_iter)]
-        for e in self.data_taken:
-            e.set()
-        self.started = True
-        self.current_batch = [None for _ in range(self.n_iter)]
-        self.next_batch = [None for _ in range(self.n_iter)]
+        self._workers = [_PrefetchWorker(it) for it in self.iters]
+        self._inflight = False
+        self._request()
 
-        def prefetch_func(self, i):
-            while True:
-                self.data_taken[i].wait()
-                if not self.started:
-                    break
-                try:
-                    self.next_batch[i] = self.iters[i].next()
-                except StopIteration:
-                    self.next_batch[i] = None
-                self.data_taken[i].clear()
-                self.data_ready[i].set()
+    def _request(self):
+        for w in self._workers:
+            w.cmds.put("next")
+        self._inflight = True
 
-        self.prefetch_threads = [
-            threading.Thread(target=prefetch_func, args=[self, i],
-                             daemon=True)
-            for i in range(self.n_iter)]
-        for thread in self.prefetch_threads:
-            thread.start()
+    def _collect(self):
+        self._inflight = False
+        return [w.outs.get() for w in self._workers]
 
     def __del__(self):
-        self.started = False
-        for e in self.data_taken:
-            e.set()
-        for thread in self.prefetch_threads:
-            thread.join(timeout=1.0)
+        for w in getattr(self, "_workers", []):
+            w.cmds.put("stop")
+
+    def _renamed(self, which, renames):
+        descs_per_iter = [getattr(it, which) for it in self.iters]
+        if renames is None:
+            return [d for descs in descs_per_iter for d in descs]
+        out = []
+        for mapping, descs in zip(renames, descs_per_iter):
+            for d in descs:
+                d = d if isinstance(d, DataDesc) else DataDesc(*d)
+                out.append(DataDesc(mapping[d.name], d.shape, d.dtype))
+        return out
 
     @property
     def provide_data(self):
-        if self.rename_data is None:
-            return sum([i.provide_data for i in self.iters], [])
-        return sum([[
-            DataDesc(r[x.name], x.shape, x.dtype)
-            if isinstance(x, DataDesc) else DataDesc(*x)
-            for x in i.provide_data
-        ] for r, i in zip(self.rename_data, self.iters)], [])
+        return self._renamed("provide_data", self.rename_data)
 
     @property
     def provide_label(self):
-        if self.rename_label is None:
-            return sum([i.provide_label for i in self.iters], [])
-        return sum([[
-            DataDesc(r[x.name], x.shape, x.dtype)
-            if isinstance(x, DataDesc) else DataDesc(*x)
-            for x in i.provide_label
-        ] for r, i in zip(self.rename_label, self.iters)], [])
+        return self._renamed("provide_label", self.rename_label)
 
     def reset(self):
-        for e in self.data_ready:
-            e.wait()
-        for i in self.iters:
-            i.reset()
-        for e in self.data_ready:
-            e.clear()
-        for e in self.data_taken:
-            e.set()
+        if self._inflight:
+            self._collect()     # drain the outstanding 'next'
+        for w in self._workers:
+            w.cmds.put("reset")
+        for w in self._workers:
+            w.outs.get()
+        self._request()
 
     def iter_next(self):
-        for e in self.data_ready:
-            e.wait()
-        if self.next_batch[0] is None:
-            for i in self.next_batch:
-                assert i is None, "Number of entry mismatches between iterators"
+        if not self._inflight:
+            self._request()
+        batches = self._collect()
+        ended = [b is StopIteration for b in batches]
+        if any(ended):
+            if not all(ended):
+                raise RuntimeError("inner iterators ended at different "
+                                   "batch counts")
             return False
-        for batch in self.next_batch:
-            assert batch.pad == self.next_batch[0].pad, \
-                "Number of entry mismatches between iterators"
+        if len({b.pad for b in batches}) != 1:
+            raise RuntimeError("inner iterators disagree on pad")
         self.current_batch = DataBatch(
-            sum([batch.data for batch in self.next_batch], []),
-            sum([batch.label for batch in self.next_batch], [])
-            if self.next_batch[0].label is not None else None,
-            self.next_batch[0].pad,
-            self.next_batch[0].index,
+            [d for b in batches for d in b.data],
+            [l for b in batches for l in b.label]
+            if batches[0].label is not None else None,
+            batches[0].pad, batches[0].index,
             provide_data=self.provide_data,
             provide_label=self.provide_label)
-        for e in self.data_ready:
-            e.clear()
-        for e in self.data_taken:
-            e.set()
+        self._request()          # keep the pipeline primed
         return True
-
-    def next(self):
-        if self.iter_next():
-            return self.current_batch
-        raise StopIteration
-
-    def getdata(self):
-        return self.current_batch.data
-
-    def getlabel(self):
-        return self.current_batch.label
-
-    def getindex(self):
-        return self.current_batch.index
-
-    def getpad(self):
-        return self.current_batch.pad
 
 
 def _init_data(data, allow_empty, default_name):
-    """Normalize input data to list of (name, numpy) (reference
-    io.py:_init_data)."""
-    assert data is not None or allow_empty
+    """Normalize data input (array | list | dict | None) into a sorted
+    [(name, NDArray)] list (reference io.py:_init_data)."""
     if data is None:
-        data = []
-    if isinstance(data, (np.ndarray, NDArray)):
-        data = [data]
-    if isinstance(data, list):
-        if not allow_empty:
-            assert len(data) > 0
+        data = {}
+    elif isinstance(data, (np.ndarray, NDArray)):
+        data = {default_name: data}
+    elif isinstance(data, list):
         if len(data) == 1:
             data = {default_name: data[0]}
         else:
             data = {"_%d_%s" % (i, default_name): d
                     for i, d in enumerate(data)}
     if not isinstance(data, dict):
-        raise TypeError(
-            "Input must be NDArray, numpy.ndarray, a list of them or dict "
-            "with them as values")
-    for k, v in data.items():
-        if not isinstance(v, NDArray):
-            try:
-                data[k] = array(np.asarray(v))
-            except Exception:
-                raise TypeError(
-                    "Invalid type '%s' for %s, should be NDArray or "
-                    "numpy.ndarray" % (type(v), k))
-    return list(sorted(data.items()))
+        raise TypeError("data must be an array, a list of arrays, or a "
+                        "dict of name->array, got %s" % type(data))
+    if not data and not allow_empty:
+        raise ValueError("empty %s input" % default_name)
+
+    def as_nd(name, v):
+        if isinstance(v, NDArray):
+            return v
+        try:
+            return array(np.asarray(v))
+        except Exception:
+            raise TypeError("cannot convert %s (%s) to NDArray"
+                            % (name, type(v)))
+    return sorted((k, as_nd(k, v)) for k, v in data.items())
 
 
 class NDArrayIter(DataIter):
@@ -334,28 +325,29 @@ class NDArrayIter(DataIter):
                                default_name=data_name)
         self.label = _init_data(label, allow_empty=True,
                                 default_name=label_name)
+        n = self.data[0][1].shape[0]
 
-        # shuffle (host-side, one permutation per construction)
+        def remap(pairs, idx):
+            return [(k, array(v.asnumpy()[idx])) for k, v in pairs]
+
         if shuffle:
-            idx = np.arange(self.data[0][1].shape[0])
-            np.random.shuffle(idx)
-            self.data = [(k, array(v.asnumpy()[idx])) for k, v in self.data]
-            self.label = [(k, array(v.asnumpy()[idx])) for k, v in self.label]
-
+            # host-side: one permutation per construction, shared by
+            # every data/label source
+            perm = np.random.permutation(n)
+            self.data, self.label = remap(self.data, perm), \
+                remap(self.label, perm)
         if last_batch_handle == "discard":
-            new_n = self.data[0][1].shape[0] - \
-                self.data[0][1].shape[0] % batch_size
-            data_dict = dict(self.data)
-            label_dict = dict(self.label)
-            self.data = [(k, data_dict[k][:new_n]) for k, _ in self.data]
-            self.label = [(k, label_dict[k][:new_n]) for k, _ in self.label]
+            # cheap device-side slice; no host round-trip
+            keep = n - n % batch_size
+            self.data = [(k, v[:keep]) for k, v in self.data]
+            self.label = [(k, v[:keep]) for k, v in self.label]
 
-        self.data_list = [x[1] for x in self.data] + \
-            [x[1] for x in self.label]
+        self.data_list = [v for _, v in self.data + self.label]
         self.num_source = len(self.data_list)
         self.num_data = self.data_list[0].shape[0]
-        assert self.num_data >= batch_size, \
-            "batch_size needs to be smaller than data size."
+        if self.num_data < batch_size:
+            raise ValueError("batch_size %d exceeds data size %d"
+                             % (batch_size, self.num_data))
         self.cursor = -batch_size
         self.batch_size = batch_size
         self.last_batch_handle = last_batch_handle
@@ -398,15 +390,16 @@ class NDArrayIter(DataIter):
         raise StopIteration
 
     def _getdata(self, data_source):
-        assert self.cursor < self.num_data, "DataIter needs reset."
+        if self.cursor >= self.num_data:
+            raise RuntimeError("iterator exhausted; call reset()")
         if self.cursor + self.batch_size <= self.num_data:
-            return [x[1][self.cursor:self.cursor + self.batch_size]
-                    for x in data_source]
-        # padded last batch: wrap around
-        pad = self.batch_size - self.num_data + self.cursor
-        return [ndarray.concatenate([x[1][self.cursor:],
-                                     x[1][:pad]])
-                for x in data_source]
+            window = slice(self.cursor, self.cursor + self.batch_size)
+            return [v[window] for _, v in data_source]
+        # padded last batch wraps to the epoch start: stitch the epoch
+        # tail to a head slice (device-side; no full-array host gather)
+        pad = self.cursor + self.batch_size - self.num_data
+        return [ndarray.concatenate([v[self.cursor:], v[:pad]])
+                for _, v in data_source]
 
     def getdata(self):
         return self._getdata(self.data)

@@ -28,72 +28,70 @@ BatchEndParam = namedtuple("BatchEndParams",
                            ["epoch", "nbatch", "eval_metric", "locals"])
 
 
+# params bigger than this make server-side ("on-kvstore") updates a
+# bandwidth loss for local training — fall back to worker-side updates
+_BIG_PARAM_ELEMS = 16 * 1024 * 1024
+
+
 def _create_kvstore(kvstore, num_device, arg_params):
     """Create kvstore + decide update_on_kvstore (reference
     model.py:96-135)."""
-    update_on_kvstore = True
     if kvstore is None:
-        kv = None
-    elif isinstance(kvstore, kvs.KVStore):
-        kv = kvstore
-    elif isinstance(kvstore, string_types):
-        if num_device == 1 and "dist" not in kvstore:
-            # no need for kvstore with a single device & process
-            kv = None
-        else:
-            kv = kvs.create(kvstore)
-            if kvstore == "local":
-                # automatically select a proper local kvstore type
-                max_size = max(np.prod(param.shape)
-                               for param in arg_params.values())
-                if max_size > 1024 * 1024 * 16:
-                    update_on_kvstore = False
-    else:
+        return None, False
+    if isinstance(kvstore, kvs.KVStore):
+        return kvstore, True
+    if not isinstance(kvstore, string_types):
         raise TypeError("kvstore must be KVStore, str or None")
+    if num_device == 1 and "dist" not in kvstore:
+        return None, False          # single local device: nothing to reduce
+    kv = kvs.create(kvstore)
+    on_kv = True
+    if kvstore == "local" and any(
+            np.prod(p.shape) > _BIG_PARAM_ELEMS
+            for p in arg_params.values()):
+        on_kv = False
+    return kv, on_kv
 
-    if kv is None:
-        update_on_kvstore = False
-    return (kv, update_on_kvstore)
+
+def _trainable(param_arrays, grad_arrays, param_names=None):
+    """Yield (index, name, weights-per-device, grads-per-device) skipping
+    frozen params (grad None)."""
+    for i, (w_list, g_list) in enumerate(zip(param_arrays, grad_arrays)):
+        if g_list[0] is not None:
+            yield i, param_names[i] if param_names else None, \
+                w_list, g_list
 
 
 def _initialize_kvstore(kvstore, param_arrays, arg_params, param_names,
                         update_on_kvstore):
     """Init kvstore entries from current params (reference
     model.py:_initialize_kvstore)."""
-    for idx, param_on_devs in enumerate(param_arrays):
-        name = param_names[idx]
+    for idx, name in enumerate(param_names):
         kvstore.init(name, arg_params[name])
         if update_on_kvstore:
-            kvstore.pull(name, param_on_devs, priority=-idx)
+            kvstore.pull(name, param_arrays[idx], priority=-idx)
 
 
 def _update_params_on_kvstore(param_arrays, grad_arrays, kvstore,
                               param_names):
     """push grads, pull updated weights (reference model.py:105-116)."""
-    for index, pair in enumerate(zip(param_arrays, grad_arrays)):
-        arg_list, grad_list = pair
-        if grad_list[0] is None:
-            continue
-        name = param_names[index]
-        kvstore.push(name, grad_list, priority=-index)
-        kvstore.pull(name, arg_list, priority=-index)
+    for i, name, w_list, g_list in _trainable(param_arrays, grad_arrays,
+                                              param_names):
+        kvstore.push(name, g_list, priority=-i)
+        kvstore.pull(name, w_list, priority=-i)
 
 
 def _update_params(param_arrays, grad_arrays, updater, num_device,
                    kvstore=None, param_names=None):
-    """Local update path, optionally reducing via kvstore first (reference
-    model.py:_update_params)."""
-    for index, pair in enumerate(zip(param_arrays, grad_arrays)):
-        arg_list, grad_list = pair
-        if grad_list[0] is None:
-            continue
+    """Worker-side update path, optionally reducing grads via kvstore
+    first (reference model.py:_update_params)."""
+    for i, name, w_list, g_list in _trainable(param_arrays, grad_arrays,
+                                              param_names):
         if kvstore:
-            name = param_names[index]
-            kvstore.push(name, grad_list, priority=-index)
-            kvstore.pull(name, grad_list, priority=-index)
-        for k, p in enumerate(zip(arg_list, grad_list)):
-            w, g = p
-            updater(index * num_device + k, g, w)
+            kvstore.push(name, g_list, priority=-i)
+            kvstore.pull(name, g_list, priority=-i)
+        for dev, (w, g) in enumerate(zip(w_list, g_list)):
+            updater(i * num_device + dev, g, w)
 
 
 def save_checkpoint(prefix, epoch, symbol, arg_params, aux_params):

@@ -35,14 +35,13 @@ class Optimizer:
     @staticmethod
     def register(klass):
         """Register an optimizer class by (lowercased) name."""
-        assert isinstance(klass, type)
+        if not isinstance(klass, type):
+            raise TypeError("can only register classes")
         name = klass.__name__.lower()
-        if name in Optimizer.opt_registry:
-            warnings.warn("WARNING: New optimizer %s.%s is overriding "
-                          "existing optimizer %s.%s" % (
-                              klass.__module__, klass.__name__,
-                              Optimizer.opt_registry[name].__module__,
-                              Optimizer.opt_registry[name].__name__))
+        prev = Optimizer.opt_registry.get(name)
+        if prev is not None:
+            warnings.warn("optimizer name %r: %s replaces %s"
+                          % (name, klass, prev))
         Optimizer.opt_registry[name] = klass
         return klass
 
@@ -50,9 +49,11 @@ class Optimizer:
     def create_optimizer(name, **kwargs):
         """Instantiate by registered name (reference
         optimizer.py:create_optimizer)."""
-        if name.lower() in Optimizer.opt_registry:
-            return Optimizer.opt_registry[name.lower()](**kwargs)
-        raise ValueError("Cannot find optimizer %s" % name)
+        try:
+            klass = Optimizer.opt_registry[name.lower()]
+        except KeyError:
+            raise ValueError("no optimizer registered under %r" % name)
+        return klass(**kwargs)
 
     def __init__(self, rescale_grad=1.0, param_idx2name=None, wd=0.0,
                  clip_gradient=None, learning_rate=0.01, lr_scheduler=None,
@@ -127,62 +128,66 @@ class Optimizer:
     def set_lr_scale(self, args_lrscale):  # pragma: no cover - deprecated
         raise DeprecationWarning("Use set_lr_mult instead.")
 
+    def _sym_attr_mults(self, attr_key):
+        """Collect __lr_mult__/__wd_mult__ symbol attrs into a dict."""
+        if not self.sym_info:
+            return {}
+        attr, arg_names = self.sym_info
+        return {n: float(attr[n][attr_key]) for n in arg_names
+                if attr_key in attr.get(n, {})}
+
     def set_lr_mult(self, args_lr_mult):
         """Per-param lr multipliers; also pulls ``__lr_mult__`` symbol attrs
         (reference optimizer.py:set_lr_mult)."""
-        self.lr_mult = {}
-        if self.sym_info:
-            attr, arg_names = self.sym_info
-            for name in arg_names:
-                if name in attr and "__lr_mult__" in attr[name]:
-                    self.lr_mult[name] = float(attr[name]["__lr_mult__"])
-        self.lr_mult.update(args_lr_mult)
+        self.lr_mult = {**self._sym_attr_mults("__lr_mult__"),
+                        **args_lr_mult}
 
     def set_wd_mult(self, args_wd_mult):
         """Per-param wd multipliers. As in the reference, params whose name
         does not end in _weight or _gamma default to wd_mult=0 (no decay
         on biases/betas)."""
-        self.wd_mult = {}
-        for n in self.idx2name.values():
-            if not (n.endswith("_weight") or n.endswith("_gamma")):
-                self.wd_mult[n] = 0.0
-        if self.sym_info:
-            attr, arg_names = self.sym_info
-            for name in arg_names:
-                if name in attr and "__wd_mult__" in attr[name]:
-                    self.wd_mult[name] = float(attr[name]["__wd_mult__"])
-        self.wd_mult.update(args_wd_mult)
+        no_decay = {n: 0.0 for n in self.idx2name.values()
+                    if not n.endswith(("_weight", "_gamma"))}
+        self.wd_mult = {**no_decay, **self._sym_attr_mults("__wd_mult__"),
+                        **args_wd_mult}
 
     def _update_count(self, index):
-        if index not in self._index_update_count:
-            self._index_update_count[index] = self.begin_num_update
-        self._index_update_count[index] += 1
-        self.num_update = max(self._index_update_count[index],
-                              self.num_update)
+        count = self._index_update_count.get(index,
+                                             self.begin_num_update) + 1
+        self._index_update_count[index] = count
+        self.num_update = max(count, self.num_update)
+
+    def _mult_for(self, index, mults, attr):
+        """Resolve the per-param multiplier: param_dict beats explicit
+        index entries beats name-keyed entries."""
+        if index in self.param_dict:
+            return getattr(self.param_dict[index], attr)
+        if index in mults:
+            return mults[index]
+        return mults.get(self.idx2name.get(index), 1.0)
 
     def _get_lr(self, index):
-        if self.lr_scheduler is not None:
-            lr = self.lr_scheduler(self.num_update)
-        else:
-            lr = self.lr
-
-        if index in self.param_dict:
-            lr *= self.param_dict[index].lr_mult
-        elif index in self.lr_mult:
-            lr *= self.lr_mult[index]
-        elif index in self.idx2name:
-            lr *= self.lr_mult.get(self.idx2name[index], 1.0)
-        return lr
+        base = self.lr_scheduler(self.num_update) \
+            if self.lr_scheduler is not None else self.lr
+        return base * self._mult_for(index, self.lr_mult, "lr_mult")
 
     def _get_wd(self, index):
-        wd = self.wd
-        if index in self.param_dict:
-            wd *= self.param_dict[index].wd_mult
-        elif index in self.wd_mult:
-            wd *= self.wd_mult[index]
-        elif index in self.idx2name:
-            wd *= self.wd_mult.get(self.idx2name[index], 1.0)
-        return wd
+        return self.wd * self._mult_for(index, self.wd_mult, "wd_mult")
+
+    # -- shared per-update preamble (the reference repeats these four
+    #    lines in every optimizer's update body; factored here) ----------
+    def _hypers(self, index):
+        """Count this update and return (lr, wd) for the param."""
+        self._update_count(index)
+        return self._get_lr(index), self._get_wd(index)
+
+    def _scaled(self, grad):
+        """Rescale + clip a gradient for non-fused update math. Fused
+        registry ops take rescale_grad/clip_gradient as attrs instead."""
+        g = grad * self.rescale_grad
+        if self.clip_gradient is not None:
+            g = _op.clip(g, -self.clip_gradient, self.clip_gradient)
+        return g
 
 register = Optimizer.register
 create = Optimizer.create_optimizer
@@ -223,11 +228,7 @@ class SGD(Optimizer):
         return self.create_state(index, weight)
 
     def _update_impl(self, index, weight, grad, state, multi_precision=False):
-        assert isinstance(weight, NDArray)
-        assert isinstance(grad, NDArray)
-        self._update_count(index)
-        lr = self._get_lr(index)
-        wd = self._get_wd(index)
+        lr, wd = self._hypers(index)
 
         kwargs = dict(lr=lr, wd=wd, rescale_grad=self.rescale_grad,
                       clip_gradient=_clip_attr(self.clip_gradient))
@@ -304,15 +305,8 @@ class DCASGD(Optimizer):
         return (zeros(weight.shape, dtype=weight.dtype), weight.copy())
 
     def update(self, index, weight, grad, state):
-        assert isinstance(weight, NDArray)
-        assert isinstance(grad, NDArray)
-        self._update_count(index)
-        lr = self._get_lr(index)
-        wd = self._get_wd(index)
-
-        grad = grad * self.rescale_grad
-        if self.clip_gradient is not None:
-            grad = _op.clip(grad, -self.clip_gradient, self.clip_gradient)
+        lr, wd = self._hypers(index)
+        grad = self._scaled(grad)
 
         mom, previous_weight = state
         if mom is not None:
@@ -336,15 +330,8 @@ class NAG(SGD):
         super().__init__(**kwargs)
 
     def update(self, index, weight, grad, state):
-        assert isinstance(weight, NDArray)
-        assert isinstance(grad, NDArray)
-        self._update_count(index)
-        lr = self._get_lr(index)
-        wd = self._get_wd(index)
-
-        grad = grad * self.rescale_grad
-        if self.clip_gradient is not None:
-            grad = _op.clip(grad, -self.clip_gradient, self.clip_gradient)
+        lr, wd = self._hypers(index)
+        grad = self._scaled(grad)
 
         if state is not None:
             mom = state
@@ -370,15 +357,8 @@ class SGLD(Optimizer):
         return None
 
     def update(self, index, weight, grad, state):
-        assert isinstance(weight, NDArray)
-        assert isinstance(grad, NDArray)
-        self._update_count(index)
-        lr = self._get_lr(index)
-        wd = self._get_wd(index)
-
-        grad = grad * self.rescale_grad
-        if self.clip_gradient is not None:
-            grad = _op.clip(grad, -self.clip_gradient, self.clip_gradient)
+        lr, wd = self._hypers(index)
+        grad = self._scaled(grad)
         from . import random as _rnd
         import jax
         noise = _array(np.asarray(
@@ -411,11 +391,7 @@ class Adam(Optimizer):
                 zeros(weight.shape, dtype=weight.dtype))   # variance
 
     def update(self, index, weight, grad, state):
-        assert isinstance(weight, NDArray)
-        assert isinstance(grad, NDArray)
-        self._update_count(index)
-        lr = self._get_lr(index)
-        wd = self._get_wd(index)
+        lr, wd = self._hypers(index)
 
         t = self._index_update_count[index]
         coef1 = 1. - self.beta1 ** t
@@ -442,15 +418,8 @@ class AdaGrad(Optimizer):
         return zeros(weight.shape, dtype=weight.dtype)  # history
 
     def update(self, index, weight, grad, state):
-        assert isinstance(weight, NDArray)
-        assert isinstance(grad, NDArray)
-        self._update_count(index)
-        lr = self._get_lr(index)
-        wd = self._get_wd(index)
-
-        grad = grad * self.rescale_grad
-        if self.clip_gradient is not None:
-            grad = _op.clip(grad, -self.clip_gradient, self.clip_gradient)
+        lr, wd = self._hypers(index)
+        grad = self._scaled(grad)
         history = state
         history[:] += grad * grad
         weight[:] += -lr * (grad / _op.sqrt(history + self.float_stable_eps)
@@ -479,11 +448,7 @@ class RMSProp(Optimizer):
         return (zeros(weight.shape, dtype=weight.dtype),)     # n
 
     def update(self, index, weight, grad, state):
-        assert isinstance(weight, NDArray)
-        assert isinstance(grad, NDArray)
-        self._update_count(index)
-        lr = self._get_lr(index)
-        wd = self._get_wd(index)
+        lr, wd = self._hypers(index)
 
         kwargs = dict(lr=lr, wd=wd, gamma1=self.gamma1,
                       epsilon=self.epsilon,
@@ -514,14 +479,8 @@ class AdaDelta(Optimizer):
                 zeros(weight.shape, dtype=weight.dtype))  # E[dx^2]
 
     def update(self, index, weight, grad, state):
-        assert isinstance(weight, NDArray)
-        assert isinstance(grad, NDArray)
-        self._update_count(index)
-        wd = self._get_wd(index)
-
-        grad = grad * self.rescale_grad
-        if self.clip_gradient is not None:
-            grad = _op.clip(grad, -self.clip_gradient, self.clip_gradient)
+        _, wd = self._hypers(index)
+        grad = self._scaled(grad)
 
         acc_g, acc_delta = state
         acc_g[:] = self.rho * acc_g + (1. - self.rho) * grad * grad
@@ -576,11 +535,7 @@ class Adamax(Optimizer):
                 zeros(weight.shape, dtype=weight.dtype))  # variance
 
     def update(self, index, weight, grad, state):
-        assert isinstance(weight, NDArray)
-        assert isinstance(grad, NDArray)
-        self._update_count(index)
-        lr = self._get_lr(index)
-        wd = self._get_wd(index)
+        lr, wd = self._hypers(index)
 
         t = self._index_update_count[index]
         lr /= (1. - self.beta1 ** t)
@@ -613,11 +568,7 @@ class Nadam(Optimizer):
                 zeros(weight.shape, dtype=weight.dtype))  # variance
 
     def update(self, index, weight, grad, state):
-        assert isinstance(weight, NDArray)
-        assert isinstance(grad, NDArray)
-        self._update_count(index)
-        lr = self._get_lr(index)
-        wd = self._get_wd(index)
+        lr, wd = self._hypers(index)
 
         t = self._index_update_count[index]
 
