@@ -47,27 +47,23 @@ class _BlockScope:
 
     @staticmethod
     def create(prefix, params, hint):
-        """Create prefix + params for a new Block."""
-        current = _BlockScope._current()
-        if current is None:
-            if prefix is None:
-                prefix = _global_count(hint) + "_"
-            if params is None:
-                params = ParameterDict(prefix)
-            else:
-                params = ParameterDict(params.prefix, params)
-            return prefix, params
-
+        """Resolve the (prefix, ParameterDict) for a new Block: auto-name
+        from `hint` counters when no prefix is given; wrap an explicitly
+        shared dict; otherwise mint a fresh dict under the full prefix."""
+        scope = _BlockScope._current()
         if prefix is None:
-            count = current._counter.get(hint, 0)
-            prefix = "%s%d_" % (hint, count)
-            current._counter[hint] = count + 1
-        if params is None:
-            parent = current._block.params
-            params = ParameterDict(parent.prefix + prefix, parent._shared)
-        else:
-            params = ParameterDict(params.prefix, params)
-        return current._block.prefix + prefix, params
+            if scope is None:
+                prefix = _global_count(hint) + "_"
+            else:
+                n = scope._counter[hint] = scope._counter.get(hint, 0) + 1
+                prefix = "%s%d_" % (hint, n - 1)
+        full = prefix if scope is None else scope._block.prefix + prefix
+        if params is not None:
+            return full, ParameterDict(params.prefix, params)
+        if scope is None:
+            return full, ParameterDict(full)
+        parent = scope._block.params
+        return full, ParameterDict(parent.prefix + prefix, parent._shared)
 
     def __enter__(self):
         self._old_scope = _BlockScope._current()
@@ -93,37 +89,35 @@ def _global_count(hint):
 
 
 def _flatten(args):
+    """Flatten a nested list/tuple of arrays into (leaves, treedef).
+    The treedef is an int for a leaf (0 = single array, n>1 = a Symbol
+    with n outputs) or a list of child treedefs."""
     if isinstance(args, NDArray):
-        return [args], int(0)
+        return [args], 0
     if isinstance(args, Symbol):
-        length = len(args.list_outputs())
-        length = length if length > 1 else 0
-        return [args], int(length)
-    assert isinstance(args, (list, tuple)), \
-        "HybridBlock input must be (nested) list of Symbol or NDArray, " \
-        "got %s of type %s" % (str(args), str(type(args)))
-    flat = []
-    fmts = []
-    for i in args:
-        arg, fmt = _flatten(i)
-        flat.extend(arg)
-        fmts.append(fmt)
-    return flat, fmts
+        n = len(args.list_outputs())
+        return [args], (n if n > 1 else 0)
+    if not isinstance(args, (list, tuple)):
+        raise TypeError("HybridBlock i/o must nest only Symbol/NDArray "
+                        "in lists/tuples, found %s" % type(args))
+    parts = [_flatten(a) for a in args]
+    return [leaf for leaves, _ in parts for leaf in leaves], \
+        [fmt for _, fmt in parts]
 
 
 def _regroup(args, fmt):
+    """Inverse of _flatten: consume leaves from `args` per the treedef,
+    returning (structure, leftover_leaves)."""
     if isinstance(fmt, int):
-        if fmt == 0:
-            return args[0], args[1:]
-        return args[:fmt], args[fmt:]
-    assert isinstance(args, (list, tuple)), \
-        "HybridBlock output must be (nested) list of Symbol or NDArray, " \
-        "got %s of type %s" % (str(args), str(type(args)))
-    ret = []
-    for i in fmt:
-        res, args = _regroup(args, i)
-        ret.append(res)
-    return ret, args
+        return (args[0], args[1:]) if fmt == 0 else (args[:fmt], args[fmt:])
+    if not isinstance(args, (list, tuple)):
+        raise TypeError("expected a sequence of outputs, got %s"
+                        % type(args))
+    out = []
+    for child in fmt:
+        piece, args = _regroup(args, child)
+        out.append(piece)
+    return out, args
 
 
 class Block:
@@ -150,20 +144,16 @@ class Block:
     def __setattr__(self, name, value):
         """Registers parameters and child blocks (reference
         block.py:__setattr__)."""
-        if hasattr(self, name):
-            existing = getattr(self, name)
-            if isinstance(existing, (Parameter, Block)) and \
-                    not isinstance(value, type(existing)):
-                raise TypeError(
-                    "Changing attribute type for {name} from {type1} to "
-                    "{type2} is not allowed.".format(
-                        name=name, type1=type(existing), type2=type(value)))
-            if isinstance(existing, Block):
-                for i, c in enumerate(self._children):
-                    if c is existing:
-                        self._children[i] = value
-            elif isinstance(value, Block):
-                self.register_child(value)
+        existing = getattr(self, name, None)
+        if isinstance(existing, (Parameter, Block)) and \
+                not isinstance(value, type(existing)):
+            raise TypeError(
+                "attribute %s holds a %s; refusing to replace it with a %s"
+                % (name, type(existing).__name__, type(value).__name__))
+        if isinstance(existing, Block):
+            # in-place swap keeps the child's position stable
+            self._children = [value if c is existing else c
+                              for c in self._children]
         elif isinstance(value, Block):
             self.register_child(value)
         super().__setattr__(name, value)

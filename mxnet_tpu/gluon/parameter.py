@@ -62,44 +62,39 @@ class Parameter:
 
     @grad_req.setter
     def grad_req(self, req):
-        assert req in ["write", "add", "null"], \
-            "grad_req must be one of write, add, or null, but got %s" % req
+        if req not in ("write", "add", "null"):
+            raise ValueError("grad_req %r not in write/add/null" % (req,))
         if not self._differentiable:
             req = "null"
-        if self._grad_req == req:
-            return
-        self._grad_req = req
-        if req == "null":
-            self._grad = None
-        elif self._data is not None and self._grad is None:
-            self._init_grad()
+        if self._grad_req != req:
+            self._grad_req = req
+            if req == "null":
+                self._grad = None
+            elif self._data is not None and self._grad is None:
+                self._init_grad()
 
     def _check_initialized(self, ctx=None):
         if self._data is not None:
             return
         if self._deferred_init:
             raise DeferredInitializationError(
-                "Parameter %s has not been initialized yet because "
-                "initialization was deferred. Actual initialization happens "
-                "during the first forward pass. Please pass one batch of "
-                "data through the network before accessing Parameters." %
-                self.name)
+                "parameter %s is deferred-initialized: its shape is only "
+                "known after the first forward pass, so run one batch "
+                "through the block before touching its arrays" % self.name)
         raise RuntimeError(
-            "Parameter %s has not been initialized. Note that you should "
-            "initialize parameters and create Trainer with "
-            "Block.collect_params() instead of Block.params because the "
-            "later does not include Parameters of nested child Blocks" %
-            self.name)
+            "parameter %s was never initialized — call .initialize() (via "
+            "Block.collect_params(), which also covers child blocks)"
+            % self.name)
 
     def _load_init(self, data, ctx):
         """Initialize from loaded data (reference
         parameter.py:_load_init)."""
-        if self.shape:
-            for self_dim, data_dim in zip(self.shape, data.shape):
-                assert self_dim == 0 or self_dim == data_dim, \
-                    "Failed loading Parameter %s from saved params: " \
-                    "shape incompatible expected %s vs saved %s" % (
-                        self.name, str(self.shape), str(data.shape))
+        known = self.shape or ()
+        if any(want not in (0, got)
+               for want, got in zip(known, data.shape)):
+            raise ValueError(
+                "saved array for %s has shape %s, parameter wants %s"
+                % (self.name, tuple(data.shape), self.shape))
         if self.dtype and np.dtype(self.dtype) != np.dtype(data.dtype):
             data = data.astype(self.dtype)
         if self._data is None:
@@ -115,10 +110,12 @@ class Parameter:
             return
         init, ctx, default_init = self._deferred_init
         self._deferred_init = ()
-        assert self.shape is not None and np.prod(self.shape) > 0, \
-            "Cannot initialize Parameter %s because it has invalid shape: " \
-            "%s. Please specify in_units, in_channels, etc for `Block`s." % (
-                self.name, str(self.shape))
+        # shape () is a valid scalar; None or any 0-dim means unknown
+        if self.shape is None or int(np.prod(self.shape)) <= 0:
+            raise ValueError(
+                "parameter %s still has unknown shape %s after deferred "
+                "init; give the block explicit in_units/in_channels"
+                % (self.name, self.shape))
 
         with autograd.pause():
             data = nd.zeros(self.shape, dtype=self.dtype)
@@ -146,31 +143,25 @@ class Parameter:
     def initialize(self, init=None, ctx=None, default_init=None,
                    force_reinit=False):
         """Initialize data+grad (reference parameter.py:initialize)."""
-        if default_init is None:
-            default_init = init_mod.Uniform()
         if self._data is not None and not force_reinit:
-            warnings.warn("Parameter %s is already initialized, ignoring. "
-                          "Set force_reinit=True to re-initialize." %
-                          self.name, stacklevel=2)
+            warnings.warn("parameter %s already initialized; pass "
+                          "force_reinit=True to redo" % self.name,
+                          stacklevel=2)
             return
         self._data = self._grad = None
-
-        if ctx is None:
-            ctx = [current_context()]
-        if isinstance(ctx, Context):
-            ctx = [ctx]
-        if init is None:
-            init = default_init if self.init is None else self.init
-        if self.shape is None or np.prod(self.shape) <= 0:
-            if self.allow_deferred_init:
-                self._deferred_init = (init, ctx, default_init)
-                return
-            raise ValueError(
-                "Cannot initialize Parameter %s because it has invalid "
-                "shape: %s." % (self.name, str(self.shape)))
-
-        self._deferred_init = (init, ctx, default_init)
-        self._finish_deferred_init()
+        default_init = default_init or init_mod.Uniform()
+        ctx = [ctx] if isinstance(ctx, Context) else \
+            (ctx or [current_context()])
+        shape_known = self.shape is not None and \
+            int(np.prod(self.shape)) > 0
+        if not shape_known and not self.allow_deferred_init:
+            raise ValueError("parameter %s has unknown shape %s and "
+                             "allow_deferred_init is off"
+                             % (self.name, self.shape))
+        self._deferred_init = (init or self.init or default_init, ctx,
+                               default_init)
+        if shape_known:
+            self._finish_deferred_init()
 
     def reset_ctx(self, ctx):
         """Re-place on new context(s) (reference
@@ -300,57 +291,54 @@ class ParameterDict:
             return self._shared._params[name]
         return None
 
+    @staticmethod
+    def _merge_shapes(want, have):
+        """Unify two shapes where 0 means 'unknown'; None if they
+        conflict."""
+        if len(want) != len(have):
+            return None
+        merged = []
+        for a, b in zip(want, have):
+            if a and b and a != b:
+                return None
+            merged.append(a or b)
+        return tuple(merged)
+
     def get(self, name, **kwargs):
-        """Get or create parameter `prefix+name` (reference
+        """Get or create parameter `prefix+name`; on a hit, reconcile the
+        requested attrs with the stored ones (reference
         parameter.py:get)."""
         name = self.prefix + name
         param = self._get_impl(name)
         if param is None:
-            param = Parameter(name, **kwargs)
-            self._params[name] = param
-        else:
-            for k, v in kwargs.items():
-                if hasattr(param, k) and getattr(param, k) is not None:
-                    existing = getattr(param, k)
-                    if k == "shape" and v is not None and \
-                            len(v) == len(existing):
-                        inferred_shape = []
-                        matched = True
-                        for dim1, dim2 in zip(v, existing):
-                            if dim1 != dim2 and dim1 * dim2 != 0:
-                                matched = False
-                                break
-                            elif dim1 == dim2:
-                                inferred_shape.append(dim1)
-                            elif dim1 == 0:
-                                inferred_shape.append(dim2)
-                            else:
-                                inferred_shape.append(dim1)
-                        if matched:
-                            param.shape = tuple(inferred_shape)
-                            continue
-                    elif k == "dtype" and np.dtype(v) == np.dtype(existing):
-                        continue
-                    assert v is None or v == existing or \
-                        (k == "shape" and existing is None), \
-                        "Cannot retrieve Parameter %s because desired " \
-                        "attribute does not match with stored for " \
-                        "attribute %s: desired %s vs stored %s" % (
-                            name, k, str(v), str(getattr(param, k)))
-                else:
-                    setattr(param, k, v)
+            param = self._params[name] = Parameter(name, **kwargs)
+            return param
+        for k, v in kwargs.items():
+            stored = getattr(param, k, None)
+            if stored is None:
+                setattr(param, k, v)
+                continue
+            if k == "shape" and v is not None:
+                merged = self._merge_shapes(tuple(v), tuple(stored))
+                if merged is not None:
+                    param.shape = merged
+                    continue
+            elif k == "dtype" and np.dtype(v) == np.dtype(stored):
+                continue
+            if v is not None and v != stored:
+                raise ValueError(
+                    "parameter %s already exists with %s=%s; requested "
+                    "%s is incompatible" % (name, k, stored, v))
         return param
 
     def update(self, other):
         """Merge another ParameterDict (reference
         parameter.py:update)."""
         for k, v in other.items():
-            if k in self._params:
-                assert self._params[k] is v, \
-                    "Cannot update self with other because they have " \
-                    "different Parameters with the same name %s" % k
-            else:
-                self._params[k] = v
+            mine = self._params.setdefault(k, v)
+            if mine is not v:
+                raise ValueError("both dicts own a different parameter "
+                                 "named %s" % k)
 
     def initialize(self, init=None, ctx=None, verbose=False,
                    force_reinit=False):
@@ -376,39 +364,34 @@ class ParameterDict:
         for v in self.values():
             setattr(v, name, value)
 
+    def _check_prefix(self, prefix, what):
+        bad = [n for n in self.keys() if not n.startswith(prefix)]
+        if bad:
+            raise ValueError("%s=%r does not prefix parameter %s"
+                             % (what, prefix, bad[0]))
+
     def save(self, filename, strip_prefix=""):
         """Save to .params file (reference parameter.py:save)."""
-        arg_dict = {}
-        for param in self.values():
-            weight = param.data()
-            if not param.name.startswith(strip_prefix):
-                raise ValueError(
-                    "Prefix %s is to be striped before saving, but "
-                    "Parameter %s does not start with %s." % (
-                        strip_prefix, param.name, strip_prefix))
-            arg_dict[param.name[len(strip_prefix):]] = weight
-        nd.save(filename, arg_dict)
+        if strip_prefix:
+            self._check_prefix(strip_prefix, "strip_prefix")
+        nd.save(filename, {p.name[len(strip_prefix):]: p.data()
+                           for p in self.values()})
 
     def load(self, filename, ctx=None, allow_missing=False,
              ignore_extra=False, restore_prefix=""):
         """Load from .params file (reference parameter.py:load)."""
         if restore_prefix:
-            for name in self.keys():
-                assert name.startswith(restore_prefix), \
-                    "restore_prefix is %s but Parameter name %s does not " \
-                    "start with it" % (restore_prefix, name)
-        lprefix = len(restore_prefix)
-        arg_dict = {restore_prefix + k: v
-                    for k, v in nd.load(filename).items()}
-        if not allow_missing:
-            for name in self.keys():
-                assert name in arg_dict, \
-                    "Parameter %s is missing in file %s" % (
-                        name[lprefix:], filename)
-        for name in arg_dict:
-            if name not in self._params:
-                assert ignore_extra, \
-                    "Parameter %s loaded from file %s is not present in " \
-                    "ParameterDict" % (name[lprefix:], filename)
-                continue
-            self[name]._load_init(arg_dict[name], ctx)
+            self._check_prefix(restore_prefix, "restore_prefix")
+        loaded = {restore_prefix + k: v
+                  for k, v in nd.load(filename).items()}
+        missing = set(self.keys()) - set(loaded)
+        if missing and not allow_missing:
+            raise ValueError("file %s lacks parameters: %s"
+                             % (filename, sorted(missing)))
+        for name, arr in loaded.items():
+            if name in self._params:
+                self._params[name]._load_init(arr, ctx)
+            elif not ignore_extra:
+                raise ValueError("file %s has unexpected parameter %s "
+                                 "(pass ignore_extra=True to skip)"
+                                 % (filename, name))

@@ -11,31 +11,23 @@ __all__ = ["split_data", "split_and_load", "clip_global_norm"]
 
 def split_data(data, num_slice, batch_axis=0, even_split=True):
     """Split along batch_axis into num_slice slices (reference
-    utils.py:split_data)."""
+    utils.py:split_data). With even_split=False the last slice absorbs
+    the remainder."""
     size = data.shape[batch_axis]
     if size < num_slice:
+        raise ValueError("cannot cut axis %d of %s into %d slices"
+                         % (batch_axis, data.shape, num_slice))
+    if even_split and size % num_slice:
         raise ValueError(
-            "Too many slices for data with shape %s. Arguments are "
-            "num_slice=%d and batch_axis=%d." % (
-                str(data.shape), num_slice, batch_axis))
-    if even_split and size % num_slice != 0:
-        raise ValueError(
-            "data with shape %s cannot be evenly split into %d slices "
-            "along axis %d. Use a batch size that's multiple of %d or set "
-            "even_split=False to allow uneven partitioning of data." % (
-                str(data.shape), num_slice, batch_axis, num_slice))
+            "axis %d of %s is not divisible by %d; pad the batch or pass "
+            "even_split=False" % (batch_axis, data.shape, num_slice))
 
     step = size // num_slice
+    bounds = [(i * step, size if i == num_slice - 1 else (i + 1) * step)
+              for i in range(num_slice)]
     if batch_axis == 0:
-        slices = [data[i * step:(i + 1) * step]
-                  if i < num_slice - 1 else data[i * step:size]
-                  for i in range(num_slice)]
-    else:
-        slices = [nd.slice_axis(data, batch_axis, i * step,
-                                (i + 1) * step if i < num_slice - 1
-                                else size)
-                  for i in range(num_slice)]
-    return slices
+        return [data[lo:hi] for lo, hi in bounds]
+    return [nd.slice_axis(data, batch_axis, lo, hi) for lo, hi in bounds]
 
 
 def split_and_load(data, ctx_list, batch_axis=0, even_split=True):
@@ -48,19 +40,18 @@ def split_and_load(data, ctx_list, batch_axis=0, even_split=True):
     if len(ctx_list) == 1:
         return [data.as_in_context(ctx_list[0])]
     slices = split_data(data, len(ctx_list), batch_axis, even_split)
-    return [i.as_in_context(ctx) for i, ctx in zip(slices, ctx_list)]
+    return [s.as_in_context(c) for s, c in zip(slices, ctx_list)]
 
 
 def clip_global_norm(arrays, max_norm):
     """Rescale arrays so total L2 norm <= max_norm (reference
     utils.py:clip_global_norm)."""
-    assert len(arrays) > 0
-    total_norm = 0.0
-    for arr in arrays:
-        total_norm += float((arr * arr).sum().asscalar())
-    total_norm = math.sqrt(total_norm)
-    scale = max_norm / (total_norm + 1e-8)
-    if scale < 1.0:
-        for arr in arrays:
-            arr *= scale
-    return total_norm
+    if not arrays:
+        raise ValueError("clip_global_norm needs at least one array")
+    total = math.sqrt(sum(float((a * a).sum().asscalar())
+                          for a in arrays))
+    if total > max_norm:
+        scale = max_norm / (total + 1e-8)
+        for a in arrays:
+            a *= scale
+    return total
