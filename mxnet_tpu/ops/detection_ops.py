@@ -247,7 +247,8 @@ def _decode_boxes(anchors, loc_pred, variances, clip):
 
 
 def _detect_one(cls_prob, loc_pred, anchors, threshold, clip, variances,
-                nms_threshold, force_suppress, nms_topk, background_id):
+                nms_threshold, force_suppress, nms_topk, background_id,
+                impl="auto"):
     """One batch element. cls_prob (C,A), loc_pred (A*4,), anchors (A,4)
     -> (A, 6) rows [class_id, score, x1, y1, x2, y2], invalid rows -1.
     Output ids renumber foreground classes with background_id skipped
@@ -279,18 +280,36 @@ def _detect_one(cls_prob, loc_pred, anchors, threshold, clip, variances,
     if not (0 < nms_threshold <= 1):
         return s_rows
 
-    iou = _box_iou_corner(s_rows[:, 2:6], s_rows[:, 2:6])   # (A, A)
-    same_cls = s_rows[:, 0][:, None] == s_rows[:, 0][None, :]
-    sup_candidate = iou >= nms_threshold
-    if not force_suppress:
-        sup_candidate = sup_candidate & same_cls
+    import os
+    if impl == "auto":
+        # resolved at trace time: the Pallas kernel on TPU, the dense
+        # XLA path elsewhere (interpret-mode Pallas is a debug mode, not
+        # a deployment path). NOTE: the jit cache key is the literal
+        # "auto", so changing MXNET_NMS_IMPL after the first call with
+        # identical shapes/attrs has no effect — pass impl= explicitly
+        # to switch within a process.
+        impl = os.environ.get(
+            "MXNET_NMS_IMPL",
+            "pallas" if jax.default_backend() == "tpu" else "xla")
+    if impl == "pallas":
+        # blocked Pallas kernel: one (block, A) IoU tile in VMEM instead
+        # of the dense (A, A) matrix in HBM (ops/nms_pallas.py)
+        from .nms_pallas import nms_keep
+        keep = nms_keep(s_rows[:, 2:6], s_rows[:, 0], s_valid,
+                        nms_threshold, force_suppress)
+    else:
+        iou = _box_iou_corner(s_rows[:, 2:6], s_rows[:, 2:6])   # (A, A)
+        same_cls = s_rows[:, 0][:, None] == s_rows[:, 0][None, :]
+        sup_candidate = iou >= nms_threshold
+        if not force_suppress:
+            sup_candidate = sup_candidate & same_cls
 
-    def nms_step(i, keep):
-        row_alive = keep[i] & s_valid[i]
-        sup = sup_candidate[i] & (jnp.arange(A) > i) & row_alive
-        return keep & ~sup
+        def nms_step(i, keep_):
+            row_alive = keep_[i] & s_valid[i]
+            sup = sup_candidate[i] & (jnp.arange(A) > i) & row_alive
+            return keep_ & ~sup
 
-    keep = lax.fori_loop(0, A, nms_step, s_valid)
+        keep = lax.fori_loop(0, A, nms_step, s_valid)
     return jnp.where((keep & s_valid)[:, None], s_rows, -1.0)
 
 
@@ -300,17 +319,25 @@ def _detect_one(cls_prob, loc_pred, anchors, threshold, clip, variances,
           aliases=("MultiBoxDetection", "_contrib_multibox_detection"),
           defaults={"clip": True, "threshold": 0.01, "background_id": 0,
                     "nms_threshold": 0.5, "force_suppress": False,
-                    "variances": (0.1, 0.1, 0.2, 0.2), "nms_topk": -1})
+                    "variances": (0.1, 0.1, 0.2, 0.2), "nms_topk": -1,
+                    "impl": "auto"})
 def _multibox_detection(cls_prob, loc_pred, anchor, clip=True,
                         threshold=0.01, background_id=0,
                         nms_threshold=0.5, force_suppress=False,
-                        variances=(0.1, 0.1, 0.2, 0.2), nms_topk=-1, **_):
-    """cls_prob (B,C,A), loc_pred (B,A*4), anchor (1,A,4) -> (B,A,6)."""
+                        variances=(0.1, 0.1, 0.2, 0.2), nms_topk=-1,
+                        impl="auto", **_):
+    """cls_prob (B,C,A), loc_pred (B,A*4), anchor (1,A,4) -> (B,A,6).
+
+    impl: "pallas" (blocked NMS kernel, ops/nms_pallas.py), "xla"
+    (dense IoU matrix + fori_loop), or "auto" (default: MXNET_NMS_IMPL
+    env if set, else pallas on TPU / xla elsewhere). Explicit impl
+    values get distinct jit cache entries, so both paths can coexist
+    in one process; "auto" resolves once per shape at trace time."""
     anchors = anchor.reshape(-1, 4)
     f = lambda cp, lp: _detect_one(cp, lp, anchors, threshold, clip,
                                    variances, nms_threshold,
                                    force_suppress, nms_topk,
-                                   background_id)
+                                   background_id, impl)
     return jax.vmap(f)(cls_prob, loc_pred)
 
 

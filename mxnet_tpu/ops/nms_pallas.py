@@ -1,0 +1,126 @@
+"""Blocked greedy NMS as a Pallas TPU kernel.
+
+The XLA path in detection_ops._detect_one materializes the full A x A IoU
+matrix before the greedy suppression loop — for SSD's 8732 anchors that is
+~300 MB of HBM traffic per sample. This kernel runs the same greedy
+algorithm (reference semantics: multibox_detection.cc:107 NMS loop) in
+score-sorted block order and only ever holds one (block x A) IoU tile in
+VMEM:
+
+  for each block b (sequential Pallas grid):
+    1. intra-block: greedy suppression inside the block (fori_loop over
+       the block's rows, vectorized across lanes)
+    2. inter-block: one (block x A) IoU tile suppresses every later row
+       against the block's survivors in a single vector op
+
+Greedy order is preserved because grid steps run sequentially on TPU and
+the keep mask is carried across steps via input/output aliasing. On
+non-TPU backends the kernel runs in Pallas interpret mode, so numerics
+are identical everywhere.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+
+_BLOCK = 128
+
+
+def _iou_tile(a, b):
+    """IoU of corner boxes a (Na,4) vs b (Nb,4) -> (Na,Nb).
+
+    Same formula as detection_ops._box_iou_corner, restated with plain
+    indexing: Mosaic rejects jnp.split on the 4-wide minor dimension, so
+    the shared helper cannot be reused inside the kernel (a unit test
+    pins the two implementations equal)."""
+    ax1, ay1, ax2, ay2 = [a[:, i][:, None] for i in range(4)]
+    bx1, by1, bx2, by2 = [b[:, i][None, :] for i in range(4)]
+    iw = jnp.maximum(0.0, jnp.minimum(ax2, bx2) - jnp.maximum(ax1, bx1))
+    ih = jnp.maximum(0.0, jnp.minimum(ay2, by2) - jnp.maximum(ay1, by1))
+    inter = iw * ih
+    union = (ax2 - ax1) * (ay2 - ay1) + (bx2 - bx1) * (by2 - by1) - inter
+    return jnp.where(union <= 0, 0.0, inter / jnp.maximum(union, 1e-12))
+
+
+def _nms_kernel(boxes_ref, cls_ref, keep_in_ref, keep_ref, *,
+                block, nms_threshold, force_suppress, num_rows):
+    bi = pl.program_id(0)
+    offs = bi * block
+
+    @pl.when(bi == 0)
+    def _seed():
+        keep_ref[...] = keep_in_ref[...]
+
+    # All masks live as 0/1 float32: Mosaic cannot vector-truncate wider
+    # ints to i1, so boolean-valued selects/reductions are avoided.
+    blk_boxes = boxes_ref[pl.ds(offs, block), :]          # (B, 4)
+    blk_cls = cls_ref[0, pl.ds(offs, block)]              # (B,)
+    blk_keep = keep_ref[0, pl.ds(offs, block)]            # (B,) 0/1 f32
+
+    iou_bb = _iou_tile(blk_boxes, blk_boxes)              # (B, B)
+    sup_bb = (iou_bb >= nms_threshold).astype(jnp.float32)
+    if not force_suppress:
+        sup_bb = sup_bb * (blk_cls[:, None] ==
+                           blk_cls[None, :]).astype(jnp.float32)
+    col = jax.lax.broadcasted_iota(jnp.int32, (block,), 0)
+
+    def intra(i, k):
+        alive = jnp.max(jnp.where(col == i, k, 0.0))
+        row = jnp.max(jnp.where(col[:, None] == i, sup_bb, 0.0), axis=0)
+        kill = alive * row * (col > i).astype(jnp.float32)
+        return k * (1.0 - kill)
+
+    blk_keep = lax.fori_loop(0, block, intra, blk_keep)
+    keep_ref[0, pl.ds(offs, block)] = blk_keep
+
+    # survivors of this block suppress every later row in one tile
+    all_boxes = boxes_ref[...]                            # (A, 4)
+    iou_ba = _iou_tile(blk_boxes, all_boxes)              # (B, A)
+    sup_ba = (iou_ba >= nms_threshold).astype(jnp.float32)
+    if not force_suppress:
+        sup_ba = sup_ba * (blk_cls[:, None] ==
+                           cls_ref[0, :][None, :]).astype(jnp.float32)
+    hit = jnp.max(blk_keep[:, None] * sup_ba, axis=0)     # (A,) 0/1
+    later = (jax.lax.broadcasted_iota(jnp.int32, (num_rows,), 0) >=
+             offs + block).astype(jnp.float32)
+    keep_ref[0, :] = keep_ref[0, :] * (1.0 - later * hit)
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("nms_threshold", "force_suppress"))
+def nms_keep(boxes, cls_ids, valid, nms_threshold, force_suppress=False):
+    """Greedy NMS over score-sorted corner boxes.
+
+    boxes (A,4), cls_ids (A,) float class labels, valid (A,) bool.
+    Returns the surviving-row bool mask — bit-identical to the dense
+    XLA path in detection_ops (tested in tests/test_detection_ops.py).
+    """
+    A = boxes.shape[0]
+    pad = (-A) % _BLOCK
+    padded = A + pad
+    boxes_p = jnp.pad(boxes.astype(jnp.float32), ((0, pad), (0, 0)),
+                      constant_values=-1.0)
+    cls_p = jnp.pad(cls_ids.astype(jnp.float32), (0, pad),
+                    constant_values=-1.0)[None, :]
+    keep0 = jnp.pad(valid.astype(jnp.float32), (0, pad))[None, :]
+
+    kernel = functools.partial(
+        _nms_kernel, block=_BLOCK, nms_threshold=nms_threshold,
+        force_suppress=force_suppress, num_rows=padded)
+    out = pl.pallas_call(
+        kernel,
+        grid=(padded // _BLOCK,),
+        in_specs=[
+            pl.BlockSpec((padded, 4), lambda b: (0, 0)),
+            pl.BlockSpec((1, padded), lambda b: (0, 0)),
+            pl.BlockSpec((1, padded), lambda b: (0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, padded), lambda b: (0, 0)),
+        out_shape=jax.ShapeDtypeStruct((1, padded), jnp.float32),
+        interpret=jax.default_backend() != "tpu",
+    )(boxes_p, cls_p, keep0)
+    return out[0, :A] > 0.0
