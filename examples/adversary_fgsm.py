@@ -1,0 +1,68 @@
+"""Fast-gradient-sign adversarial examples (reference
+example/adversary/adversary_generation.ipynb): train a small MLP, then
+perturb inputs along the sign of the input gradient and watch accuracy
+collapse — exercises autograd gradients w.r.t. DATA, not parameters.
+
+Run: python examples/adversary_fgsm.py
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, gluon, nd
+
+
+def main():
+    rng = np.random.RandomState(0)
+    # two well-separated gaussian blobs
+    n = 1024
+    X = np.concatenate([rng.randn(n // 2, 16) + 1.0,
+                        rng.randn(n // 2, 16) - 1.0]).astype(np.float32)
+    y = np.concatenate([np.ones(n // 2), np.zeros(n // 2)]).astype(
+        np.float32)
+
+    net = gluon.nn.Sequential()
+    net.add(gluon.nn.Dense(32, activation="relu"),
+            gluon.nn.Dense(2))
+    net.initialize(mx.init.Xavier())
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    trainer = gluon.Trainer(net.collect_params(), "adam",
+                            {"learning_rate": 5e-3})
+
+    ds = gluon.data.ArrayDataset(nd.array(X), nd.array(y))
+    loader = gluon.data.DataLoader(ds, batch_size=64, shuffle=True)
+    for epoch in range(5):
+        for xb, yb in loader:
+            with autograd.record():
+                loss = loss_fn(net(xb), yb)
+            loss.backward()
+            trainer.step(xb.shape[0])
+
+    def accuracy(xs):
+        pred = net(nd.array(xs)).asnumpy().argmax(1)
+        return (pred == y).mean()
+
+    clean_acc = accuracy(X)
+
+    # FGSM: eps * sign(d loss / d x)
+    xv = nd.array(X)
+    xv.attach_grad()
+    with autograd.record():
+        loss = loss_fn(net(xv), nd.array(y))
+    loss.backward()
+    x_adv = X + 2.5 * np.sign(xv.grad.asnumpy())
+    adv_acc = accuracy(x_adv)
+
+    print("clean accuracy: %.3f   adversarial accuracy: %.3f"
+          % (clean_acc, adv_acc))
+    assert clean_acc > 0.95
+    assert adv_acc < clean_acc - 0.2
+
+
+if __name__ == "__main__":
+    main()

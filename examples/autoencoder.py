@@ -1,0 +1,72 @@
+"""Deep autoencoder on a synthetic low-rank manifold (reference
+example/autoencoder/autoencoder.py): encoder/decoder stacks trained with
+L2 reconstruction; reconstruction error must beat the best linear rank-k
+baseline's neighbourhood.
+
+Run: python examples/autoencoder.py
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, gluon, nd
+
+DIM, CODE = 64, 4
+
+
+# one fixed manifold shared by train and test splits
+_W_RNG = np.random.RandomState(1234)
+_W1 = _W_RNG.randn(CODE, 32).astype(np.float32)
+_W2 = _W_RNG.randn(32, DIM).astype(np.float32) / np.sqrt(32)
+
+
+def synth(n, rng):
+    """Points on a fixed 4-D nonlinear manifold embedded in 64-D."""
+    z = rng.randn(n, CODE).astype(np.float32)
+    return np.tanh(z @ _W1) @ _W2
+
+
+def main():
+    rng = np.random.RandomState(0)
+    X = synth(4096, rng)
+
+    net = gluon.nn.Sequential()
+    net.add(gluon.nn.Dense(32, activation="relu"),
+            gluon.nn.Dense(CODE),
+            gluon.nn.Dense(32, activation="relu"),
+            gluon.nn.Dense(DIM))
+    net.initialize(mx.init.Xavier())
+    loss_fn = gluon.loss.L2Loss()
+    trainer = gluon.Trainer(net.collect_params(), "adam",
+                            {"learning_rate": 2e-3})
+
+    loader = gluon.data.DataLoader(
+        gluon.data.ArrayDataset(nd.array(X), nd.array(X)),
+        batch_size=128, shuffle=True)
+    for epoch in range(40):
+        total = 0.0
+        for xb, _ in loader:
+            with autograd.record():
+                loss = loss_fn(net(xb), xb)
+            loss.backward()
+            trainer.step(xb.shape[0])
+            total += float(loss.sum().asscalar())
+        if epoch % 10 == 0:
+            print("epoch %d recon loss/sample %.5f"
+                  % (epoch, total / len(X)))
+
+    Xte = synth(512, np.random.RandomState(1))
+    rec = net(nd.array(Xte)).asnumpy()
+    err = np.mean((rec - Xte) ** 2)
+    var = np.mean(Xte ** 2)
+    print("test relative reconstruction error: %.4f" % (err / var))
+    assert err / var < 0.15      # 4-dim bottleneck captures the manifold
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,67 @@
+"""CNN text classifier (reference example/cnn_text_classification/text_cnn.py,
+Kim 2014): embedding -> parallel conv widths -> max-over-time pooling ->
+dense. Synthetic keyword task so the script is self-contained.
+
+Run: python examples/cnn_text_classification.py
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+import numpy as np
+
+import mxnet_tpu as mx
+
+SEQ, VOCAB, EMB = 24, 200, 32
+
+
+def synth(n, rng):
+    """Class 1 iff any token from the 'positive' keyword set appears."""
+    x = rng.randint(10, VOCAB, (n, SEQ)).astype(np.float32)
+    y = np.zeros(n, np.float32)
+    pos = rng.rand(n) < 0.5
+    slots = rng.randint(0, SEQ, n)
+    x[pos, slots[pos]] = rng.randint(0, 5, pos.sum())
+    y[pos] = 1.0
+    return x, y
+
+
+def build(filter_sizes=(2, 3, 4), num_filter=32):
+    data = mx.sym.Variable("data")
+    label = mx.sym.Variable("softmax_label")
+    embed = mx.sym.Embedding(data, input_dim=VOCAB, output_dim=EMB,
+                             name="embed")                    # (N,SEQ,EMB)
+    x = mx.sym.Reshape(embed, shape=(-1, 1, SEQ, EMB))
+    pooled = []
+    for fs in filter_sizes:
+        conv = mx.sym.Convolution(x, kernel=(fs, EMB),
+                                  num_filter=num_filter,
+                                  name="conv%d" % fs)
+        act = mx.sym.Activation(conv, act_type="relu")
+        pooled.append(mx.sym.Pooling(act, pool_type="max",
+                                     kernel=(SEQ - fs + 1, 1)))
+    h = mx.sym.Concat(*pooled, dim=1)
+    h = mx.sym.Flatten(h)
+    h = mx.sym.Dropout(h, p=0.3)
+    fc = mx.sym.FullyConnected(h, num_hidden=2, name="cls")
+    return mx.sym.SoftmaxOutput(fc, label, name="softmax")
+
+
+def main():
+    rng = np.random.RandomState(0)
+    X, y = synth(2048, rng)
+    it = mx.io.NDArrayIter(X, y, batch_size=64, shuffle=True)
+    mod = mx.mod.Module(build(), context=mx.cpu())
+    mod.fit(it, num_epoch=6, optimizer="adam",
+            optimizer_params={"learning_rate": 2e-3})
+    Xte, yte = synth(512, np.random.RandomState(1))
+    acc = mod.score(mx.io.NDArrayIter(Xte, yte, batch_size=64),
+                    "acc")[0][1]
+    print("text-cnn accuracy: %.3f" % acc)
+    assert acc > 0.85
+
+
+if __name__ == "__main__":
+    main()

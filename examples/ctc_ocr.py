@@ -1,0 +1,101 @@
+"""Sequence recognition with CTC (reference example/warpctc/lstm_ocr.py
+shrunk to a synthetic task): an LSTM reads a noisy stripe rendering of a
+digit string and CTCLoss aligns the unsegmented outputs.
+
+Run: python examples/ctc_ocr.py
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, rnn
+
+T, LAB, CLASSES = 20, 4, 5      # frames, label length, digit classes
+FEAT = 16
+
+
+def render(labels, rng):
+    """Each digit paints its own channel over a few consecutive frames."""
+    n = len(labels)
+    x = rng.randn(n, T, FEAT).astype(np.float32) * 0.3
+    for i, seq in enumerate(labels):
+        for j, d in enumerate(seq):
+            lo = 2 + j * 4
+            x[i, lo:lo + 3, int(d) * 3:int(d) * 3 + 3] += 2.0
+    return x
+
+
+def greedy_decode(probs):
+    """argmax -> collapse repeats -> drop blanks (blank = 0)."""
+    best = probs.argmax(-1)
+    out = []
+    for row in best:
+        seq, prev = [], -1
+        for t in row:
+            if t != prev and t != 0:
+                seq.append(int(t))
+            prev = t
+        out.append(seq)
+    return out
+
+
+def build():
+    data = mx.sym.Variable("data")                    # (N, T, FEAT)
+    label = mx.sym.Variable("label")                  # (N, LAB)
+    cell = rnn.LSTMCell(64, prefix="lstm_")
+    outs, _ = cell.unroll(T, inputs=data, merge_outputs=True)
+    pred = mx.sym.Reshape(outs, shape=(-1, 64))
+    pred = mx.sym.FullyConnected(pred, num_hidden=CLASSES + 1,
+                                 name="cls")          # + blank
+    pred = mx.sym.Reshape(pred, shape=(-1, T, CLASSES + 1))
+    # CTCLoss wants (T, N, C) activations
+    act = mx.sym.transpose(pred, axes=(1, 0, 2))
+    loss = mx.sym.CTCLoss(act, label, name="ctc")
+    return mx.sym.MakeLoss(loss), pred
+
+
+def main():
+    rng = np.random.RandomState(0)
+    n = 512
+    labels = rng.randint(1, CLASSES + 1, (n, LAB)).astype(np.float32)
+    X = render(labels, rng)
+
+    loss_sym, pred_sym = build()
+    group = mx.sym.Group([loss_sym, mx.sym.BlockGrad(pred_sym)])
+    mod = mx.mod.Module(group, context=mx.cpu(),
+                        data_names=("data",), label_names=("label",))
+    it = mx.io.NDArrayIter({"data": X}, {"label": labels},
+                           batch_size=64, shuffle=True,
+                           label_name="label")
+    mod.bind(data_shapes=it.provide_data, label_shapes=it.provide_label)
+    mod.init_params(mx.init.Xavier())
+    mod.init_optimizer(optimizer="adam",
+                       optimizer_params={"learning_rate": 5e-3})
+    for epoch in range(15):
+        it.reset()
+        tot = 0.0
+        for batch in it:
+            mod.forward(batch)
+            mod.backward()
+            mod.update()
+            tot += float(mod.get_outputs()[0].sum().asscalar())
+        if epoch % 5 == 0:
+            print("epoch %d ctc loss/sample %.4f" % (epoch, tot / n))
+
+    mod.forward(mx.io.DataBatch([nd.array(X[:128])],
+                                [nd.array(labels[:128])]), is_train=False)
+    probs = mod.get_outputs()[1].asnumpy()
+    decoded = greedy_decode(probs)
+    hits = sum(d == list(map(int, l)) for d, l in zip(decoded, labels))
+    acc = hits / 128
+    print("ctc exact-sequence accuracy: %.3f" % acc)
+    assert acc > 0.7
+
+
+if __name__ == "__main__":
+    main()

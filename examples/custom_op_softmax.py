@@ -1,0 +1,73 @@
+"""User-defined Python operator (reference example/numpy-ops/custom_softmax.py):
+a numpy softmax + cross-entropy output layer registered as a Custom op and
+trained inside a Module graph.
+
+TPU note: the Custom op body runs host-side via jax.pure_callback with a
+custom_vjp for the backward (mxnet_tpu/ops/custom.py) — the rest of the
+graph stays compiled on device.
+
+Run: python examples/custom_op_softmax.py
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+import numpy as np
+
+import mxnet_tpu as mx
+import mxnet_tpu.operator as op
+
+
+class NumpySoftmax(op.CustomOp):
+    def forward(self, is_train, req, in_data, out_data, aux):
+        x = in_data[0].asnumpy()
+        e = np.exp(x - x.max(axis=1, keepdims=True))
+        self.assign(out_data[0], req[0], e / e.sum(axis=1, keepdims=True))
+
+    def backward(self, req, out_grad, in_data, out_data, in_grad, aux):
+        prob = out_data[0].asnumpy()
+        label = in_data[1].asnumpy().astype(np.int64)
+        grad = prob.copy()
+        grad[np.arange(len(label)), label] -= 1.0
+        self.assign(in_grad[0], req[0], grad / len(label))
+
+
+@op.register("numpy_softmax")
+class NumpySoftmaxProp(op.CustomOpProp):
+    def __init__(self):
+        super().__init__(need_top_grad=False)
+
+    def list_arguments(self):
+        return ["data", "label"]
+
+    def infer_shape(self, in_shape):
+        data = in_shape[0]
+        return [data, (data[0],)], [data], []
+
+    def create_operator(self, ctx, in_shapes, in_dtypes):
+        return NumpySoftmax()
+
+
+def main():
+    rng = np.random.RandomState(0)
+    X = rng.randn(256, 16).astype(np.float32)
+    y = (X[:, :8].sum(1) > X[:, 8:].sum(1)).astype(np.float32)
+
+    data = mx.sym.Variable("data")
+    label = mx.sym.Variable("softmax_label")
+    fc = mx.sym.FullyConnected(data, num_hidden=2, name="fc")
+    net = mx.sym.Custom(fc, label, op_type="numpy_softmax", name="softmax")
+
+    mod = mx.mod.Module(net, context=mx.cpu())
+    it = mx.io.NDArrayIter(X, y, batch_size=32, shuffle=True)
+    mod.fit(it, num_epoch=8, optimizer="sgd",
+            optimizer_params={"learning_rate": 0.5})
+    acc = mod.score(it, "acc")[0][1]
+    print("custom-op softmax accuracy: %.3f" % acc)
+    assert acc > 0.9
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,62 @@
+"""Matrix factorization recommender (reference
+example/recommenders/demo1-MF.ipynb): user/item embeddings trained on
+synthetic ratings with row-sparse lazy updates — only the rows touched by
+a batch pay optimizer traffic.
+
+Run: python examples/matrix_factorization.py
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+import numpy as np
+
+import mxnet_tpu as mx
+
+USERS, ITEMS, RANK = 200, 300, 8
+
+
+def synth(n, rng):
+    u_emb = rng.randn(USERS, RANK).astype(np.float32)
+    i_emb = rng.randn(ITEMS, RANK).astype(np.float32)
+    u = rng.randint(0, USERS, n)
+    i = rng.randint(0, ITEMS, n)
+    r = (u_emb[u] * i_emb[i]).sum(1) * 0.3
+    return (u.astype(np.float32), i.astype(np.float32),
+            r.astype(np.float32))
+
+
+def build():
+    user = mx.sym.Variable("user")
+    item = mx.sym.Variable("item")
+    score = mx.sym.Variable("score_label")
+    ue = mx.sym.Embedding(user, input_dim=USERS, output_dim=RANK,
+                          name="user_embed")
+    ie = mx.sym.Embedding(item, input_dim=ITEMS, output_dim=RANK,
+                          name="item_embed")
+    pred = mx.sym.sum(ue * ie, axis=1)
+    return mx.sym.LinearRegressionOutput(pred, score, name="pred")
+
+
+def main():
+    rng = np.random.RandomState(0)
+    u, i, r = synth(20000, rng)
+    it = mx.io.NDArrayIter({"user": u, "item": i},
+                           {"score_label": r}, batch_size=256,
+                           shuffle=True, label_name="score_label")
+    mod = mx.mod.Module(build(), context=mx.cpu(),
+                        data_names=("user", "item"),
+                        label_names=("score_label",))
+    mod.fit(it, num_epoch=10, optimizer="adam",
+            optimizer_params={"learning_rate": 0.01},
+            eval_metric="mse")
+    mse = mod.score(it, "mse")[0][1]
+    var = float(np.var(r))
+    print("rating MSE %.4f vs variance %.4f" % (mse, var))
+    assert mse < 0.3 * var
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,53 @@
+"""Profile one training step (reference example/profiler/profiler_executor.py):
+host-side chrome-trace timeline via mx.profiler plus, on TPU, an xplane
+device trace — open the JSON in chrome://tracing or Perfetto.
+
+Run: python examples/profile_model.py [out.json]
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import profiler
+
+
+def main():
+    out = sys.argv[1] if len(sys.argv) > 1 else "profile_step.json"
+    rng = np.random.RandomState(0)
+    X = rng.randn(64, 3, 32, 32).astype(np.float32)
+    y = rng.randint(0, 10, 64).astype(np.float32)
+
+    net = mx.sym.SoftmaxOutput(
+        mx.sym.FullyConnected(mx.sym.Activation(mx.sym.Convolution(
+            mx.sym.Variable("data"), kernel=(3, 3), num_filter=16,
+            name="conv"), act_type="relu"), num_hidden=10, name="fc"),
+        name="softmax")
+    mod = mx.mod.Module(net, context=mx.cpu())
+    it = mx.io.NDArrayIter(X, y, batch_size=64)
+    mod.bind(data_shapes=it.provide_data, label_shapes=it.provide_label)
+    mod.init_params()
+    mod.init_optimizer()
+
+    profiler.profiler_set_config(mode="all", filename=out)
+    profiler.profiler_set_state("run")
+    for batch in it:
+        mod.forward(batch)
+        mod.backward()
+        mod.update()
+    profiler.profiler_set_state("stop")
+    profiler.dump_profile()
+
+    import json
+    with open(out) as f:
+        events = json.load(f)["traceEvents"]
+    print("wrote %s with %d trace events" % (out, len(events)))
+    assert len(events) > 0
+
+
+if __name__ == "__main__":
+    main()

@@ -26,7 +26,7 @@ _COMMON = {"lr": 0.01, "wd": 0.0, "rescale_grad": 1.0,
            "clip_gradient": -1.0}
 
 
-@register("sgd_update", arg_names=("weight", "grad"), differentiable=False,
+@register("sgd_update", traced_attrs=('lr', 'wd', 'rescale_grad'), arg_names=("weight", "grad"), differentiable=False,
           defaults=_COMMON)
 def _sgd_update(weight, grad, lr=0.01, wd=0.0, rescale_grad=1.0,
                 clip_gradient=-1.0, **_):
@@ -34,7 +34,7 @@ def _sgd_update(weight, grad, lr=0.01, wd=0.0, rescale_grad=1.0,
     return weight - lr * g
 
 
-@register("sgd_mom_update", arg_names=("weight", "grad", "mom"),
+@register("sgd_mom_update", traced_attrs=('lr', 'momentum', 'wd', 'rescale_grad'), arg_names=("weight", "grad", "mom"),
           differentiable=False, state_inputs=(2,),
           defaults={**_COMMON, "momentum": 0.0})
 def _sgd_mom_update(weight, grad, mom, lr=0.01, momentum=0.0, wd=0.0,
@@ -44,7 +44,7 @@ def _sgd_mom_update(weight, grad, mom, lr=0.01, momentum=0.0, wd=0.0,
     return weight + new_mom, new_mom
 
 
-@register("mp_sgd_update", arg_names=("weight", "grad", "weight32"),
+@register("mp_sgd_update", traced_attrs=('lr', 'wd', 'rescale_grad'), arg_names=("weight", "grad", "weight32"),
           differentiable=False, state_inputs=(2,), defaults=_COMMON)
 def _mp_sgd_update(weight, grad, weight32, lr=0.01, wd=0.0,
                    rescale_grad=1.0, clip_gradient=-1.0, **_):
@@ -54,7 +54,7 @@ def _mp_sgd_update(weight, grad, weight32, lr=0.01, wd=0.0,
     return new_w32.astype(weight.dtype), new_w32
 
 
-@register("mp_sgd_mom_update",
+@register("mp_sgd_mom_update", traced_attrs=('lr', 'momentum', 'wd', 'rescale_grad'),
           arg_names=("weight", "grad", "mom", "weight32"),
           differentiable=False, state_inputs=(2, 3),
           defaults={**_COMMON, "momentum": 0.0})
@@ -67,7 +67,7 @@ def _mp_sgd_mom_update(weight, grad, mom, weight32, lr=0.01, momentum=0.0,
     return new_w32.astype(weight.dtype), new_mom, new_w32
 
 
-@register("adam_update", arg_names=("weight", "grad", "mean", "var"),
+@register("adam_update", traced_attrs=('lr', 'beta1', 'beta2', 'epsilon', 'wd', 'rescale_grad'), arg_names=("weight", "grad", "mean", "var"),
           differentiable=False, state_inputs=(2, 3),
           defaults={**_COMMON, "beta1": 0.9, "beta2": 0.999,
                     "epsilon": 1e-8})
@@ -81,7 +81,7 @@ def _adam_update(weight, grad, mean, var, lr=0.01, beta1=0.9, beta2=0.999,
     return new_w, new_mean, new_var
 
 
-@register("rmsprop_update", arg_names=("weight", "grad", "n"),
+@register("rmsprop_update", traced_attrs=('lr', 'gamma1', 'epsilon', 'wd', 'rescale_grad'), arg_names=("weight", "grad", "n"),
           differentiable=False, state_inputs=(2,),
           defaults={**_COMMON, "gamma1": 0.95, "epsilon": 1e-8,
                     "clip_weights": -1.0})
@@ -96,7 +96,7 @@ def _rmsprop_update(weight, grad, n, lr=0.01, gamma1=0.95, epsilon=1e-8,
     return new_w, new_n
 
 
-@register("rmspropalex_update", arg_names=("weight", "grad", "n", "g",
+@register("rmspropalex_update", traced_attrs=('lr', 'gamma1', 'gamma2', 'epsilon', 'wd', 'rescale_grad'), arg_names=("weight", "grad", "n", "g",
                                            "delta"),
           differentiable=False, state_inputs=(2, 3, 4),
           defaults={**_COMMON, "gamma1": 0.95, "gamma2": 0.9,
@@ -115,7 +115,7 @@ def _rmspropalex_update(weight, grad, n, g, delta, lr=0.01, gamma1=0.95,
     return new_w, new_n, new_g, new_delta
 
 
-@register("ftrl_update", arg_names=("weight", "grad", "z", "n"),
+@register("ftrl_update", traced_attrs=('lr', 'lamda1', 'beta', 'wd', 'rescale_grad'), arg_names=("weight", "grad", "z", "n"),
           differentiable=False, state_inputs=(2, 3),
           defaults={**_COMMON, "lamda1": 0.01, "beta": 1.0})
 def _ftrl_update(weight, grad, z, n, lr=0.01, lamda1=0.01, beta=1.0,
@@ -133,7 +133,7 @@ def _ftrl_update(weight, grad, z, n, lr=0.01, lamda1=0.01, beta=1.0,
     return new_w, new_z, new_n
 
 
-@register("signsgd_update", arg_names=("weight", "grad"),
+@register("signsgd_update", traced_attrs=('lr', 'wd', 'rescale_grad'), arg_names=("weight", "grad"),
           differentiable=False, defaults=_COMMON)
 def _signsgd_update(weight, grad, lr=0.01, wd=0.0, rescale_grad=1.0,
                     clip_gradient=-1.0, **_):
