@@ -18,7 +18,8 @@ import jax as _jax
 # numerics for f32 and get MXU speed by using bf16 *dtypes* on the perf path
 # (the reference's multi-precision story, mp_sgd_*, maps to this).
 # Override with MXNET_MATMUL_PRECISION=default|high|highest.
-_prec = _os.environ.get("MXNET_MATMUL_PRECISION", "highest")
+from . import config as _config
+_prec = _config.get("MXNET_MATMUL_PRECISION")
 if _prec != "default":
     _jax.config.update("jax_default_matmul_precision",
                        {"high": "bfloat16_3x", "highest": "float32"}.get(

@@ -46,10 +46,16 @@ DTYPE_MX_TO_NP = {v: k for k, v in DTYPE_NP_TO_MX.items()}
 
 
 def env_flag(name, default="0"):
-    """Boolean env var (reference dmlc::GetEnv bool parsing)."""
+    """Boolean config knob (reference dmlc::GetEnv bool parsing).
+    Declared knobs resolve through mxnet_tpu.config (honouring
+    set_override); unknown names fall back to a raw env read."""
     import os
-    return os.environ.get(name, default).strip().lower() in \
-        ("1", "true", "yes", "on")
+    from . import config as _config
+    try:
+        return bool(_config.get(name))
+    except KeyError:
+        return os.environ.get(name, default).strip().lower() in \
+            ("1", "true", "yes", "on")
 
 
 def np_dtype(dtype):

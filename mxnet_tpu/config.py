@@ -1,0 +1,98 @@
+"""Typed runtime configuration (the dmlc::GetEnv analogue — reference:
+dmlc-core GetEnv call sites + docs/how_to/env_var.md).
+
+Every knob the framework reads from the environment is declared here
+with a type, default, and docstring, so the surface is discoverable
+(``mxnet_tpu.config.describe()``) and testable (``set_override``)
+instead of scattered string lookups.
+"""
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass
+
+__all__ = ["define", "get", "set_override", "clear_override", "describe"]
+
+_BOOLY = {"1": True, "true": True, "yes": True, "on": True,
+          "0": False, "false": False, "no": False, "off": False}
+
+
+@dataclass
+class _Knob:
+    name: str
+    typ: type
+    default: object
+    doc: str
+
+
+_REGISTRY: dict[str, _Knob] = {}
+_OVERRIDES: dict[str, object] = {}
+
+
+def define(name, typ, default, doc):
+    """Declare a config knob (idempotent for identical declarations)."""
+    prev = _REGISTRY.get(name)
+    if prev is not None and (prev.typ, prev.default) != (typ, default):
+        raise ValueError("conflicting re-declaration of %s" % name)
+    _REGISTRY[name] = _Knob(name, typ, default, doc)
+    return name
+
+
+def _coerce(knob, raw):
+    if knob.typ is bool:
+        try:
+            return _BOOLY[str(raw).strip().lower()]
+        except KeyError:
+            raise ValueError("%s expects a boolean, got %r"
+                             % (knob.name, raw))
+    return knob.typ(raw)
+
+
+def get(name):
+    """Current value: programmatic override > environment > default."""
+    knob = _REGISTRY[name]
+    if name in _OVERRIDES:
+        return _OVERRIDES[name]
+    raw = os.environ.get(name)
+    return knob.default if raw is None else _coerce(knob, raw)
+
+
+def set_override(name, value):
+    """Set a process-local value that beats the environment (tests,
+    notebooks). Pass through ``define``d knobs only."""
+    knob = _REGISTRY[name]
+    _OVERRIDES[name] = value if value is None else _coerce(knob, value)
+
+
+def clear_override(name=None):
+    if name is None:
+        _OVERRIDES.clear()
+    else:
+        _OVERRIDES.pop(name, None)
+
+
+def describe():
+    """All declared knobs as (name, type, default, doc) rows, sorted."""
+    return [(k.name, k.typ.__name__, k.default, k.doc)
+            for k in sorted(_REGISTRY.values(), key=lambda k: k.name)]
+
+
+# ---------------------------------------------------------------------------
+# declarations (the docs/env_vars.md surface)
+# ---------------------------------------------------------------------------
+define("MXNET_MATMUL_PRECISION", str, "highest",
+       "f32 matmul lowering: highest (full f32) | high (bf16x3) | "
+       "default (bf16, MXU rate)")
+define("MXNET_BACKWARD_DO_MIRROR", bool, False,
+       "rematerialize the forward inside backward (gradient mirroring)")
+define("MXNET_NMS_IMPL", str, "",
+       "MultiBoxDetection NMS impl: pallas | xla (empty = auto: pallas "
+       "on TPU)")
+define("MXNET_NATIVE_RECORDIO", bool, True,
+       "use the native C++ mmap RecordIO reader")
+define("MXNET_PROFILER_AUTOSTART", bool, False,
+       "start profiler collection at import")
+define("MXNET_PROFILER_MODE", bool, False,
+       "False = symbolic executor events only, True = every eager op")
+define("MXNET_PROFILER_XPLANE", str, "",
+       "directory for jax.profiler device traces (empty = disabled)")

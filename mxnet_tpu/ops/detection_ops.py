@@ -280,7 +280,6 @@ def _detect_one(cls_prob, loc_pred, anchors, threshold, clip, variances,
     if not (0 < nms_threshold <= 1):
         return s_rows
 
-    import os
     if impl == "auto":
         # resolved at trace time: the Pallas kernel on TPU, the dense
         # XLA path elsewhere (interpret-mode Pallas is a debug mode, not
@@ -288,9 +287,9 @@ def _detect_one(cls_prob, loc_pred, anchors, threshold, clip, variances,
         # "auto", so changing MXNET_NMS_IMPL after the first call with
         # identical shapes/attrs has no effect — pass impl= explicitly
         # to switch within a process.
-        impl = os.environ.get(
-            "MXNET_NMS_IMPL",
-            "pallas" if jax.default_backend() == "tpu" else "xla")
+        from .. import config as _config
+        impl = _config.get("MXNET_NMS_IMPL") or \
+            ("pallas" if jax.default_backend() == "tpu" else "xla")
     if impl == "pallas":
         # blocked Pallas kernel: one (block, A) IoU tile in VMEM instead
         # of the dense (A, A) matrix in HBM (ops/nms_pallas.py)

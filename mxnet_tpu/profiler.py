@@ -58,7 +58,9 @@ def profiler_set_config(mode="symbolic", filename="profile.json",
         raise ValueError("mode must be 'symbolic' or 'all'")
     _P.mode = mode
     _P.filename = filename
-    _P.xplane_dir = xplane_dir or os.environ.get("MXNET_PROFILER_XPLANE")
+    from . import config as _config
+    _P.xplane_dir = xplane_dir or \
+        _config.get("MXNET_PROFILER_XPLANE") or None
 
 
 def profiler_set_state(state="stop"):
@@ -145,8 +147,9 @@ set_state = profiler_set_state
 dump = dump_profile
 
 
-if os.environ.get("MXNET_PROFILER_AUTOSTART", "0") == "1":
+from . import config as _cfg_mod
+
+if _cfg_mod.get("MXNET_PROFILER_AUTOSTART"):
     profiler_set_config(
-        mode="all" if os.environ.get("MXNET_PROFILER_MODE", "0") == "1"
-        else "symbolic")
+        mode="all" if _cfg_mod.get("MXNET_PROFILER_MODE") else "symbolic")
     profiler_set_state(State.run)

@@ -26,6 +26,8 @@ from collections import namedtuple
 
 import numpy as np
 
+from . import config as _config
+
 __all__ = ["MXRecordIO", "MXIndexedRecordIO", "IRHeader", "pack", "unpack",
            "pack_img", "unpack_img"]
 
@@ -66,7 +68,7 @@ class MXRecordIO:
         self._native = None
         self._cursor = 0
         if (self.flag == "r" and
-                os.environ.get("MXNET_NATIVE_RECORDIO", "1") != "0"):
+                _config.get("MXNET_NATIVE_RECORDIO")):
             try:
                 from ._native import NativeRecordFile
                 self._native = NativeRecordFile(self.uri)

@@ -596,11 +596,26 @@ def Group(symbols):
     return Symbol(entries)
 
 
+# pre-0.9 checkpoints store these per-node without the __dunder__ wrapping
+# (reference: kHiddenKeys, src/nnvm/legacy_json_util.cc:24)
+_LEGACY_HIDDEN_KEYS = ("ctx_group", "lr_mult", "wd_mult", "force_mirroring",
+                       "mirror_stage")
+
+
 def load_json(json_str):
+    """Parse a symbol JSON, upgrading pre-0.9 saves on the fly
+    (reference: UpgradeJSON_* passes, src/nnvm/legacy_json_util.cc):
+    ``param`` dicts become attrs, bare hidden keys (lr_mult, ctx_group,
+    ...) become ``__dunder__`` attrs, and layer nodes saved without
+    their parameter inputs get auto-created variables (v0.8 graphs
+    stored only data edges)."""
     data = json.loads(json_str)
     nodes = []
     for jn in data["nodes"]:
-        attrs = jn.get("attrs", jn.get("param", {})) or {}
+        attrs = dict(jn.get("attrs", jn.get("param", {})) or {})
+        for key in _LEGACY_HIDDEN_KEYS:
+            if key in attrs:
+                attrs["__%s__" % key] = attrs.pop(key)
         misc = {k: v for k, v in attrs.items()
                 if k.startswith("__") and k.endswith("__")}
         op_attrs = {k: v for k, v in attrs.items() if k not in misc}
@@ -610,9 +625,21 @@ def load_json(json_str):
                          misc_attrs=misc)
         else:
             opdef = _reg.get_op(jn["op"])
-            node = _Node(opdef, jn["name"],
-                         _reg.canon_attrs(opdef, op_attrs),
-                         [(nodes[i], oi) for (i, oi, *_v) in jn["inputs"]],
+            canon = _reg.canon_attrs(opdef, op_attrs)
+            inputs = [(nodes[i], oi) for (i, oi, *_v) in jn["inputs"]]
+            expected = opdef.active_args(canon)
+            if expected is not None and len(inputs) < len(expected):
+                # v0.8 upgrade: materialize the missing parameter inputs
+                # (UpgradeJSON_000800_000900). The new variables are not
+                # appended to `nodes` — JSON ids must keep indexing the
+                # original node table. State slots (BN moving stats)
+                # become aux variables, as composition would make them.
+                aux_slots = set(opdef.state_inputs)
+                inputs += [
+                    (_Node(None, "%s_%s" % (jn["name"], arg),
+                           is_aux=expected.index(arg) in aux_slots), 0)
+                    for arg in expected[len(inputs):]]
+            node = _Node(opdef, jn["name"], canon, inputs,
                          misc_attrs=misc)
         nodes.append(node)
     heads = data.get("heads") or [[len(nodes) - 1, 0, 0]]

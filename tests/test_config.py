@@ -1,0 +1,48 @@
+"""Config knob registry (mxnet_tpu/config.py — the dmlc::GetEnv
+analogue)."""
+import os
+
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import config
+
+
+def test_declared_knobs_documented():
+    rows = config.describe()
+    names = [r[0] for r in rows]
+    assert "MXNET_MATMUL_PRECISION" in names
+    assert "MXNET_BACKWARD_DO_MIRROR" in names
+    assert all(r[3] for r in rows), "every knob needs a docstring"
+
+
+def test_env_and_override_precedence(monkeypatch):
+    monkeypatch.setenv("MXNET_NATIVE_RECORDIO", "0")
+    assert config.get("MXNET_NATIVE_RECORDIO") is False
+    config.set_override("MXNET_NATIVE_RECORDIO", "yes")
+    try:
+        assert config.get("MXNET_NATIVE_RECORDIO") is True
+    finally:
+        config.clear_override("MXNET_NATIVE_RECORDIO")
+    assert config.get("MXNET_NATIVE_RECORDIO") is False
+
+
+def test_bool_coercion_rejects_junk(monkeypatch):
+    monkeypatch.setenv("MXNET_PROFILER_AUTOSTART", "maybe")
+    with pytest.raises(ValueError):
+        config.get("MXNET_PROFILER_AUTOSTART")
+
+
+def test_env_flag_routes_through_config():
+    from mxnet_tpu.base import env_flag
+    config.set_override("MXNET_BACKWARD_DO_MIRROR", "1")
+    try:
+        assert env_flag("MXNET_BACKWARD_DO_MIRROR") is True
+    finally:
+        config.clear_override("MXNET_BACKWARD_DO_MIRROR")
+    assert env_flag("MXNET_BACKWARD_DO_MIRROR") is False
+
+
+def test_conflicting_redefine_rejected():
+    with pytest.raises(ValueError):
+        config.define("MXNET_NATIVE_RECORDIO", str, "nope", "conflict")

@@ -153,3 +153,32 @@ def test_variable_shape_attr_used_in_infer():
     fc = sym.FullyConnected(data, num_hidden=2)
     arg_shapes, out_shapes, _ = fc.infer_shape()
     assert out_shapes == [(5, 2)]
+
+
+def test_load_legacy_v08_json():
+    """Pre-0.9 saves: attrs under 'param', layer nodes without parameter
+    inputs, bare hidden keys — the loader upgrades all three (reference
+    src/nnvm/legacy_json_util.cc UpgradeJSON_* passes)."""
+    import json
+    legacy = json.dumps({
+        "nodes": [
+            {"op": "null", "name": "data", "inputs": []},
+            {"op": "FullyConnected", "name": "fc1",
+             "param": {"num_hidden": "8", "lr_mult": "2.0"},
+             "inputs": [[0, 0]]},          # weight/bias edges missing
+            {"op": "Activation", "name": "act",
+             "param": {"act_type": "relu"}, "inputs": [[1, 0]]},
+        ],
+        "heads": [[2, 0, 0]],
+    })
+    sym = mx.sym.load_json(legacy)
+    args = sym.list_arguments()
+    assert args == ["data", "fc1_weight", "fc1_bias"]
+    shapes = sym.infer_shape(data=(4, 3))[0]
+    assert shapes[args.index("fc1_weight")] == (8, 3)
+    # hidden key became a __dunder__ attr
+    assert sym.attr_dict().get("fc1", {}).get("__lr_mult__") == "2.0"
+    # and the upgraded graph executes
+    ex = sym.simple_bind(mx.cpu(), data=(4, 3))
+    out = ex.forward()
+    assert out[0].shape == (4, 8)
