@@ -9,8 +9,6 @@ Blueprint: SURVEY.md at the repo root. Mapping of the reference's layers:
 """
 __version__ = "0.1.0"
 
-import os as _os
-
 import jax as _jax
 
 # MXNet semantics: float32 arrays mean float32 math. JAX's DEFAULT matmul

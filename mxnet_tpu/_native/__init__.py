@@ -19,6 +19,18 @@ _LOCK = threading.Lock()
 _LIBS = {}
 
 
+def _link_flags(src):
+    """Extra linker flags from a leading '// LINK: -lfoo -lbar' comment."""
+    try:
+        with open(src) as f:
+            for line in f.read(4096).splitlines():
+                if line.startswith("// LINK:"):
+                    return line.split(":", 1)[1].split()
+    except OSError:
+        pass
+    return []
+
+
 def _build(name):
     """Compile <name>.cc -> lib<name>.so if missing/stale; None on any
     failure (callers fall back to Python)."""
@@ -32,7 +44,7 @@ def _build(name):
             tmp = "%s.tmp.%d" % (so, os.getpid())
             subprocess.run(
                 ["g++", "-O3", "-std=c++17", "-shared", "-fPIC", src,
-                 "-o", tmp],
+                 "-o", tmp] + _link_flags(src),
                 check=True, capture_output=True, timeout=120)
             os.replace(tmp, so)
         return so

@@ -59,9 +59,12 @@ def get(name):
 
 def set_override(name, value):
     """Set a process-local value that beats the environment (tests,
-    notebooks). Pass through ``define``d knobs only."""
+    notebooks). ``None`` resets to environment/default resolution."""
     knob = _REGISTRY[name]
-    _OVERRIDES[name] = value if value is None else _coerce(knob, value)
+    if value is None:
+        clear_override(name)
+    else:
+        _OVERRIDES[name] = _coerce(knob, value)
 
 
 def clear_override(name=None):
@@ -90,6 +93,9 @@ define("MXNET_NMS_IMPL", str, "",
        "on TPU)")
 define("MXNET_NATIVE_RECORDIO", bool, True,
        "use the native C++ mmap RecordIO reader")
+define("MXNET_NATIVE_IMAGE", bool, True,
+       "use the native C++ batched image decode+crop+resize pipeline "
+       "when the augment list allows it")
 define("MXNET_PROFILER_AUTOSTART", bool, False,
        "start profiler collection at import")
 define("MXNET_PROFILER_MODE", bool, False,
