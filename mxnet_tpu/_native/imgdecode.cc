@@ -179,9 +179,16 @@ int imgd_batch(const uint8_t** bufs, const int64_t* lens, int n,
     std::vector<uint8_t> rgb;
     for (;;) {
       const int i = next.fetch_add(1);
-      if (i >= n) return;
+      if (i >= n || failed.load()) return;  // batch is doomed: stop early
       int h = 0, w = 0;
-      if (!decode_any(bufs[i], lens[i], &rgb, &h, &w)) {
+      bool ok = false;
+      try {
+        ok = decode_any(bufs[i], lens[i], &rgb, &h, &w) &&
+            static_cast<int64_t>(h) * w <= (1ll << 26);  // 64MPix cap
+      } catch (...) {
+        ok = false;  // bad_alloc from absurd claimed dims etc.
+      }
+      if (!ok) {
         int expect = 0;
         failed.compare_exchange_strong(expect, i + 1);
         continue;

@@ -79,3 +79,28 @@ def test_two_process_cluster(tmp_path):
     for wid, (p, out) in enumerate(zip(procs, outs)):
         assert p.returncode == 0, "worker %d failed:\n%s" % (wid, out)
         assert "WORKER_OK %d" % wid in out
+
+
+def test_launch_py_local_mode(tmp_path):
+    """tools/launch.py local mode (dmlc_tracker 'local' analogue): forks
+    N workers with the DMLC_* env and they form one jax.distributed
+    cluster."""
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    worker = tmp_path / "worker.py"
+    worker.write_text(
+        "import os, sys\n"
+        "sys.path.insert(0, %r)\n"
+        "import jax\n"
+        "jax.config.update('jax_platforms', 'cpu')\n"
+        "from mxnet_tpu.parallel import dist\n"
+        "dist.init()\n"
+        "assert dist.size() == 2\n"
+        "print('LAUNCHED-OK', dist.rank())\n" % repo)
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env.pop("DMLC_PS_ROOT_URI", None)
+    out = subprocess.run(
+        [sys.executable, os.path.join(repo, "tools", "launch.py"),
+         "-n", "2", "--launcher", "local", sys.executable, str(worker)],
+        capture_output=True, text=True, timeout=240, env=env)
+    assert out.returncode == 0, out.stderr[-800:]
+    assert out.stdout.count("LAUNCHED-OK") == 2, out.stdout

@@ -1,0 +1,119 @@
+#!/usr/bin/env python
+"""Distributed job launcher (reference: tools/launch.py + dmlc_tracker).
+
+The reference launched scheduler/server/worker processes over
+ssh/mpi/sge/yarn. TPU-native clusters run ONE SPMD program per host, so
+the launcher's job collapses to: pick a coordinator, assign process ids,
+start the same command everywhere with the right env
+(mxnet_tpu.parallel.dist.init() reads it — DMLC_* names kept for
+reference-script compat).
+
+  # N local processes on one host (the dmlc_tracker 'local' mode —
+  # how the multi-process tests run without a cluster):
+  python tools/launch.py -n 4 --launcher local python train.py
+
+  # one process per host over ssh:
+  python tools/launch.py -n 2 --launcher ssh -H hosts.txt python train.py
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import shlex
+import socket
+import subprocess
+import sys
+
+
+def _free_port():
+    with socket.socket() as s:
+        s.bind(("", 0))
+        return s.getsockname()[1]
+
+
+def _worker_env(rank, n, coord_uri, coord_port, extra=()):
+    env = dict(os.environ)
+    env.update({
+        "DMLC_ROLE": "worker",
+        "DMLC_PS_ROOT_URI": coord_uri,
+        "DMLC_PS_ROOT_PORT": str(coord_port),
+        "DMLC_NUM_WORKER": str(n),
+        "DMLC_WORKER_ID": str(rank),
+    })
+    env.update(dict(extra))
+    return env
+
+
+def launch_local(n, command, env_extra=()):
+    """Fork n local worker processes (dmlc_tracker 'local' launcher).
+    If any worker dies, the survivors are killed — a partial cluster
+    would block forever inside jax.distributed.initialize."""
+    import time
+    port = _free_port()
+    procs = [subprocess.Popen(
+        command, env=_worker_env(r, n, "127.0.0.1", port, env_extra))
+        for r in range(n)]
+    rc = 0
+    while True:
+        codes = [p.poll() for p in procs]
+        bad = [c for c in codes if c not in (None, 0)]
+        if bad and any(c is None for c in codes):
+            for p in procs:
+                if p.poll() is None:
+                    p.kill()
+        if all(c is not None for c in codes):
+            rc = next((c for c in codes if c), 0)
+            break
+        time.sleep(0.1)
+    return rc
+
+
+def launch_ssh(n, hosts, command, env_extra=()):
+    """One worker per host over ssh; host 0 is the coordinator."""
+    if len(hosts) < n:
+        raise SystemExit("need %d hosts, got %d" % (n, len(hosts)))
+    port = _free_port()
+    cmd_str = " ".join(shlex.quote(c) for c in command)
+    procs = []
+    for r in range(n):
+        env = _worker_env(r, n, hosts[0], port, env_extra)
+        keys = ["DMLC_ROLE", "DMLC_PS_ROOT_URI", "DMLC_PS_ROOT_PORT",
+                "DMLC_NUM_WORKER", "DMLC_WORKER_ID"] + \
+            [k for k, _ in env_extra]
+        exports = " ".join("%s=%s" % (k, shlex.quote(str(env[k])))
+                           for k in keys)
+        procs.append(subprocess.Popen(
+            ["ssh", "-o", "StrictHostKeyChecking=no", hosts[r],
+             "cd %s && env %s %s" % (shlex.quote(os.getcwd()), exports,
+                                     cmd_str)]))
+    rc = 0
+    for p in procs:
+        rc = p.wait() or rc
+    return rc
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        description="launch a distributed mxnet_tpu job")
+    ap.add_argument("-n", "--num-workers", type=int, required=True)
+    ap.add_argument("--launcher", choices=("local", "ssh"),
+                    default="local")
+    ap.add_argument("-H", "--hostfile",
+                    help="one host per line (ssh launcher)")
+    ap.add_argument("--env", action="append", default=[],
+                    metavar="K=V", help="extra env for every worker")
+    ap.add_argument("command", nargs=argparse.REMAINDER)
+    args = ap.parse_args(argv)
+    if not args.command:
+        ap.error("no command given")
+    extra = [kv.split("=", 1) for kv in args.env]
+
+    if args.launcher == "local":
+        return launch_local(args.num_workers, args.command, extra)
+    with open(args.hostfile) as f:
+        hosts = [ln.strip() for ln in f if ln.strip()]
+    return launch_ssh(args.num_workers, hosts, args.command, extra)
+
+
+if __name__ == "__main__":
+    sys.exit(main())
