@@ -66,6 +66,47 @@ class KVStore:
         self._optimizer = None
         self._barrier_before_exit = True
 
+    def _world(self):
+        """Process count when this is a dist store inside a cluster."""
+        if not self.type.startswith("dist"):
+            return 1
+        return self.num_workers
+
+    @staticmethod
+    def _cross_process_sum(arr_nd):
+        """Sum an array across all worker processes (the server-side
+        aggregation of the reference's dist_sync,
+        kvstore_dist_server.h:247-390 — collapsed to one collective).
+
+        Scaling note: this eager per-key path allgathers (world, *shape)
+        then sums — fine for the modest worker counts the push/pull API
+        is kept for; pod-scale training uses the compiled SPMD TrainStep
+        whose gradient psum rides ICI inside the step."""
+        import jax.numpy as jnp
+        from jax.experimental import multihost_utils
+        from . import ndarray as _nd
+        stacked = multihost_utils.process_allgather(arr_nd._data)
+        return _nd.array(jnp.sum(stacked, axis=0))
+
+    @staticmethod
+    def _broadcast_from_root(arr_nd):
+        """Rank 0's array wins cluster-wide (reference
+        KVStoreDist::InitImpl — only rank 0 pushes the init value)."""
+        from jax.experimental import multihost_utils
+        from . import ndarray as _nd
+        return _nd.array(multihost_utils.broadcast_one_to_all(
+            arr_nd._data))
+
+    @staticmethod
+    def _reject_sparse_dist(val, what):
+        from . import ndarray as _nd
+        if isinstance(val, _nd.sparse.BaseSparseNDArray):
+            raise NotImplementedError(
+                "sparse %s through a dist kvstore is not supported — "
+                "variable-nnz buffers have no fixed-shape collective; "
+                "use a local kvstore (in-process reduce keeps sparsity) "
+                "or dense arrays for the distributed path" % what)
+
     # -- identity ----------------------------------------------------------
     @property
     def rank(self):
@@ -94,7 +135,11 @@ class KVStore:
         for k, vlist in zip(keys, vals):
             if k in self._store:
                 raise ValueError("duplicate init of key %r" % (k,))
-            self._store[k] = vlist[0].copy()
+            first = vlist[0].copy()
+            if self._world() > 1:
+                self._reject_sparse_dist(first, "init")
+                first = self._broadcast_from_root(first)
+            self._store[k] = first
 
     def push(self, key, value, priority=0):
         """Push (sum-reduce device copies, then apply updater if set) —
@@ -116,6 +161,14 @@ class KVStore:
                 # one fused reduction op; on a sharded mesh this is the
                 # all-reduce (reference: CommCPU::Reduce OMP tree sum)
                 merged = ndarray.add_n(*vlist)
+            if self._world() > 1:
+                # dist_sync: aggregate across workers before the update —
+                # every worker then applies the identical update to its
+                # replica (equivalent to the reference's server-side
+                # apply + pull). Sparse pushes fail loudly rather than
+                # silently skipping the cross-worker sum.
+                self._reject_sparse_dist(merged, "push")
+                merged = self._cross_process_sum(merged)
             if self._updater is not None:
                 # updater mutates the stored weight in place
                 self._updater(k, merged, self._store[k])

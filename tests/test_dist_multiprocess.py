@@ -30,6 +30,18 @@ np.testing.assert_allclose(np.sort(np.asarray(got).ravel()),
 # kvstore reports cluster identity through the same plumbing
 kv = mx.kv.create("dist_sync")
 assert kv.num_workers == 2 and kv.rank == rank
+
+# dist_sync value semantics (reference tests/nightly/dist_sync_kvstore.py):
+# init broadcasts rank 0's value; push sums across workers exactly
+init_val = mx.nd.ones((3, 2)) * (100 + rank)   # ranks disagree on purpose
+kv.init("w", init_val)
+out = mx.nd.zeros((3, 2))
+kv.pull("w", out=out)
+np.testing.assert_allclose(out.asnumpy(), 100.0)   # rank 0 won
+
+kv.push("w", mx.nd.ones((3, 2)) * (rank + 1))      # 1 + 2 across workers
+kv.pull("w", out=out)
+np.testing.assert_allclose(out.asnumpy(), 3.0)
 print("WORKER_OK", rank)
 """
 
