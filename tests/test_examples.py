@@ -1,0 +1,30 @@
+"""Smoke-run the fast self-checking examples as subprocesses (each
+asserts its own success metric; reference analogue: the nightly
+tutorial/test_all.sh sweep). Long-running examples (bucketing, SPMD
+resnet, transformer LM) have dedicated tests elsewhere.
+"""
+import os
+import subprocess
+import sys
+
+import pytest
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+FAST_EXAMPLES = [
+    "custom_op_softmax.py",
+    "adversary_fgsm.py",
+    "profile_model.py",
+    "gan_toy.py",
+]
+
+
+@pytest.mark.parametrize("script", FAST_EXAMPLES)
+def test_example_runs(script, tmp_path):
+    env = dict(os.environ, PYTHONPATH=_REPO, JAX_PLATFORMS="cpu")
+    args = [sys.executable, os.path.join(_REPO, "examples", script)]
+    if script == "profile_model.py":
+        args.append(str(tmp_path / "trace.json"))
+    out = subprocess.run(args, capture_output=True, text=True,
+                         timeout=300, env=env, cwd=str(tmp_path))
+    assert out.returncode == 0, (out.stdout[-500:], out.stderr[-800:])
