@@ -1,0 +1,20 @@
+"""jax API compatibility shims shared by the parallel modules."""
+from __future__ import annotations
+
+from jax import lax
+
+try:                                     # jax>=0.6 moved shard_map up
+    from jax import shard_map as shard_map
+except ImportError:                      # pragma: no cover
+    from jax.experimental.shard_map import shard_map  # noqa: F401
+
+
+def pvary(x, axes):
+    """Mark a value device-varying over mesh axes (jax 0.9 renames
+    lax.pvary -> lax.pcast(..., to=varying))."""
+    if hasattr(lax, "pcast"):
+        try:
+            return lax.pcast(x, to=axes)
+        except TypeError:                # pragma: no cover - older sig
+            pass
+    return lax.pvary(x, axes)

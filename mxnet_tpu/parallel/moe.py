@@ -1,0 +1,93 @@
+"""Mixture-of-experts FFN with expert parallelism (Switch-style top-1
+routing over ``lax.all_to_all``).
+
+New TPU-native capability (SURVEY §2.3: the reference has no MoE/expert
+parallelism). Experts shard over a mesh axis; each device routes its
+local tokens, packs them into per-expert capacity buffers, exchanges
+buffers with one all_to_all (ICI), runs its resident experts' FFN, and
+all_to_alls results back — the canonical TPU MoE dataflow (Shazeer et
+al. 2017; Fedus et al., Switch Transformer, 2021).
+
+Top-1 routing with capacity dropping: tokens beyond an expert's
+capacity contribute zeros (add the usual residual connection around the
+layer so dropped tokens pass through).
+"""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from ._compat import shard_map as _shard_map
+
+__all__ = ["moe_ffn"]
+
+
+def _route(x, gate_w, num_experts, capacity):
+    """Top-1 routing of local tokens: returns (expert_id, slot, keep,
+    gate_prob) per token — slot is the token's position in its expert's
+    capacity buffer, assigned in token order (first come first served,
+    the Switch discipline)."""
+    probs = jax.nn.softmax(
+        (x.astype(jnp.float32) @ gate_w.astype(jnp.float32)), axis=-1)
+    gate = jnp.max(probs, axis=-1)
+    expert = jnp.argmax(probs, axis=-1)
+    onehot = jax.nn.one_hot(expert, num_experts, dtype=jnp.int32)
+    slot = jnp.sum((jnp.cumsum(onehot, axis=0) - 1) * onehot, axis=-1)
+    keep = slot < capacity
+    return expert, jnp.clip(slot, 0, capacity - 1), keep, gate
+
+
+def moe_ffn(x, gate_w, w1, w2, mesh, axis_name="expert",
+            capacity_factor=1.25):
+    """Expert-parallel MoE FFN.
+
+    x: (T, D) tokens, T sharded over ``axis_name``.
+    gate_w: (D, E) router weights (replicated).
+    w1: (E, D, H), w2: (E, H, D) expert weights, E sharded over the axis.
+    Returns (T, D) with x's sharding; dropped-capacity tokens yield 0.
+    """
+    n = mesh.shape[axis_name]
+    E = gate_w.shape[1]
+    if E % n:
+        raise ValueError("num_experts %d must divide over %d devices"
+                         % (E, n))
+
+    def local(xl, gw, w1l, w2l):
+        # xl (Tl, D); w1l (El, D, H); w2l (El, H, D)
+        Tl, D = xl.shape
+        El = E // n
+        cap = max(1, int(math.ceil(Tl * capacity_factor / E)))
+        expert, slot, keep, gate = _route(xl, gw, E, cap)
+
+        # pack local tokens into (E, cap, D) dispatch buffers
+        disp = jnp.zeros((E, cap, D), xl.dtype)
+        disp = disp.at[expert, slot].add(
+            jnp.where(keep[:, None], xl, 0))
+        # exchange: device d keeps buffers for its El resident experts
+        # from every sender -> (n senders, El, cap, D)
+        recv = lax.all_to_all(disp.reshape(n, El, cap, D), axis_name,
+                              split_axis=0, concat_axis=0, tiled=False)
+        # recv: (n senders, El, cap, D) -> expert-major token queues
+        tokens = recv.transpose(1, 0, 2, 3).reshape(El, n * cap, D)
+
+        h = jax.nn.relu(jnp.einsum("ecd,edh->ech", tokens, w1l))
+        y = jnp.einsum("ech,ehd->ecd", h, w2l)          # (El, n*cap, D)
+
+        # back to sender-major and return to the owning devices
+        y = y.reshape(El, n, cap, D).transpose(1, 0, 2, 3)
+        back = lax.all_to_all(y, axis_name,
+                              split_axis=0, concat_axis=0, tiled=False)
+        # back: (n expert-groups, El, cap, D); group-major flatten IS
+        # global expert order -> my tokens' buffers (E, cap, D)
+        mine = back.reshape(E, cap, D)
+        out = mine[expert, slot] * gate[:, None].astype(xl.dtype)
+        return jnp.where(keep[:, None], out, 0.0).astype(xl.dtype)
+
+    fn = _shard_map(local, mesh=mesh,
+                    in_specs=(P(axis_name), P(), P(axis_name), P(axis_name)),
+                    out_specs=P(axis_name))
+    return fn(x, gate_w, w1, w2)

@@ -1,0 +1,85 @@
+"""Pipeline parallelism — stages sharded over a mesh axis, GPipe
+microbatch schedule.
+
+New TPU-native capability (SURVEY §2.3: the reference's nearest feature
+is `PartialForward` staged execution + the model-parallel LSTM example;
+it has no pipeline schedule). Each device on the ``pipe`` axis holds ONE
+stage's parameters; microbatches stream through, activations hop to the
+next stage over ``lax.ppermute`` (neighbour ICI links). The bubble is
+the standard (S-1)/(M+S-1) GPipe fraction.
+
+The schedule runs inside ``shard_map`` and is itself jittable/
+differentiable — wrap it in a loss and `jax.grad` works through the
+collectives, so the same function serves train and inference.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from ._compat import pvary as _pvary, shard_map as _shard_map
+
+__all__ = ["pipeline_apply"]
+
+
+def pipeline_apply(stage_fn, stage_params, microbatches, mesh,
+                   axis_name="pipe"):
+    """Run ``stage_fn`` composed over S pipeline stages.
+
+    stage_fn(params_i, x) -> y: one stage's computation; every stage
+        must map (mb, ...) -> (mb, ...) of the same shape/dtype (pad
+        feature dims to a common width if stages differ).
+    stage_params: pytree whose leaves have leading dim S (stage i's
+        slice lives on device i of the axis).
+    microbatches: (M, mb, ...) — M microbatches streamed through.
+    Returns (M, mb, ...): stage S-1's outputs for every microbatch,
+    replicated across the axis.
+
+    Equivalent to ``for p in stages: x = stage_fn(p, x)`` per
+    microbatch (asserted in tests/test_pipeline_moe.py).
+    """
+    S = mesh.shape[axis_name]
+    M = microbatches.shape[0]
+    fwd_perm = [(j, (j + 1) % S) for j in range(S)]
+
+    def local(params, stream):
+        # params: leaves (1, ...) = my stage; stream: (M, mb, ...) the
+        # full microbatch queue (replicated — activations, not params)
+        my = jax.tree.map(lambda l: l[0], params)
+        me = lax.axis_index(axis_name)
+        mb_shape = stream.shape[1:]
+        carry = jnp.zeros(mb_shape, stream.dtype)
+        carry = _pvary(carry, (axis_name,))
+        outs0 = jnp.zeros((M,) + mb_shape, stream.dtype)
+        outs0 = _pvary(outs0, (axis_name,))
+
+        def tick(t, state):
+            carry, outs = state
+            # stage 0 ingests microbatch t (zeros once the stream ends)
+            feed = lax.dynamic_index_in_dim(
+                stream, jnp.minimum(t, M - 1), 0, keepdims=False)
+            feed = jnp.where(t < M, feed, jnp.zeros_like(feed))
+            x = jnp.where(me == 0, feed, carry)
+            y = stage_fn(my, x)
+            # microbatch t reaches the last stage at tick t + S - 1
+            out_slot = t - (S - 1)
+            take = (me == S - 1) & (out_slot >= 0)
+            outs = lax.cond(
+                take,
+                lambda o: lax.dynamic_update_index_in_dim(
+                    o, y, jnp.maximum(out_slot, 0), 0),
+                lambda o: o, outs)
+            carry = lax.ppermute(y, axis_name, fwd_perm)
+            return carry, outs
+
+        _, outs = lax.fori_loop(0, M + S - 1, tick, (carry, outs0))
+        # replicate the last stage's collected outputs to every device
+        return lax.psum(jnp.where(me == S - 1, outs, 0.0), axis_name)
+
+    pspec = jax.tree.map(lambda _: P(axis_name), stage_params)
+    fn = _shard_map(local, mesh=mesh,
+                    in_specs=(pspec, P()),
+                    out_specs=P())
+    return fn(stage_params, microbatches)

@@ -22,10 +22,7 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import PartitionSpec as P
 
-try:                                     # jax>=0.6 moved shard_map up
-    from jax import shard_map as _shard_map
-except ImportError:                      # pragma: no cover
-    from jax.experimental.shard_map import shard_map as _shard_map
+from ._compat import shard_map as _shard_map
 
 __all__ = ["ring_attention"]
 
