@@ -1,0 +1,86 @@
+"""Expert + pipeline parallelism demo (new TPU-native capabilities —
+the reference predates MoE and had no pipeline schedule).
+
+Builds an 8-device CPU mesh, trains a toy MoE regression layer under
+expert parallelism, then streams microbatches through a 4-stage
+pipeline and checks it against serial execution.
+
+Run: XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+     JAX_PLATFORMS=cpu python examples/moe_pipeline_parallel.py
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+os.environ.setdefault("XLA_FLAGS",
+                      "--xla_force_host_platform_device_count=8")
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+jax.config.update("jax_platforms", "cpu")
+
+from jax.sharding import Mesh
+
+from mxnet_tpu.parallel import moe_ffn, pipeline_apply
+
+
+def train_moe():
+    n = 8
+    mesh = Mesh(np.array(jax.devices()[:n]), ("expert",))
+    E, D, H, T = 16, 32, 64, 256
+    rng = np.random.RandomState(0)
+    params = {
+        "gate": jnp.array(rng.randn(D, E).astype(np.float32) * 0.3),
+        "w1": jnp.array(rng.randn(E, D, H).astype(np.float32) * 0.2),
+        "w2": jnp.array(rng.randn(E, H, D).astype(np.float32) * 0.2),
+    }
+    x = jnp.array(rng.randn(T, D).astype(np.float32))
+    target = jnp.tanh(x @ jnp.array(
+        rng.randn(D, D).astype(np.float32) * 0.4))
+
+    def loss_fn(p, x, y):
+        out = x + moe_ffn(x, p["gate"], p["w1"], p["w2"], mesh)
+        return jnp.mean((out - y) ** 2)
+
+    step = jax.jit(lambda p, x, y: (
+        loss_fn(p, x, y),
+        jax.tree.map(lambda pi, g: pi - 0.1 * g, p,
+                     jax.grad(loss_fn)(p, x, y))))
+    first = None
+    for i in range(300):
+        loss, params = step(params, x, target)
+        first = first if first is not None else float(loss)
+    print("moe loss: %.4f -> %.4f over 300 steps" % (first, float(loss)))
+    # gradients flow through the all_to_all routing: steady decrease
+    assert float(loss) < 0.8 * first
+
+
+def run_pipeline():
+    S, M, MB, D = 4, 8, 8, 32
+    mesh = Mesh(np.array(jax.devices()[:S]), ("pipe",))
+    rng = np.random.RandomState(1)
+    stages = (jnp.array(rng.randn(S, D, D).astype(np.float32) * 0.3),
+              jnp.array(rng.randn(S, D).astype(np.float32) * 0.1))
+    x = jnp.array(rng.randn(M, MB, D).astype(np.float32))
+
+    def stage(p, h):
+        return jnp.tanh(h @ p[0] + p[1])
+
+    out = jax.jit(lambda p, v: pipeline_apply(stage, p, v, mesh))(
+        stages, x)
+    ref = x
+    for s in range(S):
+        ref = jnp.tanh(ref @ stages[0][s] + stages[1][s])
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+    print("pipeline over %d stages matches serial (bubble %.0f%%)"
+          % (S, 100 * (S - 1) / (M + S - 1)))
+
+
+if __name__ == "__main__":
+    train_moe()
+    run_pipeline()
