@@ -13,8 +13,11 @@ import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))
-os.environ.setdefault("XLA_FLAGS",
-                      "--xla_force_host_platform_device_count=8")
+if "xla_force_host_platform_device_count" not in \
+        os.environ.get("XLA_FLAGS", ""):
+    os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "") +
+                               " --xla_force_host_platform_device_count=8"
+                               ).strip()
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
 
 import jax
@@ -22,6 +25,9 @@ import jax.numpy as jnp
 import numpy as np
 
 jax.config.update("jax_platforms", "cpu")
+if len(jax.devices()) < 8:
+    raise SystemExit("need >= 8 devices (is "
+                     "xla_force_host_platform_device_count pinned low?)")
 
 from jax.sharding import Mesh
 
@@ -46,10 +52,10 @@ def train_moe():
         out = x + moe_ffn(x, p["gate"], p["w1"], p["w2"], mesh)
         return jnp.mean((out - y) ** 2)
 
-    step = jax.jit(lambda p, x, y: (
-        loss_fn(p, x, y),
-        jax.tree.map(lambda pi, g: pi - 0.1 * g, p,
-                     jax.grad(loss_fn)(p, x, y))))
+    @jax.jit
+    def step(p, x, y):
+        loss, grads = jax.value_and_grad(loss_fn)(p, x, y)
+        return loss, jax.tree.map(lambda pi, g: pi - 0.1 * g, p, grads)
     first = None
     for i in range(300):
         loss, params = step(params, x, target)

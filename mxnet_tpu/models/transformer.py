@@ -46,8 +46,20 @@ def _ffn_block(x, dim, hidden, prefix):
                               name=prefix + "fc2")
 
 
+def _moe_block(x, dim, hidden, num_experts, prefix):
+    """Switch-style MoE FFN (the residual around it lives in the layer
+    loop, so capacity-dropped tokens pass through unchanged)."""
+    gate = sym.Variable(prefix + "gate_weight", shape=(dim, num_experts))
+    w1 = sym.Variable(prefix + "experts_w1_weight",
+                      shape=(num_experts, dim, hidden))
+    w2 = sym.Variable(prefix + "experts_w2_weight",
+                      shape=(num_experts, hidden, dim))
+    return sym.contrib.MoEFFN(x, gate, w1, w2, name=prefix + "moe")
+
+
 def get_symbol(vocab_size, seq_len, num_layers=2, num_heads=4, dim=128,
-               ffn_hidden=None, dropout=0.0, max_len=None):
+               ffn_hidden=None, dropout=0.0, max_len=None,
+               num_experts=0):
     """GPT-style causal LM symbol.
 
     data: (B, T) token ids; softmax_label: (B, T) next-token targets
@@ -57,6 +69,11 @@ def get_symbol(vocab_size, seq_len, num_layers=2, num_heads=4, dim=128,
     pass the same max_len (e.g. the largest bucket) to every bucket's
     get_symbol so the shared pos_embed parameter keeps one shape; each
     bucket slices the first seq_len rows.
+
+    num_experts > 0 swaps each FFN for a Switch-style top-1 MoE
+    (_contrib_MoEFFN); under a mesh the expert dimension shards like
+    any parameter, and the shard_map expert-parallel form lives in
+    parallel.moe_ffn.
     """
     ffn_hidden = ffn_hidden or 4 * dim
     max_len = max_len or seq_len
@@ -78,7 +95,8 @@ def get_symbol(vocab_size, seq_len, num_layers=2, num_heads=4, dim=128,
         a = sym.LayerNorm(x, name=p + "ln1")
         x = x + _attention_block(a, num_heads, dim, p)
         f = sym.LayerNorm(x, name=p + "ln2")
-        ff = _ffn_block(f, dim, ffn_hidden, p)
+        ff = _moe_block(f, dim, ffn_hidden, num_experts, p) \
+            if num_experts else _ffn_block(f, dim, ffn_hidden, p)
         if dropout > 0:
             ff = sym.Dropout(ff, p=dropout)
         x = x + ff

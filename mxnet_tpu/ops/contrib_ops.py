@@ -86,3 +86,25 @@ def _dequantize(data, min_range, max_range, out_type="float32", **_):
     scale = (max_range - min_range) / (hi - lo)
     return ((data.astype(jnp.float32) - lo) * scale + min_range) \
         .astype(np.dtype(out_type))
+
+
+@register("_contrib_MoEFFN",
+          arg_names=("data", "gate_weight", "expert_w1", "expert_w2"),
+          aliases=("_contrib_moe_ffn",),
+          defaults={"capacity_factor": 1.25})
+def _moe_ffn_op(data, gate_weight, expert_w1, expert_w2,
+                capacity_factor=1.25, **_):
+    """Switch-style top-1 mixture-of-experts FFN (single-program form of
+    parallel/moe.py — same routing math, no collectives; under a GSPMD
+    mesh the expert dim shards like any other tensor).
+
+    data (B, T, D) or (N, D); gate_weight (D, E); expert_w1 (E, D, H);
+    expert_w2 (E, H, D). Tokens beyond an expert's capacity
+    (ceil(N * capacity_factor / E)) output zero — pair with a residual.
+    """
+    from ..parallel.moe import dense_moe
+    orig_shape = data.shape
+    x = data.reshape(-1, orig_shape[-1])
+    out = dense_moe(x, gate_weight, expert_w1, expert_w2,
+                    capacity_factor=float(capacity_factor))
+    return out.astype(data.dtype).reshape(orig_shape)

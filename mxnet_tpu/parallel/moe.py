@@ -23,7 +23,7 @@ from jax.sharding import PartitionSpec as P
 
 from ._compat import shard_map as _shard_map
 
-__all__ = ["moe_ffn"]
+__all__ = ["moe_ffn", "dense_moe"]
 
 
 def _route(x, gate_w, num_experts, capacity):
@@ -39,6 +39,25 @@ def _route(x, gate_w, num_experts, capacity):
     slot = jnp.sum((jnp.cumsum(onehot, axis=0) - 1) * onehot, axis=-1)
     keep = slot < capacity
     return expert, jnp.clip(slot, 0, capacity - 1), keep, gate
+
+
+def dense_moe(x, gate_w, w1, w2, capacity_factor=1.25):
+    """Single-program Switch MoE: route local tokens into capacity
+    buffers, run every expert's FFN, combine — the collective-free core
+    shared by the expert-parallel form below and the _contrib_MoEFFN op.
+
+    x (N, D); gate_w (D, E); w1 (E, D, H); w2 (E, H, D) -> (N, D),
+    capacity-dropped tokens zero."""
+    N, D = x.shape
+    E = gate_w.shape[1]
+    cap = max(1, int(math.ceil(N * float(capacity_factor) / E)))
+    expert, slot, keep, gate = _route(x, gate_w, E, cap)
+    disp = jnp.zeros((E, cap, D), x.dtype)
+    disp = disp.at[expert, slot].add(jnp.where(keep[:, None], x, 0))
+    h = jax.nn.relu(jnp.einsum("ecd,edh->ech", disp, w1))
+    y = jnp.einsum("ech,ehd->ecd", h, w2)
+    out = y[expert, slot] * gate[:, None].astype(x.dtype)
+    return jnp.where(keep[:, None], out, 0.0).astype(x.dtype)
 
 
 def moe_ffn(x, gate_w, w1, w2, mesh, axis_name="expert",

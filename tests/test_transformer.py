@@ -141,3 +141,38 @@ def test_predictor_export(tmp_path):
     loaded = mx.predictor.CompiledPredictor.load(str(tmp_path / "lm"))
     got = loaded.forward(data=toks, softmax_label=dummy)[0].asnumpy()
     np.testing.assert_allclose(got, out.asnumpy(), rtol=1e-5, atol=1e-6)
+
+
+def test_moe_transformer_trains():
+    """num_experts swaps FFNs for _contrib_MoEFFN; the LM must still
+    train end-to-end through Module with decreasing loss."""
+    from mxnet_tpu.models import transformer
+    rng = np.random.RandomState(0)
+    V, T, B = 20, 8, 16
+    sym_net = transformer.get_symbol(V, T, num_layers=1, num_heads=2,
+                                     dim=32, num_experts=4)
+    args = sym_net.list_arguments()
+    assert "layer0_gate_weight" in args
+    assert "layer0_experts_w1_weight" in args
+
+    seq = rng.randint(0, V, (64, T + 1))
+    X = seq[:, :-1].astype(np.float32)
+    Y = seq[:, 1:].astype(np.float32)
+    it = mx.io.NDArrayIter(X, Y, batch_size=B, shuffle=True)
+    mod = mx.mod.Module(sym_net, context=mx.cpu())
+    mod.bind(data_shapes=it.provide_data, label_shapes=it.provide_label)
+    mod.init_params(mx.init.Xavier())
+    mod.init_optimizer(optimizer="adam",
+                       optimizer_params={"learning_rate": 5e-3})
+    metric = mx.metric.Perplexity(ignore_label=-1)
+    ppl = []
+    for epoch in range(8):
+        it.reset()
+        metric.reset()
+        for batch in it:
+            mod.forward(batch)
+            mod.update_metric(metric, batch.label)
+            mod.backward()
+            mod.update()
+        ppl.append(metric.get()[1])
+    assert ppl[-1] < ppl[0] * 0.8, ppl
