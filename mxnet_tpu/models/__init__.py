@@ -5,3 +5,8 @@ from . import resnet
 from . import lenet
 from . import mlp
 from . import transformer
+from . import alexnet
+from . import vgg
+from . import mobilenet
+from . import resnext
+from . import inception_bn

@@ -48,12 +48,23 @@ def _ffn_block(x, dim, hidden, prefix):
 
 def _moe_block(x, dim, hidden, num_experts, prefix):
     """Switch-style MoE FFN (the residual around it lives in the layer
-    loop, so capacity-dropped tokens pass through unchanged)."""
+    loop, so capacity-dropped tokens pass through unchanged).
+
+    The 3D expert weights carry explicit per-expert Xavier bounds:
+    suffix-dispatched Xavier would read (E, D, H) as a conv kernel and
+    scale by the D*H "receptive field" — ~sqrt(hidden) too small."""
+    from .. import initializer as init_mod
+
+    def xavier(fan_in, fan_out):
+        return init_mod.Uniform(scale=(6.0 / (fan_in + fan_out)) ** 0.5)
+
     gate = sym.Variable(prefix + "gate_weight", shape=(dim, num_experts))
     w1 = sym.Variable(prefix + "experts_w1_weight",
-                      shape=(num_experts, dim, hidden))
+                      shape=(num_experts, dim, hidden),
+                      init=xavier(dim, hidden))
     w2 = sym.Variable(prefix + "experts_w2_weight",
-                      shape=(num_experts, hidden, dim))
+                      shape=(num_experts, hidden, dim),
+                      init=xavier(hidden, dim))
     return sym.contrib.MoEFFN(x, gate, w1, w2, name=prefix + "moe")
 
 

@@ -41,23 +41,36 @@ def _route(x, gate_w, num_experts, capacity):
     return expert, jnp.clip(slot, 0, capacity - 1), keep, gate
 
 
+def _dispatch(x, expert, slot, keep, num_buckets, cap):
+    """Scatter kept tokens into (num_buckets, cap, D) capacity
+    buffers."""
+    disp = jnp.zeros((num_buckets, cap, x.shape[-1]), x.dtype)
+    return disp.at[expert, slot].add(jnp.where(keep[:, None], x, 0))
+
+
+def _combine(y, expert, slot, keep, gate, dtype):
+    """Gather each token's expert output back, gated; dropped tokens
+    zero."""
+    out = y[expert, slot] * gate[:, None].astype(dtype)
+    return jnp.where(keep[:, None], out, 0.0).astype(dtype)
+
+
 def dense_moe(x, gate_w, w1, w2, capacity_factor=1.25):
     """Single-program Switch MoE: route local tokens into capacity
-    buffers, run every expert's FFN, combine — the collective-free core
-    shared by the expert-parallel form below and the _contrib_MoEFFN op.
+    buffers, run every expert's FFN, combine. Shares _route/_dispatch/
+    _combine with the expert-parallel moe_ffn below (which inserts the
+    all_to_all exchanges between the same stages).
 
     x (N, D); gate_w (D, E); w1 (E, D, H); w2 (E, H, D) -> (N, D),
     capacity-dropped tokens zero."""
-    N, D = x.shape
+    N = x.shape[0]
     E = gate_w.shape[1]
     cap = max(1, int(math.ceil(N * float(capacity_factor) / E)))
     expert, slot, keep, gate = _route(x, gate_w, E, cap)
-    disp = jnp.zeros((E, cap, D), x.dtype)
-    disp = disp.at[expert, slot].add(jnp.where(keep[:, None], x, 0))
+    disp = _dispatch(x, expert, slot, keep, E, cap)
     h = jax.nn.relu(jnp.einsum("ecd,edh->ech", disp, w1))
     y = jnp.einsum("ech,ehd->ecd", h, w2)
-    out = y[expert, slot] * gate[:, None].astype(x.dtype)
-    return jnp.where(keep[:, None], out, 0.0).astype(x.dtype)
+    return _combine(y, expert, slot, keep, gate, x.dtype)
 
 
 def moe_ffn(x, gate_w, w1, w2, mesh, axis_name="expert",
@@ -81,11 +94,7 @@ def moe_ffn(x, gate_w, w1, w2, mesh, axis_name="expert",
         El = E // n
         cap = max(1, int(math.ceil(Tl * capacity_factor / E)))
         expert, slot, keep, gate = _route(xl, gw, E, cap)
-
-        # pack local tokens into (E, cap, D) dispatch buffers
-        disp = jnp.zeros((E, cap, D), xl.dtype)
-        disp = disp.at[expert, slot].add(
-            jnp.where(keep[:, None], xl, 0))
+        disp = _dispatch(xl, expert, slot, keep, E, cap)
         # exchange: device d keeps buffers for its El resident experts
         # from every sender -> (n senders, El, cap, D)
         recv = lax.all_to_all(disp.reshape(n, El, cap, D), axis_name,
@@ -103,8 +112,7 @@ def moe_ffn(x, gate_w, w1, w2, mesh, axis_name="expert",
         # back: (n expert-groups, El, cap, D); group-major flatten IS
         # global expert order -> my tokens' buffers (E, cap, D)
         mine = back.reshape(E, cap, D)
-        out = mine[expert, slot] * gate[:, None].astype(xl.dtype)
-        return jnp.where(keep[:, None], out, 0.0).astype(xl.dtype)
+        return _combine(mine, expert, slot, keep, gate, xl.dtype)
 
     fn = _shard_map(local, mesh=mesh,
                     in_specs=(P(axis_name), P(), P(axis_name), P(axis_name)),

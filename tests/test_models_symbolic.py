@@ -1,0 +1,50 @@
+"""Symbolic model catalog (models/) — each must build, infer shapes, and
+run one forward+backward step (reference analogue:
+example/image-classification/symbols/*)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.models import (alexnet, inception_bn, mobilenet, resnext,
+                              vgg)
+
+CASES = [
+    ("alexnet", lambda: alexnet.get_symbol(10), (2, 3, 224, 224)),
+    ("vgg11", lambda: vgg.get_symbol(10, num_layers=11), (2, 3, 64, 64)),
+    ("vgg16bn", lambda: vgg.get_symbol(10, num_layers=16,
+                                       batch_norm=True), (2, 3, 32, 32)),
+    ("mobilenet", lambda: mobilenet.get_symbol(10, multiplier=0.25),
+     (2, 3, 64, 64)),
+    ("resnext50", lambda: resnext.get_symbol(10, num_layers=50,
+                                             cardinality=4,
+                                             bottleneck_width=4),
+     (2, 3, 64, 64)),
+    ("inception_bn", lambda: inception_bn.get_symbol(10),
+     (2, 3, 128, 128)),
+]
+
+
+@pytest.mark.parametrize("name,build,shape",
+                         CASES, ids=[c[0] for c in CASES])
+def test_model_forward_backward(name, build, shape):
+    net = build()
+    arg_shapes, out_shapes, _ = net.infer_shape(
+        data=shape, softmax_label=(shape[0],))
+    assert out_shapes[0] == (shape[0], 10)
+    ex = net.simple_bind(mx.cpu(), data=shape,
+                         softmax_label=(shape[0],),
+                         grad_req="write")
+    rng = np.random.RandomState(0)
+    for k, v in ex.arg_dict.items():
+        if k == "data":
+            v[:] = rng.randn(*v.shape).astype(np.float32) * 0.1
+        elif k == "softmax_label":
+            v[:] = rng.randint(0, 10, v.shape).astype(np.float32)
+        elif v.ndim >= 1:
+            v[:] = rng.randn(*v.shape).astype(np.float32) * 0.05
+    out = ex.forward(is_train=True)[0].asnumpy()
+    assert np.isfinite(out).all()
+    np.testing.assert_allclose(out.sum(1), 1.0, rtol=1e-3)
+    ex.backward()
+    g = ex.grad_dict[[k for k in ex.grad_dict if "weight" in k][0]]
+    assert np.isfinite(g.asnumpy()).all()
