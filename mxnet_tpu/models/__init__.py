@@ -10,3 +10,4 @@ from . import vgg
 from . import mobilenet
 from . import resnext
 from . import inception_bn
+from . import inception_v3

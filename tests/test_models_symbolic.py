@@ -5,8 +5,8 @@ import numpy as np
 import pytest
 
 import mxnet_tpu as mx
-from mxnet_tpu.models import (alexnet, inception_bn, mobilenet, resnext,
-                              vgg)
+from mxnet_tpu.models import (alexnet, inception_bn, inception_v3,
+                              mobilenet, resnext, vgg)
 
 CASES = [
     ("alexnet", lambda: alexnet.get_symbol(10), (2, 3, 224, 224)),
@@ -21,6 +21,9 @@ CASES = [
      (2, 3, 64, 64)),
     ("inception_bn", lambda: inception_bn.get_symbol(10),
      (2, 3, 128, 128)),
+    # 139px keeps the CPU test fast; global pooling absorbs the grid size
+    ("inception_v3", lambda: inception_v3.get_symbol(10),
+     (2, 3, 139, 139)),
 ]
 
 
