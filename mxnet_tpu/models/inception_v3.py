@@ -69,8 +69,10 @@ def _grid_reduce_b(x, name):
     return sym.Concat(t3, t7, tp, dim=1)
 
 
-def _module_c(x, name):
-    """8x8 module with split 3x3 (1x3 | 3x1) towers."""
+def _module_c(x, name, pool_kind):
+    """8x8 module with split 3x3 (1x3 | 3x1) towers. The reference uses
+    an avg pool tower in the first of these modules and max in the
+    second."""
     t1 = _conv(x, name + "_1x1", 320, (1, 1))
     t3 = _conv(x, name + "_3r", 384, (1, 1))
     t3a = _conv(t3, name + "_3a", 384, (1, 3), pad=(0, 1))
@@ -79,7 +81,7 @@ def _module_c(x, name):
     td = _conv(td, name + "_d3", 384, (3, 3), pad=(1, 1))
     tda = _conv(td, name + "_d3a", 384, (1, 3), pad=(0, 1))
     tdb = _conv(td, name + "_d3b", 384, (3, 1), pad=(1, 0))
-    tp = _conv(_pool(x, "avg"), name + "_proj", 192, (1, 1))
+    tp = _conv(_pool(x, pool_kind), name + "_proj", 192, (1, 1))
     return sym.Concat(t1, t3a, t3b, tda, tdb, tp, dim=1)
 
 
@@ -102,11 +104,10 @@ def get_symbol(num_classes=1000, **_):
     x = _module_b(x, "mixed6", 160)
     x = _module_b(x, "mixed7", 192)
     x = _grid_reduce_b(x, "mixed8")
-    x = _module_c(x, "mixed9")
-    x = _module_c(x, "mixed10")
+    x = _module_c(x, "mixed9", "avg")
+    x = _module_c(x, "mixed10", "max")
 
     x = sym.Pooling(x, global_pool=True, pool_type="avg", kernel=(1, 1))
-    x = sym.Dropout(x, p=0.5)
     x = sym.Flatten(x)
     x = sym.FullyConnected(x, num_hidden=num_classes, name="fc")
     return sym.SoftmaxOutput(x, name="softmax")
