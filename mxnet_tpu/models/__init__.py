@@ -11,3 +11,23 @@ from . import mobilenet
 from . import resnext
 from . import inception_bn
 from . import inception_v3
+
+
+_CATALOG = {
+    "lenet": lenet, "mlp": mlp, "resnet": resnet, "alexnet": alexnet,
+    "vgg": vgg, "mobilenet": mobilenet, "resnext": resnext,
+    "inception-bn": inception_bn, "inception_bn": inception_bn,
+    "inception-v3": inception_v3, "inception_v3": inception_v3,
+    "transformer": transformer,
+}
+
+
+def get_symbol(network, **kwargs):
+    """Build a model symbol by name (the reference train_imagenet.py
+    --network flag pattern: importlib of symbols/<name>.get_symbol)."""
+    try:
+        module = _CATALOG[network]
+    except KeyError:
+        raise ValueError("unknown network %r; choose from %s"
+                         % (network, sorted(_CATALOG)))
+    return module.get_symbol(**kwargs)

@@ -51,3 +51,13 @@ def test_model_forward_backward(name, build, shape):
     ex.backward()
     g = ex.grad_dict[[k for k in ex.grad_dict if "weight" in k][0]]
     assert np.isfinite(g.asnumpy()).all()
+
+
+def test_get_symbol_factory():
+    """models.get_symbol(name) mirrors the reference's --network flag."""
+    from mxnet_tpu import models
+    net = models.get_symbol("vgg", num_classes=7, num_layers=11)
+    assert net.infer_shape(data=(1, 3, 32, 32),
+                           softmax_label=(1,))[1][0] == (1, 7)
+    with pytest.raises(ValueError):
+        models.get_symbol("not-a-network")
