@@ -1,0 +1,22 @@
+"""Guard the driver entry points: dryrun_multichip must keep compiling
+and executing the full SPMD story (dp+tp+sp+pp+ep) on virtual devices —
+this is the artifact the round driver records (MULTICHIP_rNN.json)."""
+import os
+import subprocess
+import sys
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_dryrun_multichip_subprocess():
+    env = dict(os.environ, PYTHONPATH=_REPO)
+    # the entry forces the CPU platform itself (the round-1 failure was
+    # exactly this going unset); no JAX_PLATFORMS needed here
+    env.pop("XLA_FLAGS", None)
+    out = subprocess.run(
+        [sys.executable, "-c",
+         "import __graft_entry__ as g; g.dryrun_multichip(4); "
+         "print('GRAFT-DRYRUN-OK')"],
+        capture_output=True, text=True, timeout=480, env=env, cwd=_REPO)
+    assert out.returncode == 0, out.stderr[-1000:]
+    assert "GRAFT-DRYRUN-OK" in out.stdout
