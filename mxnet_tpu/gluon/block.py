@@ -489,10 +489,42 @@ class SymbolBlock(HybridBlock):
 
     def forward(self, x, *args):
         if isinstance(x, NDArray):
-            return self._call_cached_op(x, *args)
+            try:
+                return self._call_cached_op(x, *args)
+            except DeferredInitializationError:
+                # shapes come from the wrapped symbol itself, not a
+                # hybrid trace — infer and finish init, then retry
+                self._infer_param_shapes(x, *args)
+                return self._call_cached_op(x, *args)
         assert isinstance(x, Symbol)
+        # compose the wrapped graph onto the incoming symbols so a
+        # SymbolBlock nests inside a hybridized parent (reference
+        # SymbolBlock forward composes the cached graph)
         ret = copy.copy(self._cached_graph[1])
+        names = [s.list_outputs()[0] for s in self._cached_graph[0]]
+        ret._compose(**dict(zip(names, (x,) + args)))
         return ret
+
+    def _infer_param_shapes(self, *inputs):
+        syms, out = self._cached_graph
+        feed = {s.list_outputs()[0]: tuple(i.shape)
+                for s, i in zip(syms, inputs)}
+        arg_shapes, _, aux_shapes = out.infer_shape(**feed)
+        known = dict(zip(out.list_arguments(), arg_shapes))
+        known.update(zip(out.list_auxiliary_states(), aux_shapes))
+        for name, p in self.params.items():
+            shape = known.get(name)
+            if shape and (not p.shape or 0 in p.shape):
+                p.shape = tuple(shape)
+            p._finish_deferred_init()
+
+    def _clear_cached_op(self):
+        # a SymbolBlock's graph IS its definition (not re-derivable by
+        # tracing): parent hybridize/cast cache clears must only drop
+        # the compiled op, never the wrapped symbol
+        graph = getattr(self, "_cached_graph", ())
+        super()._clear_cached_op()
+        self._cached_graph = graph
 
     def _call_cached_op(self, *args):
         if self._cached_op is None:

@@ -119,9 +119,18 @@ class Parameter:
 
         with autograd.pause():
             data = nd.zeros(self.shape, dtype=self.dtype)
-            init_mod.create(default_init)(
-                init_mod.InitDesc(self.name,
-                                  {"__init__": init}), data)
+            # an explicit per-param initializer overrides via the
+            # __init__ attr; otherwise the default dispatches by name
+            # suffix (so SymbolBlock-created *_gamma/*_beta/aux params
+            # get their conventional fills, not e.g. Xavier). Names
+            # matching no suffix fall back to the default's weight fill.
+            attrs = {"__init__": init} if init is not None else {}
+            desc = init_mod.InitDesc(self.name, attrs)
+            filler = init_mod.create(default_init)
+            try:
+                filler(desc, data)
+            except ValueError:
+                filler._init_weight(desc, data)
             self._init_impl(data, ctx)
 
     def _init_impl(self, data, ctx_list):
@@ -158,8 +167,9 @@ class Parameter:
             raise ValueError("parameter %s has unknown shape %s and "
                              "allow_deferred_init is off"
                              % (self.name, self.shape))
-        self._deferred_init = (init or self.init or default_init, ctx,
-                               default_init)
+        # keep "no explicit initializer" as None so _finish can fall
+        # back to the default's name-suffix dispatch
+        self._deferred_init = (init or self.init, ctx, default_init)
         if shape_known:
             self._finish_deferred_init()
 

@@ -267,17 +267,21 @@ def test_symbol_block():
     net = mx.sym.Activation(net, act_type="relu")
     sb = gluon.SymbolBlock(net, mx.sym.Variable("data"))
     sb.params.initialize()
-    # fill deferred-shape params by hand
-    for name, p in sb.params.items():
-        if not p.shape or any(s == 0 for s in (p.shape or ())):
-            continue
-    out = None
-    try:
-        out = sb(nd.ones((2, 4)))
-    except gluon.DeferredInitializationError:
-        pass
-    if out is not None:
-        assert out.shape == (2, 8)
+    # deferred shapes resolve from the wrapped symbol on first forward
+    out = sb(nd.ones((2, 4)))
+    assert out.shape == (2, 8)
+
+
+def test_symbol_block_wraps_catalog_model():
+    """SymbolBlock over a models/ builder: strip the training head and
+    run feature extraction (the reference fine-tuning workflow)."""
+    from mxnet_tpu.models import mobilenet
+    feat = mobilenet.get_symbol(10, multiplier=0.25).get_internals()[
+        "fc_output"]
+    blk = gluon.SymbolBlock(feat, [mx.sym.Variable("data")])
+    blk.collect_params().initialize(mx.init.Xavier())
+    out = blk(nd.ones((2, 3, 64, 64)))
+    assert out.shape == (2, 10)
 
 
 def test_initialize_respects_global_initializer():
@@ -297,3 +301,27 @@ def test_param_load_casts_dtype():
     p = gluon.Parameter("w", shape=(4,), dtype=np.float32)
     p._load_init(nd.array(np.arange(4, dtype=np.float64)), None)
     assert p.data().dtype == np.float32
+
+
+def test_symbol_block_nests_in_hybridized_parent():
+    """A SymbolBlock inside a hybridized HybridSequential: the parent's
+    trace composes the wrapped graph onto its input, and hybridize's
+    cache clear must not drop the wrapped symbol (it is the block's
+    definition, not re-derivable)."""
+    inner = mx.sym.Activation(mx.sym.FullyConnected(
+        mx.sym.Variable("data"), name="fc_in", num_hidden=8),
+        act_type="relu")
+    sb = gluon.SymbolBlock(inner, [mx.sym.Variable("data")])
+    net = gluon.nn.HybridSequential()
+    net.add(sb, gluon.nn.Dense(4))
+    net.initialize(mx.init.Xavier())
+    net.hybridize()
+    assert net(nd.ones((2, 6))).shape == (2, 4)
+
+
+def test_unrecognized_param_name_uses_default_fill():
+    """Params whose name matches no suffix (e.g. a PReLU 'alpha') fill
+    with the default initializer's weight rule instead of raising."""
+    p = gluon.Parameter("alpha", shape=(3,))
+    p.initialize()
+    assert p.data().shape == (3,)
