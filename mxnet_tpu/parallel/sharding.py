@@ -18,7 +18,7 @@ import jax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 __all__ = ["make_mesh", "data_parallel_mesh", "param_sharding",
-           "batch_sharding", "replicated"]
+           "batch_sharding", "replicated", "zero1_sharding"]
 
 
 def make_mesh(axis_sizes, devices=None):
@@ -54,6 +54,34 @@ def batch_sharding(mesh, ndim, batch_axis=0):
     spec = [None] * ndim
     spec[batch_axis] = "data"
     return NamedSharding(mesh, P(*spec))
+
+
+def zero1_sharding(mesh, name, shape):
+    """ZeRO-1 sharding for a parameter's optimizer state (and the update).
+
+    TPU mapping of the reference's server-side optimizer: the parameter
+    server sharded big arrays over servers and ran the update where the
+    shard lived (kvstore_dist_server.h:109-433, sync aggregation). Here
+    each data-parallel rank owns a 1/N slice of every optimizer-state
+    tensor: grads reduce-scatter onto the slice, the fused update runs on
+    the slice, and the fresh params all-gather back. Expressed purely as
+    shardings — XLA picks the collectives.
+
+    Rule: start from the parameter's TP spec and additionally partition
+    the first still-unsharded dim divisible by the 'data' axis size.
+    Tensors with no such dim stay on the TP spec (small; not worth a
+    collective).
+    """
+    base = param_sharding(mesh, name, shape).spec
+    if "data" not in mesh.axis_names:
+        return NamedSharding(mesh, base)
+    dsize = mesh.shape["data"]
+    spec = list(base) + [None] * (len(shape) - len(base))
+    for d in range(len(shape)):
+        if spec[d] is None and shape[d] % dsize == 0 and shape[d] >= dsize:
+            spec[d] = "data"
+            return NamedSharding(mesh, P(*spec))
+    return NamedSharding(mesh, base)
 
 
 def param_sharding(mesh, name, shape):

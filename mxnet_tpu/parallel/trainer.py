@@ -45,7 +45,7 @@ class TrainStep:
     def __init__(self, symbol, data_names=("data",),
                  label_names=("softmax_label",), optimizer="sgd",
                  optimizer_params=None, mesh=None, donate=True,
-                 compute_dtype=None, remat=None):
+                 compute_dtype=None, remat=None, optimizer_sharding=None):
         """compute_dtype: cast params+data to this dtype for fwd/bwd
         (e.g. 'bfloat16' for MXU-rate compute) while master weights,
         gradients, optimizer state and BN statistics stay float32 — the
@@ -55,7 +55,17 @@ class TrainStep:
         mirroring, reference MXNET_BACKWARD_DO_MIRROR /
         graph_executor.cc:276-287) — activation memory traded for
         recompute FLOPs, the lever for long sequences / deep nets.
-        Default: the MXNET_BACKWARD_DO_MIRROR env var."""
+        Default: the MXNET_BACKWARD_DO_MIRROR env var.
+
+        optimizer_sharding: None (replicated update on every chip) or
+        'zero1' — optimizer state sharded 1/N along the 'data' mesh axis,
+        grads reduce-scattered onto the owned slice, fused update on the
+        slice, params all-gathered back. The TPU mapping of the
+        reference's server-side optimizer / update_on_kvstore=True path
+        (kvstore_dist_server.h:109-433): state memory drops to 1/N per
+        chip and the update FLOPs shard with it. Same math as the
+        replicated path, equal up to float reduction order (tests
+        assert allclose)."""
         from ..base import env_flag
         self.symbol = symbol
         self.mesh = mesh
@@ -75,6 +85,14 @@ class TrainStep:
         if optimizer not in _OPT_OPS:
             raise ValueError("TrainStep supports fused optimizers %r"
                              % sorted(_OPT_OPS))
+        if optimizer_sharding not in (None, "zero1"):
+            raise ValueError("optimizer_sharding must be None or 'zero1', "
+                             "got %r" % (optimizer_sharding,))
+        if optimizer_sharding == "zero1" and (
+                mesh is None or "data" not in mesh.axis_names):
+            raise ValueError("optimizer_sharding='zero1' needs a mesh "
+                             "with a 'data' axis to shard over")
+        self.optimizer_sharding = optimizer_sharding
         self._n_state, self._opt_op = _OPT_OPS[optimizer]
         # mesh passed through so __shard__/ctx_group annotations lower to
         # sharding constraints inside the step
@@ -106,7 +124,7 @@ class TrainStep:
             v = arr._data if dtype is None else arr._data.astype(dtype)
             params[n] = self._place_param(n, v)
             opt_state[n] = tuple(
-                self._place_param(n, jnp.zeros_like(params[n]))
+                self._place_opt(n, jnp.zeros_like(params[n]))
                 for _ in range(self._n_state))
         for n in self.aux_names:
             init_v = jnp.ones(aux2shape[n], jnp.float32) \
@@ -120,6 +138,15 @@ class TrainStep:
             return value
         return jax.device_put(
             value, shd.param_sharding(self.mesh, name, value.shape))
+
+    def _place_opt(self, name, value):
+        """Optimizer state: ZeRO-1 shards it 1/N over 'data'."""
+        if self.mesh is None:
+            return value
+        if self.optimizer_sharding == "zero1":
+            return jax.device_put(
+                value, shd.zero1_sharding(self.mesh, name, value.shape))
+        return self._place_param(name, value)
 
     def _place_rep(self, value):
         if self.mesh is None:
@@ -148,6 +175,8 @@ class TrainStep:
         data_names = self.data_names
         cdt = self.compute_dtype
         remat = self.remat
+        zero1 = self.optimizer_sharding == "zero1"
+        constrain = jax.lax.with_sharding_constraint
 
         def step(params, opt_state, aux, batch, lr, rng):
             # Module.init_optimizer defaults rescale_grad=1/batch; match
@@ -193,14 +222,30 @@ class TrainStep:
 
             new_params, new_opt = {}, {}
             for n in param_names:
-                res = opt_fn(params[n], grads[n], *opt_state[n],
-                             lr=lr, **attrs)
-                if n_state:
-                    new_params[n] = res[0]
-                    new_opt[n] = tuple(res[1:])
-                else:
-                    new_params[n] = res
-                    new_opt[n] = ()
+                p, g = params[n], grads[n]
+                if zero1:
+                    # reduce-scatter the grad onto the owned 1/N slice,
+                    # run the fused update there, all-gather the result
+                    # back to the parameter's own layout. XLA turns the
+                    # psum+constraint pair into a reduce_scatter and the
+                    # final constraint into an all_gather over 'data'.
+                    zs = shd.zero1_sharding(mesh, n, p.shape)
+                    p = constrain(p, zs)
+                    g = constrain(g, zs)
+                res = opt_fn(p, g, *opt_state[n], lr=lr, **attrs)
+                new_p = res[0] if n_state else res
+                new_s = tuple(res[1:]) if n_state else ()
+                if zero1:
+                    # pin layouts explicitly: fresh params all-gather back
+                    # to the parameter layout; persistent opt state STAYS
+                    # in the 1/N slice (don't leave it to GSPMD output
+                    # propagation — a replicated choice would both break
+                    # the memory claim and force a step-2 recompile)
+                    new_p = constrain(
+                        new_p, shd.param_sharding(mesh, n, new_p.shape))
+                    new_s = tuple(constrain(s, zs) for s in new_s)
+                new_params[n] = new_p
+                new_opt[n] = new_s
             return (new_params, new_opt, new_aux), outs
 
         return step
