@@ -129,7 +129,9 @@ class Parameter:
             filler = init_mod.create(default_init)
             try:
                 filler(desc, data)
-            except ValueError:
+            except init_mod.InitPatternError:
+                # name matches no suffix convention -> weight fill; any
+                # other ValueError is a real error and propagates
                 filler._init_weight(desc, data)
             self._init_impl(data, ctx)
 

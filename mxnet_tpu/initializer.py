@@ -18,9 +18,16 @@ from .base import string_types
 from . import registry as _registry
 from . import random as _random
 
-__all__ = ["InitDesc", "Initializer", "register", "Zero", "One", "Constant",
-           "Uniform", "Normal", "Orthogonal", "Xavier", "MSRAPrelu",
-           "Bilinear", "LSTMBias", "Load", "Mixed"]
+__all__ = ["InitDesc", "InitPatternError", "Initializer", "register",
+           "Zero", "One", "Constant", "Uniform", "Normal", "Orthogonal",
+           "Xavier", "MSRAPrelu", "Bilinear", "LSTMBias", "Load", "Mixed"]
+
+
+class InitPatternError(ValueError):
+    """A parameter name matched no known *weight/*bias/*gamma/*beta
+    suffix. Distinct type so callers that fall back to a plain weight
+    fill (gluon deferred init) don't swallow genuine initializer
+    ValueErrors (bad shape etc.)."""
 
 
 class InitDesc(str):
@@ -128,7 +135,7 @@ class Initializer:
         raise NotImplementedError("Must override _init_weight")
 
     def _init_default(self, name, arr):
-        raise ValueError(
+        raise InitPatternError(
             "Unknown initialization pattern for %s. Default initialization "
             "is now limited to *weight/*bias/*gamma/*beta. Either assign a "
             "name to the variable matching those patterns, or use "

@@ -18,6 +18,20 @@ onto XLA collectives:
   mxnet_tpu.parallel. `dist_async` has no XLA analogue (documented drop;
   SURVEY.md §2.3).
 
+Reference knobs that are deliberately N/A here:
+
+* `local` vs `device` vs `dist_device_sync` pick WHERE the reduce runs
+  (CPU staging tree vs GPU P2P vs server). XLA owns collective placement
+  on TPU, so all accepted type strings collapse to the one jitted
+  reduction above — the distinction is preserved in the API (the type
+  string round-trips) but changes nothing about execution.
+* Big-array key sharding (`MXNET_KVSTORE_BIGARRAY_BOUND`,
+  kvstore_dist.h:438-517) split large tensors across servers to balance
+  PS bandwidth. Collectives have no per-key server hotspot, so the knob
+  has no analogue; the capability it bought (sharded optimizer state /
+  update) is `TrainStep(optimizer_sharding='zero1')` in
+  parallel/trainer.py.
+
 The push/pull/row_sparse_pull/updater API is preserved exactly so
 Module/Gluon training loops are unchanged.
 """

@@ -120,18 +120,20 @@ _b("broadcast_minimum", jnp.minimum, aliases=("_minimum", "_Minimum",
 _b("broadcast_hypot", jnp.hypot, aliases=("_hypot",))
 _b("_grad_add", jnp.add)
 
+# public names (mx.nd.equal & co) match the reference's registrations in
+# src/operator/tensor/elemwise_binary_broadcast_op_logic.cc
 _b("broadcast_equal", lambda l, r: (l == r).astype(l.dtype),
-   aliases=("_equal",), differentiable=False)
+   aliases=("_equal", "equal"), differentiable=False)
 _b("broadcast_not_equal", lambda l, r: (l != r).astype(l.dtype),
-   aliases=("_not_equal",), differentiable=False)
+   aliases=("_not_equal", "not_equal"), differentiable=False)
 _b("broadcast_greater", lambda l, r: (l > r).astype(l.dtype),
-   aliases=("_greater",), differentiable=False)
+   aliases=("_greater", "greater"), differentiable=False)
 _b("broadcast_greater_equal", lambda l, r: (l >= r).astype(l.dtype),
-   aliases=("_greater_equal",), differentiable=False)
+   aliases=("_greater_equal", "greater_equal"), differentiable=False)
 _b("broadcast_lesser", lambda l, r: (l < r).astype(l.dtype),
-   aliases=("_lesser",), differentiable=False)
+   aliases=("_lesser", "lesser"), differentiable=False)
 _b("broadcast_lesser_equal", lambda l, r: (l <= r).astype(l.dtype),
-   aliases=("_lesser_equal",), differentiable=False)
+   aliases=("_lesser_equal", "lesser_equal"), differentiable=False)
 _b("broadcast_logical_and", lambda l, r: ((l != 0) & (r != 0)).astype(l.dtype),
    differentiable=False)
 _b("broadcast_logical_or", lambda l, r: ((l != 0) | (r != 0)).astype(l.dtype),

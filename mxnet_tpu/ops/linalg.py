@@ -86,7 +86,8 @@ def _sumlogdiag(A, **_):
     return jnp.sum(jnp.log(diag), axis=-1)
 
 
-@register("khatri_rao", arg_names=None, aliases=("_khatri_rao",))
+@register("khatri_rao", arg_names=None,
+          aliases=("_khatri_rao", "_contrib_krprod"))
 def _khatri_rao(*args, **_):
     """Column-wise Khatri-Rao product (reference contrib krprod.h)."""
     out = args[0]

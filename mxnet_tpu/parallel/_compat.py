@@ -10,11 +10,8 @@ except ImportError:                      # pragma: no cover
 
 
 def pvary(x, axes):
-    """Mark a value device-varying over mesh axes (jax 0.9 renames
-    lax.pvary -> lax.pcast(..., to=varying))."""
+    """Mark a value device-varying over mesh axes (jax 0.9 deprecates
+    lax.pvary in favour of lax.pcast(x, axes, to='varying'))."""
     if hasattr(lax, "pcast"):
-        try:
-            return lax.pcast(x, to=axes)
-        except TypeError:                # pragma: no cover - older sig
-            pass
-    return lax.pvary(x, axes)
+        return lax.pcast(x, axes, to="varying")
+    return lax.pvary(x, axes)            # pragma: no cover - jax<0.9

@@ -22,7 +22,7 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import PartitionSpec as P
 
-from ._compat import shard_map as _shard_map
+from ._compat import pvary as _pvary, shard_map as _shard_map
 
 __all__ = ["ring_attention"]
 
@@ -42,7 +42,7 @@ def _ring_local(q, k, v, *, axis_name, causal, scale):
     acc0 = jnp.zeros((B, H, Tq, D), jnp.float32)
     # constants enter the loop carry device-varying (their updates vary
     # over the ring axis; shard_map type-checks this)
-    m0, l0, acc0 = (lax.pvary(x, (axis_name,)) for x in (m0, l0, acc0))
+    m0, l0, acc0 = (_pvary(x, (axis_name,)) for x in (m0, l0, acc0))
     perm = [(j, (j + 1) % n) for j in range(n)]
 
     def attend(t, k_cur, v_cur, m, l, acc):

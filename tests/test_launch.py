@@ -1,0 +1,88 @@
+"""Launcher tests (reference: tools/launch.py + dmlc_tracker launch modes,
+reference tools/launch.py:29-96). The ssh transport is mocked — the test
+asserts the wiring (per-rank env, coordinator choice, command quoting),
+not real ssh."""
+import os
+import shlex
+import subprocess
+import sys
+
+import pytest
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+from tools import launch  # noqa: E402
+
+
+class _FakeProc:
+    calls = []
+
+    def __init__(self, argv, env=None):
+        _FakeProc.calls.append((argv, env))
+
+    def wait(self):
+        return 0
+
+    def poll(self):
+        return 0
+
+
+@pytest.fixture
+def fake_popen(monkeypatch):
+    _FakeProc.calls = []
+    monkeypatch.setattr(subprocess, "Popen", _FakeProc)
+    return _FakeProc
+
+
+def test_ssh_two_node_wiring(fake_popen, tmp_path, monkeypatch):
+    hosts = tmp_path / "hosts.txt"
+    hosts.write_text("node-a\nnode-b\nnode-c\n")
+    rc = launch.main(["-n", "2", "--launcher", "ssh", "-H", str(hosts),
+                      "--env", "FOO=a b", "python", "train.py",
+                      "--lr", "0.1"])
+    assert rc == 0
+    assert len(fake_popen.calls) == 2
+    for rank, (argv, env) in enumerate(fake_popen.calls):
+        assert argv[0] == "ssh"
+        assert argv[-2] == ("node-a", "node-b")[rank]
+        remote = argv[-1]
+        # every worker points at host 0 as coordinator, with its own rank
+        assert "DMLC_PS_ROOT_URI=node-a" in remote
+        assert "DMLC_WORKER_ID=%d" % rank in remote
+        assert "DMLC_NUM_WORKER=2" in remote
+        assert "DMLC_ROLE=worker" in remote
+        # --env values and the command survive shell quoting
+        assert shlex.quote("a b") in remote
+        assert remote.endswith("python train.py --lr 0.1")
+    # both workers agree on the coordinator port
+    ports = {argv[-1].split("DMLC_PS_ROOT_PORT=")[1].split()[0]
+             for argv, _ in fake_popen.calls}
+    assert len(ports) == 1
+
+
+def test_ssh_needs_enough_hosts(fake_popen, tmp_path):
+    hosts = tmp_path / "hosts.txt"
+    hosts.write_text("only-one\n")
+    with pytest.raises(SystemExit):
+        launch.main(["-n", "2", "--launcher", "ssh", "-H", str(hosts),
+                     "python", "x.py"])
+
+
+def test_local_env_wiring(fake_popen):
+    rc = launch.main(["-n", "2", "--launcher", "local", "--env",
+                      "BAR=1", "python", "x.py"])
+    assert rc == 0
+    assert len(fake_popen.calls) == 2
+    ranks = set()
+    for argv, env in fake_popen.calls:
+        assert argv == ["python", "x.py"]
+        assert env["DMLC_PS_ROOT_URI"] == "127.0.0.1"
+        assert env["DMLC_NUM_WORKER"] == "2"
+        assert env["BAR"] == "1"
+        ranks.add(env["DMLC_WORKER_ID"])
+    assert ranks == {"0", "1"}
+
+
+def test_env_flag_requires_equals(fake_popen, capsys):
+    with pytest.raises(SystemExit):
+        launch.main(["-n", "1", "--env", "NOVALUE", "python", "x.py"])
+    assert "K=V" in capsys.readouterr().err

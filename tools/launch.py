@@ -106,6 +106,9 @@ def main(argv=None):
     args = ap.parse_args(argv)
     if not args.command:
         ap.error("no command given")
+    for kv in args.env:
+        if "=" not in kv:
+            ap.error("--env expects K=V, got %r" % kv)
     extra = [kv.split("=", 1) for kv in args.env]
 
     if args.launcher == "local":
