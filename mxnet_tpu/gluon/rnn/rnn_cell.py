@@ -2,6 +2,8 @@
 805 LoC)."""
 from __future__ import annotations
 
+from contextlib import contextmanager
+
 from ... import ndarray as nd
 from ... import symbol as sym_mod
 from ...base import string_types
@@ -13,65 +15,80 @@ __all__ = ["RecurrentCell", "HybridRecurrentCell", "RNNCell", "LSTMCell",
 
 
 def _cells_state_info(cells, batch_size):
-    return sum([c.state_info(batch_size) for c in cells], [])
+    return [info for c in cells for info in c.state_info(batch_size)]
 
 
 def _cells_begin_state(cells, **kwargs):
-    return sum([c.begin_state(**kwargs) for c in cells], [])
+    return [s for c in cells for s in c.begin_state(**kwargs)]
 
 
 def _get_begin_state(cell, F, begin_state, inputs, batch_size):
-    if begin_state is None:
-        begin_state = cell.begin_state(func=F.zeros,
-                                       batch_size=batch_size)
-    return begin_state
+    """Default zero initial states when the caller supplied none."""
+    return begin_state if begin_state is not None else \
+        cell.begin_state(func=F.zeros, batch_size=batch_size)
+
+
+@contextmanager
+def _unmodified(cell):
+    """Temporarily lift a cell's modified flag so its own
+    begin_state/unroll can be called from the modifier wrapping it."""
+    cell._modified = False
+    try:
+        yield cell
+    finally:
+        cell._modified = True
 
 
 def _format_sequence(length, inputs, layout, merge, in_layout=None):
-    """Normalize inputs to a list of per-step arrays or one merged array
-    (reference rnn_cell.py:_format_sequence)."""
-    assert inputs is not None, \
-        "unroll(inputs=None) is not supported. Please initialize the " \
-        "cell shape first."
-    axis = layout.find("T")
-    batch_axis = layout.find("N")
-    batch_size = 0
-    in_axis = in_layout.find("T") if in_layout is not None else axis
-    F = None
-    outputs = None
-    if isinstance(inputs, (sym_mod.Symbol,)):
-        F = sym_mod
-        if merge is False:
-            outputs = list(sym_mod.SliceChannel(
-                inputs, axis=in_axis, num_outputs=length,
-                squeeze_axis=1))
-    elif isinstance(inputs, nd.NDArray):
-        F = nd
-        batch_size = inputs.shape[batch_axis]
-        if merge is False:
-            assert length is None or length == inputs.shape[in_axis]
-            seq = nd.SliceChannel(inputs, axis=in_axis,
-                                  num_outputs=inputs.shape[in_axis],
-                                  squeeze_axis=1)
-            outputs = list(seq) if isinstance(seq, (list, tuple)) \
-                else [seq]
-    else:
+    """Bring ``inputs`` into the form ``unroll`` wants.
+
+    Source forms: a per-step list, or one time-merged Symbol/NDArray
+    (time axis taken from ``in_layout`` when it differs from ``layout``).
+    Targets: ``merge=True`` -> one array stacked on ``layout``'s time
+    axis; ``False`` -> per-step list; ``None`` -> keep the source form
+    (merged arrays are still re-laid-out to ``layout``).
+
+    Returns ``(converted, time_axis, F, batch_size)`` — F is the
+    sym/nd namespace the data lives in, batch_size is 0 for symbols
+    (unknown until binding). Capability parity with reference
+    rnn_cell.py:_format_sequence; the conversion logic is organised by
+    source form rather than by namespace.
+    """
+    if inputs is None:
+        raise ValueError("unroll(inputs=None) is not supported; pass the "
+                         "sequence (shape inference happens at bind)")
+    t_axis = layout.find("T")
+    n_axis = layout.find("N")
+    src_t = in_layout.find("T") if in_layout is not None else t_axis
+
+    if isinstance(inputs, (list, tuple)):
+        # per-step list: every element one timestep, no layout ambiguity
         assert length is None or len(inputs) == length
-        if isinstance(inputs[0], sym_mod.Symbol):
-            F = sym_mod
+        F = sym_mod if isinstance(inputs[0], sym_mod.Symbol) else nd
+        batch_size = 0 if F is sym_mod else inputs[0].shape[n_axis]
+        if merge is not True:
+            return list(inputs), t_axis, F, batch_size
+        merged = F.concat(*[F.expand_dims(s, axis=t_axis)
+                            for s in inputs], dim=t_axis)
+        return merged, t_axis, F, batch_size
+
+    # one merged array, time on src_t
+    F = sym_mod if isinstance(inputs, sym_mod.Symbol) else nd
+    batch_size = 0 if F is sym_mod else inputs.shape[n_axis]
+    if merge is False:
+        if F is nd:
+            assert length is None or length == inputs.shape[src_t]
+            n_steps = inputs.shape[src_t]
         else:
-            F = nd
-            batch_size = inputs[0].shape[batch_axis]
-        if merge is True:
-            inputs = F.concat(
-                *[F.expand_dims(i, axis=axis) for i in inputs], dim=axis)
-            in_axis = axis
-    if merge is False and outputs is not None:
-        inputs = outputs
-    if isinstance(inputs, (sym_mod.Symbol, nd.NDArray)) and \
-            axis != in_axis:
-        inputs = F.SwapAxis(inputs, dim1=axis, dim2=in_axis)
-    return inputs, axis, F, batch_size
+            n_steps = length   # symbols need the static step count
+        pieces = F.SliceChannel(inputs, axis=src_t, num_outputs=n_steps,
+                                squeeze_axis=1)
+        if not isinstance(pieces, (list, tuple)):
+            pieces = [pieces]
+        return list(pieces), t_axis, F, batch_size
+    if src_t != t_axis:
+        inputs = F.SwapAxis(inputs, dim1=t_axis, dim2=src_t)
+    return inputs, t_axis, F, batch_size
 
 
 class RecurrentCell(Block):
@@ -99,23 +116,19 @@ class RecurrentCell(Block):
             "instead."
         if func is None:
             func = nd.zeros
-        states = []
-        for info in self.state_info(batch_size):
+
+        def _make(info):
             self._init_counter += 1
-            if info is not None:
-                info = dict(info)
-                info.update(kwargs)
-            else:
-                info = dict(kwargs)
-            info.pop("__layout__", None)
+            spec = {**(info or {}), **kwargs}
+            spec.pop("__layout__", None)
             name = "%sbegin_state_%d" % (self._prefix, self._init_counter)
             try:
-                state = func(name=name, **info)
+                return func(name=name, **spec)
             except TypeError:
                 # ndarray creators take positional shape, no name
-                state = func(info.pop("shape"), **info)
-            states.append(state)
-        return states
+                return func(spec.pop("shape"), **spec)
+
+        return [_make(info) for info in self.state_info(batch_size)]
 
     def unroll(self, length, inputs, begin_state=None, layout="NTC",
                merge_outputs=None):
@@ -126,11 +139,10 @@ class RecurrentCell(Block):
                                                     False)
         begin_state = _get_begin_state(self, F, begin_state, inputs,
                                        batch_size)
-        states = begin_state
-        outputs = []
-        for i in range(length):
-            output, states = self(inputs[i], states)
-            outputs.append(output)
+        outputs, states = [], begin_state
+        for step_in in inputs[:length]:
+            step_out, states = self(step_in, states)
+            outputs.append(step_out)
         outputs, _, _, _ = _format_sequence(length, outputs, layout,
                                             merge_outputs)
         return outputs, states
@@ -160,7 +172,44 @@ class HybridRecurrentCell(RecurrentCell, HybridBlock):
         raise NotImplementedError
 
 
-class RNNCell(HybridRecurrentCell):
+class _GatedCell(HybridRecurrentCell):
+    """Shared machinery for the i2h/h2h gate cells (RNN/LSTM/GRU):
+    parameter declaration, NC state descriptors, and the two fused
+    gate projections. Parameter names/shapes match the reference
+    (i2h_weight is (ngates*hidden, input) etc., rnn_cell.py) so
+    checkpoints interoperate; the class factoring is this repo's own."""
+
+    _NGATES = 1
+    _NSTATES = 1
+
+    def __init__(self, hidden_size, input_size, inits, prefix, params):
+        super().__init__(prefix=prefix, params=params)
+        self._hidden_size = hidden_size
+        self._input_size = input_size
+        rows = self._NGATES * hidden_size
+        for pname, shape, init in (
+                ("i2h_weight", (rows, input_size), inits[0]),
+                ("h2h_weight", (rows, hidden_size), inits[1]),
+                ("i2h_bias", (rows,), inits[2]),
+                ("h2h_bias", (rows,), inits[3])):
+            setattr(self, pname, self.params.get(
+                pname, shape=shape, init=init,
+                allow_deferred_init=True))
+
+    def state_info(self, batch_size=0):
+        return [{"shape": (batch_size, self._hidden_size),
+                 "__layout__": "NC"}] * self._NSTATES
+
+    def _projections(self, F, inputs, h_prev, i2h_weight, h2h_weight,
+                     i2h_bias, h2h_bias):
+        rows = self._NGATES * self._hidden_size
+        return (F.FullyConnected(inputs, i2h_weight, i2h_bias,
+                                 num_hidden=rows),
+                F.FullyConnected(h_prev, h2h_weight, h2h_bias,
+                                 num_hidden=rows))
+
+
+class RNNCell(_GatedCell):
     """Elman RNN cell (reference rnn_cell.py:RNNCell)."""
 
     def __init__(self, hidden_size, activation="tanh",
@@ -168,138 +217,86 @@ class RNNCell(HybridRecurrentCell):
                  i2h_bias_initializer="zeros",
                  h2h_bias_initializer="zeros", input_size=0, prefix=None,
                  params=None):
-        super().__init__(prefix=prefix, params=params)
-        self._hidden_size = hidden_size
+        super().__init__(hidden_size, input_size,
+                         (i2h_weight_initializer, h2h_weight_initializer,
+                          i2h_bias_initializer, h2h_bias_initializer),
+                         prefix, params)
         self._activation = activation
-        self._input_size = input_size
-        self.i2h_weight = self.params.get(
-            "i2h_weight", shape=(hidden_size, input_size),
-            init=i2h_weight_initializer, allow_deferred_init=True)
-        self.h2h_weight = self.params.get(
-            "h2h_weight", shape=(hidden_size, hidden_size),
-            init=h2h_weight_initializer, allow_deferred_init=True)
-        self.i2h_bias = self.params.get(
-            "i2h_bias", shape=(hidden_size,),
-            init=i2h_bias_initializer, allow_deferred_init=True)
-        self.h2h_bias = self.params.get(
-            "h2h_bias", shape=(hidden_size,),
-            init=h2h_bias_initializer, allow_deferred_init=True)
-
-    def state_info(self, batch_size=0):
-        return [{"shape": (batch_size, self._hidden_size),
-                 "__layout__": "NC"}]
 
     def _alias(self):
         return "rnn"
 
     def hybrid_forward(self, F, inputs, states, i2h_weight, h2h_weight,
                        i2h_bias, h2h_bias):
-        i2h = F.FullyConnected(inputs, i2h_weight, i2h_bias,
-                               num_hidden=self._hidden_size)
-        h2h = F.FullyConnected(states[0], h2h_weight, h2h_bias,
-                               num_hidden=self._hidden_size)
+        i2h, h2h = self._projections(F, inputs, states[0], i2h_weight,
+                                     h2h_weight, i2h_bias, h2h_bias)
         output = self._get_activation(F, i2h + h2h, self._activation)
         return output, [output]
 
 
-class LSTMCell(HybridRecurrentCell):
+class LSTMCell(_GatedCell):
     """LSTM cell, gate order [i, f, c, o] (reference
     rnn_cell.py:LSTMCell)."""
+
+    _NGATES = 4
+    _NSTATES = 2
 
     def __init__(self, hidden_size, i2h_weight_initializer=None,
                  h2h_weight_initializer=None,
                  i2h_bias_initializer="zeros",
                  h2h_bias_initializer="zeros", input_size=0, prefix=None,
                  params=None):
-        super().__init__(prefix=prefix, params=params)
-        self._hidden_size = hidden_size
-        self._input_size = input_size
-        self.i2h_weight = self.params.get(
-            "i2h_weight", shape=(4 * hidden_size, input_size),
-            init=i2h_weight_initializer, allow_deferred_init=True)
-        self.h2h_weight = self.params.get(
-            "h2h_weight", shape=(4 * hidden_size, hidden_size),
-            init=h2h_weight_initializer, allow_deferred_init=True)
-        self.i2h_bias = self.params.get(
-            "i2h_bias", shape=(4 * hidden_size,),
-            init=i2h_bias_initializer, allow_deferred_init=True)
-        self.h2h_bias = self.params.get(
-            "h2h_bias", shape=(4 * hidden_size,),
-            init=h2h_bias_initializer, allow_deferred_init=True)
-
-    def state_info(self, batch_size=0):
-        return [{"shape": (batch_size, self._hidden_size),
-                 "__layout__": "NC"},
-                {"shape": (batch_size, self._hidden_size),
-                 "__layout__": "NC"}]
+        super().__init__(hidden_size, input_size,
+                         (i2h_weight_initializer, h2h_weight_initializer,
+                          i2h_bias_initializer, h2h_bias_initializer),
+                         prefix, params)
 
     def _alias(self):
         return "lstm"
 
     def hybrid_forward(self, F, inputs, states, i2h_weight, h2h_weight,
                        i2h_bias, h2h_bias):
-        i2h = F.FullyConnected(inputs, i2h_weight, i2h_bias,
-                               num_hidden=4 * self._hidden_size)
-        h2h = F.FullyConnected(states[0], h2h_weight, h2h_bias,
-                               num_hidden=4 * self._hidden_size)
-        gates = i2h + h2h
-        slice_gates = F.SliceChannel(gates, num_outputs=4)
-        in_gate = F.Activation(slice_gates[0], act_type="sigmoid")
-        forget_gate = F.Activation(slice_gates[1], act_type="sigmoid")
-        in_transform = F.Activation(slice_gates[2], act_type="tanh")
-        out_gate = F.Activation(slice_gates[3], act_type="sigmoid")
-        next_c = forget_gate * states[1] + in_gate * in_transform
-        next_h = out_gate * F.Activation(next_c, act_type="tanh")
+        h_prev, c_prev = states
+        i2h, h2h = self._projections(F, inputs, h_prev, i2h_weight,
+                                     h2h_weight, i2h_bias, h2h_bias)
+        gi, gf, gc, go = F.SliceChannel(i2h + h2h, num_outputs=4)
+        sigmoid = lambda g: F.Activation(g, act_type="sigmoid")  # noqa: E731
+        next_c = sigmoid(gf) * c_prev + \
+            sigmoid(gi) * F.Activation(gc, act_type="tanh")
+        next_h = sigmoid(go) * F.Activation(next_c, act_type="tanh")
         return next_h, [next_h, next_c]
 
 
-class GRUCell(HybridRecurrentCell):
+class GRUCell(_GatedCell):
     """GRU cell, gate order [r, z, o] (reference
     rnn_cell.py:GRUCell)."""
+
+    _NGATES = 3
 
     def __init__(self, hidden_size, i2h_weight_initializer=None,
                  h2h_weight_initializer=None,
                  i2h_bias_initializer="zeros",
                  h2h_bias_initializer="zeros", input_size=0, prefix=None,
                  params=None):
-        super().__init__(prefix=prefix, params=params)
-        self._hidden_size = hidden_size
-        self._input_size = input_size
-        self.i2h_weight = self.params.get(
-            "i2h_weight", shape=(3 * hidden_size, input_size),
-            init=i2h_weight_initializer, allow_deferred_init=True)
-        self.h2h_weight = self.params.get(
-            "h2h_weight", shape=(3 * hidden_size, hidden_size),
-            init=h2h_weight_initializer, allow_deferred_init=True)
-        self.i2h_bias = self.params.get(
-            "i2h_bias", shape=(3 * hidden_size,),
-            init=i2h_bias_initializer, allow_deferred_init=True)
-        self.h2h_bias = self.params.get(
-            "h2h_bias", shape=(3 * hidden_size,),
-            init=h2h_bias_initializer, allow_deferred_init=True)
-
-    def state_info(self, batch_size=0):
-        return [{"shape": (batch_size, self._hidden_size),
-                 "__layout__": "NC"}]
+        super().__init__(hidden_size, input_size,
+                         (i2h_weight_initializer, h2h_weight_initializer,
+                          i2h_bias_initializer, h2h_bias_initializer),
+                         prefix, params)
 
     def _alias(self):
         return "gru"
 
     def hybrid_forward(self, F, inputs, states, i2h_weight, h2h_weight,
                        i2h_bias, h2h_bias):
-        prev_state_h = states[0]
-        i2h = F.FullyConnected(inputs, i2h_weight, i2h_bias,
-                               num_hidden=3 * self._hidden_size)
-        h2h = F.FullyConnected(prev_state_h, h2h_weight, h2h_bias,
-                               num_hidden=3 * self._hidden_size)
-        i2h_r, i2h_z, i2h = F.SliceChannel(i2h, num_outputs=3)
-        h2h_r, h2h_z, h2h = F.SliceChannel(h2h, num_outputs=3)
-        reset_gate = F.Activation(i2h_r + h2h_r, act_type="sigmoid")
-        update_gate = F.Activation(i2h_z + h2h_z, act_type="sigmoid")
-        next_h_tmp = F.Activation(i2h + reset_gate * h2h,
-                                  act_type="tanh")
-        next_h = (1. - update_gate) * next_h_tmp + \
-            update_gate * prev_state_h
+        h_prev = states[0]
+        i2h, h2h = self._projections(F, inputs, h_prev, i2h_weight,
+                                     h2h_weight, i2h_bias, h2h_bias)
+        ir, iz, ic = F.SliceChannel(i2h, num_outputs=3)
+        hr, hz, hc = F.SliceChannel(h2h, num_outputs=3)
+        reset = F.Activation(ir + hr, act_type="sigmoid")
+        update = F.Activation(iz + hz, act_type="sigmoid")
+        cand = F.Activation(ic + reset * hc, act_type="tanh")
+        next_h = update * h_prev + (1. - update) * cand
         return next_h, [next_h]
 
 
@@ -319,38 +316,38 @@ class SequentialRNNCell(RecurrentCell):
         assert not self._modified
         return _cells_begin_state(self._children, **kwargs)
 
+    def _split_states(self, states):
+        """Carve the flat state list into per-child slices."""
+        it = iter(states)
+        return [[next(it) for _ in cell.state_info()]
+                for cell in self._children]
+
     def __call__(self, inputs, states):
         self._counter += 1
         next_states = []
-        p = 0
-        for cell in self._children:
+        for cell, sub in zip(self._children, self._split_states(states)):
             assert not isinstance(cell, BidirectionalCell)
-            n = len(cell.state_info())
-            state = states[p:p + n]
-            p += n
-            inputs, state = cell(inputs, state)
-            next_states.append(state)
-        return inputs, sum(next_states, [])
+            inputs, sub = cell(inputs, sub)
+            next_states += sub
+        return inputs, next_states
 
     def unroll(self, length, inputs, begin_state=None, layout="NTC",
                merge_outputs=None):
         self.reset()
         inputs, _, F, batch_size = _format_sequence(length, inputs, layout,
                                                     None)
-        num_cells = len(self._children)
         begin_state = _get_begin_state(self, F, begin_state, inputs,
                                        batch_size)
-        p = 0
         next_states = []
-        for i, cell in enumerate(self._children):
-            n = len(cell.state_info())
-            states = begin_state[p:p + n]
-            p += n
-            inputs, states = cell.unroll(
-                length, inputs=inputs, begin_state=states, layout=layout,
-                merge_outputs=None if i < num_cells - 1
-                else merge_outputs)
-            next_states.extend(states)
+        last = len(self._children) - 1
+        for i, (cell, sub) in enumerate(
+                zip(self._children, self._split_states(begin_state))):
+            # intermediate layers keep whatever form is cheapest
+            # (merge=None); only the last honors merge_outputs
+            inputs, sub = cell.unroll(
+                length, inputs=inputs, begin_state=sub, layout=layout,
+                merge_outputs=merge_outputs if i == last else None)
+            next_states += sub
         return inputs, next_states
 
     def __getitem__(self, i):
@@ -416,12 +413,8 @@ class ModifierCell(HybridRecurrentCell):
 
     def begin_state(self, func=None, **kwargs):
         assert not self._modified
-        if func is None:
-            func = nd.zeros
-        self.base_cell._modified = False
-        begin = self.base_cell.begin_state(func=func, **kwargs)
-        self.base_cell._modified = True
-        return begin
+        with _unmodified(self.base_cell) as base:
+            return base.begin_state(func=func or nd.zeros, **kwargs)
 
     def hybrid_forward(self, F, inputs, states):
         raise NotImplementedError
@@ -448,21 +441,21 @@ class ZoneoutCell(ModifierCell):
         self._prev_output = None
 
     def hybrid_forward(self, F, inputs, states):
-        cell, p_outputs, p_states = (self.base_cell, self.zoneout_outputs,
-                                     self.zoneout_states)
-        next_output, next_states = cell(inputs, states)
+        next_output, next_states = self.base_cell(inputs, states)
 
-        def mask(p, like):
-            return F.Dropout(F.ones_like(like), p=p)
+        def zone(p, new, old):
+            # inverted-dropout mask: where it fires take the fresh
+            # value, elsewhere the zoned-out carry sticks
+            if p == 0.:
+                return new
+            return F.where(F.Dropout(F.ones_like(new), p=p), new, old)
 
-        prev_output = self._prev_output
-        if prev_output is None:
-            prev_output = F.zeros_like(next_output)
-        output = F.where(mask(p_outputs, next_output), next_output,
-                         prev_output) if p_outputs != 0. else next_output
-        new_states = [F.where(mask(p_states, new_s), new_s, old_s)
-                      for new_s, old_s in zip(next_states, states)] \
-            if p_states != 0. else next_states
+        carry = self._prev_output
+        output = zone(self.zoneout_outputs, next_output,
+                      F.zeros_like(next_output) if carry is None
+                      else carry)
+        new_states = [zone(self.zoneout_states, n, o)
+                      for n, o in zip(next_states, states)]
         self._prev_output = output
         return output, new_states
 
@@ -482,21 +475,19 @@ class ResidualCell(ModifierCell):
     def unroll(self, length, inputs, begin_state=None, layout="NTC",
                merge_outputs=None):
         self.reset()
-        self.base_cell._modified = False
-        outputs, states = self.base_cell.unroll(
-            length, inputs=inputs, begin_state=begin_state, layout=layout,
-            merge_outputs=merge_outputs)
-        self.base_cell._modified = True
+        with _unmodified(self.base_cell) as base:
+            outputs, states = base.unroll(
+                length, inputs=inputs, begin_state=begin_state,
+                layout=layout, merge_outputs=merge_outputs)
 
-        merge_outputs = isinstance(outputs, (nd.NDArray, sym_mod.Symbol)) \
-            if merge_outputs is None else merge_outputs
+        # add the skip connection in whatever form the base returned
+        if merge_outputs is None:
+            merge_outputs = not isinstance(outputs, (list, tuple))
         inputs, _, F, _ = _format_sequence(length, inputs, layout,
                                            merge_outputs)
         if merge_outputs:
-            outputs = outputs + inputs
-        else:
-            outputs = [i + j for i, j in zip(outputs, inputs)]
-        return outputs, states
+            return outputs + inputs, states
+        return [o + x for o, x in zip(outputs, inputs)], states
 
 
 class BidirectionalCell(HybridRecurrentCell):
@@ -523,39 +514,36 @@ class BidirectionalCell(HybridRecurrentCell):
     def unroll(self, length, inputs, begin_state=None, layout="NTC",
                merge_outputs=None):
         self.reset()
-        inputs, axis, F, batch_size = _format_sequence(length, inputs,
-                                                       layout, False)
-        begin_state = _get_begin_state(self, F, begin_state, inputs,
+        steps, _, F, batch_size = _format_sequence(length, inputs,
+                                                   layout, False)
+        begin_state = _get_begin_state(self, F, begin_state, steps,
                                        batch_size)
 
-        states = begin_state
-        l_cell, r_cell = self._children
-        l_outputs, l_states = l_cell.unroll(
-            length, inputs=inputs,
-            begin_state=states[:len(l_cell.state_info())],
+        fwd_cell, bwd_cell = self._children
+        n_fwd = len(fwd_cell.state_info())
+        fwd_out, fwd_states = fwd_cell.unroll(
+            length, inputs=steps, begin_state=begin_state[:n_fwd],
             layout=layout, merge_outputs=merge_outputs)
-        r_outputs, r_states = r_cell.unroll(
-            length, inputs=list(reversed(inputs)),
-            begin_state=states[len(l_cell.state_info()):],
+        # run the reverse direction on the flipped sequence, then flip
+        # its per-step outputs back into forward time order
+        bwd_out, bwd_states = bwd_cell.unroll(
+            length, inputs=steps[::-1], begin_state=begin_state[n_fwd:],
             layout=layout, merge_outputs=False)
-        if isinstance(r_outputs, list):
-            r_outputs = list(reversed(r_outputs))
+        bwd_out = bwd_out[::-1]
 
         if merge_outputs is None:
-            merge_outputs = isinstance(l_outputs,
-                                       (nd.NDArray, sym_mod.Symbol))
-            l_outputs, _, _, _ = _format_sequence(None, l_outputs, layout,
-                                                  merge_outputs)
-        r_outputs, _, _, _ = _format_sequence(None, r_outputs, layout,
-                                              merge_outputs)
+            merge_outputs = not isinstance(fwd_out, (list, tuple))
+            fwd_out, _, _, _ = _format_sequence(None, fwd_out, layout,
+                                                merge_outputs)
+        bwd_out, _, _, _ = _format_sequence(None, bwd_out, layout,
+                                            merge_outputs)
 
         if merge_outputs:
-            outputs = F.concat(l_outputs, r_outputs, dim=2)
+            joined = F.concat(fwd_out, bwd_out, dim=2)
         else:
-            outputs = [F.concat(l_o, r_o, dim=1)
-                       for l_o, r_o in zip(l_outputs, r_outputs)]
-        states = l_states + r_states
-        return outputs, states
+            joined = [F.concat(f, b, dim=1)
+                      for f, b in zip(fwd_out, bwd_out)]
+        return joined, fwd_states + bwd_states
 
     def hybrid_forward(self, F, inputs, states):
         raise NotImplementedError

@@ -98,9 +98,10 @@ class Optimizer:
             return (weight_master_copy, self.create_state(index,
                                                           weight_master_copy))
         if weight.dtype == np.float16 and not self.multi_precision:
-            warnings.warn("Accumulating with float16 in optimizer can lead "
-                          "to poor accuracy or slow convergence. Consider "
-                          "using multi_precision=True option of the optimizer")
+            warnings.warn("float16 optimizer state accumulates rounding "
+                          "error (poor accuracy / slow convergence); pass "
+                          "multi_precision=True to keep float32 master "
+                          "weights")
         return self.create_state(index, weight)
 
     def update(self, index, weight, grad, state):
@@ -118,11 +119,11 @@ class Optimizer:
 
     def set_learning_rate(self, lr):
         if self.lr_scheduler is not None:
-            raise UserWarning("LRScheduler of the optimizer has already been "
-                              "defined. Note that set_learning_rate can mutate "
-                              "the value of the learning rate of the optimizer "
-                              "only when the LRScheduler of the optimizer is "
-                              "undefined.")
+            raise UserWarning(
+                "this optimizer's learning rate is driven by an "
+                "LRScheduler; set_learning_rate would be overridden on "
+                "the next update. Adjust the scheduler instead (or "
+                "create the optimizer without one).")
         self.lr = lr
 
     def set_lr_scale(self, args_lrscale):  # pragma: no cover - deprecated
@@ -221,10 +222,10 @@ class SGD(Optimizer):
             return (self.create_state(index, weight_master_copy),
                     weight_master_copy)
         if weight.dtype == np.float16 and not self.multi_precision:
-            warnings.warn("Accumulating with float16 in optimizer can lead "
-                          "to poor accuracy or slow convergence. Consider "
-                          "using multi_precision=True option of the SGD "
-                          "optimizer")
+            warnings.warn("float16 optimizer state accumulates rounding "
+                          "error (poor accuracy / slow convergence); pass "
+                          "multi_precision=True to the SGD optimizer to "
+                          "keep float32 master weights")
         return self.create_state(index, weight)
 
     def _update_impl(self, index, weight, grad, state, multi_precision=False):
