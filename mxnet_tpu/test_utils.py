@@ -94,17 +94,54 @@ def check_numeric_gradient(f, inputs, grads=None, eps=1e-3, rtol=1e-2,
                                    % xi)
 
 
-def check_consistency(fn, inputs, rtol=1e-4, atol=1e-6):
+# default tolerance per compute dtype for the consistency grid: the
+# reference's ctx_list matrix keyed tolerances by fp16/fp32/fp64
+# (test_utils.py check_consistency); bf16 (8-bit mantissa) is the risky
+# axis on TPU the way fp16 was on GPU. float64 is bounded by the
+# baseline's own precision, not by f64.
+_DTYPE_RTOL = {"float64": 1e-6, "float32": 1e-5, "bfloat16": 4e-2,
+               "float16": 1e-2}
+
+
+def check_consistency(fn, inputs, rtol=1e-4, atol=1e-6, dtypes=None):
     """Eager vs jit-compiled consistency — the TPU analogue of the
-    reference's CPU-vs-GPU check (test_utils.py check_consistency)."""
+    reference's CPU-vs-GPU check (test_utils.py check_consistency).
+
+    dtypes: optional list of dtype names (e.g. ["bfloat16"]). Each entry
+    re-runs ``fn`` jitted with float inputs cast to that dtype and
+    compares against the eager baseline at a dtype-scaled tolerance —
+    the cross-dtype consistency matrix of the reference's ctx_list
+    check, with bf16 standing in for fp16.
+    """
     import jax
+    import jax.numpy as jnp
+
+    def _np(x):
+        return x.asnumpy() if isinstance(x, NDArray) else np.asarray(x)
 
     eager = fn(*inputs)
-    jit_out = jax.jit(fn)(*inputs)
-    e = eager.asnumpy() if isinstance(eager, NDArray) else np.asarray(eager)
-    j = jit_out.asnumpy() if isinstance(jit_out, NDArray) else \
-        np.asarray(jit_out)
-    np.testing.assert_allclose(e, j, rtol=rtol, atol=atol)
+    base = _np(eager)   # native dtype: eager vs jit must match exactly
+    np.testing.assert_allclose(base, _np(jax.jit(fn)(*inputs)),
+                               rtol=rtol, atol=atol)
+
+    for dname in dtypes or ():
+        dt = jnp.dtype(dname)
+
+        def cast(x):
+            a = jnp.asarray(_np(x))
+            return a.astype(dt) if jnp.issubdtype(a.dtype,
+                                                  jnp.floating) else a
+
+        out = jax.jit(fn)(*[cast(x) for x in inputs])
+        # compare in float64 so the comparison itself adds no rounding;
+        # tolerance scales with the dtype under test (absolute slack of
+        # the same order covers near-zero outputs)
+        tol = _DTYPE_RTOL.get(dname, 1e-2)
+        np.testing.assert_allclose(
+            base.astype(np.float64), _np(out).astype(np.float64),
+            rtol=tol, atol=max(atol, tol),
+            err_msg="inconsistent vs %s baseline at dtype %s"
+                    % (base.dtype, dname))
     return eager
 
 

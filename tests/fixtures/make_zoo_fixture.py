@@ -1,0 +1,66 @@
+"""Regenerate the tiny pretrained-model fixture.
+
+Run from the repo root:
+    PYTHONPATH=. JAX_PLATFORMS=cpu python tests/fixtures/make_zoo_fixture.py
+
+Produces (committed to git; ~300KB total):
+    zoo_resnet8-symbol.json / zoo_resnet8-0000.params   checkpoint files
+    zoo_resnet8_golden.npz                              input + logits
+
+A seeded, briefly-trained CIFAR-style ResNet-8 stands in for a
+published zoo checkpoint (no network in CI): what the test guards is
+that load_checkpoint -> Predictor and the exported CompiledPredictor
+both reproduce the recorded logits bit-for-tolerance, the reference's
+pretrained inference contract (tests/python/gpu/test_forward.py).
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import numpy as np
+
+import jax
+
+import mxnet_tpu as mx
+from mxnet_tpu.initializer import Xavier
+from mxnet_tpu.models import resnet
+from mxnet_tpu.parallel import make_train_step
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+PREFIX = os.path.join(HERE, "zoo_resnet8")
+
+
+def main():
+    sym = resnet.get_symbol(num_classes=10, num_layers=8,
+                            image_shape=(3, 16, 16))
+    step = make_train_step(sym, optimizer="sgd",
+                           optimizer_params={"momentum": 0.9,
+                                             "rescale_grad": 1.0 / 64})
+    mx.random.seed(1234)
+    np.random.seed(1234)
+    state = step.init_state(Xavier(), {"data": (64, 3, 16, 16),
+                                       "softmax_label": (64,)})
+    rng_np = np.random.RandomState(99)
+    X = rng_np.randn(64, 3, 16, 16).astype(np.float32)
+    y = rng_np.randint(0, 10, 64).astype(np.float32)
+    batch = step.place_batch({"data": X, "softmax_label": y})
+    rng = jax.random.PRNGKey(0)
+    for _ in range(10):   # a few steps so BN stats/params are non-trivial
+        state, _ = step(state, batch, 0.05, rng)
+    params, _opt, aux = state
+
+    from mxnet_tpu import nd
+    arg_params = {k: nd.array(np.asarray(v)) for k, v in params.items()}
+    aux_params = {k: nd.array(np.asarray(v)) for k, v in aux.items()}
+    mx.model.save_checkpoint(PREFIX, 0, sym, arg_params, aux_params)
+
+    probe = rng_np.randn(2, 3, 16, 16).astype(np.float32)
+    pred = mx.predictor.load_checkpoint_predictor(PREFIX, 0)
+    logits = pred.forward(probe)[0].asnumpy()
+    np.savez(PREFIX + "_golden.npz", probe=probe, logits=logits)
+    print("fixture written:", PREFIX, "logits[0,:4] =", logits[0, :4])
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,65 @@
+"""Pretrained-checkpoint inference against committed golden logits —
+the reference's pretrained-zoo forward test
+(tests/python/gpu/test_forward.py) made hermetic: a tiny seeded
+ResNet-8 checkpoint lives in tests/fixtures/ (see make_zoo_fixture.py
+to regenerate), and BOTH deployment paths must reproduce the recorded
+logits:
+
+  1. load_checkpoint -> Predictor       (the MXPredCreate path)
+  2. Predictor.export -> CompiledPredictor.load  (AOT StableHLO reload)
+"""
+import os
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+PREFIX = os.path.join(HERE, "fixtures", "zoo_resnet8")
+
+
+@pytest.fixture(scope="module")
+def golden():
+    blob = np.load(PREFIX + "_golden.npz")
+    return blob["probe"], blob["logits"]
+
+
+def test_checkpoint_predictor_reproduces_golden(golden):
+    probe, want = golden
+    pred = mx.predictor.load_checkpoint_predictor(PREFIX, 0)
+    got = pred.forward(probe)[0].asnumpy()
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+    # softmax output: rows are distributions
+    np.testing.assert_allclose(got.sum(axis=1), 1.0, rtol=1e-5)
+
+
+def test_compiled_predictor_reproduces_golden(golden, tmp_path):
+    probe, want = golden
+    pred = mx.predictor.load_checkpoint_predictor(PREFIX, 0)
+    prefix = str(tmp_path / "zoo_resnet8_aot")
+    pred.export(prefix, {"data": probe.shape})
+
+    reloaded = mx.predictor.CompiledPredictor.load(prefix)
+    got = reloaded.forward(probe)[0].asnumpy()
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+    assert reloaded.output_names == pred.output_names
+
+
+def test_module_load_checkpoint_reproduces_golden(golden):
+    """Module.load path (the fit-resume surface) gives the same
+    numbers as the predictor path."""
+    probe, want = golden
+    sym, arg_params, aux_params = mx.model.load_checkpoint(PREFIX, 0)
+    mod = mx.mod.Module(sym, context=mx.cpu(),
+                        label_names=("softmax_label",))
+    mod.bind(data_shapes=[("data", probe.shape)],
+             label_shapes=[("softmax_label", (probe.shape[0],))],
+             for_training=False)
+    mod.set_params(arg_params, aux_params)
+    from mxnet_tpu import io, nd
+    batch = io.DataBatch([nd.array(probe)],
+                         [nd.zeros((probe.shape[0],))])
+    mod.forward(batch, is_train=False)
+    got = mod.get_outputs()[0].asnumpy()
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
