@@ -1,14 +1,24 @@
-"""Headline benchmark: ResNet-50 training throughput (img/s) on one chip.
+"""Headline benchmark. Default: ResNet-50 training throughput (img/s) on
+one chip — same contract as always, ONE JSON line
+{"metric", "value", "unit", "vs_baseline"}.
 
-Baseline: reference MXNet trains ResNet-50 at 109 img/s (batch 32) on one
-K80 (BASELINE.md; example/image-classification/README.md:147-155). Same
-workload here: full fwd+bwd+SGD-momentum update, synthetic ImageNet batch
-(the reference's own benchmark mode, train_imagenet.py --benchmark 1).
+--network selects any catalog workload, mirroring the reference's
+baseline table (example/image-classification/README.md:147-156) plus the
+compute-dense transformer LM:
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"} (plus
-step-time / MFU diagnostics). On backend failure prints a diagnostic JSON
-line instead of a stack trace, still rc!=0 so the driver records the error.
+    python bench.py                          # resnet-50 (driver default)
+    python bench.py --network resnet-18      # other depths: 34/101/152
+    python bench.py --network inception-v3   # also inception-bn, alexnet
+    python bench.py --network transformer_lm # MFU workload (tokens/s)
+
+Baselines are the reference's published 1x K80 img/s numbers (BASELINE.md).
+The transformer has no reference baseline (the reference predates it);
+vs_baseline reports MFU against the 0.45 north-star instead.
+
+On backend failure prints a diagnostic JSON line instead of a stack
+trace, still rc!=0 so the driver records the error.
 """
+import argparse
 import json
 import os
 import sys
@@ -22,8 +32,6 @@ sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
 import numpy as np  # noqa: E402
 
-BASELINE_IMG_S = 109.0  # reference ResNet-50, 1x K80, batch 32
-
 # bf16/fp32 peak FLOP/s per chip by device kind, for the MFU estimate.
 # (TPU v4/v5e/v5p/v6e public numbers; fp32 host fallback is a nominal 1e12.)
 _PEAK_FLOPS = {
@@ -36,22 +44,47 @@ _PEAK_FLOPS = {
     "TPU v6e": 918e12,
 }
 
+# image workloads: name -> (models.get_symbol kwargs, default batch,
+# reference 1xK80 img/s baseline from BASELINE.md, fwd GMACs/image for
+# the flops fallback, input size). inception-v3's baseline and GMACs are
+# 299px figures — benching it at 224 would overstate vs_baseline ~1.8x.
+_IMAGE_NETS = {
+    "resnet-18": (dict(network="resnet", num_layers=18), 128, 185.0,
+                  1.8, 224),
+    "resnet-34": (dict(network="resnet", num_layers=34), 128, 172.0,
+                  3.6, 224),
+    "resnet-50": (dict(network="resnet", num_layers=50), 128, 109.0,
+                  3.86, 224),
+    "resnet-101": (dict(network="resnet", num_layers=101), 96, 78.0,
+                   7.6, 224),
+    "resnet-152": (dict(network="resnet", num_layers=152), 64, 57.0,
+                   11.3, 224),
+    "inception-bn": (dict(network="inception-bn"), 128, 152.0, 1.6, 224),
+    "inception-v3": (dict(network="inception-v3"), 64, 30.4, 5.7, 299),
+    "alexnet": (dict(network="alexnet"), 512, 457.0, 0.7, 224),
+}
 
-def _fail(stage, err):
+# transformer LM defaults: compute-dense enough that one v5e chip can
+# reach the >=0.45 MFU north star (big matmuls, flash attention)
+_TLM = dict(vocab=32768, seq_len=2048, layers=4, heads=16, dim=2048,
+            batch=8)
+
+
+def _fail(metric, stage, err):
+    unit = "tokens/s" if metric.startswith("transformer") else "img/s"
     print(json.dumps({
-        "metric": "resnet50_train_throughput", "value": None, "unit": "img/s",
+        "metric": metric, "value": None, "unit": unit,
         "vs_baseline": None, "error_stage": stage,
         "error": "".join(traceback.format_exception_only(type(err), err))
                  .strip()[:500]}))
     sys.exit(1)
 
 
-def main():
-    # --- stage 1: backend probe, before building anything -----------------
-    # A dead TPU tunnel HANGS inside (GIL-holding) backend init rather
-    # than raising — a signal-based watchdog cannot interrupt it. Probe in
-    # a SUBPROCESS with a hard timeout so a hang becomes a diagnostic JSON
-    # (not rc=124 with no output) before this process touches the backend.
+def _probe_backend(metric):
+    """A dead TPU tunnel HANGS inside (GIL-holding) backend init rather
+    than raising — a signal-based watchdog cannot interrupt it. Probe in
+    a SUBPROCESS with a hard timeout so a hang becomes a diagnostic JSON
+    (not rc=124 with no output) before this process touches the backend."""
     import subprocess
 
     timeout_s = int(os.environ.get("BENCH_BACKEND_TIMEOUT", "180"))
@@ -69,36 +102,78 @@ def main():
             raise RuntimeError("backend probe failed: %s"
                                % r.stderr.strip()[-400:])
     except subprocess.TimeoutExpired:
-        _fail("backend_init", TimeoutError(
+        _fail(metric, "backend_init", TimeoutError(
             "backend init hung for %ds (TPU tunnel down or unresponsive)"
             % timeout_s))
     except Exception as e:  # noqa: BLE001
-        _fail("backend_init", e)
+        _fail(metric, "backend_init", e)
 
     try:
         import jax
         if os.environ.get("BENCH_PLATFORM"):
             jax.config.update("jax_platforms",
                               os.environ["BENCH_PLATFORM"])
-        devices = jax.devices()
-        dev = devices[0]
+        dev = jax.devices()[0]
         jax.block_until_ready(jax.numpy.zeros((8, 8)) + 1.0)
+        return jax, dev
     except Exception as e:  # noqa: BLE001
-        _fail("backend_init", e)
+        _fail(metric, "backend_init", e)
 
-    # --- stage 2: build model + step fn on host (no device work) ----------
+
+def _timed_loop(jax, step, state, batch_dev, iters, metric, lr=0.1):
+    """Warmup (2 steps + hard sync) then the timed loop. Sync via host
+    readback of a scalar — through the axon tunnel, block_until_ready
+    alone does not guarantee device completion."""
+    rng = jax.random.PRNGKey(0)
     try:
-        from mxnet_tpu.models import resnet
+        for _ in range(2):
+            state, outs = step(state, batch_dev, lr, rng)
+        np.asarray(jax.device_get(outs[0]))
+    except Exception as e:  # noqa: BLE001
+        _fail(metric, "compile_warmup", e)
+
+    t0 = time.time()
+    for _ in range(iters):
+        state, outs = step(state, batch_dev, lr, rng)
+    np.asarray(jax.device_get(outs[0]))   # true completion barrier
+    return time.time() - t0
+
+
+def _mfu(step, state, batch_vals, dev, sec_per_step, fallback_flops,
+         jax):
+    """Actual FLOPs of the compiled step (XLA cost analysis; the analytic
+    fallback covers kernels the analysis can't see) over the chip peak."""
+    step_flops = None
+    try:
+        cost = step.cost_analysis(state, batch_vals, 0.1,
+                                  jax.random.PRNGKey(0))
+        if cost and cost.get("flops"):
+            step_flops = float(cost["flops"])
+    except Exception:  # noqa: BLE001
+        pass
+    step_flops = max(step_flops or 0.0, fallback_flops)
+    peak = _PEAK_FLOPS.get(getattr(dev, "device_kind", ""), None)
+    mfu = (step_flops / sec_per_step) / peak if peak else None
+    return mfu, step_flops
+
+
+def bench_image(name, args):
+    metric = "%s_train_throughput" % name.replace("-", "")
+    net_kwargs, def_batch, baseline, gmacs, image = _IMAGE_NETS[name]
+    jax, dev = _probe_backend(metric)
+
+    batch = args.batch or int(os.environ.get("BENCH_BATCH", def_batch))
+    dtype = args.dtype or os.environ.get("BENCH_DTYPE", "bfloat16")
+    try:
+        from mxnet_tpu import models
         from mxnet_tpu.parallel import make_train_step
         from mxnet_tpu.initializer import Xavier
 
-        batch = int(os.environ.get("BENCH_BATCH", "128"))
-        # bf16 compute with f32 master weights (mp_sgd semantics) is the
-        # TPU perf path; BENCH_DTYPE=float32 measures full precision
-        dtype = os.environ.get("BENCH_DTYPE", "bfloat16")
-        image = 224
-        sym = resnet.get_symbol(num_classes=1000, num_layers=50,
-                                image_shape=(3, image, image))
+        kwargs = dict(net_kwargs)
+        kwargs.setdefault("num_classes", 1000)
+        if kwargs["network"] == "resnet":
+            kwargs["image_shape"] = (3, image, image)
+        sym = models.get_symbol(**kwargs)
         step = make_train_step(
             sym, optimizer="sgd",
             optimizer_params={"momentum": 0.9, "wd": 1e-4,
@@ -110,67 +185,120 @@ def main():
             np.float32)
         batch_vals = {"data": x, "softmax_label": y}
     except Exception as e:  # noqa: BLE001
-        _fail("graph_build", e)
+        _fail(metric, "graph_build", e)
 
-    # --- stage 3: init params on device ------------------------------------
     try:
         state = step.init_state(Xavier(factor_type="in", magnitude=2.0),
                                 {"data": (batch, 3, image, image),
                                  "softmax_label": (batch,)})
-        rng = jax.random.PRNGKey(0)
-    except Exception as e:  # noqa: BLE001
-        _fail("param_init", e)
-
-    # --- stage 4: compile + warmup -----------------------------------------
-    # The batch lives on device for the whole loop (one H2D total): the
-    # training loop overlaps host input with device compute via
-    # PrefetchingIter; paying a fresh 38MB transfer per timed step would
-    # measure the tunnel, not the chip. Sync via host readback of a
-    # scalar — through the axon tunnel, block_until_ready alone does not
-    # guarantee device completion.
-    try:
         batch_dev = step.place_batch(batch_vals)
-        for _ in range(2):
-            state, outs = step(state, batch_dev, 0.1, rng)
-        np.asarray(jax.device_get(outs[0]))
     except Exception as e:  # noqa: BLE001
-        _fail("compile_warmup", e)
+        _fail(metric, "param_init", e)
 
-    # --- stage 5: timed loop ------------------------------------------------
-    iters = int(os.environ.get("BENCH_ITERS", "20"))
-    t0 = time.time()
-    for _ in range(iters):
-        state, outs = step(state, batch_dev, 0.1, rng)
-    np.asarray(jax.device_get(outs[0]))   # true completion barrier
-    dt = time.time() - t0
+    iters = args.iters or int(os.environ.get("BENCH_ITERS", "20"))
+    dt = _timed_loop(jax, step, state, batch_dev, iters, metric)
 
     img_s = batch * iters / dt
-    step_ms = dt / iters * 1e3
-
-    # MFU: actual FLOPs of the compiled step (XLA cost analysis) over the
-    # chip's peak. Falls back to a 3x-forward analytic estimate.
-    step_flops = None
-    try:
-        cost = step.cost_analysis(state, batch_vals, 0.1, rng)
-        if cost and cost.get("flops"):
-            step_flops = float(cost["flops"])
-    except Exception:  # noqa: BLE001
-        pass
-    if not step_flops:
-        step_flops = 3 * 2 * 3.86e9 * batch  # 3.86 GMACs fwd / 224px image
-    peak = _PEAK_FLOPS.get(getattr(dev, "device_kind", ""), None)
-    mfu = (step_flops / (dt / iters)) / peak if peak else None
-
+    # fwd GMACs x2 flops/MAC x3 (fwd + ~2x bwd)
+    fallback = 3 * 2 * gmacs * 1e9 * batch
+    mfu, _flops = _mfu(step, state, batch_vals, dev, dt / iters,
+                       fallback, jax)
     print(json.dumps({
-        "metric": "resnet50_train_throughput",
+        "metric": metric,
         "value": round(img_s, 2),
         "unit": "img/s",
-        "vs_baseline": round(img_s / BASELINE_IMG_S, 3),
-        "step_time_ms": round(step_ms, 2),
+        "vs_baseline": round(img_s / baseline, 3),
+        "step_time_ms": round(dt / iters * 1e3, 2),
         "batch": batch,
         "compute_dtype": dtype,
         "device_kind": getattr(dev, "device_kind", "unknown"),
         "mfu": round(mfu, 4) if mfu is not None else None}))
+
+
+def bench_transformer(args):
+    """Compute-dense LM workload: tokens/s + MFU. vs_baseline = measured
+    MFU / 0.45 north star (BASELINE.md; the reference has no transformer)."""
+    metric = "transformer_lm_train_throughput"
+    jax, dev = _probe_backend(metric)
+
+    c = dict(_TLM)
+    for k in c:   # BENCH_TLM_DIM=256 etc. (smoke tests on CPU)
+        c[k] = int(os.environ.get("BENCH_TLM_%s" % k.upper(), c[k]))
+    if args.batch:
+        c["batch"] = args.batch
+    if args.seq_len:
+        c["seq_len"] = args.seq_len
+    B, T, D, L = c["batch"], c["seq_len"], c["dim"], c["layers"]
+    V, F = c["vocab"], 4 * c["dim"]
+    dtype = args.dtype or os.environ.get("BENCH_DTYPE", "bfloat16")
+    try:
+        from mxnet_tpu.models import transformer
+        from mxnet_tpu.parallel import make_train_step
+        from mxnet_tpu.initializer import Xavier
+
+        sym = transformer.get_symbol(V, T, num_layers=L,
+                                     num_heads=c["heads"], dim=D,
+                                     ffn_hidden=F)
+        step = make_train_step(
+            sym, optimizer="adam",
+            optimizer_params={"rescale_grad": 1.0 / B},
+            compute_dtype=None if dtype == "float32" else dtype)
+        rng_np = np.random.RandomState(0)
+        toks = rng_np.randint(0, V, (B, T)).astype(np.float32)
+        labels = np.roll(toks, -1, axis=1)
+        labels[:, -1] = -1
+        batch_vals = {"data": toks, "softmax_label": labels}
+    except Exception as e:  # noqa: BLE001
+        _fail(metric, "graph_build", e)
+
+    try:
+        state = step.init_state(Xavier(), {"data": (B, T),
+                                           "softmax_label": (B, T)})
+        batch_dev = step.place_batch(batch_vals)
+    except Exception as e:  # noqa: BLE001
+        _fail(metric, "param_init", e)
+
+    iters = args.iters or int(os.environ.get("BENCH_ITERS", "20"))
+    dt = _timed_loop(jax, step, state, batch_dev, iters, metric,
+                     lr=1e-4)
+
+    tok_s = B * T * iters / dt
+    # analytic train flops (fwd x3): dense projections 8D^2+4DF per
+    # token per layer, attention 4*T*D per token per layer (QK^T + PV),
+    # vocab head 2DV per token. Matches the scaling-book accounting;
+    # used as the floor under cost_analysis (the Pallas flash kernel's
+    # internal flops are invisible to XLA's analysis).
+    fwd = B * T * (L * (8 * D * D + 4 * D * F + 4 * T * D) + 2 * D * V)
+    mfu, flops = _mfu(step, state, batch_vals, dev, dt / iters, 3 * fwd,
+                      jax)
+    print(json.dumps({
+        "metric": metric,
+        "value": round(tok_s, 2),
+        "unit": "tokens/s",
+        "vs_baseline": round(mfu / 0.45, 3) if mfu is not None else None,
+        "step_time_ms": round(dt / iters * 1e3, 2),
+        "batch": B, "seq_len": T, "dim": D, "layers": L,
+        "compute_dtype": dtype,
+        "device_kind": getattr(dev, "device_kind", "unknown"),
+        "step_tflops": round(flops / 1e12, 2),
+        "mfu": round(mfu, 4) if mfu is not None else None}))
+
+
+def main():
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--network", default="resnet-50",
+                   choices=sorted(_IMAGE_NETS) + ["transformer_lm"])
+    p.add_argument("--batch", type=int, default=None)
+    p.add_argument("--seq-len", type=int, default=None,
+                   help="transformer_lm only")
+    p.add_argument("--iters", type=int, default=None)
+    p.add_argument("--dtype", default=None,
+                   choices=["float32", "bfloat16"])
+    args = p.parse_args()
+    if args.network == "transformer_lm":
+        bench_transformer(args)
+    else:
+        bench_image(args.network, args)
 
 
 if __name__ == "__main__":

@@ -1,0 +1,199 @@
+"""Weak-scaling harness for the SPMD train step — the analogue of the
+reference's multi-GPU/multi-node scaling tables
+(example/image-classification/README.md:302-319, AlexNet/Inception-v3/
+ResNet-152 on 1..256 K80s at ~90% efficiency).
+
+Runs the same per-device batch on growing device counts and reports
+step time, weak-scaling efficiency, and the collective traffic XLA
+inserted (parsed from the optimized HLO: all-reduce / all-gather /
+reduce-scatter / collective-permute / all-to-all output bytes).
+
+Without real multi-chip hardware it runs on a virtual CPU mesh
+(xla_force_host_platform_device_count) — collective BYTES are exact
+(they're a property of the partitioning, not the fabric), times are
+correctness-grade only. On a real slice run it unchanged:
+
+    python bench_scaling.py                      # 1,2,4,8 devices, resnet-8
+    python bench_scaling.py --devices 1,4,8 --network transformer_lm
+    python bench_scaling.py --zero1              # + sharded optimizer
+
+Prints one JSON line per device count, then a markdown table.
+"""
+import argparse
+import json
+import os
+import re
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+
+def _parse_args():
+    p = argparse.ArgumentParser()
+    p.add_argument("--devices", default="1,2,4,8",
+                   help="comma-separated device counts")
+    p.add_argument("--network", default="resnet",
+                   choices=["resnet", "transformer_lm"])
+    p.add_argument("--per-device-batch", type=int, default=8)
+    p.add_argument("--iters", type=int, default=10)
+    p.add_argument("--zero1", action="store_true",
+                   help="shard optimizer state (ZeRO-1)")
+    return p.parse_args()
+
+
+_COLLECTIVES = ("all-reduce", "all-gather", "reduce-scatter",
+                "collective-permute", "all-to-all")
+_DTYPE_BYTES = {"f64": 8, "f32": 4, "bf16": 2, "f16": 2, "s32": 4,
+                "u32": 4, "s64": 8, "u64": 8, "pred": 1, "s8": 1,
+                "u8": 1}
+# every `dtype[dims]` group in an instruction's output shape (tuple
+# outputs like `(f32[8], /*index=1*/f32[8]) all-reduce(...)` list many,
+# with index comments interleaved)
+_SHAPE_RE = re.compile(r"(\w+)\[([\d,]*)\]")
+# the executing op: whitespace-preceded collective name followed by its
+# operand list paren. Operand REFERENCES (`get-tuple-element(%all-reduce
+# .82)`) don't match: there the name is followed by `)` or `,`, not `(`.
+_OP_RE = re.compile(
+    r"\s((?:%s)[\w.-]*)\(" % "|".join(_COLLECTIVES))
+
+
+def collective_bytes(hlo_text):
+    """Sum output bytes of collective ops in optimized HLO, per op kind.
+
+    Reads lines like
+      %all-reduce = f32[64,128]{1,0} all-reduce(%dot), replica_groups=...
+    incl. variadic tuple outputs. Bytes are per-device (each device
+    materializes its own output buffer); multiply by the group size for
+    fabric-level traffic. Async `-done` halves of start/done pairs are
+    skipped so traffic isn't counted twice."""
+    out = {}
+    for line in hlo_text.splitlines():
+        if " = " not in line:
+            continue
+        shapes_part = line.split(" = ", 1)[1]
+        m = _OP_RE.search(shapes_part)
+        if not m or m.group(1).endswith("-done"):
+            continue
+        kind = next(c for c in _COLLECTIVES
+                    if m.group(1).startswith(c))
+        total = 0
+        for dt, dims in _SHAPE_RE.findall(shapes_part[:m.start()]):
+            if dt not in _DTYPE_BYTES:
+                continue
+            n = 1
+            for d in dims.split(","):
+                if d:
+                    n *= int(d)
+            total += n * _DTYPE_BYTES[dt]
+        out[kind] = out.get(kind, 0) + total
+    return out
+
+
+def build_step(network, mesh, global_batch, zero1):
+    from mxnet_tpu import models
+    from mxnet_tpu.initializer import Xavier
+    from mxnet_tpu.parallel import make_train_step
+
+    kw = dict(optimizer="sgd", optimizer_params={"momentum": 0.9},
+              mesh=mesh)
+    if zero1:
+        kw.update(optimizer="adam", optimizer_params={},
+                  optimizer_sharding="zero1")
+    if network == "resnet":
+        sym = models.get_symbol(network="resnet", num_classes=10,
+                                num_layers=8, image_shape=(3, 8, 8))
+        shapes = {"data": (global_batch, 3, 8, 8),
+                  "softmax_label": (global_batch,)}
+    else:
+        sym = models.get_symbol(network="transformer", vocab_size=256,
+                                seq_len=64, num_layers=2, num_heads=4,
+                                dim=64)
+        shapes = {"data": (global_batch, 64),
+                  "softmax_label": (global_batch, 64)}
+    step = make_train_step(sym, **kw)
+    state = step.init_state(Xavier(), shapes)
+    return step, state, shapes
+
+
+def main():
+    args = _parse_args()
+    counts = sorted({int(c) for c in args.devices.split(",")})
+
+    # force the host platform BEFORE backend init (a dead TPU tunnel
+    # hangs; and the virtual mesh needs the flag locked in first)
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=%d"
+            % max(counts + [8])).strip()
+    # the image presets JAX_PLATFORMS=axon; override unless the caller
+    # explicitly picked a platform (BENCH_PLATFORM=tpu on a real slice)
+    platform = os.environ.get("BENCH_PLATFORM", "cpu")
+    os.environ["JAX_PLATFORMS"] = platform
+    import jax
+    jax.config.update("jax_platforms", platform)
+    import numpy as np
+    from mxnet_tpu.parallel import make_mesh
+
+    devices = jax.devices()
+    if len(devices) < max(counts):
+        raise SystemExit("only %d devices visible, need %d"
+                         % (len(devices), max(counts)))
+
+    rows = []
+    for n in counts:
+        mesh = make_mesh({"data": n}, devices=devices[:n])
+        gb = args.per_device_batch * n
+        step, state, shapes = build_step(args.network, mesh, gb,
+                                         args.zero1)
+        rng_np = np.random.RandomState(0)
+        if args.network == "resnet":
+            batch = {"data": rng_np.standard_normal(
+                shapes["data"]).astype(np.float32),
+                "softmax_label": rng_np.randint(
+                    0, 10, gb).astype(np.float32)}
+        else:
+            toks = rng_np.randint(0, 256, shapes["data"]).astype(
+                np.float32)
+            batch = {"data": toks,
+                     "softmax_label": np.roll(toks, -1, axis=1)}
+        bd = step.place_batch(batch)
+        rng = jax.random.PRNGKey(0)
+
+        lowered = step.lower(state, bd, 0.1, rng)
+        compiled = lowered.compile()
+        coll = collective_bytes(compiled.as_text())
+
+        state, outs = step(state, bd, 0.1, rng)   # warmup (cached)
+        # readback barrier, not block_until_ready: through the axon
+        # tunnel the latter does not guarantee device completion
+        np.asarray(jax.device_get(outs[0]))
+        t0 = time.time()
+        for _ in range(args.iters):
+            state, outs = step(state, bd, 0.1, rng)
+        np.asarray(jax.device_get(outs[0]))
+        dt = (time.time() - t0) / args.iters
+
+        rows.append({"devices": n, "global_batch": gb,
+                     "step_ms": round(dt * 1e3, 2),
+                     "samples_s": round(gb / dt, 1),
+                     "collective_bytes_per_dev": coll,
+                     "zero1": bool(args.zero1)})
+        print(json.dumps(rows[-1]))
+
+    base = rows[0]["step_ms"]
+    print("\n| devices | global batch | step ms | samples/s | "
+          "weak-scaling eff | collective bytes/dev |")
+    print("|---|---|---|---|---|---|")
+    for r in rows:
+        eff = base / r["step_ms"]
+        tot = sum(r["collective_bytes_per_dev"].values())
+        print("| %d | %d | %.2f | %.1f | %.0f%% | %s |" % (
+            r["devices"], r["global_batch"], r["step_ms"],
+            r["samples_s"], eff * 100,
+            "{:,}".format(tot)))
+
+
+if __name__ == "__main__":
+    main()

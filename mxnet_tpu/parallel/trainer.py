@@ -94,6 +94,11 @@ class TrainStep:
                              "with a 'data' axis to shard over")
         self.optimizer_sharding = optimizer_sharding
         self._n_state, self._opt_op = _OPT_OPS[optimizer]
+        # data inputs that carry token/category ids (feed an Embedding)
+        # must NOT be cast to the compute dtype: bf16's 8-bit significand
+        # aliases ids >= 256. Found from the graph, not by name.
+        self._id_inputs = self._embedding_fed_inputs(symbol) \
+            & set(self.data_names)
         # mesh passed through so __shard__/ctx_group annotations lower to
         # sharding constraints inside the step
         self._eval_fn = _graph_eval_fn(symbol, mesh=mesh)
@@ -101,6 +106,21 @@ class TrainStep:
         step = self._build_step()
         self._jit_step = jax.jit(
             step, donate_argnums=(0, 1, 2) if donate else ())
+
+    @staticmethod
+    def _embedding_fed_inputs(symbol):
+        """Variable names whose value feeds an Embedding lookup's data
+        slot somewhere in the graph (ids, not numbers)."""
+        import json as _json
+        graph = _json.loads(symbol.tojson())
+        nodes = graph.get("nodes", [])
+        out = set()
+        for n in nodes:
+            if n.get("op") == "Embedding" and n.get("inputs"):
+                src = nodes[n["inputs"][0][0]]
+                if src.get("op") == "null":
+                    out.add(src["name"])
+        return out
 
     # -- state -------------------------------------------------------------
     def init_state(self, initializer, batch_shapes, batch_dtypes=None,
@@ -176,6 +196,7 @@ class TrainStep:
         cdt = self.compute_dtype
         remat = self.remat
         zero1 = self.optimizer_sharding == "zero1"
+        id_inputs = self._id_inputs
         constrain = jax.lax.with_sharding_constraint
 
         def step(params, opt_state, aux, batch, lr, rng):
@@ -199,12 +220,14 @@ class TrainStep:
             def fwd(p):
                 feed = dict(batch)
                 if cdt is not None:
-                    # compute-dtype cast: params + image data only (labels
-                    # carry class ids — bf16 would corrupt ids > 256);
-                    # the cast is linear so vjp returns float32 grads
+                    # compute-dtype cast: params + real-valued data only.
+                    # Labels and Embedding-fed inputs carry ids — bf16
+                    # would alias ids >= 256 (8-bit significand). The
+                    # cast is linear so vjp returns float32 grads.
                     p = {k: v.astype(cdt) for k, v in p.items()}
                     for k in data_names:
-                        feed[k] = feed[k].astype(cdt)
+                        if k not in id_inputs:
+                            feed[k] = feed[k].astype(cdt)
                 outs, new_aux = eval_fn({**feed, **p}, aux, rng, True)
                 if cdt is not None:
                     # BN moving stats stay float32 master copies

@@ -1,0 +1,81 @@
+"""Bench tooling guards: the HLO collective-traffic parser and the
+workload catalog (every --network choice must build a symbol)."""
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+
+def test_collective_bytes_parser():
+    from bench_scaling import collective_bytes
+
+    txt = "\n".join([
+        "%all-reduce.82 = (f32[16,3,3,3]{3,2,1,0}, f32[10]{0}, "
+        "/*index=2*/f32[10,64]{1,0}) all-reduce(%a, %b, %c), channel_id=1",
+        "%gte = f32[16]{0} get-tuple-element(%all-reduce.82), index=4",
+        "%ar2 = f32[8,8]{1,0} all-reduce(%dot.1), channel_id=2",
+        "%s = f32[4]{0} all-reduce-start(%x), channel_id=3",
+        "%d = f32[4]{0} all-reduce-done(%s)",
+        "%ag = bf16[64,32]{1,0} all-gather(%p), dimensions={0}",
+        "%rs = f32[16]{0} reduce-scatter(%q), dimensions={0}",
+        "%cp = bf16[2,8]{1,0} collective-permute(%r), "
+        "source_target_pairs={{0,1}}",
+    ])
+    got = collective_bytes(txt)
+    assert got == {
+        # variadic tuple (16*27 + 10 + 640 floats) + plain (64) + async
+        # start (4; the -done half must not double count)
+        "all-reduce": (16 * 27 + 10 + 640) * 4 + 64 * 4 + 16,
+        "all-gather": 64 * 32 * 2,
+        "reduce-scatter": 64,
+        "collective-permute": 32,
+    }, got
+    # operand references and non-collective lines contribute nothing
+    assert collective_bytes("%x = f32[8]{0} add(%a, %b)") == {}
+
+
+def test_collective_bytes_on_real_dp_step():
+    """End-to-end: the parser must see the grad all-reduce of a real
+    dp-sharded train step, sized like the model's parameters."""
+    import jax
+
+    import mxnet_tpu as mx
+    from bench_scaling import collective_bytes
+    from mxnet_tpu.initializer import Xavier
+    from mxnet_tpu.parallel import data_parallel_mesh, make_train_step
+
+    net = mx.sym.Variable("data")
+    net = mx.sym.FullyConnected(net, name="fc1", num_hidden=32)
+    net = mx.sym.SoftmaxOutput(net, name="softmax")
+    mesh = data_parallel_mesh()
+    step = make_train_step(net, mesh=mesh)
+    state = step.init_state(Xavier(), {"data": (16, 8),
+                                       "softmax_label": (16,)})
+    batch = step.place_batch(
+        {"data": np.zeros((16, 8), np.float32),
+         "softmax_label": np.zeros((16,), np.float32)})
+    txt = step.lower(state, batch, 0.1,
+                     jax.random.PRNGKey(0)).compile().as_text()
+    got = collective_bytes(txt)
+    # fc1: weight (32,8) + bias (32) = 288 floats = 1152 bytes of grads
+    assert got.get("all-reduce", 0) >= 288 * 4, got
+
+
+def test_bench_network_catalog_builds():
+    from bench import _IMAGE_NETS
+
+    from mxnet_tpu import models
+
+    for name, (kw, batch, baseline, gmacs, image) in _IMAGE_NETS.items():
+        kwargs = dict(kw)
+        kwargs.setdefault("num_classes", 1000)
+        if kwargs["network"] == "resnet":
+            kwargs["image_shape"] = (3, image, image)
+        sym = models.get_symbol(**kwargs)
+        assert sym.list_outputs(), name
+        assert batch > 0 and baseline > 0 and gmacs > 0
+        assert image in (224, 299), name
+    # inception-v3's baseline/GMACs are 299px figures
+    assert _IMAGE_NETS["inception-v3"][4] == 299

@@ -207,3 +207,41 @@ def test_zero1_requires_data_axis():
         make_train_step(_mlp(), optimizer_sharding="zero1")
     with pytest.raises(ValueError):
         make_train_step(_mlp(), optimizer_sharding="bogus")
+
+
+def test_bf16_compute_keeps_embedding_ids_exact():
+    """compute_dtype must not cast Embedding-fed token ids: bf16 aliases
+    ids >= 256, which would silently corrupt every LM batch."""
+    vocab, T, B = 1000, 4, 4
+    data = mx.sym.Variable("data")
+    emb = mx.sym.Embedding(data, input_dim=vocab, output_dim=8,
+                           name="embed")
+    pred = mx.sym.FullyConnected(mx.sym.Flatten(emb), num_hidden=vocab,
+                                 name="out")
+    net = mx.sym.SoftmaxOutput(pred, name="softmax")
+    step = make_train_step(net, compute_dtype="bfloat16")
+    assert step._id_inputs == {"data"}
+    state = step.init_state(Xavier(), {"data": (B, T),
+                                       "softmax_label": (B,)})
+    # distinct high ids that all collapse to 896/1024-ish under bf16
+    toks = np.array([[899, 901, 903, 905]] * B, np.float32)
+    labels = np.zeros((B,), np.float32)
+    # snapshot before the step: the jitted step donates param buffers
+    snap = {k: np.asarray(v).astype(np.float32)
+            for k, v in state[0].items()}
+    batch = step.place_batch({"data": toks, "softmax_label": labels})
+    state, outs = step(state, batch, 0.0, jax.random.PRNGKey(0))
+    # lr=0: recompute the expected forward from the UNTOUCHED ids and
+    # exact f32 embedding rows; if ids had been cast to bf16 the rows
+    # for 899/901/903/905 would all be the row of 896
+    rows = snap["embed_weight"][toks.astype(int)]
+    assert not np.allclose(rows[0, 0], rows[0, 1]), "test ids degenerate"
+    got = np.asarray(outs[0]).astype(np.float32)
+    w = snap["out_weight"]
+    b = snap["out_bias"]
+    logits = rows.reshape(B, -1).astype(np.float32) @ w.T + b
+    want = np.exp(logits - logits.max(1, keepdims=True))
+    want /= want.sum(1, keepdims=True)
+    # bf16 compute in the matmul: loose tolerance, but id aliasing would
+    # produce a completely different distribution (wrong rows)
+    np.testing.assert_allclose(got, want, rtol=0.1, atol=0.02)
