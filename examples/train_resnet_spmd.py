@@ -30,6 +30,9 @@ def main():
     p.add_argument("--steps", type=int, default=20)
     p.add_argument("--dtype", default="bfloat16",
                    choices=["bfloat16", "float32"])
+    p.add_argument("--zero1", action="store_true",
+                   help="shard optimizer state 1/N over the data axis "
+                        "(reduce-scatter -> update -> all-gather)")
     args = p.parse_args()
 
     import jax
@@ -47,7 +50,8 @@ def main():
         sym, optimizer="sgd",
         optimizer_params={"momentum": 0.9, "wd": 1e-4},
         mesh=mesh,
-        compute_dtype=None if args.dtype == "float32" else args.dtype)
+        compute_dtype=None if args.dtype == "float32" else args.dtype,
+        optimizer_sharding="zero1" if args.zero1 else None)
 
     shapes = {"data": (args.batch_size, 3, args.image_size,
                        args.image_size),

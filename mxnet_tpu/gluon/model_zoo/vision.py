@@ -2,12 +2,15 @@
 python/mxnet/gluon/model_zoo/vision/__init__.py:101+ and the per-family
 modules: alexnet, densenet, inception, resnet (v1+v2), squeezenet, vgg).
 
-Zero-egress: pretrained=True raises (no weights host reachable); the
+Zero-egress: ``pretrained=True`` loads from the LOCAL model store
+(model_store.get_model_file — ``$MXNET_HOME/models`` or
+``~/.mxnet/models``); there is no weights host to download from. The
 architectures match the reference's topologies so reference-trained
-.params files load directly via load_params.
+.params files load directly.
 """
 from __future__ import annotations
 
+from . import model_store
 from .. import nn
 from ..block import HybridBlock
 
@@ -23,12 +26,13 @@ __all__ = ["get_model", "alexnet", "resnet18_v1", "resnet34_v1",
            "DenseNet", "Inception3", "MobileNet"]
 
 
-def _no_pretrained(pretrained):
+def _load_pretrained(net, name, pretrained, root=None, ctx=None):
+    """pretrained=True: fill ``net`` from the local model store
+    (reference pattern: get_model_file + load_params at the end of each
+    factory, e.g. model_zoo/vision/alexnet.py)."""
     if pretrained:
-        raise RuntimeError(
-            "pretrained weights are not bundled (zero-egress build); "
-            "train from scratch or load reference .params via "
-            "load_params()")
+        net.load_params(model_store.get_model_file(name, root), ctx=ctx)
+    return net
 
 
 # ---------------------------------------------------------------------------
@@ -69,9 +73,10 @@ class AlexNet(HybridBlock):
         return x
 
 
-def alexnet(pretrained=False, classes=1000, **kwargs):
-    _no_pretrained(pretrained)
-    return AlexNet(classes=classes, **kwargs)
+def alexnet(pretrained=False, classes=1000, root=None, ctx=None,
+            **kwargs):
+    return _load_pretrained(AlexNet(classes=classes, **kwargs),
+                            "alexnet", pretrained, root, ctx)
 
 
 # ---------------------------------------------------------------------------
@@ -305,12 +310,14 @@ resnet_block_versions = [{"basic_block": BasicBlockV1,
                           "bottle_neck": BottleneckV2}]
 
 
-def get_resnet(version, num_layers, pretrained=False, **kwargs):
-    _no_pretrained(pretrained)
+def get_resnet(version, num_layers, pretrained=False, root=None,
+               ctx=None, **kwargs):
     block_type, layers, channels = resnet_spec[num_layers]
     resnet_class = resnet_net_versions[version - 1]
     block_class = resnet_block_versions[version - 1][block_type]
-    return resnet_class(block_class, layers, channels, **kwargs)
+    net = resnet_class(block_class, layers, channels, **kwargs)
+    return _load_pretrained(net, "resnet%d_v%d" % (num_layers, version),
+                            pretrained, root, ctx)
 
 
 def resnet18_v1(**kwargs):
@@ -402,10 +409,13 @@ vgg_spec = {11: ([1, 1, 2, 2, 2], [64, 128, 256, 512, 512]),
             19: ([2, 2, 4, 4, 4], [64, 128, 256, 512, 512])}
 
 
-def get_vgg(num_layers, pretrained=False, **kwargs):
-    _no_pretrained(pretrained)
+def get_vgg(num_layers, pretrained=False, root=None, ctx=None,
+            **kwargs):
     layers, filters = vgg_spec[num_layers]
-    return VGG(layers, filters, **kwargs)
+    net = VGG(layers, filters, **kwargs)
+    name = "vgg%d%s" % (num_layers,
+                        "_bn" if kwargs.get("batch_norm") else "")
+    return _load_pretrained(net, name, pretrained, root, ctx)
 
 
 def vgg11(**kwargs):
@@ -522,14 +532,14 @@ class SqueezeNet(HybridBlock):
         return x
 
 
-def squeezenet1_0(pretrained=False, **kwargs):
-    _no_pretrained(pretrained)
-    return SqueezeNet("1.0", **kwargs)
+def squeezenet1_0(pretrained=False, root=None, ctx=None, **kwargs):
+    return _load_pretrained(SqueezeNet("1.0", **kwargs),
+                            "squeezenet1.0", pretrained, root, ctx)
 
 
-def squeezenet1_1(pretrained=False, **kwargs):
-    _no_pretrained(pretrained)
-    return SqueezeNet("1.1", **kwargs)
+def squeezenet1_1(pretrained=False, root=None, ctx=None, **kwargs):
+    return _load_pretrained(SqueezeNet("1.1", **kwargs),
+                            "squeezenet1.1", pretrained, root, ctx)
 
 
 # ---------------------------------------------------------------------------
@@ -613,12 +623,14 @@ densenet_spec = {121: (64, 32, [6, 12, 24, 16]),
                  201: (64, 32, [6, 12, 48, 32])}
 
 
-def get_densenet(num_layers, pretrained=False, **kwargs):
-    _no_pretrained(pretrained)
+def get_densenet(num_layers, pretrained=False, root=None, ctx=None,
+                 **kwargs):
     num_init_features, growth_rate, block_config = \
         densenet_spec[num_layers]
-    return DenseNet(num_init_features, growth_rate, block_config,
-                    **kwargs)
+    net = DenseNet(num_init_features, growth_rate, block_config,
+                   **kwargs)
+    return _load_pretrained(net, "densenet%d" % num_layers, pretrained,
+                            root, ctx)
 
 
 def densenet121(**kwargs):
@@ -791,9 +803,9 @@ class Inception3(HybridBlock):
         return x
 
 
-def inception_v3(pretrained=False, **kwargs):
-    _no_pretrained(pretrained)
-    return Inception3(**kwargs)
+def inception_v3(pretrained=False, root=None, ctx=None, **kwargs):
+    return _load_pretrained(Inception3(**kwargs), "inceptionv3",
+                            pretrained, root, ctx)
 
 
 # ---------------------------------------------------------------------------
@@ -837,24 +849,24 @@ class MobileNet(HybridBlock):
         return x
 
 
-def mobilenet1_0(pretrained=False, **kwargs):
-    _no_pretrained(pretrained)
-    return MobileNet(1.0, **kwargs)
+def mobilenet1_0(pretrained=False, root=None, ctx=None, **kwargs):
+    return _load_pretrained(MobileNet(1.0, **kwargs), "mobilenet1.0",
+                            pretrained, root, ctx)
 
 
-def mobilenet0_75(pretrained=False, **kwargs):
-    _no_pretrained(pretrained)
-    return MobileNet(0.75, **kwargs)
+def mobilenet0_75(pretrained=False, root=None, ctx=None, **kwargs):
+    return _load_pretrained(MobileNet(0.75, **kwargs), "mobilenet0.75",
+                            pretrained, root, ctx)
 
 
-def mobilenet0_5(pretrained=False, **kwargs):
-    _no_pretrained(pretrained)
-    return MobileNet(0.5, **kwargs)
+def mobilenet0_5(pretrained=False, root=None, ctx=None, **kwargs):
+    return _load_pretrained(MobileNet(0.5, **kwargs), "mobilenet0.5",
+                            pretrained, root, ctx)
 
 
-def mobilenet0_25(pretrained=False, **kwargs):
-    _no_pretrained(pretrained)
-    return MobileNet(0.25, **kwargs)
+def mobilenet0_25(pretrained=False, root=None, ctx=None, **kwargs):
+    return _load_pretrained(MobileNet(0.25, **kwargs), "mobilenet0.25",
+                            pretrained, root, ctx)
 
 
 _models = {"resnet18_v1": resnet18_v1, "resnet34_v1": resnet34_v1,

@@ -1,0 +1,54 @@
+"""Model-zoo pretrained=True via the local model store (reference
+model_zoo/model_store.py get_model_file + factory load_params; here
+zero-egress, so the store is local-only)."""
+import os
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+from mxnet_tpu.gluon import model_zoo
+from mxnet_tpu.gluon.model_zoo import model_store
+
+
+def test_get_model_file_missing_is_actionable(tmp_path):
+    with pytest.raises(FileNotFoundError) as ei:
+        model_store.get_model_file("squeezenet1.0", root=str(tmp_path))
+    msg = str(ei.value)
+    assert "squeezenet1.0.params" in msg and "zero egress" in msg
+
+
+def test_store_root_resolution(tmp_path, monkeypatch):
+    monkeypatch.setenv("MXNET_HOME", str(tmp_path))
+    assert model_store.model_store_root() == str(tmp_path / "models")
+    assert model_store.model_store_root("/x/y") == "/x/y"
+
+
+def test_pretrained_roundtrip_through_store(tmp_path, monkeypatch):
+    """save_params -> local store -> pretrained=True reproduces the
+    exact forward outputs (the pretrained-zoo inference contract,
+    reference tests/python/gpu/test_forward.py made hermetic)."""
+    store = tmp_path / "models"
+    store.mkdir()
+    monkeypatch.setenv("MXNET_HOME", str(tmp_path))
+
+    mx.random.seed(42)
+    np.random.seed(42)
+    net = model_zoo.vision.squeezenet1_0(classes=10)
+    net.initialize(mx.init.Xavier())
+    x = nd.array(np.random.RandomState(0).randn(2, 3, 64, 64)
+                 .astype(np.float32))
+    want = net(x).asnumpy()   # also completes deferred init
+    net.save_params(str(store / "squeezenet1.0.params"))
+
+    loaded = model_zoo.get_model("squeezenet1.0", pretrained=True,
+                                 classes=10)
+    got = loaded(x).asnumpy()
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+
+
+def test_pretrained_false_ignores_store(tmp_path, monkeypatch):
+    monkeypatch.setenv("MXNET_HOME", str(tmp_path))   # empty store
+    net = model_zoo.vision.mobilenet0_25(classes=10)  # must not raise
+    assert net is not None
