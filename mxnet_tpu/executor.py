@@ -103,6 +103,11 @@ def _graph_eval_fn(symbol, mesh=None, group2spec=None, capture=None):
     node_uid = {id(n): i for i, n in enumerate(order)}
 
     def eval_fn(arg_vals, aux_vals, rng, is_train):
+        from .ops._mesh_ctx import use_mesh
+        with use_mesh(mesh):
+            return _eval_body(arg_vals, aux_vals, rng, is_train)
+
+    def _eval_body(arg_vals, aux_vals, rng, is_train):
         env = {}
         aux_out = dict(aux_vals)
         for node in order:

@@ -16,8 +16,10 @@ from __future__ import annotations
 from .. import symbol as sym
 
 
-def _attention_block(x, num_heads, dim, prefix):
-    """x: (B, T, C) -> (B, T, C); causal flash attention."""
+def _attention_block(x, num_heads, dim, prefix, seq_axis=None):
+    """x: (B, T, C) -> (B, T, C); causal flash attention (ring
+    attention over ``seq_axis`` when the graph lowers on a mesh
+    carrying that axis)."""
     H = num_heads
     head_dim = dim // num_heads
     qkv = sym.FullyConnected(x, num_hidden=3 * dim, flatten=False,
@@ -31,7 +33,8 @@ def _attention_block(x, num_heads, dim, prefix):
         return sym.reshape(part, shape=(-3, -2))      # (B, H, T, hd)
 
     att = sym.contrib.FlashAttention(head(0), head(1), head(2),
-                                     causal=True, name=prefix + "attn")
+                                     causal=True, seq_axis=seq_axis,
+                                     name=prefix + "attn")
     att = sym.transpose(att, axes=(0, 2, 1, 3))       # (B, T, H, hd)
     att = sym.reshape(att, shape=(0, 0, -3))          # (B, T, C)
     return sym.FullyConnected(att, num_hidden=dim, flatten=False,
@@ -70,7 +73,7 @@ def _moe_block(x, dim, hidden, num_experts, prefix):
 
 def get_symbol(vocab_size, seq_len, num_layers=2, num_heads=4, dim=128,
                ffn_hidden=None, dropout=0.0, max_len=None,
-               num_experts=0):
+               num_experts=0, seq_axis=None):
     """GPT-style causal LM symbol.
 
     data: (B, T) token ids; softmax_label: (B, T) next-token targets
@@ -85,6 +88,12 @@ def get_symbol(vocab_size, seq_len, num_layers=2, num_heads=4, dim=128,
     (_contrib_MoEFFN); under a mesh the expert dimension shards like
     any parameter, and the shard_map expert-parallel form lives in
     parallel.moe_ffn.
+
+    seq_axis: mesh-axis name for sequence/context parallelism. When the
+    symbol is bound/trained over a mesh with that axis, every attention
+    layer runs ring attention (K/V blocks rotating on ppermute, T/n of
+    the sequence per device) — the long-context training path through
+    the ordinary symbol API. Without a mesh the flag is inert.
     """
     ffn_hidden = ffn_hidden or 4 * dim
     max_len = max_len or seq_len
@@ -104,7 +113,8 @@ def get_symbol(vocab_size, seq_len, num_layers=2, num_heads=4, dim=128,
     for i in range(num_layers):
         p = "layer%d_" % i
         a = sym.LayerNorm(x, name=p + "ln1")
-        x = x + _attention_block(a, num_heads, dim, p)
+        x = x + _attention_block(a, num_heads, dim, p,
+                                 seq_axis=seq_axis)
         f = sym.LayerNorm(x, name=p + "ln2")
         ff = _moe_block(f, dim, ffn_hidden, num_experts, p) \
             if num_experts else _ffn_block(f, dim, ffn_hidden, p)

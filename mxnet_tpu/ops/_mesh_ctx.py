@@ -1,0 +1,34 @@
+"""Ambient mesh for mesh-aware operators.
+
+The executor announces the mesh it lowers a graph over; ops that can
+exploit a mesh axis (e.g. `_contrib_FlashAttention(seq_axis='sp')`
+switching to ring attention) read it at TRACE time. A contextvar —
+not a threaded argument — so the 350-op registry keeps its pure
+``fn(*arrays, **attrs)`` signature and only the ops that care opt in.
+
+Eager calls run with no ambient mesh and fall back to the single-chip
+kernel path.
+"""
+from __future__ import annotations
+
+import contextlib
+import contextvars
+
+_AMBIENT_MESH = contextvars.ContextVar("mxnet_tpu_ambient_mesh",
+                                       default=None)
+
+__all__ = ["ambient_mesh", "use_mesh"]
+
+
+def ambient_mesh():
+    """The mesh the surrounding graph is being lowered over, or None."""
+    return _AMBIENT_MESH.get()
+
+
+@contextlib.contextmanager
+def use_mesh(mesh):
+    tok = _AMBIENT_MESH.set(mesh)
+    try:
+        yield mesh
+    finally:
+        _AMBIENT_MESH.reset(tok)

@@ -173,9 +173,30 @@ def flash_attention(query, key, value, scale=None, causal=False,
           arg_names=("query", "key", "value"),
           aliases=("_contrib_flash_attention",),
           defaults={"scale": None, "causal": False, "block_q": 128,
-                    "block_k": 128})
+                    "block_k": 128, "seq_axis": None})
 def _flash_attention_op(query, key, value, scale=None, causal=False,
-                        block_q=128, block_k=128, **_):
-    """(B, H, T, D) fused attention; returns same shape."""
+                        block_q=128, block_k=128, seq_axis=None, **_):
+    """(B, H, T, D) fused attention; returns same shape.
+
+    seq_axis: name of a mesh axis to sequence-parallelize over. When the
+    surrounding graph is lowered over a mesh carrying that axis (>1
+    devices), the op runs RING attention — q stays put, k/v blocks
+    rotate via ppermute, each device holds T/n of the sequence
+    (parallel/ring.py; the symbol-level long-context path). Otherwise
+    (eager, no mesh, or axis absent/size-1) it is the single-chip
+    Pallas flash kernel. Inputs must be 4-D (B, H, T, D) for the ring
+    path."""
+    if seq_axis:
+        from ._mesh_ctx import ambient_mesh
+        mesh = ambient_mesh()
+        if mesh is not None and seq_axis in mesh.axis_names and \
+                mesh.shape[seq_axis] > 1:
+            if query.ndim != 4:
+                raise ValueError(
+                    "seq_axis ring attention needs (B, H, T, D) inputs, "
+                    "got ndim=%d" % query.ndim)
+            from ..parallel.ring import ring_attention
+            return ring_attention(query, key, value, mesh, seq_axis,
+                                  causal=bool(causal), scale=scale)
     return flash_attention(query, key, value, scale=scale, causal=causal,
                            block_q=block_q, block_k=block_k)

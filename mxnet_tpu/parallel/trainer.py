@@ -180,6 +180,11 @@ class TrainStep:
         if self.mesh is None:
             return {k: jax.device_put(jnp.asarray(v))
                     for k, v in batch.items()}
+        if "data" not in self.mesh.axis_names:
+            # sp/pipe/expert-only meshes: batch enters replicated and the
+            # mesh-aware ops (ring attention etc.) shard what they need
+            return {k: jax.device_put(v, shd.replicated(self.mesh))
+                    for k, v in batch.items()}
         return {k: jax.device_put(
             v, shd.batch_sharding(self.mesh, np.ndim(v)))
             for k, v in batch.items()}

@@ -146,3 +146,88 @@ class TestRingAttention:
             np.testing.assert_allclose(np.asarray(g), np.asarray(w),
                                        rtol=2e-4, atol=2e-5,
                                        err_msg="d%s" % name)
+
+
+@pytest.mark.skipif(jax.device_count() < 8, reason="needs 8-device mesh")
+class TestSeqAxisOp:
+    """seq_axis on _contrib_FlashAttention: the symbol-level
+    sequence-parallel path (ring attention under an ambient mesh)."""
+
+    def test_symbol_graph_rings_on_mesh(self):
+        import mxnet_tpu as mx
+        from mxnet_tpu.executor import _graph_eval_fn
+
+        mesh = Mesh(np.array(jax.devices()[:8]), ("sp",))
+        B, H, T, D = 2, 2, 8 * 8, 16
+        q, k, v = (mx.sym.Variable(n) for n in "qkv")
+        out = mx.sym.contrib.FlashAttention(q, k, v, causal=True,
+                                            seq_axis="sp")
+        qv, kv, vv = _qkv(B, T, D, heads=H)
+        shard = NamedSharding(mesh, P(None, None, "sp", None))
+
+        fn = _graph_eval_fn(out, mesh=mesh)
+        args = {"q": jax.device_put(qv, shard),
+                "k": jax.device_put(kv, shard),
+                "v": jax.device_put(vv, shard)}
+        jitted = jax.jit(lambda a: fn(a, {}, jax.random.PRNGKey(0),
+                                      False)[0][0])
+        got = jitted(args)
+        # ring == dense reference
+        ref = _attn_reference(qv.reshape(B * H, T, D),
+                              kv.reshape(B * H, T, D),
+                              vv.reshape(B * H, T, D), D ** -0.5, True)
+        np.testing.assert_allclose(
+            np.asarray(got).reshape(B * H, T, D), np.asarray(ref),
+            rtol=2e-5, atol=2e-6)
+        # and it really went around the ring
+        hlo = jitted.lower(args).compile().as_text()
+        assert "collective-permute" in hlo
+
+    def test_no_mesh_falls_back_to_flash(self):
+        import mxnet_tpu as mx
+        from mxnet_tpu.executor import _graph_eval_fn
+
+        q, k, v = (mx.sym.Variable(n) for n in "qkv")
+        out = mx.sym.contrib.FlashAttention(q, k, v, causal=True,
+                                            seq_axis="sp")
+        qv, kv, vv = _qkv(1, 32, 16, heads=2)
+        fn = _graph_eval_fn(out)   # no mesh
+        got = fn({"q": qv, "k": kv, "v": vv}, {},
+                 jax.random.PRNGKey(0), False)[0][0]
+        ref = _attn_reference(qv.reshape(2, 32, 16),
+                              kv.reshape(2, 32, 16),
+                              vv.reshape(2, 32, 16), 16 ** -0.5, True)
+        np.testing.assert_allclose(np.asarray(got).reshape(2, 32, 16),
+                                   np.asarray(ref), rtol=2e-5,
+                                   atol=2e-6)
+
+    def test_transformer_trains_sequence_parallel(self):
+        """End to end: transformer LM symbol with seq_axis, TrainStep
+        over an {'sp': 8} mesh — compiles, runs, loss sane, ring
+        collectives present."""
+        import mxnet_tpu as mx
+        from mxnet_tpu.initializer import Xavier
+        from mxnet_tpu.models import transformer
+        from mxnet_tpu.parallel import make_mesh, make_train_step
+
+        mesh = make_mesh({"sp": 8})
+        vocab, T, B = 64, 8 * 8, 2
+        sym_ = transformer.get_symbol(vocab, T, num_layers=1,
+                                      num_heads=2, dim=32,
+                                      seq_axis="sp")
+        step = make_train_step(sym_, optimizer="adam", mesh=mesh)
+        state = step.init_state(Xavier(), {"data": (B, T),
+                                           "softmax_label": (B, T)})
+        rng_np = np.random.RandomState(0)
+        toks = rng_np.randint(0, vocab, (B, T)).astype(np.float32)
+        labels = np.roll(toks, -1, axis=1)
+        labels[:, -1] = -1
+        batch = step.place_batch({"data": toks,
+                                  "softmax_label": labels})
+        hlo = step.lower(state, batch, 1e-3,
+                         jax.random.PRNGKey(0)).compile().as_text()
+        assert "collective-permute" in hlo
+        state, outs = step(state, batch, 1e-3, jax.random.PRNGKey(0))
+        probs = np.asarray(outs[0])
+        assert np.isfinite(probs).all()
+        np.testing.assert_allclose(probs.sum(axis=1), 1.0, rtol=1e-4)

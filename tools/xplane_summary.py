@@ -1,0 +1,126 @@
+"""Summarize a jax.profiler xplane trace: device time per HLO category.
+
+The reproducible half of docs/mfu_analysis.md: turns a trace directory
+into the BN-vs-matmul breakdown table.
+
+    python - <<'PY'
+    import jax
+    jax.profiler.start_trace("/tmp/trace")
+    ...  # run a few steps, sync with np.asarray(jax.device_get(x))
+    jax.profiler.stop_trace()
+    PY
+    python tools/xplane_summary.py /tmp/trace
+
+Parses the raw *.xplane.pb protos. On TPU the "/device:TPU:N" planes'
+"XLA Ops" line holds the HLO-op events and the table is exact; on the
+CPU backend the single "/host:CPU" plane also carries runtime/compile
+events, so CPU output is indicative only. Two environment quirks this tool handles
+(learned the hard way — see docs/mfu_analysis.md):
+- must run under PROTOCOL_BUFFERS_PYTHON_IMPLEMENTATION=python (the
+  tool re-execs itself to set this before importing the proto);
+- uses tensorflow.tsl.profiler.protobuf.xplane_pb2 directly — the
+  tensorboard_plugin_profile conversion API is broken against the
+  installed TF 2.21.
+"""
+import collections
+import glob
+import os
+import re
+import sys
+
+if os.environ.get("PROTOCOL_BUFFERS_PYTHON_IMPLEMENTATION") != "python":
+    os.environ["PROTOCOL_BUFFERS_PYTHON_IMPLEMENTATION"] = "python"
+    os.execv(sys.executable, [sys.executable] + sys.argv)
+
+
+# op-name -> coarse category. Order matters: first match wins, so the
+# specific multi-word keys (all-reduce, reduce-window) must precede the
+# bare "reduce" of the bn-stats bucket.
+_CATEGORIES = (
+    ("collectives", ("all-reduce", "all-gather", "reduce-scatter",
+                     "collective-permute", "all-to-all")),
+    ("pooling", ("reduce-window", "select-and-scatter", "pool")),
+    ("convolution", ("conv",)),
+    ("matmul", ("dot", "einsum", "matmul")),
+    ("bn-stats / reductions", ("reduce", "variance", "norm")),
+    ("copies / layout", ("copy", "transpose", "bitcast", "reshape",
+                         "pad", "slice", "concatenate")),
+    ("elementwise fusion", ("fusion", "add", "multiply", "subtract",
+                            "divide", "tanh", "exp", "maximum")),
+    ("custom / pallas", ("custom-call",)),
+)
+
+
+def _category(name):
+    low = name.lower()
+    for cat, keys in _CATEGORIES:
+        if any(k in low for k in keys):
+            return cat
+    return "other"
+
+
+def summarize(trace_dir):
+    from tensorflow.tsl.profiler.protobuf import xplane_pb2
+
+    paths = glob.glob(os.path.join(
+        trace_dir, "**", "*.xplane.pb"), recursive=True)
+    if not paths:
+        raise SystemExit("no *.xplane.pb under %s" % trace_dir)
+
+    per_cat = collections.Counter()
+    per_op = collections.Counter()
+    total = 0
+    for path in paths:
+        xspace = xplane_pb2.XSpace()
+        with open(path, "rb") as f:
+            xspace.ParseFromString(f.read())
+        for plane in xspace.planes:
+            # accelerator planes ("/device:TPU:0") — or, on the CPU
+            # backend, the "/host:CPU" compute plane; skip metadata and
+            # python host-activity planes
+            if not (re.search(r"/device:|tpu|gpu", plane.name,
+                              re.IGNORECASE)
+                    or plane.name == "/host:CPU"):
+                continue
+            ev_names = {eid: em.name
+                        for eid, em in plane.event_metadata.items()}
+            # device planes carry overlapping lines (XLA Modules / Steps
+            # span the same wall time as the per-op line) — keep only the
+            # HLO-op line when one exists, else every line (CPU backend)
+            lines = [ln for ln in plane.lines
+                     if "xla ops" in ln.name.lower()] or list(plane.lines)
+            for line in lines:
+                for ev in line.events:
+                    name = ev_names.get(ev.metadata_id, "?")
+                    # python host-activity frames leak into /host:CPU on
+                    # the CPU backend; keep HLO-op events only
+                    if ".py:" in name or name.startswith("$"):
+                        continue
+                    dur = ev.duration_ps
+                    per_cat[_category(name)] += dur
+                    per_op[name] += dur
+                    total += dur
+    return per_cat, per_op, total
+
+
+def main():
+    if len(sys.argv) != 2:
+        raise SystemExit("usage: xplane_summary.py <trace_dir>")
+    per_cat, per_op, total = summarize(sys.argv[1])
+    if not total:
+        raise SystemExit("no device events found (trace too short, or "
+                         "only host planes present)")
+    print("device time by category:")
+    print("| category | ms | share |")
+    print("|---|---|---|")
+    for cat, ps in per_cat.most_common():
+        print("| %s | %.2f | %.1f%% |" % (cat, ps / 1e9,
+                                          100.0 * ps / total))
+    print("\ntop 15 ops:")
+    for name, ps in per_op.most_common(15):
+        print("  %8.2f ms  %4.1f%%  %s" % (
+            ps / 1e9, 100.0 * ps / total, name[:90]))
+
+
+if __name__ == "__main__":
+    main()
