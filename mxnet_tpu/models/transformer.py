@@ -49,7 +49,7 @@ def _ffn_block(x, dim, hidden, prefix):
                               name=prefix + "fc2")
 
 
-def _moe_block(x, dim, hidden, num_experts, prefix):
+def _moe_block(x, dim, hidden, num_experts, prefix, expert_axis=None):
     """Switch-style MoE FFN (the residual around it lives in the layer
     loop, so capacity-dropped tokens pass through unchanged).
 
@@ -68,12 +68,13 @@ def _moe_block(x, dim, hidden, num_experts, prefix):
     w2 = sym.Variable(prefix + "experts_w2_weight",
                       shape=(num_experts, hidden, dim),
                       init=xavier(hidden, dim))
-    return sym.contrib.MoEFFN(x, gate, w1, w2, name=prefix + "moe")
+    return sym.contrib.MoEFFN(x, gate, w1, w2, expert_axis=expert_axis,
+                              name=prefix + "moe")
 
 
 def get_symbol(vocab_size, seq_len, num_layers=2, num_heads=4, dim=128,
                ffn_hidden=None, dropout=0.0, max_len=None,
-               num_experts=0, seq_axis=None):
+               num_experts=0, seq_axis=None, expert_axis=None):
     """GPT-style causal LM symbol.
 
     data: (B, T) token ids; softmax_label: (B, T) next-token targets
@@ -94,6 +95,9 @@ def get_symbol(vocab_size, seq_len, num_layers=2, num_heads=4, dim=128,
     layer runs ring attention (K/V blocks rotating on ppermute, T/n of
     the sequence per device) — the long-context training path through
     the ordinary symbol API. Without a mesh the flag is inert.
+
+    expert_axis: same contract for the MoE FFNs (num_experts > 0):
+    experts shard over the axis and tokens exchange via all_to_all.
     """
     ffn_hidden = ffn_hidden or 4 * dim
     max_len = max_len or seq_len
@@ -116,7 +120,8 @@ def get_symbol(vocab_size, seq_len, num_layers=2, num_heads=4, dim=128,
         x = x + _attention_block(a, num_heads, dim, p,
                                  seq_axis=seq_axis)
         f = sym.LayerNorm(x, name=p + "ln2")
-        ff = _moe_block(f, dim, ffn_hidden, num_experts, p) \
+        ff = _moe_block(f, dim, ffn_hidden, num_experts, p,
+                        expert_axis=expert_axis) \
             if num_experts else _ffn_block(f, dim, ffn_hidden, p)
         if dropout > 0:
             ff = sym.Dropout(ff, p=dropout)

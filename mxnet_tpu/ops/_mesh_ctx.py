@@ -17,12 +17,23 @@ import contextvars
 _AMBIENT_MESH = contextvars.ContextVar("mxnet_tpu_ambient_mesh",
                                        default=None)
 
-__all__ = ["ambient_mesh", "use_mesh"]
+__all__ = ["ambient_mesh", "active_mesh_axis", "use_mesh"]
 
 
 def ambient_mesh():
     """The mesh the surrounding graph is being lowered over, or None."""
     return _AMBIENT_MESH.get()
+
+
+def active_mesh_axis(axis_name):
+    """The ambient mesh if it carries ``axis_name`` with >1 devices,
+    else None — the single predicate every mesh-aware op's attr
+    (seq_axis, expert_axis, ...) gates on."""
+    mesh = _AMBIENT_MESH.get()
+    if mesh is not None and axis_name in mesh.axis_names and \
+            mesh.shape[axis_name] > 1:
+        return mesh
+    return None
 
 
 @contextlib.contextmanager

@@ -187,10 +187,9 @@ def _flash_attention_op(query, key, value, scale=None, causal=False,
     Pallas flash kernel. Inputs must be 4-D (B, H, T, D) for the ring
     path."""
     if seq_axis:
-        from ._mesh_ctx import ambient_mesh
-        mesh = ambient_mesh()
-        if mesh is not None and seq_axis in mesh.axis_names and \
-                mesh.shape[seq_axis] > 1:
+        from ._mesh_ctx import active_mesh_axis
+        mesh = active_mesh_axis(seq_axis)
+        if mesh is not None:
             if query.ndim != 4:
                 raise ValueError(
                     "seq_axis ring attention needs (B, H, T, D) inputs, "

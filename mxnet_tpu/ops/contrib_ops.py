@@ -91,9 +91,9 @@ def _dequantize(data, min_range, max_range, out_type="float32", **_):
 @register("_contrib_MoEFFN",
           arg_names=("data", "gate_weight", "expert_w1", "expert_w2"),
           aliases=("_contrib_moe_ffn",),
-          defaults={"capacity_factor": 1.25})
+          defaults={"capacity_factor": 1.25, "expert_axis": None})
 def _moe_ffn_op(data, gate_weight, expert_w1, expert_w2,
-                capacity_factor=1.25, **_):
+                capacity_factor=1.25, expert_axis=None, **_):
     """Switch-style top-1 mixture-of-experts FFN (single-program form of
     parallel/moe.py — same routing math, no collectives; under a GSPMD
     mesh the expert dim shards like any other tensor).
@@ -101,10 +101,37 @@ def _moe_ffn_op(data, gate_weight, expert_w1, expert_w2,
     data (B, T, D) or (N, D); gate_weight (D, E); expert_w1 (E, D, H);
     expert_w2 (E, H, D). Tokens beyond an expert's capacity
     (ceil(N * capacity_factor / E)) output zero — pair with a residual.
+
+    expert_axis: mesh-axis name for EXPLICIT expert parallelism. When
+    the surrounding graph lowers over a mesh carrying that axis (>1
+    devices), experts live sharded on it and tokens exchange via
+    all_to_all (parallel/moe.py moe_ffn) instead of relying on GSPMD
+    propagation. Inert eagerly / off-mesh — same ambient-mesh contract
+    as FlashAttention's seq_axis.
     """
-    from ..parallel.moe import dense_moe
     orig_shape = data.shape
     x = data.reshape(-1, orig_shape[-1])
+    if expert_axis:
+        from ._mesh_ctx import active_mesh_axis
+        mesh = active_mesh_axis(expert_axis)
+        if mesh is not None:
+            n = mesh.shape[expert_axis]
+            if x.shape[0] % n:
+                raise ValueError(
+                    "expert_axis=%r: token count %d (=prod of %r[:-1]) "
+                    "must divide over the %d devices of that mesh axis"
+                    % (expert_axis, x.shape[0], orig_shape, n))
+            if gate_weight.shape[1] % n:
+                raise ValueError(
+                    "expert_axis=%r: num_experts %d must divide over "
+                    "the %d devices of that mesh axis"
+                    % (expert_axis, gate_weight.shape[1], n))
+            from ..parallel.moe import moe_ffn
+            out = moe_ffn(x, gate_weight, expert_w1, expert_w2, mesh,
+                          axis_name=expert_axis,
+                          capacity_factor=float(capacity_factor))
+            return out.astype(data.dtype).reshape(orig_shape)
+    from ..parallel.moe import dense_moe
     out = dense_moe(x, gate_weight, expert_w1, expert_w2,
                     capacity_factor=float(capacity_factor))
     return out.astype(data.dtype).reshape(orig_shape)

@@ -102,3 +102,35 @@ def test_moe_matches_routing_oracle():
     g = jax.jit(jax.grad(lambda *a: jnp.sum(
         moe_ffn(*a, mesh=mesh) ** 2)))(x, gw, w1, w2)
     assert np.isfinite(np.asarray(g).sum())
+
+
+@pytest.mark.skipif(jax.device_count() < 8, reason="needs 8-device mesh")
+def test_moe_transformer_expert_axis_trains():
+    """expert_axis through the symbol API: the MoE transformer's FFN
+    runs the all_to_all expert-parallel form when trained over an
+    {'expert': n} mesh (ambient-mesh contract, same as seq_axis)."""
+    import mxnet_tpu as mx
+    from mxnet_tpu.initializer import Xavier
+    from mxnet_tpu.models import transformer
+    from mxnet_tpu.parallel import make_mesh, make_train_step
+
+    mesh = make_mesh({"expert": 8})
+    vocab, T, B, E = 32, 16, 8, 8
+    sym = transformer.get_symbol(vocab, T, num_layers=1, num_heads=2,
+                                 dim=32, num_experts=E,
+                                 expert_axis="expert")
+    step = make_train_step(sym, optimizer="adam", mesh=mesh)
+    state = step.init_state(Xavier(), {"data": (B, T),
+                                       "softmax_label": (B, T)})
+    rng_np = np.random.RandomState(0)
+    toks = rng_np.randint(0, vocab, (B, T)).astype(np.float32)
+    labels = np.roll(toks, -1, axis=1)
+    labels[:, -1] = -1
+    batch = step.place_batch({"data": toks, "softmax_label": labels})
+    rng = jax.random.PRNGKey(0)
+    hlo = step.lower(state, batch, 1e-3, rng).compile().as_text()
+    assert "all-to-all" in hlo
+    state, outs = step(state, batch, 1e-3, rng)
+    probs = np.asarray(outs[0])
+    assert np.isfinite(probs).all()
+    np.testing.assert_allclose(probs.sum(axis=1), 1.0, rtol=1e-4)

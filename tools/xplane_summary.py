@@ -40,6 +40,8 @@ _CATEGORIES = (
     ("collectives", ("all-reduce", "all-gather", "reduce-scatter",
                      "collective-permute", "all-to-all")),
     ("pooling", ("reduce-window", "select-and-scatter", "pool")),
+    # "convert" (dtype cast) before the "conv" substring would claim it
+    ("copies / layout", ("convert",)),
     ("convolution", ("conv",)),
     ("matmul", ("dot", "einsum", "matmul")),
     ("bn-stats / reductions", ("reduce", "variance", "norm")),
