@@ -245,3 +245,67 @@ def test_bf16_compute_keeps_embedding_ids_exact():
     # bf16 compute in the matmul: loose tolerance, but id aliasing would
     # produce a completely different distribution (wrong rows)
     np.testing.assert_allclose(got, want, rtol=0.1, atol=0.02)
+
+
+def test_train_step_save_load_state_roundtrip():
+    """SPMD checkpoint/resume: save under zero1 on a mesh, restore into
+    (a) the same setup and (b) a mesh-less replicated step — training
+    trajectories must continue identically."""
+    import os
+    import tempfile
+
+    X, y = _toy()
+    mesh = data_parallel_mesh()
+    kwargs = dict(optimizer="adam",
+                  optimizer_params={"rescale_grad": 1.0 / 64})
+    z1 = make_train_step(_mlp(), mesh=mesh, optimizer_sharding="zero1",
+                         **kwargs)
+    state = z1.init_state(Xavier(), {"data": X.shape,
+                                     "softmax_label": y.shape})
+    rng = jax.random.PRNGKey(0)
+    b = z1.place_batch({"data": X, "softmax_label": y})
+    for _ in range(3):
+        state, _ = z1(state, b, 0.05, rng)
+
+    prefix = os.path.join(tempfile.mkdtemp(), "ckpt")
+    # snapshot the post-save trajectory before donation eats the state
+    path = z1.save_state(prefix, state)
+    ref_state = z1.load_state(prefix)
+    ref_state, ref_outs = z1(ref_state, b, 0.05, rng)
+
+    # (a) same mesh/sharding resume
+    re_state = z1.load_state(prefix)
+    m = re_state[1]["fc1_weight"][0]
+    assert "data" in str(m.sharding.spec), m.sharding   # zero1 restored
+    re_state, re_outs = z1(re_state, b, 0.05, rng)
+    np.testing.assert_allclose(np.asarray(re_outs[0]),
+                               np.asarray(ref_outs[0]), rtol=1e-6)
+
+    # (b) restore onto NO mesh (single chip) — same numbers
+    single = make_train_step(_mlp(), **kwargs)
+    s_state = single.load_state(prefix)
+    bs = single.place_batch({"data": X, "softmax_label": y})
+    s_state, s_outs = single(s_state, bs, 0.05, rng)
+    np.testing.assert_allclose(np.asarray(s_outs[0]),
+                               np.asarray(ref_outs[0]), rtol=2e-5,
+                               atol=1e-6)
+
+    # incompatible checkpoints fail loudly — BOTH directions: fewer
+    # saved slots than needed (sgd ckpt -> adam) and more (adam ckpt ->
+    # sgd, which would silently install adam's m as sgd momentum)
+    sgd = make_train_step(_mlp(), optimizer="sgd")
+    sgd_state = sgd.init_state(Xavier(), {"data": X.shape,
+                                          "softmax_label": y.shape})
+    sgd_prefix = prefix + "_sgd"
+    sgd.save_state(sgd_prefix, sgd_state)
+    adam = make_train_step(_mlp(), **kwargs)
+    with pytest.raises(ValueError, match="optimizer slots"):
+        adam.load_state(sgd_prefix)
+    with pytest.raises(ValueError, match="optimizer slots"):
+        sgd.load_state(prefix)
+    # ...and a different model's checkpoint is rejected at load time
+    other = make_train_step(mx.sym.SoftmaxOutput(mx.sym.FullyConnected(
+        mx.sym.Variable("data"), name="zzz", num_hidden=2),
+        name="softmax"), **kwargs)
+    with pytest.raises(ValueError, match="params"):
+        other.load_state(prefix)
