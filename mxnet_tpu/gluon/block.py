@@ -401,6 +401,33 @@ class HybridBlock(Block):
                               mx_random.next_key())
         return _regroup(out, self._out_format)[0]
 
+    def export(self, path, epoch=0):
+        """Write ``path-symbol.json`` + ``path-NNNN.params`` — the
+        checkpoint layout of ``model.save_checkpoint`` (reference
+        block.py:HybridBlock.export) — so a gluon-built network crosses
+        to every symbolic surface: ``model.load_checkpoint`` →
+        Module / Predictor / CompiledPredictor / ``parallel.TrainStep``
+        (compose a loss head on the loaded symbol for training).
+
+        Requires a completed hybrid trace: call ``hybridize()`` and run
+        one forward first so the graph and parameter shapes exist."""
+        if not self._cached_graph:
+            raise RuntimeError(
+                "export needs the traced graph: call hybridize() and "
+                "run a forward pass first")
+        from ..model import save_checkpoint
+        sym = self._cached_graph[1]
+        arg_names = set(sym.list_arguments())
+        aux_names = set(sym.list_auxiliary_states())
+        all_params = self.collect_params().values()
+        save_checkpoint(
+            path, epoch, sym,
+            {p.name: p.data() for p in all_params
+             if p.name in arg_names},
+            {p.name: p.data() for p in all_params
+             if p.name in aux_names})
+        return path
+
     def forward(self, x, *args):
         """Dispatch: hybrid path uses the cached compiled graph; eager
         path calls hybrid_forward with the ndarray namespace (reference

@@ -124,13 +124,26 @@ class TrainStep:
 
     # -- state -------------------------------------------------------------
     def init_state(self, initializer, batch_shapes, batch_dtypes=None,
-                   dtype=None):
+                   dtype=None, arg_params=None, aux_params=None):
         """Initialize (params, opt_state, aux) with mesh placement.
 
         initializer: mxnet_tpu.initializer.Initializer applied host-side
-        (reference init path), then placed per the sharding rules."""
+        (reference init path), then placed per the sharding rules.
+
+        arg_params/aux_params: pretrained values (NDArray or array) to
+        adopt instead of initializing — the ``Module.fit(arg_params=)``
+        surface for the SPMD path, e.g. a ``model.load_checkpoint`` or
+        ``HybridBlock.export`` checkpoint. Anything not supplied falls
+        back to the initializer; optimizer state starts at zero either
+        way."""
         from ..initializer import InitDesc
-        from ..ndarray import zeros as nd_zeros
+        from ..ndarray import NDArray, zeros as nd_zeros
+
+        def _raw(x):
+            return x._data if isinstance(x, NDArray) else jnp.asarray(x)
+
+        arg_params = {k: _raw(v) for k, v in (arg_params or {}).items()}
+        aux_params = {k: _raw(v) for k, v in (aux_params or {}).items()}
 
         input_shapes = dict(batch_shapes)
         arg_shapes, _, aux_shapes = self.symbol.infer_shape(**input_shapes)
@@ -139,17 +152,29 @@ class TrainStep:
 
         params, opt_state, aux = {}, {}, {}
         for n in self.param_names:
-            arr = nd_zeros(name2shape[n])
-            initializer(InitDesc(n), arr)
-            v = arr._data if dtype is None else arr._data.astype(dtype)
+            if n in arg_params:
+                v = arg_params[n]
+                if tuple(v.shape) != tuple(name2shape[n]):
+                    raise ValueError(
+                        "arg_params[%r] has shape %r, symbol wants %r"
+                        % (n, tuple(v.shape), tuple(name2shape[n])))
+            else:
+                arr = nd_zeros(name2shape[n])
+                initializer(InitDesc(n), arr)
+                v = arr._data
+            if dtype is not None:
+                v = v.astype(dtype)
             params[n] = self._place_param(n, v)
             opt_state[n] = tuple(
                 self._place_opt(n, jnp.zeros_like(params[n]))
                 for _ in range(self._n_state))
         for n in self.aux_names:
-            init_v = jnp.ones(aux2shape[n], jnp.float32) \
-                if n.endswith("var") else jnp.zeros(aux2shape[n],
-                                                    jnp.float32)
+            if n in aux_params:
+                init_v = aux_params[n]
+            else:
+                init_v = jnp.ones(aux2shape[n], jnp.float32) \
+                    if n.endswith("var") else jnp.zeros(aux2shape[n],
+                                                        jnp.float32)
             aux[n] = self._place_rep(init_v)
         return params, opt_state, aux
 

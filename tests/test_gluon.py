@@ -325,3 +325,57 @@ def test_unrecognized_param_name_uses_default_fill():
     p = gluon.Parameter("alpha", shape=(3,))
     p.initialize()
     assert p.data().shape == (3,)
+
+
+def test_hybridblock_export_to_symbolic_surfaces():
+    """gluon -> export -> (Predictor, TrainStep): the checkpoint-layout
+    bridge from imperative model authoring to the deployment and SPMD
+    training paths (reference HybridBlock.export)."""
+    import os
+    import tempfile
+
+    import jax
+
+    from mxnet_tpu.parallel import data_parallel_mesh, make_train_step
+
+    net = gluon.nn.HybridSequential()
+    net.add(gluon.nn.Dense(16, activation="relu"),
+            gluon.nn.Dense(4))
+    net.initialize(mx.init.Xavier())
+    net.hybridize()
+    X = np.random.RandomState(0).randn(8, 12).astype(np.float32)
+    want = net(nd.array(X)).asnumpy()
+
+    prefix = os.path.join(tempfile.mkdtemp(), "gluon_net")
+    net.export(prefix)
+
+    # deployment path: load_checkpoint -> Predictor reproduces outputs
+    pred = mx.predictor.load_checkpoint_predictor(prefix, 0)
+    got = pred.forward(X)[0].asnumpy()
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+
+    # SPMD path: compose a loss head, adopt the exported weights via
+    # the public init_state(arg_params=...) surface, train on a mesh
+    sym_net, arg_params, aux_params = mx.model.load_checkpoint(prefix, 0)
+    loss = mx.sym.SoftmaxOutput(sym_net, name="softmax")
+    step = make_train_step(loss, mesh=data_parallel_mesh(),
+                           optimizer="sgd",
+                           optimizer_params={"rescale_grad": 1.0 / 8})
+    state = step.init_state(mx.init.Xavier(),
+                            {"data": X.shape, "softmax_label": (8,)},
+                            arg_params=arg_params,
+                            aux_params=aux_params)
+    np.testing.assert_allclose(
+        np.asarray(state[0]["dense0_weight"]),
+        arg_params["dense0_weight"].asnumpy())
+    y = np.random.RandomState(1).randint(0, 4, 8).astype(np.float32)
+    batch = step.place_batch({"data": X, "softmax_label": y})
+    state, outs = step(state, batch, 0.1, jax.random.PRNGKey(0))
+    assert np.isfinite(np.asarray(outs[0])).all()
+
+    # un-traced blocks refuse to export
+    fresh = gluon.nn.HybridSequential()
+    fresh.add(gluon.nn.Dense(2))
+    fresh.initialize()
+    with pytest.raises(RuntimeError, match="hybridize"):
+        fresh.export(prefix + "_x")

@@ -1,0 +1,75 @@
+#!/bin/bash
+# One-shot TPU measurement session — run the moment the axon tunnel is
+# up. Captures every number the docs/judge need, in priority order, so
+# a flaky tunnel still yields the headline artifact first.
+#
+#   bash tools/tpu_bench_session.sh [outdir]
+#
+# Produces in <outdir> (default bench_out/):
+#   resnet50.json            headline (the BENCH_rN.json payload)
+#   transformer_lm.json      MFU workload
+#   sweep.jsonl              catalog sweep (one line per network)
+#   raw_jax_control.txt      framework-overhead control
+#   trace/ + trace_summary.txt   xplane device-time breakdown
+set -u -o pipefail
+cd "$(dirname "$0")/.."
+export OUT="${1:-bench_out}"
+mkdir -p "$OUT"
+FAILED=()
+note() { [ "$1" -ne 0 ] && FAILED+=("$2 (rc=$1)"); true; }
+
+echo "== 1. headline resnet-50 =="
+python bench.py | tee "$OUT/resnet50.json"; note $? resnet50
+
+echo "== 2. transformer LM (MFU workload) =="
+python bench.py --network transformer_lm | tee "$OUT/transformer_lm.json"; note $? transformer_lm
+
+echo "== 3. catalog sweep =="
+: > "$OUT/sweep.jsonl"
+for net in resnet-18 resnet-34 resnet-101 resnet-152 inception-bn \
+           inception-v3 alexnet; do
+  echo "-- $net"
+  python bench.py --network "$net" | tee -a "$OUT/sweep.jsonl"; note $? "sweep:$net"
+done
+
+echo "== 4. raw-JAX control =="
+python benchmark/raw_jax_resnet.py | tee "$OUT/raw_jax_control.txt"; note $? raw_jax_control
+
+echo "== 5. device trace + breakdown =="
+python - <<'PY'
+import os, sys
+sys.path.insert(0, os.getcwd())
+import numpy as np, jax
+from mxnet_tpu.models import resnet
+from mxnet_tpu.parallel import make_train_step
+from mxnet_tpu.initializer import Xavier
+sym = resnet.get_symbol(num_classes=1000, num_layers=50,
+                        image_shape=(3, 224, 224))
+step = make_train_step(sym, optimizer="sgd",
+                       optimizer_params={"momentum": 0.9,
+                                         "rescale_grad": 1.0 / 128},
+                       compute_dtype="bfloat16")
+state = step.init_state(Xavier(), {"data": (128, 3, 224, 224),
+                                   "softmax_label": (128,)})
+b = step.place_batch({
+    "data": np.zeros((128, 3, 224, 224), np.float32),
+    "softmax_label": np.zeros((128,), np.float32)})
+rng = jax.random.PRNGKey(0)
+state, outs = step(state, b, 0.1, rng)          # compile
+np.asarray(jax.device_get(outs[0][0, 0]))
+out = os.environ.get("OUT", "bench_out")
+jax.profiler.start_trace(out + "/trace")
+for _ in range(5):
+    state, outs = step(state, b, 0.1, rng)
+np.asarray(jax.device_get(outs[0][0, 0]))
+jax.profiler.stop_trace()
+print("trace done")
+PY
+python tools/xplane_summary.py "$OUT/trace" \
+    | tee "$OUT/trace_summary.txt"; note $? trace_summary
+
+if [ ${#FAILED[@]} -gt 0 ]; then
+  echo "== session FINISHED WITH FAILURES: ${FAILED[*]}; artifacts in $OUT =="
+  exit 1
+fi
+echo "== session complete; artifacts in $OUT =="
