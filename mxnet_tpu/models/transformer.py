@@ -72,6 +72,39 @@ def _moe_block(x, dim, hidden, num_experts, prefix, expert_axis=None):
                               name=prefix + "moe")
 
 
+def _layer_block(x, num_heads, dim, ffn_hidden, prefix, seq_axis=None,
+                 num_experts=0, expert_axis=None, dropout=0.0):
+    """One pre-LN transformer block: attention residual + FFN/MoE
+    residual. Shared by the monolithic get_symbol layer loop and the
+    pipeline get_stage_symbol so the two can never drift."""
+    a = sym.LayerNorm(x, name=prefix + "ln1")
+    x = x + _attention_block(a, num_heads, dim, prefix,
+                             seq_axis=seq_axis)
+    f = sym.LayerNorm(x, name=prefix + "ln2")
+    ff = _moe_block(f, dim, ffn_hidden, num_experts, prefix,
+                    expert_axis=expert_axis) \
+        if num_experts else _ffn_block(f, dim, ffn_hidden, prefix)
+    if dropout > 0:
+        ff = sym.Dropout(ff, p=dropout)
+    return x + ff
+
+
+def get_stage_symbol(num_heads=4, dim=128, ffn_hidden=None,
+                     seq_axis=None):
+    """One transformer block as a standalone symbol: data (mb, T, C) ->
+    (mb, T, C). The pipeline-parallel stage for
+    ``parallel.pipeline_from_symbol`` — stack L layers' params on a
+    leading stage dim and stream microbatches through a ``pipe`` mesh
+    axis. Pre-LN and aux-free by construction, as the GPipe schedule
+    requires."""
+    ffn_hidden = ffn_hidden or 4 * dim
+    if dim % num_heads:
+        raise ValueError("dim (%d) must be divisible by num_heads (%d)"
+                         % (dim, num_heads))
+    return _layer_block(sym.Variable("data"), num_heads, dim,
+                        ffn_hidden, "")
+
+
 def get_symbol(vocab_size, seq_len, num_layers=2, num_heads=4, dim=128,
                ffn_hidden=None, dropout=0.0, max_len=None,
                num_experts=0, seq_axis=None, expert_axis=None):
@@ -115,17 +148,10 @@ def get_symbol(vocab_size, seq_len, num_layers=2, num_heads=4, dim=128,
     x = sym.broadcast_add(x, sym.expand_dims(pos, axis=0))
 
     for i in range(num_layers):
-        p = "layer%d_" % i
-        a = sym.LayerNorm(x, name=p + "ln1")
-        x = x + _attention_block(a, num_heads, dim, p,
-                                 seq_axis=seq_axis)
-        f = sym.LayerNorm(x, name=p + "ln2")
-        ff = _moe_block(f, dim, ffn_hidden, num_experts, p,
-                        expert_axis=expert_axis) \
-            if num_experts else _ffn_block(f, dim, ffn_hidden, p)
-        if dropout > 0:
-            ff = sym.Dropout(ff, p=dropout)
-        x = x + ff
+        x = _layer_block(x, num_heads, dim, ffn_hidden,
+                         "layer%d_" % i, seq_axis=seq_axis,
+                         num_experts=num_experts,
+                         expert_axis=expert_axis, dropout=dropout)
 
     x = sym.LayerNorm(x, name="ln_f")
     logits = sym.FullyConnected(x, num_hidden=vocab_size, flatten=False,

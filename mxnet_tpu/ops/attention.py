@@ -95,6 +95,18 @@ def _flash_forward(q, k, v, scale, causal, block_q, block_k, interpret):
     kernel = functools.partial(
         _flash_fwd_kernel, scale=scale, causal=causal, block_q=block_q,
         block_k=block_k, num_kb=nk, seq_k=Tk)
+    # under a vma-checking shard_map (e.g. a pipeline stage) the output
+    # aval must declare how it varies over mesh axes — the union of the
+    # inputs' variance (q may be replicated while k/v rotate, or vice
+    # versa). jax<0.9 has neither typeof nor vma; skip there.
+    typeof = getattr(jax, "typeof", None)
+    out_vma = None
+    if typeof is not None:
+        vmas = [getattr(typeof(x), "vma", None) for x in (q, k, v)]
+        vmas = [v_ for v_ in vmas if v_]
+        out_vma = frozenset().union(*vmas) if vmas else None
+    out_shape = jax.ShapeDtypeStruct(q.shape, q.dtype, vma=out_vma) \
+        if out_vma else jax.ShapeDtypeStruct(q.shape, q.dtype)
     return pl.pallas_call(
         kernel,
         grid=(BH, nq, nk),
@@ -104,7 +116,7 @@ def _flash_forward(q, k, v, scale, causal, block_q, block_k, interpret):
             pl.BlockSpec((1, block_k, D), lambda b, i, j: (b, j, 0)),
         ],
         out_specs=pl.BlockSpec((1, block_q, D), lambda b, i, j: (b, i, 0)),
-        out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
+        out_shape=out_shape,
         scratch_shapes=[
             pltpu.VMEM((block_q, 1), jnp.float32),
             pltpu.VMEM((block_q, 1), jnp.float32),

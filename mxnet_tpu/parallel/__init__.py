@@ -12,6 +12,6 @@ from .trainer import make_train_step, TrainStep
 from .sharding import (data_parallel_mesh, make_mesh, param_sharding,
                        batch_sharding)
 from .ring import ring_attention
-from .pipeline import pipeline_apply
+from .pipeline import pipeline_apply, pipeline_from_symbol
 from .moe import moe_ffn
 from . import dist

@@ -21,7 +21,7 @@ from jax.sharding import PartitionSpec as P
 
 from ._compat import pvary as _pvary, shard_map as _shard_map
 
-__all__ = ["pipeline_apply"]
+__all__ = ["pipeline_apply", "pipeline_from_symbol"]
 
 
 def pipeline_apply(stage_fn, stage_params, microbatches, mesh,
@@ -30,7 +30,10 @@ def pipeline_apply(stage_fn, stage_params, microbatches, mesh,
 
     stage_fn(params_i, x) -> y: one stage's computation; every stage
         must map (mb, ...) -> (mb, ...) of the same shape/dtype (pad
-        feature dims to a common width if stages differ).
+        feature dims to a common width if stages differ). A 3-argument
+        stage_fn additionally receives the schedule tick t (traced
+        int32) — combine it with ``lax.axis_index(axis_name)`` for
+        per-(stage, microbatch) randomness (dropout keys).
     stage_params: pytree whose leaves have leading dim S (stage i's
         slice lives on device i of the axis).
     microbatches: (M, mb, ...) — M microbatches streamed through.
@@ -40,9 +43,12 @@ def pipeline_apply(stage_fn, stage_params, microbatches, mesh,
     Equivalent to ``for p in stages: x = stage_fn(p, x)`` per
     microbatch (asserted in tests/test_pipeline_moe.py).
     """
+    import inspect
+
     S = mesh.shape[axis_name]
     M = microbatches.shape[0]
     fwd_perm = [(j, (j + 1) % S) for j in range(S)]
+    takes_tick = len(inspect.signature(stage_fn).parameters) >= 3
 
     def local(params, stream):
         # params: leaves (1, ...) = my stage; stream: (M, mb, ...) the
@@ -62,7 +68,7 @@ def pipeline_apply(stage_fn, stage_params, microbatches, mesh,
                 stream, jnp.minimum(t, M - 1), 0, keepdims=False)
             feed = jnp.where(t < M, feed, jnp.zeros_like(feed))
             x = jnp.where(me == 0, feed, carry)
-            y = stage_fn(my, x)
+            y = stage_fn(my, x, t) if takes_tick else stage_fn(my, x)
             # microbatch t reaches the last stage at tick t + S - 1
             out_slot = t - (S - 1)
             take = (me == S - 1) & (out_slot >= 0)
@@ -83,3 +89,58 @@ def pipeline_apply(stage_fn, stage_params, microbatches, mesh,
                     in_specs=(pspec, P()),
                     out_specs=P())
     return fn(stage_params, microbatches)
+
+
+def pipeline_from_symbol(layer_sym, stage_params, microbatches, mesh,
+                         axis_name="pipe", data_name="data",
+                         is_train=False, rng=None):
+    """GPipe over a SYMBOL-defined stage — pipeline parallelism for the
+    symbolic API (dp/tp: TrainStep mesh; sp: seq_axis; ep: expert_axis;
+    this is the pp leg).
+
+    layer_sym: a Symbol mapping input ``data_name`` of shape
+        (mb, ...) to a single same-shape/dtype output — e.g.
+        ``models.transformer.get_stage_symbol``. Must carry no
+        auxiliary states (BN moving stats can't live inside the
+        rotating schedule; use LayerNorm-style stages).
+    stage_params: dict name -> (S, ...) stacked per-stage values for
+        every non-data argument of ``layer_sym`` (stage i's slice is
+        row i).
+    microbatches: (M, mb, ...) streamed through all S stages.
+    Returns (M, mb, ...), differentiable; same contract as
+    ``pipeline_apply``.
+    """
+    from ..executor import _graph_eval_fn
+
+    if layer_sym.list_auxiliary_states():
+        raise ValueError(
+            "pipeline stages cannot carry auxiliary states %r — the "
+            "GPipe schedule has no slot for cross-microbatch mutable "
+            "state" % layer_sym.list_auxiliary_states())
+    if data_name not in layer_sym.list_arguments():
+        raise ValueError(
+            "data_name %r is not an argument of the stage symbol "
+            "(has %r) — the microbatch stream would be ignored"
+            % (data_name, layer_sym.list_arguments()))
+    arg_names = [n for n in layer_sym.list_arguments() if n != data_name]
+    missing = set(arg_names) - set(stage_params)
+    if missing:
+        raise ValueError("stage_params missing %r" % sorted(missing))
+    if len(layer_sym.list_outputs()) != 1:
+        raise ValueError("a pipeline stage must have exactly 1 output, "
+                         "got %r" % layer_sym.list_outputs())
+
+    eval_fn = _graph_eval_fn(layer_sym)
+    key = rng if rng is not None else jax.random.PRNGKey(0)
+
+    def stage_fn(params, x, t):
+        # distinct randomness per (stage, tick): dropout masks must not
+        # repeat across stages or microbatches
+        k = jax.random.fold_in(
+            jax.random.fold_in(key, lax.axis_index(axis_name)), t)
+        outs, _aux = eval_fn({**params, data_name: x}, {}, k, is_train)
+        return outs[0]
+
+    return pipeline_apply(stage_fn,
+                          {n: stage_params[n] for n in arg_names},
+                          microbatches, mesh, axis_name=axis_name)
