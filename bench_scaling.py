@@ -35,6 +35,9 @@ def _parse_args():
                    help="comma-separated device counts")
     p.add_argument("--network", default="resnet",
                    choices=["resnet", "transformer_lm"])
+    p.add_argument("--seq-parallel", action="store_true",
+                   help="transformer_lm over an 'sp' mesh (ring "
+                        "attention) instead of a data mesh")
     p.add_argument("--per-device-batch", type=int, default=8)
     p.add_argument("--iters", type=int, default=10)
     p.add_argument("--zero1", action="store_true",
@@ -90,7 +93,8 @@ def collective_bytes(hlo_text):
     return out
 
 
-def build_step(network, mesh, global_batch, zero1):
+def build_step(network, mesh, global_batch, zero1, seq_parallel=False,
+               seq_len=64):
     from mxnet_tpu import models
     from mxnet_tpu.initializer import Xavier
     from mxnet_tpu.parallel import make_train_step
@@ -106,11 +110,12 @@ def build_step(network, mesh, global_batch, zero1):
         shapes = {"data": (global_batch, 3, 8, 8),
                   "softmax_label": (global_batch,)}
     else:
-        sym = models.get_symbol(network="transformer", vocab_size=256,
-                                seq_len=64, num_layers=2, num_heads=4,
-                                dim=64)
-        shapes = {"data": (global_batch, 64),
-                  "softmax_label": (global_batch, 64)}
+        sym = models.get_symbol(
+            network="transformer", vocab_size=256, seq_len=seq_len,
+            num_layers=2, num_heads=4, dim=64,
+            seq_axis="sp" if seq_parallel else None)
+        shapes = {"data": (global_batch, seq_len),
+                  "softmax_label": (global_batch, seq_len)}
     step = make_train_step(sym, **kw)
     state = step.init_state(Xavier(), shapes)
     return step, state, shapes
@@ -141,12 +146,22 @@ def main():
         raise SystemExit("only %d devices visible, need %d"
                          % (len(devices), max(counts)))
 
+    if args.seq_parallel and args.network != "transformer_lm":
+        raise SystemExit("--seq-parallel needs --network transformer_lm")
+
     rows = []
     for n in counts:
-        mesh = make_mesh({"data": n}, devices=devices[:n])
-        gb = args.per_device_batch * n
+        if args.seq_parallel:
+            # weak scaling in SEQUENCE length: 64 tokens per device on
+            # an sp mesh, batch fixed — the long-context axis
+            mesh = make_mesh({"sp": n}, devices=devices[:n])
+            gb, seq_len = args.per_device_batch, 64 * n
+        else:
+            mesh = make_mesh({"data": n}, devices=devices[:n])
+            gb, seq_len = args.per_device_batch * n, 64
         step, state, shapes = build_step(args.network, mesh, gb,
-                                         args.zero1)
+                                         args.zero1, args.seq_parallel,
+                                         seq_len)
         rng_np = np.random.RandomState(0)
         if args.network == "resnet":
             batch = {"data": rng_np.standard_normal(
@@ -175,23 +190,31 @@ def main():
         np.asarray(jax.device_get(outs[0]))
         dt = (time.time() - t0) / args.iters
 
-        rows.append({"devices": n, "global_batch": gb,
-                     "step_ms": round(dt * 1e3, 2),
-                     "samples_s": round(gb / dt, 1),
-                     "collective_bytes_per_dev": coll,
-                     "zero1": bool(args.zero1)})
+        row = {"devices": n, "global_batch": gb,
+               "step_ms": round(dt * 1e3, 2),
+               "samples_s": round(gb / dt, 1),
+               "collective_bytes_per_dev": coll,
+               "zero1": bool(args.zero1)}
+        if args.network == "transformer_lm":
+            # under --seq-parallel the per-sample token count grows
+            # with n, so tokens/s is the honest weak-scaling metric
+            row["seq_len"] = seq_len
+            row["tokens_s"] = round(gb * seq_len / dt, 1)
+        rows.append(row)
         print(json.dumps(rows[-1]))
 
     base = rows[0]["step_ms"]
-    print("\n| devices | global batch | step ms | samples/s | "
-          "weak-scaling eff | collective bytes/dev |")
+    rate = "tokens_s" if "tokens_s" in rows[0] else "samples_s"
+    print("\n| devices | global batch | step ms | %s | "
+          "weak-scaling eff | collective bytes/dev |"
+          % rate.replace("_s", "/s"))
     print("|---|---|---|---|---|---|")
     for r in rows:
         eff = base / r["step_ms"]
         tot = sum(r["collective_bytes_per_dev"].values())
         print("| %d | %d | %.2f | %.1f | %.0f%% | %s |" % (
             r["devices"], r["global_batch"], r["step_ms"],
-            r["samples_s"], eff * 100,
+            r[rate], eff * 100,
             "{:,}".format(tot)))
 
 

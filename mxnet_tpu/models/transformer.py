@@ -102,7 +102,7 @@ def get_stage_symbol(num_heads=4, dim=128, ffn_hidden=None,
         raise ValueError("dim (%d) must be divisible by num_heads (%d)"
                          % (dim, num_heads))
     return _layer_block(sym.Variable("data"), num_heads, dim,
-                        ffn_hidden, "")
+                        ffn_hidden, "", seq_axis=seq_axis)
 
 
 def get_symbol(vocab_size, seq_len, num_layers=2, num_heads=4, dim=128,

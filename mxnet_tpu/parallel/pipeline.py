@@ -48,7 +48,12 @@ def pipeline_apply(stage_fn, stage_params, microbatches, mesh,
     S = mesh.shape[axis_name]
     M = microbatches.shape[0]
     fwd_perm = [(j, (j + 1) % S) for j in range(S)]
-    takes_tick = len(inspect.signature(stage_fn).parameters) >= 3
+    # tick is passed only to a stage_fn whose THIRD parameter is a
+    # plain positional without a default — a defaulted/keyword-only
+    # third param (eps=1e-6, *, cfg=None) must not receive it
+    _pos = [p for p in inspect.signature(stage_fn).parameters.values()
+            if p.kind in (p.POSITIONAL_ONLY, p.POSITIONAL_OR_KEYWORD)]
+    takes_tick = len(_pos) >= 3 and _pos[2].default is _pos[2].empty
 
     def local(params, stream):
         # params: leaves (1, ...) = my stage; stream: (M, mb, ...) the
