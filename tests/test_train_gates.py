@@ -181,3 +181,29 @@ def test_check_consistency_dtype_grid_catches_divergence():
     x = np.full((4,), 0.37, np.float32)
     with pytest.raises(AssertionError):
         check_consistency(unstable, [x], dtypes=["bfloat16"])
+
+
+@pytest.mark.parametrize("name,fn,args", [
+    ("softmax", lambda x: jax.nn.softmax(x, axis=-1),
+     [np.linspace(-8, 8, 64, dtype=np.float32).reshape(8, 8)]),
+    ("logsumexp",
+     lambda x: jax.scipy.special.logsumexp(x, axis=-1),
+     [np.linspace(-6, 6, 64, dtype=np.float32).reshape(8, 8)]),
+    ("layernorm",
+     lambda x: (x - x.mean(-1, keepdims=True))
+     / ((x.var(-1, keepdims=True) + 1e-5) ** 0.5),
+     [np.random.RandomState(0).randn(8, 32).astype(np.float32)]),
+    ("gelu", lambda x: jax.nn.gelu(x),
+     [np.linspace(-4, 4, 64, dtype=np.float32)]),
+    ("attention-scores",
+     lambda q, k: jax.nn.softmax(
+         (q @ k.T) / np.sqrt(16), axis=-1),
+     [np.random.RandomState(1).randn(8, 16).astype(np.float32) * 0.5,
+      np.random.RandomState(2).randn(8, 16).astype(np.float32) * 0.5]),
+])
+def test_bf16_grid_risky_ops(name, fn, args):
+    """The numerically risky kernels (softmax family, normalization,
+    smooth activations) must stay within bf16 tolerance of their f32
+    baselines — the dtype axis of the reference's check_consistency
+    matrix applied where it matters most on TPU."""
+    check_consistency(fn, args, dtypes=["bfloat16"])
