@@ -74,6 +74,33 @@ def main():
             ref.shape) - ref).max())
         print("max |ring - single_device_flash| = %.2e" % err)
 
+    # The same capability through the ordinary symbol API: a whole LM
+    # whose every attention layer rings over the mesh — one flag, no
+    # hand-rolled collectives (see docs/parallelism.md).
+    from mxnet_tpu.initializer import Xavier
+    from mxnet_tpu.models import transformer
+    from mxnet_tpu.parallel import make_mesh, make_train_step
+
+    sym = transformer.get_symbol(
+        vocab_size=256, seq_len=args.seq_len, num_layers=1,
+        num_heads=args.heads, dim=args.heads * args.head_dim,
+        seq_axis="sp")
+    step = make_train_step(sym, optimizer="adam",
+                           mesh=make_mesh({"sp": n}))
+    state = step.init_state(
+        Xavier(), {"data": (2, args.seq_len),
+                   "softmax_label": (2, args.seq_len)})
+    toks = rng.randint(0, 256, (2, args.seq_len)).astype(np.float32)
+    batch = step.place_batch(
+        {"data": toks, "softmax_label": np.roll(toks, -1, axis=1)})
+    state, outs = step(state, batch, 1e-3, jax.random.PRNGKey(0))
+    np.asarray(jax.device_get(outs[0][0, 0]))
+    t0 = time.time()
+    state, outs = step(state, batch, 1e-3, jax.random.PRNGKey(0))
+    np.asarray(jax.device_get(outs[0][0, 0]))
+    print("full LM train step (symbol seq_axis='sp'): %.1f ms for "
+          "%d-token context" % ((time.time() - t0) * 1e3, args.seq_len))
+
 
 if __name__ == "__main__":
     main()

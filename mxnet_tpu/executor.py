@@ -51,9 +51,15 @@ def _parse_pspec(spec):
                  for p in parts)
 
 
-def _shard_constraint(mesh, spec, val):
-    """Apply a sharding constraint to one node output, validating the spec
-    against the mesh and the value's shape."""
+def _shard_constraint(mesh, spec, val, strict=True):
+    """Apply a sharding constraint to one node output.
+
+    strict (the __shard__ attr): a spec naming an axis the mesh lacks,
+    or an indivisible dim, is an error. strict=False (the
+    __shard_hint__ attr): such specs are silently skipped — the lenient
+    form for annotations baked into reusable model builders (e.g. the
+    transformer's seq_axis residual-stream hint), where the same symbol
+    must still bind on meshes without that axis."""
     parts = _parse_pspec(spec)
     if len(parts) > np.ndim(val):
         return val  # annotation written for a different-rank tensor
@@ -61,10 +67,14 @@ def _shard_constraint(mesh, spec, val):
         if axis is None:
             continue
         if axis not in mesh.axis_names:
+            if not strict:
+                return val
             raise MXNetError(
                 "__shard__ axis %r not in mesh axes %r"
                 % (axis, mesh.axis_names))
         if val.shape[dim] % mesh.shape[axis] != 0:
+            if not strict:
+                return val
             raise MXNetError(
                 "__shard__=%r: dim %d of shape %r not divisible by mesh "
                 "axis %r (size %d)" % (spec, dim, tuple(val.shape), axis,
@@ -146,6 +156,12 @@ def _graph_eval_fn(symbol, mesh=None, group2spec=None, capture=None):
                 spec = _node_shard_spec(node, group2spec)
                 if spec is not None:
                     outs = [_shard_constraint(mesh, spec, o) for o in outs]
+                else:
+                    hint = node.misc_attrs.get("__shard_hint__")
+                    if hint is not None:
+                        outs = [_shard_constraint(mesh, hint, o,
+                                                  strict=False)
+                                for o in outs]
             if capture is not None:
                 capture(node.name, outs)
             env[id(node)] = outs

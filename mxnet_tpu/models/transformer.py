@@ -86,7 +86,14 @@ def _layer_block(x, num_heads, dim, ffn_hidden, prefix, seq_axis=None,
         if num_experts else _ffn_block(f, dim, ffn_hidden, prefix)
     if dropout > 0:
         ff = sym.Dropout(ff, p=dropout)
-    return x + ff
+    out = x + ff
+    if seq_axis:
+        # keep the (B, T, C) residual stream T-sharded between layers —
+        # without the hint GSPMD re-replicates it around the ring
+        # shard_map boundary (an all-gather per layer, visible in
+        # bench_scaling --seq-parallel). Lenient: inert off-mesh.
+        out._set_attr(__shard_hint__="None,%s,None" % seq_axis)
+    return out
 
 
 def get_stage_symbol(num_heads=4, dim=128, ffn_hidden=None,

@@ -186,3 +186,45 @@ def test_backward_do_mirror_env_matches_plain(monkeypatch):
     for k in plain:
         np.testing.assert_allclose(plain[k], mirrored[k], rtol=1e-5,
                                    atol=1e-6, err_msg=k)
+
+
+def test_shard_hint_is_lenient():
+    """__shard_hint__ applies when the mesh carries the axis and is
+    silently inert otherwise — unlike __shard__, which errors (so model
+    builders can bake hints into reusable symbols)."""
+    import jax
+    from mxnet_tpu.executor import _graph_eval_fn
+    from mxnet_tpu.parallel import make_mesh
+
+    if len(jax.devices()) < 4:
+        pytest.skip("needs 4 virtual devices")
+
+    x = S.Variable("data")
+    h = S.FullyConnected(x, name="fc1", num_hidden=8)
+    h._set_attr(__shard_hint__="None,model")
+    out = S.Activation(h, name="act", act_type="relu")
+
+    args = {"data": np.zeros((4, 6), np.float32),
+            "fc1_weight": np.zeros((8, 6), np.float32),
+            "fc1_bias": np.zeros((8,), np.float32)}
+    rng = jax.random.PRNGKey(0)
+
+    # axis present: the constraint lands — the activation (and
+    # everything downstream of it) comes out 'model'-sharded on dim 1
+    mesh = make_mesh({"model": 4}, devices=jax.devices()[:4])
+    fn = _graph_eval_fn(out, mesh=mesh)
+    res = jax.jit(lambda a: fn(a, {}, rng, False)[0][0])(args)
+    assert "model" in str(res.sharding.spec), res.sharding
+    assert res.sharding.spec[1] == "model", res.sharding
+
+    # axis absent: same symbol binds and runs, hint skipped
+    mesh2 = make_mesh({"data": 4}, devices=jax.devices()[:4])
+    fn2 = _graph_eval_fn(out, mesh=mesh2)
+    res = fn2(args, {}, rng, False)[0][0]
+    assert res.shape == (4, 8)
+
+    # non-divisible dim: skipped, not an error
+    mesh3 = make_mesh({"model": 3}, devices=jax.devices()[:3])
+    fn3 = _graph_eval_fn(out, mesh=mesh3)
+    res3 = fn3(args, {}, rng, False)[0][0]
+    assert res3.shape == (4, 8)
