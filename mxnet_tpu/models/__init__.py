@@ -9,15 +9,32 @@ from . import alexnet
 from . import vgg
 from . import mobilenet
 from . import resnext
+from . import googlenet
 from . import inception_bn
 from . import inception_v3
+from . import inception_v4
+from . import inception_resnet_v2
+
+
+class _ResnetV1:
+    """'resnet-v1' catalog entry: the reference's separate
+    symbols/resnet-v1.py file maps to resnet.get_symbol(version=1)."""
+    @staticmethod
+    def get_symbol(**kwargs):
+        kwargs.setdefault("version", 1)
+        return resnet.get_symbol(**kwargs)
 
 
 _CATALOG = {
     "lenet": lenet, "mlp": mlp, "resnet": resnet, "alexnet": alexnet,
     "vgg": vgg, "mobilenet": mobilenet, "resnext": resnext,
+    "googlenet": googlenet,
+    "resnet-v1": _ResnetV1, "resnet_v1": _ResnetV1,
     "inception-bn": inception_bn, "inception_bn": inception_bn,
     "inception-v3": inception_v3, "inception_v3": inception_v3,
+    "inception-v4": inception_v4, "inception_v4": inception_v4,
+    "inception-resnet-v2": inception_resnet_v2,
+    "inception_resnet_v2": inception_resnet_v2,
     "transformer": transformer,
 }
 

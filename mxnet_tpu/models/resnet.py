@@ -11,6 +11,47 @@ from __future__ import annotations
 from .. import symbol as sym
 
 
+def residual_unit_v1(data, num_filter, stride, dim_match, name,
+                     bottle_neck=True, bn_mom=0.9, memonger=False):
+    """One residual unit, ORIGINAL (v1, post-activation) form:
+    conv->bn->relu chains, projection shortcut from the raw input,
+    relu AFTER the add (reference symbols/resnet-v1.py:residual_unit).
+    """
+    def cbr(x, nf, kernel, stride_, pad, idx, act=True):
+        x = sym.Convolution(data=x, num_filter=nf, kernel=kernel,
+                            stride=stride_, pad=pad, no_bias=True,
+                            name="%s_conv%d" % (name, idx))
+        x = sym.BatchNorm(data=x, fix_gamma=False, eps=2e-5,
+                          momentum=bn_mom, name="%s_bn%d" % (name, idx))
+        if act:
+            x = sym.Activation(data=x, act_type="relu",
+                               name="%s_relu%d" % (name, idx))
+        return x
+
+    if bottle_neck:
+        body = cbr(data, int(num_filter * 0.25), (1, 1), stride,
+                   (0, 0), 1)
+        body = cbr(body, int(num_filter * 0.25), (3, 3), (1, 1),
+                   (1, 1), 2)
+        body = cbr(body, num_filter, (1, 1), (1, 1), (0, 0), 3,
+                   act=False)
+    else:
+        body = cbr(data, num_filter, (3, 3), stride, (1, 1), 1)
+        body = cbr(body, num_filter, (3, 3), (1, 1), (1, 1), 2,
+                   act=False)
+    if dim_match:
+        shortcut = data
+    else:
+        shortcut = sym.Convolution(data=data, num_filter=num_filter,
+                                   kernel=(1, 1), stride=stride,
+                                   no_bias=True, name=name + "_sc")
+        shortcut = sym.BatchNorm(data=shortcut, fix_gamma=False,
+                                 eps=2e-5, momentum=bn_mom,
+                                 name=name + "_sc_bn")
+    return sym.Activation(data=body + shortcut, act_type="relu",
+                          name=name + "_out")
+
+
 def residual_unit(data, num_filter, stride, dim_match, name,
                   bottle_neck=True, bn_mom=0.9, memonger=False):
     """One residual unit, pre-activation (v2) form (reference
@@ -69,8 +110,10 @@ def residual_unit(data, num_filter, stride, dim_match, name,
 
 
 def resnet(units, num_stages, filter_list, num_classes, image_shape,
-           bottle_neck=True, bn_mom=0.9, memonger=False):
-    """Assemble a ResNet (reference symbols/resnet.py:resnet)."""
+           bottle_neck=True, bn_mom=0.9, memonger=False, version=2):
+    """Assemble a ResNet (reference symbols/resnet.py:resnet; version=1
+    selects the original post-activation units of symbols/resnet-v1.py)."""
+    unit_fn = residual_unit if version == 2 else residual_unit_v1
     num_unit = len(units)
     assert num_unit == num_stages
     data = sym.Variable(name="data")
@@ -91,20 +134,22 @@ def resnet(units, num_stages, filter_list, num_classes, image_shape,
                            pad=(1, 1), pool_type="max")
 
     for i in range(num_stages):
-        body = residual_unit(
+        body = unit_fn(
             body, filter_list[i + 1],
             (1 if i == 0 else 2, 1 if i == 0 else 2), False,
             name="stage%d_unit%d" % (i + 1, 1), bottle_neck=bottle_neck,
             bn_mom=bn_mom, memonger=memonger)
         for j in range(units[i] - 1):
-            body = residual_unit(body, filter_list[i + 1], (1, 1), True,
+            body = unit_fn(body, filter_list[i + 1], (1, 1), True,
                                  name="stage%d_unit%d" % (i + 1, j + 2),
                                  bottle_neck=bottle_neck, bn_mom=bn_mom,
                                  memonger=memonger)
-    bn1 = sym.BatchNorm(data=body, fix_gamma=False, eps=2e-5,
-                        momentum=bn_mom, name="bn1")
-    relu1 = sym.Activation(data=bn1, act_type="relu", name="relu1")
-    pool1 = sym.Pooling(data=relu1, global_pool=True, kernel=(7, 7),
+    if version == 2:
+        # v2 trunk ends pre-activation: close with BN+relu
+        body = sym.BatchNorm(data=body, fix_gamma=False, eps=2e-5,
+                             momentum=bn_mom, name="bn1")
+        body = sym.Activation(data=body, act_type="relu", name="relu1")
+    pool1 = sym.Pooling(data=body, global_pool=True, kernel=(7, 7),
                         pool_type="avg", name="pool1")
     flat = sym.Flatten(data=pool1)
     fc1 = sym.FullyConnected(data=flat, num_hidden=num_classes, name="fc1")
@@ -112,9 +157,14 @@ def resnet(units, num_stages, filter_list, num_classes, image_shape,
 
 
 def get_symbol(num_classes, num_layers, image_shape, conv_workspace=256,
-               dtype="float32", **kwargs):
+               dtype="float32", version=2, **kwargs):
     """ResNet symbol factory (reference symbols/resnet.py:get_symbol) —
-    same layer-count table."""
+    same layer-count table. version=1 builds the original
+    post-activation form (reference symbols/resnet-v1.py)."""
+    version = int(version)
+    if version not in (1, 2):
+        raise ValueError("resnet version must be 1 or 2, got %r"
+                         % (version,))
     image_shape = [int(l) for l in image_shape.split(",")] \
         if isinstance(image_shape, str) else list(image_shape)
     (nchannel, height, width) = image_shape
@@ -151,4 +201,5 @@ def get_symbol(num_classes, num_layers, image_shape, conv_workspace=256,
 
     return resnet(units=units, num_stages=num_stages,
                   filter_list=filter_list, num_classes=num_classes,
-                  image_shape=image_shape, bottle_neck=bottle_neck)
+                  image_shape=image_shape, bottle_neck=bottle_neck,
+                  version=version)

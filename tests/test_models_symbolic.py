@@ -5,8 +5,10 @@ import numpy as np
 import pytest
 
 import mxnet_tpu as mx
-from mxnet_tpu.models import (alexnet, inception_bn, inception_v3,
-                              mobilenet, resnext, vgg)
+from mxnet_tpu.models import (alexnet, googlenet, inception_bn,
+                              inception_resnet_v2, inception_v3,
+                              inception_v4, mobilenet, resnet, resnext,
+                              vgg)
 
 CASES = [
     ("alexnet", lambda: alexnet.get_symbol(10), (2, 3, 224, 224)),
@@ -24,6 +26,14 @@ CASES = [
     # 139px keeps the CPU test fast; global pooling absorbs the grid size
     ("inception_v3", lambda: inception_v3.get_symbol(10),
      (2, 3, 139, 139)),
+    ("googlenet", lambda: googlenet.get_symbol(10), (2, 3, 224, 224)),
+    ("inception_v4", lambda: inception_v4.get_symbol(10),
+     (2, 3, 139, 139)),
+    ("inception_resnet_v2",
+     lambda: inception_resnet_v2.get_symbol(10), (2, 3, 139, 139)),
+    ("resnet18_v1", lambda: resnet.get_symbol(
+        10, num_layers=18, image_shape=(3, 64, 64), version=1),
+     (2, 3, 64, 64)),
 ]
 
 
