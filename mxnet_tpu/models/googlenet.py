@@ -56,7 +56,7 @@ def get_symbol(num_classes=1000, **_):
                             pool_type="max")
         else:
             x = _mix(x, *entry)
-    x = sym.Pooling(x, kernel=(7, 7), stride=(1, 1), pool_type="avg")
+    x = sym.Pooling(x, kernel=(7, 7), global_pool=True, pool_type="avg")
     x = sym.Flatten(x)
     x = sym.FullyConnected(x, num_hidden=num_classes, name="fc")
     return sym.SoftmaxOutput(x, name="softmax")

@@ -12,13 +12,7 @@ very deep inception stacks train without aux heads.
 from __future__ import annotations
 
 from .. import symbol as sym
-
-
-def _conv(x, name, nf, kernel, stride=(1, 1), pad=(0, 0), act=True):
-    x = sym.Convolution(x, num_filter=nf, kernel=kernel, stride=stride,
-                        pad=pad, no_bias=True, name=name)
-    x = sym.BatchNorm(x, eps=2e-5, name=name + "_bn")
-    return sym.Activation(x, act_type="relu") if act else x
+from .inception_v3 import _conv
 
 
 def _chain(x, name, steps):

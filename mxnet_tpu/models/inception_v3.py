@@ -8,11 +8,12 @@ from __future__ import annotations
 from .. import symbol as sym
 
 
-def _conv(x, name, nf, kernel, stride=(1, 1), pad=(0, 0)):
+def _conv(x, name, nf, kernel, stride=(1, 1), pad=(0, 0), act=True):
+    """conv+BN(+relu) — shared by the inception family builders."""
     x = sym.Convolution(x, num_filter=nf, kernel=kernel, stride=stride,
                         pad=pad, no_bias=True, name=name)
     x = sym.BatchNorm(x, eps=2e-5, name=name + "_bn")
-    return sym.Activation(x, act_type="relu")
+    return sym.Activation(x, act_type="relu") if act else x
 
 
 def _pool(x, kind, kernel=(3, 3), stride=(1, 1), pad=(1, 1)):

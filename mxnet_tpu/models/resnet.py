@@ -123,6 +123,13 @@ def resnet(units, num_stages, filter_list, num_classes, image_shape,
         body = sym.Convolution(data=data, num_filter=filter_list[0],
                                kernel=(3, 3), stride=(1, 1), pad=(1, 1),
                                no_bias=True, name="conv0")
+        if version == 1:
+            # v1 units consume an ACTIVATED trunk (v2's pre-activation
+            # units supply their own leading BN+relu)
+            body = sym.BatchNorm(data=body, fix_gamma=False, eps=2e-5,
+                                 momentum=bn_mom, name="bn0")
+            body = sym.Activation(data=body, act_type="relu",
+                                  name="relu0")
     else:  # imagenet stem
         body = sym.Convolution(data=data, num_filter=filter_list[0],
                                kernel=(7, 7), stride=(2, 2), pad=(3, 3),

@@ -25,6 +25,22 @@ from . import sharding as shd
 
 __all__ = ["make_train_step", "TrainStep"]
 
+
+def _nd_wrap(x):
+    from ..ndarray.ndarray import _wrap
+    return _wrap(x)
+
+
+class _SimpleBatchEnd:
+    """BatchEndParam-compatible namespace for Speedometer-style
+    callbacks (reference model.py:BatchEndParam)."""
+
+    def __init__(self, epoch, nbatch, eval_metric):
+        self.epoch = epoch
+        self.nbatch = nbatch
+        self.eval_metric = eval_metric
+        self.locals = None
+
 # fused optimizer ops: name -> (#state tensors, op name)
 _OPT_OPS = {
     "sgd": (1, "sgd_mom_update"),       # momentum (0.0 => plain sgd math)
@@ -177,6 +193,104 @@ class TrainStep:
                                                         jnp.float32)
             aux[n] = self._place_rep(init_v)
         return params, opt_state, aux
+
+    def fit(self, train_data, num_epoch, initializer=None, lr=0.01,
+            lr_scheduler=None, eval_metric="acc", state=None,
+            arg_params=None, aux_params=None, checkpoint_prefix=None,
+            checkpoint_period=1, resume=True, batch_end_callback=None,
+            epoch_end_callback=None, seed=0, logger=None):
+        """Module.fit for the SPMD path: epochs over a DataIter, metric
+        tracking, periodic checkpointing, and crash resume — the
+        reference fit-loop UX (base_module.py:fit) on the compiled
+        train step.
+
+        train_data: DataIter yielding DataBatch (batch size must match
+            across batches — one compiled program).
+        lr_scheduler: callable(update_count) -> lr (mxnet_tpu
+            lr_scheduler instances work).
+        checkpoint_prefix: save_state to ``prefix_NNNN`` each
+            ``checkpoint_period`` epochs; with resume=True an existing
+            latest checkpoint is loaded and training continues AFTER
+            it (the elastic-restart story — kill the process anywhere,
+            rerun the same command; the scheduler/rng update counter
+            resumes too, via the checkpoint's sidecar meta file).
+        Returns (state, final_metric_value) — metric is None when a
+        resumed run has no epochs left."""
+        import glob as _glob
+        import json as _json
+        import logging
+        import re as _re
+
+        from .. import metric as metric_mod
+        from ..initializer import Uniform
+
+        log = logger or logging.getLogger(__name__)
+        metric = metric_mod.create(eval_metric) \
+            if not hasattr(eval_metric, "update") else eval_metric
+
+        begin_epoch = 0
+        n_update = 0
+        if checkpoint_prefix and resume:
+            found = sorted(
+                p for p in _glob.glob(checkpoint_prefix + "_*.npz")
+                if _re.search(r"_\d{4}\.npz$", p))
+            if found:
+                latest = found[-1][:-len(".npz")]
+                begin_epoch = int(latest.rsplit("_", 1)[1]) + 1
+                state = self.load_state(latest)
+                try:
+                    with open(latest + ".meta.json") as f:
+                        n_update = int(_json.load(f)["n_update"])
+                except (OSError, ValueError, KeyError):
+                    log.warning(
+                        "%s.meta.json missing/unreadable; lr schedule "
+                        "and rng folds restart from update 0", latest)
+                log.info("resumed %s (continuing at epoch %d, "
+                         "update %d)", latest, begin_epoch, n_update)
+        if begin_epoch >= num_epoch:
+            log.info("checkpoints already cover all %d epochs; "
+                     "nothing to train", num_epoch)
+            return state, None
+        if state is None:
+            shapes = {}
+            for name, shape in (train_data.provide_data
+                                + train_data.provide_label):
+                shapes[name] = tuple(shape)
+            state = self.init_state(initializer or Uniform(0.01),
+                                    shapes, arg_params=arg_params,
+                                    aux_params=aux_params)
+
+        rng = jax.random.PRNGKey(seed)
+        for epoch in range(begin_epoch, num_epoch):
+            train_data.reset()
+            metric.reset()
+            for nbatch, batch in enumerate(train_data):
+                feed = dict(zip(self.data_names, batch.data))
+                feed.update(zip(self.label_names, batch.label))
+                cur_lr = lr_scheduler(n_update) if lr_scheduler else lr
+                placed = self.place_batch(
+                    {k: v.asnumpy() if hasattr(v, "asnumpy") else v
+                     for k, v in feed.items()})
+                state, outs = self(state, placed,
+                                   cur_lr, jax.random.fold_in(
+                                       rng, n_update))
+                n_update += 1
+                metric.update(batch.label,
+                              [_nd_wrap(o) for o in outs])
+                if batch_end_callback:
+                    batch_end_callback(_SimpleBatchEnd(
+                        epoch, nbatch, metric))
+            name, val = metric.get()
+            log.info("Epoch[%d] Train-%s=%f", epoch, name, val)
+            if checkpoint_prefix and \
+                    (epoch + 1) % checkpoint_period == 0:
+                ck = "%s_%04d" % (checkpoint_prefix, epoch)
+                self.save_state(ck, state)
+                with open(ck + ".meta.json", "w") as f:
+                    _json.dump({"n_update": n_update}, f)
+            if epoch_end_callback:
+                epoch_end_callback(epoch, state)
+        return state, metric.get()[1]
 
     def save_state(self, prefix, state):
         """Checkpoint (params, opt_state, aux) to ``prefix.npz`` —

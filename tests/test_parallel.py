@@ -309,3 +309,60 @@ def test_train_step_save_load_state_roundtrip():
         name="softmax"), **kwargs)
     with pytest.raises(ValueError, match="params"):
         other.load_state(prefix)
+
+
+def test_train_step_fit_loop_and_resume(tmp_path):
+    """TrainStep.fit: Module.fit UX on the SPMD path — trains to the
+    accuracy gate, checkpoints per epoch, and a 'crashed' rerun resumes
+    from the latest checkpoint instead of restarting."""
+    from mxnet_tpu import io
+
+    X, y = _toy(n=96)
+    prefix = str(tmp_path / "ck")
+
+    def make():
+        train = io.NDArrayIter(X, y, batch_size=32, shuffle=True)
+        step = make_train_step(_mlp(), optimizer="sgd",
+                               optimizer_params={"momentum": 0.9,
+                                                 "rescale_grad": 1.0 / 32},
+                               mesh=data_parallel_mesh())
+        return step, train
+
+    seen = []
+    step, train = make()
+    state, acc = step.fit(
+        train, num_epoch=12, initializer=Xavier(), lr=0.5,
+        checkpoint_prefix=prefix,
+        epoch_end_callback=lambda e, s: seen.append(e))
+    assert acc > 0.95, acc
+    assert seen == list(range(12))
+    import glob
+    assert len(glob.glob(prefix + "_*.npz")) == 12
+
+    # rerun the same command: must resume AFTER epoch 11, not retrain —
+    # and the update counter continues (scheduler/rng don't replay)
+    lrs_seen = []
+    step2, train2 = make()
+    resumed = []
+    state2, acc2 = step2.fit(
+        train2, num_epoch=14, initializer=Xavier(), lr=0.5,
+        lr_scheduler=lambda n: lrs_seen.append(n) or 0.5,
+        checkpoint_prefix=prefix,
+        epoch_end_callback=lambda e, s: resumed.append(e))
+    assert resumed == [12, 13], resumed
+    assert acc2 > 0.95
+    assert lrs_seen[0] == 12 * 3, lrs_seen[:3]   # 3 batches/epoch
+
+    # a third run with nothing left is a no-op, not a NaN metric
+    step3, train3 = make()
+    state3, acc3 = step3.fit(train3, num_epoch=14,
+                             initializer=Xavier(),
+                             checkpoint_prefix=prefix)
+    assert acc3 is None and state3 is not None
+
+    # stray non-epoch files next to the checkpoints don't break resume
+    open(prefix + "_final.npz", "wb").close()
+    step4, train4 = make()
+    state4, _ = step4.fit(train4, num_epoch=15, initializer=Xavier(),
+                          lr=0.5, checkpoint_prefix=prefix)
+    assert state4 is not None
