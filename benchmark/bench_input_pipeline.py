@@ -1,0 +1,112 @@
+"""Input-pipeline throughput: RecordIO -> decode -> augment -> batch.
+
+Host-side (no TPU needed): measures the framework's image path — the
+native C++ batched decoder (+ prefetch overlap) against the pure-PIL
+fallback — on a synthetic RecordIO file it writes itself. The reference
+framework's equivalent path is the fully-C++ ImageRecordIOParser2
+(src/io/iter_image_recordio_2.cc).
+
+    python benchmark/bench_input_pipeline.py [--n 512] [--size 256]
+
+Prints one JSON line per pipeline variant.
+"""
+import argparse
+import io as _io
+import json
+import os
+import shutil
+import sys
+import tempfile
+import time
+
+# host-side benchmark: never touch the TPU backend (batch wrapping
+# calls device_put, which would grab — or hang on — the accelerator).
+# The axon sitecustomize imports jax at interpreter startup with
+# JAX_PLATFORMS=axon already locked in, so the env var alone is too
+# late — override the config post-import (the conftest.py pattern).
+_platform = os.environ.get("BENCH_PLATFORM", "cpu")
+os.environ["JAX_PLATFORMS"] = _platform
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", _platform)
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+import numpy as np
+
+
+def make_recfile(path, n, size):
+    from PIL import Image
+
+    import mxnet_tpu as mx
+
+    rec = mx.recordio.MXIndexedRecordIO(path + ".idx", path + ".rec",
+                                        "w")
+    rng = np.random.RandomState(0)
+    for i in range(n):
+        img = Image.fromarray(
+            rng.randint(0, 255, (size, size, 3), np.uint8))
+        buf = _io.BytesIO()
+        img.save(buf, format="JPEG", quality=90)
+        header = mx.recordio.IRHeader(0, float(i % 10), i, 0)
+        rec.write_idx(i, mx.recordio.pack(header, buf.getvalue()))
+    rec.close()
+
+
+def run(path, n, batch_size, variant):
+    import mxnet_tpu as mx
+    from mxnet_tpu import image as mx_image
+
+    from mxnet_tpu import config
+
+    config.set_override("MXNET_NATIVE_IMAGE", variant != "pil")
+    it = mx_image.ImageIter(
+        batch_size, (3, 224, 224), path_imgrec=path + ".rec",
+        path_imgidx=path + ".idx", resize=256, rand_crop=True,
+        rand_mirror=True, num_threads=4)
+    if variant == "native+prefetch":
+        from mxnet_tpu import io
+        it = io.PrefetchingIter(it)
+
+    # warmup epoch (decoder pools spin up, buffers allocate)
+    for _ in it:
+        pass
+    it.reset()
+    t0 = time.time()
+    count = 0
+    for batch in it:
+        count += batch.data[0].shape[0]
+    dt = time.time() - t0
+    return count / dt
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--n", type=int, default=512)
+    ap.add_argument("--size", type=int, default=256,
+                    help="stored JPEG side length")
+    ap.add_argument("--batch-size", type=int, default=64)
+    args = ap.parse_args()
+
+    d = tempfile.mkdtemp()
+    try:
+        path = os.path.join(d, "bench")
+        make_recfile(path, args.n, args.size)
+
+        results = {}
+        for variant in ("pil", "native", "native+prefetch"):
+            rate = run(path, args.n, args.batch_size, variant)
+            results[variant] = rate
+            print(json.dumps({
+                "metric": "input_pipeline_throughput",
+                "variant": variant,
+                "value": round(rate, 1),
+                "unit": "img/s",
+                "vs_pil": round(rate / results["pil"], 2)}))
+    finally:
+        shutil.rmtree(d, ignore_errors=True)
+
+
+if __name__ == "__main__":
+    main()
