@@ -640,6 +640,8 @@ EXEMPT = {
     "_contrib_quantize", "_contrib_dequantize",
     # attention — tests/test_attention.py (vs reference + grads)
     "_contrib_FlashAttention",
+    # MoE — tests/test_pipeline_moe.py (dense-vs-expert-parallel + gates)
+    "_contrib_MoEFFN",
 }
 
 
