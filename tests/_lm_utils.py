@@ -1,0 +1,29 @@
+"""Shared LM-test helpers: the arithmetic-stride toy corpus and the
+NLL readout used by the transformer convergence gates. One copy, so
+the loss/ignore-label conventions can't drift between gates."""
+import numpy as np
+
+
+def arith_corpus(B, T, vocab, seed=5):
+    """(tokens, labels): each row counts by a random stride mod vocab —
+    fully predictable from context, so tiny LMs drive NLL toward 0.
+    labels are next-token with -1 (ignore) at the last position."""
+    rng = np.random.RandomState(seed)
+    starts = rng.randint(0, vocab, B)
+    strides = rng.randint(1, 4, B)
+    toks = ((starts[:, None] + strides[:, None] * np.arange(T)[None, :])
+            % vocab).astype(np.float32)
+    labels = np.roll(toks, -1, axis=1).astype(np.float32)
+    labels[:, -1] = -1
+    return toks, labels
+
+
+def lm_nll(outs, labels, vocab):
+    """Mean next-token NLL from the softmax output (B*T, V), ignoring
+    -1-labelled positions."""
+    B, T = labels.shape
+    pr = np.asarray(outs[0]).astype(np.float32).reshape(B, T, vocab)
+    tgt = labels.astype(int)
+    bi, ti = np.nonzero(tgt >= 0)
+    return float(-np.log(np.maximum(pr[bi, ti, tgt[bi, ti]],
+                                    1e-9)).mean())

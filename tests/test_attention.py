@@ -253,30 +253,18 @@ def test_full_composition_dp_sp_zero1_bf16():
     assert step._id_inputs == {"data"}   # ids survive the bf16 cast
     state = step.init_state(Xavier(), {"data": (B, T),
                                        "softmax_label": (B, T)})
-    rng_np = np.random.RandomState(5)
-    starts = rng_np.randint(0, vocab, B)
-    strides = rng_np.randint(1, 4, B)
-    toks = ((starts[:, None] + strides[:, None] * np.arange(T)[None, :])
-            % vocab).astype(np.float32)
-    labels = np.roll(toks, -1, 1)
-    labels[:, -1] = -1
+    from tests._lm_utils import arith_corpus, lm_nll
+    toks, labels = arith_corpus(B, T, vocab)
     batch = step.place_batch({"data": toks, "softmax_label": labels})
     rng = jax.random.PRNGKey(0)
     hlo = step.lower(state, batch, 1e-3, rng).compile().as_text()
     assert "collective-permute" in hlo          # the ring is real
 
-    def nll(outs):
-        pr = np.asarray(outs[0]).astype(np.float32).reshape(B, T, vocab)
-        tgt = labels.astype(int)
-        bi, ti = np.nonzero(tgt >= 0)
-        return float(-np.log(
-            np.maximum(pr[bi, ti, tgt[bi, ti]], 1e-9)).mean())
-
     state, outs = step(state, batch, 2e-3, rng)
-    first = nll(outs)
+    first = lm_nll(outs, labels, vocab)
     for _ in range(60):
         state, outs = step(state, batch, 2e-3, rng)
-    assert nll(outs) < first / 2
+    assert lm_nll(outs, labels, vocab) < first / 2
     # optimizer state stayed ZeRO-1 sharded through the run
     m = state[1]["layer0_qkv_weight"][0]
     assert "data" in str(m.sharding.spec), m.sharding

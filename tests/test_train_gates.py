@@ -121,14 +121,10 @@ def test_transformer_lm_loss_gate():
     pattern tests/python/train gates)."""
     from mxnet_tpu.models import transformer
 
+    from tests._lm_utils import arith_corpus, lm_nll
+
     vocab, T, B = 32, 16, 16
-    rng_np = np.random.RandomState(5)
-    starts = rng_np.randint(0, vocab, B)
-    steps_ = rng_np.randint(1, 4, B)
-    toks = ((starts[:, None] + steps_[:, None] * np.arange(T)[None, :])
-            % vocab).astype(np.float32)
-    labels = np.roll(toks, -1, axis=1).astype(np.float32)
-    labels[:, -1] = -1
+    toks, labels = arith_corpus(B, T, vocab)
 
     sym = transformer.get_symbol(vocab, T, num_layers=1, num_heads=2,
                                  dim=32)
@@ -140,18 +136,11 @@ def test_transformer_lm_loss_gate():
     rng = jax.random.PRNGKey(0)
     batch = step.place_batch({"data": toks, "softmax_label": labels})
 
-    def nll(outs):
-        pr = np.asarray(outs[0]).reshape(B, T, vocab)
-        tgt = labels.astype(int)
-        bi, ti = np.nonzero(tgt >= 0)
-        return float(-np.log(
-            np.maximum(pr[bi, ti, tgt[bi, ti]], 1e-9)).mean())
-
     state, outs = step(state, batch, 3e-3, rng)
-    first = nll(outs)
+    first = lm_nll(outs, labels, vocab)
     for _ in range(30):
         state, outs = step(state, batch, 3e-3, rng)
-    final = nll(outs)
+    final = lm_nll(outs, labels, vocab)
     assert final < first / 2, (first, final)
 
 

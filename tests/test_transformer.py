@@ -34,19 +34,12 @@ def test_trainstep_convergence():
     bv = step.place_batch({"data": toks, "softmax_label": labels})
     rng = jax.random.PRNGKey(0)
 
-    def nll(probs):
-        p = np.asarray(probs).reshape(B, T, vocab)
-        tgt = labels.astype(int)
-        mask = tgt >= 0
-        bi, ti = np.nonzero(mask)
-        return -np.log(np.maximum(
-            p[bi, ti, tgt[bi, ti]], 1e-9)).mean()
-
+    from tests._lm_utils import lm_nll
     state, outs = step(state, bv, 3e-3, rng)
-    first = nll(jax.device_get(outs[0]))
+    first = lm_nll(outs, labels, vocab)
     for _ in range(60):
         state, outs = step(state, bv, 3e-3, rng)
-    last = nll(jax.device_get(outs[0]))
+    last = lm_nll(outs, labels, vocab)
     assert last < first * 0.2, (first, last)
 
 
