@@ -16,6 +16,7 @@ FAST_EXAMPLES = [
     "adversary_fgsm.py",
     "profile_model.py",
     "gan_toy.py",
+    "fit_spmd_elastic.py",
 ]
 
 
