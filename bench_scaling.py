@@ -38,6 +38,10 @@ def _parse_args():
     p.add_argument("--seq-parallel", action="store_true",
                    help="transformer_lm over an 'sp' mesh (ring "
                         "attention) instead of a data mesh")
+    p.add_argument("--expert-parallel", action="store_true",
+                   help="transformer_lm MoE over an 'expert' mesh "
+                        "(all_to_all token exchange); experts = 2x "
+                        "devices")
     p.add_argument("--per-device-batch", type=int, default=8)
     p.add_argument("--iters", type=int, default=10)
     p.add_argument("--zero1", action="store_true",
@@ -94,7 +98,7 @@ def collective_bytes(hlo_text):
 
 
 def build_step(network, mesh, global_batch, zero1, seq_parallel=False,
-               seq_len=64):
+               seq_len=64, num_experts=0):
     from mxnet_tpu import models
     from mxnet_tpu.initializer import Xavier
     from mxnet_tpu.parallel import make_train_step
@@ -113,7 +117,9 @@ def build_step(network, mesh, global_batch, zero1, seq_parallel=False,
         sym = models.get_symbol(
             network="transformer", vocab_size=256, seq_len=seq_len,
             num_layers=2, num_heads=4, dim=64,
-            seq_axis="sp" if seq_parallel else None)
+            seq_axis="sp" if seq_parallel else None,
+            num_experts=num_experts,
+            expert_axis="expert" if num_experts else None)
         shapes = {"data": (global_batch, seq_len),
                   "softmax_label": (global_batch, seq_len)}
     step = make_train_step(sym, **kw)
@@ -146,22 +152,34 @@ def main():
         raise SystemExit("only %d devices visible, need %d"
                          % (len(devices), max(counts)))
 
-    if args.seq_parallel and args.network != "transformer_lm":
-        raise SystemExit("--seq-parallel needs --network transformer_lm")
+    if (args.seq_parallel or args.expert_parallel) and \
+            args.network != "transformer_lm":
+        raise SystemExit("--seq-parallel/--expert-parallel need "
+                         "--network transformer_lm")
+    if args.seq_parallel and args.expert_parallel:
+        raise SystemExit("pick one of --seq-parallel/--expert-parallel "
+                         "(composition lives in the test suite)")
 
     rows = []
     for n in counts:
+        num_experts = 0
         if args.seq_parallel:
             # weak scaling in SEQUENCE length: 64 tokens per device on
             # an sp mesh, batch fixed — the long-context axis
             mesh = make_mesh({"sp": n}, devices=devices[:n])
             gb, seq_len = args.per_device_batch, 64 * n
+        elif args.expert_parallel:
+            # weak scaling in EXPERTS: 2 experts per device, tokens
+            # fixed per device — the MoE capacity axis
+            mesh = make_mesh({"expert": n}, devices=devices[:n])
+            gb, seq_len = args.per_device_batch * n, 64
+            num_experts = 2 * n
         else:
             mesh = make_mesh({"data": n}, devices=devices[:n])
             gb, seq_len = args.per_device_batch * n, 64
         step, state, shapes = build_step(args.network, mesh, gb,
                                          args.zero1, args.seq_parallel,
-                                         seq_len)
+                                         seq_len, num_experts)
         rng_np = np.random.RandomState(0)
         if args.network == "resnet":
             batch = {"data": rng_np.standard_normal(

@@ -93,7 +93,16 @@ def param_sharding(mesh, name, shape):
     (O,I,H,W); shard O. Anything not divisible stays replicated. This is
     the round-1 heuristic surface; per-layer annotations (ctx_group
     analogue) override via Symbol attrs `__shard__`.
+
+    On a mesh with an 'expert' axis, per-expert stacked weights
+    (leading dim = num_experts, names carrying 'expert') live sharded
+    over it — each device holds only its resident experts' parameters
+    AND optimizer state, matching moe_ffn's all_to_all layout.
     """
+    if "expert" in mesh.axis_names and "expert" in name and \
+            len(shape) >= 1 and shape[0] % mesh.shape["expert"] == 0:
+        return NamedSharding(
+            mesh, P(*(["expert"] + [None] * (len(shape) - 1))))
     if "model" not in mesh.axis_names:
         return NamedSharding(mesh, P())
     msize = mesh.shape["model"]

@@ -130,6 +130,12 @@ def test_moe_transformer_expert_axis_trains():
     rng = jax.random.PRNGKey(0)
     hlo = step.lower(state, batch, 1e-3, rng).compile().as_text()
     assert "all-to-all" in hlo
+    # expert weights (and their adam state) live sharded over the
+    # expert axis — 1/n parameters per device, not replicated
+    w1 = state[0]["layer0_experts_w1_weight"]
+    assert "expert" in str(w1.sharding.spec), w1.sharding
+    m1 = state[1]["layer0_experts_w1_weight"][0]
+    assert "expert" in str(m1.sharding.spec), m1.sharding
     state, outs = step(state, batch, 1e-3, rng)
     probs = np.asarray(outs[0])
     assert np.isfinite(probs).all()
