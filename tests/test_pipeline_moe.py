@@ -196,3 +196,41 @@ def test_pipeline_from_symbol_validation():
     with pytest.raises(ValueError, match="auxiliary"):
         pipeline_from_symbol(bn, {}, np.zeros((2, 2, 8),
                                               np.float32), mesh)
+
+
+@pytest.mark.skipif(jax.device_count() < 8, reason="needs 8-device mesh")
+def test_moe_data_expert_zero1_composition():
+    """2-D data x expert mesh with ZeRO-1: expert weights shard over
+    'expert', and their optimizer state additionally shards over
+    'data' (P('expert','data',None)) — the layered MoE memory recipe.
+    Training trajectory unchanged."""
+    from mxnet_tpu.initializer import Xavier
+    from mxnet_tpu.models import transformer
+    from mxnet_tpu.parallel import make_mesh, make_train_step
+
+    from tests._lm_utils import arith_corpus, lm_nll
+
+    mesh = make_mesh({"data": 2, "expert": 4})
+    vocab, T, B = 32, 16, 16
+    sym = transformer.get_symbol(vocab, T, num_layers=1, num_heads=2,
+                                 dim=32, num_experts=8,
+                                 expert_axis="expert")
+    step = make_train_step(sym, optimizer="adam", mesh=mesh,
+                           optimizer_sharding="zero1")
+    state = step.init_state(Xavier(), {"data": (B, T),
+                                       "softmax_label": (B, T)})
+    w1 = state[0]["layer0_experts_w1_weight"]
+    assert str(w1.sharding.spec) == \
+        "PartitionSpec('expert', None, None)", w1.sharding
+    m1 = state[1]["layer0_experts_w1_weight"][0]
+    assert str(m1.sharding.spec) == \
+        "PartitionSpec('expert', 'data', None)", m1.sharding
+
+    toks, labels = arith_corpus(B, T, vocab)
+    batch = step.place_batch({"data": toks, "softmax_label": labels})
+    rng = jax.random.PRNGKey(0)
+    state, outs = step(state, batch, 3e-3, rng)
+    first = lm_nll(outs, labels, vocab)
+    for _ in range(60):
+        state, outs = step(state, batch, 3e-3, rng)
+    assert lm_nll(outs, labels, vocab) < first / 2
