@@ -178,7 +178,8 @@ def bench_image(name, args):
             sym, optimizer="sgd",
             optimizer_params={"momentum": 0.9, "wd": 1e-4,
                               "rescale_grad": 1.0 / batch},
-            compute_dtype=None if dtype == "float32" else dtype)
+            compute_dtype=None if dtype == "float32" else dtype,
+            remat=args.remat or None)
         x = np.random.RandomState(0).standard_normal(
             (batch, 3, image, image)).astype(np.float32)
         y = np.random.RandomState(1).randint(0, 1000, (batch,)).astype(
@@ -242,7 +243,8 @@ def bench_transformer(args):
         step = make_train_step(
             sym, optimizer="adam",
             optimizer_params={"rescale_grad": 1.0 / B},
-            compute_dtype=None if dtype == "float32" else dtype)
+            compute_dtype=None if dtype == "float32" else dtype,
+            remat=args.remat or None)
         rng_np = np.random.RandomState(0)
         toks = rng_np.randint(0, V, (B, T)).astype(np.float32)
         labels = np.roll(toks, -1, axis=1)
@@ -294,6 +296,10 @@ def main():
     p.add_argument("--iters", type=int, default=None)
     p.add_argument("--dtype", default=None,
                    choices=["float32", "bfloat16"])
+    p.add_argument("--remat", action="store_true",
+                   help="rematerialize the forward (activation memory "
+                        "/ recompute trade — for configs that don't "
+                        "fit HBM otherwise)")
     args = p.parse_args()
     if args.network == "transformer_lm":
         bench_transformer(args)
