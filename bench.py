@@ -140,17 +140,23 @@ def _timed_loop(jax, step, state, batch_dev, iters, metric, lr=0.1):
 
 
 def _mfu(step, state, batch_vals, dev, sec_per_step, fallback_flops,
-         jax):
+         jax, model_flops_only=False):
     """Actual FLOPs of the compiled step (XLA cost analysis; the analytic
-    fallback covers kernels the analysis can't see) over the chip peak."""
+    fallback covers kernels the analysis can't see) over the chip peak.
+
+    model_flops_only (remat runs): cost analysis would count the
+    recomputed forward too — that's HFU, not MFU — so use the analytic
+    MODEL flops alone and a slower remat run can never report a higher
+    MFU."""
     step_flops = None
-    try:
-        cost = step.cost_analysis(state, batch_vals, 0.1,
-                                  jax.random.PRNGKey(0))
-        if cost and cost.get("flops"):
-            step_flops = float(cost["flops"])
-    except Exception:  # noqa: BLE001
-        pass
+    if not model_flops_only:
+        try:
+            cost = step.cost_analysis(state, batch_vals, 0.1,
+                                      jax.random.PRNGKey(0))
+            if cost and cost.get("flops"):
+                step_flops = float(cost["flops"])
+        except Exception:  # noqa: BLE001
+            pass
     step_flops = max(step_flops or 0.0, fallback_flops)
     peak = _PEAK_FLOPS.get(getattr(dev, "device_kind", ""), None)
     mfu = (step_flops / sec_per_step) / peak if peak else None
@@ -203,7 +209,7 @@ def bench_image(name, args):
     # fwd GMACs x2 flops/MAC x3 (fwd + ~2x bwd)
     fallback = 3 * 2 * gmacs * 1e9 * batch
     mfu, _flops = _mfu(step, state, batch_vals, dev, dt / iters,
-                       fallback, jax)
+                       fallback, jax, model_flops_only=args.remat)
     print(json.dumps({
         "metric": metric,
         "value": round(img_s, 2),
@@ -212,6 +218,7 @@ def bench_image(name, args):
         "step_time_ms": round(dt / iters * 1e3, 2),
         "batch": batch,
         "compute_dtype": dtype,
+        "remat": bool(args.remat),
         "device_kind": getattr(dev, "device_kind", "unknown"),
         "mfu": round(mfu, 4) if mfu is not None else None}))
 
@@ -272,7 +279,7 @@ def bench_transformer(args):
     # internal flops are invisible to XLA's analysis).
     fwd = B * T * (L * (8 * D * D + 4 * D * F + 4 * T * D) + 2 * D * V)
     mfu, flops = _mfu(step, state, batch_vals, dev, dt / iters, 3 * fwd,
-                      jax)
+                      jax, model_flops_only=args.remat)
     print(json.dumps({
         "metric": metric,
         "value": round(tok_s, 2),
@@ -281,6 +288,7 @@ def bench_transformer(args):
         "step_time_ms": round(dt / iters * 1e3, 2),
         "batch": B, "seq_len": T, "dim": D, "layers": L,
         "compute_dtype": dtype,
+        "remat": bool(args.remat),
         "device_kind": getattr(dev, "device_kind", "unknown"),
         "step_tflops": round(flops / 1e12, 2),
         "mfu": round(mfu, 4) if mfu is not None else None}))
