@@ -1,0 +1,44 @@
+"""Category mapping of the xplane device-time summarizer — the exact
+rules the MFU evidence pack depends on (docs/mfu_analysis.md)."""
+import os
+import sys
+
+import pytest
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+from tools.xplane_summary import _category, summarize  # noqa: E402
+
+
+@pytest.mark.parametrize("op,cat", [
+    ("convolution.5", "convolution"),
+    ("conv_general_fusion", "convolution"),
+    ("convert.12", "copies / layout"),          # NOT convolution
+    ("all-reduce.82", "collectives"),           # NOT bn-stats
+    ("reduce-scatter", "collectives"),
+    ("all-to-all.1", "collectives"),
+    ("reduce-window.3", "pooling"),             # NOT bn-stats
+    ("reduce.11", "bn-stats / reductions"),
+    ("variance", "bn-stats / reductions"),
+    ("dot.4", "matmul"),
+    ("custom-call.2", "custom / pallas"),
+    ("transpose.9", "copies / layout"),
+    ("while.1", "other"),
+])
+def test_category_rules(op, cat):
+    assert _category(op) == cat
+
+
+def test_summarize_guards_proto_backend(tmp_path, monkeypatch):
+    """With a non-python protobuf backend active, summarize refuses
+    instead of silently mis-parsing (the guard checks the backend
+    protobuf actually picked, not the env var)."""
+    from google.protobuf.internal import api_implementation
+    if api_implementation.Type() == "python":
+        pytest.skip("pure-python protobuf backend active; guard "
+                    "correctly lets this through")
+    # env var set but too late — backend already locked: must refuse
+    monkeypatch.setenv("PROTOCOL_BUFFERS_PYTHON_IMPLEMENTATION",
+                       "python")
+    with pytest.raises(RuntimeError, match="backend"):
+        summarize(str(tmp_path))

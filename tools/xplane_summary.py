@@ -28,7 +28,11 @@ import os
 import re
 import sys
 
-if os.environ.get("PROTOCOL_BUFFERS_PYTHON_IMPLEMENTATION") != "python":
+# the proto parse needs the pure-python protobuf backend; re-exec is
+# only safe when WE are the program (an importer would restart itself)
+if __name__ == "__main__" and \
+        os.environ.get("PROTOCOL_BUFFERS_PYTHON_IMPLEMENTATION") != \
+        "python":
     os.environ["PROTOCOL_BUFFERS_PYTHON_IMPLEMENTATION"] = "python"
     os.execv(sys.executable, [sys.executable] + sys.argv)
 
@@ -62,6 +66,17 @@ def _category(name):
 
 
 def summarize(trace_dir):
+    # check the backend protobuf ACTUALLY picked (the env var only
+    # matters before the first protobuf import — a caller who imported
+    # tensorflow first is already locked to the C++/upb backend)
+    from google.protobuf.internal import api_implementation
+    if api_implementation.Type() != "python":
+        raise RuntimeError(
+            "protobuf is running the %r backend, which mis-parses "
+            "these planes; set PROTOCOL_BUFFERS_PYTHON_IMPLEMENTATION="
+            "python before the FIRST protobuf/tensorflow import "
+            "(running this file as a script does it automatically)"
+            % api_implementation.Type())
     from tensorflow.tsl.profiler.protobuf import xplane_pb2
 
     paths = glob.glob(os.path.join(
