@@ -122,20 +122,24 @@ def _probe_backend(metric):
 
 def _timed_loop(jax, step, state, batch_dev, iters, metric, lr=0.1):
     """Warmup (2 steps + hard sync) then the timed loop. Sync via host
-    readback of a scalar — through the axon tunnel, block_until_ready
-    alone does not guarantee device completion."""
+    readback of a SCALAR derived from the last step's output — through
+    the axon tunnel, block_until_ready alone does not guarantee device
+    completion, and reading the full output tensor would measure tunnel
+    transfer bandwidth, not the step (the transformer head's softmax
+    output is ~2 GB; pulling it once cost more than 30 training steps)."""
     rng = jax.random.PRNGKey(0)
+    scalar = jax.jit(lambda x: x.ravel()[0])
     try:
         for _ in range(2):
             state, outs = step(state, batch_dev, lr, rng)
-        np.asarray(jax.device_get(outs[0]))
+        np.asarray(jax.device_get(scalar(outs[0])))
     except Exception as e:  # noqa: BLE001
         _fail(metric, "compile_warmup", e)
 
     t0 = time.time()
     for _ in range(iters):
         state, outs = step(state, batch_dev, lr, rng)
-    np.asarray(jax.device_get(outs[0]))   # true completion barrier
+    np.asarray(jax.device_get(scalar(outs[0])))  # completion barrier
     return time.time() - t0
 
 

@@ -10,10 +10,13 @@ running max/denominator/accumulator live in VMEM scratch; each
 (block_q, d) @ (d, block_k) product lands on the MXU with float32
 accumulation. O(T) memory instead of the naive (T, T) score matrix.
 
-Backward recomputes probabilities blockwise in jnp under remat-friendly
-form (one (block, T) strip at a time via the saved row statistics) —
-XLA fuses it; the forward kernel is where flash wins (no score
-materialization) and stays Pallas.
+Backward is the FlashAttention-2 split: the forward additionally emits
+the per-row logsumexp; the backward runs two Pallas kernels — a dq pass
+(grid over q blocks, k innermost) and a dk/dv pass (grid over k blocks,
+q innermost) — plus a cheap jnp delta = rowsum(do * o) precompute.
+Nothing ever materializes a (T, T) score tensor, so the backward stays
+HBM-light at long context (the dense-recompute alternative cost ~60 ms
+/step on the v5e transformer bench from (BH, T, T) f32 traffic alone).
 
 Off-TPU (CPU tests, axon-less runs) the same kernel executes in
 interpreter mode, so numerics are identical everywhere.
@@ -32,8 +35,15 @@ from .registry import register
 _NEG_INF = -1e30
 
 
-def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref,
-                      *, scale, causal, block_q, block_k, num_kb, seq_k):
+def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, *rest,
+                      scale, causal, block_q, block_k, num_kb, seq_k,
+                      want_lse):
+    # the lse output only exists under differentiation (want_lse);
+    # forward-only calls skip its ~BH*T*128 f32 HBM writes entirely
+    if want_lse:
+        lse_ref, m_ref, l_ref, acc_ref = rest
+    else:
+        m_ref, l_ref, acc_ref = rest
     kb = pl.program_id(2)
     qb = pl.program_id(1)
 
@@ -46,8 +56,16 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref,
     def _block():
         q = q_ref[0]                          # (bq, d)
         k = k_ref[0]                          # (bk, d)
+        # precision is pinned to DEFAULT: native-dtype MXU passes with f32
+        # accumulation (preferred_element_type) — the flash numerics
+        # contract. Inheriting the ambient jax_default_matmul_precision
+        # (MXNET_MATMUL_PRECISION=highest sets float32 globally) would ask
+        # Mosaic for an fp32-contract bf16 matmul, which it rejects
+        # ("Bad lhs type") — the global knob is an XLA-lowering policy for
+        # f32 arrays, not a Pallas one.
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
+            precision=jax.lax.Precision.DEFAULT,
             preferred_element_type=jnp.float32) * scale   # (bq, bk)
         cols = kb * block_k + jax.lax.broadcasted_iota(
             jnp.int32, s.shape, 1)
@@ -64,11 +82,10 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref,
         l_ref[:] = l_ref[:] * alpha + p.sum(axis=1, keepdims=True)
         # padded tail rows of V must be zeroed, not just down-weighted:
         # 0 * garbage (NaN-filled pad in interpret mode) would poison acc
-        v_rows = kb * block_k + jax.lax.broadcasted_iota(
-            jnp.int32, (block_k, 1), 0)
-        v_blk = jnp.where(v_rows < seq_k, v_ref[0], 0)
+        v_blk = _masked_block(v_ref, kb * block_k, seq_k, block_k)
         acc_ref[:] = acc_ref[:] * alpha + jax.lax.dot_general(
             p.astype(v_blk.dtype), v_blk, (((1,), (0,)), ((), ())),
+            precision=jax.lax.Precision.DEFAULT,
             preferred_element_type=jnp.float32)
         m_ref[:] = m_new
 
@@ -82,32 +99,57 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref,
     def _finish():
         denom = jnp.maximum(l_ref[:], 1e-30)
         o_ref[0] = (acc_ref[:] / denom).astype(o_ref.dtype)
+        # logsumexp per row — the backward's softmax recompute key,
+        # replicated across the 128-lane minor dim (Mosaic's block rules
+        # want the last dim %128; a (BH, T) layout would put a size-1
+        # sublane dim in the block — same trick as jax's own TPU flash
+        # kernel's l/m residuals). Fully-masked (padded) rows have
+        # l == 0; the max() keeps their lse finite so the backward's
+        # exp() stays NaN-free (their contributions are masked there).
+        if want_lse:
+            lse_ref[0] = jnp.broadcast_to(m_ref[:] + jnp.log(denom),
+                                          lse_ref.shape[1:])
 
 
-def _flash_forward(q, k, v, scale, causal, block_q, block_k, interpret):
-    BH, T, D = q.shape
-    Tk = k.shape[1]
+_LANES = 128   # minor-dim replication for per-row stats
+
+
+def _snap_blocks(T, Tk, block_q, block_k, interpret):
+    """Clamp blocks to the sequence and, on the compiled TPU path, snap
+    them to Mosaic's sublane rule (second-to-last block dim divisible
+    by 8, or equal to the array dim). Interpret mode keeps arbitrary
+    requests, giving tests coverage of odd blockings."""
     block_q = min(block_q, T)
     block_k = min(block_k, Tk)
+    if not interpret:
+        if block_q < T and block_q % 8:
+            block_q = min(T, max(8, (block_q // 8) * 8))
+        if block_k < Tk and block_k % 8:
+            block_k = min(Tk, max(8, (block_k // 8) * 8))
+    return block_q, block_k
+
+
+def _flash_forward(q, k, v, scale, causal, block_q, block_k, interpret,
+                   want_lse):
+    q, k, v = _uniform_vma(q, k, v)
+    BH, T, D = q.shape
+    Tk = k.shape[1]
+    block_q, block_k = _snap_blocks(T, Tk, block_q, block_k, interpret)
     nq = -(-T // block_q)
     nk = -(-Tk // block_k)
 
     kernel = functools.partial(
         _flash_fwd_kernel, scale=scale, causal=causal, block_q=block_q,
-        block_k=block_k, num_kb=nk, seq_k=Tk)
-    # under a vma-checking shard_map (e.g. a pipeline stage) the output
-    # aval must declare how it varies over mesh axes — the union of the
-    # inputs' variance (q may be replicated while k/v rotate, or vice
-    # versa). jax<0.9 has neither typeof nor vma; skip there.
-    typeof = getattr(jax, "typeof", None)
-    out_vma = None
-    if typeof is not None:
-        vmas = [getattr(typeof(x), "vma", None) for x in (q, k, v)]
-        vmas = [v_ for v_ in vmas if v_]
-        out_vma = frozenset().union(*vmas) if vmas else None
-    out_shape = jax.ShapeDtypeStruct(q.shape, q.dtype, vma=out_vma) \
-        if out_vma else jax.ShapeDtypeStruct(q.shape, q.dtype)
-    return pl.pallas_call(
+        block_k=block_k, num_kb=nk, seq_k=Tk, want_lse=want_lse)
+    shapes = [jax.ShapeDtypeStruct(q.shape, q.dtype)]              # o
+    out_specs = [pl.BlockSpec((1, block_q, D),
+                              lambda b, i, j: (b, i, 0))]
+    if want_lse:
+        shapes.append(
+            jax.ShapeDtypeStruct((BH, T, _LANES), jnp.float32))
+        out_specs.append(pl.BlockSpec((1, block_q, _LANES),
+                                      lambda b, i, j: (b, i, 0)))
+    outs = pl.pallas_call(
         kernel,
         grid=(BH, nq, nk),
         in_specs=[
@@ -115,8 +157,8 @@ def _flash_forward(q, k, v, scale, causal, block_q, block_k, interpret):
             pl.BlockSpec((1, block_k, D), lambda b, i, j: (b, j, 0)),
             pl.BlockSpec((1, block_k, D), lambda b, i, j: (b, j, 0)),
         ],
-        out_specs=pl.BlockSpec((1, block_q, D), lambda b, i, j: (b, i, 0)),
-        out_shape=out_shape,
+        out_specs=out_specs,
+        out_shape=_with_vma(shapes, (q, k, v)),
         scratch_shapes=[
             pltpu.VMEM((block_q, 1), jnp.float32),
             pltpu.VMEM((block_q, 1), jnp.float32),
@@ -124,6 +166,51 @@ def _flash_forward(q, k, v, scale, causal, block_q, block_k, interpret):
         ],
         interpret=interpret,
     )(q, k, v)
+    return outs if want_lse else (outs[0], None)
+
+
+def _with_vma(shapes, operands):
+    """Attach varying-over-mesh-axes info to output avals.
+
+    Under a vma-checking shard_map (e.g. a pipeline stage) the output
+    aval must declare how it varies over mesh axes — the union of the
+    inputs' variance (q may be replicated while k/v rotate, or vice
+    versa). jax<0.9 has neither typeof nor vma; skip there."""
+    typeof = getattr(jax, "typeof", None)
+    if typeof is None:
+        return shapes
+    vmas = [getattr(typeof(x), "vma", None) for x in operands]
+    vmas = [v_ for v_ in vmas if v_ is not None]
+    if not vmas:
+        return shapes
+    # an empty union is still attached: under a vma-checking shard_map
+    # with fully-replicated operands the out aval must SAY replicated —
+    # omitting vma entirely is only correct outside shard_map (where
+    # typeof reports no vma at all)
+    vma = frozenset().union(*vmas)
+    return [jax.ShapeDtypeStruct(s.shape, s.dtype, vma=vma)
+            for s in shapes]
+
+
+def _uniform_vma(*operands):
+    """Broadcast every operand to the union of their mesh variances.
+
+    A pallas_call cannot mix replicated and axis-varying inputs (its
+    internal loads trip shard_map's vma check); pvary-ing the
+    replicated ones up to the union is a free device-local broadcast,
+    and _narrow_vma psums the corresponding cotangents back down."""
+    typeof = getattr(jax, "typeof", None)
+    if typeof is None:
+        return operands
+    vmas = [getattr(typeof(x), "vma", None) or frozenset()
+            for x in operands]
+    union = frozenset().union(*vmas)
+    if not union:
+        return operands
+    from ..parallel._compat import pvary
+    return tuple(
+        pvary(x, tuple(sorted(union - v))) if union - v else x
+        for x, v in zip(operands, vmas))
 
 
 def _attn_reference(q, k, v, scale, causal):
@@ -138,33 +225,254 @@ def _attn_reference(q, k, v, scale, causal):
     return jnp.einsum("bqk,bkd->bqd", p.astype(v.dtype), v)
 
 
+def _masked_block(ref, rows_base, limit, block_rows):
+    """Load a (1, block, D) ref, zeroing rows past ``limit`` (the padded
+    ragged tail is garbage in interpret mode; 0 * NaN would poison the
+    MXU accumulators)."""
+    rows = rows_base + jax.lax.broadcasted_iota(
+        jnp.int32, (block_rows, 1), 0)
+    return jnp.where(rows < limit, ref[0], 0)
+
+
+def _flash_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                     dq_ref, dq_acc, *, scale, causal, block_q, block_k,
+                     num_kb, seq_q, seq_k):
+    qb, kb = pl.program_id(1), pl.program_id(2)
+
+    @pl.when(kb == 0)
+    def _init():
+        dq_acc[:] = jnp.zeros_like(dq_acc)
+
+    def _block():
+        q = q_ref[0]
+        k = _masked_block(k_ref, kb * block_k, seq_k, block_k)
+        v = _masked_block(v_ref, kb * block_k, seq_k, block_k)
+        do = do_ref[0]
+        lse = lse_ref[0][:, :1]              # (bq, 1)
+        delta = delta_ref[0][:, :1]          # (bq, 1)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            precision=jax.lax.Precision.DEFAULT,
+            preferred_element_type=jnp.float32) * scale
+        cols = kb * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, s.shape, 1)
+        valid = cols < seq_k
+        if causal:
+            rows = qb * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, s.shape, 0)
+            valid = valid & (rows >= cols)
+        p = jnp.where(valid, jnp.exp(s - lse), 0)       # (bq, bk)
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())),
+            precision=jax.lax.Precision.DEFAULT,
+            preferred_element_type=jnp.float32)         # (bq, bk)
+        # the where() must wrap the whole product: p is already 0 at
+        # masked slots, but 0 * (dp - NaN-padded delta) would be NaN
+        ds = jnp.where(valid, p * (dp - delta) * scale,
+                       0).astype(k.dtype)
+        dq_acc[:] += jax.lax.dot_general(
+            ds, k, (((1,), (0,)), ((), ())),
+            precision=jax.lax.Precision.DEFAULT,
+            preferred_element_type=jnp.float32)
+
+    if causal:
+        pl.when(qb * block_q + block_q - 1 >= kb * block_k)(_block)
+    else:
+        _block()
+
+    @pl.when(kb == num_kb - 1)
+    def _finish():
+        dq_ref[0] = dq_acc[:].astype(dq_ref.dtype)
+
+
+def _flash_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                      dk_ref, dv_ref, dk_acc, dv_acc,
+                      *, scale, causal, block_q, block_k, num_qb,
+                      seq_q, seq_k):
+    kb, qb = pl.program_id(1), pl.program_id(2)
+
+    @pl.when(qb == 0)
+    def _init():
+        dk_acc[:] = jnp.zeros_like(dk_acc)
+        dv_acc[:] = jnp.zeros_like(dv_acc)
+
+    def _block():
+        q = _masked_block(q_ref, qb * block_q, seq_q, block_q)
+        do = _masked_block(do_ref, qb * block_q, seq_q, block_q)
+        k = k_ref[0]
+        v = v_ref[0]
+        lse = lse_ref[0][:, :1]
+        delta = delta_ref[0][:, :1]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            precision=jax.lax.Precision.DEFAULT,
+            preferred_element_type=jnp.float32) * scale  # (bq, bk)
+        rows = qb * block_q + jax.lax.broadcasted_iota(
+            jnp.int32, s.shape, 0)
+        valid = rows < seq_q
+        if causal:
+            cols = kb * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, s.shape, 1)
+            valid = valid & (rows >= cols)
+        p = jnp.where(valid, jnp.exp(s - lse), 0)
+        dv_acc[:] += jax.lax.dot_general(
+            p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
+            precision=jax.lax.Precision.DEFAULT,
+            preferred_element_type=jnp.float32)          # (bk, d)
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())),
+            precision=jax.lax.Precision.DEFAULT,
+            preferred_element_type=jnp.float32)          # (bq, bk)
+        # see dq kernel: NaN-padded delta rows must not reach the MXU
+        ds = jnp.where(valid, p * (dp - delta) * scale,
+                       0).astype(q.dtype)
+        dk_acc[:] += jax.lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())),
+            precision=jax.lax.Precision.DEFAULT,
+            preferred_element_type=jnp.float32)          # (bk, d)
+
+    if causal:
+        # k block entirely above every q row in this block: contributes 0
+        pl.when(qb * block_q + block_q - 1 >= kb * block_k)(_block)
+    else:
+        _block()
+
+    @pl.when(qb == num_qb - 1)
+    def _finish():
+        dk_ref[0] = dk_acc[:].astype(dk_ref.dtype)
+        dv_ref[0] = dv_acc[:].astype(dv_ref.dtype)
+
+
+def _flash_backward(q, k, v, o, lse, do, scale, causal, block_q,
+                    block_k, interpret):
+    q, k, v, o, lse, do = _uniform_vma(q, k, v, o, lse, do)
+    BH, T, D = q.shape
+    Tk = k.shape[1]
+    block_q, block_k = _snap_blocks(T, Tk, block_q, block_k, interpret)
+    nq = -(-T // block_q)
+    nk = -(-Tk // block_k)
+
+    # delta_i = rowsum(do_i * o_i): one cheap fused elementwise+reduce,
+    # lane-replicated like lse (see _flash_fwd_kernel)
+    delta = jnp.broadcast_to(
+        jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32),
+                axis=-1, keepdims=True), (BH, T, _LANES))
+
+    q_spec = pl.BlockSpec((1, block_q, D), lambda b, i, j: (b, i, 0))
+    k_spec = pl.BlockSpec((1, block_k, D), lambda b, i, j: (b, j, 0))
+    r_spec = pl.BlockSpec((1, block_q, _LANES),
+                          lambda b, i, j: (b, i, 0))
+    dq = pl.pallas_call(
+        functools.partial(
+            _flash_dq_kernel, scale=scale, causal=causal,
+            block_q=block_q, block_k=block_k, num_kb=nk,
+            seq_q=T, seq_k=Tk),
+        grid=(BH, nq, nk),
+        in_specs=[q_spec, k_spec, k_spec, q_spec, r_spec, r_spec],
+        out_specs=q_spec,
+        out_shape=_with_vma(
+            [jax.ShapeDtypeStruct(q.shape, q.dtype)], (q, k, v, do))[0],
+        scratch_shapes=[pltpu.VMEM((block_q, D), jnp.float32)],
+        interpret=interpret,
+    )(q, k, v, do, lse, delta)
+
+    # dk/dv: grid's middle axis walks k blocks, inner axis q blocks
+    q_spec2 = pl.BlockSpec((1, block_q, D), lambda b, i, j: (b, j, 0))
+    k_spec2 = pl.BlockSpec((1, block_k, D), lambda b, i, j: (b, i, 0))
+    r_spec2 = pl.BlockSpec((1, block_q, _LANES),
+                           lambda b, i, j: (b, j, 0))
+    kv_shapes = [jax.ShapeDtypeStruct(k.shape, k.dtype),
+                 jax.ShapeDtypeStruct(v.shape, v.dtype)]
+    dk, dv = pl.pallas_call(
+        functools.partial(
+            _flash_dkv_kernel, scale=scale, causal=causal,
+            block_q=block_q, block_k=block_k, num_qb=nq,
+            seq_q=T, seq_k=Tk),
+        grid=(BH, nk, nq),
+        in_specs=[q_spec2, k_spec2, k_spec2, q_spec2, r_spec2, r_spec2],
+        out_specs=[k_spec2, k_spec2],
+        out_shape=_with_vma(kv_shapes, (q, k, v, do)),
+        scratch_shapes=[pltpu.VMEM((block_k, D), jnp.float32),
+                        pltpu.VMEM((block_k, D), jnp.float32)],
+        interpret=interpret,
+    )(q, k, v, do, lse, delta)
+    return dq, dk, dv
+
+
+def _dense_fallback(q, k, v, scale, causal):
+    """Pallas's interpret mode cannot execute with mesh-varying
+    operands (its internal block loads mix varying data with replicated
+    grid indices, tripping shard_map's vma check). Compiled TPU
+    execution is an opaque custom call and unaffected — so only the
+    CPU-mesh test path takes this dense recompute, wrapped in
+    checkpoint so strips rematerialize instead of caching (T, T)."""
+    return jax.checkpoint(
+        lambda a, b, c: _attn_reference(a, b, c, scale, causal)
+    )(q, k, v)
+
+
+def _interpret_needs_fallback(*xs):
+    if jax.default_backend() == "tpu":
+        return False
+    typeof = getattr(jax, "typeof", None)
+    return typeof is not None and any(
+        getattr(typeof(x), "vma", None) for x in xs)
+
+
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
 def _flash(q, k, v, scale, causal, block_q, block_k):
+    if _interpret_needs_fallback(q, k, v):
+        return _dense_fallback(q, k, v, scale, causal).astype(q.dtype)
     interpret = jax.default_backend() != "tpu"
-    return _flash_forward(q, k, v, scale, causal, block_q, block_k,
-                          interpret)
+    o, _ = _flash_forward(q, k, v, scale, causal, block_q, block_k,
+                          interpret, want_lse=False)
+    return o
 
 
 def _flash_fwd_rule(q, k, v, scale, causal, block_q, block_k):
-    o = _flash(q, k, v, scale, causal, block_q, block_k)
-    return o, (q, k, v)
+    if _interpret_needs_fallback(q, k, v):
+        o = _dense_fallback(q, k, v, scale, causal).astype(q.dtype)
+        return o, (q, k, v, None, None)
+    interpret = jax.default_backend() != "tpu"
+    o, lse = _flash_forward(q, k, v, scale, causal, block_q, block_k,
+                            interpret, want_lse=True)
+    return o, (q, k, v, o, lse)
+
+
+def _narrow_vma(ct, primal):
+    """Reduce a cotangent to its primal's mesh variance.
+
+    The backward kernels stamp every output with the union of the
+    inputs' vma (_with_vma). Under a vma-checking shard_map with mixed
+    variance (e.g. q replicated while k/v rotate) the correct adjoint
+    of the implicit broadcast is a psum over the extra axes."""
+    typeof = getattr(jax, "typeof", None)
+    if typeof is None:
+        return ct
+    ct_vma = getattr(typeof(ct), "vma", None) or frozenset()
+    p_vma = getattr(typeof(primal), "vma", None) or frozenset()
+    extra = tuple(sorted(set(ct_vma) - set(p_vma)))
+    return jax.lax.psum(ct, extra) if extra else ct
 
 
 def _flash_bwd_rule(scale, causal, block_q, block_k, res, do):
-    q, k, v = res
-    # standard attention gradients with probability recompute; wrapped in
-    # checkpoint so XLA rematerializes strips instead of caching (T,T)
-    def f(q_, k_, v_):
-        return _attn_reference(q_, k_, v_, scale, causal)
-    _, vjp = jax.vjp(jax.checkpoint(f), q, k, v)
-    return vjp(do)
+    q, k, v, o, lse = res
+    if lse is None:          # dense interpret-mode fallback (see above)
+        _, vjp = jax.vjp(
+            lambda a, b, c: _dense_fallback(
+                a, b, c, scale, causal).astype(q.dtype), q, k, v)
+        return vjp(do)
+    interpret = jax.default_backend() != "tpu"
+    dq, dk, dv = _flash_backward(q, k, v, o, lse, do, scale, causal,
+                                 block_q, block_k, interpret)
+    return _narrow_vma(dq, q), _narrow_vma(dk, k), _narrow_vma(dv, v)
 
 
 _flash.defvjp(_flash_fwd_rule, _flash_bwd_rule)
 
 
 def flash_attention(query, key, value, scale=None, causal=False,
-                    block_q=128, block_k=128):
+                    block_q=512, block_k=512):
     """Fused attention over (B, H, T, D) or (BH, T, D) inputs."""
     q4 = query.ndim == 4
     if q4:
@@ -184,10 +492,10 @@ def flash_attention(query, key, value, scale=None, causal=False,
 @register("_contrib_FlashAttention",
           arg_names=("query", "key", "value"),
           aliases=("_contrib_flash_attention",),
-          defaults={"scale": None, "causal": False, "block_q": 128,
-                    "block_k": 128, "seq_axis": None})
+          defaults={"scale": None, "causal": False, "block_q": 512,
+                    "block_k": 512, "seq_axis": None})
 def _flash_attention_op(query, key, value, scale=None, causal=False,
-                        block_q=128, block_k=128, seq_axis=None, **_):
+                        block_q=512, block_k=512, seq_axis=None, **_):
     """(B, H, T, D) fused attention; returns same shape.
 
     seq_axis: name of a mesh axis to sequence-parallelize over. When the

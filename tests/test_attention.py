@@ -71,6 +71,47 @@ class TestFlashKernel:
             np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                        rtol=1e-4, atol=1e-5)
 
+    @pytest.mark.parametrize("causal", [False, True])
+    def test_gradients_multiblock_ragged(self, causal):
+        """Pallas backward over several blocks incl. a ragged tail: the
+        dq pass and the dk/dv pass must both mask padded rows/cols."""
+        q, k, v = _qkv(2, 72, 16, seed=7)
+
+        def loss_flash(q_, k_, v_):
+            return (flash_attention(q_, k_, v_, causal=causal,
+                                    block_q=32, block_k=32) ** 2).sum()
+
+        def loss_ref(q_, k_, v_):
+            return (_attn_reference(q_, k_, v_, 16 ** -0.5,
+                                    causal) ** 2).sum()
+
+        gf = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+        gr = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+        for a, b in zip(gf, gr):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=1e-4, atol=1e-5)
+
+    @pytest.mark.parametrize("causal", [False, True])
+    def test_gradients_cross_lengths(self, causal):
+        """Backward with Tk != Tq (cross attention), incl. the causal
+        row>=col masking against ragged q AND k tails."""
+        q, _, _ = _qkv(1, 40, 16, seed=8)
+        _, k, v = _qkv(1, 56, 16, seed=9)
+
+        def loss_flash(q_, k_, v_):
+            return (flash_attention(q_, k_, v_, causal=causal,
+                                    block_q=32, block_k=32) ** 2).sum()
+
+        def loss_ref(q_, k_, v_):
+            return (_attn_reference(q_, k_, v_, 16 ** -0.5,
+                                    causal) ** 2).sum()
+
+        gf = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+        gr = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+        for a, b in zip(gf, gr):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=1e-4, atol=1e-5)
+
     def test_registered_op(self):
         q, k, v = _qkv(1, 16, 8, heads=2)
         out = nd._contrib_FlashAttention(nd.array(np.asarray(q)),
@@ -78,6 +119,53 @@ class TestFlashKernel:
                                          nd.array(np.asarray(v)),
                                          causal=True)
         assert out.shape == (1, 2, 16, 8)
+
+    @pytest.mark.skipif(jax.device_count() < 2,
+                        reason="needs a 2-device mesh")
+    def test_replicated_shard_map_runs_kernel(self):
+        """Fully-replicated q/k/v under a vma-checking shard_map: the
+        kernel path itself runs (no varying operand, so no interpret
+        fallback) and the out aval must declare vma=empty — omitting
+        vma entirely raises under check_vma."""
+        from mxnet_tpu.parallel._compat import shard_map
+        mesh = Mesh(np.array(jax.devices()[:2]), ("sp",))
+        q, k, v = _qkv(2, 32, 16, seed=12)
+        fn = shard_map(
+            lambda a, b, c: flash_attention(a, b, c, block_q=16,
+                                            block_k=16),
+            mesh=mesh, in_specs=(P(), P(), P()), out_specs=P())
+        out = fn(q, k, v)
+        ref = _attn_reference(q, k, v, 16 ** -0.5, False)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=2e-5, atol=2e-6)
+
+    @pytest.mark.skipif(jax.device_count() < 2,
+                        reason="needs a 2-device mesh")
+    def test_grad_mixed_variance_shard_map(self):
+        """Backward under a vma-checking shard_map where q is replicated
+        while k/v vary over the mesh axis: the cotangent dq must come
+        back replicated (psum over the extra axis), not union-varying
+        (regression: the Pallas backward stamps outputs with the union
+        vma; _narrow_vma reduces it to each primal's variance)."""
+        from mxnet_tpu.parallel._compat import shard_map
+        mesh = Mesh(np.array(jax.devices()[:2]), ("sp",))
+        q, k, v = _qkv(2, 32, 16, seed=11)
+
+        def body(q_, k_, v_):
+            # each device attends its local half of the keys; q is
+            # shared, so its cotangent must be psum'd back to replicated
+            def loss(a, b, c):
+                return (flash_attention(a, b, c, block_q=16,
+                                        block_k=16)
+                        .astype(jnp.float32) ** 2).sum()
+            return jax.grad(loss, argnums=(0, 1, 2))(q_, k_, v_)
+
+        fn = shard_map(body, mesh=mesh,
+                       in_specs=(P(), P(None, "sp"), P(None, "sp")),
+                       out_specs=(P(), P(None, "sp"), P(None, "sp")))
+        dq, dk, dv = fn(q, k, v)   # raises if dq variance is wrong
+        assert dq.shape == q.shape
+        assert dk.shape == k.shape
 
 
 @pytest.mark.skipif(jax.device_count() < 8, reason="needs 8-device mesh")

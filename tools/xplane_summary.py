@@ -58,7 +58,18 @@ _CATEGORIES = (
 
 
 def _category(name):
+    # events carry full HLO text ("%divide_subtract_fusion = (f32[...])
+    # fusion(f32[...] %param), kind=kLoop ..."); match only the
+    # instruction name plus the opcode token after "=", not operand text
+    # (shape strings contain "slice"/"convert"-like substrings)
     low = name.lower()
+    head = low.split(" = ", 1)
+    if len(head) == 2:
+        # opcode = the identifier right before the operand list, i.e.
+        # after the result type (which itself contains parens/braces:
+        # "(f32[8]{0:T(1024)}, ...) fusion(...)")
+        m = re.search(r"[)}\]]\s+([a-z][a-z0-9._-]*)\(", head[1])
+        low = head[0] + " " + (m.group(1) if m else "")
     for cat, keys in _CATEGORIES:
         if any(k in low for k in keys):
             return cat
@@ -91,21 +102,31 @@ def summarize(trace_dir):
         xspace = xplane_pb2.XSpace()
         with open(path, "rb") as f:
             xspace.ParseFromString(f.read())
+        # when a real accelerator plane exists (TPU runs), host planes
+        # must be ignored wholesale: their python-activity events (e.g.
+        # "np.asarray(jax.Array)" blocking on a readback) span the whole
+        # trace and would swamp the device table. /host:CPU is only the
+        # compute plane on the CPU backend, where no device plane exists.
+        has_device = any(p.name.startswith("/device:") and
+                         any(ln.events for ln in p.lines)
+                         for p in xspace.planes)
         for plane in xspace.planes:
-            # accelerator planes ("/device:TPU:0") — or, on the CPU
-            # backend, the "/host:CPU" compute plane; skip metadata and
-            # python host-activity planes
-            if not (re.search(r"/device:|tpu|gpu", plane.name,
-                              re.IGNORECASE)
-                    or plane.name == "/host:CPU"):
+            if has_device:
+                if not plane.name.startswith("/device:"):
+                    continue
+            elif not (re.search(r"/device:|tpu|gpu", plane.name,
+                                re.IGNORECASE)
+                      or plane.name == "/host:CPU"):
                 continue
             ev_names = {eid: em.name
                         for eid, em in plane.event_metadata.items()}
-            # device planes carry overlapping lines (XLA Modules / Steps
-            # span the same wall time as the per-op line) — keep only the
-            # HLO-op line when one exists, else every line (CPU backend)
+            # device planes carry overlapping lines: XLA Modules / Steps
+            # span the same wall time as the per-op line, and "Async XLA
+            # Ops" holds in-flight copy spans that overlap compute — keep
+            # exactly the HLO-op line when one exists, else every line
+            # (CPU backend)
             lines = [ln for ln in plane.lines
-                     if "xla ops" in ln.name.lower()] or list(plane.lines)
+                     if ln.name.lower() == "xla ops"] or list(plane.lines)
             for line in lines:
                 for ev in line.events:
                     name = ev_names.get(ev.metadata_id, "?")
