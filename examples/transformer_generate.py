@@ -1,0 +1,76 @@
+"""Train a tiny transformer LM, then generate from it with the KV-cache
+decoder — the full train -> decode round trip on one chip:
+
+`python examples/transformer_generate.py`
+
+The corpus is arithmetic token sequences (start + k*step mod vocab), so
+a trained model's greedy continuation should keep extending the
+progression — checked at the end.
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+import jax
+import numpy as np
+
+from mxnet_tpu.generation import Generator
+from mxnet_tpu.initializer import Xavier
+from mxnet_tpu.models import transformer
+from mxnet_tpu.parallel import make_train_step
+
+V, T, L, H, DIM, B = 32, 16, 2, 2, 64, 32
+
+
+def corpus(n, seed=0):
+    rng = np.random.RandomState(seed)
+    starts = rng.randint(0, V, n)
+    steps = rng.randint(1, 4, n)
+    return (starts[:, None] + steps[:, None] * np.arange(T)[None, :]) \
+        % V
+
+
+def main():
+    sym = transformer.get_symbol(V, T, num_layers=L, num_heads=H,
+                                 dim=DIM)
+    step = make_train_step(sym, optimizer="adam")
+    state = step.init_state(Xavier(factor_type="avg", magnitude=2.0),
+                            {"data": (B, T), "softmax_label": (B, T)})
+    data = corpus(B * 40)
+    key = jax.random.PRNGKey(0)
+    for epoch in range(6):
+        last_probs = None
+        for i in range(0, len(data), B):
+            toks = data[i:i + B].astype(np.float32)
+            labels = np.roll(toks, -1, axis=1)
+            labels[:, -1] = -1
+            batch = step.place_batch({"data": toks,
+                                      "softmax_label": labels})
+            state, outs = step(state, batch, 1e-3, key)
+            last_probs = (outs[0], labels)
+        probs, labels = last_probs
+        flat = np.asarray(probs).reshape(-1, V)
+        keep = labels.ravel() >= 0
+        nll = -np.log(np.maximum(
+            flat[np.arange(len(flat)), labels.ravel().astype(int)],
+            1e-9))[keep].mean()
+        print("epoch %d  last-batch nll %.3f" % (epoch, nll))
+
+    gen = Generator(state[0], V, max_len=T, num_layers=L, num_heads=H,
+                    dim=DIM, batch_size=2)
+    prompt = np.array([[3, 4, 5, 6], [10, 12, 14, 16]])
+    out = gen.generate(prompt, max_new_tokens=8)
+    print("greedy continuations:")
+    for row in out:
+        print("  ", row.tolist())
+    # the first row is a +1 progression; count how far it continues
+    want = (prompt[0, 0] + np.arange(12)) % V
+    match = int((out[0] == want).sum())
+    print("progression match: %d/12" % match)
+    assert match >= 8, "decode should continue the learned progression"
+
+
+if __name__ == "__main__":
+    main()

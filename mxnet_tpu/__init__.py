@@ -80,6 +80,8 @@ _kvstore_server._init_kvstore_server_module()
 from . import profiler
 from . import predictor
 from .predictor import Predictor
+from . import generation
+from .generation import Generator
 from . import rtc
 from . import visualization
 from . import visualization as viz

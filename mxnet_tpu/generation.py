@@ -1,0 +1,152 @@
+"""Autoregressive generation — KV-cache decoding for the transformer LM.
+
+New TPU-native capability: the 2017 reference's incremental-inference
+story was RNNCell step-wise unrolling (`rnn/rnn_cell.py` begin_state /
+__call__ chains); the transformer analogue is a KV cache threaded as
+auxiliary state through `models.transformer.get_decode_symbol`'s graph
+(`ops/attention.py` `_contrib_CachedAttention`).
+
+Design: two jit specializations, bucketing-style — one for the prefill
+chunk (B, P) and one for the single-token step (B, 1) — each a whole
+-graph XLA program with the caches as donated-in-spirit aux arrays kept
+on device between steps. Sampling (greedy / temperature / top-k) runs
+on device too; only the chosen token ids come back to the host.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from .executor import _graph_eval_fn
+from .models import transformer
+
+__all__ = ["Generator"]
+
+
+class Generator:
+    """Drives `transformer.get_decode_symbol` with params from a trained
+    `transformer.get_symbol` checkpoint (same parameter names).
+
+    Parameters
+    ----------
+    arg_params : dict name -> array-like (NDArray, np or jnp)
+        Trained parameters (e.g. `Module.get_params()[0]` or
+        `load_checkpoint`'s arg_params).
+    vocab_size, num_layers, num_heads, dim, ffn_hidden :
+        Architecture — must match the training symbol.
+    max_len : int
+        KV-cache capacity (prompt + generated tokens must fit).
+    batch_size : int
+    dtype : optional compute dtype for params/caches (e.g. "bfloat16").
+    """
+
+    def __init__(self, arg_params, vocab_size, max_len, num_layers=2,
+                 num_heads=4, dim=128, ffn_hidden=None, batch_size=1,
+                 dtype=None):
+        self.vocab_size = int(vocab_size)
+        self.max_len = int(max_len)
+        self.batch_size = int(batch_size)
+        self.num_layers = int(num_layers)
+        head_dim = dim // num_heads
+        sym = transformer.get_decode_symbol(
+            vocab_size, max_len, num_layers=num_layers,
+            num_heads=num_heads, dim=dim, ffn_hidden=ffn_hidden)
+        self._sym = sym
+        eval_fn = _graph_eval_fn(sym)
+        self._step_fn = jax.jit(
+            lambda args, aux, rng: eval_fn(args, aux, rng, False))
+
+        def _raw(v):
+            data = getattr(v, "_data", v)
+            arr = jnp.asarray(data)
+            return arr.astype(dtype) if dtype else arr
+
+        wanted = set(sym.list_arguments())
+        self._params = {k: _raw(v) for k, v in arg_params.items()
+                        if k in wanted}
+        missing = wanted - set(self._params) - {
+            "data", "positions", "cache_pos"}
+        if missing:
+            raise ValueError("Generator missing parameters: %s"
+                             % sorted(missing))
+        pos_rows = self._params["pos_embed_weight"].shape[0]
+        if pos_rows < self.max_len:
+            # the decode symbol's position lookup is take(mode='clip');
+            # without this check, positions past the trained table
+            # would silently reuse its last row
+            raise ValueError(
+                "max_len=%d exceeds the trained position table (%d "
+                "rows) — generation past it would silently clip"
+                % (self.max_len, pos_rows))
+        cache_dtype = dtype or next(
+            iter(self._params.values())).dtype
+        self._cache_shape = (self.batch_size, num_heads, self.max_len,
+                             head_dim)
+        self._cache_dtype = cache_dtype
+
+    def _fresh_aux(self):
+        aux = {}
+        for name in self._sym.list_auxiliary_states():
+            aux[name] = jnp.zeros(self._cache_shape, self._cache_dtype)
+        return aux
+
+    def _forward(self, aux, tokens, pos):
+        """tokens: (B, Tnew) int array; pos: python int."""
+        tn = tokens.shape[1]
+        args = dict(self._params)
+        args["data"] = jnp.asarray(tokens, jnp.float32)
+        args["positions"] = jnp.arange(pos, pos + tn, dtype=jnp.float32)
+        args["cache_pos"] = jnp.full((1,), pos, jnp.float32)
+        outs, new_aux = self._step_fn(args, aux, jax.random.PRNGKey(0))
+        return outs[0], new_aux     # logits (B, Tnew, V)
+
+    def generate(self, prompt, max_new_tokens, temperature=0.0,
+                 top_k=None, eos_id=None, seed=0):
+        """Greedy (temperature 0) or sampled continuation.
+
+        prompt: (B, P) int token ids. Returns (B, P + n) ids as numpy
+        (n <= max_new_tokens; generation stops early only when every
+        row has emitted eos_id)."""
+        prompt = np.asarray(prompt)
+        if prompt.ndim != 2 or prompt.shape[0] != self.batch_size:
+            raise ValueError("prompt must be (batch_size, P), got %r"
+                             % (prompt.shape,))
+        P = prompt.shape[1]
+        if P + max_new_tokens > self.max_len:
+            raise ValueError(
+                "prompt (%d) + max_new_tokens (%d) exceeds the cache "
+                "capacity max_len=%d" % (P, max_new_tokens,
+                                         self.max_len))
+        key = jax.random.PRNGKey(seed)
+        aux = self._fresh_aux()
+        logits, aux = self._forward(aux, prompt, 0)
+        ids = [prompt]
+        done = np.zeros((self.batch_size,), bool)
+        last = logits[:, -1]
+        for i in range(max_new_tokens):
+            key, sub = jax.random.split(key)
+            nxt = np.asarray(_pick_token(last, temperature, top_k, sub))
+            if eos_id is not None:
+                nxt = np.where(done, eos_id, nxt)
+                done |= nxt == eos_id
+            ids.append(nxt[:, None])
+            if eos_id is not None and done.all():
+                break
+            if i + 1 < max_new_tokens:
+                logits, aux = self._forward(aux, nxt[:, None], P + i)
+                last = logits[:, -1]
+        return np.concatenate(ids, axis=1)
+
+
+def _pick_token(logits, temperature, top_k, key):
+    """logits (B, V) -> (B,) int32, on device."""
+    logits = logits.astype(jnp.float32)
+    if temperature and float(temperature) > 0:
+        logits = logits / float(temperature)
+        if top_k:
+            kth = jnp.sort(logits, axis=-1)[:, -int(top_k)][:, None]
+            logits = jnp.where(logits < kth, -jnp.inf, logits)
+        return jax.random.categorical(key, logits, axis=-1)
+    return jnp.argmax(logits, axis=-1).astype(jnp.int32)

@@ -16,29 +16,44 @@ from __future__ import annotations
 from .. import symbol as sym
 
 
-def _attention_block(x, num_heads, dim, prefix, seq_axis=None):
-    """x: (B, T, C) -> (B, T, C); causal flash attention (ring
-    attention over ``seq_axis`` when the graph lowers on a mesh
-    carrying that axis)."""
-    H = num_heads
+def _qkv_heads(x, num_heads, dim, prefix):
+    """Shared qkv projection + head split: (B, T, C) -> three
+    (B, H, T, hd). The training and decode attention blocks both use
+    this so their parameter packing can never drift (a repack would
+    still bind the same "<prefix>qkv" weights and silently corrupt
+    decode otherwise)."""
     head_dim = dim // num_heads
     qkv = sym.FullyConnected(x, num_hidden=3 * dim, flatten=False,
                              name=prefix + "qkv")
     # (B, T, 3C) -> (3, B, H, T, hd)
-    qkv = sym.reshape(qkv, shape=(0, 0, 3, H, head_dim))
+    qkv = sym.reshape(qkv, shape=(0, 0, 3, num_heads, head_dim))
     qkv = sym.transpose(qkv, axes=(2, 0, 3, 1, 4))
 
     def head(i):
         part = sym.slice_axis(qkv, axis=0, begin=i, end=i + 1)
         return sym.reshape(part, shape=(-3, -2))      # (B, H, T, hd)
 
-    att = sym.contrib.FlashAttention(head(0), head(1), head(2),
-                                     causal=True, seq_axis=seq_axis,
-                                     name=prefix + "attn")
+    return head(0), head(1), head(2)
+
+
+def _merge_heads_proj(att, dim, prefix):
+    """(B, H, T, hd) attention output -> (B, T, C) through the shared
+    output projection."""
     att = sym.transpose(att, axes=(0, 2, 1, 3))       # (B, T, H, hd)
     att = sym.reshape(att, shape=(0, 0, -3))          # (B, T, C)
     return sym.FullyConnected(att, num_hidden=dim, flatten=False,
                               name=prefix + "proj")
+
+
+def _attention_block(x, num_heads, dim, prefix, seq_axis=None):
+    """x: (B, T, C) -> (B, T, C); causal flash attention (ring
+    attention over ``seq_axis`` when the graph lowers on a mesh
+    carrying that axis)."""
+    q, k, v = _qkv_heads(x, num_heads, dim, prefix)
+    att = sym.contrib.FlashAttention(q, k, v,
+                                     causal=True, seq_axis=seq_axis,
+                                     name=prefix + "attn")
+    return _merge_heads_proj(att, dim, prefix)
 
 
 def _ffn_block(x, dim, hidden, prefix):
@@ -110,6 +125,60 @@ def get_stage_symbol(num_heads=4, dim=128, ffn_hidden=None,
                          % (dim, num_heads))
     return _layer_block(sym.Variable("data"), num_heads, dim,
                         ffn_hidden, "", seq_axis=seq_axis)
+
+
+def _decode_attention_block(x, num_heads, dim, prefix, max_len, pos):
+    """Incremental variant of _attention_block: identical qkv/proj
+    helpers (a training checkpoint binds unchanged), attention routed
+    through _contrib_CachedAttention with per-layer k/v cache aux
+    states ("<prefix>attn_k_cache"/"_v_cache", created by the op's
+    state_inputs registration)."""
+    q, k, v = _qkv_heads(x, num_heads, dim, prefix)
+    att = sym.contrib.CachedAttention(q, k, v,
+                                      pos=pos, max_len=max_len,
+                                      name=prefix + "attn")
+    return _merge_heads_proj(att, dim, prefix)
+
+
+def get_decode_symbol(vocab_size, max_len, num_layers=2, num_heads=4,
+                      dim=128, ffn_hidden=None):
+    """Autoregressive-decode twin of get_symbol.
+
+    Inputs: data (B, Tnew) token ids for the tokens being appended
+    (the whole prompt at prefill, one per step after), positions
+    (Tnew,) absolute position ids, cache_pos (1,) = tokens already in
+    the caches. Output: logits (B, Tnew, vocab) — no loss head.
+    Parameter names match get_symbol exactly; the KV caches are
+    auxiliary states shaped (B, H, max_len, head_dim).
+
+    New TPU-native capability (the 2017 reference's decode story was
+    rnn.RNNCell step-wise unrolling); mxnet_tpu.generation.Generator
+    drives this symbol."""
+    ffn_hidden = ffn_hidden or 4 * dim
+    if dim % num_heads:
+        raise ValueError("dim (%d) must be divisible by num_heads (%d)"
+                         % (dim, num_heads))
+    data = sym.Variable("data")
+    positions = sym.Variable("positions")
+    cache_pos = sym.Variable("cache_pos", shape=(1,))
+
+    x = sym.Embedding(data, input_dim=vocab_size, output_dim=dim,
+                      name="tok_embed")
+    pos_table = sym.Variable("pos_embed_weight", shape=(max_len, dim))
+    pos_vec = sym.take(pos_table, positions)          # (Tnew, dim)
+    x = sym.broadcast_add(x, sym.expand_dims(pos_vec, axis=0))
+
+    for i in range(num_layers):
+        prefix = "layer%d_" % i
+        a = sym.LayerNorm(x, name=prefix + "ln1")
+        x = x + _decode_attention_block(a, num_heads, dim, prefix,
+                                        max_len, cache_pos)
+        f = sym.LayerNorm(x, name=prefix + "ln2")
+        x = x + _ffn_block(f, dim, ffn_hidden, prefix)
+
+    x = sym.LayerNorm(x, name="ln_f")
+    return sym.FullyConnected(x, num_hidden=vocab_size, flatten=False,
+                              name="lm_head")
 
 
 def get_symbol(vocab_size, seq_len, num_layers=2, num_heads=4, dim=128,

@@ -489,6 +489,57 @@ def flash_attention(query, key, value, scale=None, causal=False,
     return out
 
 
+def cached_attention(query, key, value, k_cache, v_cache, pos,
+                     scale=None):
+    """Incremental-decode attention over a KV cache.
+
+    query/key/value: (B, H, Tnew, hd) — projections of the Tnew tokens
+    being appended (Tnew = prompt length at prefill, 1 per step after).
+    k_cache/v_cache: (B, H, Tmax, hd) rolling caches. pos: (1,) int —
+    number of tokens already cached; the new keys land at
+    [pos, pos+Tnew) and query row r may attend cache columns <= pos+r.
+
+    Decode is bandwidth-bound (one (Tnew, Tmax) strip per head), so
+    this is a plain jnp composition — XLA fuses the mask+softmax; the
+    MXU-dense training path stays with the Pallas flash kernel.
+    Returns (out, new_k_cache, new_v_cache)."""
+    B, H, Tn, D = query.shape
+    if scale is None:
+        scale = D ** -0.5
+    p0 = jnp.reshape(pos, ()).astype(jnp.int32)
+    k_cache = jax.lax.dynamic_update_slice(
+        k_cache, key.astype(k_cache.dtype), (0, 0, p0, 0))
+    v_cache = jax.lax.dynamic_update_slice(
+        v_cache, value.astype(v_cache.dtype), (0, 0, p0, 0))
+    s = jnp.einsum("bhqd,bhkd->bhqk", query, k_cache,
+                   precision=jax.lax.Precision.DEFAULT,
+                   preferred_element_type=jnp.float32) * scale
+    cols = jnp.arange(k_cache.shape[2])[None, :]
+    rows = jnp.arange(Tn)[:, None]
+    s = jnp.where(cols <= p0 + rows, s, _NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    out = jnp.einsum("bhqk,bhkd->bhqd", p.astype(v_cache.dtype),
+                     v_cache,
+                     precision=jax.lax.Precision.DEFAULT)
+    return out.astype(query.dtype), k_cache, v_cache
+
+
+@register("_contrib_CachedAttention",
+          arg_names=("query", "key", "value", "k_cache", "v_cache",
+                     "pos"),
+          state_inputs=(3, 4), nondiff_inputs=(5,),
+          differentiable=False,
+          defaults={"scale": None, "max_len": 0})
+def _cached_attention_op(query, key, value, k_cache, v_cache, pos,
+                         scale=None, **_):
+    """(B, H, Tnew, hd) decode attention; k_cache/v_cache are aux
+    states updated in place (the executor threads them like BN moving
+    stats — but unconditionally, since appending to the cache is the
+    op's purpose at inference)."""
+    return cached_attention(query, key, value, k_cache, v_cache, pos,
+                            scale=scale)
+
+
 @register("_contrib_FlashAttention",
           arg_names=("query", "key", "value"),
           aliases=("_contrib_flash_attention",),

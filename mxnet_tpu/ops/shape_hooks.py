@@ -252,3 +252,23 @@ def _regression_label_shapes(shapes, attrs):
 for _name in ("LinearRegressionOutput", "MAERegressionOutput",
               "LogisticRegressionOutput"):
     set_param_shapes(_name, _regression_label_shapes)
+
+
+# -- CachedAttention (decode KV caches sized by the max_len attr) -----------
+
+def _cached_attention_shapes(shapes, attrs):
+    q = shapes[0]
+    out = list(shapes)
+    tmax = int(attrs.get("max_len", 0))
+    if q is not None and tmax:
+        cache = (q[0], q[1], tmax, q[3])
+        if len(out) > 3 and out[3] is None:
+            out[3] = cache
+        if len(out) > 4 and out[4] is None:
+            out[4] = cache
+    if len(out) > 5 and out[5] is None:
+        out[5] = (1,)
+    return out
+
+
+set_param_shapes("_contrib_CachedAttention", _cached_attention_shapes)

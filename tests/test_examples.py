@@ -17,6 +17,7 @@ FAST_EXAMPLES = [
     "profile_model.py",
     "gan_toy.py",
     "fit_spmd_elastic.py",
+    "transformer_generate.py",
 ]
 
 

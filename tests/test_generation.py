@@ -1,0 +1,200 @@
+"""KV-cache decode: _contrib_CachedAttention + get_decode_symbol +
+Generator.
+
+The load-bearing check is teacher-forcing consistency: feeding a
+sequence through the incremental decode path (prefill + one token at a
+time) must reproduce the training symbol's per-position softmax.
+"""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+from mxnet_tpu.executor import _graph_eval_fn
+from mxnet_tpu.generation import Generator
+from mxnet_tpu.initializer import Xavier
+from mxnet_tpu.models import transformer
+from mxnet_tpu.ops.attention import cached_attention, _attn_reference
+from mxnet_tpu.parallel import make_train_step
+
+V, L, H, DIM, T, B = 50, 2, 2, 32, 12, 2
+
+
+def _trained_params(seed=0):
+    sym = transformer.get_symbol(V, T, num_layers=L, num_heads=H,
+                                 dim=DIM)
+    step = make_train_step(sym, optimizer="sgd")
+    with mx.random.seed_scope(seed) if hasattr(
+            mx.random, "seed_scope") else _noop():
+        state = step.init_state(Xavier(),
+                                {"data": (B, T),
+                                 "softmax_label": (B, T)})
+    return sym, state[0]
+
+
+class _noop:
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *a):
+        return False
+
+
+class TestCachedAttentionOp:
+    def test_matches_reference_incremental(self):
+        """Appending one token at a time over a causal sequence equals
+        dense causal attention."""
+        rng = np.random.RandomState(0)
+        Tmax, hd = 8, 16
+        q = jnp.asarray(rng.randn(1, 2, Tmax, hd), jnp.float32)
+        k = jnp.asarray(rng.randn(1, 2, Tmax, hd), jnp.float32)
+        v = jnp.asarray(rng.randn(1, 2, Tmax, hd), jnp.float32)
+        kc = jnp.zeros((1, 2, Tmax, hd), jnp.float32)
+        vc = jnp.zeros_like(kc)
+        outs = []
+        for t in range(Tmax):
+            o, kc, vc = cached_attention(
+                q[:, :, t:t + 1], k[:, :, t:t + 1], v[:, :, t:t + 1],
+                kc, vc, jnp.full((1,), t))
+            outs.append(o)
+        inc = jnp.concatenate(outs, axis=2).reshape(2, Tmax, hd)
+        ref = _attn_reference(q.reshape(2, Tmax, hd),
+                              k.reshape(2, Tmax, hd),
+                              v.reshape(2, Tmax, hd),
+                              hd ** -0.5, True)
+        np.testing.assert_allclose(np.asarray(inc), np.asarray(ref),
+                                   rtol=2e-5, atol=2e-6)
+
+    def test_prefill_then_steps(self):
+        """A multi-token prefill chunk equals the same tokens appended
+        one by one."""
+        rng = np.random.RandomState(1)
+        Tmax, hd, P = 8, 8, 5
+        mk = lambda: jnp.asarray(rng.randn(1, 1, Tmax, hd), jnp.float32)
+        q, k, v = mk(), mk(), mk()
+        kc = jnp.zeros((1, 1, Tmax, hd), jnp.float32)
+        vc = jnp.zeros_like(kc)
+        o_chunk, kc1, vc1 = cached_attention(
+            q[:, :, :P], k[:, :, :P], v[:, :, :P], kc, vc,
+            jnp.zeros((1,)))
+        kc2, vc2 = kc, vc
+        outs = []
+        for t in range(P):
+            o, kc2, vc2 = cached_attention(
+                q[:, :, t:t + 1], k[:, :, t:t + 1], v[:, :, t:t + 1],
+                kc2, vc2, jnp.full((1,), t))
+            outs.append(o)
+        np.testing.assert_allclose(
+            np.asarray(o_chunk), np.asarray(jnp.concatenate(outs, 2)),
+            rtol=2e-5, atol=2e-6)
+        np.testing.assert_allclose(np.asarray(kc1), np.asarray(kc2),
+                                   rtol=1e-6, atol=1e-7)
+
+    def test_registered_with_cache_aux(self):
+        s = transformer.get_decode_symbol(V, T, num_layers=L,
+                                          num_heads=H, dim=DIM)
+        aux = s.list_auxiliary_states()
+        assert sorted(aux) == sorted(
+            ["layer%d_attn_%s" % (i, n)
+             for i in range(L) for n in ("k_cache", "v_cache")])
+        args = s.list_arguments()
+        assert "cache_pos" in args and "positions" in args
+
+
+class TestTeacherForcingConsistency:
+    def test_decode_matches_training_symbol(self):
+        """Incremental logits == training-symbol softmax at every
+        position (prefill of 4, then token-by-token)."""
+        train_sym, params = _trained_params()
+        rng = np.random.RandomState(3)
+        toks = rng.randint(0, V, (B, T)).astype(np.float32)
+
+        # full forward through the training graph -> per-position probs
+        eval_fn = _graph_eval_fn(train_sym)
+        raw = {k: getattr(v, "_data", v) for k, v in params.items()}
+        labels = np.zeros((B * T,), np.float32)
+        outs, _ = eval_fn({**raw, "data": jnp.asarray(toks),
+                           "softmax_label": jnp.asarray(labels)},
+                          {}, jax.random.PRNGKey(0), False)
+        probs_full = np.asarray(outs[0]).reshape(B, T, V)
+
+        # incremental: prefill 4 tokens, then one at a time
+        dec = transformer.get_decode_symbol(V, T, num_layers=L,
+                                            num_heads=H, dim=DIM)
+        dfn = _graph_eval_fn(dec)
+        aux = {n: jnp.zeros((B, H, T, DIM // H), jnp.float32)
+               for n in dec.list_auxiliary_states()}
+        P = 4
+        logits_inc = []
+
+        def fwd(chunk, pos):
+            nonlocal aux
+            tn = chunk.shape[1]
+            outs, aux = dfn(
+                {**raw, "data": jnp.asarray(chunk),
+                 "positions": jnp.arange(pos, pos + tn,
+                                         dtype=jnp.float32),
+                 "cache_pos": jnp.full((1,), pos, jnp.float32)},
+                aux, jax.random.PRNGKey(0), False)
+            return np.asarray(outs[0])
+
+        logits_inc.append(fwd(toks[:, :P], 0))
+        for t in range(P, T):
+            logits_inc.append(fwd(toks[:, t:t + 1], t))
+        logits_inc = np.concatenate(logits_inc, axis=1)
+        probs_inc = np.asarray(
+            jax.nn.softmax(jnp.asarray(logits_inc), axis=-1))
+        np.testing.assert_allclose(probs_inc, probs_full,
+                                   rtol=1e-4, atol=1e-5)
+
+
+class TestGenerator:
+    def test_greedy_deterministic_and_shapes(self):
+        _, params = _trained_params()
+        gen = Generator(params, V, max_len=T, num_layers=L,
+                        num_heads=H, dim=DIM, batch_size=B)
+        prompt = np.array([[1, 2, 3], [4, 5, 6]])
+        out1 = gen.generate(prompt, max_new_tokens=5)
+        out2 = gen.generate(prompt, max_new_tokens=5)
+        assert out1.shape == (B, 8)
+        assert (out1 == out2).all()
+        assert (out1[:, :3] == prompt).all()
+        assert (out1 >= 0).all() and (out1 < V).all()
+
+    def test_sampling_seeded(self):
+        _, params = _trained_params()
+        gen = Generator(params, V, max_len=T, num_layers=L,
+                        num_heads=H, dim=DIM, batch_size=B)
+        prompt = np.array([[1, 2, 3], [4, 5, 6]])
+        a = gen.generate(prompt, max_new_tokens=5, temperature=1.0,
+                         top_k=5, seed=7)
+        b = gen.generate(prompt, max_new_tokens=5, temperature=1.0,
+                         top_k=5, seed=7)
+        c = gen.generate(prompt, max_new_tokens=5, temperature=1.0,
+                         top_k=5, seed=8)
+        assert (a == b).all()
+        assert a.shape == c.shape
+
+    def test_capacity_and_param_errors(self):
+        _, params = _trained_params()
+        gen = Generator(params, V, max_len=T, num_layers=L,
+                        num_heads=H, dim=DIM, batch_size=B)
+        with pytest.raises(ValueError, match="exceeds the cache"):
+            gen.generate(np.zeros((B, T - 1)), max_new_tokens=2)
+        with pytest.raises(ValueError, match="missing parameters"):
+            Generator({"tok_embed_weight": np.zeros((V, DIM))}, V,
+                      max_len=T, num_layers=L, num_heads=H, dim=DIM)
+
+    def test_eos_early_stop(self):
+        _, params = _trained_params()
+        gen = Generator(params, V, max_len=T, num_layers=L,
+                        num_heads=H, dim=DIM, batch_size=B)
+        prompt = np.array([[1, 2], [3, 4]])
+        full = gen.generate(prompt, max_new_tokens=6)
+        eos = int(full[0, 2])     # force the first greedy pick as eos
+        out = gen.generate(prompt, max_new_tokens=6, eos_id=eos)
+        assert out.shape[1] <= full.shape[1]
+        assert (out[0, 2:] == eos).any()
