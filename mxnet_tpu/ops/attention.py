@@ -213,16 +213,24 @@ def _uniform_vma(*operands):
         for x, v in zip(operands, vmas))
 
 
-def _attn_reference(q, k, v, scale, causal):
-    """Plain jnp attention (oracle + backward building block)."""
+def _dense_with_lse(q, k, v, scale, causal):
+    """Dense (o, lse) oracle — the single implementation behind
+    _attn_reference and the interpret-mode fallbacks."""
     s = jnp.einsum("bqd,bkd->bqk", q, k,
                    preferred_element_type=jnp.float32) * scale
     if causal:
         T, Tk = s.shape[-2], s.shape[-1]
         mask = jnp.arange(T)[:, None] >= jnp.arange(Tk)[None, :]
         s = jnp.where(mask, s, _NEG_INF)
-    p = jax.nn.softmax(s, axis=-1)
-    return jnp.einsum("bqk,bkd->bqd", p.astype(v.dtype), v)
+    lse = jax.scipy.special.logsumexp(s, axis=-1)
+    p = jnp.exp(s - lse[..., None])
+    o = jnp.einsum("bqk,bkd->bqd", p.astype(v.dtype), v)
+    return o.astype(q.dtype), lse
+
+
+def _attn_reference(q, k, v, scale, causal):
+    """Plain jnp attention (oracle + backward building block)."""
+    return _dense_with_lse(q, k, v, scale, causal)[0]
 
 
 def _masked_block(ref, rows_base, limit, block_rows):
@@ -344,8 +352,12 @@ def _flash_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
 
 def _flash_backward(q, k, v, o, lse, do, scale, causal, block_q,
-                    block_k, interpret):
-    q, k, v, o, lse, do = _uniform_vma(q, k, v, o, lse, do)
+                    block_k, interpret, dlse=None):
+    if dlse is None:
+        q, k, v, o, lse, do = _uniform_vma(q, k, v, o, lse, do)
+    else:
+        q, k, v, o, lse, do, dlse = _uniform_vma(q, k, v, o, lse, do,
+                                                 dlse)
     BH, T, D = q.shape
     Tk = k.shape[1]
     block_q, block_k = _snap_blocks(T, Tk, block_q, block_k, interpret)
@@ -353,10 +365,19 @@ def _flash_backward(q, k, v, o, lse, do, scale, causal, block_q,
     nk = -(-Tk // block_k)
 
     # delta_i = rowsum(do_i * o_i): one cheap fused elementwise+reduce,
-    # lane-replicated like lse (see _flash_fwd_kernel)
-    delta = jnp.broadcast_to(
-        jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32),
-                axis=-1, keepdims=True), (BH, T, _LANES))
+    # lane-replicated like lse (see _flash_fwd_kernel). When the lse
+    # output itself carries a cotangent (the ring-merge path), its
+    # whole contribution folds into this term: ds_ij = p_ij * (dp_ij -
+    # delta_i + dlse_i), since d lse_i / d s_ij = p_ij — so the kernels
+    # run unchanged on delta' = delta - dlse.
+    delta2 = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32),
+                     axis=-1, keepdims=True)
+    if dlse is not None:
+        delta2 = delta2 - dlse.astype(jnp.float32)[..., None]
+    delta = jnp.broadcast_to(delta2, (BH, T, _LANES))
+    # the residual stores one lane; re-broadcast transiently for the
+    # kernels' (1, block_q, _LANES) stat blocks
+    lse = jnp.broadcast_to(lse[..., None], (BH, T, _LANES))
 
     q_spec = pl.BlockSpec((1, block_q, D), lambda b, i, j: (b, i, 0))
     k_spec = pl.BlockSpec((1, block_k, D), lambda b, i, j: (b, j, 0))
@@ -436,7 +457,10 @@ def _flash_fwd_rule(q, k, v, scale, causal, block_q, block_k):
     interpret = jax.default_backend() != "tpu"
     o, lse = _flash_forward(q, k, v, scale, causal, block_q, block_k,
                             interpret, want_lse=True)
-    return o, (q, k, v, o, lse)
+    # residual keeps ONE lane — the 128-lane replication is a Mosaic
+    # block-layout need of the backward kernels' INPUT, re-broadcast
+    # transiently there, not worth holding across the whole forward
+    return o, (q, k, v, o, lse[..., 0])
 
 
 def _narrow_vma(ct, primal):
@@ -469,6 +493,58 @@ def _flash_bwd_rule(scale, causal, block_q, block_k, res, do):
 
 
 _flash.defvjp(_flash_fwd_rule, _flash_bwd_rule)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def _flash_lse(q, k, v, scale, causal, block_q, block_k):
+    """Flash attention that also returns the per-row logsumexp, with
+    real gradient flow through BOTH outputs. The ring-attention merge
+    consumes (o, lse) pairs per visiting KV block."""
+    if _interpret_needs_fallback(q, k, v):
+        return _dense_with_lse(q, k, v, scale, causal)
+    interpret = jax.default_backend() != "tpu"
+    o, lse3 = _flash_forward(q, k, v, scale, causal, block_q, block_k,
+                             interpret, want_lse=True)
+    return o, lse3[..., 0]
+
+
+def _flash_lse_fwd_rule(q, k, v, scale, causal, block_q, block_k):
+    if _interpret_needs_fallback(q, k, v):
+        o, lse = _dense_with_lse(q, k, v, scale, causal)
+        return (o, lse), (q, k, v, None, None)
+    interpret = jax.default_backend() != "tpu"
+    o, lse3 = _flash_forward(q, k, v, scale, causal, block_q, block_k,
+                             interpret, want_lse=True)
+    lse = lse3[..., 0]
+    return (o, lse), (q, k, v, o, lse)   # single-lane residual
+
+
+def _flash_lse_bwd_rule(scale, causal, block_q, block_k, res, cts):
+    q, k, v, o, lse = res
+    do, dlse = cts
+    if lse is None:          # dense interpret-mode fallback (see above)
+        _, vjp = jax.vjp(
+            lambda a, b, c: _dense_with_lse(a, b, c, scale, causal),
+            q, k, v)
+        return vjp((do, dlse))
+    interpret = jax.default_backend() != "tpu"
+    dq, dk, dv = _flash_backward(q, k, v, o, lse, do, scale, causal,
+                                 block_q, block_k, interpret,
+                                 dlse=dlse)
+    return _narrow_vma(dq, q), _narrow_vma(dk, k), _narrow_vma(dv, v)
+
+
+_flash_lse.defvjp(_flash_lse_fwd_rule, _flash_lse_bwd_rule)
+
+
+def flash_attention_with_lse(query, key, value, scale=None,
+                             causal=False, block_q=512, block_k=512):
+    """(o, lse) over (BH, T, D) inputs — both differentiable; the
+    building block for ring attention's block merge."""
+    if scale is None:
+        scale = query.shape[-1] ** -0.5
+    return _flash_lse(query, key, value, float(scale), bool(causal),
+                      int(block_q), int(block_k))
 
 
 def flash_attention(query, key, value, scale=None, causal=False,

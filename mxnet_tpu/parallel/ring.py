@@ -31,53 +31,81 @@ _NEG_INF = -1e30
 
 def _ring_local(q, k, v, *, axis_name, causal, scale):
     """Per-device body: q (B,H,Tq,D) local; k/v local blocks that will
-    rotate n-1 times."""
+    rotate n-1 times.
+
+    Each visiting block runs the Pallas flash kernel (MXU-dense,
+    O(Tq + Tk) memory — no (Tq, Tk) score materialization, so local
+    shards can be tens of thousands of tokens) returning normalized
+    (o, lse); blocks combine by logsumexp merge. The causal mask over
+    GLOBAL positions reduces, for equal shards, to three whole-block
+    cases on the visiting block id: src < me fully visible, src == me
+    the standard diagonal, src > me skipped."""
     n = lax.psum(1, axis_name)
     me = lax.axis_index(axis_name)
     B, H, Tq, D = q.shape
     Tk = k.shape[2]
+    from ..ops.attention import flash_attention_with_lse
+    q3 = q.reshape(B * H, Tq, D)
 
-    m0 = jnp.full((B, H, Tq, 1), _NEG_INF, jnp.float32)
-    l0 = jnp.zeros((B, H, Tq, 1), jnp.float32)
-    acc0 = jnp.zeros((B, H, Tq, D), jnp.float32)
+    def block_attend(k_cur, v_cur, src):
+        k3 = k_cur.reshape(B * H, Tk, D)
+        v3 = v_cur.reshape(B * H, Tk, D)
+
+        def full(_):
+            return flash_attention_with_lse(q3, k3, v3, scale=scale,
+                                            causal=False)
+
+        def diag(_):
+            return flash_attention_with_lse(q3, k3, v3, scale=scale,
+                                            causal=True)
+
+        def skip(_):
+            # fresh constants are replicated-typed; match the kernel
+            # branches' device-varying outputs for lax.switch
+            return tuple(_pvary(x, (axis_name,)) for x in (
+                jnp.zeros(q3.shape, q3.dtype),
+                jnp.full((B * H, Tq), _NEG_INF, jnp.float32)))
+
+        if not causal:
+            return full(None)
+        if Tq != Tk:
+            raise ValueError("causal ring attention needs equal "
+                             "sequence shards (Tq=%d, Tk=%d)"
+                             % (Tq, Tk))
+        idx = jnp.where(src == me, 1, jnp.where(src < me, 0, 2))
+        return lax.switch(idx, [full, diag, skip], None)
+
+    def merge(o_acc, lse_acc, o_b, lse_b):
+        lse = jnp.logaddexp(lse_acc, lse_b)
+        w_a = jnp.exp(lse_acc - lse)[..., None]
+        w_b = jnp.exp(lse_b - lse)[..., None]
+        return (o_acc * w_a + o_b.astype(jnp.float32) * w_b, lse)
+
+    o0 = jnp.zeros((B * H, Tq, D), jnp.float32)
+    lse0 = jnp.full((B * H, Tq), _NEG_INF, jnp.float32)
     # constants enter the loop carry device-varying (their updates vary
     # over the ring axis; shard_map type-checks this)
-    m0, l0, acc0 = (_pvary(x, (axis_name,)) for x in (m0, l0, acc0))
+    o0, lse0 = (_pvary(x, (axis_name,)) for x in (o0, lse0))
     perm = [(j, (j + 1) % n) for j in range(n)]
 
-    def attend(t, k_cur, v_cur, m, l, acc):
-        src = (me - t) % n               # global block id of k_cur
-        s = jnp.einsum("bhqd,bhkd->bhqk", q, k_cur,
-                       preferred_element_type=jnp.float32) * scale
-        if causal:
-            rows = me * Tq + jnp.arange(Tq)[:, None]
-            cols = src * Tk + jnp.arange(Tk)[None, :]
-            s = jnp.where(rows >= cols, s, _NEG_INF)
-        m_new = jnp.maximum(m, s.max(axis=-1, keepdims=True))
-        p = jnp.exp(s - m_new)
-        alpha = jnp.exp(m - m_new)
-        l = l * alpha + p.sum(axis=-1, keepdims=True)
-        acc = acc * alpha + jnp.einsum(
-            "bhqk,bhkd->bhqd", p.astype(v_cur.dtype), v_cur,
-            preferred_element_type=jnp.float32)
-        return m_new, l, acc
-
     def step(t, carry):
-        k_cur, v_cur, m, l, acc = carry
-        m, l, acc = attend(t, k_cur, v_cur, m, l, acc)
+        k_cur, v_cur, o_acc, lse_acc = carry
+        o_b, lse_b = block_attend(k_cur, v_cur, (me - t) % n)
+        o_acc, lse_acc = merge(o_acc, lse_acc, o_b, lse_b)
         # rotate KV to the next neighbour (ICI hop), overlapping with
         # the next block's compute under XLA's async collectives
         k_nxt = lax.ppermute(k_cur, axis_name, perm)
         v_nxt = lax.ppermute(v_cur, axis_name, perm)
-        return k_nxt, v_nxt, m, l, acc
+        return k_nxt, v_nxt, o_acc, lse_acc
 
     # n-1 rotations visit every remote block; the final visiting block is
     # consumed without a wasted last rotation (a collective in the loop
     # tail cannot be DCE'd by XLA)
-    k_last, v_last, m, l, acc = lax.fori_loop(
-        0, n - 1, step, (k, v, m0, l0, acc0))
-    m, l, acc = attend(n - 1, k_last, v_last, m, l, acc)
-    return (acc / jnp.maximum(l, 1e-30)).astype(q.dtype)
+    k_last, v_last, o_acc, lse_acc = lax.fori_loop(
+        0, n - 1, step, (k, v, o0, lse0))
+    o_b, lse_b = block_attend(k_last, v_last, (me - (n - 1)) % n)
+    o_acc, _ = merge(o_acc, lse_acc, o_b, lse_b)
+    return o_acc.reshape(B, H, Tq, D).astype(q.dtype)
 
 
 def ring_attention(q, k, v, mesh, axis_name="sp", causal=False,

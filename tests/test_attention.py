@@ -120,6 +120,35 @@ class TestFlashKernel:
                                          causal=True)
         assert out.shape == (1, 2, 16, 8)
 
+    @pytest.mark.parametrize("causal", [False, True])
+    def test_lse_variant_gradients(self, causal):
+        """flash_attention_with_lse: gradient flow through BOTH outputs
+        (the lse cotangent folds into the backward's delta term)."""
+        from mxnet_tpu.ops.attention import flash_attention_with_lse
+        q, k, v = _qkv(2, 72, 16, seed=13)
+
+        def loss_flash(q_, k_, v_):
+            o, lse = flash_attention_with_lse(q_, k_, v_,
+                                              causal=causal,
+                                              block_q=32, block_k=32)
+            return (o ** 2).sum() + (jnp.sin(lse) ** 2).sum()
+
+        def loss_ref(q_, k_, v_):
+            s = jnp.einsum("bqd,bkd->bqk", q_, k_) * 16 ** -0.5
+            if causal:
+                m = jnp.arange(72)[:, None] >= jnp.arange(72)[None, :]
+                s = jnp.where(m, s, -1e30)
+            lse = jax.scipy.special.logsumexp(s, axis=-1)
+            o = jnp.einsum("bqk,bkd->bqd",
+                           jnp.exp(s - lse[..., None]), v_)
+            return (o ** 2).sum() + (jnp.sin(lse) ** 2).sum()
+
+        gf = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+        gr = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+        for a, b in zip(gf, gr):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=2e-4, atol=2e-5)
+
     @pytest.mark.skipif(jax.device_count() < 2,
                         reason="needs a 2-device mesh")
     def test_replicated_shard_map_runs_kernel(self):
