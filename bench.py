@@ -298,6 +298,69 @@ def bench_transformer(args):
         "mfu": round(mfu, 4) if mfu is not None else None}))
 
 
+def bench_decode(args):
+    """KV-cache decode throughput: the whole prefill+scan generation
+    runs as ONE device program (Generator.generate_on_device), so the
+    measurement is chip decode speed, not dispatch round-trips.
+    Decode is memory-bandwidth-bound (every step streams the full
+    parameter set + caches), so tokens/s is the metric; no baseline
+    (the reference predates transformer serving)."""
+    metric = "transformer_lm_decode_throughput"
+    jax, dev = _probe_backend(metric)
+
+    c = dict(_TLM)
+    for k in c:
+        c[k] = int(os.environ.get("BENCH_TLM_%s" % k.upper(), c[k]))
+    if args.batch:
+        c["batch"] = args.batch
+    B, D, L, V = c["batch"], c["dim"], c["layers"], c["vocab"]
+    P = int(os.environ.get("BENCH_DECODE_PROMPT", "128"))
+    N = int(os.environ.get("BENCH_DECODE_TOKENS", "256"))
+    max_len = P + N
+    dtype = args.dtype or os.environ.get("BENCH_DTYPE", "bfloat16")
+    try:
+        from mxnet_tpu.generation import Generator
+        from mxnet_tpu.models import transformer
+        from mxnet_tpu.parallel import make_train_step
+        from mxnet_tpu.initializer import Xavier
+
+        sym = transformer.get_symbol(V, max_len, num_layers=L,
+                                     num_heads=c["heads"], dim=D,
+                                     ffn_hidden=4 * D)
+        step = make_train_step(sym, optimizer="sgd")
+        state = step.init_state(Xavier(), {
+            "data": (B, max_len), "softmax_label": (B, max_len)})
+        gen = Generator(state[0], V, max_len=max_len, num_layers=L,
+                        num_heads=c["heads"], dim=D,
+                        batch_size=B,
+                        dtype=None if dtype == "float32" else dtype)
+        prompt = np.random.RandomState(0).randint(0, V, (B, P))
+    except Exception as e:  # noqa: BLE001
+        _fail(metric, "graph_build", e)
+
+    try:
+        out = gen.generate_on_device(prompt, N)   # compile + warmup
+        assert out.shape == (B, P + N)
+    except Exception as e:  # noqa: BLE001
+        _fail(metric, "compile_warmup", e)
+
+    iters = args.iters or int(os.environ.get("BENCH_ITERS", "3"))
+    t0 = time.time()
+    for i in range(iters):
+        out = gen.generate_on_device(prompt, N, seed=i)
+    dt = (time.time() - t0) / iters               # out is host numpy
+    tok_s = B * N / dt
+    print(json.dumps({
+        "metric": metric,
+        "value": round(tok_s, 2),
+        "unit": "tokens/s",
+        "vs_baseline": None,
+        "ms_per_token": round(dt / N * 1e3, 3),
+        "batch": B, "prompt_len": P, "new_tokens": N,
+        "dim": D, "layers": L, "compute_dtype": dtype,
+        "device_kind": getattr(dev, "device_kind", "unknown")}))
+
+
 def main():
     p = argparse.ArgumentParser(description=__doc__)
     p.add_argument("--network", default="resnet-50",
@@ -312,9 +375,15 @@ def main():
                    help="rematerialize the forward (activation memory "
                         "/ recompute trade — for configs that don't "
                         "fit HBM otherwise)")
+    p.add_argument("--decode", action="store_true",
+                   help="transformer_lm only: KV-cache generation "
+                        "throughput instead of training")
     args = p.parse_args()
     if args.network == "transformer_lm":
-        bench_transformer(args)
+        if args.decode:
+            bench_decode(args)
+        else:
+            bench_transformer(args)
     else:
         bench_image(args.network, args)
 

@@ -55,8 +55,10 @@ class Generator:
             num_heads=num_heads, dim=dim, ffn_hidden=ffn_hidden)
         self._sym = sym
         eval_fn = _graph_eval_fn(sym)
+        self._eval_fn = eval_fn
         self._step_fn = jax.jit(
             lambda args, aux, rng: eval_fn(args, aux, rng, False))
+        self._loop_cache = {}
 
         def _raw(v):
             data = getattr(v, "_data", v)
@@ -86,6 +88,19 @@ class Generator:
                              head_dim)
         self._cache_dtype = cache_dtype
 
+    def _check_prompt(self, prompt, max_new_tokens):
+        prompt = np.asarray(prompt)
+        if prompt.ndim != 2 or prompt.shape[0] != self.batch_size:
+            raise ValueError("prompt must be (batch_size, P), got %r"
+                             % (prompt.shape,))
+        P = prompt.shape[1]
+        if P + max_new_tokens > self.max_len:
+            raise ValueError(
+                "prompt (%d) + max_new_tokens (%d) exceeds the cache "
+                "capacity max_len=%d" % (P, max_new_tokens,
+                                         self.max_len))
+        return prompt, P
+
     def _fresh_aux(self):
         aux = {}
         for name in self._sym.list_auxiliary_states():
@@ -102,6 +117,62 @@ class Generator:
         outs, new_aux = self._step_fn(args, aux, jax.random.PRNGKey(0))
         return outs[0], new_aux     # logits (B, Tnew, V)
 
+    def generate_on_device(self, prompt, max_new_tokens,
+                           temperature=0.0, top_k=None, seed=0):
+        """Whole-generation-on-device: prefill + a lax.scan over decode
+        steps compiled into ONE XLA program — a single dispatch instead
+        of one per token (the production-serving shape; through a
+        remote tunnel the per-token loop is round-trip-bound).
+
+        Same sampling semantics as generate() but fixed length (no eos
+        early-exit — a scan has static trip count). Each distinct
+        (prompt_len, max_new_tokens, temperature, top_k) tuple compiles
+        once (the sampling knobs are baked into the program)."""
+        prompt, P = self._check_prompt(prompt, max_new_tokens)
+        toks = self._device_loop(P, int(max_new_tokens),
+                                 float(temperature),
+                                 int(top_k) if top_k else 0)(
+            jnp.asarray(prompt, jnp.float32),
+            jax.random.PRNGKey(seed))
+        return np.concatenate([prompt.astype(np.int64),
+                               np.asarray(toks)], axis=1)
+
+    def _device_loop(self, P, n_steps, temperature, top_k):
+        key_ = (P, n_steps, temperature, top_k)
+        cached = self._loop_cache.get(key_)
+        if cached is not None:
+            return cached
+        eval_fn = self._eval_fn
+        params = self._params
+
+        def run(prompt, key):
+            aux = self._fresh_aux()
+            args = dict(params)
+            args["data"] = prompt
+            args["positions"] = jnp.arange(P, dtype=jnp.float32)
+            args["cache_pos"] = jnp.zeros((1,), jnp.float32)
+            outs, aux = eval_fn(args, aux, key, False)
+            last = outs[0][:, -1]
+
+            def body(carry, i):
+                aux, last, key = carry
+                key, sub = jax.random.split(key)
+                tok = _pick_token(last, temperature, top_k, sub)
+                args = dict(params)
+                args["data"] = tok[:, None].astype(jnp.float32)
+                args["positions"] = jnp.full((1,), P + i, jnp.float32)
+                args["cache_pos"] = jnp.full((1,), P + i, jnp.float32)
+                outs, aux = eval_fn(args, aux, sub, False)
+                return (aux, outs[0][:, -1], key), tok
+
+            (_, _, _), toks = jax.lax.scan(
+                body, (aux, last, key), jnp.arange(n_steps))
+            return toks.T                        # (B, n_steps)
+
+        fn = jax.jit(run)
+        self._loop_cache[key_] = fn
+        return fn
+
     def generate(self, prompt, max_new_tokens, temperature=0.0,
                  top_k=None, eos_id=None, seed=0):
         """Greedy (temperature 0) or sampled continuation.
@@ -109,16 +180,7 @@ class Generator:
         prompt: (B, P) int token ids. Returns (B, P + n) ids as numpy
         (n <= max_new_tokens; generation stops early only when every
         row has emitted eos_id)."""
-        prompt = np.asarray(prompt)
-        if prompt.ndim != 2 or prompt.shape[0] != self.batch_size:
-            raise ValueError("prompt must be (batch_size, P), got %r"
-                             % (prompt.shape,))
-        P = prompt.shape[1]
-        if P + max_new_tokens > self.max_len:
-            raise ValueError(
-                "prompt (%d) + max_new_tokens (%d) exceeds the cache "
-                "capacity max_len=%d" % (P, max_new_tokens,
-                                         self.max_len))
+        prompt, P = self._check_prompt(prompt, max_new_tokens)
         key = jax.random.PRNGKey(seed)
         aux = self._fresh_aux()
         logits, aux = self._forward(aux, prompt, 0)
@@ -146,7 +208,9 @@ def _pick_token(logits, temperature, top_k, key):
     if temperature and float(temperature) > 0:
         logits = logits / float(temperature)
         if top_k:
-            kth = jnp.sort(logits, axis=-1)[:, -int(top_k)][:, None]
+            # kth-largest threshold via top_k, not a full V-sort — this
+            # sits on the per-token decode hot path
+            kth = jax.lax.top_k(logits, int(top_k))[0][:, -1:]
             logits = jnp.where(logits < kth, -jnp.inf, logits)
         return jax.random.categorical(key, logits, axis=-1)
     return jnp.argmax(logits, axis=-1).astype(jnp.int32)
