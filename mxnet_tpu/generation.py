@@ -117,6 +117,90 @@ class Generator:
         outs, new_aux = self._step_fn(args, aux, jax.random.PRNGKey(0))
         return outs[0], new_aux     # logits (B, Tnew, V)
 
+    def beam_search(self, prompt, max_new_tokens, beam_size=4,
+                    length_penalty=0.0, eos_id=None):
+        """Beam decoding over the same KV-cache graph.
+
+        Beams fold into the batch dimension (caches run at B*W); after
+        each step the caches are reordered by the surviving beams'
+        parent indices (a gather on the cache batch axis). Returns
+        (B, P + n) ids — the highest-scoring beam per row, scores
+        normalized by (generated length) ** length_penalty.
+
+        eos_id: a beam that emits eos is frozen (only eos continues it,
+        at no score change); search stops early when every beam of
+        every row is frozen."""
+        prompt, P = self._check_prompt(prompt, max_new_tokens)
+        B, W, V = self.batch_size, int(beam_size), self.vocab_size
+        if W < 1:
+            raise ValueError("beam_size must be >= 1")
+
+        # prefill ONCE at batch B, then tile caches/logits to the
+        # B*W beam batch — the prompt forward is the expensive part
+        # and all beams share it
+        aux = self._fresh_aux()
+        logits, aux = self._forward(aux, prompt, 0)
+        aux = {k: jnp.repeat(v, W, axis=0) for k, v in aux.items()}
+        last = np.repeat(np.asarray(jax.nn.log_softmax(
+            logits[:, -1].astype(jnp.float32), axis=-1)), W, axis=0)
+
+        # duplicate beams would tie forever: start all but beam 0 at
+        # -inf so step 1 picks W DISTINCT first tokens
+        scores = np.full((B, W), -np.inf)
+        scores[:, 0] = 0.0
+        tokens = np.zeros((B, W, 0), np.int64)
+        frozen = np.zeros((B, W), bool)
+
+        for t in range(max_new_tokens):
+            logp = last.reshape(B, W, V).copy()
+            if eos_id is not None:
+                # frozen beams: only eos continues, for free
+                logp[frozen] = -np.inf
+                logp[frozen, eos_id] = 0.0
+            cand = scores[:, :, None] + logp           # (B, W, V)
+            flat = cand.reshape(B, W * V)
+            top = np.argsort(-flat, axis=1)[:, :W]     # (B, W)
+            parent = top // V
+            tok = top % V
+            scores = np.take_along_axis(flat, top, axis=1)
+            tokens = np.concatenate(
+                [np.take_along_axis(
+                    tokens, parent[:, :, None], axis=1),
+                 tok[:, :, None]], axis=2)
+            if eos_id is not None:
+                frozen = np.take_along_axis(frozen, parent, axis=1) \
+                    | (tok == eos_id)
+                if frozen.all():
+                    break
+            if t + 1 == max_new_tokens:
+                break
+            # reorder caches to the surviving beams' parents and feed
+            # the chosen tokens
+            flat_idx = (np.arange(B)[:, None] * W + parent).reshape(-1)
+            idx_dev = jnp.asarray(flat_idx)
+            aux = {k: jnp.take(v, idx_dev, axis=0)
+                   for k, v in aux.items()}
+            logits, aux = self._forward(aux, tok.reshape(-1, 1), P + t)
+            last = np.asarray(jax.nn.log_softmax(
+                logits[:, -1].astype(jnp.float32), axis=-1))
+
+        gen_len = tokens.shape[2]
+        if length_penalty:
+            # per-beam effective length: up to the first eos (frozen
+            # beams pad with free eos tokens that must not count)
+            lens = np.full((B, W), gen_len, np.float64)
+            if eos_id is not None:
+                is_eos = tokens == eos_id              # (B, W, t)
+                has = is_eos.any(axis=2)
+                lens[has] = is_eos.argmax(axis=2)[has] + 1
+            norm = scores / np.maximum(1.0,
+                                       lens) ** float(length_penalty)
+        else:
+            norm = scores
+        best = norm.argmax(axis=1)                     # (B,)
+        out = tokens[np.arange(B), best]               # (B, gen_len)
+        return np.concatenate([prompt.astype(np.int64), out], axis=1)
+
     def generate_on_device(self, prompt, max_new_tokens,
                            temperature=0.0, top_k=None, seed=0):
         """Whole-generation-on-device: prefill + a lax.scan over decode

@@ -19,17 +19,18 @@ from mxnet_tpu.parallel import dist
 import jax, jax.numpy as jnp
 
 dist.init()
-assert dist.size() == 2, dist.size()
+n = int(os.environ["DMLC_NUM_WORKER"])
+assert dist.size() == n, dist.size()
 rank = dist.rank()
 
 from jax.experimental import multihost_utils
 got = multihost_utils.process_allgather(jnp.array([rank + 10.0]))
 np.testing.assert_allclose(np.sort(np.asarray(got).ravel()),
-                           [10.0, 11.0])
+                           [10.0 + i for i in range(n)])
 
 # kvstore reports cluster identity through the same plumbing
 kv = mx.kv.create("dist_sync")
-assert kv.num_workers == 2 and kv.rank == rank
+assert kv.num_workers == n and kv.rank == rank
 
 # dist_sync value semantics (reference tests/nightly/dist_sync_kvstore.py):
 # init broadcasts rank 0's value; push sums across workers exactly
@@ -39,9 +40,9 @@ out = mx.nd.zeros((3, 2))
 kv.pull("w", out=out)
 np.testing.assert_allclose(out.asnumpy(), 100.0)   # rank 0 won
 
-kv.push("w", mx.nd.ones((3, 2)) * (rank + 1))      # 1 + 2 across workers
+kv.push("w", mx.nd.ones((3, 2)) * (rank + 1))      # sum 1..n
 kv.pull("w", out=out)
-np.testing.assert_allclose(out.asnumpy(), 3.0)
+np.testing.assert_allclose(out.asnumpy(), n * (n + 1) / 2.0)
 print("WORKER_OK", rank)
 """
 
@@ -99,6 +100,13 @@ def _run_workers(tmp_path, worker_src, marker, extra_env=None,
 @pytest.mark.slow
 def test_two_process_cluster(tmp_path):
     _run_workers(tmp_path, _WORKER_SRC, "WORKER_OK")
+
+
+@pytest.mark.slow
+def test_four_process_cluster(tmp_path):
+    """Same dist_sync contract over a 4-worker cluster — the DCN path
+    beyond pairwise (allgather ordering, 4-way push reduction)."""
+    _run_workers(tmp_path, _WORKER_SRC, "WORKER_OK", n=4)
 
 
 def test_launch_py_local_mode(tmp_path):
