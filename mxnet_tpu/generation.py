@@ -44,7 +44,7 @@ class Generator:
 
     def __init__(self, arg_params, vocab_size, max_len, num_layers=2,
                  num_heads=4, dim=128, ffn_hidden=None, batch_size=1,
-                 dtype=None):
+                 dtype=None, num_experts=0):
         self.vocab_size = int(vocab_size)
         self.max_len = int(max_len)
         self.batch_size = int(batch_size)
@@ -52,7 +52,8 @@ class Generator:
         head_dim = dim // num_heads
         sym = transformer.get_decode_symbol(
             vocab_size, max_len, num_layers=num_layers,
-            num_heads=num_heads, dim=dim, ffn_hidden=ffn_hidden)
+            num_heads=num_heads, dim=dim, ffn_hidden=ffn_hidden,
+            num_experts=num_experts)
         self._sym = sym
         eval_fn = _graph_eval_fn(sym)
         self._eval_fn = eval_fn

@@ -64,7 +64,8 @@ def _ffn_block(x, dim, hidden, prefix):
                               name=prefix + "fc2")
 
 
-def _moe_block(x, dim, hidden, num_experts, prefix, expert_axis=None):
+def _moe_block(x, dim, hidden, num_experts, prefix, expert_axis=None,
+               capacity_factor=1.25):
     """Switch-style MoE FFN (the residual around it lives in the layer
     loop, so capacity-dropped tokens pass through unchanged).
 
@@ -84,11 +85,13 @@ def _moe_block(x, dim, hidden, num_experts, prefix, expert_axis=None):
                       shape=(num_experts, hidden, dim),
                       init=xavier(hidden, dim))
     return sym.contrib.MoEFFN(x, gate, w1, w2, expert_axis=expert_axis,
+                              capacity_factor=capacity_factor,
                               name=prefix + "moe")
 
 
 def _layer_block(x, num_heads, dim, ffn_hidden, prefix, seq_axis=None,
-                 num_experts=0, expert_axis=None, dropout=0.0):
+                 num_experts=0, expert_axis=None, dropout=0.0,
+                 moe_capacity_factor=1.25):
     """One pre-LN transformer block: attention residual + FFN/MoE
     residual. Shared by the monolithic get_symbol layer loop and the
     pipeline get_stage_symbol so the two can never drift."""
@@ -97,7 +100,8 @@ def _layer_block(x, num_heads, dim, ffn_hidden, prefix, seq_axis=None,
                              seq_axis=seq_axis)
     f = sym.LayerNorm(x, name=prefix + "ln2")
     ff = _moe_block(f, dim, ffn_hidden, num_experts, prefix,
-                    expert_axis=expert_axis) \
+                    expert_axis=expert_axis,
+                    capacity_factor=moe_capacity_factor) \
         if num_experts else _ffn_block(f, dim, ffn_hidden, prefix)
     if dropout > 0:
         ff = sym.Dropout(ff, p=dropout)
@@ -141,7 +145,7 @@ def _decode_attention_block(x, num_heads, dim, prefix, max_len, pos):
 
 
 def get_decode_symbol(vocab_size, max_len, num_layers=2, num_heads=4,
-                      dim=128, ffn_hidden=None):
+                      dim=128, ffn_hidden=None, num_experts=0):
     """Autoregressive-decode twin of get_symbol.
 
     Inputs: data (B, Tnew) token ids for the tokens being appended
@@ -174,7 +178,14 @@ def get_decode_symbol(vocab_size, max_len, num_layers=2, num_heads=4,
         x = x + _decode_attention_block(a, num_heads, dim, prefix,
                                         max_len, cache_pos)
         f = sym.LayerNorm(x, name=prefix + "ln2")
-        x = x + _ffn_block(f, dim, ffn_hidden, prefix)
+        # inference never capacity-drops: every token is served, so
+        # the factor is raised to E (cap == token count). Training-time
+        # drops mean a dropping checkpoint's decode can differ exactly
+        # where training zeroed a token's FFN.
+        ff = _moe_block(f, dim, ffn_hidden, num_experts, prefix,
+                        capacity_factor=num_experts) \
+            if num_experts else _ffn_block(f, dim, ffn_hidden, prefix)
+        x = x + ff
 
     x = sym.LayerNorm(x, name="ln_f")
     return sym.FullyConnected(x, num_hidden=vocab_size, flatten=False,
@@ -183,7 +194,8 @@ def get_decode_symbol(vocab_size, max_len, num_layers=2, num_heads=4,
 
 def get_symbol(vocab_size, seq_len, num_layers=2, num_heads=4, dim=128,
                ffn_hidden=None, dropout=0.0, max_len=None,
-               num_experts=0, seq_axis=None, expert_axis=None):
+               num_experts=0, seq_axis=None, expert_axis=None,
+               moe_capacity_factor=1.25):
     """GPT-style causal LM symbol.
 
     data: (B, T) token ids; softmax_label: (B, T) next-token targets
@@ -227,7 +239,8 @@ def get_symbol(vocab_size, seq_len, num_layers=2, num_heads=4, dim=128,
         x = _layer_block(x, num_heads, dim, ffn_hidden,
                          "layer%d_" % i, seq_axis=seq_axis,
                          num_experts=num_experts,
-                         expert_axis=expert_axis, dropout=dropout)
+                         expert_axis=expert_axis, dropout=dropout,
+                         moe_capacity_factor=moe_capacity_factor)
 
     x = sym.LayerNorm(x, name="ln_f")
     logits = sym.FullyConnected(x, num_hidden=vocab_size, flatten=False,

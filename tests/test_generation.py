@@ -151,6 +151,53 @@ class TestTeacherForcingConsistency:
                                    rtol=1e-4, atol=1e-5)
 
 
+class TestMoEDecode:
+    def test_moe_teacher_forcing_consistency(self):
+        """A Switch-MoE-FFN checkpoint decodes identically to its
+        training forward (expert gating runs per appended token)."""
+        E = 4
+        # capacity raised to E on the training side too: dropping is a
+        # training-throughput knob, and a dropped token's FFN output is
+        # legitimately zero there while decode always serves it
+        sym = transformer.get_symbol(V, T, num_layers=L, num_heads=H,
+                                     dim=DIM, num_experts=E,
+                                     moe_capacity_factor=E)
+        step = make_train_step(sym, optimizer="sgd")
+        state = step.init_state(Xavier(),
+                                {"data": (B, T),
+                                 "softmax_label": (B, T)})
+        params = state[0]
+        raw = {k: getattr(v, "_data", v) for k, v in params.items()}
+        rng = np.random.RandomState(5)
+        toks = rng.randint(0, V, (B, T)).astype(np.float32)
+
+        eval_fn = _graph_eval_fn(sym)
+        outs, _ = eval_fn({**raw, "data": jnp.asarray(toks),
+                           "softmax_label": jnp.zeros((B * T,),
+                                                      jnp.float32)},
+                          {}, jax.random.PRNGKey(0), False)
+        probs_full = np.asarray(outs[0]).reshape(B, T, V)
+
+        dec = transformer.get_decode_symbol(V, T, num_layers=L,
+                                            num_heads=H, dim=DIM,
+                                            num_experts=E)
+        dfn = _graph_eval_fn(dec)
+        aux = {n: jnp.zeros((B, H, T, DIM // H), jnp.float32)
+               for n in dec.list_auxiliary_states()}
+        logits = []
+        for t in range(T):
+            outs, aux = dfn(
+                {**raw, "data": jnp.asarray(toks[:, t:t + 1]),
+                 "positions": jnp.full((1,), t, jnp.float32),
+                 "cache_pos": jnp.full((1,), t, jnp.float32)},
+                aux, jax.random.PRNGKey(0), False)
+            logits.append(np.asarray(outs[0]))
+        probs_inc = np.asarray(jax.nn.softmax(
+            jnp.asarray(np.concatenate(logits, axis=1)), axis=-1))
+        np.testing.assert_allclose(probs_inc, probs_full,
+                                   rtol=1e-4, atol=1e-5)
+
+
 class TestGenerator:
     def test_greedy_deterministic_and_shapes(self):
         _, params = _trained_params()
