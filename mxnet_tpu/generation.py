@@ -40,35 +40,60 @@ class Generator:
         KV-cache capacity (prompt + generated tokens must fit).
     batch_size : int
     dtype : optional compute dtype for params/caches (e.g. "bfloat16").
+    mesh : optional jax.sharding.Mesh for multi-chip serving. Params
+        place by the TP rule (`parallel.sharding.param_sharding`:
+        Megatron column-parallel weights over a 'model' axis, experts
+        over 'expert'), KV caches shard heads over 'model' and batch
+        over 'data'; GSPMD inserts the collectives.
     """
 
     def __init__(self, arg_params, vocab_size, max_len, num_layers=2,
                  num_heads=4, dim=128, ffn_hidden=None, batch_size=1,
-                 dtype=None, num_experts=0):
+                 dtype=None, num_experts=0, mesh=None):
+        from .parallel import sharding as shd
+
         self.vocab_size = int(vocab_size)
         self.max_len = int(max_len)
         self.batch_size = int(batch_size)
         self.num_layers = int(num_layers)
+        self.mesh = mesh
         head_dim = dim // num_heads
         sym = transformer.get_decode_symbol(
             vocab_size, max_len, num_layers=num_layers,
             num_heads=num_heads, dim=dim, ffn_hidden=ffn_hidden,
             num_experts=num_experts)
         self._sym = sym
-        eval_fn = _graph_eval_fn(sym)
+        eval_fn = _graph_eval_fn(sym, mesh=mesh)
         self._eval_fn = eval_fn
         self._step_fn = jax.jit(
             lambda args, aux, rng: eval_fn(args, aux, rng, False))
         self._loop_cache = {}
 
-        def _raw(v):
-            data = getattr(v, "_data", v)
-            arr = jnp.asarray(data)
-            return arr.astype(dtype) if dtype else arr
+        def _raw(name, v):
+            arr = jnp.asarray(getattr(v, "_data", v))
+            if dtype:
+                arr = arr.astype(dtype)
+            if mesh is not None:
+                arr = jax.device_put(
+                    arr, shd.param_sharding(mesh, name, arr.shape))
+            return arr
 
         wanted = set(sym.list_arguments())
-        self._params = {k: _raw(v) for k, v in arg_params.items()
+        self._params = {k: _raw(k, v) for k, v in arg_params.items()
                         if k in wanted}
+        # cache placement: batch over 'data', heads over 'model'
+        if mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec as P
+            spec = [None, None, None, None]
+            if "data" in mesh.axis_names and \
+                    batch_size % mesh.shape["data"] == 0:
+                spec[0] = "data"
+            if "model" in mesh.axis_names and \
+                    num_heads % mesh.shape["model"] == 0:
+                spec[1] = "model"
+            self._cache_sharding = NamedSharding(mesh, P(*spec))
+        else:
+            self._cache_sharding = None
         missing = wanted - set(self._params) - {
             "data", "positions", "cache_pos"}
         if missing:
@@ -105,7 +130,10 @@ class Generator:
     def _fresh_aux(self):
         aux = {}
         for name in self._sym.list_auxiliary_states():
-            aux[name] = jnp.zeros(self._cache_shape, self._cache_dtype)
+            z = jnp.zeros(self._cache_shape, self._cache_dtype)
+            if self._cache_sharding is not None:
+                z = jax.device_put(z, self._cache_sharding)
+            aux[name] = z
         return aux
 
     def _forward(self, aux, tokens, pos):
