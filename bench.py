@@ -335,7 +335,8 @@ def bench_decode(args):
         gen = Generator(state[0], V, max_len=max_len, num_layers=L,
                         num_heads=c["heads"], dim=D,
                         batch_size=B,
-                        dtype=None if dtype == "float32" else dtype)
+                        dtype=None if dtype == "float32" else dtype,
+                        quantize=args.quantize)
         prompt = np.random.RandomState(0).randint(0, V, (B, P))
     except Exception as e:  # noqa: BLE001
         _fail(metric, "graph_build", e)
@@ -372,6 +373,7 @@ def bench_decode(args):
         "end_to_end_tokens_s": round(B * N / dt_long, 2),
         "batch": B, "prompt_len": P, "new_tokens": N,
         "dim": D, "layers": L, "compute_dtype": dtype,
+        "quantize": args.quantize,
         "device_kind": getattr(dev, "device_kind", "unknown")}))
 
 
@@ -392,7 +394,13 @@ def main():
     p.add_argument("--decode", action="store_true",
                    help="transformer_lm only: KV-cache generation "
                         "throughput instead of training")
+    p.add_argument("--quantize", default=None, choices=["int8"],
+                   help="with --decode: weight-only int8 (halved "
+                        "weight HBM traffic on the bandwidth-bound "
+                        "decode path)")
     args = p.parse_args()
+    if args.quantize and not args.decode:
+        p.error("--quantize applies to --decode only")
     if args.network == "transformer_lm":
         if args.decode:
             if args.remat:

@@ -49,9 +49,12 @@ class Generator:
 
     def __init__(self, arg_params, vocab_size, max_len, num_layers=2,
                  num_heads=4, dim=128, ffn_hidden=None, batch_size=1,
-                 dtype=None, num_experts=0, mesh=None):
+                 dtype=None, num_experts=0, mesh=None, quantize=None):
         from .parallel import sharding as shd
 
+        if quantize not in (None, "int8"):
+            raise ValueError("quantize must be None or 'int8', got %r"
+                             % (quantize,))
         self.vocab_size = int(vocab_size)
         self.max_len = int(max_len)
         self.batch_size = int(batch_size)
@@ -61,7 +64,10 @@ class Generator:
         sym = transformer.get_decode_symbol(
             vocab_size, max_len, num_layers=num_layers,
             num_heads=num_heads, dim=dim, ffn_hidden=ffn_hidden,
-            num_experts=num_experts)
+            num_experts=num_experts, quantized=quantize is not None)
+        if quantize:
+            arg_params = _quantize_weights(
+                arg_params, sym.list_arguments())
         self._sym = sym
         eval_fn = _graph_eval_fn(sym, mesh=mesh)
         self._eval_fn = eval_fn
@@ -71,7 +77,10 @@ class Generator:
 
         def _raw(name, v):
             arr = jnp.asarray(getattr(v, "_data", v))
-            if dtype:
+            # int8 weights and their f32 scales keep their dtypes (the
+            # whole point of quantize= is the int8 HBM footprint)
+            if dtype and jnp.issubdtype(arr.dtype, jnp.floating) and \
+                    not name.endswith("_scale"):
                 arr = arr.astype(dtype)
             if mesh is not None:
                 arr = jax.device_put(
@@ -108,8 +117,12 @@ class Generator:
                 "max_len=%d exceeds the trained position table (%d "
                 "rows) — generation past it would silently clip"
                 % (self.max_len, pos_rows))
+        # cache dtype follows the FLOAT params — under quantize="int8"
+        # the dict also holds int8 weights, and an int8 cache would
+        # silently truncate k/v (cached_attention casts to cache dtype)
         cache_dtype = dtype or next(
-            iter(self._params.values())).dtype
+            v.dtype for v in self._params.values()
+            if jnp.issubdtype(v.dtype, jnp.floating))
         self._cache_shape = (self.batch_size, num_heads, self.max_len,
                              head_dim)
         self._cache_dtype = cache_dtype
@@ -313,6 +326,27 @@ class Generator:
                 logits, aux = self._forward(aux, nxt[:, None], P + i)
                 last = logits[:, -1]
         return np.concatenate(ids, axis=1)
+
+
+def _quantize_weights(arg_params, decode_args):
+    """Weight-only int8: for every quantized layer in the decode graph
+    (marked by its "<name>_scale" argument), replace the float
+    "<name>_weight" with per-output-channel symmetric int8 + f32 scale.
+    Other params (embeddings, norms, biases) pass through."""
+    out = {k: v for k, v in arg_params.items()}
+    for arg in decode_args:
+        if not arg.endswith("_scale"):
+            continue
+        wname = arg[:-len("_scale")] + "_weight"
+        if wname not in out:
+            continue
+        w = np.asarray(getattr(out[wname], "_data", out[wname]),
+                       np.float32)
+        scale = np.maximum(np.abs(w).max(axis=1), 1e-12) / 127.0
+        out[wname] = np.clip(np.rint(w / scale[:, None]),
+                             -127, 127).astype(np.int8)
+        out[arg] = scale.astype(np.float32)
+    return out
 
 
 def _pick_token(logits, temperature, top_k, key):

@@ -16,15 +16,26 @@ from __future__ import annotations
 from .. import symbol as sym
 
 
-def _qkv_heads(x, num_heads, dim, prefix):
+def _fc(x, num_hidden, name, quantized=False):
+    """FullyConnected or its weight-only-int8 twin. Same "<name>_weight"
+    binding; the quantized form adds "<name>_scale" (per-out-channel)
+    and keeps the f32 bias. Decode-side only — training always uses the
+    float op."""
+    if quantized:
+        return sym.contrib.QuantizedFullyConnected(
+            x, num_hidden=num_hidden, flatten=False, name=name)
+    return sym.FullyConnected(x, num_hidden=num_hidden, flatten=False,
+                              name=name)
+
+
+def _qkv_heads(x, num_heads, dim, prefix, quantized=False):
     """Shared qkv projection + head split: (B, T, C) -> three
     (B, H, T, hd). The training and decode attention blocks both use
     this so their parameter packing can never drift (a repack would
     still bind the same "<prefix>qkv" weights and silently corrupt
     decode otherwise)."""
     head_dim = dim // num_heads
-    qkv = sym.FullyConnected(x, num_hidden=3 * dim, flatten=False,
-                             name=prefix + "qkv")
+    qkv = _fc(x, 3 * dim, prefix + "qkv", quantized)
     # (B, T, 3C) -> (3, B, H, T, hd)
     qkv = sym.reshape(qkv, shape=(0, 0, 3, num_heads, head_dim))
     qkv = sym.transpose(qkv, axes=(2, 0, 3, 1, 4))
@@ -36,13 +47,12 @@ def _qkv_heads(x, num_heads, dim, prefix):
     return head(0), head(1), head(2)
 
 
-def _merge_heads_proj(att, dim, prefix):
+def _merge_heads_proj(att, dim, prefix, quantized=False):
     """(B, H, T, hd) attention output -> (B, T, C) through the shared
     output projection."""
     att = sym.transpose(att, axes=(0, 2, 1, 3))       # (B, T, H, hd)
     att = sym.reshape(att, shape=(0, 0, -3))          # (B, T, C)
-    return sym.FullyConnected(att, num_hidden=dim, flatten=False,
-                              name=prefix + "proj")
+    return _fc(att, dim, prefix + "proj", quantized)
 
 
 def _attention_block(x, num_heads, dim, prefix, seq_axis=None):
@@ -56,12 +66,10 @@ def _attention_block(x, num_heads, dim, prefix, seq_axis=None):
     return _merge_heads_proj(att, dim, prefix)
 
 
-def _ffn_block(x, dim, hidden, prefix):
-    h = sym.FullyConnected(x, num_hidden=hidden, flatten=False,
-                           name=prefix + "fc1")
+def _ffn_block(x, dim, hidden, prefix, quantized=False):
+    h = _fc(x, hidden, prefix + "fc1", quantized)
     h = sym.Activation(h, act_type="relu")
-    return sym.FullyConnected(h, num_hidden=dim, flatten=False,
-                              name=prefix + "fc2")
+    return _fc(h, dim, prefix + "fc2", quantized)
 
 
 def _moe_block(x, dim, hidden, num_experts, prefix, expert_axis=None,
@@ -131,21 +139,23 @@ def get_stage_symbol(num_heads=4, dim=128, ffn_hidden=None,
                         ffn_hidden, "", seq_axis=seq_axis)
 
 
-def _decode_attention_block(x, num_heads, dim, prefix, max_len, pos):
+def _decode_attention_block(x, num_heads, dim, prefix, max_len, pos,
+                            quantized=False):
     """Incremental variant of _attention_block: identical qkv/proj
     helpers (a training checkpoint binds unchanged), attention routed
     through _contrib_CachedAttention with per-layer k/v cache aux
     states ("<prefix>attn_k_cache"/"_v_cache", created by the op's
     state_inputs registration)."""
-    q, k, v = _qkv_heads(x, num_heads, dim, prefix)
+    q, k, v = _qkv_heads(x, num_heads, dim, prefix, quantized)
     att = sym.contrib.CachedAttention(q, k, v,
                                       pos=pos, max_len=max_len,
                                       name=prefix + "attn")
-    return _merge_heads_proj(att, dim, prefix)
+    return _merge_heads_proj(att, dim, prefix, quantized)
 
 
 def get_decode_symbol(vocab_size, max_len, num_layers=2, num_heads=4,
-                      dim=128, ffn_hidden=None, num_experts=0):
+                      dim=128, ffn_hidden=None, num_experts=0,
+                      quantized=False):
     """Autoregressive-decode twin of get_symbol.
 
     Inputs: data (B, Tnew) token ids for the tokens being appended
@@ -176,20 +186,22 @@ def get_decode_symbol(vocab_size, max_len, num_layers=2, num_heads=4,
         prefix = "layer%d_" % i
         a = sym.LayerNorm(x, name=prefix + "ln1")
         x = x + _decode_attention_block(a, num_heads, dim, prefix,
-                                        max_len, cache_pos)
+                                        max_len, cache_pos,
+                                        quantized=quantized)
         f = sym.LayerNorm(x, name=prefix + "ln2")
         # inference never capacity-drops: every token is served, so
         # the factor is raised to E (cap == token count). Training-time
         # drops mean a dropping checkpoint's decode can differ exactly
-        # where training zeroed a token's FFN.
+        # where training zeroed a token's FFN. (MoE expert weights stay
+        # float — quantized= covers the dense projections.)
         ff = _moe_block(f, dim, ffn_hidden, num_experts, prefix,
                         capacity_factor=num_experts) \
-            if num_experts else _ffn_block(f, dim, ffn_hidden, prefix)
+            if num_experts else _ffn_block(f, dim, ffn_hidden, prefix,
+                                           quantized=quantized)
         x = x + ff
 
     x = sym.LayerNorm(x, name="ln_f")
-    return sym.FullyConnected(x, num_hidden=vocab_size, flatten=False,
-                              name="lm_head")
+    return _fc(x, vocab_size, "lm_head", quantized)
 
 
 def get_symbol(vocab_size, seq_len, num_layers=2, num_heads=4, dim=128,

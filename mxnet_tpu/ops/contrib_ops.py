@@ -88,6 +88,42 @@ def _dequantize(data, min_range, max_range, out_type="float32", **_):
         .astype(np.dtype(out_type))
 
 
+@register("_contrib_QuantizedFullyConnected",
+          arg_names=("data", "weight", "scale", "bias"),
+          differentiable=False,
+          defaults={"num_hidden": 0, "no_bias": False,
+                    "flatten": True})
+def _quantized_fc(data, weight, scale, bias=None, num_hidden=0,
+                  no_bias=False, flatten=True, **_):
+    """Weight-only int8 FullyConnected — the TPU serving quantization.
+
+    weight: int8 (num_hidden, in), per-output-channel symmetric;
+    scale: f32 (num_hidden,) with w_f32 ~= weight * scale[:, None].
+    Decode is HBM-bandwidth-bound (every token streams the full weight
+    set), so halving weight bytes directly buys decode throughput; the
+    int8->compute-dtype convert fuses into the matmul's operand read.
+    The scale applies AFTER the matmul (per output channel — identical
+    algebra, O(N*out) instead of O(out*in) multiplies).
+
+    Modernizes the reference's contrib quantize story
+    (src/operator/contrib/quantize-inl.h — elementwise affine quantize
+    ops, kept as `_contrib_quantize`/`_contrib_dequantize` above) into
+    an actual quantized-layer op. Inference-only (not differentiable);
+    generation.Generator(quantize="int8") builds on it."""
+    cdt = data.dtype
+    if flatten and data.ndim > 2:
+        data = data.reshape(data.shape[0], -1)
+    w = weight.astype(cdt)
+    y = jax.lax.dot_general(
+        data, w, (((data.ndim - 1,), (1,)), ((), ())),
+        precision=jax.lax.Precision.DEFAULT,
+        preferred_element_type=jnp.float32)
+    y = (y * scale.astype(jnp.float32)).astype(cdt)
+    if not no_bias and bias is not None:
+        y = y + bias.astype(cdt)
+    return y
+
+
 @register("_contrib_MoEFFN",
           arg_names=("data", "gate_weight", "expert_w1", "expert_w2"),
           aliases=("_contrib_moe_ffn",),

@@ -272,3 +272,29 @@ def _cached_attention_shapes(shapes, attrs):
 
 
 set_param_shapes("_contrib_CachedAttention", _cached_attention_shapes)
+
+
+# -- QuantizedFullyConnected ------------------------------------------------
+
+set_arg_select("_contrib_QuantizedFullyConnected", lambda a: (
+    ("data", "weight", "scale") if str(a.get("no_bias", False)) in
+    ("True", "true", "1") else ("data", "weight", "scale", "bias")))
+
+
+def _quant_fc_shapes(shapes, attrs):
+    # data/weight/bias follow FullyConnected's rule; the extra scale
+    # slot (index 2) is (num_hidden,)
+    fc = _fc_shapes([shapes[0], shapes[1],
+                     shapes[3] if len(shapes) > 3 else None], attrs)
+    out = list(shapes)
+    if len(out) > 1 and out[1] is None:
+        out[1] = fc[1]
+    if len(out) > 2 and out[2] is None and int(attrs.get(
+            "num_hidden", 0)):
+        out[2] = (int(attrs["num_hidden"]),)
+    if len(out) > 3 and out[3] is None and len(fc) > 2:
+        out[3] = fc[2]
+    return out
+
+
+set_param_shapes("_contrib_QuantizedFullyConnected", _quant_fc_shapes)

@@ -151,6 +151,67 @@ class TestTeacherForcingConsistency:
                                    rtol=1e-4, atol=1e-5)
 
 
+class TestQuantizedDecode:
+    def test_quantized_fc_op_matches_dequant(self):
+        rng = np.random.RandomState(0)
+        x = jnp.asarray(rng.randn(4, 8), jnp.float32)
+        w = rng.randn(6, 8).astype(np.float32)
+        scale = np.abs(w).max(axis=1) / 127.0
+        wq = np.rint(w / scale[:, None]).astype(np.int8)
+        b = rng.randn(6).astype(np.float32)
+        out = nd._contrib_QuantizedFullyConnected(
+            nd.array(np.asarray(x)), nd.array(wq), nd.array(scale),
+            nd.array(b), num_hidden=6)
+        ref = x @ (wq.astype(np.float32) * scale[:, None]).T + b
+        np.testing.assert_allclose(out.asnumpy(), np.asarray(ref),
+                                   rtol=1e-5, atol=1e-5)
+
+    def test_int8_decode_close_to_float(self):
+        """Weight-only int8 greedy decode: per-position softmax stays
+        close to the float path, weights actually land int8."""
+        _, params = _trained_params()
+        gen_f = Generator(params, V, max_len=T, num_layers=L,
+                          num_heads=H, dim=DIM, batch_size=B)
+        gen_q = Generator(params, V, max_len=T, num_layers=L,
+                          num_heads=H, dim=DIM, batch_size=B,
+                          quantize="int8")
+        assert gen_q._params["layer0_qkv_weight"].dtype == jnp.int8
+        assert gen_q._params["lm_head_weight"].dtype == jnp.int8
+        assert "layer0_qkv_scale" in gen_q._params
+
+        prompt = np.array([[1, 2, 3], [4, 5, 6]])
+        rng_toks = np.random.RandomState(4).randint(
+            0, V, (B, 8)).astype(np.float32)
+        aux_f = gen_f._fresh_aux()
+        aux_q = gen_q._fresh_aux()
+        lf, _ = gen_f._forward(aux_f, rng_toks, 0)
+        lq, _ = gen_q._forward(aux_q, rng_toks, 0)
+        pf = np.asarray(jax.nn.softmax(lf.astype(jnp.float32), -1))
+        pq = np.asarray(jax.nn.softmax(lq.astype(jnp.float32), -1))
+        assert np.abs(pf - pq).max() < 0.05
+        # end-to-end still generates
+        out = gen_q.generate(prompt, max_new_tokens=5)
+        assert out.shape == (B, 8)
+
+    def test_cache_dtype_ignores_int8_params(self):
+        """Param-dict ordering must not leak int8 into the KV caches
+        (regression: cache dtype was taken from the dict's first
+        entry)."""
+        _, params = _trained_params()
+        reordered = {"layer0_qkv_weight": params["layer0_qkv_weight"]}
+        reordered.update(params)
+        gen = Generator(reordered, V, max_len=T, num_layers=L,
+                        num_heads=H, dim=DIM, batch_size=B,
+                        quantize="int8")
+        assert jnp.issubdtype(gen._cache_dtype, jnp.floating)
+
+    def test_quantize_rejects_unknown(self):
+        _, params = _trained_params()
+        with pytest.raises(ValueError, match="quantize"):
+            Generator(params, V, max_len=T, num_layers=L, num_heads=H,
+                      dim=DIM, batch_size=B, quantize="fp4")
+
+
 @pytest.mark.skipif(jax.device_count() < 4,
                     reason="needs a 4-device mesh")
 class TestMeshDecode:
