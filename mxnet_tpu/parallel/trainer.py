@@ -61,7 +61,8 @@ class TrainStep:
     def __init__(self, symbol, data_names=("data",),
                  label_names=("softmax_label",), optimizer="sgd",
                  optimizer_params=None, mesh=None, donate=True,
-                 compute_dtype=None, remat=None, optimizer_sharding=None):
+                 compute_dtype=None, remat=None, optimizer_sharding=None,
+                 clip_norm=None):
         """compute_dtype: cast params+data to this dtype for fwd/bwd
         (e.g. 'bfloat16' for MXU-rate compute) while master weights,
         gradients, optimizer state and BN statistics stay float32 — the
@@ -81,7 +82,14 @@ class TrainStep:
         (kvstore_dist_server.h:109-433): state memory drops to 1/N per
         chip and the update FLOPs shard with it. Same math as the
         replicated path, equal up to float reduction order (tests
-        assert allclose)."""
+        assert allclose).
+
+        clip_norm: clip gradients by GLOBAL norm before the optimizer
+        (the LM-training standard; the per-element clip_gradient knob
+        on the optimizer still applies inside the fused update). The
+        SPMD counterpart of gluon.utils.clip_global_norm — all grads
+        scale by min(1, clip_norm / ||g||_2) computed over the whole
+        gradient pytree, inside the compiled step."""
         from ..base import env_flag
         self.symbol = symbol
         self.mesh = mesh
@@ -108,6 +116,12 @@ class TrainStep:
                 mesh is None or "data" not in mesh.axis_names):
             raise ValueError("optimizer_sharding='zero1' needs a mesh "
                              "with a 'data' axis to shard over")
+        if clip_norm is not None and not float(clip_norm) > 0:
+            # "not > 0" (rather than "<= 0") also rejects NaN, which
+            # would silently poison every gradient inside the jit
+            raise ValueError("clip_norm must be positive, got %r"
+                             % (clip_norm,))
+        self.clip_norm = None if clip_norm is None else float(clip_norm)
         self.optimizer_sharding = optimizer_sharding
         self._n_state, self._opt_op = _OPT_OPS[optimizer]
         # data inputs that carry token/category ids (feed an Embedding)
@@ -411,6 +425,7 @@ class TrainStep:
         remat = self.remat
         zero1 = self.optimizer_sharding == "zero1"
         id_inputs = self._id_inputs
+        clip_norm = self.clip_norm
         constrain = jax.lax.with_sharding_constraint
 
         def step(params, opt_state, aux, batch, lr, rng):
@@ -456,6 +471,19 @@ class TrainStep:
             # head-grad convention (Executor.backward)
             cot = tuple(jnp.ones_like(o) for o in outs)
             grads = vjp(cot)[0]
+
+            if clip_norm is not None:
+                # bound the EFFECTIVE gradient's global norm (after the
+                # optimizer's rescale_grad, i.e. the per-example mean) —
+                # "clip at 1.0" then means what LM recipes mean by it
+                rescale = float(attrs.get("rescale_grad", 1.0))
+                gnorm = rescale * jnp.sqrt(sum(
+                    jnp.sum(jnp.square(g.astype(jnp.float32)))
+                    for g in grads.values()))
+                gscale = jnp.minimum(1.0, clip_norm /
+                                     jnp.maximum(gnorm, 1e-12))
+                grads = {n: (g * gscale).astype(g.dtype)
+                         for n, g in grads.items()}
 
             new_params, new_opt = {}, {}
             for n in param_names:

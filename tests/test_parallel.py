@@ -202,6 +202,49 @@ def test_train_step_zero1_matches_replicated():
     assert "data" in str(m_after.sharding.spec), m_after.sharding
 
 
+def test_train_step_clip_norm():
+    """clip_norm bounds the effective (rescaled) global gradient norm:
+    one clipped SGD step equals the manual scale-then-update oracle,
+    and a huge threshold is a no-op."""
+    X, y = _toy()
+    B = X.shape[0]
+    clip = 0.05   # small enough to certainly engage on step 1
+    base = dict(optimizer="sgd",
+                optimizer_params={"rescale_grad": 1.0 / B})
+    plain = make_train_step(_mlp(), **base)
+    clipped = make_train_step(_mlp(), clip_norm=clip, **base)
+    loose = make_train_step(_mlp(), clip_norm=1e9, **base)
+
+    state0 = plain.init_state(Xavier(), {"data": X.shape,
+                                         "softmax_label": y.shape})
+    rng = jax.random.PRNGKey(0)
+    batch = plain.place_batch({"data": X, "softmax_label": y})
+    lr = 0.5
+
+    s_plain, _ = plain(jax.tree.map(jnp.copy, state0), batch, lr, rng)
+    s_clip, _ = clipped(jax.tree.map(jnp.copy, state0), batch, lr, rng)
+    s_loose, _ = loose(jax.tree.map(jnp.copy, state0), batch, lr, rng)
+
+    # raw per-param updates recover the rescaled grads; compute the
+    # oracle clip factor from them
+    g = {k: (np.asarray(state0[0][k]) - np.asarray(s_plain[0][k])) / lr
+         for k in state0[0]}
+    gnorm = np.sqrt(sum((v.astype(np.float64) ** 2).sum()
+                        for v in g.values()))
+    assert gnorm > clip   # the test must actually engage the clip
+    factor = clip / gnorm
+    for k in state0[0]:
+        want = np.asarray(state0[0][k]) - lr * factor * g[k]
+        np.testing.assert_allclose(np.asarray(s_clip[0][k]), want,
+                                   rtol=2e-5, atol=1e-6, err_msg=k)
+        np.testing.assert_allclose(np.asarray(s_loose[0][k]),
+                                   np.asarray(s_plain[0][k]),
+                                   rtol=1e-6, atol=1e-7, err_msg=k)
+
+    with pytest.raises(ValueError, match="clip_norm"):
+        make_train_step(_mlp(), clip_norm=0.0, **base)
+
+
 def test_zero1_requires_data_axis():
     with pytest.raises(ValueError):
         make_train_step(_mlp(), optimizer_sharding="zero1")
