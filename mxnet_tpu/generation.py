@@ -64,7 +64,8 @@ class Generator:
         sym = transformer.get_decode_symbol(
             vocab_size, max_len, num_layers=num_layers,
             num_heads=num_heads, dim=dim, ffn_hidden=ffn_hidden,
-            num_experts=num_experts, quantized=quantize is not None)
+            num_experts=num_experts, quantized=quantize is not None,
+            compute_dtype=str(dtype) if dtype else None)
         if quantize:
             arg_params = _quantize_weights(
                 arg_params, sym.list_arguments())

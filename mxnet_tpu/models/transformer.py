@@ -155,7 +155,7 @@ def _decode_attention_block(x, num_heads, dim, prefix, max_len, pos,
 
 def get_decode_symbol(vocab_size, max_len, num_layers=2, num_heads=4,
                       dim=128, ffn_hidden=None, num_experts=0,
-                      quantized=False):
+                      quantized=False, compute_dtype=None):
     """Autoregressive-decode twin of get_symbol.
 
     Inputs: data (B, Tnew) token ids for the tokens being appended
@@ -176,8 +176,15 @@ def get_decode_symbol(vocab_size, max_len, num_layers=2, num_heads=4,
     positions = sym.Variable("positions")
     cache_pos = sym.Variable("cache_pos", shape=(1,))
 
-    x = sym.Embedding(data, input_dim=vocab_size, output_dim=dim,
-                      name="tok_embed")
+    if quantized:
+        # per-row int8 token table (the largest parameter at serving)
+        x = sym.contrib.QuantizedEmbedding(
+            data, input_dim=vocab_size, output_dim=dim,
+            dtype=compute_dtype or "float32",
+            name="tok_embed")
+    else:
+        x = sym.Embedding(data, input_dim=vocab_size, output_dim=dim,
+                          name="tok_embed")
     pos_table = sym.Variable("pos_embed_weight", shape=(max_len, dim))
     pos_vec = sym.take(pos_table, positions)          # (Tnew, dim)
     x = sym.broadcast_add(x, sym.expand_dims(pos_vec, axis=0))

@@ -124,6 +124,24 @@ def _quantized_fc(data, weight, scale, bias=None, num_hidden=0,
     return y
 
 
+@register("_contrib_QuantizedEmbedding",
+          arg_names=("data", "weight", "scale"),
+          differentiable=False,
+          defaults={"input_dim": 0, "output_dim": 0,
+                    "dtype": "float32"})
+def _quantized_embedding(data, weight, scale, dtype="float32", **_):
+    """Weight-only int8 Embedding: weight int8 (V, D) with per-ROW
+    symmetric scales (V,) — a token lookup reads one int8 row and one
+    f32 scalar. Halves the (often largest) parameter's HBM footprint
+    at serving; the gather itself is unchanged. dtype: output dtype
+    (same attr convention as Embedding) so a bf16 compute stream is
+    not silently promoted to f32."""
+    ids = data.astype(jnp.int32)
+    rows = jnp.take(weight, ids, axis=0).astype(jnp.float32)
+    out = rows * jnp.take(scale, ids, axis=0)[..., None]
+    return out.astype(np.dtype(dtype))
+
+
 @register("_contrib_MoEFFN",
           arg_names=("data", "gate_weight", "expert_w1", "expert_w2"),
           aliases=("_contrib_moe_ffn",),

@@ -298,3 +298,17 @@ def _quant_fc_shapes(shapes, attrs):
 
 
 set_param_shapes("_contrib_QuantizedFullyConnected", _quant_fc_shapes)
+
+
+def _quant_embedding_shapes(shapes, attrs):
+    out = list(shapes)
+    vd = (int(attrs.get("input_dim", 0)), int(attrs.get("output_dim",
+                                                        0)))
+    if len(out) > 1 and out[1] is None:
+        out[1] = vd
+    if len(out) > 2 and out[2] is None:
+        out[2] = (vd[0],)
+    return out
+
+
+set_param_shapes("_contrib_QuantizedEmbedding", _quant_embedding_shapes)

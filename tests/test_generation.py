@@ -177,7 +177,9 @@ class TestQuantizedDecode:
                           quantize="int8")
         assert gen_q._params["layer0_qkv_weight"].dtype == jnp.int8
         assert gen_q._params["lm_head_weight"].dtype == jnp.int8
+        assert gen_q._params["tok_embed_weight"].dtype == jnp.int8
         assert "layer0_qkv_scale" in gen_q._params
+        assert "tok_embed_scale" in gen_q._params
 
         prompt = np.array([[1, 2, 3], [4, 5, 6]])
         rng_toks = np.random.RandomState(4).randint(
