@@ -121,7 +121,7 @@ class Generator:
         # cache dtype follows the FLOAT params — under quantize="int8"
         # the dict also holds int8 weights, and an int8 cache would
         # silently truncate k/v (cached_attention casts to cache dtype)
-        cache_dtype = dtype or next(
+        cache_dtype = jnp.dtype(dtype) if dtype else next(
             v.dtype for v in self._params.values()
             if jnp.issubdtype(v.dtype, jnp.floating))
         self._cache_shape = (self.batch_size, num_heads, self.max_len,
@@ -159,6 +159,26 @@ class Generator:
         args["cache_pos"] = jnp.full((1,), pos, jnp.float32)
         outs, new_aux = self._step_fn(args, aux, jax.random.PRNGKey(0))
         return outs[0], new_aux     # logits (B, Tnew, V)
+
+    def log_likelihood(self, tokens):
+        """Teacher-forcing score: per-row sum of log P(t_{i+1} | t_<=i)
+        over the sequence, via one prefill pass. tokens: (B, Tseq) with
+        Tseq <= max_len; returns (B,) float64. The serving-side eval
+        utility (perplexity = exp(-ll / (Tseq - 1)))."""
+        tokens = np.asarray(tokens)
+        if tokens.ndim != 2 or tokens.shape[0] != self.batch_size:
+            raise ValueError("tokens must be (batch_size, T), got %r"
+                             % (tokens.shape,))
+        if tokens.shape[1] > self.max_len:
+            raise ValueError("sequence length %d exceeds max_len=%d"
+                             % (tokens.shape[1], self.max_len))
+        logits, _ = self._forward(self._fresh_aux(), tokens, 0)
+        logp = np.asarray(jax.nn.log_softmax(
+            logits.astype(jnp.float32), axis=-1))     # (B, T, V)
+        nxt = tokens[:, 1:].astype(np.int64)
+        rows = np.arange(self.batch_size)[:, None]
+        cols = np.arange(tokens.shape[1] - 1)[None, :]
+        return logp[rows, cols, nxt].sum(axis=1).astype(np.float64)
 
     def beam_search(self, prompt, max_new_tokens, beam_size=4,
                     length_penalty=0.0, eos_id=None):
@@ -245,7 +265,8 @@ class Generator:
         return np.concatenate([prompt.astype(np.int64), out], axis=1)
 
     def generate_on_device(self, prompt, max_new_tokens,
-                           temperature=0.0, top_k=None, seed=0):
+                           temperature=0.0, top_k=None, top_p=None,
+                           seed=0):
         """Whole-generation-on-device: prefill + a lax.scan over decode
         steps compiled into ONE XLA program — a single dispatch instead
         of one per token (the production-serving shape; through a
@@ -253,19 +274,21 @@ class Generator:
 
         Same sampling semantics as generate() but fixed length (no eos
         early-exit — a scan has static trip count). Each distinct
-        (prompt_len, max_new_tokens, temperature, top_k) tuple compiles
-        once (the sampling knobs are baked into the program)."""
+        (prompt_len, max_new_tokens, temperature, top_k, top_p)
+        tuple compiles once (the sampling knobs are baked into the
+        program)."""
         prompt, P = self._check_prompt(prompt, max_new_tokens)
         toks = self._device_loop(P, int(max_new_tokens),
                                  float(temperature),
-                                 int(top_k) if top_k else 0)(
+                                 int(top_k) if top_k else 0,
+                                 float(top_p) if top_p else 0.0)(
             jnp.asarray(prompt, jnp.float32),
             jax.random.PRNGKey(seed))
         return np.concatenate([prompt.astype(np.int64),
                                np.asarray(toks)], axis=1)
 
-    def _device_loop(self, P, n_steps, temperature, top_k):
-        key_ = (P, n_steps, temperature, top_k)
+    def _device_loop(self, P, n_steps, temperature, top_k, top_p=0.0):
+        key_ = (P, n_steps, temperature, top_k, top_p)
         cached = self._loop_cache.get(key_)
         if cached is not None:
             return cached
@@ -284,7 +307,8 @@ class Generator:
             def body(carry, i):
                 aux, last, key = carry
                 key, sub = jax.random.split(key)
-                tok = _pick_token(last, temperature, top_k, sub)
+                tok = _pick_token(last, temperature, top_k, sub,
+                                  top_p)
                 args = dict(params)
                 args["data"] = tok[:, None].astype(jnp.float32)
                 args["positions"] = jnp.full((1,), P + i, jnp.float32)
@@ -301,7 +325,7 @@ class Generator:
         return fn
 
     def generate(self, prompt, max_new_tokens, temperature=0.0,
-                 top_k=None, eos_id=None, seed=0):
+                 top_k=None, top_p=None, eos_id=None, seed=0):
         """Greedy (temperature 0) or sampled continuation.
 
         prompt: (B, P) int token ids. Returns (B, P + n) ids as numpy
@@ -316,7 +340,8 @@ class Generator:
         last = logits[:, -1]
         for i in range(max_new_tokens):
             key, sub = jax.random.split(key)
-            nxt = np.asarray(_pick_token(last, temperature, top_k, sub))
+            nxt = np.asarray(_pick_token(last, temperature, top_k,
+                                         sub, top_p))
             if eos_id is not None:
                 nxt = np.where(done, eos_id, nxt)
                 done |= nxt == eos_id
@@ -350,7 +375,7 @@ def _quantize_weights(arg_params, decode_args):
     return out
 
 
-def _pick_token(logits, temperature, top_k, key):
+def _pick_token(logits, temperature, top_k, key, top_p=None):
     """logits (B, V) -> (B,) int32, on device."""
     logits = logits.astype(jnp.float32)
     if temperature and float(temperature) > 0:
@@ -360,5 +385,16 @@ def _pick_token(logits, temperature, top_k, key):
             # sits on the per-token decode hot path
             kth = jax.lax.top_k(logits, int(top_k))[0][:, -1:]
             logits = jnp.where(logits < kth, -jnp.inf, logits)
+        if top_p and float(top_p) < 1.0:
+            # nucleus: keep the smallest prefix of descending-prob
+            # tokens whose mass reaches top_p (the first token past the
+            # threshold is included, per the standard formulation)
+            srt = jnp.sort(logits, axis=-1)[:, ::-1]
+            probs = jax.nn.softmax(srt, axis=-1)
+            mass = jnp.cumsum(probs, axis=-1)
+            keep = mass - probs < float(top_p)       # (B, V) on sorted
+            cut = jnp.where(keep, srt, jnp.inf).min(axis=-1,
+                                                    keepdims=True)
+            logits = jnp.where(logits < cut, -jnp.inf, logits)
         return jax.random.categorical(key, logits, axis=-1)
     return jnp.argmax(logits, axis=-1).astype(jnp.int32)

@@ -398,6 +398,57 @@ class TestGenerator:
         assert row[0] == eos
         assert (row == eos).all()   # frozen: eos continues for free
 
+    def test_top_p_sampling(self):
+        """Nucleus sampling: seeded determinism; top_p=tiny degenerates
+        to greedy (only the argmax survives the nucleus)."""
+        _, params = _trained_params()
+        gen = Generator(params, V, max_len=T, num_layers=L,
+                        num_heads=H, dim=DIM, batch_size=B)
+        prompt = np.array([[1, 2, 3], [4, 5, 6]])
+        a = gen.generate(prompt, 5, temperature=1.0, top_p=0.9, seed=3)
+        b = gen.generate(prompt, 5, temperature=1.0, top_p=0.9, seed=3)
+        assert (a == b).all()
+        greedy = gen.generate(prompt, 5)
+        tiny = gen.generate(prompt, 5, temperature=1.0, top_p=1e-9,
+                            seed=11)
+        assert (tiny == greedy).all()
+        dev = gen.generate_on_device(prompt, 5, temperature=1.0,
+                                     top_p=1e-9, seed=11)
+        assert (dev == greedy).all()
+
+    def test_log_likelihood(self):
+        """Scoring matches a hand-rolled teacher-forcing sum, and the
+        greedy continuation scores >= a perturbed one."""
+        _, params = _trained_params()
+        gen = Generator(params, V, max_len=T, num_layers=L,
+                        num_heads=H, dim=DIM, batch_size=B)
+        toks = np.random.RandomState(9).randint(0, V, (B, 8))
+        ll = gen.log_likelihood(toks)
+        logits, _ = gen._forward(gen._fresh_aux(), toks, 0)
+        lp = np.asarray(jax.nn.log_softmax(
+            logits.astype(jnp.float32), -1))
+        want = np.zeros(B)
+        for b_ in range(B):
+            for t in range(7):
+                want[b_] += lp[b_, t, toks[b_, t + 1]]
+        np.testing.assert_allclose(ll, want, rtol=1e-5, atol=1e-5)
+
+        greedy = gen.generate(toks[:, :3], max_new_tokens=5)
+        other = greedy.copy()
+        other[:, -1] = (other[:, -1] + 1) % V
+        assert (gen.log_likelihood(greedy)
+                >= gen.log_likelihood(other) - 1e-6).all()
+
+    def test_bf16_decode(self):
+        _, params = _trained_params()
+        gen = Generator(params, V, max_len=T, num_layers=L,
+                        num_heads=H, dim=DIM, batch_size=B,
+                        dtype="bfloat16")
+        assert gen._cache_dtype == jnp.bfloat16
+        out = gen.generate(np.array([[1, 2], [3, 4]]),
+                           max_new_tokens=4)
+        assert out.shape == (B, 6)
+
     def test_eos_early_stop(self):
         _, params = _trained_params()
         gen = Generator(params, V, max_len=T, num_layers=L,
