@@ -449,6 +449,29 @@ class TestGenerator:
                            max_new_tokens=4)
         assert out.shape == (B, 6)
 
+    def test_checkpoint_roundtrip(self, tmp_path):
+        """save_checkpoint -> load_checkpoint -> Generator: the
+        deployment path the docs promise, end to end."""
+        sym, params = _trained_params()
+        mod = mx.mod.Module(sym, context=mx.cpu(),
+                            label_names=("softmax_label",))
+        mod.bind(data_shapes=[("data", (B, T))],
+                 label_shapes=[("softmax_label", (B, T))])
+        mod.set_params({k: mx.nd.array(np.asarray(
+            getattr(v, "_data", v))) for k, v in params.items()}, {},
+            allow_missing=False)
+        prefix = str(tmp_path / "lm")
+        mod.save_checkpoint(prefix, 1)
+
+        _, arg, _ = mx.model.load_checkpoint(prefix, 1)
+        gen = Generator(arg, V, max_len=T, num_layers=L, num_heads=H,
+                        dim=DIM, batch_size=B)
+        direct = Generator(params, V, max_len=T, num_layers=L,
+                           num_heads=H, dim=DIM, batch_size=B)
+        prompt = np.array([[1, 2, 3], [4, 5, 6]])
+        assert (gen.generate(prompt, 5)
+                == direct.generate(prompt, 5)).all()
+
     def test_eos_early_stop(self):
         _, params = _trained_params()
         gen = Generator(params, V, max_len=T, num_layers=L,
