@@ -236,6 +236,22 @@ class TestMeshDecode:
         qkv = tp._params["layer0_qkv_weight"]
         assert qkv.sharding.spec[0] == "model"
 
+    def test_int8_composes_with_mesh(self):
+        """quantize='int8' + TP mesh: int8 weights shard like float
+        ones and decode still runs."""
+        from jax.sharding import Mesh
+        _, params = _trained_params()
+        mesh = Mesh(np.asarray(jax.devices()[:4]).reshape(2, 2),
+                    ("data", "model"))
+        gen = Generator(params, V, max_len=T, num_layers=L,
+                        num_heads=H, dim=DIM, batch_size=B, mesh=mesh,
+                        quantize="int8")
+        w = gen._params["layer0_qkv_weight"]
+        assert w.dtype == jnp.int8 and w.sharding.spec[0] == "model"
+        out = gen.generate(np.array([[1, 2], [3, 4]]),
+                           max_new_tokens=3)
+        assert out.shape == (B, 5)
+
 
 class TestMoEDecode:
     def test_moe_teacher_forcing_consistency(self):
