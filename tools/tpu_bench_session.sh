@@ -9,6 +9,8 @@
 #   resnet50.json            headline (the BENCH_rN.json payload)
 #   transformer_lm.json      MFU workload
 #   sweep.jsonl              catalog sweep (one line per network)
+#   decode.json / decode_int8.json   KV-cache generation throughput
+#   longcontext.jsonl        4k..32k single-chip context sweep
 #   raw_jax_control.txt      framework-overhead control
 #   trace/ + trace_summary.txt   xplane device-time breakdown
 set -u -o pipefail
@@ -31,6 +33,20 @@ for net in resnet-18 resnet-34 resnet-101 resnet-152 inception-bn \
   echo "-- $net"
   python bench.py --network "$net" | tee -a "$OUT/sweep.jsonl"; note $? "sweep:$net"
 done
+
+echo "== 3b. decode throughput (float + int8) =="
+python bench.py --network transformer_lm --decode | tee "$OUT/decode.json"; note $? decode
+python bench.py --network transformer_lm --decode --quantize int8 \
+    | tee "$OUT/decode_int8.json"; note $? decode_int8
+
+echo "== 3c. long-context sweep (batch 1) =="
+: > "$OUT/longcontext.jsonl"
+for T in 4096 8192 16384; do
+  BENCH_ITERS=10 python bench.py --network transformer_lm --batch 1 \
+      --seq-len "$T" | tee -a "$OUT/longcontext.jsonl"; note $? "lctx:$T"
+done
+BENCH_ITERS=5 python bench.py --network transformer_lm --batch 1 \
+    --seq-len 32768 --remat | tee -a "$OUT/longcontext.jsonl"; note $? lctx:32768
 
 echo "== 4. raw-JAX control =="
 python benchmark/raw_jax_resnet.py | tee "$OUT/raw_jax_control.txt"; note $? raw_jax_control
