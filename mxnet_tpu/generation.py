@@ -49,7 +49,8 @@ class Generator:
 
     def __init__(self, arg_params, vocab_size, max_len, num_layers=2,
                  num_heads=4, dim=128, ffn_hidden=None, batch_size=1,
-                 dtype=None, num_experts=0, mesh=None, quantize=None):
+                 dtype=None, num_experts=0, mesh=None, quantize=None,
+                 pos_encoding="learned"):
         from .parallel import sharding as shd
 
         if quantize not in (None, "int8"):
@@ -65,7 +66,8 @@ class Generator:
             vocab_size, max_len, num_layers=num_layers,
             num_heads=num_heads, dim=dim, ffn_hidden=ffn_hidden,
             num_experts=num_experts, quantized=quantize is not None,
-            compute_dtype=str(dtype) if dtype else None)
+            compute_dtype=str(dtype) if dtype else None,
+            pos_encoding=pos_encoding)
         if quantize:
             arg_params = _quantize_weights(
                 arg_params, sym.list_arguments())
@@ -109,15 +111,16 @@ class Generator:
         if missing:
             raise ValueError("Generator missing parameters: %s"
                              % sorted(missing))
-        pos_rows = self._params["pos_embed_weight"].shape[0]
-        if pos_rows < self.max_len:
-            # the decode symbol's position lookup is take(mode='clip');
-            # without this check, positions past the trained table
-            # would silently reuse its last row
-            raise ValueError(
-                "max_len=%d exceeds the trained position table (%d "
-                "rows) — generation past it would silently clip"
-                % (self.max_len, pos_rows))
+        if pos_encoding == "learned":
+            pos_rows = self._params["pos_embed_weight"].shape[0]
+            if pos_rows < self.max_len:
+                # the decode symbol's position lookup is
+                # take(mode='clip'); without this check, positions past
+                # the trained table would silently reuse its last row
+                raise ValueError(
+                    "max_len=%d exceeds the trained position table "
+                    "(%d rows) — generation past it would silently "
+                    "clip" % (self.max_len, pos_rows))
         # cache dtype follows the FLOAT params — under quantize="int8"
         # the dict also holds int8 weights, and an int8 cache would
         # silently truncate k/v (cached_attention casts to cache dtype)

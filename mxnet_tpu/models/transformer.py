@@ -55,11 +55,17 @@ def _merge_heads_proj(att, dim, prefix, quantized=False):
     return _fc(att, dim, prefix + "proj", quantized)
 
 
-def _attention_block(x, num_heads, dim, prefix, seq_axis=None):
+def _attention_block(x, num_heads, dim, prefix, seq_axis=None,
+                     rope_positions=None):
     """x: (B, T, C) -> (B, T, C); causal flash attention (ring
     attention over ``seq_axis`` when the graph lowers on a mesh
-    carrying that axis)."""
+    carrying that axis). rope_positions: (T,) position-id symbol —
+    when given, q/k rotate (RoPE) instead of the model using a learned
+    position table."""
     q, k, v = _qkv_heads(x, num_heads, dim, prefix)
+    if rope_positions is not None:
+        q = sym.contrib.RoPE(q, rope_positions)
+        k = sym.contrib.RoPE(k, rope_positions)
     att = sym.contrib.FlashAttention(q, k, v,
                                      causal=True, seq_axis=seq_axis,
                                      name=prefix + "attn")
@@ -97,15 +103,27 @@ def _moe_block(x, dim, hidden, num_experts, prefix, expert_axis=None,
                               name=prefix + "moe")
 
 
+def _check_pos_encoding(pos_encoding, dim, num_heads):
+    if pos_encoding not in ("learned", "rope"):
+        raise ValueError("pos_encoding must be 'learned' or 'rope', "
+                         "got %r" % (pos_encoding,))
+    if pos_encoding == "rope" and (dim // num_heads) % 2:
+        # rope rotates half-split pairs; an odd head_dim would fail
+        # deep in lowering with an opaque broadcast error
+        raise ValueError("pos_encoding='rope' needs an even head_dim, "
+                         "got %d" % (dim // num_heads))
+
+
 def _layer_block(x, num_heads, dim, ffn_hidden, prefix, seq_axis=None,
                  num_experts=0, expert_axis=None, dropout=0.0,
-                 moe_capacity_factor=1.25):
+                 moe_capacity_factor=1.25, rope_positions=None):
     """One pre-LN transformer block: attention residual + FFN/MoE
     residual. Shared by the monolithic get_symbol layer loop and the
     pipeline get_stage_symbol so the two can never drift."""
     a = sym.LayerNorm(x, name=prefix + "ln1")
     x = x + _attention_block(a, num_heads, dim, prefix,
-                             seq_axis=seq_axis)
+                             seq_axis=seq_axis,
+                             rope_positions=rope_positions)
     f = sym.LayerNorm(x, name=prefix + "ln2")
     ff = _moe_block(f, dim, ffn_hidden, num_experts, prefix,
                     expert_axis=expert_axis,
@@ -124,29 +142,49 @@ def _layer_block(x, num_heads, dim, ffn_hidden, prefix, seq_axis=None,
 
 
 def get_stage_symbol(num_heads=4, dim=128, ffn_hidden=None,
-                     seq_axis=None):
+                     seq_axis=None, pos_encoding="learned",
+                     seq_len=None):
     """One transformer block as a standalone symbol: data (mb, T, C) ->
     (mb, T, C). The pipeline-parallel stage for
     ``parallel.pipeline_from_symbol`` — stack L layers' params on a
     leading stage dim and stream microbatches through a ``pipe`` mesh
     axis. Pre-LN and aux-free by construction, as the GPipe schedule
-    requires."""
+    requires.
+
+    pos_encoding: "learned" means position information enters BEFORE
+    stage 0 (the embedding+table sum, as get_symbol builds it), so the
+    stage itself is position-free. "rope" must rotate inside EVERY
+    attention layer, so a rope stage needs ``seq_len`` to build its
+    positions."""
     ffn_hidden = ffn_hidden or 4 * dim
     if dim % num_heads:
         raise ValueError("dim (%d) must be divisible by num_heads (%d)"
                          % (dim, num_heads))
+    _check_pos_encoding(pos_encoding, dim, num_heads)
+    rope_positions = None
+    if pos_encoding == "rope":
+        if not seq_len:
+            raise ValueError("pos_encoding='rope' stages need seq_len "
+                             "(RoPE applies inside each layer)")
+        rope_positions = sym.arange(start=0, stop=seq_len)
     return _layer_block(sym.Variable("data"), num_heads, dim,
-                        ffn_hidden, "", seq_axis=seq_axis)
+                        ffn_hidden, "", seq_axis=seq_axis,
+                        rope_positions=rope_positions)
 
 
 def _decode_attention_block(x, num_heads, dim, prefix, max_len, pos,
-                            quantized=False):
+                            quantized=False, rope_positions=None):
     """Incremental variant of _attention_block: identical qkv/proj
     helpers (a training checkpoint binds unchanged), attention routed
     through _contrib_CachedAttention with per-layer k/v cache aux
     states ("<prefix>attn_k_cache"/"_v_cache", created by the op's
     state_inputs registration)."""
     q, k, v = _qkv_heads(x, num_heads, dim, prefix, quantized)
+    if rope_positions is not None:
+        # rotate BEFORE caching: cached keys carry their absolute
+        # rotation, so each step only rotates the new tokens
+        q = sym.contrib.RoPE(q, rope_positions)
+        k = sym.contrib.RoPE(k, rope_positions)
     att = sym.contrib.CachedAttention(q, k, v,
                                       pos=pos, max_len=max_len,
                                       name=prefix + "attn")
@@ -155,7 +193,8 @@ def _decode_attention_block(x, num_heads, dim, prefix, max_len, pos,
 
 def get_decode_symbol(vocab_size, max_len, num_layers=2, num_heads=4,
                       dim=128, ffn_hidden=None, num_experts=0,
-                      quantized=False, compute_dtype=None):
+                      quantized=False, compute_dtype=None,
+                      pos_encoding="learned"):
     """Autoregressive-decode twin of get_symbol.
 
     Inputs: data (B, Tnew) token ids for the tokens being appended
@@ -185,16 +224,25 @@ def get_decode_symbol(vocab_size, max_len, num_layers=2, num_heads=4,
     else:
         x = sym.Embedding(data, input_dim=vocab_size, output_dim=dim,
                           name="tok_embed")
-    pos_table = sym.Variable("pos_embed_weight", shape=(max_len, dim))
-    pos_vec = sym.take(pos_table, positions)          # (Tnew, dim)
-    x = sym.broadcast_add(x, sym.expand_dims(pos_vec, axis=0))
+    rope_positions = None
+    if pos_encoding == "rope":
+        rope_positions = positions
+    elif pos_encoding == "learned":
+        pos_table = sym.Variable("pos_embed_weight",
+                                 shape=(max_len, dim))
+        pos_vec = sym.take(pos_table, positions)      # (Tnew, dim)
+        x = sym.broadcast_add(x, sym.expand_dims(pos_vec, axis=0))
+    else:
+        raise ValueError("pos_encoding must be 'learned' or 'rope', "
+                         "got %r" % (pos_encoding,))
 
     for i in range(num_layers):
         prefix = "layer%d_" % i
         a = sym.LayerNorm(x, name=prefix + "ln1")
         x = x + _decode_attention_block(a, num_heads, dim, prefix,
                                         max_len, cache_pos,
-                                        quantized=quantized)
+                                        quantized=quantized,
+                                        rope_positions=rope_positions)
         f = sym.LayerNorm(x, name=prefix + "ln2")
         # inference never capacity-drops: every token is served, so
         # the factor is raised to E (cap == token count). Training-time
@@ -214,7 +262,7 @@ def get_decode_symbol(vocab_size, max_len, num_layers=2, num_heads=4,
 def get_symbol(vocab_size, seq_len, num_layers=2, num_heads=4, dim=128,
                ffn_hidden=None, dropout=0.0, max_len=None,
                num_experts=0, seq_axis=None, expert_axis=None,
-               moe_capacity_factor=1.25):
+               moe_capacity_factor=1.25, pos_encoding="learned"):
     """GPT-style causal LM symbol.
 
     data: (B, T) token ids; softmax_label: (B, T) next-token targets
@@ -238,6 +286,11 @@ def get_symbol(vocab_size, seq_len, num_layers=2, num_heads=4, dim=128,
 
     expert_axis: same contract for the MoE FFNs (num_experts > 0):
     experts shard over the axis and tokens exchange via all_to_all.
+
+    pos_encoding: "learned" (the pos_embed table, max_len-capped) or
+    "rope" — rotary embeddings applied to q/k inside every attention
+    layer (no position parameters, graceful length extrapolation; the
+    modern long-context choice).
     """
     ffn_hidden = ffn_hidden or 4 * dim
     max_len = max_len or seq_len
@@ -245,21 +298,28 @@ def get_symbol(vocab_size, seq_len, num_layers=2, num_heads=4, dim=128,
     if dim % num_heads:
         raise ValueError("dim (%d) must be divisible by num_heads (%d)"
                          % (dim, num_heads))
+    _check_pos_encoding(pos_encoding, dim, num_heads)
     data = sym.Variable("data")
     label = sym.Variable("softmax_label")
 
     x = sym.Embedding(data, input_dim=vocab_size, output_dim=dim,
                       name="tok_embed")
-    pos_table = sym.Variable("pos_embed_weight", shape=(max_len, dim))
-    pos = sym.slice_axis(pos_table, axis=0, begin=0, end=seq_len)
-    x = sym.broadcast_add(x, sym.expand_dims(pos, axis=0))
+    rope_positions = None
+    if pos_encoding == "rope":
+        rope_positions = sym.arange(start=0, stop=seq_len)
+    else:
+        pos_table = sym.Variable("pos_embed_weight",
+                                 shape=(max_len, dim))
+        pos = sym.slice_axis(pos_table, axis=0, begin=0, end=seq_len)
+        x = sym.broadcast_add(x, sym.expand_dims(pos, axis=0))
 
     for i in range(num_layers):
         x = _layer_block(x, num_heads, dim, ffn_hidden,
                          "layer%d_" % i, seq_axis=seq_axis,
                          num_experts=num_experts,
                          expert_axis=expert_axis, dropout=dropout,
-                         moe_capacity_factor=moe_capacity_factor)
+                         moe_capacity_factor=moe_capacity_factor,
+                         rope_positions=rope_positions)
 
     x = sym.LayerNorm(x, name="ln_f")
     logits = sym.FullyConnected(x, num_hidden=vocab_size, flatten=False,

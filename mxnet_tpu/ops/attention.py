@@ -600,6 +600,38 @@ def cached_attention(query, key, value, k_cache, v_cache, pos,
     return out.astype(query.dtype), k_cache, v_cache
 
 
+def rope(x, positions, base=10000.0):
+    """Rotary position embedding over (B, H, T, hd).
+
+    positions: (T,) absolute position ids. HALF-SPLIT pairing (GPT
+    -NeoX convention): (x[i], x[i+hd/2]) rotate together by
+    pos * base^(-2i/hd) — NOT the interleaved (x[2i], x[2i+1])
+    RoFormer/LLaMA layout; checkpoints crossing implementations must
+    repack. Relative-position attention with no learned table and
+    graceful length extrapolation (RoFormer, Su et al. 2021). Applied
+    to q AND k before attention; cached keys are stored rotated, so
+    incremental decode needs only the new tokens' positions."""
+    B, H, T, D = x.shape
+    half = D // 2
+    freqs = jnp.power(
+        float(base), -jnp.arange(0, half, dtype=jnp.float32) / half)
+    ang = positions.astype(jnp.float32)[:, None] * freqs[None, :]
+    cos = jnp.cos(ang)[None, None]            # (1, 1, T, half)
+    sin = jnp.sin(ang)[None, None]
+    x1 = x[..., :half].astype(jnp.float32)
+    x2 = x[..., half:].astype(jnp.float32)
+    out = jnp.concatenate([x1 * cos - x2 * sin,
+                           x1 * sin + x2 * cos], axis=-1)
+    return out.astype(x.dtype)
+
+
+@register("_contrib_RoPE", arg_names=("data", "positions"),
+          nondiff_inputs=(1,), defaults={"base": 10000.0})
+def _rope_op(data, positions, base=10000.0, **_):
+    """(B, H, T, hd) rotary position embedding; positions (T,)."""
+    return rope(data, positions, base=float(base))
+
+
 @register("_contrib_CachedAttention",
           arg_names=("query", "key", "value", "k_cache", "v_cache",
                      "pos"),

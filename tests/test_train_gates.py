@@ -115,10 +115,11 @@ def test_lstm_lm_perplexity_gate():
     assert last < 8.0, last
 
 
-def test_transformer_lm_loss_gate():
+@pytest.mark.parametrize("pos_encoding", ["learned", "rope"])
+def test_transformer_lm_loss_gate(pos_encoding):
     """Seeded transformer LM: NLL must drop below half its initial
     value within 30 steps (flagship long-context family; reference
-    pattern tests/python/train gates)."""
+    pattern tests/python/train gates). Both position encodings gate."""
     from mxnet_tpu.models import transformer
 
     from tests._lm_utils import arith_corpus, lm_nll
@@ -127,7 +128,7 @@ def test_transformer_lm_loss_gate():
     toks, labels = arith_corpus(B, T, vocab)
 
     sym = transformer.get_symbol(vocab, T, num_layers=1, num_heads=2,
-                                 dim=32)
+                                 dim=32, pos_encoding=pos_encoding)
     step = make_train_step(sym, optimizer="adam")
     mx.random.seed(11)
     np.random.seed(11)
