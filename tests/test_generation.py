@@ -336,6 +336,20 @@ class TestMeshDecode:
         qkv = tp._params["layer0_qkv_weight"]
         assert qkv.sharding.spec[0] == "model"
 
+    def test_on_device_loop_under_mesh(self):
+        """The whole-generation lax.scan program also runs with TP
+        sharded params + caches and matches the host loop."""
+        from jax.sharding import Mesh
+        _, params = _trained_params()
+        mesh = Mesh(np.asarray(jax.devices()[:4]).reshape(2, 2),
+                    ("data", "model"))
+        tp = Generator(params, V, max_len=T, num_layers=L,
+                       num_heads=H, dim=DIM, batch_size=B, mesh=mesh)
+        prompt = np.array([[1, 2, 3], [4, 5, 6]])
+        host = tp.generate(prompt, max_new_tokens=5)
+        dev = tp.generate_on_device(prompt, max_new_tokens=5)
+        assert (host == dev).all()
+
     def test_int8_composes_with_mesh(self):
         """quantize='int8' + TP mesh: int8 weights shard like float
         ones and decode still runs."""
