@@ -267,6 +267,95 @@ class Generator:
         out = tokens[np.arange(B), best]               # (B, gen_len)
         return np.concatenate([prompt.astype(np.int64), out], axis=1)
 
+    def generate_speculative(self, draft, prompt, max_new_tokens,
+                             lookahead=4):
+        """Greedy speculative decoding: a small `draft` Generator
+        proposes `lookahead` tokens per round; this (target) model
+        verifies them in ONE forward and keeps the longest greedy-
+        matching prefix plus its own next token. Output is EXACTLY
+        this model's greedy continuation — the draft only changes how
+        many target forwards it takes (classic speculative decoding,
+        greedy acceptance).
+
+        Cache rollback is free by construction: `_contrib_
+        CachedAttention` writes at `cache_pos` and masks columns
+        beyond `pos + row`, so rejected speculative entries are simply
+        overwritten by the next append and can never be attended.
+
+        Exactness caveat: "exactly greedy" holds up to XLA kernel
+        numerics — the chunked verify forward (Tnew = lookahead+1) and
+        the one-token decode forward may differ at the last ulp, so a
+        near-exact logit TIE can in principle resolve differently than
+        generate() would. Irrelevant for real sampling temperatures
+        and not observed in tests; noted for bit-exactness audits.
+
+        draft: a Generator with the same vocab/batch (typically fewer
+        layers/dims). Returns (B, P + max_new_tokens) ids. Batch rows
+        advance in lockstep (the accepted length each round is the
+        minimum across rows), so batching still helps only with
+        similar acceptance; B=1 is the classic setting."""
+        if draft.vocab_size != self.vocab_size or \
+                draft.batch_size != self.batch_size:
+            raise ValueError("draft must share vocab_size/batch_size "
+                             "with the target")
+        prompt, P = self._check_prompt(prompt, max_new_tokens)
+        if P + max_new_tokens > draft.max_len:
+            raise ValueError("draft max_len=%d too small for %d tokens"
+                             % (draft.max_len, P + max_new_tokens))
+        gamma = max(1, int(lookahead))
+
+        # invariant: before each round, both caches hold a VALID prefix
+        # covering [0, len(out) - 1) — every round's feeds start at
+        # position len(out) - 1 and overwrite any stale speculative
+        # entries beyond the accepted boundary
+        t_aux = self._fresh_aux()
+        d_aux = draft._fresh_aux()
+        if P > 1:
+            _, t_aux = self._forward(t_aux, prompt[:, :P - 1], 0)
+            _, d_aux = draft._forward(d_aux, prompt[:, :P - 1], 0)
+        out = prompt.astype(np.int64)
+
+        while out.shape[1] - P < max_new_tokens:
+            pos = out.shape[1]
+            budget = max_new_tokens - (pos - P)
+            g = min(gamma, budget - 1)      # leave room for the bonus
+            # draft proposes g tokens, continuing from the last emitted
+            cur = out[:, -1]
+            props = []
+            for i in range(g):
+                dl, d_aux = draft._forward(d_aux, cur[:, None],
+                                           pos - 1 + i)
+                cur = np.asarray(jnp.argmax(dl[:, -1], axis=-1))
+                props.append(cur)
+            # ONE target forward scores last_emitted + all proposals:
+            # tokens at positions pos-1 .. pos+g-1, logits predicting
+            # positions pos .. pos+g
+            chunk = np.concatenate(
+                [out[:, -1:]] + [p[:, None] for p in props], axis=1)
+            tl, t_aux = self._forward(t_aux, chunk, pos - 1)
+            greedy = np.asarray(jnp.argmax(tl, axis=-1))  # (B, g+1)
+            # accept while the draft token at pos+i matches the target
+            # greedy prediction for pos+i; lockstep across the batch
+            acc = 0
+            while acc < g and bool(
+                    (props[acc] == greedy[:, acc]).all()):
+                acc += 1
+            # emit the accepted draft tokens + the target's own next
+            # token (correctly conditioned: its inputs are the accepted
+            # prefix) — every emitted token is exactly target-greedy
+            emit = np.stack(props[:acc] + [greedy[:, acc]], axis=1)
+            out = np.concatenate([out, emit], axis=1)
+            if acc == g and g > 0 and \
+                    out.shape[1] - P < max_new_tokens:
+                # full acceptance: the draft never ingested its own
+                # last proposal's k/v (its loop stops after computing
+                # it) — feed it so the invariant holds next round
+                # (skipped when the budget is exhausted: one whole
+                # dispatch saved on the final round)
+                _, d_aux = draft._forward(d_aux, props[-1][:, None],
+                                          pos + g - 1)
+        return out[:, :P + max_new_tokens]
+
     def generate_on_device(self, prompt, max_new_tokens,
                            temperature=0.0, top_k=None, top_p=None,
                            seed=0):

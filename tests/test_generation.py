@@ -27,20 +27,11 @@ def _trained_params(seed=0):
     sym = transformer.get_symbol(V, T, num_layers=L, num_heads=H,
                                  dim=DIM)
     step = make_train_step(sym, optimizer="sgd")
-    with mx.random.seed_scope(seed) if hasattr(
-            mx.random, "seed_scope") else _noop():
-        state = step.init_state(Xavier(),
-                                {"data": (B, T),
-                                 "softmax_label": (B, T)})
+    mx.random.seed(seed)      # distinct seeds -> genuinely distinct
+    state = step.init_state(Xavier(),
+                            {"data": (B, T),
+                             "softmax_label": (B, T)})
     return sym, state[0]
-
-
-class _noop:
-    def __enter__(self):
-        return self
-
-    def __exit__(self, *a):
-        return False
 
 
 class TestCachedAttentionOp:
@@ -601,6 +592,59 @@ class TestGenerator:
         prompt = np.array([[1, 2, 3], [4, 5, 6]])
         assert (gen.generate(prompt, 5)
                 == direct.generate(prompt, 5)).all()
+
+    @pytest.mark.parametrize("lookahead", [1, 3, 5])
+    def test_speculative_equals_greedy(self, lookahead):
+        """Speculative output must be EXACTLY the target's greedy
+        continuation, for any draft: a weak draft (different seed),
+        a perfect draft (the target itself), across lookaheads."""
+        _, params = _trained_params()
+        target = Generator(params, V, max_len=T, num_layers=L,
+                           num_heads=H, dim=DIM, batch_size=B)
+        _, params2 = _trained_params(seed=1)
+        weak = Generator(params2, V, max_len=T, num_layers=L,
+                         num_heads=H, dim=DIM, batch_size=B)
+        perfect = Generator(params, V, max_len=T, num_layers=L,
+                            num_heads=H, dim=DIM, batch_size=B)
+        prompt = np.array([[1, 2, 3], [4, 5, 6]])
+        want = target.generate(prompt, max_new_tokens=9)
+        for draft in (weak, perfect):
+            got = target.generate_speculative(
+                draft, prompt, max_new_tokens=9, lookahead=lookahead)
+            assert (got == want).all(), (lookahead, got, want)
+
+    def test_speculative_perfect_draft_efficiency(self):
+        """A perfect draft (the target itself) must accept every
+        proposal: ceil(N / (lookahead+1)) verification forwards. This
+        is the test that catches draft-cache staleness — a corrupted
+        draft cache degrades acceptance, not output."""
+        _, params = _trained_params()
+        target = Generator(params, V, max_len=T, num_layers=L,
+                           num_heads=H, dim=DIM, batch_size=B)
+        perfect = Generator(params, V, max_len=T, num_layers=L,
+                            num_heads=H, dim=DIM, batch_size=B)
+        calls = {"target": 0}
+        orig = target._forward
+
+        def counting(aux, tokens, pos):
+            calls["target"] += 1
+            return orig(aux, tokens, pos)
+
+        target._forward = counting
+        prompt = np.array([[1, 2, 3], [4, 5, 6]])
+        target.generate_speculative(perfect, prompt,
+                                    max_new_tokens=8, lookahead=3)
+        # 1 prefill + ceil(8/4)=2 verification rounds
+        assert calls["target"] == 3, calls
+
+    def test_speculative_validation(self):
+        _, params = _trained_params()
+        target = Generator(params, V, max_len=T, num_layers=L,
+                           num_heads=H, dim=DIM, batch_size=B)
+        small = Generator(params, V, max_len=4, num_layers=L,
+                          num_heads=H, dim=DIM, batch_size=B)
+        with pytest.raises(ValueError, match="draft max_len"):
+            target.generate_speculative(small, np.zeros((B, 2)), 6)
 
     def test_eos_early_stop(self):
         _, params = _trained_params()
