@@ -50,7 +50,7 @@ class Generator:
     def __init__(self, arg_params, vocab_size, max_len, num_layers=2,
                  num_heads=4, dim=128, ffn_hidden=None, batch_size=1,
                  dtype=None, num_experts=0, mesh=None, quantize=None,
-                 pos_encoding="learned"):
+                 pos_encoding="learned", attention_window=0):
         from .parallel import sharding as shd
 
         if quantize not in (None, "int8"):
@@ -67,7 +67,8 @@ class Generator:
             num_heads=num_heads, dim=dim, ffn_hidden=ffn_hidden,
             num_experts=num_experts, quantized=quantize is not None,
             compute_dtype=str(dtype) if dtype else None,
-            pos_encoding=pos_encoding)
+            pos_encoding=pos_encoding,
+            attention_window=attention_window)
         if quantize:
             arg_params = _quantize_weights(
                 arg_params, sym.list_arguments())

@@ -56,7 +56,7 @@ def _merge_heads_proj(att, dim, prefix, quantized=False):
 
 
 def _attention_block(x, num_heads, dim, prefix, seq_axis=None,
-                     rope_positions=None):
+                     rope_positions=None, window=0):
     """x: (B, T, C) -> (B, T, C); causal flash attention (ring
     attention over ``seq_axis`` when the graph lowers on a mesh
     carrying that axis). rope_positions: (T,) position-id symbol —
@@ -68,6 +68,7 @@ def _attention_block(x, num_heads, dim, prefix, seq_axis=None,
         k = sym.contrib.RoPE(k, rope_positions)
     att = sym.contrib.FlashAttention(q, k, v,
                                      causal=True, seq_axis=seq_axis,
+                                     window=window,
                                      name=prefix + "attn")
     return _merge_heads_proj(att, dim, prefix)
 
@@ -116,14 +117,16 @@ def _check_pos_encoding(pos_encoding, dim, num_heads):
 
 def _layer_block(x, num_heads, dim, ffn_hidden, prefix, seq_axis=None,
                  num_experts=0, expert_axis=None, dropout=0.0,
-                 moe_capacity_factor=1.25, rope_positions=None):
+                 moe_capacity_factor=1.25, rope_positions=None,
+                 window=0):
     """One pre-LN transformer block: attention residual + FFN/MoE
     residual. Shared by the monolithic get_symbol layer loop and the
     pipeline get_stage_symbol so the two can never drift."""
     a = sym.LayerNorm(x, name=prefix + "ln1")
     x = x + _attention_block(a, num_heads, dim, prefix,
                              seq_axis=seq_axis,
-                             rope_positions=rope_positions)
+                             rope_positions=rope_positions,
+                             window=window)
     f = sym.LayerNorm(x, name=prefix + "ln2")
     ff = _moe_block(f, dim, ffn_hidden, num_experts, prefix,
                     expert_axis=expert_axis,
@@ -143,7 +146,7 @@ def _layer_block(x, num_heads, dim, ffn_hidden, prefix, seq_axis=None,
 
 def get_stage_symbol(num_heads=4, dim=128, ffn_hidden=None,
                      seq_axis=None, pos_encoding="learned",
-                     seq_len=None):
+                     seq_len=None, attention_window=0):
     """One transformer block as a standalone symbol: data (mb, T, C) ->
     (mb, T, C). The pipeline-parallel stage for
     ``parallel.pipeline_from_symbol`` — stack L layers' params on a
@@ -169,11 +172,13 @@ def get_stage_symbol(num_heads=4, dim=128, ffn_hidden=None,
         rope_positions = sym.arange(start=0, stop=seq_len)
     return _layer_block(sym.Variable("data"), num_heads, dim,
                         ffn_hidden, "", seq_axis=seq_axis,
-                        rope_positions=rope_positions)
+                        rope_positions=rope_positions,
+                        window=attention_window)
 
 
 def _decode_attention_block(x, num_heads, dim, prefix, max_len, pos,
-                            quantized=False, rope_positions=None):
+                            quantized=False, rope_positions=None,
+                            window=0):
     """Incremental variant of _attention_block: identical qkv/proj
     helpers (a training checkpoint binds unchanged), attention routed
     through _contrib_CachedAttention with per-layer k/v cache aux
@@ -187,6 +192,7 @@ def _decode_attention_block(x, num_heads, dim, prefix, max_len, pos,
         k = sym.contrib.RoPE(k, rope_positions)
     att = sym.contrib.CachedAttention(q, k, v,
                                       pos=pos, max_len=max_len,
+                                      window=window,
                                       name=prefix + "attn")
     return _merge_heads_proj(att, dim, prefix, quantized)
 
@@ -194,7 +200,7 @@ def _decode_attention_block(x, num_heads, dim, prefix, max_len, pos,
 def get_decode_symbol(vocab_size, max_len, num_layers=2, num_heads=4,
                       dim=128, ffn_hidden=None, num_experts=0,
                       quantized=False, compute_dtype=None,
-                      pos_encoding="learned"):
+                      pos_encoding="learned", attention_window=0):
     """Autoregressive-decode twin of get_symbol.
 
     Inputs: data (B, Tnew) token ids for the tokens being appended
@@ -242,7 +248,8 @@ def get_decode_symbol(vocab_size, max_len, num_layers=2, num_heads=4,
         x = x + _decode_attention_block(a, num_heads, dim, prefix,
                                         max_len, cache_pos,
                                         quantized=quantized,
-                                        rope_positions=rope_positions)
+                                        rope_positions=rope_positions,
+                                        window=attention_window)
         f = sym.LayerNorm(x, name=prefix + "ln2")
         # inference never capacity-drops: every token is served, so
         # the factor is raised to E (cap == token count). Training-time
@@ -262,7 +269,8 @@ def get_decode_symbol(vocab_size, max_len, num_layers=2, num_heads=4,
 def get_symbol(vocab_size, seq_len, num_layers=2, num_heads=4, dim=128,
                ffn_hidden=None, dropout=0.0, max_len=None,
                num_experts=0, seq_axis=None, expert_axis=None,
-               moe_capacity_factor=1.25, pos_encoding="learned"):
+               moe_capacity_factor=1.25, pos_encoding="learned",
+               attention_window=0):
     """GPT-style causal LM symbol.
 
     data: (B, T) token ids; softmax_label: (B, T) next-token targets
@@ -319,7 +327,8 @@ def get_symbol(vocab_size, seq_len, num_layers=2, num_heads=4, dim=128,
                          num_experts=num_experts,
                          expert_axis=expert_axis, dropout=dropout,
                          moe_capacity_factor=moe_capacity_factor,
-                         rope_positions=rope_positions)
+                         rope_positions=rope_positions,
+                         window=attention_window)
 
     x = sym.LayerNorm(x, name="ln_f")
     logits = sym.FullyConnected(x, num_hidden=vocab_size, flatten=False,

@@ -37,7 +37,7 @@ _NEG_INF = -1e30
 
 def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, *rest,
                       scale, causal, block_q, block_k, num_kb, seq_k,
-                      want_lse):
+                      want_lse, window=0):
     # the lse output only exists under differentiation (want_lse);
     # forward-only calls skip its ~BH*T*128 f32 HBM writes entirely
     if want_lse:
@@ -73,7 +73,7 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, *rest,
         if causal:
             rows = qb * block_q + jax.lax.broadcasted_iota(
                 jnp.int32, s.shape, 0)
-            valid = valid & (rows >= cols)
+            valid = _band_valid(valid, rows, cols, window)
         s = jnp.where(valid, s, _NEG_INF)
         m_prev = m_ref[:]
         m_new = jnp.maximum(m_prev, s.max(axis=1, keepdims=True))
@@ -90,8 +90,9 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, *rest,
         m_ref[:] = m_new
 
     if causal:
-        # whole block above the diagonal: skip (saves ~half the FLOPs)
-        pl.when(qb * block_q + block_q - 1 >= kb * block_k)(_block)
+        # whole block outside the band: skip (half the FLOPs for plain
+        # causal; O(T*window) total with a window)
+        pl.when(_band_run(qb, kb, block_q, block_k, window))(_block)
     else:
         _block()
 
@@ -109,6 +110,27 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, *rest,
         if want_lse:
             lse_ref[0] = jnp.broadcast_to(m_ref[:] + jnp.log(denom),
                                           lse_ref.shape[1:])
+
+
+
+
+def _band_valid(valid, rows, cols, window):
+    """Causal + optional sliding-window mask shared by all kernels."""
+    valid = valid & (rows >= cols)
+    if window:
+        valid = valid & (rows - cols < window)
+    return valid
+
+
+def _band_run(qb, kb, block_q, block_k, window):
+    """Block participates iff the (q-block x k-block) rectangle meets
+    the causal band: below-or-on diagonal, and (with a window) not
+    entirely below it. Shared by the fwd/dq/dkv kernels."""
+    run = qb * block_q + block_q - 1 >= kb * block_k
+    if window:
+        run = run & (kb * block_k + block_k - 1
+                     > qb * block_q - window)
+    return run
 
 
 _LANES = 128   # minor-dim replication for per-row stats
@@ -130,7 +152,7 @@ def _snap_blocks(T, Tk, block_q, block_k, interpret):
 
 
 def _flash_forward(q, k, v, scale, causal, block_q, block_k, interpret,
-                   want_lse):
+                   want_lse, window=0):
     q, k, v = _uniform_vma(q, k, v)
     BH, T, D = q.shape
     Tk = k.shape[1]
@@ -140,7 +162,8 @@ def _flash_forward(q, k, v, scale, causal, block_q, block_k, interpret,
 
     kernel = functools.partial(
         _flash_fwd_kernel, scale=scale, causal=causal, block_q=block_q,
-        block_k=block_k, num_kb=nk, seq_k=Tk, want_lse=want_lse)
+        block_k=block_k, num_kb=nk, seq_k=Tk, want_lse=want_lse,
+        window=window)
     shapes = [jax.ShapeDtypeStruct(q.shape, q.dtype)]              # o
     out_specs = [pl.BlockSpec((1, block_q, D),
                               lambda b, i, j: (b, i, 0))]
@@ -213,14 +236,18 @@ def _uniform_vma(*operands):
         for x, v in zip(operands, vmas))
 
 
-def _dense_with_lse(q, k, v, scale, causal):
+def _dense_with_lse(q, k, v, scale, causal, window=0):
     """Dense (o, lse) oracle — the single implementation behind
     _attn_reference and the interpret-mode fallbacks."""
     s = jnp.einsum("bqd,bkd->bqk", q, k,
                    preferred_element_type=jnp.float32) * scale
     if causal:
         T, Tk = s.shape[-2], s.shape[-1]
-        mask = jnp.arange(T)[:, None] >= jnp.arange(Tk)[None, :]
+        rows = jnp.arange(T)[:, None]
+        cols = jnp.arange(Tk)[None, :]
+        mask = rows >= cols
+        if window:
+            mask = mask & (rows - cols < window)
         s = jnp.where(mask, s, _NEG_INF)
     lse = jax.scipy.special.logsumexp(s, axis=-1)
     p = jnp.exp(s - lse[..., None])
@@ -244,7 +271,7 @@ def _masked_block(ref, rows_base, limit, block_rows):
 
 def _flash_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                      dq_ref, dq_acc, *, scale, causal, block_q, block_k,
-                     num_kb, seq_q, seq_k):
+                     num_kb, seq_q, seq_k, window=0):
     qb, kb = pl.program_id(1), pl.program_id(2)
 
     @pl.when(kb == 0)
@@ -268,7 +295,7 @@ def _flash_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         if causal:
             rows = qb * block_q + jax.lax.broadcasted_iota(
                 jnp.int32, s.shape, 0)
-            valid = valid & (rows >= cols)
+            valid = _band_valid(valid, rows, cols, window)
         p = jnp.where(valid, jnp.exp(s - lse), 0)       # (bq, bk)
         dp = jax.lax.dot_general(
             do, v, (((1,), (1,)), ((), ())),
@@ -284,7 +311,7 @@ def _flash_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             preferred_element_type=jnp.float32)
 
     if causal:
-        pl.when(qb * block_q + block_q - 1 >= kb * block_k)(_block)
+        pl.when(_band_run(qb, kb, block_q, block_k, window))(_block)
     else:
         _block()
 
@@ -296,7 +323,7 @@ def _flash_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 def _flash_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                       dk_ref, dv_ref, dk_acc, dv_acc,
                       *, scale, causal, block_q, block_k, num_qb,
-                      seq_q, seq_k):
+                      seq_q, seq_k, window=0):
     kb, qb = pl.program_id(1), pl.program_id(2)
 
     @pl.when(qb == 0)
@@ -321,7 +348,7 @@ def _flash_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         if causal:
             cols = kb * block_k + jax.lax.broadcasted_iota(
                 jnp.int32, s.shape, 1)
-            valid = valid & (rows >= cols)
+            valid = _band_valid(valid, rows, cols, window)
         p = jnp.where(valid, jnp.exp(s - lse), 0)
         dv_acc[:] += jax.lax.dot_general(
             p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
@@ -340,8 +367,8 @@ def _flash_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             preferred_element_type=jnp.float32)          # (bk, d)
 
     if causal:
-        # k block entirely above every q row in this block: contributes 0
-        pl.when(qb * block_q + block_q - 1 >= kb * block_k)(_block)
+        # k block outside the band contributes 0
+        pl.when(_band_run(qb, kb, block_q, block_k, window))(_block)
     else:
         _block()
 
@@ -352,7 +379,7 @@ def _flash_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
 
 def _flash_backward(q, k, v, o, lse, do, scale, causal, block_q,
-                    block_k, interpret, dlse=None):
+                    block_k, interpret, dlse=None, window=0):
     if dlse is None:
         q, k, v, o, lse, do = _uniform_vma(q, k, v, o, lse, do)
     else:
@@ -387,7 +414,7 @@ def _flash_backward(q, k, v, o, lse, do, scale, causal, block_q,
         functools.partial(
             _flash_dq_kernel, scale=scale, causal=causal,
             block_q=block_q, block_k=block_k, num_kb=nk,
-            seq_q=T, seq_k=Tk),
+            seq_q=T, seq_k=Tk, window=window),
         grid=(BH, nq, nk),
         in_specs=[q_spec, k_spec, k_spec, q_spec, r_spec, r_spec],
         out_specs=q_spec,
@@ -408,7 +435,7 @@ def _flash_backward(q, k, v, o, lse, do, scale, causal, block_q,
         functools.partial(
             _flash_dkv_kernel, scale=scale, causal=causal,
             block_q=block_q, block_k=block_k, num_qb=nq,
-            seq_q=T, seq_k=Tk),
+            seq_q=T, seq_k=Tk, window=window),
         grid=(BH, nk, nq),
         in_specs=[q_spec2, k_spec2, k_spec2, q_spec2, r_spec2, r_spec2],
         out_specs=[k_spec2, k_spec2],
@@ -420,7 +447,7 @@ def _flash_backward(q, k, v, o, lse, do, scale, causal, block_q,
     return dq, dk, dv
 
 
-def _dense_fallback(q, k, v, scale, causal):
+def _dense_fallback(q, k, v, scale, causal, window=0):
     """Pallas's interpret mode cannot execute with mesh-varying
     operands (its internal block loads mix varying data with replicated
     grid indices, tripping shard_map's vma check). Compiled TPU
@@ -428,7 +455,8 @@ def _dense_fallback(q, k, v, scale, causal):
     CPU-mesh test path takes this dense recompute, wrapped in
     checkpoint so strips rematerialize instead of caching (T, T)."""
     return jax.checkpoint(
-        lambda a, b, c: _attn_reference(a, b, c, scale, causal)
+        lambda a, b, c: _dense_with_lse(a, b, c, scale, causal,
+                                        window)[0]
     )(q, k, v)
 
 
@@ -440,23 +468,26 @@ def _interpret_needs_fallback(*xs):
         getattr(typeof(x), "vma", None) for x in xs)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
-def _flash(q, k, v, scale, causal, block_q, block_k):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _flash(q, k, v, scale, causal, block_q, block_k, window=0):
     if _interpret_needs_fallback(q, k, v):
-        return _dense_fallback(q, k, v, scale, causal).astype(q.dtype)
+        return _dense_fallback(q, k, v, scale, causal,
+                               window).astype(q.dtype)
     interpret = jax.default_backend() != "tpu"
     o, _ = _flash_forward(q, k, v, scale, causal, block_q, block_k,
-                          interpret, want_lse=False)
+                          interpret, want_lse=False, window=window)
     return o
 
 
-def _flash_fwd_rule(q, k, v, scale, causal, block_q, block_k):
+def _flash_fwd_rule(q, k, v, scale, causal, block_q, block_k,
+                    window=0):
     if _interpret_needs_fallback(q, k, v):
-        o = _dense_fallback(q, k, v, scale, causal).astype(q.dtype)
+        o = _dense_fallback(q, k, v, scale, causal,
+                            window).astype(q.dtype)
         return o, (q, k, v, None, None)
     interpret = jax.default_backend() != "tpu"
     o, lse = _flash_forward(q, k, v, scale, causal, block_q, block_k,
-                            interpret, want_lse=True)
+                            interpret, want_lse=True, window=window)
     # residual keeps ONE lane — the 128-lane replication is a Mosaic
     # block-layout need of the backward kernels' INPUT, re-broadcast
     # transiently there, not worth holding across the whole forward
@@ -479,16 +510,18 @@ def _narrow_vma(ct, primal):
     return jax.lax.psum(ct, extra) if extra else ct
 
 
-def _flash_bwd_rule(scale, causal, block_q, block_k, res, do):
+def _flash_bwd_rule(scale, causal, block_q, block_k, window, res, do):
     q, k, v, o, lse = res
     if lse is None:          # dense interpret-mode fallback (see above)
         _, vjp = jax.vjp(
             lambda a, b, c: _dense_fallback(
-                a, b, c, scale, causal).astype(q.dtype), q, k, v)
+                a, b, c, scale, causal, window).astype(q.dtype),
+            q, k, v)
         return vjp(do)
     interpret = jax.default_backend() != "tpu"
     dq, dk, dv = _flash_backward(q, k, v, o, lse, do, scale, causal,
-                                 block_q, block_k, interpret)
+                                 block_q, block_k, interpret,
+                                 window=window)
     return _narrow_vma(dq, q), _narrow_vma(dk, k), _narrow_vma(dv, v)
 
 
@@ -548,8 +581,14 @@ def flash_attention_with_lse(query, key, value, scale=None,
 
 
 def flash_attention(query, key, value, scale=None, causal=False,
-                    block_q=512, block_k=512):
-    """Fused attention over (B, H, T, D) or (BH, T, D) inputs."""
+                    block_q=512, block_k=512, window=None):
+    """Fused attention over (B, H, T, D) or (BH, T, D) inputs.
+
+    window: sliding-window width W (causal only): row t attends
+    [t-W+1, t]. Compute AND memory become O(T*W); blocks fully outside
+    the band are skipped on the grid."""
+    if window and not causal:
+        raise ValueError("window attention requires causal=True")
     q4 = query.ndim == 4
     if q4:
         B, H, T, D = query.shape
@@ -559,14 +598,14 @@ def flash_attention(query, key, value, scale=None, causal=False,
     if scale is None:
         scale = query.shape[-1] ** -0.5
     out = _flash(query, key, value, float(scale), bool(causal),
-                 int(block_q), int(block_k))
+                 int(block_q), int(block_k), int(window or 0))
     if q4:
         out = out.reshape(B, H, T, D)
     return out
 
 
 def cached_attention(query, key, value, k_cache, v_cache, pos,
-                     scale=None):
+                     scale=None, window=0):
     """Incremental-decode attention over a KV cache.
 
     query/key/value: (B, H, Tnew, hd) — projections of the Tnew tokens
@@ -592,7 +631,10 @@ def cached_attention(query, key, value, k_cache, v_cache, pos,
                    preferred_element_type=jnp.float32) * scale
     cols = jnp.arange(k_cache.shape[2])[None, :]
     rows = jnp.arange(Tn)[:, None]
-    s = jnp.where(cols <= p0 + rows, s, _NEG_INF)
+    valid = cols <= p0 + rows
+    if window:
+        valid = valid & (p0 + rows - cols < window)
+    s = jnp.where(valid, s, _NEG_INF)
     p = jax.nn.softmax(s, axis=-1)
     out = jnp.einsum("bhqk,bhkd->bhqd", p.astype(v_cache.dtype),
                      v_cache,
@@ -637,24 +679,25 @@ def _rope_op(data, positions, base=10000.0, **_):
                      "pos"),
           state_inputs=(3, 4), nondiff_inputs=(5,),
           differentiable=False,
-          defaults={"scale": None, "max_len": 0})
+          defaults={"scale": None, "max_len": 0, "window": 0})
 def _cached_attention_op(query, key, value, k_cache, v_cache, pos,
-                         scale=None, **_):
+                         scale=None, window=0, **_):
     """(B, H, Tnew, hd) decode attention; k_cache/v_cache are aux
     states updated in place (the executor threads them like BN moving
     stats — but unconditionally, since appending to the cache is the
     op's purpose at inference)."""
     return cached_attention(query, key, value, k_cache, v_cache, pos,
-                            scale=scale)
+                            scale=scale, window=int(window or 0))
 
 
 @register("_contrib_FlashAttention",
           arg_names=("query", "key", "value"),
           aliases=("_contrib_flash_attention",),
           defaults={"scale": None, "causal": False, "block_q": 512,
-                    "block_k": 512, "seq_axis": None})
+                    "block_k": 512, "seq_axis": None, "window": 0})
 def _flash_attention_op(query, key, value, scale=None, causal=False,
-                        block_q=512, block_k=512, seq_axis=None, **_):
+                        block_q=512, block_k=512, seq_axis=None,
+                        window=0, **_):
     """(B, H, T, D) fused attention; returns same shape.
 
     seq_axis: name of a mesh axis to sequence-parallelize over. When the
@@ -669,6 +712,9 @@ def _flash_attention_op(query, key, value, scale=None, causal=False,
         from ._mesh_ctx import active_mesh_axis
         mesh = active_mesh_axis(seq_axis)
         if mesh is not None:
+            if window:
+                raise ValueError("window attention is not supported "
+                                 "on the ring (seq_axis) path yet")
             if query.ndim != 4:
                 raise ValueError(
                     "seq_axis ring attention needs (B, H, T, D) inputs, "
@@ -677,4 +723,5 @@ def _flash_attention_op(query, key, value, scale=None, causal=False,
             return ring_attention(query, key, value, mesh, seq_axis,
                                   causal=bool(causal), scale=scale)
     return flash_attention(query, key, value, scale=scale, causal=causal,
-                           block_q=block_q, block_k=block_k)
+                           block_q=block_q, block_k=block_k,
+                           window=int(window or 0) or None)

@@ -120,6 +120,41 @@ class TestFlashKernel:
                                          causal=True)
         assert out.shape == (1, 2, 16, 8)
 
+    @pytest.mark.parametrize("window", [1, 3, 16, 100])
+    def test_window_attention_matches_dense(self, window):
+        """Sliding-window flash (fwd + Pallas bwd) equals the dense
+        banded-mask oracle, across window widths incl. degenerate
+        (1 = self-only) and wider-than-T (= plain causal)."""
+        q, k, v = _qkv(2, 40, 16, seed=17)
+
+        def dense(q_, k_, v_):
+            s = jnp.einsum("bqd,bkd->bqk", q_, k_) * 16 ** -0.5
+            r = jnp.arange(40)[:, None]
+            c = jnp.arange(40)[None, :]
+            s = jnp.where((r >= c) & (r - c < window), s, -1e30)
+            return jnp.einsum("bqk,bkd->bqd",
+                              jax.nn.softmax(s, axis=-1), v_)
+
+        out = flash_attention(q, k, v, causal=True, window=window,
+                              block_q=16, block_k=16)
+        np.testing.assert_allclose(np.asarray(out),
+                                   np.asarray(dense(q, k, v)),
+                                   rtol=2e-5, atol=2e-6)
+
+        gf = jax.grad(lambda a, b, c: (flash_attention(
+            a, b, c, causal=True, window=window, block_q=16,
+            block_k=16) ** 2).sum(), argnums=(0, 1, 2))(q, k, v)
+        gr = jax.grad(lambda a, b, c: (dense(a, b, c) ** 2).sum(),
+                      argnums=(0, 1, 2))(q, k, v)
+        for a, b in zip(gf, gr):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=1e-4, atol=1e-5)
+
+    def test_window_requires_causal(self):
+        q, k, v = _qkv(1, 16, 8)
+        with pytest.raises(ValueError, match="causal"):
+            flash_attention(q, k, v, causal=False, window=4)
+
     @pytest.mark.parametrize("causal", [False, True])
     def test_lse_variant_gradients(self, causal):
         """flash_attention_with_lse: gradient flow through BOTH outputs
