@@ -222,6 +222,7 @@ def bench_image(name, args):
         "step_time_ms": round(dt / iters * 1e3, 2),
         "batch": batch,
         "compute_dtype": dtype,
+        "window": args.window,
         "remat": bool(args.remat),
         "device_kind": getattr(dev, "device_kind", "unknown"),
         "mfu": round(mfu, 4) if mfu is not None else None}))
@@ -250,7 +251,8 @@ def bench_transformer(args):
 
         sym = transformer.get_symbol(V, T, num_layers=L,
                                      num_heads=c["heads"], dim=D,
-                                     ffn_hidden=F)
+                                     ffn_hidden=F,
+                                     attention_window=args.window or 0)
         step = make_train_step(
             sym, optimizer="adam",
             optimizer_params={"rescale_grad": 1.0 / B},
@@ -277,11 +279,14 @@ def bench_transformer(args):
 
     tok_s = B * T * iters / dt
     # analytic train flops (fwd x3): dense projections 8D^2+4DF per
-    # token per layer, attention 4*T*D per token per layer (QK^T + PV),
-    # vocab head 2DV per token. Matches the scaling-book accounting;
-    # used as the floor under cost_analysis (the Pallas flash kernel's
-    # internal flops are invisible to XLA's analysis).
-    fwd = B * T * (L * (8 * D * D + 4 * D * F + 4 * T * D) + 2 * D * V)
+    # token per layer, attention 4*Teff*D per token per layer (QK^T +
+    # PV; Teff = min(T, window) under sliding-window attention), vocab
+    # head 2DV per token. Matches the scaling-book accounting; used as
+    # the floor under cost_analysis (the Pallas flash kernel's internal
+    # flops are invisible to XLA's analysis).
+    t_eff = min(T, args.window) if args.window else T
+    fwd = B * T * (L * (8 * D * D + 4 * D * F + 4 * t_eff * D)
+                   + 2 * D * V)
     mfu, flops = _mfu(step, state, batch_vals, dev, dt / iters, 3 * fwd,
                       jax, model_flops_only=args.remat)
     print(json.dumps({
@@ -292,6 +297,7 @@ def bench_transformer(args):
         "step_time_ms": round(dt / iters * 1e3, 2),
         "batch": B, "seq_len": T, "dim": D, "layers": L,
         "compute_dtype": dtype,
+        "window": args.window,
         "remat": bool(args.remat),
         "device_kind": getattr(dev, "device_kind", "unknown"),
         "step_tflops": round(flops / 1e12, 2),
@@ -394,6 +400,9 @@ def main():
     p.add_argument("--decode", action="store_true",
                    help="transformer_lm only: KV-cache generation "
                         "throughput instead of training")
+    p.add_argument("--window", type=int, default=None,
+                   help="transformer_lm only: sliding-window attention "
+                        "width (training bench)")
     p.add_argument("--quantize", default=None, choices=["int8"],
                    help="with --decode: weight-only int8 (halved "
                         "weight HBM traffic on the bandwidth-bound "

@@ -47,6 +47,10 @@ for T in 4096 8192 16384; do
 done
 BENCH_ITERS=5 python bench.py --network transformer_lm --batch 1 \
     --seq-len 32768 --remat | tee -a "$OUT/longcontext.jsonl"; note $? lctx:32768
+# windowed attention: O(T*W) compute lets 32k train un-rematerialized
+BENCH_ITERS=5 python bench.py --network transformer_lm --batch 1 \
+    --seq-len 32768 --window 4096 \
+    | tee -a "$OUT/longcontext.jsonl"; note $? lctx:32768w4096
 
 echo "== 4. raw-JAX control =="
 python benchmark/raw_jax_resnet.py | tee "$OUT/raw_jax_control.txt"; note $? raw_jax_control
