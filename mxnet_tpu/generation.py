@@ -50,7 +50,8 @@ class Generator:
     def __init__(self, arg_params, vocab_size, max_len, num_layers=2,
                  num_heads=4, dim=128, ffn_hidden=None, batch_size=1,
                  dtype=None, num_experts=0, mesh=None, quantize=None,
-                 pos_encoding="learned", attention_window=0):
+                 pos_encoding="learned", attention_window=0,
+                 rolling_cache=False):
         from .parallel import sharding as shd
 
         if quantize not in (None, "int8"):
@@ -61,6 +62,8 @@ class Generator:
         self.batch_size = int(batch_size)
         self.num_layers = int(num_layers)
         self.mesh = mesh
+        self._window = int(attention_window or 0)
+        self._rolling = bool(rolling_cache)
         head_dim = dim // num_heads
         sym = transformer.get_decode_symbol(
             vocab_size, max_len, num_layers=num_layers,
@@ -68,7 +71,8 @@ class Generator:
             num_experts=num_experts, quantized=quantize is not None,
             compute_dtype=str(dtype) if dtype else None,
             pos_encoding=pos_encoding,
-            attention_window=attention_window)
+            attention_window=attention_window,
+            rolling_cache=rolling_cache)
         if quantize:
             arg_params = _quantize_weights(
                 arg_params, sym.list_arguments())
@@ -112,9 +116,11 @@ class Generator:
         if missing:
             raise ValueError("Generator missing parameters: %s"
                              % sorted(missing))
+        self._pos_rows = None
         if pos_encoding == "learned":
             pos_rows = self._params["pos_embed_weight"].shape[0]
-            if pos_rows < self.max_len:
+            self._pos_rows = int(pos_rows)
+            if not self._rolling and pos_rows < self.max_len:
                 # the decode symbol's position lookup is
                 # take(mode='clip'); without this check, positions past
                 # the trained table would silently reuse its last row
@@ -138,7 +144,23 @@ class Generator:
             raise ValueError("prompt must be (batch_size, P), got %r"
                              % (prompt.shape,))
         P = prompt.shape[1]
-        if P + max_new_tokens > self.max_len:
+        if self._rolling:
+            # circular cache: generation length is unbounded up to
+            # the float32-exact position range, 2^24 (pair with RoPE);
+            # the capacity only has to fit one window plus the prefill
+            # chunk's in-flight overwrites
+            if self._window + P - 1 > self.max_len:
+                raise ValueError(
+                    "rolling cache capacity max_len=%d must be >= "
+                    "window (%d) + prompt (%d) - 1"
+                    % (self.max_len, self._window, P))
+            if self._pos_rows is not None and \
+                    P + max_new_tokens > self._pos_rows:
+                raise ValueError(
+                    "learned positions cap total length at the table "
+                    "(%d rows); use pos_encoding='rope' for unbounded "
+                    "rolling generation" % self._pos_rows)
+        elif P + max_new_tokens > self.max_len:
             raise ValueError(
                 "prompt (%d) + max_new_tokens (%d) exceeds the cache "
                 "capacity max_len=%d" % (P, max_new_tokens,
@@ -157,6 +179,14 @@ class Generator:
     def _forward(self, aux, tokens, pos):
         """tokens: (B, Tnew) int array; pos: python int."""
         tn = tokens.shape[1]
+        if pos + tn > 2 ** 24:
+            # positions ride the float32 input convention; past 2^24
+            # consecutive integers stop being representable (RoPE
+            # angles and circular-slot indices would silently corrupt)
+            raise ValueError(
+                "position %d exceeds the float32-exact range (2^24); "
+                "longer rolling generation needs integer position "
+                "plumbing" % (pos + tn))
         args = dict(self._params)
         args["data"] = jnp.asarray(tokens, jnp.float32)
         args["positions"] = jnp.arange(pos, pos + tn, dtype=jnp.float32)
@@ -176,6 +206,12 @@ class Generator:
         if tokens.shape[1] > self.max_len:
             raise ValueError("sequence length %d exceeds max_len=%d"
                              % (tokens.shape[1], self.max_len))
+        if self._pos_rows is not None and \
+                tokens.shape[1] > self._pos_rows:
+            raise ValueError(
+                "sequence length %d exceeds the trained position "
+                "table (%d rows) — scoring would silently clip"
+                % (tokens.shape[1], self._pos_rows))
         logits, _ = self._forward(self._fresh_aux(), tokens, 0)
         logp = np.asarray(jax.nn.log_softmax(
             logits.astype(jnp.float32), axis=-1))     # (B, T, V)
@@ -299,6 +335,11 @@ class Generator:
                 draft.batch_size != self.batch_size:
             raise ValueError("draft must share vocab_size/batch_size "
                              "with the target")
+        if self._rolling or getattr(draft, "_rolling", False):
+            # rejected speculative slots could alias older positions in
+            # a circular buffer (p_s mis-attribution) — not supported
+            raise ValueError("speculative decoding is not supported "
+                             "with rolling caches")
         prompt, P = self._check_prompt(prompt, max_new_tokens)
         if P + max_new_tokens > draft.max_len:
             raise ValueError("draft max_len=%d too small for %d tokens"

@@ -178,7 +178,7 @@ def get_stage_symbol(num_heads=4, dim=128, ffn_hidden=None,
 
 def _decode_attention_block(x, num_heads, dim, prefix, max_len, pos,
                             quantized=False, rope_positions=None,
-                            window=0):
+                            window=0, rolling=False):
     """Incremental variant of _attention_block: identical qkv/proj
     helpers (a training checkpoint binds unchanged), attention routed
     through _contrib_CachedAttention with per-layer k/v cache aux
@@ -190,17 +190,23 @@ def _decode_attention_block(x, num_heads, dim, prefix, max_len, pos,
         # rotation, so each step only rotates the new tokens
         q = sym.contrib.RoPE(q, rope_positions)
         k = sym.contrib.RoPE(k, rope_positions)
-    att = sym.contrib.CachedAttention(q, k, v,
-                                      pos=pos, max_len=max_len,
-                                      window=window,
-                                      name=prefix + "attn")
+    if rolling:
+        att = sym.contrib.RollingCachedAttention(
+            q, k, v, pos=pos, max_len=max_len, window=window,
+            name=prefix + "attn")
+    else:
+        att = sym.contrib.CachedAttention(q, k, v,
+                                          pos=pos, max_len=max_len,
+                                          window=window,
+                                          name=prefix + "attn")
     return _merge_heads_proj(att, dim, prefix, quantized)
 
 
 def get_decode_symbol(vocab_size, max_len, num_layers=2, num_heads=4,
                       dim=128, ffn_hidden=None, num_experts=0,
                       quantized=False, compute_dtype=None,
-                      pos_encoding="learned", attention_window=0):
+                      pos_encoding="learned", attention_window=0,
+                      rolling_cache=False):
     """Autoregressive-decode twin of get_symbol.
 
     Inputs: data (B, Tnew) token ids for the tokens being appended
@@ -217,6 +223,9 @@ def get_decode_symbol(vocab_size, max_len, num_layers=2, num_heads=4,
     if dim % num_heads:
         raise ValueError("dim (%d) must be divisible by num_heads (%d)"
                          % (dim, num_heads))
+    if rolling_cache and not attention_window:
+        raise ValueError("rolling_cache needs attention_window > 0 "
+                         "(the circular capacity covers one window)")
     data = sym.Variable("data")
     positions = sym.Variable("positions")
     cache_pos = sym.Variable("cache_pos", shape=(1,))
@@ -249,7 +258,8 @@ def get_decode_symbol(vocab_size, max_len, num_layers=2, num_heads=4,
                                         max_len, cache_pos,
                                         quantized=quantized,
                                         rope_positions=rope_positions,
-                                        window=attention_window)
+                                        window=attention_window,
+                                        rolling=rolling_cache)
         f = sym.LayerNorm(x, name=prefix + "ln2")
         # inference never capacity-drops: every token is served, so
         # the factor is raised to E (cap == token count). Training-time

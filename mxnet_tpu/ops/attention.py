@@ -674,6 +674,65 @@ def _rope_op(data, positions, base=10000.0, **_):
     return rope(data, positions, base=float(base))
 
 
+def rolling_cached_attention(query, key, value, k_cache, v_cache, pos,
+                             window, scale=None):
+    """Sliding-window decode attention over a CIRCULAR cache.
+
+    Caches have fixed capacity C = k_cache.shape[2]; position p lives
+    in slot p % C, so memory stays O(C) however long generation runs
+    (pair with RoPE — a learned position table would still bound
+    absolute positions). Correctness needs C >= window + Tnew - 1:
+    appending Tnew tokens may overwrite up to Tnew-1 older slots, and
+    every new row must still find its full window (the Generator
+    checks this against the prefill length).
+
+    Masking derives each slot's ABSOLUTE position in closed form:
+    after appending through pos_end, slot s holds
+    p_s = pos_end - ((pos_end - s) mod C) — the newest position
+    congruent to s. Valid for query row r iff 0 <= p_s <= p0+r and
+    p0+r - p_s < window."""
+    B, H, Tn, D = query.shape
+    C = k_cache.shape[2]
+    if scale is None:
+        scale = D ** -0.5
+    p0 = jnp.reshape(pos, ()).astype(jnp.int32)
+    slots = (p0 + jnp.arange(Tn)) % C
+    k_cache = k_cache.at[:, :, slots].set(key.astype(k_cache.dtype))
+    v_cache = v_cache.at[:, :, slots].set(value.astype(v_cache.dtype))
+    s = jnp.einsum("bhqd,bhkd->bhqk", query, k_cache,
+                   precision=jax.lax.Precision.DEFAULT,
+                   preferred_element_type=jnp.float32) * scale
+    pos_end = p0 + Tn - 1
+    slot_ids = jnp.arange(C)[None, :]
+    p_s = pos_end - ((pos_end - slot_ids) % C)      # (1, C)
+    rows = p0 + jnp.arange(Tn)[:, None]             # (Tn, 1)
+    valid = (p_s >= 0) & (p_s <= rows) & (rows - p_s < window)
+    s = jnp.where(valid, s, _NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    out = jnp.einsum("bhqk,bhkd->bhqd", p.astype(v_cache.dtype),
+                     v_cache, precision=jax.lax.Precision.DEFAULT)
+    return out.astype(query.dtype), k_cache, v_cache
+
+
+@register("_contrib_RollingCachedAttention",
+          arg_names=("query", "key", "value", "k_cache", "v_cache",
+                     "pos"),
+          state_inputs=(3, 4), nondiff_inputs=(5,),
+          differentiable=False,
+          defaults={"scale": None, "max_len": 0, "window": 0})
+def _rolling_cached_attention_op(query, key, value, k_cache, v_cache,
+                                 pos, scale=None, window=0, **_):
+    """Circular-buffer twin of _contrib_CachedAttention for sliding-
+    window models; max_len is the cache CAPACITY here, not a sequence
+    bound."""
+    if not window:
+        raise ValueError("_contrib_RollingCachedAttention needs "
+                         "window > 0")
+    return rolling_cached_attention(query, key, value, k_cache,
+                                    v_cache, pos, int(window),
+                                    scale=scale)
+
+
 @register("_contrib_CachedAttention",
           arg_names=("query", "key", "value", "k_cache", "v_cache",
                      "pos"),

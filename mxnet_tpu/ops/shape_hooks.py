@@ -312,3 +312,7 @@ def _quant_embedding_shapes(shapes, attrs):
 
 
 set_param_shapes("_contrib_QuantizedEmbedding", _quant_embedding_shapes)
+
+
+set_param_shapes("_contrib_RollingCachedAttention",
+                 _cached_attention_shapes)

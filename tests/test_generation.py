@@ -286,6 +286,69 @@ class TestWindowedDecode:
                       - probs_full).max() > 1e-3
 
 
+class TestRollingCache:
+    def _rope_windowed_params(self, W):
+        sym = transformer.get_symbol(V, T, num_layers=L, num_heads=H,
+                                     dim=DIM, pos_encoding="rope",
+                                     attention_window=W)
+        step = make_train_step(sym, optimizer="sgd")
+        state = step.init_state(Xavier(), {"data": (B, T),
+                                           "softmax_label": (B, T)})
+        return state[0]
+
+    def test_rolling_matches_plain_windowed_decode(self):
+        """Within the plain cache's reach, a circular cache of capacity
+        W+P-1 must produce identical greedy output."""
+        W = 4
+        params = self._rope_windowed_params(W)
+        prompt = np.array([[1, 2, 3], [4, 5, 6]])
+        P = prompt.shape[1]
+        plain = Generator(params, V, max_len=T, num_layers=L,
+                          num_heads=H, dim=DIM, batch_size=B,
+                          pos_encoding="rope", attention_window=W)
+        rolling = Generator(params, V, max_len=W + P - 1, num_layers=L,
+                            num_heads=H, dim=DIM, batch_size=B,
+                            pos_encoding="rope", attention_window=W,
+                            rolling_cache=True)
+        a = plain.generate(prompt, max_new_tokens=8)
+        b = rolling.generate(prompt, max_new_tokens=8)
+        assert (a == b).all(), (a, b)
+
+    def test_rolling_generates_past_capacity(self):
+        """The point of the circular buffer: generation length far
+        beyond the cache capacity (impossible for the plain cache),
+        still matching a large-capacity plain run token for token."""
+        W = 4
+        params = self._rope_windowed_params(W)
+        prompt = np.array([[1, 2], [3, 4]])
+        P, N = prompt.shape[1], 30          # 32 total >> capacity 5
+        rolling = Generator(params, V, max_len=W + P - 1, num_layers=L,
+                            num_heads=H, dim=DIM, batch_size=B,
+                            pos_encoding="rope", attention_window=W,
+                            rolling_cache=True)
+        big = Generator(params, V, max_len=P + N, num_layers=L,
+                        num_heads=H, dim=DIM, batch_size=B,
+                        pos_encoding="rope", attention_window=W)
+        a = rolling.generate(prompt, max_new_tokens=N)
+        b = big.generate(prompt, max_new_tokens=N)
+        assert a.shape == (B, P + N)
+        assert (a == b).all()
+
+    def test_rolling_validation(self):
+        W = 4
+        params = self._rope_windowed_params(W)
+        gen = Generator(params, V, max_len=W + 1, num_layers=L,
+                        num_heads=H, dim=DIM, batch_size=B,
+                        pos_encoding="rope", attention_window=W,
+                        rolling_cache=True)
+        with pytest.raises(ValueError, match="rolling cache capacity"):
+            gen.generate(np.zeros((B, 4)), max_new_tokens=2)
+        with pytest.raises(ValueError, match="rolling_cache needs"):
+            transformer.get_decode_symbol(V, 8, rolling_cache=True)
+        with pytest.raises(ValueError, match="speculative"):
+            gen.generate_speculative(gen, np.zeros((B, 2)), 2)
+
+
 class TestQuantizedDecode:
     def test_quantized_fc_op_matches_dequant(self):
         rng = np.random.RandomState(0)
