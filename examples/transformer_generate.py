@@ -71,6 +71,25 @@ def main():
     print("progression match: %d/12" % match)
     assert match >= 8, "decode should continue the learned progression"
 
+    # the rest of the serving stack over the same checkpoint:
+    beam = gen.beam_search(prompt, max_new_tokens=8, beam_size=4)
+    print("beam-4 best:", beam[0].tolist())
+    assert gen.log_likelihood(beam)[0] >= gen.log_likelihood(out)[0] \
+        - 1e-6, "beam must not score below greedy"
+
+    spec = gen.generate_speculative(gen, prompt, max_new_tokens=8,
+                                    lookahead=4)
+    assert (spec == out).all(), "speculative must equal greedy"
+    print("speculative decode: exact greedy match")
+
+    gen8 = Generator(state[0], V, max_len=T, num_layers=L,
+                     num_heads=H, dim=DIM, batch_size=2,
+                     quantize="int8")
+    out8 = gen8.generate(prompt, max_new_tokens=8)
+    m8 = int((out8[0] == want).sum())
+    print("int8 weight-only greedy match: %d/12" % m8)
+    assert m8 >= 8, "int8 decode should keep the progression"
+
 
 if __name__ == "__main__":
     main()
