@@ -58,6 +58,15 @@ class Generator:
             raise ValueError("quantize must be None or 'int8', got %r"
                              % (quantize,))
         self.vocab_size = int(vocab_size)
+        if self.vocab_size > 2 ** 24:
+            # token ids ride the float32 "data" input convention;
+            # integers past 2^24 stop being exactly representable and
+            # would silently alias (positions get the same guard in
+            # _forward)
+            raise ValueError(
+                "vocab_size=%d exceeds the float32-exact id range "
+                "(2^24); larger vocabularies need integer id plumbing"
+                % self.vocab_size)
         self.max_len = int(max_len)
         self.batch_size = int(batch_size)
         self.num_layers = int(num_layers)
@@ -137,6 +146,17 @@ class Generator:
         self._cache_shape = (self.batch_size, num_heads, self.max_len,
                              head_dim)
         self._cache_dtype = cache_dtype
+
+    @staticmethod
+    def _check_sampling(temperature, top_k, top_p):
+        """top_k/top_p only act on the sampled path; at temperature<=0
+        decoding is greedy and they would be silently ignored — make
+        that contract explicit instead."""
+        if (top_k or top_p) and not (temperature
+                                     and float(temperature) > 0):
+            raise ValueError(
+                "top_k/top_p require temperature > 0 (temperature<=0 "
+                "decodes greedily and would silently ignore them)")
 
     def _check_prompt(self, prompt, max_new_tokens):
         prompt = np.asarray(prompt)
@@ -411,7 +431,10 @@ class Generator:
         (prompt_len, max_new_tokens, temperature, top_k, top_p)
         tuple compiles once (the sampling knobs are baked into the
         program)."""
+        self._check_sampling(temperature, top_k, top_p)
         prompt, P = self._check_prompt(prompt, max_new_tokens)
+        if int(max_new_tokens) == 0:
+            return np.asarray(prompt, np.int64)
         toks = self._device_loop(P, int(max_new_tokens),
                                  float(temperature),
                                  int(top_k) if top_k else 0,
@@ -450,8 +473,16 @@ class Generator:
                 outs, aux = eval_fn(args, aux, sub, False)
                 return (aux, outs[0][:, -1], key), tok
 
-            (_, _, _), toks = jax.lax.scan(
-                body, (aux, last, key), jnp.arange(n_steps))
+            # the scan body samples token i from the PREVIOUS step's
+            # logits and then runs a forward — so the n-th token needs
+            # only n-1 forwards: run n-1 bodies and sample the final
+            # token from the last carry outside the scan (same rng
+            # split pattern, one decode forward saved per call)
+            (_, last, key), toks = jax.lax.scan(
+                body, (aux, last, key), jnp.arange(n_steps - 1))
+            _, sub = jax.random.split(key)
+            tok_f = _pick_token(last, temperature, top_k, sub, top_p)
+            toks = jnp.concatenate([toks, tok_f[None]], axis=0)
             return toks.T                        # (B, n_steps)
 
         fn = jax.jit(run)
@@ -465,6 +496,7 @@ class Generator:
         prompt: (B, P) int token ids. Returns (B, P + n) ids as numpy
         (n <= max_new_tokens; generation stops early only when every
         row has emitted eos_id)."""
+        self._check_sampling(temperature, top_k, top_p)
         prompt, P = self._check_prompt(prompt, max_new_tokens)
         key = jax.random.PRNGKey(seed)
         aux = self._fresh_aux()

@@ -614,6 +614,15 @@ def cached_attention(query, key, value, k_cache, v_cache, pos,
     number of tokens already cached; the new keys land at
     [pos, pos+Tnew) and query row r may attend cache columns <= pos+r.
 
+    CAPACITY CONTRACT: pos + Tnew must be <= Tmax. Past it,
+    dynamic_update_slice CLAMPS the start index rather than raising, so
+    an overrun silently overwrites the most recent cache rows (and the
+    causal mask then attends corrupted history). `Generator` guards
+    this on the host; direct users of the op (get_decode_symbol /
+    _contrib_CachedAttention) must enforce it themselves. Under
+    `jax.disable_jit()` — this framework's NaiveEngine-style debug mode
+    — pos is concrete and the op raises on violation.
+
     Decode is bandwidth-bound (one (Tnew, Tmax) strip per head), so
     this is a plain jnp composition — XLA fuses the mask+softmax; the
     MXU-dense training path stays with the Pallas flash kernel.
@@ -622,6 +631,13 @@ def cached_attention(query, key, value, k_cache, v_cache, pos,
     if scale is None:
         scale = D ** -0.5
     p0 = jnp.reshape(pos, ()).astype(jnp.int32)
+    if not isinstance(p0, jax.core.Tracer) and \
+            int(p0) + Tn > k_cache.shape[2]:
+        raise ValueError(
+            "cached_attention overrun: pos (%d) + Tnew (%d) exceeds "
+            "cache capacity Tmax=%d — dynamic_update_slice would clamp "
+            "and silently corrupt the cache"
+            % (int(p0), Tn, k_cache.shape[2]))
     k_cache = jax.lax.dynamic_update_slice(
         k_cache, key.astype(k_cache.dtype), (0, 0, p0, 0))
     v_cache = jax.lax.dynamic_update_slice(

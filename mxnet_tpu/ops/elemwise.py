@@ -117,7 +117,10 @@ _b("broadcast_maximum", jnp.maximum, aliases=("_maximum", "_Maximum",
                                               "maximum"))
 _b("broadcast_minimum", jnp.minimum, aliases=("_minimum", "_Minimum",
                                               "minimum"))
-_b("broadcast_hypot", jnp.hypot, aliases=("_hypot",))
+# plain `hypot` is a same-shape elemwise op in the reference
+# (src/operator/tensor/elemwise_binary_op.cc); the broadcasting form is
+# a strict superset, so it aliases here like maximum/minimum do
+_b("broadcast_hypot", jnp.hypot, aliases=("_hypot", "hypot"))
 _b("_grad_add", jnp.add)
 
 # public names (mx.nd.equal & co) match the reference's registrations in

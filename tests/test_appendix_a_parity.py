@@ -1,0 +1,157 @@
+"""SURVEY.md Appendix A walked as a test: every operator name the
+reference registers (grep over NNVM_REGISTER_OP / MXNET_REGISTER_OP_
+PROPERTY in /root/reference/src/operator, transcribed in SURVEY.md
+Appendix A) must resolve on BOTH mx.sym and mx.nd — the analogue of the
+reference's auto-generation guarantee (python/mxnet/base.py:381 creates
+one Python function per registered op, so the reference could never
+have a name gap).
+
+Names the rebuild deliberately does not carry are in EXPECTED_ABSENT
+with the SURVEY/VERDICT justification; everything else missing is a
+straight failure.
+"""
+import pytest
+
+import mxnet_tpu as mx
+
+# -- Appendix A, transcribed -------------------------------------------------
+
+LEGACY_LAYERS = [
+    "Activation", "BatchNorm", "BatchNorm_v1", "BilinearSampler",
+    "Concat", "Convolution", "Convolution_v1", "Correlation", "Crop",
+    "Deconvolution", "Dropout", "FullyConnected", "GridGenerator",
+    "IdentityAttachKLSparseReg", "InstanceNorm", "L2Normalization",
+    "LRN", "LeakyReLU", "LinearRegressionOutput",
+    "LogisticRegressionOutput", "MAERegressionOutput", "MakeLoss",
+    "Pad", "Pooling", "Pooling_v1", "RNN", "ROIPooling", "SVMOutput",
+    "SequenceLast", "SequenceMask", "SequenceReverse", "SliceChannel",
+    "Softmax", "SoftmaxActivation", "SoftmaxOutput",
+    "SpatialTransformer", "SwapAxis", "UpSampling",
+]
+
+CONTRIB_LEGACY = [
+    "_contrib_CTCLoss", "_contrib_DeformableConvolution",
+    "_contrib_DeformablePSROIPooling", "_contrib_MultiBoxDetection",
+    "_contrib_MultiBoxPrior", "_contrib_MultiBoxTarget",
+    "_contrib_MultiProposal", "_contrib_PSROIPooling",
+    "_contrib_Proposal", "_contrib_count_sketch", "_contrib_fft",
+    "_contrib_ifft",
+]
+
+NNVM_CORE = [
+    "Cast", "Custom", "Embedding", "Flatten", "Reshape",
+]
+
+TENSOR = [
+    "_arange", "_ones", "_zeros", "zeros_like", "ones_like", "_copy",
+    "BlockGrad", "make_loss", "_identity_with_attr_like_rhs", "clip",
+    "repeat", "tile", "reverse", "stack", "expand_dims", "slice",
+    "_slice_assign", "_crop_assign_scalar", "slice_axis", "dot",
+    "batch_dot", "transpose", "norm", "topk", "sort", "argsort",
+    "argmax", "argmin", "argmax_channel", "pick", "take", "batch_take",
+    "one_hot", "where", "cast_storage", "_sparse_retain", "_square_sum",
+    "sum", "mean", "prod", "nansum", "nanprod", "max", "min",
+    "broadcast_axis", "broadcast_to", "softmax", "log_softmax",
+    "softmax_cross_entropy", "smooth_l1",
+]
+
+# "elemwise binary (+`_scalar`, `broadcast_*`, sparse variants):
+# add/sub/mul/div/mod, _grad_add, maximum/minimum, power/rpower, hypot,
+# equal/..., elemwise_{add,sub,mul,div}, add_n"
+ELEMWISE_BINARY = (
+    ["elemwise_add", "elemwise_sub", "elemwise_mul", "elemwise_div",
+     "add_n", "_grad_add",
+     "maximum", "minimum", "hypot",
+     "equal", "not_equal", "greater", "greater_equal", "lesser",
+     "lesser_equal"]
+    + ["broadcast_%s" % n for n in
+       ("add", "sub", "mul", "div", "mod", "power", "maximum",
+        "minimum", "hypot", "equal", "not_equal", "greater",
+        "greater_equal", "lesser", "lesser_equal")]
+    + ["_%s_scalar" % n for n in
+       ("plus", "minus", "rminus", "mul", "div", "rdiv", "mod", "rmod",
+        "power", "rpower", "maximum", "minimum", "hypot", "equal",
+        "not_equal", "greater", "greater_equal", "lesser",
+        "lesser_equal")]
+)
+
+UNARY_MATH = [
+    "abs", "sign", "negative", "reciprocal", "rcbrt", "cbrt", "sqrt",
+    "rsqrt", "square", "exp", "expm1", "log", "log10", "log1p", "log2",
+    "sin", "cos", "tan", "sinh", "cosh", "tanh", "arcsin", "arccos",
+    "arctan", "arcsinh", "arccosh", "arctanh", "degrees", "radians",
+    "gamma", "gammaln", "relu", "sigmoid", "ceil", "floor", "rint",
+    "round", "fix", "trunc",
+]
+
+RANDOM = (
+    ["_random_%s" % n for n in
+     ("uniform", "normal", "exponential", "gamma", "poisson",
+      "negative_binomial", "generalized_negative_binomial")]
+    + ["_sample_%s" % n for n in
+       ("uniform", "normal", "exponential", "gamma", "poisson",
+        "negative_binomial", "generalized_negative_binomial")]
+    + ["sample_multinomial"]
+)
+
+LINALG = ["_linalg_%s" % n for n in
+          ("gemm", "gemm2", "potrf", "potri", "trmm", "trsm", "syrk",
+           "gelqf", "sumlogdiag")]
+
+OPTIMIZER = [
+    "sgd_update", "sgd_mom_update", "mp_sgd_update",
+    "mp_sgd_mom_update", "adam_update", "rmsprop_update",
+    "rmspropalex_update", "ftrl_update",
+]
+
+CONTRIB_NNVM = ["_contrib_quantize", "_contrib_dequantize"]
+
+# .add_alias legacy names called out in Appendix A
+ALIASES = ["identity", "stop_gradient"]
+
+ALL_NAMES = (LEGACY_LAYERS + CONTRIB_LEGACY + NNVM_CORE + TENSOR
+             + ELEMWISE_BINARY + UNARY_MATH + RANDOM + LINALG
+             + OPTIMIZER + CONTRIB_NNVM + ALIASES)
+
+# -- sanctioned drops (SURVEY section 7 design stance / VERDICT r3) ----------
+
+EXPECTED_ABSENT = {
+    # N30 plugins: caffe/torch/warpctc bridges are meaningless without
+    # the bridged frameworks; VERDICT r3 counts the drop as acceptable
+    "CaffeLoss", "CaffeOp", "TorchCriterion", "TorchModule", "WarpCTC",
+    # cuDNN-internal registration: the cuDNN special-case dissolves
+    # into XLA's conv (SURVEY N10 "absorbed"); user-facing BatchNorm /
+    # BatchNorm_v1 both bind
+    "CuDNNBatchNorm",
+    # engine-internal node inserted by the PlaceDevice pass, never a
+    # user-callable op; device movement is GSPMD sharding here
+    # (executor.py ctx_group -> sharding constraints)
+    "_CrossDeviceCopy",
+    # legacy pre-0.9 python-op bridges superseded IN THE REFERENCE by
+    # Custom (src/operator/custom/custom.cc); the rebuild carries
+    # Custom only
+    "_NDArray", "_Native",
+}
+
+
+def _resolves(ns, name):
+    try:
+        return callable(getattr(ns, name))
+    except AttributeError:
+        return False
+
+
+@pytest.mark.parametrize("name", sorted(set(ALL_NAMES)))
+def test_name_resolves(name):
+    missing = [repr(ns_name) for ns_name, ns in
+               (("mx.sym", mx.sym), ("mx.nd", mx.nd))
+               if not _resolves(ns, name)]
+    assert not missing, "%s does not resolve on %s" % (name, missing)
+
+
+@pytest.mark.parametrize("name", sorted(EXPECTED_ABSENT))
+def test_documented_drops_stay_dropped(name):
+    """If one of these starts resolving, it graduated — move it out of
+    EXPECTED_ABSENT so the parity list tracks reality."""
+    assert not _resolves(mx.sym, name), (
+        "%s now resolves; remove it from EXPECTED_ABSENT" % name)
