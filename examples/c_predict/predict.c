@@ -1,0 +1,82 @@
+/* Minimal C host for the MXTpuPred ABI — the deployment analogue of
+ * the reference's image-classification/predict-cpp example over
+ * MXPredCreate/SetInput/Forward/GetOutput (src/c_api/c_predict_api.cc).
+ *
+ * Usage: predict <model_prefix> <input.f32> <num_floats>
+ *   model_prefix : path prefix of Predictor.export artifacts
+ *                  (<prefix>.stablehlo + <prefix>.meta.json)
+ *   input.f32    : raw little-endian float32 buffer for input "data"
+ *
+ * Prints, for each model output: "output <i> shape d0 d1 ..." then the
+ * values, one per line (%.8g). The CI smoke test diffs this against
+ * the in-process Python forward.
+ *
+ * Build (see tests/test_c_predict.py):
+ *   gcc predict.c -o predict -L<_native> -lpredict_shim -Wl,-rpath,<_native>
+ */
+#include <stdint.h>
+#include <stdio.h>
+#include <stdlib.h>
+
+extern void* MXTpuPredCreate(const char* model_prefix);
+extern int MXTpuPredSetInput(void* h, const char* key, const float* data,
+                             uint64_t size);
+extern int MXTpuPredForward(void* h);
+extern int MXTpuPredGetOutputShape(void* h, uint32_t index,
+                                   uint32_t* shape, uint32_t* ndim);
+extern int MXTpuPredGetOutput(void* h, uint32_t index, float* data,
+                              uint64_t size);
+extern void MXTpuPredFree(void* h);
+extern const char* MXTpuGetLastError(void);
+
+static void die(const char* what) {
+  fprintf(stderr, "%s: %s\n", what, MXTpuGetLastError());
+  exit(1);
+}
+
+int main(int argc, char** argv) {
+  if (argc != 4) {
+    fprintf(stderr, "usage: %s <model_prefix> <input.f32> <num_floats>\n",
+            argv[0]);
+    return 2;
+  }
+  const char* prefix = argv[1];
+  uint64_t n = (uint64_t)strtoull(argv[3], NULL, 10);
+
+  float* input = (float*)malloc(n * sizeof(float));
+  FILE* f = fopen(argv[2], "rb");
+  if (!f || fread(input, sizeof(float), n, f) != n) {
+    fprintf(stderr, "cannot read %llu floats from %s\n",
+            (unsigned long long)n, argv[2]);
+    return 2;
+  }
+  fclose(f);
+
+  void* h = MXTpuPredCreate(prefix);
+  if (!h) die("create");
+  if (MXTpuPredSetInput(h, "data", input, n) != 0) die("set_input");
+  if (MXTpuPredForward(h) != 0) die("forward");
+
+  for (uint32_t i = 0;; ++i) {
+    uint32_t shape[8];
+    uint32_t ndim = 8;
+    if (MXTpuPredGetOutputShape(h, i, shape, &ndim) != 0) {
+      if (i == 0) die("get_output_shape");
+      break; /* index out of range: all outputs printed */
+    }
+    uint64_t total = 1;
+    printf("output %u shape", i);
+    for (uint32_t d = 0; d < ndim; ++d) {
+      printf(" %u", shape[d]);
+      total *= shape[d];
+    }
+    printf("\n");
+    float* out = (float*)malloc(total * sizeof(float));
+    if (MXTpuPredGetOutput(h, i, out, total) != 0) die("get_output");
+    for (uint64_t k = 0; k < total; ++k) printf("%.8g\n", out[k]);
+    free(out);
+  }
+  MXTpuPredFree(h);
+  free(input);
+  return 0;
+}

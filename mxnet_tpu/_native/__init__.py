@@ -68,6 +68,37 @@ def load(name):
         return lib
 
 
+def build_predict_shim():
+    """Compile the C predict ABI (predict_shim.cc) -> libpredict_shim.so.
+
+    Separate from _build because it embeds CPython: include/lib flags
+    come from sysconfig rather than a LINK comment. Returns the .so
+    path, or None when the toolchain/headers are missing (the Python
+    Predictor/CompiledPredictor surface is unaffected)."""
+    import sysconfig
+
+    src = os.path.join(_DIR, "predict_shim.cc")
+    so = os.path.join(_DIR, "libpredict_shim.so")
+    try:
+        if (os.path.exists(so) and
+                os.path.getmtime(so) >= os.path.getmtime(src)):
+            return so
+        inc = sysconfig.get_paths()["include"]
+        libdir = sysconfig.get_config_var("LIBDIR")
+        pyver = sysconfig.get_config_var("VERSION")
+        tmp = "%s.tmp.%d" % (so, os.getpid())
+        subprocess.run(
+            ["g++", "-O2", "-std=c++17", "-shared", "-fPIC",
+             "-I%s" % inc, src, "-o", tmp,
+             "-L%s" % libdir, "-Wl,-rpath,%s" % libdir,
+             "-lpython%s" % pyver],
+            check=True, capture_output=True, timeout=120)
+        os.replace(tmp, so)
+        return so
+    except Exception:
+        return None
+
+
 _MAGIC_BYTES = b"\x0a\x23\xd7\xce"
 
 

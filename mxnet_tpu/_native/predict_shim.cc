@@ -1,0 +1,263 @@
+// C-callable predict surface over the AOT StableHLO deployment path.
+//
+// Reference parity target: src/c_api/c_predict_api.cc:363 — the
+// standalone MXPredCreate/SetInput/Forward/GetOutput ABI that powered
+// the amalgamation build, mobile targets and the non-Python bindings.
+// The TPU-native artifact is a serialized XLA program
+// (Predictor.export -> prefix.stablehlo + prefix.meta.json); this shim
+// lets a C host load and run it through an EMBEDDED CPython interpreter
+// hosting CompiledPredictor. The heavy lifting (deserialization,
+// device placement, execution) is XLA's; the interpreter is a thin
+// control plane, so this is the deployment analogue of the reference's
+// "predict-only, no training framework" build — the host app needs no
+// Python source, no symbol JSON, no parameter files.
+//
+// ABI (all functions thread-safe via the GIL; floats only, matching
+// MXPredSetInput/MXPredGetOutput's float* contract):
+//   MXTpuPredCreate(prefix)                 -> handle | NULL (error)
+//   MXTpuPredSetInput(h, key, data, size)   -> 0 | -1
+//   MXTpuPredForward(h)                     -> 0 | -1
+//   MXTpuPredGetOutputShape(h, i, shape[], ndim*) -> 0 | -1
+//   MXTpuPredGetOutput(h, i, data, size)    -> 0 | -1
+//   MXTpuPredFree(h)
+//   MXTpuGetLastError()                     -> const char*
+//
+// Build: _native.build_predict_shim() (g++ + sysconfig flags); the
+// Python side is optional — this file has no Python-package build-time
+// dependency beyond Python.h.
+#include <Python.h>
+
+#include <cstdint>
+#include <cstring>
+#include <mutex>
+#include <string>
+
+namespace {
+
+std::mutex g_err_mu;
+std::string g_last_error;
+
+void set_error(const std::string& msg) {
+  std::lock_guard<std::mutex> lock(g_err_mu);
+  g_last_error = msg;
+}
+
+// Capture the pending Python exception into g_last_error.
+void set_error_from_python() {
+  PyObject *type = nullptr, *value = nullptr, *tb = nullptr;
+  PyErr_Fetch(&type, &value, &tb);
+  PyErr_NormalizeException(&type, &value, &tb);
+  std::string msg = "unknown python error";
+  if (value) {
+    PyObject* s = PyObject_Str(value);
+    if (s) {
+      const char* c = PyUnicode_AsUTF8(s);
+      if (c) msg = c;
+      Py_DECREF(s);
+    }
+  }
+  Py_XDECREF(type);
+  Py_XDECREF(value);
+  Py_XDECREF(tb);
+  set_error(msg);
+}
+
+// Python glue executed once into a private namespace: the shim calls
+// these four functions instead of fingering package internals from C.
+const char* kGlue = R"PY(
+import numpy as np
+from mxnet_tpu.predictor import CompiledPredictor
+
+def _create(prefix):
+    p = CompiledPredictor.load(prefix)
+    return {"p": p, "inputs": {}, "outputs": None, "meta": p._meta}
+
+def _set_input(h, key, buf):
+    shapes = h["meta"]["data_shapes"]
+    if key not in shapes:
+        raise KeyError("unknown input %r; model inputs: %s"
+                       % (key, sorted(shapes)))
+    shape = shapes[key]
+    arr = np.frombuffer(buf, dtype=np.float32)
+    need = int(np.prod(shape))
+    if arr.size != need:
+        raise ValueError("input %r: got %d floats, shape %s needs %d"
+                         % (key, arr.size, shape, need))
+    h["inputs"][key] = arr.reshape(shape).copy()
+
+def _forward(h):
+    missing = [n for n in h["meta"]["data_names"]
+               if n not in h["inputs"]]
+    if missing:
+        raise ValueError("inputs not set: %s" % missing)
+    outs = h["p"].forward(**h["inputs"])
+    h["outputs"] = [np.asarray(o.asnumpy(), dtype=np.float32)
+                    for o in outs]
+
+def _output(h, i):
+    if h["outputs"] is None:
+        raise RuntimeError("run forward first")
+    return h["outputs"][int(i)]
+)PY";
+
+PyObject* g_ns = nullptr;  // glue namespace dict
+
+bool ensure_python() {
+  if (!Py_IsInitialized()) {
+    Py_InitializeEx(0);  // no signal handlers: we are a guest
+  }
+  PyGILState_STATE st = PyGILState_Ensure();
+  bool ok = true;
+  if (!g_ns) {
+    g_ns = PyDict_New();
+    PyDict_SetItemString(g_ns, "__builtins__", PyEval_GetBuiltins());
+    PyObject* r = PyRun_String(kGlue, Py_file_input, g_ns, g_ns);
+    if (!r) {
+      set_error_from_python();
+      Py_CLEAR(g_ns);
+      ok = false;
+    } else {
+      Py_DECREF(r);
+    }
+  }
+  PyGILState_Release(st);
+  return ok;
+}
+
+PyObject* glue_call(const char* fn, PyObject* args) {
+  // caller holds the GIL; steals nothing, returns new ref or NULL
+  PyObject* f = PyDict_GetItemString(g_ns, fn);  // borrowed
+  if (!f) {
+    set_error(std::string("glue function missing: ") + fn);
+    return nullptr;
+  }
+  PyObject* out = PyObject_CallObject(f, args);
+  if (!out) set_error_from_python();
+  return out;
+}
+
+}  // namespace
+
+extern "C" {
+
+const char* MXTpuGetLastError() {
+  std::lock_guard<std::mutex> lock(g_err_mu);
+  return g_last_error.c_str();
+}
+
+void* MXTpuPredCreate(const char* model_prefix) {
+  if (!ensure_python()) return nullptr;
+  PyGILState_STATE st = PyGILState_Ensure();
+  PyObject* args = Py_BuildValue("(s)", model_prefix);
+  PyObject* h = glue_call("_create", args);
+  Py_DECREF(args);
+  PyGILState_Release(st);
+  return h;  // new ref owned by the caller's handle
+}
+
+int MXTpuPredSetInput(void* handle, const char* key, const float* data,
+                      uint64_t size) {
+  if (!handle) { set_error("null handle"); return -1; }
+  PyGILState_STATE st = PyGILState_Ensure();
+  PyObject* buf = PyBytes_FromStringAndSize(
+      reinterpret_cast<const char*>(data),
+      static_cast<Py_ssize_t>(size * sizeof(float)));
+  PyObject* args = Py_BuildValue("(OsO)", static_cast<PyObject*>(handle),
+                                 key, buf);
+  Py_DECREF(buf);
+  PyObject* r = glue_call("_set_input", args);
+  Py_DECREF(args);
+  int rc = r ? 0 : -1;
+  Py_XDECREF(r);
+  PyGILState_Release(st);
+  return rc;
+}
+
+int MXTpuPredForward(void* handle) {
+  if (!handle) { set_error("null handle"); return -1; }
+  PyGILState_STATE st = PyGILState_Ensure();
+  PyObject* args = Py_BuildValue("(O)", static_cast<PyObject*>(handle));
+  PyObject* r = glue_call("_forward", args);
+  Py_DECREF(args);
+  int rc = r ? 0 : -1;
+  Py_XDECREF(r);
+  PyGILState_Release(st);
+  return rc;
+}
+
+int MXTpuPredGetOutputShape(void* handle, uint32_t index,
+                            uint32_t* shape, uint32_t* ndim) {
+  if (!handle) { set_error("null handle"); return -1; }
+  PyGILState_STATE st = PyGILState_Ensure();
+  PyObject* args = Py_BuildValue("(OI)", static_cast<PyObject*>(handle),
+                                 index);
+  PyObject* arr = glue_call("_output", args);
+  Py_DECREF(args);
+  int rc = -1;
+  if (arr) {
+    PyObject* shp = PyObject_GetAttrString(arr, "shape");
+    if (shp) {
+      Py_ssize_t n = PyTuple_Size(shp);
+      if (*ndim < n) {
+        set_error("shape buffer too small");
+      } else {
+        for (Py_ssize_t i = 0; i < n; ++i)
+          shape[i] = static_cast<uint32_t>(
+              PyLong_AsLong(PyTuple_GetItem(shp, i)));
+        *ndim = static_cast<uint32_t>(n);
+        rc = 0;
+      }
+      Py_DECREF(shp);
+    } else {
+      set_error_from_python();
+    }
+    Py_DECREF(arr);
+  }
+  PyGILState_Release(st);
+  return rc;
+}
+
+int MXTpuPredGetOutput(void* handle, uint32_t index, float* data,
+                       uint64_t size) {
+  if (!handle) { set_error("null handle"); return -1; }
+  PyGILState_STATE st = PyGILState_Ensure();
+  PyObject* args = Py_BuildValue("(OI)", static_cast<PyObject*>(handle),
+                                 index);
+  PyObject* arr = glue_call("_output", args);
+  Py_DECREF(args);
+  int rc = -1;
+  if (arr) {
+    PyObject* bytes = PyObject_CallMethod(arr, "tobytes", nullptr);
+    if (bytes) {
+      char* raw = nullptr;
+      Py_ssize_t len = 0;
+      if (PyBytes_AsStringAndSize(bytes, &raw, &len) == 0) {
+        if (static_cast<uint64_t>(len) != size * sizeof(float)) {
+          set_error("output size mismatch: have " + std::to_string(len) +
+                    " bytes, caller buffer holds " +
+                    std::to_string(size * sizeof(float)));
+        } else {
+          std::memcpy(data, raw, len);
+          rc = 0;
+        }
+      } else {
+        set_error_from_python();
+      }
+      Py_DECREF(bytes);
+    } else {
+      set_error_from_python();
+    }
+    Py_DECREF(arr);
+  }
+  PyGILState_Release(st);
+  return rc;
+}
+
+void MXTpuPredFree(void* handle) {
+  if (!handle) return;
+  PyGILState_STATE st = PyGILState_Ensure();
+  Py_DECREF(static_cast<PyObject*>(handle));
+  PyGILState_Release(st);
+}
+
+}  // extern "C"
