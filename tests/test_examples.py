@@ -18,6 +18,7 @@ FAST_EXAMPLES = [
     "gan_toy.py",
     "fit_spmd_elastic.py",
     "transformer_generate.py",
+    "rcnn_train.py",
 ]
 
 
