@@ -19,6 +19,8 @@ FAST_EXAMPLES = [
     "fit_spmd_elastic.py",
     "transformer_generate.py",
     "rcnn_train.py",
+    "fcn_xs.py",
+    "nce_loss.py",
 ]
 
 
