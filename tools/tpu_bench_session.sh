@@ -52,6 +52,11 @@ BENCH_ITERS=5 python bench.py --network transformer_lm --batch 1 \
     --seq-len 32768 --window 4096 \
     | tee -a "$OUT/longcontext.jsonl"; note $? lctx:32768w4096
 
+echo "== 3d. input-pipeline train overlap (net img/s with real decode) =="
+python benchmark/bench_input_pipeline.py --train-overlap \
+    --n 512 --batch-size 128 --threads 8 \
+    | tee "$OUT/pipeline_overlap.json"; note $? pipeline_overlap
+
 echo "== 4. raw-JAX control =="
 python benchmark/raw_jax_resnet.py | tee "$OUT/raw_jax_control.txt"; note $? raw_jax_control
 

@@ -131,8 +131,14 @@ def summarize(trace_dir):
                 for ev in line.events:
                     name = ev_names.get(ev.metadata_id, "?")
                     # python host-activity frames leak into /host:CPU on
-                    # the CPU backend; keep HLO-op events only
-                    if ".py:" in name or name.startswith("$"):
+                    # the CPU backend AND into tunneled-TPU traces where
+                    # no /device: plane exists (the round-3 capture's
+                    # "np.asarray(jax.Array)" 73% artifact); keep
+                    # HLO-op events only
+                    if ".py:" in name or name.startswith("$") or \
+                            name.startswith(("np.", "jax.",
+                                             "PjitFunction",
+                                             "PyArray", "Thread")):
                         continue
                     dur = ev.duration_ps
                     per_cat[_category(name)] += dur
