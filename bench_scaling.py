@@ -46,6 +46,15 @@ def _parse_args():
     p.add_argument("--iters", type=int, default=10)
     p.add_argument("--zero1", action="store_true",
                    help="shard optimizer state (ZeRO-1)")
+    p.add_argument("--full-size", action="store_true",
+                   help="the REAL bench.py configs (resnet-50 224px "
+                        "batch 128/dev; transformer dim 2048): exact "
+                        "collective bytes for the roofline in "
+                        "docs/scaling.md. Pair with --compile-only on "
+                        "a CPU host")
+    p.add_argument("--compile-only", action="store_true",
+                   help="lower+compile and report collective bytes "
+                        "without running the step")
     return p.parse_args()
 
 
@@ -98,7 +107,7 @@ def collective_bytes(hlo_text):
 
 
 def build_step(network, mesh, global_batch, zero1, seq_parallel=False,
-               seq_len=64, num_experts=0):
+               seq_len=64, num_experts=0, full_size=False):
     from mxnet_tpu import models
     from mxnet_tpu.initializer import Xavier
     from mxnet_tpu.parallel import make_train_step
@@ -108,18 +117,35 @@ def build_step(network, mesh, global_batch, zero1, seq_parallel=False,
     if zero1:
         kw.update(optimizer="adam", optimizer_params={},
                   optimizer_sharding="zero1")
+    if full_size:
+        kw["compute_dtype"] = "bfloat16"   # match bench.py exactly
     if network == "resnet":
-        sym = models.get_symbol(network="resnet", num_classes=10,
-                                num_layers=8, image_shape=(3, 8, 8))
-        shapes = {"data": (global_batch, 3, 8, 8),
-                  "softmax_label": (global_batch,)}
+        if full_size:
+            sym = models.get_symbol(network="resnet", num_classes=1000,
+                                    num_layers=50,
+                                    image_shape=(3, 224, 224))
+            shapes = {"data": (global_batch, 3, 224, 224),
+                      "softmax_label": (global_batch,)}
+        else:
+            sym = models.get_symbol(network="resnet", num_classes=10,
+                                    num_layers=8, image_shape=(3, 8, 8))
+            shapes = {"data": (global_batch, 3, 8, 8),
+                      "softmax_label": (global_batch,)}
     else:
-        sym = models.get_symbol(
-            network="transformer", vocab_size=256, seq_len=seq_len,
-            num_layers=2, num_heads=4, dim=64,
-            seq_axis="sp" if seq_parallel else None,
-            num_experts=num_experts,
-            expert_axis="expert" if num_experts else None)
+        if full_size:
+            sym = models.get_symbol(
+                network="transformer", vocab_size=32768,
+                seq_len=seq_len, num_layers=4, num_heads=16, dim=2048,
+                seq_axis="sp" if seq_parallel else None,
+                num_experts=num_experts,
+                expert_axis="expert" if num_experts else None)
+        else:
+            sym = models.get_symbol(
+                network="transformer", vocab_size=256, seq_len=seq_len,
+                num_layers=2, num_heads=4, dim=64,
+                seq_axis="sp" if seq_parallel else None,
+                num_experts=num_experts,
+                expert_axis="expert" if num_experts else None)
         shapes = {"data": (global_batch, seq_len),
                   "softmax_label": (global_batch, seq_len)}
     step = make_train_step(sym, **kw)
@@ -177,9 +203,13 @@ def main():
         else:
             mesh = make_mesh({"data": n}, devices=devices[:n])
             gb, seq_len = args.per_device_batch * n, 64
+        if args.full_size:
+            seq_len = 2048 if args.network == "transformer_lm" \
+                else seq_len
         step, state, shapes = build_step(args.network, mesh, gb,
                                          args.zero1, args.seq_parallel,
-                                         seq_len, num_experts)
+                                         seq_len, num_experts,
+                                         args.full_size)
         rng_np = np.random.RandomState(0)
         if args.network == "resnet":
             batch = {"data": rng_np.standard_normal(
@@ -197,6 +227,15 @@ def main():
         lowered = step.lower(state, bd, 0.1, rng)
         compiled = lowered.compile()
         coll = collective_bytes(compiled.as_text())
+
+        if args.compile_only:
+            rows.append({"devices": n, "global_batch": gb,
+                         "step_ms": None,
+                         "collective_bytes_per_dev": coll,
+                         "zero1": bool(args.zero1),
+                         "full_size": bool(args.full_size)})
+            print(json.dumps(rows[-1]))
+            continue
 
         state, outs = step(state, bd, 0.1, rng)   # warmup (cached)
         # readback barrier, not block_until_ready: through the axon
@@ -221,6 +260,8 @@ def main():
         rows.append(row)
         print(json.dumps(rows[-1]))
 
+    if args.compile_only:
+        return
     base = rows[0]["step_ms"]
     rate = "tokens_s" if "tokens_s" in rows[0] else "samples_s"
     print("\n| devices | global batch | step ms | %s | "
