@@ -1,0 +1,108 @@
+"""BatchNorm training fwd+bwd microbench: one-pass/closed-form (the
+framework op) vs the naive two-pass autodiff formulation, at
+ResNet-50's dominant BN shapes (batch 128, bf16 activations).
+
+Quantifies the _bn_train_core rewrite (docs/mfu_analysis.md measured BN
+statistics at ~18% of the ResNet-50 step). Run on the TPU when the
+tunnel is up:
+
+    python benchmark/bench_bn.py            # TPU (or BENCH_PLATFORM=cpu)
+
+Chains iterations on device and reads back one scalar (axon-tunnel
+measurement discipline). Prints one JSON line per shape.
+"""
+import json
+import os
+import sys
+import time
+
+_platform = os.environ.get("BENCH_PLATFORM")
+if _platform:
+    os.environ["JAX_PLATFORMS"] = _platform
+import jax  # noqa: E402
+
+if _platform:
+    jax.config.update("jax_platforms", _platform)
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+import jax.numpy as jnp  # noqa: E402
+import numpy as np  # noqa: E402
+
+# (N, C, H, W) — ResNet-50 stage shapes at batch 128
+SHAPES = [
+    (128, 64, 112, 112),
+    (128, 256, 56, 56),
+    (128, 512, 28, 28),
+    (128, 1024, 14, 14),
+    (128, 2048, 7, 7),
+]
+ITERS = int(os.environ.get("BENCH_ITERS", "30"))
+
+
+def naive_bn(x, gamma, beta, eps=1e-3):
+    xf = x.astype(jnp.float32)
+    mean = jnp.mean(xf, axis=(0, 2, 3))
+    var = jnp.var(xf, axis=(0, 2, 3))
+    inv = jax.lax.rsqrt(var[None, :, None, None] + eps)
+    out = (xf - mean[None, :, None, None]) * inv \
+        * gamma.astype(jnp.float32)[None, :, None, None] \
+        + beta.astype(jnp.float32)[None, :, None, None]
+    return out.astype(x.dtype)
+
+
+def framework_bn(x, gamma, beta, eps=1e-3):
+    from mxnet_tpu.ops.nn import _batch_norm
+    C = x.shape[1]
+    return _batch_norm(x, gamma, beta, jnp.zeros(C), jnp.ones(C),
+                       eps=eps, fix_gamma=False, is_train=True)[0]
+
+
+def timed(fn, shape):
+    """fwd+bwd step, CHAINED on device: the loop carries x so iteration
+    i+1 depends on i, and one scalar readback amortizes the tunnel
+    RTT over all iterations."""
+    N, C, H, W = shape
+    rng = np.random.RandomState(0)
+    x0 = jnp.asarray(rng.randn(*shape), jnp.bfloat16)
+    gamma = jnp.ones((C,), jnp.float32)
+    beta = jnp.zeros((C,), jnp.float32)
+    dy = jnp.asarray(rng.randn(*shape), jnp.bfloat16)
+
+    def step(x):
+        def loss(x_, g_, b_):
+            return jnp.sum(fn(x_, g_, b_).astype(jnp.float32)
+                           * dy.astype(jnp.float32))
+        dx, dg, db = jax.grad(loss, argnums=(0, 1, 2))(x, gamma, beta)
+        return dx.astype(x.dtype)      # feeds the next iteration
+
+    @jax.jit
+    def chain(x):
+        return jax.lax.fori_loop(0, ITERS, lambda i, x_: step(x_), x)
+
+    scalar = jax.jit(lambda x: x.ravel()[0])
+    np.asarray(jax.device_get(scalar(chain(x0))))       # compile+warm
+    t0 = time.time()
+    np.asarray(jax.device_get(scalar(chain(x0))))
+    return (time.time() - t0) / ITERS
+
+
+def main():
+    dev = jax.devices()[0].device_kind
+    for shape in SHAPES:
+        t_new = timed(framework_bn, shape)
+        t_old = timed(naive_bn, shape)
+        bytes_tensor = int(np.prod(shape)) * 2      # bf16
+        print(json.dumps({
+            "metric": "batchnorm_train_fwd_bwd",
+            "shape": list(shape),
+            "one_pass_ms": round(t_new * 1e3, 3),
+            "two_pass_ms": round(t_old * 1e3, 3),
+            "speedup": round(t_old / t_new, 3),
+            "tensor_mb": round(bytes_tensor / 1e6, 1),
+            "device_kind": dev}))
+
+
+if __name__ == "__main__":
+    main()

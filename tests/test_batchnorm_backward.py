@@ -1,0 +1,105 @@
+"""BatchNorm one-pass/closed-form training path vs the naive two-pass
+autodiff formulation: outputs, moving-stat updates, and ALL gradients
+(data/gamma/beta) must agree to float32 tightness, across axes and
+fix_gamma. Guards the HBM-traffic rewrite of ops/nn.py:_bn_train_core
+(VERDICT r3 #3: BN stats measured at ~18% of the ResNet-50 step).
+"""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, nd
+
+
+def _naive_bn(x, gamma, beta, eps, axis, fix_gamma):
+    red = tuple(i for i in range(x.ndim) if i != axis)
+    bshape = tuple(x.shape[axis] if i == axis else 1
+                   for i in range(x.ndim))
+    g = jnp.ones_like(gamma) if fix_gamma else gamma
+    xf = x.astype(jnp.float32)
+    mean = jnp.mean(xf, axis=red)
+    var = jnp.var(xf, axis=red)
+    inv = jax.lax.rsqrt(var.reshape(bshape) + eps)
+    out = (xf - mean.reshape(bshape)) * inv * \
+        g.reshape(bshape).astype(jnp.float32) + \
+        beta.reshape(bshape).astype(jnp.float32)
+    return out.astype(x.dtype), mean, var
+
+
+@pytest.mark.parametrize("axis", [1, 3])
+@pytest.mark.parametrize("fix_gamma", [False, True])
+def test_train_bn_matches_naive(axis, fix_gamma):
+    rng = np.random.RandomState(0)
+    x = rng.randn(4, 5, 6, 7).astype(np.float32) * 2.0 + 0.5
+    C = x.shape[axis]
+    gamma = rng.rand(C).astype(np.float32) + 0.5
+    beta = rng.randn(C).astype(np.float32)
+    dy = rng.randn(*x.shape).astype(np.float32)
+    eps = 1e-3
+
+    from mxnet_tpu.ops.nn import _batch_norm
+
+    def framework(x_, g_, b_):
+        out = _batch_norm(jnp.asarray(x_), g_, b_,
+                          jnp.zeros(C), jnp.ones(C), eps=eps,
+                          fix_gamma=fix_gamma, axis=axis,
+                          is_train=True)
+        return out[0]
+
+    def naive(x_, g_, b_):
+        return _naive_bn(jnp.asarray(x_), g_, b_, eps, axis,
+                         fix_gamma)[0]
+
+    y_f = framework(x, gamma, beta)
+    y_n = naive(x, gamma, beta)
+    np.testing.assert_allclose(np.asarray(y_f), np.asarray(y_n),
+                               rtol=2e-5, atol=2e-5)
+
+    def loss_with(fn):
+        def f(x_, g_, b_):
+            return jnp.sum(fn(x_, g_, b_) * dy)
+        return jax.grad(f, argnums=(0, 1, 2))
+
+    gf = loss_with(framework)(x, gamma, beta)
+    gn = loss_with(naive)(x, gamma, beta)
+    for a, b, name in zip(gf, gn, ("dx", "dgamma", "dbeta")):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=2e-4, atol=2e-4,
+            err_msg="%s mismatch (axis=%d fix_gamma=%s)"
+                    % (name, axis, fix_gamma))
+
+
+def test_moving_stats_and_eval_path():
+    """Moving stats update from the one-pass mean/var; eval mode uses
+    them (unchanged path)."""
+    rng = np.random.RandomState(1)
+    x = nd.array(rng.randn(8, 3, 5, 5).astype(np.float32))
+    gamma, beta = nd.ones((3,)), nd.zeros((3,))
+    mm, mv = nd.zeros((3,)), nd.ones((3,))
+    with autograd.record():
+        out = nd.BatchNorm(x, gamma, beta, mm, mv, fix_gamma=False,
+                           momentum=0.9, eps=1e-3)
+    got_mm = mm.asnumpy()
+    want = 0.1 * x.asnumpy().mean(axis=(0, 2, 3))
+    np.testing.assert_allclose(got_mm, want, rtol=1e-5, atol=1e-6)
+
+    # eval: normalize with the (updated) moving stats
+    out_eval = nd.BatchNorm(x, gamma, beta, mm, mv, fix_gamma=False)
+    xn = x.asnumpy()
+    ref = (xn - got_mm[None, :, None, None]) / np.sqrt(
+        mv.asnumpy()[None, :, None, None] + 1e-3)
+    np.testing.assert_allclose(out_eval.asnumpy(), ref, rtol=1e-4,
+                               atol=1e-4)
+
+
+def test_one_pass_var_nonnegative():
+    """E[x^2]-E[x]^2 can go fractionally negative in f32; the clamp
+    must keep rsqrt finite even for constant inputs."""
+    x = jnp.full((4, 2, 8, 8), 3.14159, jnp.float32)
+    from mxnet_tpu.ops.nn import _batch_norm
+    out = _batch_norm(x, jnp.ones(2), jnp.zeros(2), jnp.zeros(2),
+                      jnp.ones(2), eps=1e-3, is_train=True)
+    assert np.isfinite(np.asarray(out[0])).all()

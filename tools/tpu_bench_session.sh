@@ -52,6 +52,9 @@ BENCH_ITERS=5 python bench.py --network transformer_lm --batch 1 \
     --seq-len 32768 --window 4096 \
     | tee -a "$OUT/longcontext.jsonl"; note $? lctx:32768w4096
 
+echo "== 3d0. BatchNorm one-pass vs two-pass microbench =="
+python benchmark/bench_bn.py | tee "$OUT/bn_micro.jsonl"; note $? bn_micro
+
 echo "== 3d. input-pipeline train overlap (net img/s with real decode) =="
 python benchmark/bench_input_pipeline.py --train-overlap \
     --n 512 --batch-size 128 --threads 8 \
