@@ -60,8 +60,12 @@ python benchmark/bench_input_pipeline.py --train-overlap \
     --n 512 --batch-size 128 --threads 8 \
     | tee "$OUT/pipeline_overlap.json"; note $? pipeline_overlap
 
-echo "== 4. raw-JAX control =="
+echo "== 4. raw-JAX controls (resnet-50 + the sub-30%-MFU nets) =="
 python benchmark/raw_jax_resnet.py | tee "$OUT/raw_jax_control.txt"; note $? raw_jax_control
+python benchmark/raw_jax_controls.py --network alexnet \
+    | tee -a "$OUT/raw_jax_control.txt"; note $? raw_jax_alexnet
+python benchmark/raw_jax_controls.py --network inception-v3 \
+    | tee -a "$OUT/raw_jax_control.txt"; note $? raw_jax_inception
 
 echo "== 5. device trace + breakdown =="
 python - <<'PY'
