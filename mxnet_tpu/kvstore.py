@@ -37,6 +37,7 @@ Module/Gluon training loops are unchanged.
 """
 from __future__ import annotations
 
+import os
 import pickle
 
 from .base import string_types
@@ -79,6 +80,16 @@ class KVStore:
         self._updater = None
         self._optimizer = None
         self._barrier_before_exit = True
+        self._async_client = None
+        if kv_type == "dist_async" and \
+                os.environ.get("DMLC_PS_ROOT_URI"):
+            # true async mode: a host-side parameter server applies
+            # each push on arrival (parallel/ps_async.py — the
+            # reference's kvstore_dist_server.h async semantic).
+            # Workers never form a collective; identity comes from the
+            # DMLC env, not jax.distributed.
+            from .parallel.ps_async import AsyncPSClient
+            self._async_client = AsyncPSClient()
 
     def _world(self):
         """Process count when this is a dist store inside a cluster."""
@@ -125,7 +136,10 @@ class KVStore:
     @property
     def rank(self):
         """Worker rank (reference kvstore.py:rank). In-process: 0; the
-        multi-host path reports jax.process_index() via parallel.dist."""
+        multi-host path reports jax.process_index() via parallel.dist;
+        async mode reads the DMLC env (no collective group exists)."""
+        if self._async_client is not None:
+            return int(os.environ.get("DMLC_WORKER_ID", "0"))
         try:
             import jax
             return jax.process_index()
@@ -134,6 +148,8 @@ class KVStore:
 
     @property
     def num_workers(self):
+        if self._async_client is not None:
+            return int(os.environ.get("DMLC_NUM_WORKER", "1"))
         try:
             import jax
             return jax.process_count()
@@ -146,6 +162,17 @@ class KVStore:
         the initial (replicated) weights."""
         keys, _ = _key_list(key)
         vals = _value_list(value, len(keys))
+        if self._async_client is not None:
+            # rank 0's value becomes the server's (reference
+            # KVStoreDist::InitImpl: only rank 0 pushes init); the
+            # barrier makes "initialized" visible to every worker
+            # before anyone pulls
+            for k, vlist in zip(keys, vals):
+                self._reject_sparse_dist(vlist[0], "init")
+                if self.rank == 0:
+                    self._async_client.init(k, vlist[0].asnumpy())
+            self._async_client.barrier()
+            return
         for k, vlist in zip(keys, vals):
             if k in self._store:
                 raise ValueError("duplicate init of key %r" % (k,))
@@ -160,6 +187,17 @@ class KVStore:
         reference kvstore.py:push / comm.h Reduce."""
         keys, _ = _key_list(key)
         vals = _value_list(value, len(keys))
+        if self._async_client is not None:
+            # device-local merge, then ship to the server, which applies
+            # the optimizer IMMEDIATELY — no cross-worker aggregation,
+            # the defining dist_async semantic (kvstore_dist_server.h
+            # sync_mode_=false path)
+            for k, vlist in zip(keys, vals):
+                self._reject_sparse_dist(vlist[0], "push")
+                merged = vlist[0] if len(vlist) == 1 \
+                    else ndarray.add_n(*vlist)
+                self._async_client.push(k, merged.asnumpy())
+            return
         for k, vlist in zip(keys, vals):
             if k not in self._store:
                 raise KeyError("key %r has not been initialized" % (k,))
@@ -197,6 +235,13 @@ class KVStore:
         assert out is not None
         keys, _ = _key_list(key)
         outs = _value_list(out, len(keys))
+        if self._async_client is not None:
+            import jax.numpy as jnp
+            for k, olist in zip(keys, outs):
+                cur = self._async_client.pull(k)   # possibly stale: async
+                for o in olist:
+                    o._set_data(jnp.asarray(cur, dtype=o.dtype))
+            return
         sparse = ndarray.sparse
         for k, olist in zip(keys, outs):
             if k not in self._store:
@@ -263,8 +308,13 @@ class KVStore:
     def set_optimizer(self, optimizer):
         """Run this optimizer on the (logical) server (reference
         kvstore.py:set_optimizer; server side kvstore_dist_server.h:233).
-        In-process and on-mesh this installs the fused-update updater."""
+        In-process and on-mesh this installs the fused-update updater;
+        async mode ships the optimizer to the REAL server process (the
+        reference's controller command channel)."""
         self._optimizer = optimizer
+        if self._async_client is not None:
+            self._async_client.set_optimizer(optimizer)
+            return
         self.set_updater(opt.get_updater(optimizer))
 
     # -- gradient compression (reference has none in 0.11; no-op hook) -----
@@ -285,8 +335,13 @@ class KVStore:
 
     # -- cluster control surface (reference kvstore.py:barrier etc.) -------
     def barrier(self):
-        """Global sync barrier across workers. In-process: no-op; multihost
-        uses the coordinator (parallel.dist)."""
+        """Global sync barrier across workers. In-process: no-op;
+        multihost uses the coordinator (parallel.dist); async mode uses
+        the server's counted barrier (reference ps::Postoffice
+        Barrier)."""
+        if self._async_client is not None:
+            self._async_client.barrier()
+            return
         if self.num_workers > 1:
             import jax
             from jax.experimental import multihost_utils
@@ -296,7 +351,12 @@ class KVStore:
         pass
 
     def __del__(self):
-        pass
+        if getattr(self, "_async_client", None) is not None:
+            try:
+                self._async_client.close()
+            except Exception:  # noqa: BLE001
+                pass
+            self._async_client = None
 
 
 def create(name="local"):

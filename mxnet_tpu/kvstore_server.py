@@ -67,9 +67,31 @@ def _init_kvstore_server_module():
     """Start the server loop iff this process was launched with the
     server role (reference kvstore_server.py:_init_kvstore_server_module
     checks DMLC_ROLE)."""
+    if os.environ.get("MXNET_PS_SERVING") == "1":
+        # we ARE the re-exec'd async server script (below); let the
+        # package import finish so it can serve afterwards
+        return False
     role = os.environ.get("DMLC_ROLE", "worker")
     if role in ("server", "scheduler"):
         import sys
+        if role == "server" and os.environ.get(
+                "MXNET_KVSTORE_TYPE", "") == "dist_async":
+            # async mode: this process IS a real parameter server — it
+            # owns the weights and applies pushes on arrival
+            # (parallel/ps_async.py; reference kvstore_dist_server.h
+            # async path). Serving CANNOT start here: this function
+            # runs inside the mxnet_tpu package import, whose import
+            # lock is then held for the server's lifetime — any lazy
+            # `from .. import X` in an optimizer-applying handler
+            # thread would deadlock on it (measured via faulthandler).
+            # Re-exec a fresh interpreter that finishes the package
+            # import FIRST, then serves.
+            os.environ["MXNET_PS_SERVING"] = "1"
+            os.execv(sys.executable, [
+                sys.executable, "-c",
+                "import mxnet_tpu\n"
+                "from mxnet_tpu.parallel import ps_async\n"
+                "ps_async.serve_forever()\n"])
         from . import kvstore
         server = KVStoreServer(kvstore.create("dist"))
         server.run()

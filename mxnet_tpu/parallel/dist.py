@@ -6,8 +6,9 @@ env vars (DMLC_ROLE, DMLC_PS_ROOT_URI...). TPU-native: every host runs the
 SAME SPMD program; `jax.distributed.initialize` (coordinator address +
 process id) replaces the scheduler; the global device mesh spans hosts over
 DCN and collectives replace push/pull. `dist_async` (server applies updates
-as they arrive) has no XLA analogue and is a documented drop — use
-`dist_sync` semantics (the reference's recommended mode for convergence).
+as they arrive) is deliberately not a collective: it runs as a host-side
+parameter server instead (parallel/ps_async.py; the server role in
+kvstore_server.py serves it when MXNET_KVSTORE_TYPE=dist_async).
 
 Env compat shims: DMLC_* vars map onto the JAX coordinator so reference
 launch scripts keep working.

@@ -1,0 +1,175 @@
+"""dist_async kvstore: a REAL host-side parameter server applying each
+push on arrival — the reference's kvstore_dist_server.h async mode
+(sync_mode_=false), previously a documented drop. In-thread unit tests
+for the server protocol + a 1-server/2-worker multiprocess test of the
+full mx.kv.create("dist_async") surface.
+
+The defining assertion: a worker that pushes and immediately pulls sees
+its own update WITHOUT any other worker participating — no aggregation
+barrier exists (dist_sync would block in the cross-worker collective).
+"""
+import os
+import socket
+import subprocess
+import sys
+import threading
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.parallel.ps_async import AsyncPSClient, AsyncPSServer
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+@pytest.fixture
+def server():
+    srv = AsyncPSServer(host="127.0.0.1", port=0, num_workers=2)
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    yield srv
+    srv.stop()
+
+
+def _client(srv):
+    return AsyncPSClient(host="127.0.0.1", port=srv.port)
+
+
+def test_push_replaces_without_optimizer(server):
+    c = _client(server)
+    c.init("w", np.full((3,), 5.0, np.float32))
+    np.testing.assert_allclose(c.pull("w"), 5.0)
+    c.push("w", np.full((3,), 2.0, np.float32))
+    np.testing.assert_allclose(c.pull("w"), 2.0)   # replaced, not summed
+    c.close()
+
+
+def test_async_apply_with_server_side_optimizer(server):
+    a, b = _client(server), _client(server)
+    a.set_optimizer(mx.optimizer.SGD(learning_rate=0.1))
+    a.init("w", np.ones((4,), np.float32))
+    # a pushes and immediately sees the applied update — no b involved
+    a.push("w", np.ones((4,), np.float32))
+    np.testing.assert_allclose(a.pull("w"), 0.9, rtol=1e-6)
+    # b's push lands on a's result: updates serialize, never aggregate
+    b.push("w", np.full((4,), 2.0, np.float32))
+    np.testing.assert_allclose(b.pull("w"), 0.7, rtol=1e-6)
+    np.testing.assert_allclose(a.pull("w"), 0.7, rtol=1e-6)
+    a.close()
+    b.close()
+
+
+def test_init_first_writer_wins(server):
+    a, b = _client(server), _client(server)
+    a.init("w", np.zeros((2,), np.float32))
+    b.init("w", np.ones((2,), np.float32))      # ignored: already there
+    np.testing.assert_allclose(b.pull("w"), 0.0)
+    a.close()
+    b.close()
+
+
+def test_barrier_counts_workers(server):
+    a, b = _client(server), _client(server)
+    hits = []
+
+    def wait_then_barrier():
+        b.barrier()
+        hits.append("b")
+
+    t = threading.Thread(target=wait_then_barrier, daemon=True)
+    t.start()
+    assert not hits              # b is blocked until a arrives
+    a.barrier()
+    t.join(timeout=10)
+    assert hits == ["b"]
+    a.close()
+    b.close()
+
+
+_WORKER_SRC = r"""
+import os, sys, time
+sys.path.insert(0, os.environ["REPO"])
+import numpy as np
+import mxnet_tpu as mx
+
+rank = int(os.environ["DMLC_WORKER_ID"])
+kv = mx.kv.create("dist_async")
+assert kv.type == "dist_async"
+assert kv.rank == rank and kv.num_workers == 2
+
+if rank == 0:
+    kv.set_optimizer(mx.optimizer.SGD(learning_rate=0.1))
+kv.init("w", mx.nd.ones((2, 3)))        # internal barrier: optimizer set
+out = mx.nd.zeros((2, 3))
+kv.pull("w", out=out)
+np.testing.assert_allclose(out.asnumpy(), 1.0)
+
+if rank == 0:
+    # ASYNC: push then pull with worker 1 idle — must see own update
+    kv.push("w", mx.nd.ones((2, 3)))
+    kv.pull("w", out=out)
+    np.testing.assert_allclose(out.asnumpy(), 0.9, rtol=1e-6)
+kv.barrier()
+if rank == 1:
+    kv.push("w", mx.nd.ones((2, 3)) * 2)
+kv.barrier()
+kv.pull("w", out=out)
+np.testing.assert_allclose(out.asnumpy(), 0.7, rtol=1e-6)
+print("ASYNC_WORKER_OK", rank)
+"""
+
+_SERVER_SRC = r"""
+import os, sys
+sys.path.insert(0, os.environ["REPO"])
+from mxnet_tpu.kvstore_server import _init_kvstore_server_module
+_init_kvstore_server_module()
+"""
+
+
+def test_dist_async_multiprocess(tmp_path):
+    port = _free_port()
+    base_env = dict(os.environ)
+    base_env.update({
+        "REPO": REPO,
+        "PYTHONPATH": REPO,            # drop the axon plugin site
+        "JAX_PLATFORMS": "cpu",
+        "DMLC_PS_ROOT_URI": "127.0.0.1",
+        "DMLC_PS_ROOT_PORT": str(port),
+        "DMLC_NUM_WORKER": "2",
+        "MXNET_KVSTORE_TYPE": "dist_async",
+    })
+    (tmp_path / "server.py").write_text(_SERVER_SRC)
+    (tmp_path / "worker.py").write_text(_WORKER_SRC)
+
+    senv = dict(base_env, DMLC_ROLE="server")
+    server = subprocess.Popen(
+        [sys.executable, str(tmp_path / "server.py")], env=senv,
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True)
+    workers = []
+    try:
+        for wid in range(2):
+            wenv = dict(base_env, DMLC_ROLE="worker",
+                        DMLC_WORKER_ID=str(wid))
+            workers.append(subprocess.Popen(
+                [sys.executable, str(tmp_path / "worker.py")],
+                env=wenv, stdout=subprocess.PIPE,
+                stderr=subprocess.STDOUT, text=True))
+        for wid, w in enumerate(workers):
+            out, _ = w.communicate(timeout=180)
+            assert w.returncode == 0, "worker %d:\n%s" % (wid, out[-900:])
+            assert "ASYNC_WORKER_OK %d" % wid in out
+        sout, _ = server.communicate(timeout=60)   # exits after 2 byes
+        assert server.returncode == 0, "server:\n%s" % sout[-900:]
+    finally:
+        for p in workers + [server]:
+            if p.poll() is None:
+                p.kill()
