@@ -1,0 +1,172 @@
+"""Actor-critic policy gradient — reference example/reinforcement-
+learning/parallel_actor_critic (Module-era A2C; its dqn/ddpg siblings
+need external simulators, this one is self-contained like ours).
+
+Environment (built in, no dependency): a 1-D corridor of N cells; the
+agent starts in a random cell, the goal sits at the right end; actions
+move left/right; reward -1 per step, +10 at the goal, 40-step cap.
+Optimal policy: always move right.
+
+Exercises the imperative RL seam: per-step action SAMPLING from the
+policy head, trajectory collection outside the graph, then ONE
+autograd.record() pass over the stacked trajectory with the policy-
+gradient surrogate loss (log-prob x advantage) plus a value-baseline
+MSE — the pattern every reference RL example builds from.
+
+Self-checking: after training, greedy rollouts must reach the goal in
+<= 1.3x the optimal step count on average. Run:
+python examples/actor_critic.py
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+import argparse
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, nd
+
+N_CELLS = 12
+MAX_STEPS = 40
+
+
+class Corridor:
+    def __init__(self, rng):
+        self.rng = rng
+
+    def reset(self):
+        self.pos = int(self.rng.randint(0, N_CELLS - 1))
+        self.t = 0
+        return self.pos
+
+    def step(self, action):                  # 0 = left, 1 = right
+        self.pos = max(0, min(N_CELLS - 1,
+                              self.pos + (1 if action == 1 else -1)))
+        self.t += 1
+        done = self.pos == N_CELLS - 1 or self.t >= MAX_STEPS
+        reward = 10.0 if self.pos == N_CELLS - 1 else -1.0
+        return self.pos, reward, done
+
+
+def one_hot(states):
+    out = np.zeros((len(states), N_CELLS), np.float32)
+    out[np.arange(len(states)), states] = 1.0
+    return out
+
+
+class ActorCritic:
+    """Shared trunk, policy + value heads; plain NDArrays with
+    attach_grad (the imperative API end to end)."""
+
+    def __init__(self, rng, hidden=32):
+        def init(shape, scale):
+            return nd.array(rng.randn(*shape).astype(np.float32) * scale)
+
+        self.params = {
+            "w1": init((hidden, N_CELLS), 0.3),
+            "b1": nd.zeros((hidden,)),
+            "wp": init((2, hidden), 0.1),
+            "bp": nd.zeros((2,)),
+            "wv": init((1, hidden), 0.1),
+            "bv": nd.zeros((1,)),
+        }
+        for p in self.params.values():
+            p.attach_grad()
+
+    def forward(self, x):
+        h = nd.relu(nd.FullyConnected(x, self.params["w1"],
+                                      self.params["b1"],
+                                      num_hidden=self.params["w1"].shape[0]))
+        logits = nd.FullyConnected(h, self.params["wp"],
+                                   self.params["bp"], num_hidden=2)
+        value = nd.FullyConnected(h, self.params["wv"],
+                                  self.params["bv"], num_hidden=1)
+        return logits, value
+
+    def act(self, state, rng):
+        logits, _ = self.forward(nd.array(one_hot([state])))
+        p = np.asarray(nd.softmax(logits).asnumpy()).ravel()
+        return int(rng.choice(2, p=p / p.sum()))
+
+    def greedy(self, state):
+        logits, _ = self.forward(nd.array(one_hot([state])))
+        return int(logits.asnumpy().argmax())
+
+
+def run_episode(env, agent, rng):
+    states, actions, rewards = [], [], []
+    s = env.reset()
+    done = False
+    while not done:
+        a = agent.act(s, rng)
+        s2, r, done = env.step(a)
+        states.append(s)
+        actions.append(a)
+        rewards.append(r)
+        s = s2
+    return states, actions, rewards
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--episodes", type=int, default=300)
+    p.add_argument("--lr", type=float, default=0.05)
+    p.add_argument("--gamma", type=float, default=0.98)
+    args = p.parse_args()
+
+    rng = np.random.RandomState(0)
+    env = Corridor(rng)
+    agent = ActorCritic(rng)
+
+    for ep in range(args.episodes):
+        states, actions, rewards = run_episode(env, agent, rng)
+        # discounted returns
+        G, ret = 0.0, []
+        for r in reversed(rewards):
+            G = r + args.gamma * G
+            ret.append(G)
+        ret = np.array(ret[::-1], np.float32)
+
+        x = nd.array(one_hot(states))
+        a = nd.array(np.array(actions, np.float32))
+        g = nd.array(ret)
+        with autograd.record():
+            logits, value = agent.forward(x)
+            logp = nd.log_softmax(logits)                 # (T, 2)
+            chosen = nd.pick(logp, a)                     # (T,)
+            adv = g - nd.BlockGrad(nd.Flatten(value).reshape((-1,)))
+            pg_loss = -(chosen * adv).mean()
+            v_loss = nd.square(
+                nd.Flatten(value).reshape((-1,)) - g).mean()
+            loss = pg_loss + 0.5 * v_loss
+        loss.backward()
+        for name, prm in agent.params.items():
+            nd.sgd_update(prm, prm.grad, lr=args.lr, out=prm)
+        if (ep + 1) % 100 == 0:
+            print("episode %d steps %d return %.1f" % (
+                ep + 1, len(rewards), sum(rewards)))
+
+    # -- gate: greedy policy near-optimal -----------------------------------
+    eval_rng = np.random.RandomState(7)
+    env_eval = Corridor(eval_rng)
+    ratios = []
+    for _ in range(40):
+        s = env_eval.reset()
+        optimal = max(1, (N_CELLS - 1) - s)
+        steps, done = 0, False
+        while not done and steps < MAX_STEPS:
+            s, _r, done = env_eval.step(agent.greedy(s))
+            steps += 1
+        ratios.append(steps / optimal)
+    avg = float(np.mean(ratios))
+    print("avg steps / optimal: %.3f" % avg)
+    assert avg <= 1.3, "policy gate failed: %.3f" % avg
+    print("actor_critic: PASS")
+
+
+if __name__ == "__main__":
+    main()

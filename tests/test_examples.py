@@ -21,6 +21,8 @@ FAST_EXAMPLES = [
     "rcnn_train.py",
     "fcn_xs.py",
     "nce_loss.py",
+    "actor_critic.py",
+    "multi_task.py",
 ]
 
 
