@@ -66,10 +66,23 @@ void set_error_from_python() {
 // these four functions instead of fingering package internals from C.
 const char* kGlue = R"PY(
 import numpy as np
-from mxnet_tpu.predictor import CompiledPredictor
+
+def _load_predictor(prefix):
+    # amalgamated deployments ship mxtpu_predict_min.py NEXT TO the
+    # model (tools/amalgamate.py) so no framework source is needed at
+    # run time; a full install falls back to the framework class
+    import os, sys
+    d = os.path.dirname(os.path.abspath(prefix))
+    if d and d not in sys.path:
+        sys.path.insert(0, d)
+    try:
+        from mxtpu_predict_min import CompiledPredictor
+    except ImportError:
+        from mxnet_tpu.predictor import CompiledPredictor
+    return CompiledPredictor.load(prefix)
 
 def _create(prefix):
-    p = CompiledPredictor.load(prefix)
+    p = _load_predictor(prefix)
     return {"p": p, "inputs": {}, "outputs": None, "meta": p._meta}
 
 def _set_input(h, key, buf):

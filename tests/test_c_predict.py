@@ -98,6 +98,51 @@ def test_c_predict_matches_python(c_binary, tmp_path):
     np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
 
 
+def test_amalgamated_bundle(tmp_path):
+    """tools/amalgamate.py: the bundle builds and predicts with the
+    FRAMEWORK SOURCE ABSENT from PYTHONPATH — the reference
+    amalgamation's 'deploy without the framework' property (N29)."""
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    try:
+        import amalgamate
+    finally:
+        sys.path.pop(0)
+
+    net, args = _small_model()
+    pred = Predictor(net, args, data_names=("data",))
+    x = np.random.RandomState(3).standard_normal((2, 8)).astype(
+        np.float32)
+    want = np.asarray(pred.forward(x)[0].asnumpy(), np.float32)
+    prefix = str(tmp_path / "export" / "m")
+    os.makedirs(os.path.dirname(prefix))
+    pred.export(prefix, {"data": (2, 8)})
+
+    bundle = str(tmp_path / "bundle")
+    amalgamate.amalgamate(prefix, bundle)
+    r = subprocess.run(["sh", os.path.join(bundle, "build.sh")],
+                       capture_output=True, text=True, timeout=180)
+    if r.returncode != 0:
+        pytest.skip("bundle build failed (toolchain): %s"
+                    % r.stderr[-300:])
+
+    raw = tmp_path / "input.f32"
+    raw.write_bytes(x.tobytes())
+    env = dict(os.environ)
+    env["PYTHONPATH"] = ""            # NO framework source anywhere
+    env["JAX_PLATFORMS"] = "cpu"
+    r = subprocess.run(
+        [os.path.join(bundle, "predict"),
+         os.path.join(bundle, "model"), str(raw), str(x.size)],
+        capture_output=True, text=True, timeout=300, env=env)
+    assert r.returncode == 0, "bundle predict failed: %s" % \
+        r.stderr[-500:]
+    lines = r.stdout.strip().splitlines()
+    shape = tuple(int(v) for v in lines[0].split("shape")[1].split())
+    got = np.array([float(v) for v in
+                    lines[1:1 + want.size]]).reshape(shape)
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+
+
 def test_c_predict_error_surface(c_binary, tmp_path):
     """A bad model prefix must fail with a real error message through
     MXTpuGetLastError, not crash."""
