@@ -1,0 +1,87 @@
+// Header-only C++ wrapper over the MXTpuPred C ABI — the predict-only
+// analogue of the reference's cpp-package (SURVEY N28:
+// cpp-package/include/mxnet-cpp/*, a header-only front end over the C
+// ABI). Training lives in Python/JAX by design; deployment-side C++
+// gets a typed RAII surface:
+//
+//   #include "mxtpu_cpp.hpp"
+//   mxtpu::Predictor pred("model");            // model.stablehlo + meta
+//   pred.set_input("data", buf);               // std::vector<float>
+//   pred.forward();
+//   std::vector<float> out = pred.output(0);
+//   std::vector<uint32_t> shape = pred.output_shape(0);
+//
+// Link against libpredict_shim.so (build_predict_shim() or an
+// amalgamated bundle's build.sh).
+#pragma once
+
+#include <cstdint>
+#include <stdexcept>
+#include <string>
+#include <vector>
+
+extern "C" {
+void* MXTpuPredCreate(const char* model_prefix);
+int MXTpuPredSetInput(void* h, const char* key, const float* data,
+                      uint64_t size);
+int MXTpuPredForward(void* h);
+int MXTpuPredGetOutputShape(void* h, uint32_t index, uint32_t* shape,
+                            uint32_t* ndim);
+int MXTpuPredGetOutput(void* h, uint32_t index, float* data,
+                       uint64_t size);
+void MXTpuPredFree(void* h);
+const char* MXTpuGetLastError(void);
+}
+
+namespace mxtpu {
+
+class Error : public std::runtime_error {
+ public:
+  explicit Error(const std::string& what)
+      : std::runtime_error(what + ": " + MXTpuGetLastError()) {}
+};
+
+class Predictor {
+ public:
+  explicit Predictor(const std::string& model_prefix)
+      : handle_(MXTpuPredCreate(model_prefix.c_str())) {
+    if (!handle_) throw Error("MXTpuPredCreate");
+  }
+  ~Predictor() { MXTpuPredFree(handle_); }
+  Predictor(const Predictor&) = delete;
+  Predictor& operator=(const Predictor&) = delete;
+  Predictor(Predictor&& o) noexcept : handle_(o.handle_) {
+    o.handle_ = nullptr;
+  }
+
+  void set_input(const std::string& key, const std::vector<float>& v) {
+    if (MXTpuPredSetInput(handle_, key.c_str(), v.data(), v.size()))
+      throw Error("MXTpuPredSetInput(" + key + ")");
+  }
+
+  void forward() {
+    if (MXTpuPredForward(handle_)) throw Error("MXTpuPredForward");
+  }
+
+  std::vector<uint32_t> output_shape(uint32_t index) const {
+    uint32_t shape[8];
+    uint32_t ndim = 8;
+    if (MXTpuPredGetOutputShape(handle_, index, shape, &ndim))
+      throw Error("MXTpuPredGetOutputShape");
+    return std::vector<uint32_t>(shape, shape + ndim);
+  }
+
+  std::vector<float> output(uint32_t index) const {
+    uint64_t total = 1;
+    for (uint32_t d : output_shape(index)) total *= d;
+    std::vector<float> out(total);
+    if (MXTpuPredGetOutput(handle_, index, out.data(), total))
+      throw Error("MXTpuPredGetOutput");
+    return out;
+  }
+
+ private:
+  void* handle_;
+};
+
+}  // namespace mxtpu

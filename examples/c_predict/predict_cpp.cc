@@ -1,0 +1,52 @@
+// C++ host example over mxtpu_cpp.hpp (the predict-only cpp-package
+// analogue). Same CLI contract as predict.c; CI diffs both against the
+// in-process Python forward.
+//
+//   g++ -std=c++17 predict_cpp.cc -o predict_cpp \
+//       -L<_native> -lpredict_shim -Wl,-rpath,<_native>
+#include <cstdio>
+#include <cstdlib>
+#include <fstream>
+
+#include "mxtpu_cpp.hpp"
+
+int main(int argc, char** argv) {
+  if (argc != 4) {
+    std::fprintf(stderr,
+                 "usage: %s <model_prefix> <input.f32> <num_floats>\n",
+                 argv[0]);
+    return 2;
+  }
+  uint64_t n = std::strtoull(argv[3], nullptr, 10);
+  std::vector<float> input(n);
+  std::ifstream f(argv[2], std::ios::binary);
+  if (!f.read(reinterpret_cast<char*>(input.data()),
+              n * sizeof(float))) {
+    std::fprintf(stderr, "cannot read %llu floats from %s\n",
+                 (unsigned long long)n, argv[2]);
+    return 2;
+  }
+
+  try {
+    mxtpu::Predictor pred(argv[1]);
+    pred.set_input("data", input);
+    pred.forward();
+    for (uint32_t i = 0;; ++i) {
+      std::vector<uint32_t> shape;
+      try {
+        shape = pred.output_shape(i);
+      } catch (const mxtpu::Error&) {
+        if (i == 0) throw;
+        break;
+      }
+      std::printf("output %u shape", i);
+      for (uint32_t d : shape) std::printf(" %u", d);
+      std::printf("\n");
+      for (float v : pred.output(i)) std::printf("%.8g\n", v);
+    }
+  } catch (const std::exception& e) {
+    std::fprintf(stderr, "error: %s\n", e.what());
+    return 1;
+  }
+  return 0;
+}

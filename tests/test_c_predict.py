@@ -98,6 +98,44 @@ def test_c_predict_matches_python(c_binary, tmp_path):
     np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
 
 
+def test_cpp_wrapper_matches_python(shim, tmp_path):
+    """mxtpu_cpp.hpp (the predict-only cpp-package analogue, N28):
+    the RAII C++ host must match the in-process Python forward."""
+    native_dir = os.path.dirname(shim)
+    src = os.path.join(REPO, "examples", "c_predict", "predict_cpp.cc")
+    binary = str(tmp_path / "predict_cpp")
+    r = subprocess.run(
+        ["g++", "-std=c++17", src, "-o", binary,
+         "-I%s" % os.path.dirname(src), "-L%s" % native_dir,
+         "-lpredict_shim", "-Wl,-rpath,%s" % native_dir],
+        capture_output=True, text=True, timeout=120)
+    if r.returncode != 0:
+        pytest.skip("cannot build C++ host: %s" % r.stderr[-300:])
+
+    net, args = _small_model()
+    pred = Predictor(net, args, data_names=("data",))
+    x = np.random.RandomState(5).standard_normal((2, 8)).astype(
+        np.float32)
+    want = np.asarray(pred.forward(x)[0].asnumpy(), np.float32)
+    prefix = str(tmp_path / "model")
+    pred.export(prefix, {"data": (2, 8)})
+    raw = tmp_path / "input.f32"
+    raw.write_bytes(x.tobytes())
+
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO
+    env["JAX_PLATFORMS"] = "cpu"
+    r = subprocess.run([binary, prefix, str(raw), str(x.size)],
+                       capture_output=True, text=True, timeout=300,
+                       env=env)
+    assert r.returncode == 0, "C++ host failed: %s" % r.stderr[-500:]
+    lines = r.stdout.strip().splitlines()
+    shape = tuple(int(v) for v in lines[0].split("shape")[1].split())
+    got = np.array([float(v) for v in
+                    lines[1:1 + want.size]]).reshape(shape)
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+
+
 def test_amalgamated_bundle(tmp_path):
     """tools/amalgamate.py: the bundle builds and predicts with the
     FRAMEWORK SOURCE ABSENT from PYTHONPATH — the reference
