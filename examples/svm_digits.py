@@ -1,0 +1,79 @@
+"""SVM output layer — reference example/svm_mnist (trains an MLP whose
+head is SVMOutput, the margin/hinge loss, instead of softmax; the
+example exists to exercise that op end to end).
+
+Data: the committed real handwritten-digit fixture. Both SVM modes are
+trained — L2-regularized squared hinge (default) and L1 hinge
+(use_linear=True) — and both must clear the accuracy gate.
+
+Run: python examples/svm_digits.py
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+import argparse
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import io
+
+FIXTURE = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "tests", "fixtures", "digits_8x8.npz")
+
+
+def svm_symbol(use_linear):
+    data = mx.sym.Variable("data")
+    net = mx.sym.Activation(mx.sym.FullyConnected(
+        data, num_hidden=64, name="fc1"), act_type="relu")
+    net = mx.sym.FullyConnected(net, num_hidden=10, name="fc2")
+    return mx.sym.SVMOutput(net, name="svm", margin=1.0,
+                            regularization_coefficient=1.0,
+                            use_linear=use_linear)
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--epochs", type=int, default=15)
+    p.add_argument("--batch-size", type=int, default=64)
+    args = p.parse_args()
+    B = args.batch_size
+
+    with np.load(FIXTURE) as z:
+        X = z["images"].astype(np.float32).reshape(-1, 64) / 16.0
+        y = z["labels"].astype(np.float32)
+    test = np.arange(len(y)) % 5 == 0
+    Xtr, ytr, Xte, yte = X[~test], y[~test], X[test], y[test]
+
+    for use_linear, name in ((False, "L2 squared-hinge"),
+                             (True, "L1 hinge")):
+        train = io.NDArrayIter(Xtr, ytr, batch_size=B, shuffle=True,
+                               label_name="svm_label")
+        mod = mx.mod.Module(svm_symbol(use_linear), context=mx.cpu(),
+                            label_names=("svm_label",))
+        mod.fit(train, num_epoch=args.epochs, optimizer="sgd",
+                initializer=mx.init.Xavier(),
+                optimizer_params={"learning_rate": 0.05,
+                                  "momentum": 0.9,
+                                  "rescale_grad": 1.0 / B})
+        it = io.NDArrayIter(Xte, yte, batch_size=B,
+                            label_name="svm_label")
+        correct = total = 0
+        for batch in it:
+            mod.forward(batch, is_train=False)
+            scores = mod.get_outputs()[0].asnumpy()
+            n = min(B, len(yte) - total)
+            correct += int((scores.argmax(1)[:n] ==
+                            batch.label[0].asnumpy()[:n]).sum())
+            total += n
+        acc = correct / total
+        print("%s: held-out accuracy %.3f" % (name, acc))
+        assert acc > 0.90, "%s gate failed: %.3f" % (name, acc)
+    print("svm_digits: PASS")
+
+
+if __name__ == "__main__":
+    main()

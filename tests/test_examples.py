@@ -23,6 +23,8 @@ FAST_EXAMPLES = [
     "nce_loss.py",
     "actor_critic.py",
     "multi_task.py",
+    "svm_digits.py",
+    "vae.py",
 ]
 
 
