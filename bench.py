@@ -451,10 +451,17 @@ def bench_decode(args):
     # lengths and difference them, so the (identical) prefill cost
     # cancels and the metric is PURE decode tokens/s
     N_SHORT = max(1, N // 8)
+    beam = int(args.beam or 0)
+    if beam:
+        metric = "transformer_lm_beam%d_decode_throughput" % beam
+        run = lambda n, i: gen.beam_search_on_device(prompt, n,
+                                                     beam_size=beam)
+    else:
+        run = lambda n, i: gen.generate_on_device(prompt, n, seed=i)
     try:
-        out = gen.generate_on_device(prompt, N)   # compile + warmup
+        out = run(N, 0)                           # compile + warmup
         assert out.shape == (B, P + N)
-        gen.generate_on_device(prompt, N_SHORT)   # compile short
+        run(N_SHORT, 0)                           # compile short
     except Exception as e:  # noqa: BLE001
         _fail(metric, "compile_warmup", e)
 
@@ -463,7 +470,7 @@ def bench_decode(args):
     def timed(n_tok):
         t0 = time.time()
         for i in range(iters):
-            gen.generate_on_device(prompt, n_tok, seed=i)
+            run(n_tok, i)
         return (time.time() - t0) / iters         # output is host numpy
 
     dt_long = timed(N)
@@ -478,6 +485,7 @@ def bench_decode(args):
         "ms_per_token": round(dt_decode / (N - N_SHORT) * 1e3, 3),
         "end_to_end_tokens_s": round(B * N / dt_long, 2),
         "batch": B, "prompt_len": P, "new_tokens": N,
+        "beam": beam or None,
         "dim": D, "layers": L, "compute_dtype": dtype,
         "quantize": args.quantize,
         "device_kind": getattr(dev, "device_kind", "unknown")}))
@@ -507,9 +515,15 @@ def main():
                    help="with --decode: weight-only int8 (halved "
                         "weight HBM traffic on the bandwidth-bound "
                         "decode path)")
+    p.add_argument("--beam", type=int, default=None,
+                   help="with --decode: on-device beam search width "
+                        "(beams fold into the batch; tokens/s counts "
+                        "emitted sequences, not beams)")
     args = p.parse_args()
     if args.quantize and not args.decode:
         p.error("--quantize applies to --decode only")
+    if args.beam and not args.decode:
+        p.error("--beam applies to --decode only")
     global _DEFAULT_CONFIG
     _DEFAULT_CONFIG = (
         args.batch is None and args.seq_len is None

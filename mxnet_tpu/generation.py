@@ -324,6 +324,134 @@ class Generator:
         out = tokens[np.arange(B), best]               # (B, gen_len)
         return np.concatenate([prompt.astype(np.int64), out], axis=1)
 
+    def beam_search_on_device(self, prompt, max_new_tokens,
+                              beam_size=4, length_penalty=0.0,
+                              eos_id=None):
+        """beam_search compiled into ONE device program: prefill + a
+        lax.scan whose body does the (W*V) top-k, reorders the token
+        history AND the KV caches by the surviving beams' parent
+        indices (a batch-axis gather), and runs the next forward — no
+        per-token host round-trips (the host-loop beam_search pays one
+        dispatch per step, which through a remote link is RTT-bound).
+
+        Same selection semantics as beam_search; fixed trip count (eos
+        freezes beams — they extend with free eos tokens — but cannot
+        early-exit a scan, so the output is always P + n long where the
+        host loop may return shorter once every beam froze). Each
+        distinct
+        (prompt_len, max_new_tokens, beam_size, eos_id) compiles once.
+        Returns (B, P + n) ids."""
+        prompt, P = self._check_prompt(prompt, max_new_tokens)
+        B, W = self.batch_size, int(beam_size)
+        if W < 1:
+            raise ValueError("beam_size must be >= 1")
+        n = int(max_new_tokens)
+        if n == 0:
+            return np.asarray(prompt, np.int64)
+        fn = self._beam_loop(P, n, W,
+                             -1 if eos_id is None else int(eos_id))
+        tokens, scores = fn(jnp.asarray(prompt, jnp.float32))
+        tokens = np.asarray(tokens)            # (B, W, n)
+        scores = np.asarray(scores)            # (B, W)
+
+        # length-penalty + best-beam selection on host, sharing the
+        # host beam_search's exact formulation
+        if length_penalty:
+            lens = np.full((B, W), n, np.float64)
+            if eos_id is not None:
+                is_eos = tokens == eos_id
+                has = is_eos.any(axis=2)
+                lens[has] = is_eos.argmax(axis=2)[has] + 1
+            norm = scores / np.maximum(1.0,
+                                       lens) ** float(length_penalty)
+        else:
+            norm = scores
+        best = norm.argmax(axis=1)
+        out = tokens[np.arange(B), best].astype(np.int64)
+        return np.concatenate([prompt.astype(np.int64), out], axis=1)
+
+    def _beam_loop(self, P, n, W, eos):
+        key_ = ("beam", P, n, W, eos)
+        cached = self._loop_cache.get(key_)
+        if cached is not None:
+            return cached
+        eval_fn = self._eval_fn
+        params = self._params
+        B, V = self.batch_size, self.vocab_size
+
+        def fwd(aux, data, pos):
+            args = dict(params)
+            args["data"] = data.astype(jnp.float32)
+            args["positions"] = jnp.full((1,), pos, jnp.float32)
+            args["cache_pos"] = jnp.full((1,), pos, jnp.float32)
+            outs, aux = eval_fn(args, aux, jax.random.PRNGKey(0),
+                                False)
+            return jax.nn.log_softmax(
+                outs[0][:, -1].astype(jnp.float32), axis=-1), aux
+
+        def select(logp, scores, tokens, frozen, i):
+            """One beam step: (W*V) top-k + history reorder."""
+            if eos >= 0:
+                free = jnp.full((V,), -jnp.inf).at[eos].set(0.0)
+                logp = jnp.where(frozen[:, :, None], free[None, None],
+                                 logp)
+            flat = (scores[:, :, None] + logp).reshape(B, W * V)
+            top_scores, top_idx = jax.lax.top_k(flat, W)
+            parent = top_idx // V
+            tok = top_idx % V
+            tokens = jnp.take_along_axis(tokens, parent[:, :, None],
+                                         axis=1)
+            tokens = tokens.at[:, :, i].set(tok.astype(jnp.int32))
+            if eos >= 0:
+                frozen = jnp.take_along_axis(frozen, parent, axis=1) \
+                    | (tok == eos)
+            return top_scores, tokens, frozen, parent, tok
+
+        def run(prompt):
+            aux = self._fresh_aux()
+            args = dict(params)
+            args["data"] = prompt
+            args["positions"] = jnp.arange(P, dtype=jnp.float32)
+            args["cache_pos"] = jnp.zeros((1,), jnp.float32)
+            outs, aux = eval_fn(args, aux, jax.random.PRNGKey(0),
+                                False)
+            logp = jax.nn.log_softmax(
+                outs[0][:, -1].astype(jnp.float32), axis=-1)  # (B, V)
+            # beams fold into batch: caches at B*W, all sharing the
+            # prefill; duplicate beams start at -inf so step 1 picks W
+            # distinct first tokens (host beam_search's trick)
+            aux = {k: jnp.repeat(v, W, axis=0) for k, v in aux.items()}
+            logp = jnp.repeat(logp, W, axis=0).reshape(B, W, V)
+            scores = jnp.where(jnp.arange(W) == 0, 0.0,
+                               -jnp.inf)[None, :].repeat(B, axis=0)
+            tokens = jnp.zeros((B, W, n), jnp.int32)
+            frozen = jnp.zeros((B, W), bool)
+
+            def body(carry, i):
+                aux, logp, scores, tokens, frozen = carry
+                scores, tokens, frozen, parent, tok = select(
+                    logp, scores, tokens, frozen, i)
+                flat_idx = (jnp.arange(B)[:, None] * W
+                            + parent).reshape(-1)
+                aux = {k: jnp.take(v, flat_idx, axis=0)
+                       for k, v in aux.items()}
+                logp, aux = fwd(aux, tok.reshape(-1, 1), P + i)
+                logp = logp.reshape(B, W, V)
+                return (aux, logp, scores, tokens, frozen), None
+
+            # final step needs no forward (host beam_search breaks
+            # before its last forward the same way)
+            (aux, logp, scores, tokens, frozen), _ = jax.lax.scan(
+                body, (aux, logp, scores, tokens, frozen),
+                jnp.arange(n - 1))
+            scores, tokens, frozen, _, _ = select(
+                logp, scores, tokens, frozen, n - 1)
+            return tokens, scores
+
+        fn = jax.jit(run)
+        self._loop_cache[key_] = fn
+        return fn
+
     def generate_speculative(self, draft, prompt, max_new_tokens,
                              lookahead=4):
         """Greedy speculative decoding: a small `draft` Generator

@@ -626,6 +626,42 @@ class TestGenerator:
         assert row[0] == eos
         assert (row == eos).all()   # frozen: eos continues for free
 
+    def test_beam_on_device_matches_host(self):
+        """beam_search_on_device (one compiled scan, in-scan cache
+        reorder) must reproduce the host-loop beam exactly — tokens
+        and W=1/W=4, with and without length penalty."""
+        _, params = _trained_params()
+        gen = Generator(params, V, max_len=T, num_layers=L,
+                        num_heads=H, dim=DIM, batch_size=B)
+        prompt = np.array([[1, 2, 3], [4, 5, 6]])
+        for w in (1, 4):
+            host = gen.beam_search(prompt, max_new_tokens=6,
+                                   beam_size=w)
+            dev = gen.beam_search_on_device(prompt, max_new_tokens=6,
+                                            beam_size=w)
+            assert (host == dev).all(), (w, host, dev)
+        host = gen.beam_search(prompt, 6, beam_size=4,
+                               length_penalty=1.0)
+        dev = gen.beam_search_on_device(prompt, 6, beam_size=4,
+                                        length_penalty=1.0)
+        assert (host == dev).all()
+
+    def test_beam_on_device_eos_freeze(self):
+        """eos freezing inside the scan: frozen beams pad with eos at
+        no score cost, like the host loop (modulo the host's early
+        break — same tokens, fixed length)."""
+        _, params = _trained_params()
+        gen = Generator(params, V, max_len=T, num_layers=L,
+                        num_heads=H, dim=DIM, batch_size=B)
+        prompt = np.array([[1, 2], [3, 4]])
+        greedy = gen.generate(prompt, max_new_tokens=1)
+        eos = int(greedy[0, 2])
+        out = gen.beam_search_on_device(prompt, max_new_tokens=6,
+                                        beam_size=1, eos_id=eos)
+        assert out.shape == (B, 8)
+        row = out[0, 2:]
+        assert row[0] == eos and (row == eos).all()
+
     def test_top_p_sampling(self):
         """Nucleus sampling: seeded determinism; top_p=tiny degenerates
         to greedy (only the argmax survives the nucleus)."""

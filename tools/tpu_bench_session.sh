@@ -34,10 +34,12 @@ for net in resnet-18 resnet-34 resnet-101 resnet-152 inception-bn \
   python bench.py --network "$net" | tee -a "$OUT/sweep.jsonl"; note $? "sweep:$net"
 done
 
-echo "== 3b. decode throughput (float + int8) =="
+echo "== 3b. decode throughput (float + int8 + on-device beam) =="
 python bench.py --network transformer_lm --decode | tee "$OUT/decode.json"; note $? decode
 python bench.py --network transformer_lm --decode --quantize int8 \
     | tee "$OUT/decode_int8.json"; note $? decode_int8
+python bench.py --network transformer_lm --decode --beam 4 \
+    | tee "$OUT/decode_beam4.json"; note $? decode_beam4
 
 echo "== 3c. long-context sweep (batch 1) =="
 : > "$OUT/longcontext.jsonl"
