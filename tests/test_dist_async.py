@@ -134,6 +134,82 @@ from mxnet_tpu.kvstore_server import _init_kvstore_server_module
 _init_kvstore_server_module()
 """
 
+_FIT_WORKER_SRC = r"""
+import os, sys
+sys.path.insert(0, os.environ["REPO"])
+import numpy as np
+import mxnet_tpu as mx
+from mxnet_tpu import io
+
+rank = int(os.environ["DMLC_WORKER_ID"])
+rng = np.random.RandomState(0)
+protos = rng.randn(10, 32).astype(np.float32)
+lab = rng.randint(0, 10, 512)
+X = (protos[lab] + 0.3 * rng.randn(512, 32)).astype(np.float32)
+y = lab.astype(np.float32)
+# each worker trains on ITS shard — updates meet only on the server
+Xw, yw = X[rank::2], y[rank::2]
+
+net = mx.sym.SoftmaxOutput(mx.sym.FullyConnected(mx.sym.Activation(
+    mx.sym.FullyConnected(mx.sym.Variable("data"), num_hidden=32,
+                          name="fc1"), act_type="relu"),
+    num_hidden=10, name="fc2"), name="softmax")
+it = io.NDArrayIter(Xw, yw, batch_size=32, shuffle=True)
+mod = mx.mod.Module(net, context=mx.cpu())
+mod.fit(it, num_epoch=8, optimizer="sgd", kvstore="dist_async",
+        initializer=mx.init.Xavier(),
+        optimizer_params={"learning_rate": 0.1, "momentum": 0.9,
+                          "rescale_grad": 1.0 / 32})
+score = mod.score(it, "acc")
+acc = score[0][1] if isinstance(score, list) else float(score)
+assert acc > 0.9, "rank %d acc %.3f" % (rank, acc)
+print("FIT_WORKER_OK", rank)
+"""
+
+
+def test_module_fit_dist_async(tmp_path):
+    """The reference's actual async workflow: Module.fit with
+    kvstore='dist_async' — grads pushed to the server-side optimizer,
+    possibly-stale weights pulled, two workers on disjoint shards —
+    must still converge."""
+    port = _free_port()
+    base_env = dict(os.environ)
+    base_env.update({
+        "REPO": REPO,
+        "PYTHONPATH": REPO,
+        "JAX_PLATFORMS": "cpu",
+        "DMLC_PS_ROOT_URI": "127.0.0.1",
+        "DMLC_PS_ROOT_PORT": str(port),
+        "DMLC_NUM_WORKER": "2",
+        "MXNET_KVSTORE_TYPE": "dist_async",
+    })
+    (tmp_path / "server.py").write_text(_SERVER_SRC)
+    (tmp_path / "fit_worker.py").write_text(_FIT_WORKER_SRC)
+
+    server = subprocess.Popen(
+        [sys.executable, str(tmp_path / "server.py")],
+        env=dict(base_env, DMLC_ROLE="server"),
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True)
+    workers = []
+    try:
+        for wid in range(2):
+            workers.append(subprocess.Popen(
+                [sys.executable, str(tmp_path / "fit_worker.py")],
+                env=dict(base_env, DMLC_ROLE="worker",
+                         DMLC_WORKER_ID=str(wid)),
+                stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+                text=True))
+        for wid, w in enumerate(workers):
+            out, _ = w.communicate(timeout=300)
+            assert w.returncode == 0, "worker %d:\n%s" % (wid, out[-900:])
+            assert "FIT_WORKER_OK %d" % wid in out
+        sout, _ = server.communicate(timeout=60)
+        assert server.returncode == 0, "server:\n%s" % sout[-900:]
+    finally:
+        for p in workers + [server]:
+            if p.poll() is None:
+                p.kill()
+
 
 def test_dist_async_multiprocess(tmp_path):
     port = _free_port()
