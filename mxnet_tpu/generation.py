@@ -51,7 +51,7 @@ class Generator:
                  num_heads=4, dim=128, ffn_hidden=None, batch_size=1,
                  dtype=None, num_experts=0, mesh=None, quantize=None,
                  pos_encoding="learned", attention_window=0,
-                 rolling_cache=False):
+                 rolling_cache=False, num_kv_heads=None):
         from .parallel import sharding as shd
 
         if quantize not in (None, "int8"):
@@ -74,6 +74,7 @@ class Generator:
         self._window = int(attention_window or 0)
         self._rolling = bool(rolling_cache)
         head_dim = dim // num_heads
+        kv_heads = int(num_kv_heads or num_heads)
         sym = transformer.get_decode_symbol(
             vocab_size, max_len, num_layers=num_layers,
             num_heads=num_heads, dim=dim, ffn_hidden=ffn_hidden,
@@ -81,7 +82,7 @@ class Generator:
             compute_dtype=str(dtype) if dtype else None,
             pos_encoding=pos_encoding,
             attention_window=attention_window,
-            rolling_cache=rolling_cache)
+            rolling_cache=rolling_cache, num_kv_heads=num_kv_heads)
         if quantize:
             arg_params = _quantize_weights(
                 arg_params, sym.list_arguments())
@@ -115,7 +116,7 @@ class Generator:
                     batch_size % mesh.shape["data"] == 0:
                 spec[0] = "data"
             if "model" in mesh.axis_names and \
-                    num_heads % mesh.shape["model"] == 0:
+                    kv_heads % mesh.shape["model"] == 0:
                 spec[1] = "model"
             self._cache_sharding = NamedSharding(mesh, P(*spec))
         else:
@@ -143,7 +144,8 @@ class Generator:
         cache_dtype = jnp.dtype(dtype) if dtype else next(
             v.dtype for v in self._params.values()
             if jnp.issubdtype(v.dtype, jnp.floating))
-        self._cache_shape = (self.batch_size, num_heads, self.max_len,
+        # GQA: caches hold only the kv heads (the memory win)
+        self._cache_shape = (self.batch_size, kv_heads, self.max_len,
                              head_dim)
         self._cache_dtype = cache_dtype
 

@@ -28,23 +28,33 @@ def _fc(x, num_hidden, name, quantized=False):
                               name=name)
 
 
-def _qkv_heads(x, num_heads, dim, prefix, quantized=False):
-    """Shared qkv projection + head split: (B, T, C) -> three
-    (B, H, T, hd). The training and decode attention blocks both use
-    this so their parameter packing can never drift (a repack would
-    still bind the same "<prefix>qkv" weights and silently corrupt
-    decode otherwise)."""
+def _qkv_heads(x, num_heads, dim, prefix, quantized=False,
+               num_kv_heads=None):
+    """Shared qkv projection + head split: (B, T, C) -> q (B, H, T, hd)
+    and k/v (B, Hkv, T, hd). The training and decode attention blocks
+    both use this so their parameter packing can never drift (a repack
+    would still bind the same "<prefix>qkv" weights and silently
+    corrupt decode otherwise).
+
+    num_kv_heads < num_heads is grouped-query attention (GQA): the
+    projection shrinks to (H + 2*Hkv)*hd and the decode KV cache
+    stores only Hkv heads — the modern serving memory/bandwidth
+    saver. The packing layout [q | k | v] along the output dim equals
+    the historical fused-3C layout when Hkv == H, so existing
+    checkpoints bind unchanged."""
+    Hkv = int(num_kv_heads or num_heads)
     head_dim = dim // num_heads
-    qkv = _fc(x, 3 * dim, prefix + "qkv", quantized)
-    # (B, T, 3C) -> (3, B, H, T, hd)
-    qkv = sym.reshape(qkv, shape=(0, 0, 3, num_heads, head_dim))
-    qkv = sym.transpose(qkv, axes=(2, 0, 3, 1, 4))
+    kv_dim = Hkv * head_dim
+    qkv = _fc(x, dim + 2 * kv_dim, prefix + "qkv", quantized)
 
-    def head(i):
-        part = sym.slice_axis(qkv, axis=0, begin=i, end=i + 1)
-        return sym.reshape(part, shape=(-3, -2))      # (B, H, T, hd)
+    def cut(begin, end, heads):
+        part = sym.slice_axis(qkv, axis=2, begin=begin, end=end)
+        part = sym.reshape(part, shape=(0, 0, heads, head_dim))
+        return sym.transpose(part, axes=(0, 2, 1, 3))  # (B, H, T, hd)
 
-    return head(0), head(1), head(2)
+    return (cut(0, dim, num_heads),
+            cut(dim, dim + kv_dim, Hkv),
+            cut(dim + kv_dim, dim + 2 * kv_dim, Hkv))
 
 
 def _merge_heads_proj(att, dim, prefix, quantized=False):
@@ -56,13 +66,14 @@ def _merge_heads_proj(att, dim, prefix, quantized=False):
 
 
 def _attention_block(x, num_heads, dim, prefix, seq_axis=None,
-                     rope_positions=None, window=0):
+                     rope_positions=None, window=0, num_kv_heads=None):
     """x: (B, T, C) -> (B, T, C); causal flash attention (ring
     attention over ``seq_axis`` when the graph lowers on a mesh
     carrying that axis). rope_positions: (T,) position-id symbol —
     when given, q/k rotate (RoPE) instead of the model using a learned
     position table."""
-    q, k, v = _qkv_heads(x, num_heads, dim, prefix)
+    q, k, v = _qkv_heads(x, num_heads, dim, prefix,
+                         num_kv_heads=num_kv_heads)
     if rope_positions is not None:
         q = sym.contrib.RoPE(q, rope_positions)
         k = sym.contrib.RoPE(k, rope_positions)
@@ -104,6 +115,13 @@ def _moe_block(x, dim, hidden, num_experts, prefix, expert_axis=None,
                               name=prefix + "moe")
 
 
+def _check_kv_heads(num_heads, num_kv_heads):
+    if num_kv_heads and num_heads % int(num_kv_heads):
+        raise ValueError(
+            "num_heads (%d) must be a multiple of num_kv_heads (%d) "
+            "for grouped-query attention" % (num_heads, num_kv_heads))
+
+
 def _check_pos_encoding(pos_encoding, dim, num_heads):
     if pos_encoding not in ("learned", "rope"):
         raise ValueError("pos_encoding must be 'learned' or 'rope', "
@@ -118,7 +136,7 @@ def _check_pos_encoding(pos_encoding, dim, num_heads):
 def _layer_block(x, num_heads, dim, ffn_hidden, prefix, seq_axis=None,
                  num_experts=0, expert_axis=None, dropout=0.0,
                  moe_capacity_factor=1.25, rope_positions=None,
-                 window=0):
+                 window=0, num_kv_heads=None):
     """One pre-LN transformer block: attention residual + FFN/MoE
     residual. Shared by the monolithic get_symbol layer loop and the
     pipeline get_stage_symbol so the two can never drift."""
@@ -126,7 +144,8 @@ def _layer_block(x, num_heads, dim, ffn_hidden, prefix, seq_axis=None,
     x = x + _attention_block(a, num_heads, dim, prefix,
                              seq_axis=seq_axis,
                              rope_positions=rope_positions,
-                             window=window)
+                             window=window,
+                             num_kv_heads=num_kv_heads)
     f = sym.LayerNorm(x, name=prefix + "ln2")
     ff = _moe_block(f, dim, ffn_hidden, num_experts, prefix,
                     expert_axis=expert_axis,
@@ -178,13 +197,15 @@ def get_stage_symbol(num_heads=4, dim=128, ffn_hidden=None,
 
 def _decode_attention_block(x, num_heads, dim, prefix, max_len, pos,
                             quantized=False, rope_positions=None,
-                            window=0, rolling=False):
+                            window=0, rolling=False,
+                            num_kv_heads=None):
     """Incremental variant of _attention_block: identical qkv/proj
     helpers (a training checkpoint binds unchanged), attention routed
     through _contrib_CachedAttention with per-layer k/v cache aux
     states ("<prefix>attn_k_cache"/"_v_cache", created by the op's
     state_inputs registration)."""
-    q, k, v = _qkv_heads(x, num_heads, dim, prefix, quantized)
+    q, k, v = _qkv_heads(x, num_heads, dim, prefix, quantized,
+                         num_kv_heads=num_kv_heads)
     if rope_positions is not None:
         # rotate BEFORE caching: cached keys carry their absolute
         # rotation, so each step only rotates the new tokens
@@ -206,7 +227,7 @@ def get_decode_symbol(vocab_size, max_len, num_layers=2, num_heads=4,
                       dim=128, ffn_hidden=None, num_experts=0,
                       quantized=False, compute_dtype=None,
                       pos_encoding="learned", attention_window=0,
-                      rolling_cache=False):
+                      rolling_cache=False, num_kv_heads=None):
     """Autoregressive-decode twin of get_symbol.
 
     Inputs: data (B, Tnew) token ids for the tokens being appended
@@ -214,7 +235,9 @@ def get_decode_symbol(vocab_size, max_len, num_layers=2, num_heads=4,
     (Tnew,) absolute position ids, cache_pos (1,) = tokens already in
     the caches. Output: logits (B, Tnew, vocab) — no loss head.
     Parameter names match get_symbol exactly; the KV caches are
-    auxiliary states shaped (B, H, max_len, head_dim).
+    auxiliary states shaped (B, Hkv, max_len, head_dim) where Hkv =
+    num_kv_heads or num_heads (grouped-query attention stores only the
+    kv heads — the cache memory/bandwidth win).
 
     New TPU-native capability (the 2017 reference's decode story was
     rnn.RNNCell step-wise unrolling); mxnet_tpu.generation.Generator
@@ -223,6 +246,7 @@ def get_decode_symbol(vocab_size, max_len, num_layers=2, num_heads=4,
     if dim % num_heads:
         raise ValueError("dim (%d) must be divisible by num_heads (%d)"
                          % (dim, num_heads))
+    _check_kv_heads(num_heads, num_kv_heads)
     if rolling_cache and not attention_window:
         raise ValueError("rolling_cache needs attention_window > 0 "
                          "(the circular capacity covers one window)")
@@ -256,6 +280,7 @@ def get_decode_symbol(vocab_size, max_len, num_layers=2, num_heads=4,
         a = sym.LayerNorm(x, name=prefix + "ln1")
         x = x + _decode_attention_block(a, num_heads, dim, prefix,
                                         max_len, cache_pos,
+                                        num_kv_heads=num_kv_heads,
                                         quantized=quantized,
                                         rope_positions=rope_positions,
                                         window=attention_window,
@@ -280,7 +305,7 @@ def get_symbol(vocab_size, seq_len, num_layers=2, num_heads=4, dim=128,
                ffn_hidden=None, dropout=0.0, max_len=None,
                num_experts=0, seq_axis=None, expert_axis=None,
                moe_capacity_factor=1.25, pos_encoding="learned",
-               attention_window=0):
+               attention_window=0, num_kv_heads=None):
     """GPT-style causal LM symbol.
 
     data: (B, T) token ids; softmax_label: (B, T) next-token targets
@@ -316,6 +341,7 @@ def get_symbol(vocab_size, seq_len, num_layers=2, num_heads=4, dim=128,
     if dim % num_heads:
         raise ValueError("dim (%d) must be divisible by num_heads (%d)"
                          % (dim, num_heads))
+    _check_kv_heads(num_heads, num_kv_heads)
     _check_pos_encoding(pos_encoding, dim, num_heads)
     data = sym.Variable("data")
     label = sym.Variable("softmax_label")
@@ -337,6 +363,7 @@ def get_symbol(vocab_size, seq_len, num_layers=2, num_heads=4, dim=128,
                          num_experts=num_experts,
                          expert_axis=expert_axis, dropout=dropout,
                          moe_capacity_factor=moe_capacity_factor,
+                         num_kv_heads=num_kv_heads,
                          rope_positions=rope_positions,
                          window=attention_window)
 

@@ -628,6 +628,13 @@ def cached_attention(query, key, value, k_cache, v_cache, pos,
     MXU-dense training path stays with the Pallas flash kernel.
     Returns (out, new_k_cache, new_v_cache)."""
     B, H, Tn, D = query.shape
+    Hkv = k_cache.shape[1]
+    if H % Hkv:
+        raise ValueError(
+            "query heads (%d) must be a multiple of cache kv heads "
+            "(%d) — grouped-query attention groups q heads over kv "
+            "heads" % (H, Hkv))
+    G = H // Hkv
     if scale is None:
         scale = D ** -0.5
     p0 = jnp.reshape(pos, ()).astype(jnp.int32)
@@ -642,7 +649,12 @@ def cached_attention(query, key, value, k_cache, v_cache, pos,
         k_cache, key.astype(k_cache.dtype), (0, 0, p0, 0))
     v_cache = jax.lax.dynamic_update_slice(
         v_cache, value.astype(v_cache.dtype), (0, 0, p0, 0))
-    s = jnp.einsum("bhqd,bhkd->bhqk", query, k_cache,
+    # grouped einsum: q reshaped (B, Hkv, G, Tn, D) against the
+    # (B, Hkv, Tmax, D) cache — each cache head is READ ONCE for its
+    # whole q-head group (the GQA decode-bandwidth win; a repeat would
+    # materialize G copies)
+    qg = query.reshape(B, Hkv, G, Tn, D)
+    s = jnp.einsum("bhgqd,bhkd->bhgqk", qg, k_cache,
                    precision=jax.lax.Precision.DEFAULT,
                    preferred_element_type=jnp.float32) * scale
     cols = jnp.arange(k_cache.shape[2])[None, :]
@@ -652,10 +664,11 @@ def cached_attention(query, key, value, k_cache, v_cache, pos,
         valid = valid & (p0 + rows - cols < window)
     s = jnp.where(valid, s, _NEG_INF)
     p = jax.nn.softmax(s, axis=-1)
-    out = jnp.einsum("bhqk,bhkd->bhqd", p.astype(v_cache.dtype),
+    out = jnp.einsum("bhgqk,bhkd->bhgqd", p.astype(v_cache.dtype),
                      v_cache,
                      precision=jax.lax.Precision.DEFAULT)
-    return out.astype(query.dtype), k_cache, v_cache
+    return (out.reshape(B, H, Tn, D).astype(query.dtype),
+            k_cache, v_cache)
 
 
 def rope(x, positions, base=10000.0):
@@ -708,6 +721,12 @@ def rolling_cached_attention(query, key, value, k_cache, v_cache, pos,
     congruent to s. Valid for query row r iff 0 <= p_s <= p0+r and
     p0+r - p_s < window."""
     B, H, Tn, D = query.shape
+    Hkv = k_cache.shape[1]
+    if H % Hkv:
+        raise ValueError(
+            "query heads (%d) must be a multiple of cache kv heads "
+            "(%d)" % (H, Hkv))
+    G = H // Hkv
     C = k_cache.shape[2]
     if scale is None:
         scale = D ** -0.5
@@ -715,7 +734,8 @@ def rolling_cached_attention(query, key, value, k_cache, v_cache, pos,
     slots = (p0 + jnp.arange(Tn)) % C
     k_cache = k_cache.at[:, :, slots].set(key.astype(k_cache.dtype))
     v_cache = v_cache.at[:, :, slots].set(value.astype(v_cache.dtype))
-    s = jnp.einsum("bhqd,bhkd->bhqk", query, k_cache,
+    qg = query.reshape(B, Hkv, G, Tn, D)    # GQA: see cached_attention
+    s = jnp.einsum("bhgqd,bhkd->bhgqk", qg, k_cache,
                    precision=jax.lax.Precision.DEFAULT,
                    preferred_element_type=jnp.float32) * scale
     pos_end = p0 + Tn - 1
@@ -725,9 +745,10 @@ def rolling_cached_attention(query, key, value, k_cache, v_cache, pos,
     valid = (p_s >= 0) & (p_s <= rows) & (rows - p_s < window)
     s = jnp.where(valid, s, _NEG_INF)
     p = jax.nn.softmax(s, axis=-1)
-    out = jnp.einsum("bhqk,bhkd->bhqd", p.astype(v_cache.dtype),
+    out = jnp.einsum("bhgqk,bhkd->bhgqd", p.astype(v_cache.dtype),
                      v_cache, precision=jax.lax.Precision.DEFAULT)
-    return out.astype(query.dtype), k_cache, v_cache
+    return (out.reshape(B, H, Tn, D).astype(query.dtype),
+            k_cache, v_cache)
 
 
 @register("_contrib_RollingCachedAttention",
@@ -782,7 +803,20 @@ def _flash_attention_op(query, key, value, scale=None, causal=False,
     (parallel/ring.py; the symbol-level long-context path). Otherwise
     (eager, no mesh, or axis absent/size-1) it is the single-chip
     Pallas flash kernel. Inputs must be 4-D (B, H, T, D) for the ring
-    path."""
+    path.
+
+    Grouped-query attention: k/v may carry FEWER heads than q (Hkv
+    dividing H); they are broadcast to the q-head count here, before
+    the kernel. Training compute is MXU-bound so the repeat costs
+    little; the GQA win is the decode cache (cached_attention keeps
+    Hkv heads and never materializes the repeat)."""
+    if query.ndim == 4 and key.shape[1] != query.shape[1]:
+        H, Hkv = query.shape[1], key.shape[1]
+        if H % Hkv:
+            raise ValueError("query heads (%d) must be a multiple of "
+                             "kv heads (%d)" % (H, Hkv))
+        key = jnp.repeat(key, H // Hkv, axis=1)
+        value = jnp.repeat(value, H // Hkv, axis=1)
     if seq_axis:
         from ._mesh_ctx import active_mesh_axis
         mesh = active_mesh_axis(seq_axis)

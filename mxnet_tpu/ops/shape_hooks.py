@@ -258,10 +258,15 @@ for _name in ("LinearRegressionOutput", "MAERegressionOutput",
 
 def _cached_attention_shapes(shapes, attrs):
     q = shapes[0]
+    k = shapes[1] if len(shapes) > 1 else None
     out = list(shapes)
     tmax = int(attrs.get("max_len", 0))
     if q is not None and tmax:
-        cache = (q[0], q[1], tmax, q[3])
+        # cache head count follows the KEY projection, not the query —
+        # under grouped-query attention Hkv < H and the cache stores
+        # only the kv heads
+        heads = k[1] if k is not None else q[1]
+        cache = (q[0], heads, tmax, q[3])
         if len(out) > 3 and out[3] is None:
             out[3] = cache
         if len(out) > 4 and out[4] is None:

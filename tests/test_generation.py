@@ -626,6 +626,66 @@ class TestGenerator:
         assert row[0] == eos
         assert (row == eos).all()   # frozen: eos continues for free
 
+    def test_gqa_teacher_forcing_consistency(self):
+        """Grouped-query attention (num_kv_heads=2, H=4): incremental
+        decode must reproduce the training symbol's per-position
+        softmax, and the caches must hold only the kv heads."""
+        sym_t = transformer.get_symbol(V, T, num_layers=L, num_heads=4,
+                                       dim=DIM, num_kv_heads=2)
+        step = make_train_step(sym_t, optimizer="sgd")
+        mx.random.seed(3)
+        params = step.init_state(Xavier(), {"data": (B, T),
+                                            "softmax_label": (B, T)})[0]
+        gen = Generator(params, V, max_len=T, num_layers=L,
+                        num_heads=4, dim=DIM, batch_size=B,
+                        num_kv_heads=2)
+        hd = DIM // 4
+        assert gen._cache_shape == (B, 2, T, hd)
+
+        rng = np.random.RandomState(0)
+        toks = rng.randint(0, V, (B, T))
+        eval_fn = _graph_eval_fn(sym_t)
+        raw = {k: getattr(v, "_data", v) for k, v in params.items()}
+        outs, _ = eval_fn(
+            {**raw, "data": jnp.asarray(toks, jnp.float32),
+             "softmax_label": jnp.zeros((B * T,), jnp.float32)},
+            {}, jax.random.PRNGKey(0), False)
+        want = np.asarray(outs[0]).reshape(B, T, V)
+
+        aux = gen._fresh_aux()
+        got = []
+        for t in range(T):
+            logits, aux = gen._forward(aux, toks[:, t:t + 1], t)
+            p = np.asarray(jax.nn.softmax(
+                logits[:, -1].astype(jnp.float32), axis=-1))
+            got.append(p)
+        got = np.stack(got, axis=1)
+        np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-4)
+
+    def test_gqa_generates_and_validates(self):
+        """qkv projection shrinks to (H + 2*Hkv)*hd; generation runs;
+        invalid head grouping raises."""
+        sym_t = transformer.get_symbol(V, T, num_layers=L, num_heads=4,
+                                       dim=DIM, num_kv_heads=1)
+        step = make_train_step(sym_t, optimizer="sgd")
+        mx.random.seed(4)
+        params = step.init_state(Xavier(), {"data": (B, T),
+                                            "softmax_label": (B, T)})[0]
+        hd = DIM // 4
+        w = getattr(params["layer0_qkv_weight"], "_data",
+                    params["layer0_qkv_weight"])
+        assert w.shape[0] == DIM + 2 * hd      # H*hd + 2*(1*hd)
+        gen = Generator(params, V, max_len=T, num_layers=L,
+                        num_heads=4, dim=DIM, batch_size=B,
+                        num_kv_heads=1)
+        prompt = np.array([[1, 2, 3], [4, 5, 6]])
+        host = gen.generate(prompt, max_new_tokens=5)
+        dev = gen.generate_on_device(prompt, max_new_tokens=5)
+        assert host.shape == (B, 8) and (host == dev).all()
+
+        with pytest.raises(ValueError, match="multiple of"):
+            transformer.get_symbol(V, T, num_heads=4, num_kv_heads=3)
+
     def test_beam_on_device_matches_host(self):
         """beam_search_on_device (one compiled scan, in-scan cache
         reorder) must reproduce the host-loop beam exactly — tokens
