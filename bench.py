@@ -411,7 +411,9 @@ def bench_decode(args):
     Decode is memory-bandwidth-bound (every step streams the full
     parameter set + caches), so tokens/s is the metric; no baseline
     (the reference predates transformer serving)."""
-    metric = "transformer_lm_decode_throughput"
+    beam = int(args.beam or 0)
+    metric = "transformer_lm_beam%d_decode_throughput" % beam if beam \
+        else "transformer_lm_decode_throughput"
     jax, dev = _probe_backend(metric)
 
     c = dict(_TLM)
@@ -451,9 +453,7 @@ def bench_decode(args):
     # lengths and difference them, so the (identical) prefill cost
     # cancels and the metric is PURE decode tokens/s
     N_SHORT = max(1, N // 8)
-    beam = int(args.beam or 0)
     if beam:
-        metric = "transformer_lm_beam%d_decode_throughput" % beam
         run = lambda n, i: gen.beam_search_on_device(prompt, n,
                                                      beam_size=beam)
     else:

@@ -70,15 +70,25 @@ import numpy as np
 def _load_predictor(prefix):
     # amalgamated deployments ship mxtpu_predict_min.py NEXT TO the
     # model (tools/amalgamate.py) so no framework source is needed at
-    # run time; a full install falls back to the framework class
-    import os, sys
+    # run time; a full install falls back to the framework class. The
+    # bundled module loads BY FILE PATH under a per-directory name —
+    # never via sys.path, which would let files beside one model shadow
+    # later imports process-wide (and would pin the first bundle's
+    # loader for every subsequent bundle)
+    import hashlib, importlib.util, os, sys
     d = os.path.dirname(os.path.abspath(prefix))
-    if d and d not in sys.path:
-        sys.path.insert(0, d)
-    try:
-        from mxtpu_predict_min import CompiledPredictor
-    except ImportError:
-        from mxnet_tpu.predictor import CompiledPredictor
+    cand = os.path.join(d, "mxtpu_predict_min.py")
+    if os.path.exists(cand):
+        name = "mxtpu_predict_min_" + hashlib.md5(
+            d.encode()).hexdigest()[:10]
+        mod = sys.modules.get(name)
+        if mod is None:
+            spec = importlib.util.spec_from_file_location(name, cand)
+            mod = importlib.util.module_from_spec(spec)
+            sys.modules[name] = mod
+            spec.loader.exec_module(mod)
+        return mod.CompiledPredictor.load(prefix)
+    from mxnet_tpu.predictor import CompiledPredictor
     return CompiledPredictor.load(prefix)
 
 def _create(prefix):

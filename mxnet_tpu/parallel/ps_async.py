@@ -105,7 +105,7 @@ class AsyncPSServer:
         self._barrier_cv = threading.Condition()
         self._done = threading.Event()
         self._byes = 0
-        self._seen = 0
+        self._worker_ids = set()   # hello'd workers (stray conns don't count)
         self._active = 0
         self._srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
@@ -155,6 +155,13 @@ class AsyncPSServer:
                             not self._done.is_set():
                         self._barrier_cv.wait(timeout=1.0)
             return True
+        if op == "hello":
+            # worker handshake: lifetime tracks DISTINCT worker ids, so
+            # stray connections (port scans, health checks) and worker
+            # restarts can neither trigger nor block shutdown
+            with self._lock:
+                self._worker_ids.add(int(key))
+            return True
         if op == "bye":
             with self._lock:
                 self._byes += 1
@@ -193,12 +200,12 @@ class AsyncPSServer:
             conn.close()
             with self._lock:
                 self._active -= 1
-                # lifetime: once the full worker cohort has connected
+                # lifetime: once the full worker cohort has SAID HELLO
                 # and every connection has drained, the job is over —
                 # interpreter teardown does not reliably deliver the
                 # explicit byes (reference: ps-lite's scheduler-tracked
                 # FINALIZE; here disconnect IS the signal)
-                if self._seen >= self._num_workers and \
+                if len(self._worker_ids) >= self._num_workers and \
                         self._active == 0:
                     self._done.set()
                     with self._barrier_cv:
@@ -215,7 +222,6 @@ class AsyncPSServer:
             except OSError:
                 break
             with self._lock:
-                self._seen += 1
                 self._active += 1
             t = threading.Thread(target=self._client_loop,
                                  args=(conn,), daemon=True)
@@ -257,7 +263,11 @@ class AsyncPSClient:
                 if time.time() >= deadline:
                     raise
                 time.sleep(0.5)
+        # barriers block indefinitely by design (a worker may lag a
+        # slow epoch); the 600s timeout applies to CONNECT only
+        self._sock.settimeout(None)
         self._lock = threading.Lock()
+        self._call("hello", int(os.environ.get("DMLC_WORKER_ID", "0")))
 
     def _call(self, op, key=None, payload=None):
         with self._lock:
