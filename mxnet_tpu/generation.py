@@ -548,6 +548,144 @@ class Generator:
                                           pos + g - 1)
         return out[:, :P + max_new_tokens]
 
+    def generate_speculative_on_device(self, draft, prompt,
+                                       max_new_tokens, lookahead=4):
+        """generate_speculative compiled into ONE device program: a
+        lax.while_loop whose body runs the draft's propose scan, the
+        target's single verify forward, the lockstep acceptance rule,
+        and the emit — both models' parameters and caches live in one
+        XLA program, no host dispatches per round. Output is exactly
+        the target's greedy continuation (same rule as the host loop;
+        pinned against it in tests).
+
+        Static-shape discipline: every round proposes the FULL
+        `lookahead` and emissions are clamped to the remaining budget,
+        so both caches need headroom — max_len >= P + max_new_tokens +
+        lookahead on target AND draft (validated here). Greedy only,
+        like the host speculative path."""
+        if draft.vocab_size != self.vocab_size or \
+                draft.batch_size != self.batch_size:
+            raise ValueError("draft must share vocab_size/batch_size "
+                             "with the target")
+        if self._rolling or getattr(draft, "_rolling", False):
+            raise ValueError("speculative decoding is not supported "
+                             "with rolling caches")
+        prompt, P = self._check_prompt(prompt, max_new_tokens)
+        n = int(max_new_tokens)
+        if n == 0:
+            return np.asarray(prompt, np.int64)
+        g = max(1, int(lookahead))
+        need = P + n + g
+        for which, who in (("target", self), ("draft", draft)):
+            if need > who.max_len:
+                raise ValueError(
+                    "%s max_len=%d too small: on-device speculative "
+                    "needs prompt (%d) + max_new_tokens (%d) + "
+                    "lookahead (%d) headroom (fixed-shape rounds may "
+                    "overrun the budget by up to lookahead)"
+                    % (which, who.max_len, P, n, g))
+        key_ = ("spec", P, n, g, id(draft))
+        cached = self._loop_cache.get(key_)
+        if cached is None:
+            fn = self._spec_loop(draft, P, n, g)
+            self._loop_cache[key_] = (fn, draft)   # pin draft alive
+        else:
+            fn = cached[0]
+        out = fn(jnp.asarray(prompt, jnp.float32))
+        return np.asarray(out[:, :P + n], np.int64)
+
+    def _spec_loop(self, draft, P, n, g):
+        B = self.batch_size
+        t_eval, t_params = self._eval_fn, self._params
+        d_eval, d_params = draft._eval_fn, draft._params
+        rng0 = jax.random.PRNGKey(0)
+
+        def fwd(eval_fn, params, aux, tokens, pos, tn):
+            """tokens (B, tn) int32, pos scalar int32."""
+            args = dict(params)
+            args["data"] = tokens.astype(jnp.float32)
+            args["positions"] = (pos + jnp.arange(tn)).astype(
+                jnp.float32)
+            args["cache_pos"] = pos.astype(jnp.float32)[None]
+            outs, aux = eval_fn(args, aux, rng0, False)
+            return outs[0], aux
+
+        def run(prompt):
+            t_aux = self._fresh_aux()
+            d_aux = draft._fresh_aux()
+            prompt_i = prompt.astype(jnp.int32)
+            if P > 1:
+                _, t_aux = fwd(t_eval, t_params, t_aux,
+                               prompt_i[:, :P - 1], jnp.int32(0),
+                               P - 1)
+                _, d_aux = fwd(d_eval, d_params, d_aux,
+                               prompt_i[:, :P - 1], jnp.int32(0),
+                               P - 1)
+            buf = jnp.zeros((B, P + n + g + 1), jnp.int32)
+            buf = buf.at[:, :P].set(prompt_i)
+            emitted = jnp.int32(0)
+
+            def cond(carry):
+                return carry[3] < n
+
+            def body(carry):
+                t_aux, d_aux, buf, emitted = carry
+                pos = P + emitted
+                last = jnp.take_along_axis(
+                    buf, (pos - 1)[None].repeat(B)[:, None],
+                    axis=1)[:, 0]                       # (B,)
+
+                # draft proposes g tokens (ingesting each as it goes;
+                # round 1's first step also ingests the prompt's last
+                # token, which the prefill deliberately left out)
+                def d_step(dc, i):
+                    d_aux, cur = dc
+                    dl, d_aux = fwd(d_eval, d_params, d_aux,
+                                    cur[:, None], pos - 1 + i, 1)
+                    nxt = jnp.argmax(dl[:, -1], axis=-1).astype(
+                        jnp.int32)
+                    return (d_aux, nxt), nxt
+
+                (d_aux, _), props = jax.lax.scan(
+                    d_step, (d_aux, last), jnp.arange(g))   # (g, B)
+                props_t = props.T                            # (B, g)
+
+                # ONE target forward scores last + proposals
+                chunk = jnp.concatenate([last[:, None], props_t],
+                                        axis=1)              # (B, g+1)
+                tl, t_aux = fwd(t_eval, t_params, t_aux, chunk,
+                                pos - 1, g + 1)
+                greedy = jnp.argmax(tl, axis=-1).astype(
+                    jnp.int32)                               # (B, g+1)
+
+                # lockstep acceptance: leading i with batch-unanimous
+                # draft/target agreement
+                match = (props_t == greedy[:, :g]).all(axis=0)  # (g,)
+                acc = jnp.cumprod(match.astype(jnp.int32)).sum()
+                # emit accepted proposals + the target's next token
+                idx = jnp.arange(g + 1)
+                bonus = jnp.take_along_axis(
+                    greedy, acc[None].repeat(B)[:, None], axis=1)
+                emit = jnp.where(idx[None, :] < acc,
+                                 jnp.concatenate(
+                                     [props_t, props_t[:, -1:]],
+                                     axis=1),
+                                 bonus)                      # (B, g+1)
+                take = jnp.minimum(acc + 1, n - emitted)
+                # write the g+1 block at pos; columns past `take` hold
+                # junk but land in the headroom region or are
+                # overwritten by the next round (which starts at
+                # pos + take)
+                buf = jax.lax.dynamic_update_slice(
+                    buf, emit, (0, pos))
+                return (t_aux, d_aux, buf, emitted + take)
+
+            _, _, buf, _ = jax.lax.while_loop(
+                cond, body, (t_aux, d_aux, buf, emitted))
+            return buf
+
+        return jax.jit(run)
+
     def generate_on_device(self, prompt, max_new_tokens,
                            temperature=0.0, top_k=None, top_p=None,
                            seed=0):

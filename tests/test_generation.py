@@ -686,6 +686,70 @@ class TestGenerator:
         with pytest.raises(ValueError, match="multiple of"):
             transformer.get_symbol(V, T, num_heads=4, num_kv_heads=3)
 
+    def test_speculative_on_device_matches_host_and_greedy(self):
+        """The compiled speculative loop (draft scan + verify + accept
+        inside lax.while_loop) must emit EXACTLY the target's greedy
+        continuation — same contract as the host speculative path."""
+        cap = 3 + 8 + 4                            # P + n + lookahead
+
+        def params_with_table(seed):
+            sym_t = transformer.get_symbol(V, T, num_layers=L,
+                                           num_heads=H, dim=DIM,
+                                           max_len=cap)
+            step = make_train_step(sym_t, optimizer="sgd")
+            mx.random.seed(seed)
+            return step.init_state(Xavier(), {
+                "data": (B, T), "softmax_label": (B, T)})[0]
+
+        target = Generator(params_with_table(0), V, max_len=cap,
+                           num_layers=L, num_heads=H, dim=DIM,
+                           batch_size=B)
+        draft = Generator(params_with_table(1), V, max_len=cap,
+                          num_layers=L, num_heads=H, dim=DIM,
+                          batch_size=B)
+        prompt = np.array([[1, 2, 3], [4, 5, 6]])
+        greedy = target.generate(prompt, max_new_tokens=8)
+        host = target.generate_speculative(draft, prompt, 8,
+                                           lookahead=4)
+        dev = target.generate_speculative_on_device(draft, prompt, 8,
+                                                    lookahead=4)
+        assert (host == greedy).all()
+        assert (dev == greedy).all(), (dev, greedy)
+        # self-drafting: always fully accepts, still exact
+        dev2 = target.generate_speculative_on_device(target, prompt,
+                                                     8, lookahead=4)
+        assert (dev2 == greedy).all()
+
+    def test_speculative_on_device_validates_capacity(self):
+        _, t_params = _trained_params(seed=0)
+        gen = Generator(t_params, V, max_len=T, num_layers=L,
+                        num_heads=H, dim=DIM, batch_size=B)
+        prompt = np.array([[1, 2, 3], [4, 5, 6]])
+        with pytest.raises(ValueError, match="headroom"):
+            gen.generate_speculative_on_device(
+                gen, prompt, T - 3, lookahead=4)
+
+    def test_gqa_composes_with_window_and_rolling(self):
+        """GQA + RoPE + sliding window + rolling circular caches — the
+        full modern-serving composition; rolling caches keep only
+        (B, Hkv, C, hd)."""
+        sym_t = transformer.get_symbol(V, 24, num_layers=L, num_heads=4,
+                                       dim=DIM, num_kv_heads=2,
+                                       pos_encoding="rope",
+                                       attention_window=8)
+        step = make_train_step(sym_t, optimizer="sgd")
+        mx.random.seed(5)
+        params = step.init_state(Xavier(), {"data": (B, 24),
+                                            "softmax_label": (B, 24)})[0]
+        gen = Generator(params, V, max_len=12, num_layers=L,
+                        num_heads=4, dim=DIM, num_kv_heads=2,
+                        batch_size=B, pos_encoding="rope",
+                        attention_window=8, rolling_cache=True)
+        assert gen._cache_shape == (B, 2, 12, DIM // 4)
+        out = gen.generate(np.array([[1, 2, 3], [4, 5, 6]]),
+                           max_new_tokens=20)   # past plain capacity
+        assert out.shape == (B, 23)
+
     def test_beam_on_device_matches_host(self):
         """beam_search_on_device (one compiled scan, in-scan cache
         reorder) must reproduce the host-loop beam exactly — tokens
