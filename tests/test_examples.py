@@ -25,6 +25,7 @@ FAST_EXAMPLES = [
     "multi_task.py",
     "svm_digits.py",
     "vae.py",
+    "neural_style.py",
 ]
 
 
