@@ -79,3 +79,20 @@ def test_bench_network_catalog_builds():
         assert image in (224, 299), name
     # inception-v3's baseline/GMACs are 299px figures
     assert _IMAGE_NETS["inception-v3"][4] == 299
+
+
+def test_perf_tables_renders_from_committed_captures():
+    """tools/perf_tables.py turns bench_out/ artifacts into the docs
+    tables; must at least render the committed training captures."""
+    import importlib.util
+    import os
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    spec = importlib.util.spec_from_file_location(
+        "perf_tables", os.path.join(repo, "tools", "perf_tables.py"))
+    pt = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(pt)
+    recs = pt.load_records(os.path.join(repo, "bench_out"))
+    assert any(r["metric"] == "resnet50_train_throughput"
+               for r in recs)
+    table = pt.training_table(recs)
+    assert "resnet50" in table and "| workload |" in table

@@ -1,0 +1,154 @@
+"""Render markdown performance tables from bench_out/ artifacts.
+
+Keeps docs/performance.md honest: every number in the docs should trace
+to a committed capture, and regenerating the tables after a bench
+session is one command:
+
+    python tools/perf_tables.py            # prints markdown to stdout
+    python tools/perf_tables.py --json     # machine-readable summary
+
+Reads every *.json / *.jsonl under bench_out/ (one JSON object per
+line), groups by metric, and prints the most recent record per
+(metric, variant-ish key). Records with value=null are skipped.
+"""
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def load_records(out_dir):
+    recs = []
+    for path in sorted(glob.glob(os.path.join(out_dir, "*.json*"))):
+        try:
+            with open(path) as f:
+                for line in f:
+                    line = line.strip()
+                    if not line.startswith("{"):
+                        continue
+                    try:
+                        rec = json.loads(line)
+                    except json.JSONDecodeError:
+                        continue
+                    if rec.get("value") is None:
+                        continue
+                    rec["_file"] = os.path.basename(path)
+                    recs.append(rec)
+        except OSError:
+            continue
+    return recs
+
+
+def _fmt(v):
+    if isinstance(v, float):
+        return "%.4g" % v
+    return str(v)
+
+
+def training_table(recs):
+    rows = [r for r in recs
+            if r.get("metric", "").endswith("_train_throughput")]
+    if not rows:
+        return ""
+    out = ["## Training (one chip)", "",
+           "| workload | value | unit | vs baseline | MFU | step ms |",
+           "|---|---|---|---|---|---|"]
+    seen = set()
+    for r in rows:
+        key = (r["metric"], r.get("seq_len"), r.get("window"),
+               r.get("remat"))
+        if key in seen:
+            continue
+        seen.add(key)
+        name = r["metric"].replace("_train_throughput", "")
+        if r.get("seq_len"):
+            name += " T=%d" % r["seq_len"]
+        if r.get("window"):
+            name += " W=%d" % r["window"]
+        if r.get("remat"):
+            name += " (remat)"
+        out.append("| %s | %s | %s | %s | %s | %s |" % (
+            name, _fmt(r["value"]), r.get("unit", ""),
+            _fmt(r.get("vs_baseline", "")),
+            _fmt(r["mfu"]) if r.get("mfu") is not None else "",
+            _fmt(r.get("step_time_ms", ""))))
+    return "\n".join(out)
+
+
+def decode_table(recs):
+    rows = [r for r in recs if "decode_throughput" in
+            r.get("metric", "")]
+    if not rows:
+        return ""
+    out = ["## Decode / serving (one chip)", "",
+           "| mode | tokens/s | ms/token | batch | quantize |",
+           "|---|---|---|---|---|"]
+    for r in rows:
+        mode = "greedy"
+        if r.get("beam"):
+            mode = "beam-%d" % r["beam"]
+        if r.get("quantize"):
+            mode += " int8"
+        out.append("| %s | %s | %s | %s | %s |" % (
+            mode, _fmt(r["value"]), _fmt(r.get("ms_per_token", "")),
+            r.get("batch", ""), r.get("quantize") or "-"))
+    return "\n".join(out)
+
+
+def bn_table(recs):
+    rows = [r for r in recs
+            if r.get("metric") == "batchnorm_train_fwd_bwd"]
+    if not rows:
+        return ""
+    out = ["## BatchNorm one-pass vs two-pass (fwd+bwd)", "",
+           "| shape | one-pass ms | two-pass ms | speedup |",
+           "|---|---|---|---|"]
+    for r in rows:
+        out.append("| %s | %s | %s | %sx |" % (
+            "x".join(str(d) for d in r["shape"]),
+            _fmt(r["one_pass_ms"]), _fmt(r["two_pass_ms"]),
+            _fmt(r["speedup"])))
+    return "\n".join(out)
+
+
+def pipeline_table(recs):
+    rows = [r for r in recs if r.get("metric", "").startswith(
+        "input_pipeline")]
+    if not rows:
+        return ""
+    out = ["## Input pipeline", "",
+           "| variant | img/s | threads | batch |",
+           "|---|---|---|---|"]
+    for r in rows:
+        name = r.get("variant") or r["metric"].replace(
+            "input_pipeline_", "")
+        out.append("| %s | %s | %s | %s |" % (
+            name, _fmt(r["value"]), r.get("threads", ""),
+            r.get("batch", "")))
+    return "\n".join(out)
+
+
+def main():
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--out-dir", default=os.path.join(_REPO,
+                                                     "bench_out"))
+    p.add_argument("--json", action="store_true")
+    args = p.parse_args()
+    recs = load_records(args.out_dir)
+    if args.json:
+        print(json.dumps(recs, indent=1))
+        return
+    sections = [t for t in (training_table(recs), decode_table(recs),
+                            bn_table(recs), pipeline_table(recs)) if t]
+    if not sections:
+        raise SystemExit("no records with values under %s"
+                         % args.out_dir)
+    print("\n\n".join(sections))
+
+
+if __name__ == "__main__":
+    main()
