@@ -271,6 +271,36 @@ class TestRingAttention:
             .as_text()
         assert "collective-permute" in hlo
 
+    def test_gqa_through_flash_op_on_ring(self):
+        """GQA kv broadcast happens BEFORE the ring branch in the flash
+        op, so num_kv_heads < H trains sequence-parallel: the op with
+        (B, 2, T, D) kv against (B, 4, T, D) q over the sp mesh must
+        equal the dense GQA reference."""
+        from mxnet_tpu.ops.attention import _flash_attention_op
+        from mxnet_tpu.ops import _mesh_ctx
+        mesh = self._mesh()
+        B, H, Hkv, T, D = 1, 4, 2, 8 * 8, 16
+        rng = np.random.RandomState(0)
+        q = jnp.asarray(rng.randn(B, H, T, D), jnp.float32)
+        k = jnp.asarray(rng.randn(B, Hkv, T, D), jnp.float32)
+        v = jnp.asarray(rng.randn(B, Hkv, T, D), jnp.float32)
+        qs = jax.device_put(q, NamedSharding(
+            mesh, P(None, None, "sp", None)))
+        ks, vs = (jax.device_put(x, NamedSharding(
+            mesh, P(None, None, "sp", None))) for x in (k, v))
+        with _mesh_ctx.use_mesh(mesh):
+            out = _flash_attention_op(qs, ks, vs, causal=True,
+                                      seq_axis="sp")
+        kr = jnp.repeat(k, H // Hkv, axis=1)
+        vr = jnp.repeat(v, H // Hkv, axis=1)
+        ref = _attn_reference(q.reshape(B * H, T, D),
+                              kr.reshape(B * H, T, D),
+                              vr.reshape(B * H, T, D), D ** -0.5,
+                              True)
+        np.testing.assert_allclose(
+            np.asarray(out).reshape(B * H, T, D), np.asarray(ref),
+            rtol=2e-5, atol=2e-6)
+
     @pytest.mark.parametrize("causal", [False, True])
     def test_gradients_match_dense(self, causal):
         """Long-context TRAINING path: autodiff through the ring
