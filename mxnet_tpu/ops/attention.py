@@ -37,7 +37,7 @@ _NEG_INF = -1e30
 
 def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, *rest,
                       scale, causal, block_q, block_k, num_kb, seq_k,
-                      want_lse, window=0):
+                      want_lse, window=0, band_offset=0):
     # the lse output only exists under differentiation (want_lse);
     # forward-only calls skip its ~BH*T*128 f32 HBM writes entirely
     if want_lse:
@@ -73,7 +73,7 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, *rest,
         if causal:
             rows = qb * block_q + jax.lax.broadcasted_iota(
                 jnp.int32, s.shape, 0)
-            valid = _band_valid(valid, rows, cols, window)
+            valid = _band_valid(valid, rows, cols, window, band_offset)
         s = jnp.where(valid, s, _NEG_INF)
         m_prev = m_ref[:]
         m_new = jnp.maximum(m_prev, s.max(axis=1, keepdims=True))
@@ -92,7 +92,8 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, *rest,
     if causal:
         # whole block outside the band: skip (half the FLOPs for plain
         # causal; O(T*window) total with a window)
-        pl.when(_band_run(qb, kb, block_q, block_k, window))(_block)
+        pl.when(_band_run(qb, kb, block_q, block_k, window,
+                          band_offset))(_block)
     else:
         _block()
 
@@ -114,22 +115,28 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, *rest,
 
 
 
-def _band_valid(valid, rows, cols, window):
-    """Causal + optional sliding-window mask shared by all kernels."""
-    valid = valid & (rows >= cols)
+def _band_valid(valid, rows, cols, window, offset=0):
+    """Causal + optional sliding-window mask shared by all kernels.
+
+    offset: static amount by which q GLOBAL positions lead the k
+    positions (rows + offset is the true position of row `rows`) — the
+    windowed-ring case, where the visiting k block sits `offset`
+    positions earlier in the sequence than the local q block. offset=0
+    is the ordinary same-block band."""
+    valid = valid & (rows + offset >= cols)
     if window:
-        valid = valid & (rows - cols < window)
+        valid = valid & (rows + offset - cols < window)
     return valid
 
 
-def _band_run(qb, kb, block_q, block_k, window):
+def _band_run(qb, kb, block_q, block_k, window, offset=0):
     """Block participates iff the (q-block x k-block) rectangle meets
     the causal band: below-or-on diagonal, and (with a window) not
     entirely below it. Shared by the fwd/dq/dkv kernels."""
-    run = qb * block_q + block_q - 1 >= kb * block_k
+    run = qb * block_q + block_q - 1 + offset >= kb * block_k
     if window:
         run = run & (kb * block_k + block_k - 1
-                     > qb * block_q - window)
+                     > qb * block_q + offset - window)
     return run
 
 
@@ -152,7 +159,7 @@ def _snap_blocks(T, Tk, block_q, block_k, interpret):
 
 
 def _flash_forward(q, k, v, scale, causal, block_q, block_k, interpret,
-                   want_lse, window=0):
+                   want_lse, window=0, band_offset=0):
     q, k, v = _uniform_vma(q, k, v)
     BH, T, D = q.shape
     Tk = k.shape[1]
@@ -163,7 +170,7 @@ def _flash_forward(q, k, v, scale, causal, block_q, block_k, interpret,
     kernel = functools.partial(
         _flash_fwd_kernel, scale=scale, causal=causal, block_q=block_q,
         block_k=block_k, num_kb=nk, seq_k=Tk, want_lse=want_lse,
-        window=window)
+        window=window, band_offset=band_offset)
     shapes = [jax.ShapeDtypeStruct(q.shape, q.dtype)]              # o
     out_specs = [pl.BlockSpec((1, block_q, D),
                               lambda b, i, j: (b, i, 0))]
@@ -236,14 +243,14 @@ def _uniform_vma(*operands):
         for x, v in zip(operands, vmas))
 
 
-def _dense_with_lse(q, k, v, scale, causal, window=0):
+def _dense_with_lse(q, k, v, scale, causal, window=0, band_offset=0):
     """Dense (o, lse) oracle — the single implementation behind
     _attn_reference and the interpret-mode fallbacks."""
     s = jnp.einsum("bqd,bkd->bqk", q, k,
                    preferred_element_type=jnp.float32) * scale
     if causal:
         T, Tk = s.shape[-2], s.shape[-1]
-        rows = jnp.arange(T)[:, None]
+        rows = jnp.arange(T)[:, None] + band_offset
         cols = jnp.arange(Tk)[None, :]
         mask = rows >= cols
         if window:
@@ -271,7 +278,7 @@ def _masked_block(ref, rows_base, limit, block_rows):
 
 def _flash_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                      dq_ref, dq_acc, *, scale, causal, block_q, block_k,
-                     num_kb, seq_q, seq_k, window=0):
+                     num_kb, seq_q, seq_k, window=0, band_offset=0):
     qb, kb = pl.program_id(1), pl.program_id(2)
 
     @pl.when(kb == 0)
@@ -295,7 +302,7 @@ def _flash_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         if causal:
             rows = qb * block_q + jax.lax.broadcasted_iota(
                 jnp.int32, s.shape, 0)
-            valid = _band_valid(valid, rows, cols, window)
+            valid = _band_valid(valid, rows, cols, window, band_offset)
         p = jnp.where(valid, jnp.exp(s - lse), 0)       # (bq, bk)
         dp = jax.lax.dot_general(
             do, v, (((1,), (1,)), ((), ())),
@@ -311,7 +318,8 @@ def _flash_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             preferred_element_type=jnp.float32)
 
     if causal:
-        pl.when(_band_run(qb, kb, block_q, block_k, window))(_block)
+        pl.when(_band_run(qb, kb, block_q, block_k, window,
+                          band_offset))(_block)
     else:
         _block()
 
@@ -323,7 +331,7 @@ def _flash_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 def _flash_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                       dk_ref, dv_ref, dk_acc, dv_acc,
                       *, scale, causal, block_q, block_k, num_qb,
-                      seq_q, seq_k, window=0):
+                      seq_q, seq_k, window=0, band_offset=0):
     kb, qb = pl.program_id(1), pl.program_id(2)
 
     @pl.when(qb == 0)
@@ -348,7 +356,7 @@ def _flash_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         if causal:
             cols = kb * block_k + jax.lax.broadcasted_iota(
                 jnp.int32, s.shape, 1)
-            valid = _band_valid(valid, rows, cols, window)
+            valid = _band_valid(valid, rows, cols, window, band_offset)
         p = jnp.where(valid, jnp.exp(s - lse), 0)
         dv_acc[:] += jax.lax.dot_general(
             p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
@@ -368,7 +376,8 @@ def _flash_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
     if causal:
         # k block outside the band contributes 0
-        pl.when(_band_run(qb, kb, block_q, block_k, window))(_block)
+        pl.when(_band_run(qb, kb, block_q, block_k, window,
+                          band_offset))(_block)
     else:
         _block()
 
@@ -379,7 +388,8 @@ def _flash_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
 
 def _flash_backward(q, k, v, o, lse, do, scale, causal, block_q,
-                    block_k, interpret, dlse=None, window=0):
+                    block_k, interpret, dlse=None, window=0,
+                    band_offset=0):
     if dlse is None:
         q, k, v, o, lse, do = _uniform_vma(q, k, v, o, lse, do)
     else:
@@ -414,7 +424,8 @@ def _flash_backward(q, k, v, o, lse, do, scale, causal, block_q,
         functools.partial(
             _flash_dq_kernel, scale=scale, causal=causal,
             block_q=block_q, block_k=block_k, num_kb=nk,
-            seq_q=T, seq_k=Tk, window=window),
+            seq_q=T, seq_k=Tk, window=window,
+            band_offset=band_offset),
         grid=(BH, nq, nk),
         in_specs=[q_spec, k_spec, k_spec, q_spec, r_spec, r_spec],
         out_specs=q_spec,
@@ -435,7 +446,8 @@ def _flash_backward(q, k, v, o, lse, do, scale, causal, block_q,
         functools.partial(
             _flash_dkv_kernel, scale=scale, causal=causal,
             block_q=block_q, block_k=block_k, num_qb=nq,
-            seq_q=T, seq_k=Tk, window=window),
+            seq_q=T, seq_k=Tk, window=window,
+            band_offset=band_offset),
         grid=(BH, nk, nq),
         in_specs=[q_spec2, k_spec2, k_spec2, q_spec2, r_spec2, r_spec2],
         out_specs=[k_spec2, k_spec2],
@@ -528,42 +540,56 @@ def _flash_bwd_rule(scale, causal, block_q, block_k, window, res, do):
 _flash.defvjp(_flash_fwd_rule, _flash_bwd_rule)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
-def _flash_lse(q, k, v, scale, causal, block_q, block_k):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
+def _flash_lse(q, k, v, scale, causal, block_q, block_k, window=0,
+               band_offset=0):
     """Flash attention that also returns the per-row logsumexp, with
     real gradient flow through BOTH outputs. The ring-attention merge
-    consumes (o, lse) pairs per visiting KV block."""
+    consumes (o, lse) pairs per visiting KV block.
+
+    window/band_offset: static banded mask over GLOBAL positions
+    (q row r sits at r + band_offset) — the windowed-ring case, where
+    the visiting k block is band_offset positions earlier than the
+    local q block. Defaults preserve the classic behavior exactly."""
     if _interpret_needs_fallback(q, k, v):
-        return _dense_with_lse(q, k, v, scale, causal)
+        return _dense_with_lse(q, k, v, scale, causal, window,
+                               band_offset)
     interpret = jax.default_backend() != "tpu"
     o, lse3 = _flash_forward(q, k, v, scale, causal, block_q, block_k,
-                             interpret, want_lse=True)
+                             interpret, want_lse=True, window=window,
+                             band_offset=band_offset)
     return o, lse3[..., 0]
 
 
-def _flash_lse_fwd_rule(q, k, v, scale, causal, block_q, block_k):
+def _flash_lse_fwd_rule(q, k, v, scale, causal, block_q, block_k,
+                        window=0, band_offset=0):
     if _interpret_needs_fallback(q, k, v):
-        o, lse = _dense_with_lse(q, k, v, scale, causal)
+        o, lse = _dense_with_lse(q, k, v, scale, causal, window,
+                                 band_offset)
         return (o, lse), (q, k, v, None, None)
     interpret = jax.default_backend() != "tpu"
     o, lse3 = _flash_forward(q, k, v, scale, causal, block_q, block_k,
-                             interpret, want_lse=True)
+                             interpret, want_lse=True, window=window,
+                             band_offset=band_offset)
     lse = lse3[..., 0]
     return (o, lse), (q, k, v, o, lse)   # single-lane residual
 
 
-def _flash_lse_bwd_rule(scale, causal, block_q, block_k, res, cts):
+def _flash_lse_bwd_rule(scale, causal, block_q, block_k, window,
+                        band_offset, res, cts):
     q, k, v, o, lse = res
     do, dlse = cts
     if lse is None:          # dense interpret-mode fallback (see above)
         _, vjp = jax.vjp(
-            lambda a, b, c: _dense_with_lse(a, b, c, scale, causal),
+            lambda a, b, c: _dense_with_lse(a, b, c, scale, causal,
+                                            window, band_offset),
             q, k, v)
         return vjp((do, dlse))
     interpret = jax.default_backend() != "tpu"
     dq, dk, dv = _flash_backward(q, k, v, o, lse, do, scale, causal,
                                  block_q, block_k, interpret,
-                                 dlse=dlse)
+                                 dlse=dlse, window=window,
+                                 band_offset=band_offset)
     return _narrow_vma(dq, q), _narrow_vma(dk, k), _narrow_vma(dv, v)
 
 
@@ -571,13 +597,16 @@ _flash_lse.defvjp(_flash_lse_fwd_rule, _flash_lse_bwd_rule)
 
 
 def flash_attention_with_lse(query, key, value, scale=None,
-                             causal=False, block_q=512, block_k=512):
+                             causal=False, block_q=512, block_k=512,
+                             window=0, band_offset=0):
     """(o, lse) over (BH, T, D) inputs — both differentiable; the
-    building block for ring attention's block merge."""
+    building block for ring attention's block merge. window/band_offset
+    select a banded mask over global positions (see _flash_lse)."""
     if scale is None:
         scale = query.shape[-1] ** -0.5
     return _flash_lse(query, key, value, float(scale), bool(causal),
-                      int(block_q), int(block_k))
+                      int(block_q), int(block_k), int(window or 0),
+                      int(band_offset or 0))
 
 
 def flash_attention(query, key, value, scale=None, causal=False,
@@ -821,16 +850,14 @@ def _flash_attention_op(query, key, value, scale=None, causal=False,
         from ._mesh_ctx import active_mesh_axis
         mesh = active_mesh_axis(seq_axis)
         if mesh is not None:
-            if window:
-                raise ValueError("window attention is not supported "
-                                 "on the ring (seq_axis) path yet")
             if query.ndim != 4:
                 raise ValueError(
                     "seq_axis ring attention needs (B, H, T, D) inputs, "
                     "got ndim=%d" % query.ndim)
             from ..parallel.ring import ring_attention
             return ring_attention(query, key, value, mesh, seq_axis,
-                                  causal=bool(causal), scale=scale)
+                                  causal=bool(causal), scale=scale,
+                                  window=int(window or 0))
     return flash_attention(query, key, value, scale=scale, causal=causal,
                            block_q=block_q, block_k=block_k,
                            window=int(window or 0) or None)

@@ -108,15 +108,83 @@ def _ring_local(q, k, v, *, axis_name, causal, scale):
     return o_acc.reshape(B, H, Tq, D).astype(q.dtype)
 
 
+def _ring_local_windowed(q, k, v, *, axis_name, scale, window, n):
+    """Windowed (banded causal) ring body, UNROLLED over visiting-block
+    distance t — n is static, so each step's band offset t*Tb is a
+    static kernel parameter and, crucially, the loop runs only
+    r = ceil((window-1)/Tb) rotations instead of n-1: a window reaches
+    at most r predecessor blocks, so the ring only has to carry K/V
+    that far (communication O(window), not O(T))."""
+    me = lax.axis_index(axis_name)
+    B, H, Tq, D = q.shape
+    Tb = k.shape[2]
+    if Tq != Tb:
+        raise ValueError("windowed ring attention needs equal "
+                         "sequence shards (Tq=%d, Tk=%d)" % (Tq, Tb))
+    from ..ops.attention import flash_attention_with_lse
+    q3 = q.reshape(B * H, Tq, D)
+    r = 0 if window <= 1 else min(n - 1, (window - 2) // Tb + 1)
+
+    def merge(o_acc, lse_acc, o_b, lse_b):
+        lse = jnp.logaddexp(lse_acc, lse_b)
+        w_a = jnp.exp(lse_acc - lse)[..., None]
+        w_b = jnp.exp(lse_b - lse)[..., None]
+        return (o_acc * w_a + o_b.astype(jnp.float32) * w_b, lse)
+
+    o_acc = _pvary(jnp.zeros((B * H, Tq, D), jnp.float32),
+                   (axis_name,))
+    lse_acc = _pvary(jnp.full((B * H, Tq), _NEG_INF, jnp.float32),
+                     (axis_name,))
+    perm = [(j, (j + 1) % n) for j in range(n)]
+    k_cur, v_cur = k, v
+    for t in range(r + 1):
+        k3 = k_cur.reshape(B * H, Tb, D)
+        v3 = v_cur.reshape(B * H, Tb, D)
+
+        def compute(_, k3=k3, v3=v3, t=t):
+            return flash_attention_with_lse(
+                q3, k3, v3, scale=scale, causal=True, window=window,
+                band_offset=t * Tb)
+
+        def skip(_):
+            return tuple(_pvary(x, (axis_name,)) for x in (
+                jnp.zeros(q3.shape, q3.dtype),
+                jnp.full((B * H, Tq), _NEG_INF, jnp.float32)))
+
+        if t == 0:
+            o_b, lse_b = compute(None)
+        else:
+            # devices whose t-th predecessor wraps past position 0
+            # have no such block (causal): skip at run time
+            o_b, lse_b = lax.cond(me >= t, compute, skip, None)
+        o_acc, lse_acc = merge(o_acc, lse_acc, o_b, lse_b)
+        if t < r:
+            k_cur = lax.ppermute(k_cur, axis_name, perm)
+            v_cur = lax.ppermute(v_cur, axis_name, perm)
+    return o_acc.reshape(B, H, Tq, D).astype(q.dtype)
+
+
 def ring_attention(q, k, v, mesh, axis_name="sp", causal=False,
-                   scale=None):
+                   scale=None, window=0):
     """Sequence-parallel attention: (B, H, T, D) inputs with T sharded
-    over ``mesh`` axis ``axis_name``; output sharded the same way."""
+    over ``mesh`` axis ``axis_name``; output sharded the same way.
+
+    window > 0 (causal only) runs the BANDED ring: each device visits
+    only the predecessor blocks its window reaches, so both compute
+    and ring communication scale with the window, not the context."""
     if scale is None:
         scale = q.shape[-1] ** -0.5
+    if window and not causal:
+        raise ValueError("window attention requires causal=True")
     spec = P(None, None, axis_name, None)
-    fn = _shard_map(
-        functools.partial(_ring_local, axis_name=axis_name,
-                          causal=causal, scale=float(scale)),
-        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec)
+    if window:
+        body = functools.partial(
+            _ring_local_windowed, axis_name=axis_name,
+            scale=float(scale), window=int(window),
+            n=int(mesh.shape[axis_name]))
+    else:
+        body = functools.partial(_ring_local, axis_name=axis_name,
+                                 causal=causal, scale=float(scale))
+    fn = _shard_map(body, mesh=mesh, in_specs=(spec, spec, spec),
+                    out_specs=spec)
     return fn(q, k, v)
