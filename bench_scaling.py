@@ -38,6 +38,9 @@ def _parse_args():
     p.add_argument("--seq-parallel", action="store_true",
                    help="transformer_lm over an 'sp' mesh (ring "
                         "attention) instead of a data mesh")
+    p.add_argument("--window", type=int, default=0,
+                   help="with --seq-parallel: banded ring attention "
+                        "(communication scales with the window)")
     p.add_argument("--expert-parallel", action="store_true",
                    help="transformer_lm MoE over an 'expert' mesh "
                         "(all_to_all token exchange); experts = 2x "
@@ -77,6 +80,13 @@ _OP_RE = re.compile(
 def collective_bytes(hlo_text):
     """Sum output bytes of collective ops in optimized HLO, per op kind.
 
+    Caveat: a collective INSIDE a while/fori loop appears once in the
+    text but executes once per trip — e.g. the plain ring's ppermute
+    (n-1 trips) vs the windowed ring's unrolled ceil((W-1)/Tb) hops
+    count the same here despite very different wire traffic. Loop-free
+    programs (dp/zero1/MoE) are exact; ring comparisons need the trip
+    count applied by the reader (or real-fabric timing).
+
     Reads lines like
       %all-reduce = f32[64,128]{1,0} all-reduce(%dot), replica_groups=...
     incl. variadic tuple outputs. Bytes are per-device (each device
@@ -107,7 +117,7 @@ def collective_bytes(hlo_text):
 
 
 def build_step(network, mesh, global_batch, zero1, seq_parallel=False,
-               seq_len=64, num_experts=0, full_size=False):
+               seq_len=64, num_experts=0, full_size=False, window=0):
     from mxnet_tpu import models
     from mxnet_tpu.initializer import Xavier
     from mxnet_tpu.parallel import make_train_step
@@ -209,7 +219,7 @@ def main():
         step, state, shapes = build_step(args.network, mesh, gb,
                                          args.zero1, args.seq_parallel,
                                          seq_len, num_experts,
-                                         args.full_size)
+                                         args.full_size, args.window)
         rng_np = np.random.RandomState(0)
         if args.network == "resnet":
             batch = {"data": rng_np.standard_normal(
