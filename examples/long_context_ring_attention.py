@@ -35,6 +35,10 @@ def main():
     p.add_argument("--batch", type=int, default=1)
     p.add_argument("--causal", action=argparse.BooleanOptionalAction,
                    default=True)
+    p.add_argument("--window", type=int, default=0,
+                   help="banded (sliding-window) ring attention: "
+                        "compute and ring hops scale with the window, "
+                        "not the context (causal only)")
     args = p.parse_args()
 
     devs = jax.devices()
@@ -52,7 +56,8 @@ def main():
         for _ in range(3))
 
     fn = jax.jit(lambda a, b, c: ring_attention(
-        a, b, c, mesh, "sp", causal=args.causal))
+        a, b, c, mesh, "sp", causal=args.causal,
+        window=args.window))
     out = fn(q, k, v)
     np.asarray(jax.device_get(out[0, 0, 0, :1]))   # sync
     t0 = time.time()
@@ -69,7 +74,7 @@ def main():
                                                    args.head_dim),
             jnp.asarray(jax.device_get(v)).reshape(-1, args.seq_len,
                                                    args.head_dim),
-            causal=args.causal)
+            causal=args.causal, window=args.window or None)
         err = float(jnp.abs(jnp.asarray(jax.device_get(out)).reshape(
             ref.shape) - ref).max())
         print("max |ring - single_device_flash| = %.2e" % err)
@@ -84,7 +89,7 @@ def main():
     sym = transformer.get_symbol(
         vocab_size=256, seq_len=args.seq_len, num_layers=1,
         num_heads=args.heads, dim=args.heads * args.head_dim,
-        seq_axis="sp")
+        seq_axis="sp", attention_window=args.window)
     step = make_train_step(sym, optimizer="adam",
                            mesh=make_mesh({"sp": n}))
     state = step.init_state(
