@@ -414,6 +414,13 @@ def bench_decode(args):
     beam = int(args.beam or 0)
     metric = "transformer_lm_beam%d_decode_throughput" % beam if beam \
         else "transformer_lm_decode_throughput"
+    # BENCH_TLM_KV_HEADS: grouped-query decode (cache holds Hkv heads
+    # instead of H — the decode path is cache-bandwidth-bound, so this
+    # measures the GQA win directly). Named before the probe so early
+    # failures report under the right metric.
+    kv_heads = int(os.environ.get("BENCH_TLM_KV_HEADS", "0")) or None
+    if kv_heads:
+        metric += "_gqa%d" % kv_heads
     jax, dev = _probe_backend(metric)
 
     c = dict(_TLM)
@@ -436,13 +443,14 @@ def bench_decode(args):
 
         sym = transformer.get_symbol(V, max_len, num_layers=L,
                                      num_heads=c["heads"], dim=D,
-                                     ffn_hidden=4 * D)
+                                     ffn_hidden=4 * D,
+                                     num_kv_heads=kv_heads)
         step = make_train_step(sym, optimizer="sgd")
         state = step.init_state(Xavier(), {
             "data": (B, max_len), "softmax_label": (B, max_len)})
         gen = Generator(state[0], V, max_len=max_len, num_layers=L,
                         num_heads=c["heads"], dim=D,
-                        batch_size=B,
+                        batch_size=B, num_kv_heads=kv_heads,
                         dtype=None if dtype == "float32" else dtype,
                         quantize=args.quantize)
         prompt = np.random.RandomState(0).randint(0, V, (B, P))
@@ -486,6 +494,7 @@ def bench_decode(args):
         "end_to_end_tokens_s": round(B * N / dt_long, 2),
         "batch": B, "prompt_len": P, "new_tokens": N,
         "beam": beam or None,
+        "kv_heads": kv_heads,
         "dim": D, "layers": L, "compute_dtype": dtype,
         "quantize": args.quantize,
         "device_kind": getattr(dev, "device_kind", "unknown")}))

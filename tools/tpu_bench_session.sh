@@ -40,6 +40,8 @@ python bench.py --network transformer_lm --decode --quantize int8 \
     | tee "$OUT/decode_int8.json"; note $? decode_int8
 python bench.py --network transformer_lm --decode --beam 4 \
     | tee "$OUT/decode_beam4.json"; note $? decode_beam4
+BENCH_TLM_KV_HEADS=4 python bench.py --network transformer_lm --decode \
+    | tee "$OUT/decode_gqa4.json"; note $? decode_gqa4
 
 echo "== 3c. long-context sweep (batch 1) =="
 : > "$OUT/longcontext.jsonl"
