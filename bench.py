@@ -332,6 +332,9 @@ def bench_transformer(args):
     """Compute-dense LM workload: tokens/s + MFU. vs_baseline = measured
     MFU / 0.45 north star (BASELINE.md; the reference has no transformer)."""
     metric = "transformer_lm_train_throughput"
+    kv_heads = int(os.environ.get("BENCH_TLM_KV_HEADS", "0")) or None
+    if kv_heads:
+        metric += "_gqa%d" % kv_heads
     jax, dev = _probe_backend(metric)
 
     c = dict(_TLM)
@@ -352,6 +355,7 @@ def bench_transformer(args):
         sym = transformer.get_symbol(V, T, num_layers=L,
                                      num_heads=c["heads"], dim=D,
                                      ffn_hidden=F,
+                                     num_kv_heads=kv_heads,
                                      attention_window=args.window or 0)
         step = make_train_step(
             sym, optimizer="adam",
@@ -379,13 +383,16 @@ def bench_transformer(args):
 
     tok_s = B * T * iters / dt
     # analytic train flops (fwd x3): dense projections 8D^2+4DF per
-    # token per layer, attention 4*Teff*D per token per layer (QK^T +
-    # PV; Teff = min(T, window) under sliding-window attention), vocab
-    # head 2DV per token. Matches the scaling-book accounting; used as
-    # the floor under cost_analysis (the Pallas flash kernel's internal
-    # flops are invisible to XLA's analysis).
+    # token per layer (with GQA the k/v projections shrink to
+    # Hkv*hd columns: 4D^2 + 4*D*kvdim), attention 4*Teff*D per token
+    # per layer (QK^T + PV; Teff = min(T, window) under sliding-window
+    # attention), vocab head 2DV per token. Matches the scaling-book
+    # accounting; used as the floor under cost_analysis (the Pallas
+    # flash kernel's internal flops are invisible to XLA's analysis).
     t_eff = min(T, args.window) if args.window else T
-    fwd = B * T * (L * (8 * D * D + 4 * D * F + 4 * t_eff * D)
+    kvdim = (D // c["heads"]) * kv_heads if kv_heads else D
+    fwd = B * T * (L * (4 * D * D + 4 * D * kvdim + 4 * D * F
+                        + 4 * t_eff * D)
                    + 2 * D * V)
     mfu, flops = _mfu(step, state, batch_vals, dev, dt / iters, 3 * fwd,
                       jax, model_flops_only=args.remat)
