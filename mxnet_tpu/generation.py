@@ -549,7 +549,8 @@ class Generator:
         return out[:, :P + max_new_tokens]
 
     def generate_speculative_on_device(self, draft, prompt,
-                                       max_new_tokens, lookahead=4):
+                                       max_new_tokens, lookahead=4,
+                                       return_rounds=False):
         """generate_speculative compiled into ONE device program: a
         lax.while_loop whose body runs the draft's propose scan, the
         target's single verify forward, the lockstep acceptance rule,
@@ -573,7 +574,8 @@ class Generator:
         prompt, P = self._check_prompt(prompt, max_new_tokens)
         n = int(max_new_tokens)
         if n == 0:
-            return np.asarray(prompt, np.int64)
+            toks = np.asarray(prompt, np.int64)
+            return (toks, 0) if return_rounds else toks
         g = max(1, int(lookahead))
         need = P + n + g
         for which, who in (("target", self), ("draft", draft)):
@@ -591,8 +593,13 @@ class Generator:
             self._loop_cache[key_] = (fn, draft)   # pin draft alive
         else:
             fn = cached[0]
-        out = fn(jnp.asarray(prompt, jnp.float32))
-        return np.asarray(out[:, :P + n], np.int64)
+        out, rounds = fn(jnp.asarray(prompt, jnp.float32))
+        toks = np.asarray(out[:, :P + n], np.int64)
+        if return_rounds:
+            # rounds -> acceptance: each round emits acc+1 tokens, so
+            # mean accepted draft tokens per round = n/rounds - 1
+            return toks, int(rounds)
+        return toks
 
     def _spec_loop(self, draft, P, n, g):
         B = self.batch_size
@@ -629,7 +636,7 @@ class Generator:
                 return carry[3] < n
 
             def body(carry):
-                t_aux, d_aux, buf, emitted = carry
+                t_aux, d_aux, buf, emitted, rounds = carry
                 pos = P + emitted
                 last = jnp.take_along_axis(
                     buf, (pos - 1)[None].repeat(B)[:, None],
@@ -678,11 +685,13 @@ class Generator:
                 # pos + take)
                 buf = jax.lax.dynamic_update_slice(
                     buf, emit, (0, pos))
-                return (t_aux, d_aux, buf, emitted + take)
+                return (t_aux, d_aux, buf, emitted + take,
+                        rounds + 1)
 
-            _, _, buf, _ = jax.lax.while_loop(
-                cond, body, (t_aux, d_aux, buf, emitted))
-            return buf
+            _, _, buf, _, rounds = jax.lax.while_loop(
+                cond, body, (t_aux, d_aux, buf, emitted,
+                             jnp.int32(0)))
+            return buf, rounds
 
         return jax.jit(run)
 

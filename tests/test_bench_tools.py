@@ -81,6 +81,53 @@ def test_bench_network_catalog_builds():
     assert _IMAGE_NETS["inception-v3"][4] == 299
 
 
+def test_bench_fail_exit_code_contract(monkeypatch, capsys):
+    """Advisor r4: a tunnel hang must NOT silently promote a stale
+    capture into the top-level value with rc=0. Contract: rc=3 for
+    hang-under-default-config with last_known attached as a sub-object
+    (value null), rc=1 for real failures, and promotion only under the
+    explicit BENCH_ALLOW_LAST_KNOWN=1 opt-in."""
+    import json
+
+    import pytest
+
+    import bench
+
+    rec = {"value": 123.0, "unit": "img/s", "vs_baseline": 1.1}
+    prov = {"file": "bench_out/resnet50.json", "commit": "abc0000",
+            "captured": "2026-07-31T00:00:00+00:00"}
+    monkeypatch.setattr(bench, "_last_known", lambda m: (rec, prov))
+    monkeypatch.setattr(bench, "_DEFAULT_CONFIG", True)
+    monkeypatch.delenv("BENCH_ALLOW_LAST_KNOWN", raising=False)
+
+    with pytest.raises(SystemExit) as e:
+        bench._fail("resnet50_train_throughput", "backend_init",
+                    TimeoutError("tunnel hang"))
+    assert e.value.code == 3
+    out = json.loads(capsys.readouterr().out.strip())
+    assert out["value"] is None and out["live"] is False
+    assert out["last_known"]["value"] == 123.0
+    assert out["last_known"]["commit"] == "abc0000"
+
+    # explicit driver opt-in restores the promotion, clearly labeled
+    monkeypatch.setenv("BENCH_ALLOW_LAST_KNOWN", "1")
+    with pytest.raises(SystemExit) as e:
+        bench._fail("resnet50_train_throughput", "backend_init",
+                    TimeoutError("tunnel hang"))
+    assert e.value.code == 0
+    out = json.loads(capsys.readouterr().out.strip())
+    assert out["value"] == 123.0 and out["source"] == "last_known"
+    assert out["live"] is False
+
+    # fast/real failures stay rc=1 even with the opt-in set
+    with pytest.raises(SystemExit) as e:
+        bench._fail("resnet50_train_throughput", "graph_build",
+                    RuntimeError("boom"))
+    assert e.value.code == 1
+    out = json.loads(capsys.readouterr().out.strip())
+    assert out["value"] is None
+
+
 def test_perf_tables_renders_from_committed_captures():
     """tools/perf_tables.py turns bench_out/ artifacts into the docs
     tables; must at least render the committed training captures."""

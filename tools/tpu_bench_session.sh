@@ -9,10 +9,15 @@
 #   resnet50.json            headline (the BENCH_rN.json payload)
 #   transformer_lm.json      MFU workload
 #   sweep.jsonl              catalog sweep (one line per network)
-#   decode.json / decode_int8.json   KV-cache generation throughput
+#   decode*.json             KV-cache generation (greedy/int8/beam/gqa/spec)
 #   longcontext.jsonl        4k..32k single-chip context sweep
 #   raw_jax_control.txt      framework-overhead control
 #   trace/ + trace_summary.txt   xplane device-time breakdown
+#
+# Artifacts are written through a temp file and installed ONLY on
+# stage success — a mid-session tunnel drop must never overwrite a
+# previously-committed good capture with a value:null diagnostic
+# (bench.py's last_known fallback reads these same files).
 set -u -o pipefail
 cd "$(dirname "$0")/.."
 export OUT="${1:-bench_out}"
@@ -20,56 +25,83 @@ mkdir -p "$OUT"
 FAILED=()
 note() { [ "$1" -ne 0 ] && FAILED+=("$2 (rc=$1)"); true; }
 
+cap() {   # cap <outfile> <label> <cmd...>: install output on success only
+  local out="$1" label="$2"; shift 2
+  local tmp; tmp="$(mktemp)"
+  "$@" 2>&1 | tee "$tmp"
+  local rc=${PIPESTATUS[0]}
+  if [ "$rc" -eq 0 ] && [ -s "$tmp" ]; then mv "$tmp" "$out"
+  else rm -f "$tmp"; fi
+  note "$rc" "$label"
+}
+capa() {  # capa <outfile> <label> <cmd...>: append on success only
+  local out="$1" label="$2"; shift 2
+  local tmp; tmp="$(mktemp)"
+  "$@" 2>&1 | tee "$tmp"
+  local rc=${PIPESTATUS[0]}
+  if [ "$rc" -eq 0 ] && [ -s "$tmp" ]; then cat "$tmp" >> "$out"; fi
+  rm -f "$tmp"
+  note "$rc" "$label"
+}
+
 echo "== 1. headline resnet-50 =="
-python bench.py | tee "$OUT/resnet50.json"; note $? resnet50
+cap "$OUT/resnet50.json" resnet50 python bench.py
 
 echo "== 2. transformer LM (MFU workload) =="
-python bench.py --network transformer_lm | tee "$OUT/transformer_lm.json"; note $? transformer_lm
+cap "$OUT/transformer_lm.json" transformer_lm \
+    python bench.py --network transformer_lm
 
 echo "== 3. catalog sweep =="
-: > "$OUT/sweep.jsonl"
+SWEEP="$OUT/sweep.jsonl.new"; : > "$SWEEP"
 for net in resnet-18 resnet-34 resnet-101 resnet-152 inception-bn \
            inception-v3 alexnet; do
   echo "-- $net"
-  python bench.py --network "$net" | tee -a "$OUT/sweep.jsonl"; note $? "sweep:$net"
+  capa "$SWEEP" "sweep:$net" python bench.py --network "$net"
 done
+[ -s "$SWEEP" ] && mv "$SWEEP" "$OUT/sweep.jsonl" || rm -f "$SWEEP"
 
-echo "== 3b. decode throughput (float + int8 + on-device beam) =="
-python bench.py --network transformer_lm --decode | tee "$OUT/decode.json"; note $? decode
-python bench.py --network transformer_lm --decode --quantize int8 \
-    | tee "$OUT/decode_int8.json"; note $? decode_int8
-python bench.py --network transformer_lm --decode --beam 4 \
-    | tee "$OUT/decode_beam4.json"; note $? decode_beam4
-BENCH_TLM_KV_HEADS=4 python bench.py --network transformer_lm --decode \
-    | tee "$OUT/decode_gqa4.json"; note $? decode_gqa4
+echo "== 3b. decode throughput (float + int8 + beam + gqa + spec) =="
+cap "$OUT/decode.json" decode \
+    python bench.py --network transformer_lm --decode
+cap "$OUT/decode_int8.json" decode_int8 \
+    python bench.py --network transformer_lm --decode --quantize int8
+cap "$OUT/decode_beam4.json" decode_beam4 \
+    python bench.py --network transformer_lm --decode --beam 4
+cap "$OUT/decode_gqa4.json" decode_gqa4 \
+    env BENCH_TLM_KV_HEADS=4 python bench.py --network transformer_lm \
+        --decode
+cap "$OUT/decode_spec4.json" decode_spec4 \
+    python bench.py --network transformer_lm --decode --speculative 4
 
 echo "== 3c. long-context sweep (batch 1) =="
-: > "$OUT/longcontext.jsonl"
+LCTX="$OUT/longcontext.jsonl.new"; : > "$LCTX"
 for T in 4096 8192 16384; do
-  BENCH_ITERS=10 python bench.py --network transformer_lm --batch 1 \
-      --seq-len "$T" | tee -a "$OUT/longcontext.jsonl"; note $? "lctx:$T"
+  capa "$LCTX" "lctx:$T" env BENCH_ITERS=10 python bench.py \
+      --network transformer_lm --batch 1 --seq-len "$T"
 done
-BENCH_ITERS=5 python bench.py --network transformer_lm --batch 1 \
-    --seq-len 32768 --remat | tee -a "$OUT/longcontext.jsonl"; note $? lctx:32768
+capa "$LCTX" lctx:32768 env BENCH_ITERS=5 python bench.py \
+    --network transformer_lm --batch 1 --seq-len 32768 --remat
 # windowed attention: O(T*W) compute lets 32k train un-rematerialized
-BENCH_ITERS=5 python bench.py --network transformer_lm --batch 1 \
-    --seq-len 32768 --window 4096 \
-    | tee -a "$OUT/longcontext.jsonl"; note $? lctx:32768w4096
+capa "$LCTX" lctx:32768w4096 env BENCH_ITERS=5 python bench.py \
+    --network transformer_lm --batch 1 --seq-len 32768 --window 4096
+[ -s "$LCTX" ] && mv "$LCTX" "$OUT/longcontext.jsonl" || rm -f "$LCTX"
 
 echo "== 3d0. BatchNorm one-pass vs two-pass microbench =="
-python benchmark/bench_bn.py | tee "$OUT/bn_micro.jsonl"; note $? bn_micro
+cap "$OUT/bn_micro.jsonl" bn_micro python benchmark/bench_bn.py
 
 echo "== 3d. input-pipeline train overlap (net img/s with real decode) =="
-python benchmark/bench_input_pipeline.py --train-overlap \
-    --n 512 --batch-size 128 --threads 8 \
-    | tee "$OUT/pipeline_overlap.json"; note $? pipeline_overlap
+cap "$OUT/pipeline_overlap.json" pipeline_overlap \
+    python benchmark/bench_input_pipeline.py --train-overlap \
+        --n 512 --batch-size 128 --threads 8
 
 echo "== 4. raw-JAX controls (resnet-50 + the sub-30%-MFU nets) =="
-python benchmark/raw_jax_resnet.py | tee "$OUT/raw_jax_control.txt"; note $? raw_jax_control
-python benchmark/raw_jax_controls.py --network alexnet \
-    | tee -a "$OUT/raw_jax_control.txt"; note $? raw_jax_alexnet
-python benchmark/raw_jax_controls.py --network inception-v3 \
-    | tee -a "$OUT/raw_jax_control.txt"; note $? raw_jax_inception
+CTRL="$OUT/raw_jax_control.txt.new"; : > "$CTRL"
+capa "$CTRL" raw_jax_control python benchmark/raw_jax_resnet.py
+capa "$CTRL" raw_jax_alexnet \
+    python benchmark/raw_jax_controls.py --network alexnet
+capa "$CTRL" raw_jax_inception \
+    python benchmark/raw_jax_controls.py --network inception-v3
+[ -s "$CTRL" ] && mv "$CTRL" "$OUT/raw_jax_control.txt" || rm -f "$CTRL"
 
 echo "== 5. device trace + breakdown =="
 python - <<'PY'
@@ -101,8 +133,8 @@ np.asarray(jax.device_get(outs[0][0, 0]))
 jax.profiler.stop_trace()
 print("trace done")
 PY
-python tools/xplane_summary.py "$OUT/trace" \
-    | tee "$OUT/trace_summary.txt"; note $? trace_summary
+cap "$OUT/trace_summary.txt" trace_summary \
+    python tools/xplane_summary.py "$OUT/trace"
 
 if [ ${#FAILED[@]} -gt 0 ]; then
   echo "== session FINISHED WITH FAILURES: ${FAILED[*]}; artifacts in $OUT =="
