@@ -13,10 +13,13 @@ onto XLA collectives:
   stacked axis — executed as ONE jitted reduction; when the copies live on a
   mesh this lowers to an ICI all-reduce (psum). The merged value lives
   replicated (the analogue of the CPU merge buffer).
-* `dist_*`: the parameter-server worker/server/scheduler triad is replaced
-  by jax.distributed (coordinator) + the same collective step — see
-  mxnet_tpu.parallel. `dist_async` has no XLA analogue (documented drop;
-  SURVEY.md §2.3).
+* `dist_sync`: the parameter-server worker/server/scheduler triad is
+  replaced by jax.distributed (coordinator) + the same collective step —
+  see mxnet_tpu.parallel.
+* `dist_async`: genuinely non-collective (updates apply per-push with no
+  barrier), so it keeps a REAL host-side parameter server —
+  parallel/ps_async.py, sharded across DMLC_NUM_SERVER processes with
+  per-key application, the reference's kvstore_dist_server.h async mode.
 
 Reference knobs that are deliberately N/A here:
 
@@ -26,11 +29,12 @@ Reference knobs that are deliberately N/A here:
   reduction above — the distinction is preserved in the API (the type
   string round-trips) but changes nothing about execution.
 * Big-array key sharding (`MXNET_KVSTORE_BIGARRAY_BOUND`,
-  kvstore_dist.h:438-517) split large tensors across servers to balance
-  PS bandwidth. Collectives have no per-key server hotspot, so the knob
-  has no analogue; the capability it bought (sharded optimizer state /
-  update) is `TrainStep(optimizer_sharding='zero1')` in
-  parallel/trainer.py.
+  kvstore_dist.h:438-517): on the COLLECTIVE path (dist_sync) there is
+  no per-key server hotspot, so the knob is N/A there; the capability it
+  bought (sharded optimizer state/update) is
+  `TrainStep(optimizer_sharding='zero1')` in parallel/trainer.py. On the
+  dist_async PS path the knob IS honored: arrays above the bound stripe
+  across all servers (parallel/ps_async.py ShardedPSClient).
 
 The push/pull/row_sparse_pull/updater API is preserved exactly so
 Module/Gluon training loops are unchanged.
@@ -83,13 +87,14 @@ class KVStore:
         self._async_client = None
         if kv_type == "dist_async" and \
                 os.environ.get("DMLC_PS_ROOT_URI"):
-            # true async mode: a host-side parameter server applies
+            # true async mode: host-side parameter server(s) apply
             # each push on arrival (parallel/ps_async.py — the
             # reference's kvstore_dist_server.h async semantic).
             # Workers never form a collective; identity comes from the
-            # DMLC env, not jax.distributed.
-            from .parallel.ps_async import AsyncPSClient
-            self._async_client = AsyncPSClient()
+            # DMLC env, not jax.distributed. create_client returns a
+            # key-sharded fan-out client when DMLC_NUM_SERVER>1.
+            from .parallel.ps_async import create_client
+            self._async_client = create_client()
 
     def _world(self):
         """Process count when this is a dist store inside a cluster."""
@@ -238,7 +243,11 @@ class KVStore:
         if self._async_client is not None:
             import jax.numpy as jnp
             for k, olist in zip(keys, outs):
-                cur = self._async_client.pull(k)   # possibly stale: async
+                # possibly stale (async); shape/dtype let a sharded
+                # client derive the stripe plan for keys this worker
+                # never pushed
+                cur = self._async_client.pull(
+                    k, shape=olist[0].shape, dtype=olist[0].dtype)
                 for o in olist:
                     o._set_data(jnp.asarray(cur, dtype=o.dtype))
             return

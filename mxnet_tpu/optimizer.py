@@ -13,6 +13,7 @@ from __future__ import annotations
 import logging
 import math
 import pickle
+import threading
 import warnings
 
 import numpy as np
@@ -70,6 +71,7 @@ class Optimizer:
         self.begin_num_update = begin_num_update
         self.num_update = begin_num_update
         self._index_update_count = {}
+        self._count_lock = threading.Lock()
         self.clip_gradient = clip_gradient
         self.multi_precision = False
 
@@ -137,6 +139,17 @@ class Optimizer:
         return {n: float(attr[n][attr_key]) for n in arg_names
                 if attr_key in attr.get(n, {})}
 
+    def __getstate__(self):
+        # optimizers travel by pickle (dist_async set_optimizer ships
+        # them to the server); locks don't pickle — recreated on load
+        d = self.__dict__.copy()
+        d.pop("_count_lock", None)
+        return d
+
+    def __setstate__(self, d):
+        self.__dict__.update(d)
+        self._count_lock = threading.Lock()
+
     def set_lr_mult(self, args_lr_mult):
         """Per-param lr multipliers; also pulls ``__lr_mult__`` symbol attrs
         (reference optimizer.py:set_lr_mult)."""
@@ -153,10 +166,16 @@ class Optimizer:
                         **args_wd_mult}
 
     def _update_count(self, index):
-        count = self._index_update_count.get(index,
-                                             self.begin_num_update) + 1
-        self._index_update_count[index] = count
-        self.num_update = max(count, self.num_update)
+        # lock: the async PS applies distinct-key updates from
+        # concurrent handler threads (parallel/ps_async.py per-key lock
+        # table); per-index state is disjoint there, but num_update is
+        # a SHARED scalar whose read-modify-write must not interleave
+        # (a stale max would rewind lr schedules / bias correction)
+        with self._count_lock:
+            count = self._index_update_count.get(
+                index, self.begin_num_update) + 1
+            self._index_update_count[index] = count
+            self.num_update = max(count, self.num_update)
 
     def _mult_for(self, index, mults, attr):
         """Resolve the per-param multiplier: param_dict beats explicit

@@ -12,14 +12,26 @@ semantic), SURVEY §2.3's "emulate with host callback PS" sketch.
 
 Wire format: 4-byte big-endian length + pickle of (op, key, payload).
 Trusted-cluster assumption, exactly like ps-lite: anyone who can reach
-the port can drive training — bind to a private interface.
+the port can drive training. The server binds MXNET_PS_BIND if set,
+else DMLC_PS_ROOT_URI, else 127.0.0.1 — exposing it beyond a private
+interface is an explicit operator decision, never the default.
+
+Multi-server (reference kvstore_dist.h:412-517): DMLC_NUM_SERVER=N
+shards keys across N servers (server i binds DMLC_PS_ROOT_PORT+i, or
+set MXNET_PS_SERVER_URIS="h1:p1,h2:p2,..."). Key routing uses a crc32
+hash — STABLE across processes, unlike Python's per-process-salted
+hash(), so every worker maps a key to the same server. Arrays larger
+than MXNET_KVSTORE_BIGARRAY_BOUND (default 1_000_000 elements) are
+striped in contiguous chunks across ALL servers, the reference's
+big-array split that balances PS bandwidth on the embedding-sized keys
+that would otherwise hotspot one server.
 
 Use through the normal surface:
 
     # server process (DMLC_ROLE=server):       python -m mxnet_tpu.kvstore_server
     # worker:
     kv = mx.kv.create("dist_async")
-    kv.set_optimizer(mx.optimizer.SGD(...))    # runs ON THE SERVER
+    kv.set_optimizer(mx.optimizer.SGD(...))    # runs ON THE SERVER(S)
     kv.init("w", w0)                            # rank 0 wins
     kv.push("w", grad)                          # applied immediately
     kv.pull("w", out=w)                         # possibly-stale weights
@@ -43,7 +55,9 @@ import numpy as np
 from .. import ndarray as _nd
 from .. import optimizer as _opt
 
-__all__ = ["AsyncPSServer", "AsyncPSClient", "serve_forever"]
+__all__ = ["AsyncPSServer", "AsyncPSClient", "ShardedPSClient",
+           "create_client", "server_endpoints", "shard_for_key",
+           "serve_forever"]
 
 
 class _NoImportUnpickler(pickle.Unpickler):
@@ -90,15 +104,25 @@ def _recv_msg(sock):
 
 
 class AsyncPSServer:
-    """Single parameter-server process holding the authoritative
-    weights. Per-key lock; every push applies immediately (async mode's
+    """One parameter-server process holding (its shard of) the
+    authoritative weights. Every push applies immediately (async mode's
     defining property). Without an optimizer a push REPLACES the stored
-    value (reference server default: merge buffer copied over)."""
+    value (reference server default: merge buffer copied over).
 
-    def __init__(self, host="0.0.0.0", port=9000, num_workers=1):
+    Locking: a PER-KEY lock table — concurrent pushes to different keys
+    apply in parallel (the numpy optimizer apply runs under only its
+    own key's lock), while same-key pushes serialize, matching the
+    reference's per-NDArray engine write dependency
+    (kvstore_dist_server.h:233-241). `_lock` guards only metadata (dict
+    membership, worker tracking), never an optimizer apply. Updater
+    state is keyed by index, so parallel applies on distinct keys touch
+    distinct state entries (dict ops are GIL-atomic)."""
+
+    def __init__(self, host="127.0.0.1", port=9000, num_workers=1):
         self._store = {}
         self._updater = None
-        self._lock = threading.Lock()
+        self._lock = threading.Lock()          # metadata only
+        self._key_locks = {}                   # key -> Lock
         self._num_workers = int(num_workers)
         self._barrier_count = 0
         self._barrier_gen = 0
@@ -113,16 +137,28 @@ class AsyncPSServer:
         self._srv.listen(64)
         self.port = self._srv.getsockname()[1]
 
+    def _key_lock(self, key):
+        with self._lock:
+            lk = self._key_locks.get(key)
+            if lk is None:
+                lk = self._key_locks[key] = threading.Lock()
+            return lk
+
     # -- request handlers ---------------------------------------------------
     def _handle(self, op, key, payload):
         if op == "init":
-            with self._lock:
-                # first writer wins (reference InitImpl: rank 0 pushes)
+            with self._key_lock(key):
+                # first writer wins (reference InitImpl: rank 0
+                # pushes). The dict INSERT additionally takes the meta
+                # lock: init is the only op that grows the store, and
+                # stats iterates it under that lock (pushes only swap
+                # values of existing keys, which iteration tolerates).
                 if key not in self._store:
-                    self._store[key] = np.array(payload, copy=True)
+                    with self._lock:
+                        self._store[key] = np.array(payload, copy=True)
             return True
         if op == "push":
-            with self._lock:
+            with self._key_lock(key):
                 if key not in self._store:
                     raise KeyError("push before init of %r" % (key,))
                 if self._updater is not None:
@@ -131,7 +167,7 @@ class AsyncPSServer:
                     self._store[key] = np.array(payload, copy=True)
             return True
         if op == "pull":
-            with self._lock:
+            with self._key_lock(key):
                 if key not in self._store:
                     raise KeyError("pull before init of %r" % (key,))
                 return np.array(self._store[key], copy=True)
@@ -155,6 +191,11 @@ class AsyncPSServer:
                             not self._done.is_set():
                         self._barrier_cv.wait(timeout=1.0)
             return True
+        if op == "stats":
+            # observability: which keys this shard holds (tests assert
+            # the sharded distribution; operators debug placement)
+            with self._lock:
+                return sorted(map(str, self._store.keys()))
         if op == "hello":
             # worker handshake: lifetime tracks DISTINCT worker ids, so
             # stray connections (port scans, health checks) and worker
@@ -173,10 +214,10 @@ class AsyncPSServer:
         raise ValueError("unknown op %r" % (op,))
 
     def _apply(self, key, grad):
-        """Run the server-side optimizer on one key — under the store
-        lock, so concurrent pushes serialize per server (the reference
-        serialized through the engine's write dependency on the stored
-        NDArray, kvstore_dist_server.h:233-241)."""
+        """Run the server-side optimizer on one key — under that KEY's
+        lock only, so same-key pushes serialize while different keys
+        apply concurrently (the reference's per-NDArray engine write
+        dependency, kvstore_dist_server.h:233-241)."""
         g = _nd.array(np.asarray(grad))
         w = _nd.array(self._store[key])
         self._updater(_hash_key(key), g, w)
@@ -241,6 +282,171 @@ def _hash_key(key):
     return abs(hash(str(key))) % (1 << 30)
 
 
+def _stable_hash(key):
+    """Cross-process-stable key hash for server routing. Python's
+    hash() is salted per process (PYTHONHASHSEED), so it would route
+    the same key to DIFFERENT servers on different workers; crc32 is
+    deterministic everywhere."""
+    import zlib
+    return zlib.crc32(str(key).encode("utf-8"))
+
+
+def shard_for_key(key, num_servers):
+    """Which server owns `key` (reference kvstore_dist.h: key->server
+    assignment). Same on every worker by construction."""
+    return _stable_hash(key) % max(1, int(num_servers))
+
+
+def server_endpoints():
+    """(host, port) per server from the DMLC/MXNET env. Default layout:
+    N servers on DMLC_PS_ROOT_URI at consecutive ports starting from
+    DMLC_PS_ROOT_PORT; MXNET_PS_SERVER_URIS="h1:p1,h2:p2" overrides for
+    servers on distinct hosts (the reference's scheduler handed out
+    real endpoints; a static env serves the same purpose here)."""
+    uris = os.environ.get("MXNET_PS_SERVER_URIS", "").strip()
+    if uris:
+        out = []
+        for ep in uris.split(","):
+            h, _, p = ep.strip().rpartition(":")
+            out.append((h, int(p)))
+        return out
+    n = int(os.environ.get("DMLC_NUM_SERVER", "1"))
+    host = os.environ.get("DMLC_PS_ROOT_URI", "127.0.0.1")
+    port = int(os.environ.get("DMLC_PS_ROOT_PORT", "9000"))
+    return [(host, port + i) for i in range(n)]
+
+
+def _bigarray_bound():
+    return int(os.environ.get("MXNET_KVSTORE_BIGARRAY_BOUND",
+                              str(1_000_000)))
+
+
+class ShardedPSClient:
+    """Worker-side fan-out over N async PS shards. Routing:
+
+    * normal keys -> server shard_for_key(key, N) (whole array);
+    * arrays with more elements than MXNET_KVSTORE_BIGARRAY_BOUND are
+      striped: the FLAT array splits into N contiguous chunks, chunk i
+      stored on server i under subkey "<key>__strip<i>" (reference
+      kvstore_dist.h:438-517 big-array split). The optimizer then runs
+      per-stripe server-side — exactly the reference's behavior, where
+      each server applied the update to its slice;
+    * set_optimizer broadcasts to every server (the controller command
+      channel reached all servers);
+    * barrier is arbitrated by server 0 alone (one authority, so the
+      worker cohort can never split-brain across shards);
+    * hello/bye go everywhere (each server tracks the full cohort for
+      its own lifetime/shutdown accounting).
+
+    Striping is a PURE FUNCTION of (total size, N): chunk i gets
+    size//N elements plus one extra for i < size%N. Every worker
+    derives the identical plan from an array's shape alone — so a
+    worker that never pushed a key can still pull it by passing the
+    out-array's shape/dtype (kvstore.pull always has one)."""
+
+    def __init__(self, endpoints=None):
+        from concurrent.futures import ThreadPoolExecutor
+        eps = endpoints or server_endpoints()
+        self._clients = [AsyncPSClient(h, p) for h, p in eps]
+        self._n = len(self._clients)
+        self._striped = {}   # key -> (shape, dtype, [chunk_sizes])
+        # stripe RPCs fan out concurrently — issued sequentially over
+        # blocking sockets, striping would ADD latency instead of
+        # buying bandwidth parallelism (each AsyncPSClient carries its
+        # own lock, and a stripe op touches each client exactly once)
+        self._pool = ThreadPoolExecutor(max_workers=self._n)
+
+    # -- routing helpers ----------------------------------------------------
+    def _route(self, key):
+        return self._clients[shard_for_key(key, self._n)]
+
+    def _stripe_sizes(self, total):
+        base, rem = divmod(int(total), self._n)
+        return [base + (1 if i < rem else 0) for i in range(self._n)]
+
+    def _stripe_plan(self, key, shape, dtype):
+        total = int(np.prod(shape)) if shape else 1
+        plan = (tuple(shape), np.dtype(dtype),
+                self._stripe_sizes(total))
+        self._striped[key] = plan
+        return plan
+
+    def _should_stripe(self, size):
+        return self._n > 1 and int(size) > _bigarray_bound()
+
+    # -- the AsyncPSClient surface ------------------------------------------
+    def _scatter(self, op, key, arr):
+        _, _, sizes = self._striped[key]
+        flat = np.asarray(arr).reshape(-1)
+        offs = np.cumsum([0] + sizes)
+        futs = [self._pool.submit(
+            getattr(self._clients[i], op), "%s__strip%d" % (key, i),
+            flat[offs[i]:offs[i + 1]])
+            for i in range(len(sizes))]
+        for f in futs:
+            f.result()
+
+    def init(self, key, value):
+        value = np.asarray(value)
+        if self._should_stripe(value.size):
+            self._stripe_plan(key, value.shape, value.dtype)
+            self._scatter("init", key, value)
+            return
+        self._route(key).init(key, value)
+
+    def push(self, key, grad):
+        grad = np.asarray(grad)
+        if key in self._striped or self._should_stripe(grad.size):
+            if key not in self._striped:
+                self._stripe_plan(key, grad.shape, grad.dtype)
+            self._scatter("push", key, grad)
+            return
+        self._route(key).push(key, grad)
+
+    def pull(self, key, shape=None, dtype=None):
+        """shape/dtype: the out-array's metadata, so a worker that
+        never init/pushed this key still derives the stripe plan (the
+        plan is a pure function of size and N)."""
+        plan = self._striped.get(key)
+        if plan is None and shape is not None and \
+                self._should_stripe(np.prod(shape) if shape else 1):
+            plan = self._stripe_plan(key, shape,
+                                     dtype or np.float32)
+        if plan is not None:
+            shp, dt, sizes = plan
+            futs = [self._pool.submit(self._clients[i].pull,
+                                      "%s__strip%d" % (key, i))
+                    for i in range(len(sizes))]
+            return np.concatenate(
+                [np.asarray(f.result()).reshape(-1)
+                 for f in futs]).reshape(shp).astype(dt, copy=False)
+        return self._route(key).pull(key)
+
+    def set_optimizer(self, optimizer):
+        blob = pickle.dumps(optimizer, protocol=4)
+        for c in self._clients:
+            c._call("set_optimizer", None, blob)
+
+    def barrier(self):
+        self._clients[0].barrier()
+
+    def close(self):
+        self._pool.shutdown(wait=True)
+        for c in self._clients:
+            c.close()
+
+
+def create_client():
+    """The worker-side client for the configured topology: a plain
+    AsyncPSClient for one server, a ShardedPSClient over
+    server_endpoints() when DMLC_NUM_SERVER>1 (or MXNET_PS_SERVER_URIS
+    lists several)."""
+    eps = server_endpoints()
+    if len(eps) == 1:
+        return AsyncPSClient(*eps[0])
+    return ShardedPSClient(eps)
+
+
 class AsyncPSClient:
     """One worker's connection to the async server. Thread-safe per
     client via a lock (a worker's pushes are ordered on its own
@@ -286,12 +492,17 @@ class AsyncPSClient:
     def push(self, key, grad):
         self._call("push", key, np.asarray(grad))
 
-    def pull(self, key):
+    def pull(self, key, shape=None, dtype=None):
+        # shape/dtype accepted for ShardedPSClient surface parity
         return self._call("pull", key)
 
     def set_optimizer(self, optimizer):
         self._call("set_optimizer", None,
                    pickle.dumps(optimizer, protocol=4))
+
+    def stats(self):
+        """Keys held by this server (shard observability)."""
+        return self._call("stats")
 
     def barrier(self):
         self._call("barrier")
@@ -305,11 +516,20 @@ class AsyncPSClient:
 
 
 def serve_forever():
-    """Server-role entry: bind DMLC_PS_ROOT_PORT and serve until every
-    worker said bye (kvstore_server.py calls this when
-    MXNET_KVSTORE_TYPE=dist_async)."""
+    """Server-role entry: serve this process's shard until every worker
+    said bye (kvstore_server.py calls this when
+    MXNET_KVSTORE_TYPE=dist_async). Which shard = DMLC_SERVER_ID
+    (default 0), picking that entry of server_endpoints(). Bind host:
+    MXNET_PS_BIND > DMLC_PS_ROOT_URI > 127.0.0.1 — never 0.0.0.0 by
+    default (the wire unpickles requests; exposing it beyond a trusted
+    interface must be an explicit operator decision)."""
+    sid = int(os.environ.get("DMLC_SERVER_ID", "0"))
+    eps = server_endpoints()
+    if not 0 <= sid < len(eps):
+        raise ValueError("DMLC_SERVER_ID=%d out of range for %d "
+                         "configured server(s)" % (sid, len(eps)))
+    bind = os.environ.get("MXNET_PS_BIND") or eps[sid][0]
     server = AsyncPSServer(
-        host="0.0.0.0",
-        port=int(os.environ.get("DMLC_PS_ROOT_PORT", "9000")),
+        host=bind, port=eps[sid][1],
         num_workers=int(os.environ.get("DMLC_NUM_WORKER", "1")))
     server.serve_forever()

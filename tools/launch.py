@@ -31,6 +31,28 @@ def _free_port():
         return s.getsockname()[1]
 
 
+def _free_port_block(n):
+    """Base port with ports base..base+n-1 all currently bindable —
+    dist_async server i binds base+i (ps_async.server_endpoints), so
+    checking only the base would let one occupied follow-on port kill
+    the whole job at startup."""
+    if n <= 1:
+        return _free_port()
+    for _ in range(100):
+        base = _free_port()
+        ok = True
+        for i in range(1, n):
+            with socket.socket() as s:
+                try:
+                    s.bind(("", base + i))
+                except OSError:
+                    ok = False
+                    break
+        if ok:
+            return base
+    raise RuntimeError("no block of %d consecutive free ports found" % n)
+
+
 def _worker_env(rank, n, coord_uri, coord_port, extra=()):
     env = dict(os.environ)
     env.update({
@@ -44,14 +66,41 @@ def _worker_env(rank, n, coord_uri, coord_port, extra=()):
     return env
 
 
-def launch_local(n, command, env_extra=()):
-    """Fork n local worker processes (dmlc_tracker 'local' launcher).
-    If any worker dies, the survivors are killed — a partial cluster
+def _server_env(sid, n_workers, n_servers, coord_uri, coord_port,
+                extra=()):
+    """Server-role env (dist_async parameter-server shard sid; servers
+    bind coord_port+sid — parallel/ps_async.server_endpoints)."""
+    env = dict(os.environ)
+    env.update({
+        "DMLC_ROLE": "server",
+        "DMLC_PS_ROOT_URI": coord_uri,
+        "DMLC_PS_ROOT_PORT": str(coord_port),
+        "DMLC_NUM_WORKER": str(n_workers),
+        "DMLC_NUM_SERVER": str(n_servers),
+        "DMLC_SERVER_ID": str(sid),
+    })
+    env.update(dict(extra))
+    return env
+
+
+def launch_local(n, command, env_extra=(), num_servers=0):
+    """Fork n local worker processes (dmlc_tracker 'local' launcher),
+    plus num_servers parameter-server processes for dist_async (the
+    reference tracker launched servers the same way: same command,
+    DMLC_ROLE=server — the framework import enters the server loop).
+    If any process dies, the survivors are killed — a partial cluster
     would block forever inside jax.distributed.initialize."""
     import time
-    port = _free_port()
+    port = _free_port_block(max(1, num_servers))
+    extra = list(env_extra)
+    if num_servers:
+        extra.append(("DMLC_NUM_SERVER", str(num_servers)))
     procs = [subprocess.Popen(
-        command, env=_worker_env(r, n, "127.0.0.1", port, env_extra))
+        command, env=_server_env(s, n, num_servers, "127.0.0.1", port,
+                                 env_extra))
+        for s in range(num_servers)]
+    procs += [subprocess.Popen(
+        command, env=_worker_env(r, n, "127.0.0.1", port, extra))
         for r in range(n)]
     rc = 0
     while True:
@@ -96,6 +145,9 @@ def main(argv=None):
     ap = argparse.ArgumentParser(
         description="launch a distributed mxnet_tpu job")
     ap.add_argument("-n", "--num-workers", type=int, required=True)
+    ap.add_argument("-s", "--num-servers", type=int, default=0,
+                    help="parameter-server process count (dist_async; "
+                         "0 = collective-only job, no servers)")
     ap.add_argument("--launcher", choices=("local", "ssh"),
                     default="local")
     ap.add_argument("-H", "--hostfile",
@@ -112,7 +164,12 @@ def main(argv=None):
     extra = [kv.split("=", 1) for kv in args.env]
 
     if args.launcher == "local":
-        return launch_local(args.num_workers, args.command, extra)
+        return launch_local(args.num_workers, args.command, extra,
+                            num_servers=args.num_servers)
+    if args.num_servers:
+        ap.error("--num-servers is supported by the local launcher "
+                 "only (ssh server placement needs explicit "
+                 "MXNET_PS_SERVER_URIS)")
     with open(args.hostfile) as f:
         hosts = [ln.strip() for ln in f if ln.strip()]
     return launch_ssh(args.num_workers, hosts, args.command, extra)

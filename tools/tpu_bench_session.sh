@@ -25,10 +25,13 @@ mkdir -p "$OUT"
 FAILED=()
 note() { [ "$1" -ne 0 ] && FAILED+=("$2 (rc=$1)"); true; }
 
+# stdout ONLY goes through tee into the artifact (stderr stays on the
+# console/session log — backend warnings must never land inside a
+# committed .json and break strict consumers)
 cap() {   # cap <outfile> <label> <cmd...>: install output on success only
   local out="$1" label="$2"; shift 2
   local tmp; tmp="$(mktemp)"
-  "$@" 2>&1 | tee "$tmp"
+  "$@" | tee "$tmp"
   local rc=${PIPESTATUS[0]}
   if [ "$rc" -eq 0 ] && [ -s "$tmp" ]; then mv "$tmp" "$out"
   else rm -f "$tmp"; fi
@@ -37,7 +40,7 @@ cap() {   # cap <outfile> <label> <cmd...>: install output on success only
 capa() {  # capa <outfile> <label> <cmd...>: append on success only
   local out="$1" label="$2"; shift 2
   local tmp; tmp="$(mktemp)"
-  "$@" 2>&1 | tee "$tmp"
+  "$@" | tee "$tmp"
   local rc=${PIPESTATUS[0]}
   if [ "$rc" -eq 0 ] && [ -s "$tmp" ]; then cat "$tmp" >> "$out"; fi
   rm -f "$tmp"
