@@ -202,16 +202,27 @@ def _bn_train_core(data, g, beta, eps, red, bshape):
     backward — the HBM-traffic-minimal formulation (this op was
     measured at ~18% of the ResNet-50 step, docs/mfu_analysis.md):
 
-    forward: sum(x) and sum(x^2) are SIBLING reductions over the same
-    bf16 input (XLA fuses them into one loop with f32 accumulators;
-    jnp.var's E[(x-mean)^2] would chain two dependent passes), then
-    one read+write apply pass — 2 reads + 1 write total.
+    forward: shifted sums sum(x-c) and sum((x-c)^2) are SIBLING
+    reductions over the same bf16 input (XLA fuses them into one loop
+    with f32 accumulators; jnp.var's E[(x-mean)^2] would chain two
+    dependent passes), then one read+write apply pass — 2 reads +
+    1 write total. The per-channel shift c (the first sample's channel
+    mean — a 1/N-of-the-data reduction, then an in-pass broadcast
+    subtract) removes the catastrophic cancellation of the naive
+    E[x^2]-E[x]^2 form when |mean| >> std: variance is
+    translation-invariant, and with c drawn from the batch itself the
+    shifted mean is O(std), giving two-pass-grade accuracy at
+    one-pass HBM cost (advisor r4).
 
     backward: the textbook closed form
         dx = (g*inv/m) * (m*dy - sum(dy) - xhat*sum(dy*xhat))
     needs only the sibling pair sum(dy), sum(dy*xhat) (one pass over
     dy,x) plus the dx pass — autodiff of the two-pass forward chains
-    dvar/dmean passes on top.
+    dvar/dmean passes on top. The mean/var outputs' own cotangents
+    (nonzero when a graph differentiates through output_mean_var)
+    enter via d mean/dx = 1/m and d var/dx = 2(x-mean)/m, fused into
+    the same dx pass; training graphs pass zeros there and XLA folds
+    the terms away.
 
     Returns (y, mean, var); callers thread moving stats outside (the
     custom_vjp boundary must not capture them)."""
@@ -225,12 +236,23 @@ def _bn_train_core(data, g, beta, eps, red, bshape):
         m = 1
         for i in red:
             m *= x.shape[i]
-        s1 = jnp.sum(x, axis=red, dtype=jnp.float32)
-        s2 = jnp.sum(jnp.square(x.astype(jnp.float32)), axis=red)
-        mean = s1 / m
-        var = jnp.maximum(s2 / m - jnp.square(mean), 0.0)
+        xf = x.astype(jnp.float32)
+        # per-channel shift: the FIRST SAMPLE's channel mean — a
+        # reduction over 1/N of the data, so near-free next to the two
+        # main sums, but robust where a single anchor pixel is not
+        # (e.g. a zero-padded corner in a large-mean channel would
+        # reintroduce the very cancellation the shift removes)
+        x0 = lax.index_in_dim(xf, 0, red[0], keepdims=True)
+        cb = lax.stop_gradient(
+            jnp.mean(x0, axis=red, keepdims=True))   # bshape
+        c = cb.reshape(-1)                           # (C,)
+        s1 = jnp.sum(xf - cb, axis=red)
+        s2 = jnp.sum(jnp.square(xf - cb), axis=red)
+        mean_s = s1 / m
+        mean = c + mean_s
+        var = jnp.maximum(s2 / m - jnp.square(mean_s), 0.0)
         inv = lax.rsqrt(var + eps)
-        y = ((x.astype(jnp.float32) - mean.reshape(bshape))
+        y = ((xf - mean.reshape(bshape))
              * (inv.reshape(bshape)
                 * g.reshape(bshape).astype(jnp.float32))
              + b.reshape(bshape).astype(jnp.float32)).astype(x.dtype)
@@ -241,8 +263,10 @@ def _bn_train_core(data, g, beta, eps, red, bshape):
         return (y, mean, var), (x, g, mean, inv)
 
     def bwd(res, cts):
-        dy = cts[0].astype(jnp.float32)   # mean/var cotangents are
-        x, g, mean, inv = res             # zero in training graphs
+        dy = cts[0].astype(jnp.float32)
+        dmean = cts[1].astype(jnp.float32)
+        dvar = cts[2].astype(jnp.float32)
+        x, g, mean, inv = res
         m = 1
         for i in red:
             m *= x.shape[i]
@@ -252,7 +276,10 @@ def _bn_train_core(data, g, beta, eps, red, bshape):
         k = (g.astype(jnp.float32) * inv) / m
         dx = (k.reshape(bshape)
               * (m * dy - db.reshape(bshape)
-                 - xc * (inv * dgx).reshape(bshape))).astype(x.dtype)
+                 - xc * (inv * dgx).reshape(bshape))
+              # mean/var output cotangents (zero in training graphs)
+              + (dmean / m).reshape(bshape)
+              + (2.0 / m) * xc * dvar.reshape(bshape)).astype(x.dtype)
         return dx, dgx.astype(g.dtype), db.astype(beta.dtype)
 
     f.defvjp(fwd, bwd)

@@ -528,8 +528,26 @@ def serve_forever():
     if not 0 <= sid < len(eps):
         raise ValueError("DMLC_SERVER_ID=%d out of range for %d "
                          "configured server(s)" % (sid, len(eps)))
-    bind = os.environ.get("MXNET_PS_BIND") or eps[sid][0]
-    server = AsyncPSServer(
-        host=bind, port=eps[sid][1],
-        num_workers=int(os.environ.get("DMLC_NUM_WORKER", "1")))
+    bind = os.environ.get("MXNET_PS_BIND")
+    n_workers = int(os.environ.get("DMLC_NUM_WORKER", "1"))
+    if bind:
+        server = AsyncPSServer(host=bind, port=eps[sid][1],
+                               num_workers=n_workers)
+    else:
+        # default: bind the advertised endpoint host. When that
+        # address is not locally bindable (NAT/public IP on a cloud
+        # VM), fall back to all interfaces with a loud warning rather
+        # than dying — MXNET_PS_BIND pins it explicitly either way.
+        try:
+            server = AsyncPSServer(host=eps[sid][0], port=eps[sid][1],
+                                   num_workers=n_workers)
+        except OSError:
+            import logging
+            logging.warning(
+                "async PS: advertised host %s is not locally bindable"
+                " — binding all interfaces (0.0.0.0). The wire "
+                "unpickles requests; set MXNET_PS_BIND to a private "
+                "interface on untrusted networks.", eps[sid][0])
+            server = AsyncPSServer(host="", port=eps[sid][1],
+                                   num_workers=n_workers)
     server.serve_forever()

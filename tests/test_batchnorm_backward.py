@@ -95,6 +95,80 @@ def test_moving_stats_and_eval_path():
                                atol=1e-4)
 
 
+def test_mean_var_output_cotangents():
+    """Advisor r4: a graph that differentiates THROUGH the mean/var
+    outputs (output_mean_var consumers) must get correct gradients —
+    the closed-form backward folds d mean/dx = 1/m and
+    d var/dx = 2(x-mean)/m into the dx pass, not silently dropping
+    the cotangents."""
+    from mxnet_tpu.ops.nn import _bn_train_core
+
+    rng = np.random.RandomState(3)
+    x = rng.randn(4, 3, 5, 5).astype(np.float32)
+    gamma = rng.rand(3).astype(np.float32) + 0.5
+    beta = rng.randn(3).astype(np.float32)
+    eps = 1e-3
+    red, bshape = (0, 2, 3), (1, 3, 1, 1)
+    w_y = rng.randn(*x.shape).astype(np.float32)
+    w_m = rng.randn(3).astype(np.float32)
+    w_v = rng.randn(3).astype(np.float32)
+
+    def core_loss(x_, g_, b_):
+        y, mean, var = _bn_train_core(jnp.asarray(x_), g_, b_, eps,
+                                      red, bshape)
+        return (jnp.sum(y * w_y) + jnp.sum(mean * w_m)
+                + jnp.sum(var * w_v))
+
+    def naive_loss(x_, g_, b_):
+        xf = jnp.asarray(x_).astype(jnp.float32)
+        mean = jnp.mean(xf, axis=red)
+        var = jnp.var(xf, axis=red)
+        inv = jax.lax.rsqrt(var.reshape(bshape) + eps)
+        y = (xf - mean.reshape(bshape)) * inv * g_.reshape(bshape) + \
+            b_.reshape(bshape)
+        return (jnp.sum(y * w_y) + jnp.sum(mean * w_m)
+                + jnp.sum(var * w_v))
+
+    gf = jax.grad(core_loss, argnums=(0, 1, 2))(x, gamma, beta)
+    gn = jax.grad(naive_loss, argnums=(0, 1, 2))(x, gamma, beta)
+    for a, b, name in zip(gf, gn, ("dx", "dgamma", "dbeta")):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=2e-4, atol=2e-4,
+            err_msg="%s mismatch through mean/var outputs" % name)
+
+
+def test_one_pass_variance_large_mean_accuracy():
+    """Advisor r4: naive E[x^2]-E[x]^2 catastrophically cancels when
+    |mean| >> std. The shifted one-pass form must normalize a
+    mean=1e4, std=1e-2 batch to two-pass accuracy (unshifted f32
+    would clamp the variance to ~0 and blow the output up against
+    eps)."""
+    from mxnet_tpu.ops.nn import _batch_norm
+
+    rng = np.random.RandomState(4)
+    noise = rng.randn(64, 2, 8, 8).astype(np.float32)
+    x = (1e4 + 1e-2 * noise).astype(np.float32)
+    out = _batch_norm(jnp.asarray(x), jnp.ones(2), jnp.zeros(2),
+                      jnp.zeros(2), jnp.ones(2), eps=1e-5,
+                      fix_gamma=False, is_train=True)
+    y = np.asarray(out[0], np.float64)
+    # unshifted one-pass: s2/m and mean^2 are ~1e8 with an f32 ulp of
+    # ~8, so the 1e-4 true variance cancels to the clamp -> rsqrt(eps)
+    # blows the output std up to ~300. The shifted form must keep a
+    # unit-std output...
+    for c in range(2):
+        assert 0.9 < y[:, c].std() < 1.1, y[:, c].std()
+    # ...and match the two-pass E[(x-mean)^2] formulation (both share
+    # the f32 input-representation floor, so they agree tightly)
+    xf = jnp.asarray(x)
+    mean = jnp.mean(xf, axis=(0, 2, 3))
+    var = jnp.var(xf, axis=(0, 2, 3))
+    ref = (xf - mean.reshape(1, 2, 1, 1)) * jax.lax.rsqrt(
+        var.reshape(1, 2, 1, 1) + 1e-5)
+    np.testing.assert_allclose(y, np.asarray(ref), rtol=1e-3,
+                               atol=5e-3)
+
+
 def test_one_pass_var_nonnegative():
     """E[x^2]-E[x]^2 can go fractionally negative in f32; the clamp
     must keep rsqrt finite even for constant inputs."""

@@ -128,6 +128,26 @@ def test_bench_fail_exit_code_contract(monkeypatch, capsys):
     assert out["value"] is None
 
 
+def test_perf_tables_newest_capture_wins(tmp_path):
+    """Advisor r4: JSONL captures append chronologically; the rendered
+    table must show the LAST record per key, not the first."""
+    import importlib.util
+    import json
+    import os
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    spec = importlib.util.spec_from_file_location(
+        "perf_tables", os.path.join(repo, "tools", "perf_tables.py"))
+    pt = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(pt)
+    rec = {"metric": "resnet50_train_throughput", "unit": "img/s",
+           "vs_baseline": 1.0, "mfu": 0.2, "step_time_ms": 50.0}
+    lines = [dict(rec, value=1000.0), dict(rec, value=2222.0)]
+    (tmp_path / "sweep.jsonl").write_text(
+        "\n".join(json.dumps(r) for r in lines) + "\n")
+    table = pt.training_table(pt.load_records(str(tmp_path)))
+    assert "2222" in table and "1000" not in table
+
+
 def test_perf_tables_renders_from_committed_captures():
     """tools/perf_tables.py turns bench_out/ artifacts into the docs
     tables; must at least render the committed training captures."""

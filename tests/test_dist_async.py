@@ -32,24 +32,10 @@ def _free_port():
 
 
 def _free_port_block(n):
-    """Base port with base..base+n-1 all bindable (server i binds
-    base+i under the default endpoint layout)."""
-    for _ in range(50):
-        base = _free_port()
-        ok = True
-        for i in range(1, n):
-            s = socket.socket()
-            try:
-                s.bind(("127.0.0.1", base + i))
-            except OSError:
-                ok = False
-            finally:
-                s.close()
-            if not ok:
-                break
-        if ok:
-            return base
-    raise RuntimeError("no free port block of %d" % n)
+    """Server i binds base+i under the default endpoint layout; reuse
+    the launcher's own block prober rather than a drifting copy."""
+    from tools.launch import _free_port_block as block
+    return block(n)
 
 
 @pytest.fixture

@@ -21,9 +21,22 @@ import os
 _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
+def _mtime(path):
+    try:
+        return os.path.getmtime(path)
+    except OSError:
+        return 0.0
+
+
 def load_records(out_dir):
+    """Records in best-effort chronological order: files sorted by
+    mtime (then name as the tiebreak — e.g. a fresh checkout where all
+    mtimes match), lines within a file in append order. Downstream
+    newest-wins dedup relies on this ordering."""
     recs = []
-    for path in sorted(glob.glob(os.path.join(out_dir, "*.json*"))):
+    paths = glob.glob(os.path.join(out_dir, "*.json*"))
+    for path in sorted(paths, key=lambda p: (_mtime(p),
+                                             os.path.basename(p))):
         try:
             with open(path) as f:
                 for line in f:
@@ -49,6 +62,23 @@ def _fmt(v):
     return str(v)
 
 
+def _dedupe_newest(rows, keyfn):
+    """Newest capture wins. load_records orders files by mtime and
+    lines by append order, so the LAST record per key is the most
+    recent — iterate reversed for the dedup, then restore encounter
+    order for stable table layout (advisor r4: the old first-wins scan
+    rendered the OLDEST record)."""
+    newest = {}
+    for r in reversed(rows):
+        newest.setdefault(keyfn(r), r)
+    out = []
+    for r in rows:
+        k = keyfn(r)
+        if newest.get(k) is r:
+            out.append(r)
+    return out
+
+
 def training_table(recs):
     rows = [r for r in recs
             if r.get("metric", "").endswith("_train_throughput")]
@@ -57,13 +87,9 @@ def training_table(recs):
     out = ["## Training (one chip)", "",
            "| workload | value | unit | vs baseline | MFU | step ms |",
            "|---|---|---|---|---|---|"]
-    seen = set()
-    for r in rows:
-        key = (r["metric"], r.get("seq_len"), r.get("window"),
-               r.get("remat"))
-        if key in seen:
-            continue
-        seen.add(key)
+    for r in _dedupe_newest(rows, lambda r: (
+            r["metric"], r.get("seq_len"), r.get("window"),
+            r.get("remat"))):
         name = r["metric"].replace("_train_throughput", "")
         if r.get("seq_len"):
             name += " T=%d" % r["seq_len"]
@@ -85,17 +111,26 @@ def decode_table(recs):
     if not rows:
         return ""
     out = ["## Decode / serving (one chip)", "",
-           "| mode | tokens/s | ms/token | batch | quantize |",
-           "|---|---|---|---|---|"]
-    for r in rows:
+           "| mode | tokens/s | ms/token | batch | quantize | notes |",
+           "|---|---|---|---|---|---|"]
+    for r in _dedupe_newest(rows, lambda r: (
+            r["metric"], r.get("quantize"), r.get("batch"),
+            r.get("prompt_len"), r.get("new_tokens"))):
         mode = "greedy"
         if r.get("beam"):
             mode = "beam-%d" % r["beam"]
+        if r.get("speculative_lookahead"):
+            mode = "speculative-%d" % r["speculative_lookahead"]
+        if r.get("kv_heads"):
+            mode += " gqa-%d" % r["kv_heads"]
         if r.get("quantize"):
             mode += " int8"
-        out.append("| %s | %s | %s | %s | %s |" % (
+        notes = ""
+        if r.get("spec_accepted_per_round") is not None:
+            notes = "%.2f accepted/round" % r["spec_accepted_per_round"]
+        out.append("| %s | %s | %s | %s | %s | %s |" % (
             mode, _fmt(r["value"]), _fmt(r.get("ms_per_token", "")),
-            r.get("batch", ""), r.get("quantize") or "-"))
+            r.get("batch", ""), r.get("quantize") or "-", notes))
     return "\n".join(out)
 
 
@@ -107,7 +142,7 @@ def bn_table(recs):
     out = ["## BatchNorm one-pass vs two-pass (fwd+bwd)", "",
            "| shape | one-pass ms | two-pass ms | speedup |",
            "|---|---|---|---|"]
-    for r in rows:
+    for r in _dedupe_newest(rows, lambda r: tuple(r["shape"])):
         out.append("| %s | %s | %s | %sx |" % (
             "x".join(str(d) for d in r["shape"]),
             _fmt(r["one_pass_ms"]), _fmt(r["two_pass_ms"]),
@@ -123,7 +158,8 @@ def pipeline_table(recs):
     out = ["## Input pipeline", "",
            "| variant | img/s | threads | batch |",
            "|---|---|---|---|"]
-    for r in rows:
+    for r in _dedupe_newest(rows, lambda r: (
+            r["metric"], r.get("variant"), r.get("threads"))):
         name = r.get("variant") or r["metric"].replace(
             "input_pipeline_", "")
         out.append("| %s | %s | %s | %s |" % (
