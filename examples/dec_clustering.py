@@ -1,0 +1,193 @@
+"""DEC — Deep Embedded Clustering (Xie et al. 2016) — reference
+example/dec/dec.py: pretrain an autoencoder, initialize cluster
+centres with KMeans on the embedded features, then refine encoder AND
+centres jointly by minimizing KL(p || q), where q is a Student-t
+soft assignment and the target p is recomputed from q every
+`update_interval` steps; stop when fewer than 0.1% of the hard
+assignments change between refreshes.
+
+The reference seam this exercises is the CUSTOM TRAINING LOOP: DEC
+does not fit the fit()/epoch mold — it interleaves full-dataset
+feature extraction, host-side KMeans/target computation, a bespoke
+NumpyOp loss (dec.py:DECLoss with a hand-written backward), and a
+convergence test on cluster assignments.
+
+TPU-first redesign: the hand-written DECLoss backward disappears —
+q and KL(p||q) are expressed in autograd-recorded nd ops (the
+Student-t kernel is two matmul-shaped reductions, MXU-friendly) and
+the gradient to both the encoder weights and the centres `mu` comes
+from autograd.backward. The periodic refresh stays a host decision
+(it is control flow over the WHOLE dataset, exactly what should not
+live inside a traced step), matching the reference's iter callback.
+
+Self-checking: on the real-digits fixture (10 classes), DEC must
+(a) terminate via the assignment-change criterion, and (b) end with
+Hungarian-matched cluster accuracy above 0.65 without degrading its
+own KMeans-in-embedding-space initialization. (The raw-pixel KMeans
+baseline is printed for context only: 8x8 digits are easy enough that
+pixels already cluster well — DEC's MNIST-scale win is over data
+where they don't.)
+
+Run: python examples/dec_clustering.py
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, io, nd
+
+EMBED = 10
+HIDDEN = 64
+ALPHA = 1.0
+BATCH = 64
+
+
+def load_digits():
+    f = np.load(os.path.join(os.path.dirname(__file__), "..", "tests",
+                             "fixtures", "digits_8x8.npz"))
+    X = f["images"].astype(np.float32).reshape(len(f["images"]), -1)
+    X /= 16.0
+    return X, f["labels"].astype(np.int64)
+
+
+def cluster_acc(y_pred, y):
+    """Hungarian-matched accuracy (dec.py:cluster_acc, re-derived on
+    scipy's modern assignment API)."""
+    from scipy.optimize import linear_sum_assignment
+    D = int(max(y_pred.max(), y.max())) + 1
+    w = np.zeros((D, D), np.int64)
+    for yp, yt in zip(y_pred, y):
+        w[int(yp), int(yt)] += 1
+    rows, cols = linear_sum_assignment(w.max() - w)
+    return w[rows, cols].sum() / float(len(y))
+
+
+def pretrain_autoencoder(X):
+    """Reconstruction pretraining via the normal Module surface (the
+    reference used layerwise pretraining over 150k steps; one joint
+    phase is plenty at this scale)."""
+    data = mx.sym.Variable("data")
+    enc = mx.sym.Activation(mx.sym.FullyConnected(
+        data, num_hidden=HIDDEN, name="enc1"), act_type="relu")
+    enc = mx.sym.FullyConnected(enc, num_hidden=EMBED, name="enc2")
+    dec = mx.sym.Activation(mx.sym.FullyConnected(
+        enc, num_hidden=HIDDEN, name="dec1"), act_type="relu")
+    dec = mx.sym.FullyConnected(dec, num_hidden=X.shape[1],
+                                name="dec2")
+    loss = mx.sym.LinearRegressionOutput(dec, mx.sym.Variable(
+        "label"), name="recon")
+    mod = mx.mod.Module(loss, label_names=("label",), context=mx.cpu())
+    it = io.NDArrayIter({"data": X}, {"label": X}, batch_size=BATCH,
+                        shuffle=True)
+    mod.fit(it, num_epoch=30, optimizer="adam",
+            initializer=mx.init.Xavier(),
+            optimizer_params={"learning_rate": 1e-3,
+                              "rescale_grad": 1.0 / BATCH})
+    args, _ = mod.get_params()
+    return {k: args[k] for k in ("enc1_weight", "enc1_bias",
+                                 "enc2_weight", "enc2_bias")}
+
+
+def encode(params, x):
+    h = nd.relu(nd.FullyConnected(x, params["enc1_weight"],
+                                  params["enc1_bias"],
+                                  num_hidden=HIDDEN))
+    return nd.FullyConnected(h, params["enc2_weight"],
+                             params["enc2_bias"], num_hidden=EMBED)
+
+
+def soft_assign(z, mu):
+    """Student-t similarity q_ij (dec.py:DECLoss.forward, re-derived
+    as autograd-recorded ops: ||z-mu||^2 via the Gram expansion keeps
+    it matmul-shaped for the MXU)."""
+    zz = nd.sum(z * z, axis=1, keepdims=True)            # (N,1)
+    mm = nd.sum(mu * mu, axis=1, keepdims=True)          # (K,1)
+    d2 = zz + nd.transpose(mm) - 2.0 * nd.dot(z, nd.transpose(mu))
+    q = (1.0 + d2 / ALPHA) ** (-(ALPHA + 1.0) / 2.0)
+    return nd.broadcast_div(q, nd.sum(q, axis=1, keepdims=True))
+
+
+def target_distribution(q):
+    """p = q^2 / f, renormalized (the self-sharpening target;
+    frequency weighting f = per-cluster soft count)."""
+    w = (q ** 2) / np.maximum(q.sum(axis=0, keepdims=True), 1e-9)
+    return (w.T / w.sum(axis=1)).T.astype(np.float32)
+
+
+def main():
+    X, y = load_digits()
+    N = len(X)
+    rng = np.random.RandomState(0)
+
+    from sklearn.cluster import KMeans
+    pixel_acc = cluster_acc(
+        KMeans(10, n_init=10, random_state=0).fit_predict(X), y)
+    print("raw-pixel KMeans baseline: %.3f" % pixel_acc)
+
+    params = pretrain_autoencoder(X)
+    for p in params.values():
+        p.attach_grad()
+
+    z0 = encode(params, nd.array(X)).asnumpy()
+    km = KMeans(10, n_init=20, random_state=0).fit(z0)
+    mu = nd.array(km.cluster_centers_.astype(np.float32))
+    mu.attach_grad()
+    init_acc = cluster_acc(km.labels_, y)
+    print("AE-feature KMeans init: %.3f" % init_acc)
+
+    trainable = list(params.values()) + [mu]
+    update_interval = 4 * (N // BATCH)       # ~4 epochs per refresh
+    tol = 0.001
+    y_last = np.zeros(N, np.int64) - 1
+    p_full = None
+    converged = False
+    step = 0
+    order = np.arange(N)
+    while step < 400 * (N // BATCH):
+        if step % update_interval == 0:
+            q_full = soft_assign(encode(params, nd.array(X)),
+                                 mu).asnumpy()
+            y_pred = q_full.argmax(axis=1)
+            p_full = target_distribution(q_full)
+            changed = np.mean(y_pred != y_last)
+            print("refresh @%d: acc %.3f, %.4f changed"
+                  % (step, cluster_acc(y_pred, y), changed))
+            if y_last[0] >= 0 and changed < tol:
+                converged = True
+                break
+            y_last = y_pred
+            rng.shuffle(order)
+        idx = order[(step * BATCH) % N:(step * BATCH) % N + BATCH]
+        if len(idx) < BATCH:
+            step += 1
+            continue
+        xb = nd.array(X[idx])
+        pb = nd.array(p_full[idx])
+        with autograd.record():
+            q = soft_assign(encode(params, xb), mu)
+            # KL(p||q): the -sum(p log q) half carries the gradient
+            loss = -nd.sum(pb * nd.log(q + 1e-9))
+        loss.backward()
+        for prm in trainable:
+            nd.sgd_update(prm, prm.grad, lr=0.01,
+                          rescale_grad=1.0 / BATCH, out=prm)
+        step += 1
+
+    assert converged, "DEC never hit the assignment-change criterion"
+    final = cluster_acc(y_last, y)
+    print("final DEC accuracy: %.3f (init %.3f, pixel baseline %.3f)"
+          % (final, init_acc, pixel_acc))
+    assert final > 0.65, "DEC accuracy too low: %.3f" % final
+    assert final >= init_acc - 0.01, \
+        "DEC refinement degraded its own init: %.3f < %.3f" \
+        % (final, init_acc)
+    print("dec_clustering OK")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,240 @@
+"""Speech-style acoustic model: variable-length CONTINUOUS-feature
+utterances, frame-level labels, bucketed batching, and an LSTM with a
+projected recurrent state — reference example/speech-demo/
+(train_lstm_proj.py + lstm_proj.py + io_util.py). That example fed
+Kaldi filterbank utterances of wildly varying length through custom
+bucket iterators into an LSTMP acoustic model with per-frame
+cross-entropy; this is the same seam, zero-egress:
+
+* LSTMPCell — the projection matrix inside the recurrence
+  (lstm_proj.py's num_hidden_proj: h_t is replaced by r_t = W_p m_t,
+  shrinking both the recurrent matmul and the state), defined HERE as
+  a BaseRNNCell subclass, exactly how the reference example carried
+  its own cell.
+* a speech bucket iterator — float (B, T, F) features + (B, T) frame
+  labels, utterances padded to the bucket length with label -1 (the
+  reference padded with zero frames and masked); SoftmaxOutput's
+  use_ignore drops the padded frames from the loss.
+* BucketingModule — one jit specialization per bucket length sharing
+  one parameter set (the XLA-native answer to dynamic shapes).
+
+TPU notes: frames stream through time-major unrolled matmuls that
+batch over utterances (MXU-friendly); buckets keep shapes static so
+each length compiles once.
+
+Self-checking:
+1. frame accuracy on real (non-padded) frames > 0.9 after training;
+2. causal padding invariance: the same short utterance padded into
+   two DIFFERENT buckets yields identical predictions on its real
+   frames (padding can never leak backward into a unidirectional
+   LSTM — the bucketing analogue of the masking guarantee).
+
+Run: python examples/speech_lstm_bucketing.py
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import io
+from mxnet_tpu.ndarray import op as _nop  # noqa: F401 (parity import)
+from mxnet_tpu.symbol import op as _op
+
+K = 6            # phoneme classes
+F = 20           # filterbank-ish feature dim
+HIDDEN = 96
+PROJ = 48
+BATCH = 8
+BUCKETS = (16, 32, 48)
+
+
+class LSTMPCell(mx.rnn.BaseRNNCell):
+    """LSTM with projected recurrent state (reference
+    example/speech-demo/lstm_proj.py): memory cell m_t keeps
+    num_hidden units, but the state fed back (and emitted) is
+    r_t = W_p m_t with num_proj < num_hidden — the h2h matmul runs at
+    proj width, the classic speech-model compute saver."""
+
+    def __init__(self, num_hidden, num_proj, prefix="lstmp_",
+                 params=None, forget_bias=1.0):
+        super().__init__(prefix=prefix, params=params)
+        self._num_hidden = num_hidden
+        self._num_proj = num_proj
+        self._iW = self.params.get("i2h_weight")
+        self._hW = self.params.get("h2h_weight")
+        self._iB = self.params.get(
+            "i2h_bias",
+            init=mx.init.LSTMBias(forget_bias=forget_bias))
+        self._hB = self.params.get("h2h_bias")
+        self._pW = self.params.get("proj_weight")
+
+    @property
+    def state_info(self):
+        return [{"shape": (0, self._num_proj), "__layout__": "NC"},
+                {"shape": (0, self._num_hidden), "__layout__": "NC"}]
+
+    @property
+    def _gate_names(self):
+        return ("i", "f", "c", "o")
+
+    def __call__(self, inputs, states):
+        self._counter += 1
+        name = "%st%d_" % (self._prefix, self._counter)
+        i2h = _op.FullyConnected(inputs, self._iW, self._iB,
+                                 num_hidden=4 * self._num_hidden,
+                                 name="%si2h" % name)
+        h2h = _op.FullyConnected(states[0], self._hW, self._hB,
+                                 num_hidden=4 * self._num_hidden,
+                                 name="%sh2h" % name)
+        gates = _op.SliceChannel(i2h + h2h, num_outputs=4, axis=-1)
+        i_g = _op.Activation(gates[0], act_type="sigmoid")
+        f_g = _op.Activation(gates[1], act_type="sigmoid")
+        c_t = _op.Activation(gates[2], act_type="tanh")
+        o_g = _op.Activation(gates[3], act_type="sigmoid")
+        next_m = f_g * states[1] + i_g * c_t
+        h = o_g * _op.Activation(next_m, act_type="tanh")
+        r = _op.FullyConnected(h, self._pW, no_bias=True,
+                               num_hidden=self._num_proj,
+                               name="%sproj" % name)
+        return r, [r, next_m]
+
+
+def sym_gen(seq_len):
+    data = mx.sym.Variable("data")               # (B, T, F)
+    label = mx.sym.Variable("softmax_label")     # (B, T), -1 = pad
+    cell = LSTMPCell(HIDDEN, PROJ)
+    outputs, _ = cell.unroll(seq_len, data, layout="NTC",
+                             merge_outputs=True)     # (B, T, PROJ)
+    flat = mx.sym.Reshape(outputs, shape=(-1, PROJ))
+    fc = mx.sym.FullyConnected(flat, num_hidden=K, name="frame_fc")
+    sm = mx.sym.SoftmaxOutput(fc, mx.sym.Reshape(label, shape=(-1,)),
+                              use_ignore=True, ignore_label=-1,
+                              normalization="valid", name="softmax")
+    return sm, ("data",), ("softmax_label",)
+
+
+def synth_utterance(rng, protos):
+    """Phoneme prototypes + noise, random durations — a caricature of
+    filterbank frames with alignments."""
+    n_ph = rng.randint(2, 7)
+    frames, labels = [], []
+    for _ in range(n_ph):
+        ph = rng.randint(0, K)
+        dur = rng.randint(3, 9)
+        frames.append(protos[ph][None].repeat(dur, 0)
+                      + 0.4 * rng.randn(dur, F))
+        labels.append(np.full(dur, ph))
+    return (np.concatenate(frames).astype(np.float32),
+            np.concatenate(labels).astype(np.float32))
+
+
+def bucket_for(n):
+    for b in BUCKETS:
+        if n <= b:
+            return b
+    return None
+
+
+def make_batches(utts, rng=None):
+    """Group utterances by bucket, pad to the bucket length (features
+    with zero frames, labels with -1), emit full DataBatches — the
+    example-local bucket iterator, like the reference's io_util.py."""
+    by_bucket = {b: [] for b in BUCKETS}
+    for x, y in utts:
+        b = bucket_for(len(x))
+        if b is not None:
+            by_bucket[b].append((x, y))
+    batches = []
+    for b, items in by_bucket.items():
+        for i in range(0, len(items) - BATCH + 1, BATCH):
+            X = np.zeros((BATCH, b, F), np.float32)
+            Y = np.full((BATCH, b), -1.0, np.float32)
+            for j, (x, y) in enumerate(items[i:i + BATCH]):
+                X[j, :len(x)] = x
+                Y[j, :len(y)] = y
+            batches.append(io.DataBatch(
+                data=[mx.nd.array(X)], label=[mx.nd.array(Y)],
+                bucket_key=b,
+                provide_data=[("data", (BATCH, b, F))],
+                provide_label=[("softmax_label", (BATCH, b))]))
+    if rng is not None:
+        rng.shuffle(batches)
+    return batches
+
+
+def frame_accuracy(mod, batches):
+    correct = total = 0
+    for batch in batches:
+        mod.forward(batch, is_train=False)
+        pred = mod.get_outputs()[0].asnumpy().argmax(axis=1)
+        lab = batch.label[0].asnumpy().reshape(-1)
+        real = lab >= 0
+        correct += (pred[real] == lab[real]).sum()
+        total += real.sum()
+    return correct / float(total)
+
+
+def check_padding_invariance(mod, protos):
+    """One short utterance, padded into bucket 16 AND bucket 48: the
+    predictions on its real frames must match exactly-ish (causality:
+    pad frames sit in the future of every real frame)."""
+    rng = np.random.RandomState(99)
+    x, y = synth_utterance(rng, protos)
+    x, y = x[:14], y[:14]
+    preds = {}
+    for b in (BUCKETS[0], BUCKETS[-1]):
+        X = np.zeros((BATCH, b, F), np.float32)
+        Y = np.full((BATCH, b), -1.0, np.float32)
+        X[0, :len(x)] = x
+        Y[0, :len(y)] = y
+        batch = io.DataBatch(
+            data=[mx.nd.array(X)], label=[mx.nd.array(Y)],
+            bucket_key=b,
+            provide_data=[("data", (BATCH, b, F))],
+            provide_label=[("softmax_label", (BATCH, b))])
+        mod.forward(batch, is_train=False)
+        out = mod.get_outputs()[0].asnumpy().reshape(BATCH, b, K)
+        preds[b] = out[0, :len(x)]
+    np.testing.assert_allclose(preds[BUCKETS[0]], preds[BUCKETS[-1]],
+                               rtol=1e-4, atol=1e-5)
+    print("padding invariance OK: identical real-frame predictions "
+          "across buckets %d and %d" % (BUCKETS[0], BUCKETS[-1]))
+
+
+def main():
+    rng = np.random.RandomState(0)
+    protos = rng.randn(K, F).astype(np.float32) * 2.0
+    utts = [synth_utterance(rng, protos) for _ in range(480)]
+
+    mod = mx.mod.BucketingModule(sym_gen,
+                                 default_bucket_key=BUCKETS[-1],
+                                 context=mx.cpu())
+    mod.bind(data_shapes=[("data", (BATCH, BUCKETS[-1], F))],
+             label_shapes=[("softmax_label", (BATCH, BUCKETS[-1]))])
+    mod.init_params(mx.init.Xavier())
+    mod.init_optimizer(optimizer="adam",
+                       optimizer_params={"learning_rate": 2e-3,
+                                         "rescale_grad": 1.0 / BATCH})
+    for epoch in range(4):
+        batches = make_batches(utts, rng)
+        for batch in batches:
+            mod.forward_backward(batch)
+            mod.update()
+        print("epoch %d frame-acc %.3f"
+              % (epoch, frame_accuracy(mod, batches[:12])))
+
+    batches = make_batches(utts)
+    acc = frame_accuracy(mod, batches)
+    print("final frame accuracy (non-pad frames): %.3f" % acc)
+    assert acc > 0.9, "acoustic model failed to train: %.3f" % acc
+
+    check_padding_invariance(mod, protos)
+    print("speech_lstm_bucketing OK")
+
+
+if __name__ == "__main__":
+    main()

@@ -150,15 +150,36 @@ class DataParallelExecutorGroup:
 
         # param/aux arrays from a previous bind (batch-shape reshape) must
         # be carried over — rebuilding them as zeros would silently wipe
-        # trained weights mid-training
+        # trained weights mid-training. A shared_group (bucketing) goes
+        # further: its executor's param/aux NDArrays are adopted BY
+        # REFERENCE, so every bucket reads and updates the SAME arrays
+        # (the reference's shared_exec arg sharing,
+        # executor_group.py:_bind_ith_exec) — without this each bucket
+        # silently trains its own diverging parameter copy.
         prev_args = self.execs[0].arg_dict if self.execs else {}
         prev_aux = self.execs[0].aux_dict if self.execs else {}
+        shared_args = shared_group.execs[0].arg_dict if shared_group \
+            else {}
+        shared_aux = shared_group.execs[0].aux_dict if shared_group \
+            else {}
 
         args = {}
         for name, shape, dtype in zip(self.arg_names, arg_shapes, arg_types):
             if name in self.param_names and name in prev_args and \
                     tuple(prev_args[name].shape) == tuple(shape):
                 args[name] = prev_args[name]
+            elif name in self.param_names and name in shared_args:
+                if tuple(shared_args[name].shape) != tuple(shape):
+                    # a bucket-dependent PARAM shape would silently
+                    # fork the parameter set — fail loudly (the
+                    # reference asserts here too)
+                    raise MXNetError(
+                        "bucketing: param %r has shape %s in this "
+                        "bucket but %s in the shared (default) bucket "
+                        "— parameters must be bucket-invariant"
+                        % (name, tuple(shape),
+                           tuple(shared_args[name].shape)))
+                args[name] = shared_args[name]
             elif name in self.shared_data_arrays and \
                     tuple(self.shared_data_arrays[name].shape) == \
                     tuple(shape):
@@ -167,12 +188,48 @@ class DataParallelExecutorGroup:
                 args[name] = zeros(shape, dtype=dtype)
                 if name not in self.param_names:
                     self.shared_data_arrays[name] = args[name]
-        aux = [prev_aux[n] if n in prev_aux and
-               tuple(prev_aux[n].shape) == tuple(s) else zeros(s, dtype=t)
+
+        def _aux_for(n, s, t):
+            if n in prev_aux and tuple(prev_aux[n].shape) == tuple(s):
+                return prev_aux[n]
+            if n in shared_aux:
+                if tuple(shared_aux[n].shape) != tuple(s):
+                    raise MXNetError(
+                        "bucketing: aux state %r has shape %s in this "
+                        "bucket but %s in the shared (default) bucket"
+                        % (n, tuple(s), tuple(shared_aux[n].shape)))
+                return shared_aux[n]
+            return zeros(s, dtype=t)
+
+        aux = [_aux_for(n, s, t)
                for n, s, t in zip(self.aux_names, aux_shapes, aux_types)]
+
+        # grad buffers shared the same way (reference shared_exec also
+        # reused args_grad): one param-sized grad set for ALL buckets —
+        # safe because update() consumes the current bucket's grads
+        # right after its backward, and required for grad_req="add" to
+        # accumulate across buckets like the reference
+        shared_grads = shared_group.execs[0].grad_dict if shared_group \
+            else {}
+        args_grad = None
+        if any(self.grad_req.get(n, "null") != "null"
+               for n in self.arg_names):
+            args_grad = {}
+            for name in self.arg_names:
+                if self.grad_req.get(name, "null") == "null":
+                    continue
+                g = shared_grads.get(name)
+                if g is not None and \
+                        tuple(g.shape) == tuple(args[name].shape):
+                    args_grad[name] = g
+                else:
+                    args_grad[name] = zeros(
+                        tuple(args[name].shape),
+                        dtype=args[name].dtype)
 
         executor = Executor(self.symbol, ctx=self.contexts[0],
                             args=[args[n] for n in self.arg_names],
+                            args_grad=args_grad,
                             grad_req=self.grad_req, aux_states=aux,
                             mesh=self._mesh)
         self.execs = [executor]

@@ -26,15 +26,41 @@ FAST_EXAMPLES = [
     "svm_digits.py",
     "vae.py",
     "neural_style.py",
+    "stochastic_depth.py",
 ]
+
+
+def test_speech_lstm_bucketing_example(tmp_path):
+    """Speech-style bucketed pipeline: runs the example (self-checking:
+    frame-accuracy floor + cross-bucket padding invariance, the check
+    that caught the round-5 bucket-parameter-sharing regression)."""
+    _run_example("speech_lstm_bucketing.py", tmp_path, timeout=600,
+                 expect="speech_lstm_bucketing OK")
+
+
+def test_dec_clustering_example(tmp_path):
+    """DEC has its own entry: the AE pretrain + refinement loop runs
+    longer than the FAST_EXAMPLES budget (still self-checking —
+    convergence criterion + accuracy floor + no-degradation)."""
+    _run_example("dec_clustering.py", tmp_path, timeout=900,
+                 expect="dec_clustering OK")
+
+
+def _run_example(script, tmp_path, timeout=300, extra_args=(),
+                 expect=None):
+    env = dict(os.environ, PYTHONPATH=_REPO, JAX_PLATFORMS="cpu")
+    args = [sys.executable, os.path.join(_REPO, "examples", script)]
+    args += list(extra_args)
+    out = subprocess.run(args, capture_output=True, text=True,
+                         timeout=timeout, env=env, cwd=str(tmp_path))
+    assert out.returncode == 0, (out.stdout[-500:], out.stderr[-800:])
+    if expect is not None:
+        assert expect in out.stdout
+    return out
 
 
 @pytest.mark.parametrize("script", FAST_EXAMPLES)
 def test_example_runs(script, tmp_path):
-    env = dict(os.environ, PYTHONPATH=_REPO, JAX_PLATFORMS="cpu")
-    args = [sys.executable, os.path.join(_REPO, "examples", script)]
-    if script == "profile_model.py":
-        args.append(str(tmp_path / "trace.json"))
-    out = subprocess.run(args, capture_output=True, text=True,
-                         timeout=300, env=env, cwd=str(tmp_path))
-    assert out.returncode == 0, (out.stdout[-500:], out.stderr[-800:])
+    extra = [str(tmp_path / "trace.json")] \
+        if script == "profile_model.py" else []
+    _run_example(script, tmp_path, extra_args=extra)

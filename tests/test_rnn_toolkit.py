@@ -263,6 +263,75 @@ class TestPTBShapedTraining:
             perps.append(metric.get()[1])
         assert perps[-1] < perps[0] / 2, perps
 
+    def test_buckets_share_one_parameter_set(self):
+        """Round-5 regression (caught by the speech example's padding-
+        invariance check): bucket executors must adopt the default
+        bucket's param/aux arrays BY REFERENCE — without it every
+        bucket trains its own silently diverging parameter copy
+        (reference executor_group.py:_bind_ith_exec shared_exec arg
+        sharing)."""
+        vocab = 16
+
+        def sym_gen(seq_len):
+            data = mx.sym.Variable("data")
+            label = mx.sym.Variable("softmax_label")
+            embed = mx.sym.Embedding(data, input_dim=vocab,
+                                     output_dim=8, name="embed")
+            cell = mx.rnn.LSTMCell(16, prefix="lstm_")
+            outputs, _ = cell.unroll(seq_len, embed, layout="NTC",
+                                     merge_outputs=True)
+            pred = mx.sym.Reshape(outputs, shape=(-1, 16))
+            # BatchNorm: its moving stats are AUX state — included so
+            # the aux-sharing branch is exercised too
+            pred = mx.sym.BatchNorm(pred, name="bn", fix_gamma=False)
+            pred = mx.sym.FullyConnected(pred, num_hidden=vocab,
+                                         name="pred")
+            label = mx.sym.Reshape(label, shape=(-1,))
+            return (mx.sym.SoftmaxOutput(pred, label, name="softmax"),
+                    ("data",), ("softmax_label",))
+
+        B = 4
+        mod = mx.mod.BucketingModule(sym_gen, default_bucket_key=6)
+        mod.bind(data_shapes=[("data", (B, 6))],
+                 label_shapes=[("softmax_label", (B, 6))])
+        mod.init_params(mx.init.Xavier())
+        mod.init_optimizer(optimizer="sgd",
+                           optimizer_params=(("learning_rate", 0.5),))
+
+        def batch_for(T):
+            x = np.arange(B * T, dtype=np.float32).reshape(B, T) % vocab
+            return mx.io.DataBatch(
+                data=[mx.nd.array(x)], label=[mx.nd.array(x)],
+                bucket_key=T,
+                provide_data=[("data", (B, T))],
+                provide_label=[("softmax_label", (B, T))])
+
+        # bind bucket 4 lazily, then train ONLY through bucket 6
+        mod.forward(batch_for(4), is_train=False)
+        for _ in range(3):
+            mod.forward_backward(batch_for(6))
+            mod.update()
+
+        # the crisp assertion: every param/aux/grad NDArray is the
+        # SAME object in both bucket executors
+        e6 = mod._buckets[6]._exec_group.execs[0]
+        e4 = mod._buckets[4]._exec_group.execs[0]
+        for name in ("embed_weight", "lstm_i2h_weight", "pred_weight",
+                     "pred_bias", "bn_gamma"):
+            assert e6.arg_dict[name] is e4.arg_dict[name], name
+            assert e6.grad_dict[name] is e4.grad_dict[name], name
+        for name in ("bn_moving_mean", "bn_moving_var"):
+            assert e6.aux_dict[name] is e4.aux_dict[name], name
+        # and behaviorally: bucket 4 sees bucket 6's training,
+        # including the BN moving stats it never ran itself
+        w6 = mod._buckets[6].get_params()[0]["pred_weight"].asnumpy()
+        w4 = mod._buckets[4].get_params()[0]["pred_weight"].asnumpy()
+        np.testing.assert_array_equal(w6, w4)
+        m6 = mod._buckets[6].get_params()[1]["bn_moving_mean"].asnumpy()
+        m4 = mod._buckets[4].get_params()[1]["bn_moving_mean"].asnumpy()
+        np.testing.assert_array_equal(m6, m4)
+        assert np.abs(m6).max() > 0  # training actually moved them
+
 
 class TestRNNCheckpoint:
     def test_fused_unfused_checkpoint_interop(self, tmp_path):
