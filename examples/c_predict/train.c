@@ -1,0 +1,100 @@
+/* C host for the MXTpuTrain* training ABI: loads an exported
+ * compiled-train-step artifact (TrainStep.export), feeds one batch,
+ * runs N optimizer steps, then prints the last step's first output
+ * and a named trained parameter — no Python source in this program.
+ *
+ *   train <model_prefix> <data.f32> <data_size> <label.f32>
+ *         <label_size> <n_steps> <lr> <param_name>
+ *
+ * Reference parity: the training half of include/mxnet/c_api.h —
+ * redesigned as ONE entry over the compiled step program instead of
+ * 146 per-op calls (decision memo: docs/c_abi.md). */
+#include <stdint.h>
+#include <stdio.h>
+#include <stdlib.h>
+
+extern void* MXTpuTrainCreate(const char* prefix);
+extern int MXTpuTrainSetBatch(void* h, const char* key,
+                              const float* data, uint64_t size);
+extern int MXTpuTrainStep(void* h, float lr);
+extern int MXTpuTrainGetOutputShape(void* h, uint32_t index,
+                                    uint32_t* shape, uint32_t* ndim);
+extern int MXTpuTrainGetOutput(void* h, uint32_t index, float* data,
+                               uint64_t size);
+extern int MXTpuTrainGetParamShape(void* h, const char* name,
+                                   uint32_t* shape, uint32_t* ndim);
+extern int MXTpuTrainGetParam(void* h, const char* name, float* data,
+                              uint64_t size);
+extern void MXTpuTrainFree(void* h);
+extern const char* MXTpuGetLastError(void);
+
+static float* read_f32(const char* path, uint64_t n) {
+  FILE* f = fopen(path, "rb");
+  if (!f) return NULL;
+  float* buf = (float*)malloc(n * sizeof(float));
+  size_t got = fread(buf, sizeof(float), n, f);
+  fclose(f);
+  if (got != n) { free(buf); return NULL; }
+  return buf;
+}
+
+static void die(const char* what) {
+  fprintf(stderr, "%s: %s\n", what, MXTpuGetLastError());
+  exit(1);
+}
+
+int main(int argc, char** argv) {
+  if (argc != 9) {
+    fprintf(stderr,
+            "usage: %s prefix data.f32 dsize label.f32 lsize "
+            "n_steps lr param_name\n", argv[0]);
+    return 2;
+  }
+  uint64_t dsize = strtoull(argv[3], NULL, 10);
+  uint64_t lsize = strtoull(argv[5], NULL, 10);
+  int n_steps = atoi(argv[6]);
+  float lr = (float)atof(argv[7]);
+  float* data = read_f32(argv[2], dsize);
+  float* label = read_f32(argv[4], lsize);
+  if (!data || !label) { fprintf(stderr, "bad input files\n"); return 2; }
+
+  void* h = MXTpuTrainCreate(argv[1]);
+  if (!h) die("create");
+  if (MXTpuTrainSetBatch(h, "data", data, dsize) != 0) die("set data");
+  if (MXTpuTrainSetBatch(h, "softmax_label", label, lsize) != 0)
+    die("set label");
+
+  for (int i = 0; i < n_steps; ++i)
+    if (MXTpuTrainStep(h, lr) != 0) die("step");
+
+  uint32_t shape[8], ndim = 8;
+  if (MXTpuTrainGetOutputShape(h, 0, shape, &ndim) != 0) die("oshape");
+  uint64_t osize = 1;
+  printf("output 0 shape");
+  for (uint32_t i = 0; i < ndim; ++i) {
+    printf(" %u", shape[i]);
+    osize *= shape[i];
+  }
+  printf("\n");
+  float* out = (float*)malloc(osize * sizeof(float));
+  if (MXTpuTrainGetOutput(h, 0, out, osize) != 0) die("output");
+  for (uint64_t i = 0; i < osize; ++i) printf("%.6e\n", out[i]);
+
+  ndim = 8;
+  if (MXTpuTrainGetParamShape(h, argv[8], shape, &ndim) != 0)
+    die("pshape");
+  uint64_t psize = 1;
+  printf("param %s shape", argv[8]);
+  for (uint32_t i = 0; i < ndim; ++i) {
+    printf(" %u", shape[i]);
+    psize *= shape[i];
+  }
+  printf("\n");
+  float* pw = (float*)malloc(psize * sizeof(float));
+  if (MXTpuTrainGetParam(h, argv[8], pw, psize) != 0) die("param");
+  for (uint64_t i = 0; i < psize; ++i) printf("%.6e\n", pw[i]);
+
+  MXTpuTrainFree(h);
+  free(out); free(pw); free(data); free(label);
+  return 0;
+}

@@ -22,6 +22,16 @@
 //   MXTpuPredFree(h)
 //   MXTpuGetLastError()                     -> const char*
 //
+// Training ABI (round 5; artifact from TrainStep.export — the whole
+// forward+backward+optimizer step as one compiled program):
+//   MXTpuTrainCreate(prefix)                -> handle | NULL
+//   MXTpuTrainSetBatch(h, key, data, size)  -> 0 | -1
+//   MXTpuTrainStep(h, lr)                   -> 0 | -1  (one update)
+//   MXTpuTrainGetOutputShape/GetOutput      last step's loss heads
+//   MXTpuTrainGetParamShape/GetParam        trained weights by name
+//   MXTpuTrainSaveState(h, prefix)          -> 0 | -1
+//   MXTpuTrainFree(h)
+//
 // Build: _native.build_predict_shim() (g++ + sysconfig flags); the
 // Python side is optional — this file has no Python-package build-time
 // dependency beyond Python.h.
@@ -121,6 +131,82 @@ def _output(h, i):
     if h["outputs"] is None:
         raise RuntimeError("run forward first")
     return h["outputs"][int(i)]
+
+# ---- training surface (MXTpuTrain*): drives CompiledTrainStep, the
+# exported whole-train-step StableHLO program (forward + backward +
+# optimizer baked in). Same deployment discipline as predict: an
+# mxtpu_train_min.py next to the model wins (no framework source),
+# else the installed framework class.
+def _load_trainstep(prefix):
+    import hashlib, importlib.util, os, sys
+    d = os.path.dirname(os.path.abspath(prefix))
+    cand = os.path.join(d, "mxtpu_train_min.py")
+    if os.path.exists(cand):
+        name = "mxtpu_train_min_" + hashlib.md5(
+            d.encode()).hexdigest()[:10]
+        mod = sys.modules.get(name)
+        if mod is None:
+            spec = importlib.util.spec_from_file_location(name, cand)
+            mod = importlib.util.module_from_spec(spec)
+            sys.modules[name] = mod
+            spec.loader.exec_module(mod)
+        return mod.CompiledTrainStep.load(prefix)
+    from mxnet_tpu.parallel.trainer import CompiledTrainStep
+    return CompiledTrainStep.load(prefix)
+
+def _train_create(prefix):
+    t = _load_trainstep(prefix)
+    return {"t": t, "batch": {}, "outputs": None, "meta": t._meta}
+
+def _train_set_batch(h, key, buf):
+    meta = h["meta"]
+    if key not in meta["batch_shapes"]:
+        raise KeyError("unknown batch input %r; exported inputs: %s"
+                       % (key, sorted(meta["batch_shapes"])))
+    shape = meta["batch_shapes"][key]
+    arr = np.frombuffer(buf, dtype=np.float32)
+    need = int(np.prod(shape)) if shape else 1
+    if arr.size != need:
+        raise ValueError("input %r: got %d floats, shape %s needs %d"
+                         % (key, arr.size, shape, need))
+    h["batch"][key] = arr.reshape(shape).copy()
+
+def _train_step(h, lr):
+    missing = [n for n in h["meta"]["batch_names"]
+               if n not in h["batch"]]
+    if missing:
+        raise ValueError("batch inputs not set: %s" % missing)
+    outs = h["t"].step(h["batch"], float(lr))
+    h["outputs"] = [np.asarray(o, dtype=np.float32) for o in outs]
+
+def _train_output(h, i):
+    if h["outputs"] is None:
+        raise RuntimeError("run a step first")
+    return h["outputs"][int(i)]
+
+def _train_param_shape(h, name):
+    # shape without materializing/casting the array (a large embedding
+    # would otherwise be copied just to learn its dimensions); the
+    # zero-strided broadcast view only carries .shape for the C side
+    return np.broadcast_to(np.float32(0), h["t"].get_param_shape(name))
+
+def _train_param(h, name):
+    # float32 conversions cached per training step: the shape+data
+    # call pattern must not copy every parameter twice
+    cached = h.get("param_cache")
+    if cached is None or cached[0] != h["t"]._step_count:
+        cached = (h["t"]._step_count, {})
+        h["param_cache"] = cached
+    if name not in cached[1]:
+        params = h["t"].get_params()
+        if name not in params:
+            raise KeyError("unknown param %r; params: %s"
+                           % (name, sorted(params)))
+        cached[1][name] = np.asarray(params[name], dtype=np.float32)
+    return cached[1][name]
+
+def _train_save(h, prefix):
+    h["t"].save_state(prefix)
 )PY";
 
 PyObject* g_ns = nullptr;  // glue namespace dict
@@ -157,6 +243,48 @@ PyObject* glue_call(const char* fn, PyObject* args) {
   PyObject* out = PyObject_CallObject(f, args);
   if (!out) set_error_from_python();
   return out;
+}
+
+// Copy a numpy array's shape / float32 payload out to C buffers.
+// Caller holds the GIL and owns `arr`.
+int arr_shape_out(PyObject* arr, uint32_t* shape, uint32_t* ndim) {
+  PyObject* shp = PyObject_GetAttrString(arr, "shape");
+  if (!shp) { set_error_from_python(); return -1; }
+  int rc = -1;
+  Py_ssize_t n = PyTuple_Size(shp);
+  if (*ndim < n) {
+    set_error("shape buffer too small");
+  } else {
+    for (Py_ssize_t i = 0; i < n; ++i)
+      shape[i] = static_cast<uint32_t>(
+          PyLong_AsLong(PyTuple_GetItem(shp, i)));
+    *ndim = static_cast<uint32_t>(n);
+    rc = 0;
+  }
+  Py_DECREF(shp);
+  return rc;
+}
+
+int arr_copy_out(PyObject* arr, float* data, uint64_t size) {
+  PyObject* bytes = PyObject_CallMethod(arr, "tobytes", nullptr);
+  if (!bytes) { set_error_from_python(); return -1; }
+  int rc = -1;
+  char* raw = nullptr;
+  Py_ssize_t len = 0;
+  if (PyBytes_AsStringAndSize(bytes, &raw, &len) == 0) {
+    if (static_cast<uint64_t>(len) != size * sizeof(float)) {
+      set_error("output size mismatch: have " + std::to_string(len) +
+                " bytes, caller buffer holds " +
+                std::to_string(size * sizeof(float)));
+    } else {
+      std::memcpy(data, raw, len);
+      rc = 0;
+    }
+  } else {
+    set_error_from_python();
+  }
+  Py_DECREF(bytes);
+  return rc;
 }
 
 }  // namespace
@@ -216,26 +344,8 @@ int MXTpuPredGetOutputShape(void* handle, uint32_t index,
                                  index);
   PyObject* arr = glue_call("_output", args);
   Py_DECREF(args);
-  int rc = -1;
-  if (arr) {
-    PyObject* shp = PyObject_GetAttrString(arr, "shape");
-    if (shp) {
-      Py_ssize_t n = PyTuple_Size(shp);
-      if (*ndim < n) {
-        set_error("shape buffer too small");
-      } else {
-        for (Py_ssize_t i = 0; i < n; ++i)
-          shape[i] = static_cast<uint32_t>(
-              PyLong_AsLong(PyTuple_GetItem(shp, i)));
-        *ndim = static_cast<uint32_t>(n);
-        rc = 0;
-      }
-      Py_DECREF(shp);
-    } else {
-      set_error_from_python();
-    }
-    Py_DECREF(arr);
-  }
+  int rc = arr ? arr_shape_out(arr, shape, ndim) : -1;
+  Py_XDECREF(arr);
   PyGILState_Release(st);
   return rc;
 }
@@ -248,35 +358,136 @@ int MXTpuPredGetOutput(void* handle, uint32_t index, float* data,
                                  index);
   PyObject* arr = glue_call("_output", args);
   Py_DECREF(args);
-  int rc = -1;
-  if (arr) {
-    PyObject* bytes = PyObject_CallMethod(arr, "tobytes", nullptr);
-    if (bytes) {
-      char* raw = nullptr;
-      Py_ssize_t len = 0;
-      if (PyBytes_AsStringAndSize(bytes, &raw, &len) == 0) {
-        if (static_cast<uint64_t>(len) != size * sizeof(float)) {
-          set_error("output size mismatch: have " + std::to_string(len) +
-                    " bytes, caller buffer holds " +
-                    std::to_string(size * sizeof(float)));
-        } else {
-          std::memcpy(data, raw, len);
-          rc = 0;
-        }
-      } else {
-        set_error_from_python();
-      }
-      Py_DECREF(bytes);
-    } else {
-      set_error_from_python();
-    }
-    Py_DECREF(arr);
-  }
+  int rc = arr ? arr_copy_out(arr, data, size) : -1;
+  Py_XDECREF(arr);
   PyGILState_Release(st);
   return rc;
 }
 
 void MXTpuPredFree(void* handle) {
+  if (!handle) return;
+  PyGILState_STATE st = PyGILState_Ensure();
+  Py_DECREF(static_cast<PyObject*>(handle));
+  PyGILState_Release(st);
+}
+
+// ---- training ABI: one compiled-train-step artifact, driven from C.
+// The step program (forward+backward+optimizer) and state layout come
+// from TrainStep.export; see docs/c_abi.md for why the C training
+// boundary is the compiled program rather than the reference's 146
+// per-op entry points (include/mxnet/c_api.h).
+
+void* MXTpuTrainCreate(const char* model_prefix) {
+  if (!ensure_python()) return nullptr;
+  PyGILState_STATE st = PyGILState_Ensure();
+  PyObject* args = Py_BuildValue("(s)", model_prefix);
+  PyObject* h = glue_call("_train_create", args);
+  Py_DECREF(args);
+  PyGILState_Release(st);
+  return h;
+}
+
+int MXTpuTrainSetBatch(void* handle, const char* key, const float* data,
+                       uint64_t size) {
+  if (!handle) { set_error("null handle"); return -1; }
+  PyGILState_STATE st = PyGILState_Ensure();
+  PyObject* buf = PyBytes_FromStringAndSize(
+      reinterpret_cast<const char*>(data),
+      static_cast<Py_ssize_t>(size * sizeof(float)));
+  PyObject* args = Py_BuildValue("(OsO)", static_cast<PyObject*>(handle),
+                                 key, buf);
+  Py_DECREF(buf);
+  PyObject* r = glue_call("_train_set_batch", args);
+  Py_DECREF(args);
+  int rc = r ? 0 : -1;
+  Py_XDECREF(r);
+  PyGILState_Release(st);
+  return rc;
+}
+
+int MXTpuTrainStep(void* handle, float lr) {
+  if (!handle) { set_error("null handle"); return -1; }
+  PyGILState_STATE st = PyGILState_Ensure();
+  PyObject* args = Py_BuildValue("(Of)", static_cast<PyObject*>(handle),
+                                 lr);
+  PyObject* r = glue_call("_train_step", args);
+  Py_DECREF(args);
+  int rc = r ? 0 : -1;
+  Py_XDECREF(r);
+  PyGILState_Release(st);
+  return rc;
+}
+
+int MXTpuTrainGetOutputShape(void* handle, uint32_t index,
+                             uint32_t* shape, uint32_t* ndim) {
+  if (!handle) { set_error("null handle"); return -1; }
+  PyGILState_STATE st = PyGILState_Ensure();
+  PyObject* args = Py_BuildValue("(OI)", static_cast<PyObject*>(handle),
+                                 index);
+  PyObject* arr = glue_call("_train_output", args);
+  Py_DECREF(args);
+  int rc = arr ? arr_shape_out(arr, shape, ndim) : -1;
+  Py_XDECREF(arr);
+  PyGILState_Release(st);
+  return rc;
+}
+
+int MXTpuTrainGetOutput(void* handle, uint32_t index, float* data,
+                        uint64_t size) {
+  if (!handle) { set_error("null handle"); return -1; }
+  PyGILState_STATE st = PyGILState_Ensure();
+  PyObject* args = Py_BuildValue("(OI)", static_cast<PyObject*>(handle),
+                                 index);
+  PyObject* arr = glue_call("_train_output", args);
+  Py_DECREF(args);
+  int rc = arr ? arr_copy_out(arr, data, size) : -1;
+  Py_XDECREF(arr);
+  PyGILState_Release(st);
+  return rc;
+}
+
+int MXTpuTrainGetParamShape(void* handle, const char* name,
+                            uint32_t* shape, uint32_t* ndim) {
+  if (!handle) { set_error("null handle"); return -1; }
+  PyGILState_STATE st = PyGILState_Ensure();
+  PyObject* args = Py_BuildValue("(Os)", static_cast<PyObject*>(handle),
+                                 name);
+  PyObject* arr = glue_call("_train_param_shape", args);
+  Py_DECREF(args);
+  int rc = arr ? arr_shape_out(arr, shape, ndim) : -1;
+  Py_XDECREF(arr);
+  PyGILState_Release(st);
+  return rc;
+}
+
+int MXTpuTrainGetParam(void* handle, const char* name, float* data,
+                       uint64_t size) {
+  if (!handle) { set_error("null handle"); return -1; }
+  PyGILState_STATE st = PyGILState_Ensure();
+  PyObject* args = Py_BuildValue("(Os)", static_cast<PyObject*>(handle),
+                                 name);
+  PyObject* arr = glue_call("_train_param", args);
+  Py_DECREF(args);
+  int rc = arr ? arr_copy_out(arr, data, size) : -1;
+  Py_XDECREF(arr);
+  PyGILState_Release(st);
+  return rc;
+}
+
+int MXTpuTrainSaveState(void* handle, const char* prefix) {
+  if (!handle) { set_error("null handle"); return -1; }
+  PyGILState_STATE st = PyGILState_Ensure();
+  PyObject* args = Py_BuildValue("(Os)", static_cast<PyObject*>(handle),
+                                 prefix);
+  PyObject* r = glue_call("_train_save", args);
+  Py_DECREF(args);
+  int rc = r ? 0 : -1;
+  Py_XDECREF(r);
+  PyGILState_Release(st);
+  return rc;
+}
+
+void MXTpuTrainFree(void* handle) {
   if (!handle) return;
   PyGILState_STATE st = PyGILState_Ensure();
   Py_DECREF(static_cast<PyObject*>(handle));

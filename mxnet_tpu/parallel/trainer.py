@@ -535,6 +535,192 @@ class TrainStep:
             ca = ca[0] if ca else {}
         return dict(ca or {})
 
+    # -- AOT training export -------------------------------------------------
+    def export(self, prefix, state, batch):
+        """Serialize the WHOLE training step (forward + backward +
+        optimizer update) as a portable StableHLO artifact, plus the
+        current state and a flat-calling-convention manifest:
+
+            prefix.train.stablehlo   the exported step program
+            prefix.train.meta.json   flat layout: state/batch/output
+                                     names, shapes, dtypes
+            prefix.state.npz         initial state values (flat order)
+
+        Reload with :class:`CompiledTrainStep` (no symbol/source
+        needed) or drive from C via the MXTpuTrain* ABI
+        (_native/predict_shim.cc) — the TPU-native answer to the
+        reference's 146-entry C training API (include/mxnet/c_api.h):
+        where the reference exposed per-op graph construction to
+        foreign hosts, here the natural C boundary is the COMPILED
+        program; see docs/c_abi.md for the decision memo.
+
+        The exported program is a pure function
+            (seed, lr, *state_flat, *batch_flat) -> (*state_flat', *outs)
+        so a host loops: feed batch, call, carry the returned state.
+        Flat order: params (sorted), optimizer slots (per param,
+        sorted), aux (sorted) — recorded in the manifest."""
+        from jax import export as jexport
+
+        params, opt_state, aux = state
+        pn = sorted(params)
+        an = sorted(aux)
+        n_slots = self._n_state
+        batch_names = list(self.data_names) + [
+            k for k in sorted(batch) if k not in self.data_names]
+
+        def pack(params, opt_state, aux):
+            flat = [params[n] for n in pn]
+            for n in pn:
+                flat.extend(opt_state[n])
+            flat.extend(aux[n] for n in an)
+            return flat
+
+        def unpack(flat):
+            i = len(pn)
+            params = dict(zip(pn, flat[:i]))
+            opt_state = {}
+            for n in pn:
+                opt_state[n] = tuple(flat[i:i + n_slots])
+                i += n_slots
+            aux = dict(zip(an, flat[i:i + len(an)]))
+            return params, opt_state, aux
+
+        raw_step = self._build_step()
+
+        def flat_step(seed, lr, *arrs):
+            n_state_leaves = len(pn) * (1 + n_slots) + len(an)
+            p, o, a = unpack(list(arrs[:n_state_leaves]))
+            b = dict(zip(batch_names, arrs[n_state_leaves:]))
+            rng = jax.random.PRNGKey(seed)
+            (np_, no_, na_), outs = raw_step(p, o, a, b, lr, rng)
+            return tuple(pack(np_, no_, na_)) + tuple(outs)
+
+        state_flat = [np.asarray(x) for x in
+                      jax.device_get(pack(params, opt_state, aux))]
+        batch_vals = [np.asarray(jax.device_get(batch[n]))
+                      for n in batch_names]
+        structs = [jax.ShapeDtypeStruct((), np.uint32),
+                   jax.ShapeDtypeStruct((), np.float32)]
+        structs += [jax.ShapeDtypeStruct(a.shape, a.dtype)
+                    for a in state_flat]
+        structs += [jax.ShapeDtypeStruct(a.shape, a.dtype)
+                    for a in batch_vals]
+        blob = jexport.export(jax.jit(flat_step))(*structs).serialize()
+        with open(prefix + ".train.stablehlo", "wb") as f:
+            f.write(blob)
+
+        import json as _json
+        n_outputs = len(self.symbol.list_outputs())
+        meta = {
+            "param_names": pn,
+            "n_opt_slots": n_slots,
+            "aux_names": an,
+            "batch_names": batch_names,
+            "batch_shapes": {n: list(np.shape(v)) for n, v in
+                             zip(batch_names, batch_vals)},
+            "batch_dtypes": {n: str(v.dtype) for n, v in
+                             zip(batch_names, batch_vals)},
+            "n_state_leaves": len(state_flat),
+            "n_outputs": n_outputs,
+            "output_names": self.symbol.list_outputs(),
+        }
+        with open(prefix + ".train.meta.json", "w") as f:
+            _json.dump(meta, f)
+        np.savez(prefix + ".state.npz", step_count=np.int64(0),
+                 **{"s%05d" % i: a for i, a in enumerate(state_flat)})
+        return prefix + ".train.stablehlo"
+
+
+class CompiledTrainStep:
+    """Runs an exported training-step artifact — training with no
+    framework source, symbol JSON, or optimizer code at run time (all
+    of it is baked into the StableHLO program). The C ABI's MXTpuTrain*
+    entries drive exactly this class through the embedded interpreter.
+
+    State lives host-side as the flat array list and is carried
+    between calls; step() feeds a batch, runs one compiled update, and
+    swaps in the new state."""
+
+    def __init__(self, exported, meta, state_flat, step_count=0):
+        self._exported = exported
+        self._meta = meta
+        self._state = list(state_flat)
+        self._step_count = int(step_count)
+
+    @classmethod
+    def load(cls, prefix):
+        import json as _json
+        from jax import export as jexport
+        with open(prefix + ".train.stablehlo", "rb") as f:
+            exported = jexport.deserialize(f.read())
+        with open(prefix + ".train.meta.json") as f:
+            meta = _json.load(f)
+        with np.load(prefix + ".state.npz") as blob:
+            state = [blob["s%05d" % i]
+                     for i in range(meta["n_state_leaves"])]
+            # step_count persists so a resumed run CONTINUES the
+            # default-seed sequence instead of replaying masks from 0
+            count = int(blob["step_count"]) \
+                if "step_count" in blob.files else 0
+        return cls(exported, meta, state, step_count=count)
+
+    @property
+    def batch_names(self):
+        return list(self._meta["batch_names"])
+
+    @property
+    def batch_shapes(self):
+        return {n: tuple(s) for n, s in
+                self._meta["batch_shapes"].items()}
+
+    def step(self, batch, lr, seed=None):
+        """One compiled train step. batch: dict name -> array matching
+        the exported shapes. Returns the step's outputs (loss heads).
+        seed defaults to the running step count (fresh dropout noise
+        per step, reproducible across runs)."""
+        missing = [n for n in self._meta["batch_names"]
+                   if n not in batch]
+        if missing:
+            raise ValueError("batch missing inputs: %s" % missing)
+        feed = []
+        for n in self._meta["batch_names"]:
+            a = np.asarray(batch[n],
+                           dtype=self._meta["batch_dtypes"][n])
+            want = tuple(self._meta["batch_shapes"][n])
+            if a.shape != want:
+                raise ValueError("input %r: shape %s, exported %s"
+                                 % (n, a.shape, want))
+            feed.append(a)
+        if seed is None:
+            seed = self._step_count
+        res = self._exported.call(
+            np.uint32(seed), np.float32(lr), *self._state, *feed)
+        n = self._meta["n_state_leaves"]
+        self._state = [np.asarray(x) for x in res[:n]]
+        self._step_count += 1
+        return [np.asarray(x) for x in res[n:]]
+
+    def get_params(self):
+        """Current parameter dict (e.g. to hand to a Predictor export
+        after compiled fine-tuning)."""
+        pn = self._meta["param_names"]
+        return dict(zip(pn, self._state[:len(pn)]))
+
+    def get_param_shape(self, name):
+        """Shape of a parameter without materializing a copy."""
+        pn = self._meta["param_names"]
+        if name not in pn:
+            raise KeyError("unknown param %r; params: %s"
+                           % (name, sorted(pn)))
+        return tuple(self._state[pn.index(name)].shape)
+
+    def save_state(self, prefix):
+        np.savez(prefix + ".state.npz",
+                 step_count=np.int64(self._step_count),
+                 **{"s%05d" % i: np.asarray(a)
+                    for i, a in enumerate(self._state)})
+        return prefix + ".state.npz"
+
 
 def make_train_step(symbol, **kwargs):
     """Factory: TrainStep (see class docs)."""

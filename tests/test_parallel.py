@@ -409,3 +409,65 @@ def test_train_step_fit_loop_and_resume(tmp_path):
     state4, _ = step4.fit(train4, num_epoch=15, initializer=Xavier(),
                           lr=0.5, checkpoint_prefix=prefix)
     assert state4 is not None
+
+
+def test_train_step_export_compiled_roundtrip(tmp_path):
+    """TrainStep.export -> CompiledTrainStep (round 5, the AOT
+    training boundary behind the MXTpuTrain* C ABI): the exported
+    program must (a) train — loss drops over compiled steps with no
+    framework graph code involved, (b) expose trained params by name,
+    (c) round-trip its state through save_state, and (d) track the
+    in-process TrainStep trajectory exactly given the same seeds."""
+    import numpy as np
+
+    from mxnet_tpu.parallel.trainer import CompiledTrainStep
+
+    net = mx.sym.SoftmaxOutput(mx.sym.FullyConnected(mx.sym.Activation(
+        mx.sym.FullyConnected(mx.sym.Variable("data"), num_hidden=16,
+                              name="fc1"), act_type="relu"),
+        num_hidden=2, name="fc2"), name="softmax")
+    step = make_train_step(net, optimizer="adam",
+                           optimizer_params={"rescale_grad": 1.0 / 32})
+    state = step.init_state(Xavier(), {"data": (32, 8),
+                                       "softmax_label": (32,)})
+    rng = np.random.RandomState(0)
+    X = rng.standard_normal((32, 8)).astype(np.float32)
+    y = (X @ rng.standard_normal(8) > 0).astype(np.float32)
+    batch = step.place_batch({"data": X, "softmax_label": y})
+    prefix = str(tmp_path / "m")
+    step.export(prefix, state, batch)
+
+    def xent(outs):
+        p = np.asarray(outs[0])
+        return -np.log(p[np.arange(32), y.astype(int)] + 1e-9).mean()
+
+    ct = CompiledTrainStep.load(prefix)
+    assert ct.batch_names == ["data", "softmax_label"]
+    first = last = None
+    for i in range(40):
+        outs = ct.step({"data": X, "softmax_label": y}, lr=1e-2)
+        if i == 0:
+            first = xent(outs)
+    last = xent(outs)
+    assert last < first * 0.5, (first, last)
+
+    # (d) exact trajectory match vs the in-process step, same seeds
+    import jax
+    st = state
+    for i in range(40):
+        st, _ = step(st, batch, 1e-2, jax.random.PRNGKey(i))
+    want = np.asarray(jax.device_get(st[0]["fc1_weight"]))
+    got = ct.get_params()["fc1_weight"]
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+
+    # (c) state round-trip: reload continues from the trained state
+    ct.save_state(str(tmp_path / "m"))     # overwrites m.state.npz
+    ct2 = CompiledTrainStep.load(prefix)
+    np.testing.assert_allclose(ct2.get_params()["fc1_weight"], got)
+
+    # shape validation is loud
+    try:
+        ct.step({"data": X[:8], "softmax_label": y[:8]}, lr=1e-2)
+        raise AssertionError("expected shape error")
+    except ValueError as e:
+        assert "shape" in str(e)
