@@ -30,7 +30,9 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(
 import jax.numpy as jnp  # noqa: E402
 import numpy as np  # noqa: E402
 
-# (N, C, H, W) — ResNet-50 stage shapes at batch 128
+# (N, C, H, W) — ResNet-50 stage shapes at batch 128.
+# BENCH_BN_SMOKE=1 shrinks them for CPU CI (Pallas interpret mode runs
+# the grid in Python — full shapes would take minutes per call).
 SHAPES = [
     (128, 64, 112, 112),
     (128, 256, 56, 56),
@@ -38,6 +40,8 @@ SHAPES = [
     (128, 1024, 14, 14),
     (128, 2048, 7, 7),
 ]
+if os.environ.get("BENCH_BN_SMOKE") == "1":
+    SHAPES = [(4, 8, 6, 6), (2, 16, 4, 4)]
 ITERS = int(os.environ.get("BENCH_ITERS", "30"))
 
 
@@ -57,6 +61,12 @@ def framework_bn(x, gamma, beta, eps=1e-3):
     C = x.shape[1]
     return _batch_norm(x, gamma, beta, jnp.zeros(C), jnp.ones(C),
                        eps=eps, fix_gamma=False, is_train=True)[0]
+
+
+def pallas_bn(x, gamma, beta, eps=1e-3):
+    """The below-XLA explicit-pass kernels (ops/bn_pallas.py)."""
+    from mxnet_tpu.ops.bn_pallas import bn_train_pallas
+    return bn_train_pallas(x, gamma, beta, eps)[0]
 
 
 def timed(fn, shape):
@@ -93,13 +103,25 @@ def main():
     for shape in SHAPES:
         t_new = timed(framework_bn, shape)
         t_old = timed(naive_bn, shape)
+        try:
+            # the Pallas explicit-pass variant: a Mosaic rejection on
+            # some shape must not kill the XLA A/B numbers
+            t_pallas = timed(pallas_bn, shape)
+        except Exception as e:  # noqa: BLE001
+            print("pallas variant failed on %s: %s"
+                  % (shape, str(e)[:200]), file=sys.stderr)
+            t_pallas = None
         bytes_tensor = int(np.prod(shape)) * 2      # bf16
         print(json.dumps({
             "metric": "batchnorm_train_fwd_bwd",
             "shape": list(shape),
             "one_pass_ms": round(t_new * 1e3, 3),
             "two_pass_ms": round(t_old * 1e3, 3),
+            "pallas_ms": round(t_pallas * 1e3, 3)
+            if t_pallas else None,
             "speedup": round(t_old / t_new, 3),
+            "pallas_vs_one_pass": round(t_new / t_pallas, 3)
+            if t_pallas else None,
             "tensor_mb": round(bytes_tensor / 1e6, 1),
             "device_kind": dev}))
 

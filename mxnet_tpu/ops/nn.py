@@ -308,8 +308,18 @@ def _batch_norm(data, gamma, beta, moving_mean, moving_var, eps=1e-3,
         # fix_gamma: g is ones_like(gamma), so no gradient reaches
         # gamma through the core (ones_like is a constant), matching
         # the reference's zeroed fixed-gamma grad
-        out, mean, var = _bn_train_core(data, g, beta, float(eps), red,
-                                        bshape)
+        import os as _os
+        if _os.environ.get("MXNET_BN_PALLAS") == "1" and \
+                data.ndim == 4 and axis == 1:
+            # below-XLA experiment: explicit-pass Pallas kernels
+            # (ops/bn_pallas.py) — same math, guaranteed 2-read
+            # forward / 2-read backward structure
+            from .bn_pallas import bn_train_pallas
+            out, mean, var = bn_train_pallas(data, g, beta,
+                                             float(eps))
+        else:
+            out, mean, var = _bn_train_core(data, g, beta, float(eps),
+                                            red, bshape)
         new_mm = moving_mean * momentum + mean * (1 - momentum)
         new_mv = moving_var * momentum + var * (1 - momentum)
         use_mean, use_var = mean, var
