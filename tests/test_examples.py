@@ -27,6 +27,8 @@ FAST_EXAMPLES = [
     "vae.py",
     "neural_style.py",
     "stochastic_depth.py",
+    "sgld_bayes.py",
+    "dsd_pruning.py",
 ]
 
 
