@@ -473,11 +473,13 @@ def bench_decode(args):
         step = make_train_step(sym, optimizer="sgd")
         state = step.init_state(Xavier(), {
             "data": (B, max_len), "softmax_label": (B, max_len)})
+        qz = args.quantize or ""
         gen = Generator(state[0], V, max_len=max_len, num_layers=L,
                         num_heads=c["heads"], dim=D,
                         batch_size=B, num_kv_heads=kv_heads,
                         dtype=None if dtype == "float32" else dtype,
-                        quantize=args.quantize)
+                        quantize="int8" if "int8" in qz else None,
+                        quantize_kv="kv8" in qz)
         draft = None
         if spec:
             # draft = same vocab/batch, quarter the layers and half the
@@ -577,10 +579,13 @@ def main():
     p.add_argument("--window", type=int, default=None,
                    help="transformer_lm only: sliding-window attention "
                         "width (training bench)")
-    p.add_argument("--quantize", default=None, choices=["int8"],
-                   help="with --decode: weight-only int8 (halved "
-                        "weight HBM traffic on the bandwidth-bound "
-                        "decode path)")
+    p.add_argument("--quantize", default=None,
+                   choices=["int8", "kv8", "int8+kv8"],
+                   help="with --decode: int8 = weight-only int8 "
+                        "(halved weight HBM traffic), kv8 = int8 KV "
+                        "caches with per-token scales (halved cache "
+                        "traffic — the dominant stream at long "
+                        "prompts), int8+kv8 = both")
     p.add_argument("--beam", type=int, default=None,
                    help="with --decode: on-device beam search width "
                         "(beams fold into the batch; tokens/s counts "

@@ -51,12 +51,16 @@ class Generator:
                  num_heads=4, dim=128, ffn_hidden=None, batch_size=1,
                  dtype=None, num_experts=0, mesh=None, quantize=None,
                  pos_encoding="learned", attention_window=0,
-                 rolling_cache=False, num_kv_heads=None):
+                 rolling_cache=False, num_kv_heads=None,
+                 quantize_kv=False):
         from .parallel import sharding as shd
 
         if quantize not in (None, "int8"):
             raise ValueError("quantize must be None or 'int8', got %r"
                              % (quantize,))
+        if quantize_kv and rolling_cache:
+            raise ValueError("quantize_kv is not supported with "
+                             "rolling_cache")
         self.vocab_size = int(vocab_size)
         if self.vocab_size > 2 ** 24:
             # token ids ride the float32 "data" input convention;
@@ -82,7 +86,8 @@ class Generator:
             compute_dtype=str(dtype) if dtype else None,
             pos_encoding=pos_encoding,
             attention_window=attention_window,
-            rolling_cache=rolling_cache, num_kv_heads=num_kv_heads)
+            rolling_cache=rolling_cache, num_kv_heads=num_kv_heads,
+            kv_quantize=quantize_kv)
         if quantize:
             arg_params = _quantize_weights(
                 arg_params, sym.list_arguments())
@@ -119,8 +124,10 @@ class Generator:
                     kv_heads % mesh.shape["model"] == 0:
                 spec[1] = "model"
             self._cache_sharding = NamedSharding(mesh, P(*spec))
+            self._scale_sharding = NamedSharding(mesh, P(*spec[:3]))
         else:
             self._cache_sharding = None
+            self._scale_sharding = None
         missing = wanted - set(self._params) - {
             "data", "positions", "cache_pos"}
         if missing:
@@ -148,6 +155,10 @@ class Generator:
         self._cache_shape = (self.batch_size, kv_heads, self.max_len,
                              head_dim)
         self._cache_dtype = cache_dtype
+        # quantize_kv: k/v live int8 with per-token f32 scale caches —
+        # halves decode's dominant HBM stream (the cache is re-read
+        # every step; each weight only once)
+        self._quantize_kv = bool(quantize_kv)
 
     @staticmethod
     def _check_sampling(temperature, top_k, top_p):
@@ -192,9 +203,18 @@ class Generator:
     def _fresh_aux(self):
         aux = {}
         for name in self._sym.list_auxiliary_states():
-            z = jnp.zeros(self._cache_shape, self._cache_dtype)
-            if self._cache_sharding is not None:
-                z = jax.device_put(z, self._cache_sharding)
+            if name.endswith(("_k_scale", "_v_scale")):
+                # per-token dequant scales for the int8 caches
+                z = jnp.zeros(self._cache_shape[:3], jnp.float32)
+                shard = self._scale_sharding
+            elif self._quantize_kv:
+                z = jnp.zeros(self._cache_shape, jnp.int8)
+                shard = self._cache_sharding
+            else:
+                z = jnp.zeros(self._cache_shape, self._cache_dtype)
+                shard = self._cache_sharding
+            if shard is not None:
+                z = jax.device_put(z, shard)
             aux[name] = z
         return aux
 

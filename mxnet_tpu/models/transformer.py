@@ -198,12 +198,14 @@ def get_stage_symbol(num_heads=4, dim=128, ffn_hidden=None,
 def _decode_attention_block(x, num_heads, dim, prefix, max_len, pos,
                             quantized=False, rope_positions=None,
                             window=0, rolling=False,
-                            num_kv_heads=None):
+                            num_kv_heads=None, kv_quantize=False):
     """Incremental variant of _attention_block: identical qkv/proj
     helpers (a training checkpoint binds unchanged), attention routed
     through _contrib_CachedAttention with per-layer k/v cache aux
     states ("<prefix>attn_k_cache"/"_v_cache", created by the op's
-    state_inputs registration)."""
+    state_inputs registration). kv_quantize routes through the int8
+    variant (_contrib_CachedAttentionQ8), which adds per-token scale
+    aux states ("_k_scale"/"_v_scale")."""
     q, k, v = _qkv_heads(x, num_heads, dim, prefix, quantized,
                          num_kv_heads=num_kv_heads)
     if rope_positions is not None:
@@ -213,6 +215,10 @@ def _decode_attention_block(x, num_heads, dim, prefix, max_len, pos,
         k = sym.contrib.RoPE(k, rope_positions)
     if rolling:
         att = sym.contrib.RollingCachedAttention(
+            q, k, v, pos=pos, max_len=max_len, window=window,
+            name=prefix + "attn")
+    elif kv_quantize:
+        att = sym.contrib.CachedAttentionQ8(
             q, k, v, pos=pos, max_len=max_len, window=window,
             name=prefix + "attn")
     else:
@@ -227,7 +233,8 @@ def get_decode_symbol(vocab_size, max_len, num_layers=2, num_heads=4,
                       dim=128, ffn_hidden=None, num_experts=0,
                       quantized=False, compute_dtype=None,
                       pos_encoding="learned", attention_window=0,
-                      rolling_cache=False, num_kv_heads=None):
+                      rolling_cache=False, num_kv_heads=None,
+                      kv_quantize=False):
     """Autoregressive-decode twin of get_symbol.
 
     Inputs: data (B, Tnew) token ids for the tokens being appended
@@ -250,6 +257,10 @@ def get_decode_symbol(vocab_size, max_len, num_layers=2, num_heads=4,
     if rolling_cache and not attention_window:
         raise ValueError("rolling_cache needs attention_window > 0 "
                          "(the circular capacity covers one window)")
+    if kv_quantize and rolling_cache:
+        raise ValueError("kv_quantize is not supported with "
+                         "rolling_cache (no int8 variant of the "
+                         "circular-buffer op)")
     data = sym.Variable("data")
     positions = sym.Variable("positions")
     cache_pos = sym.Variable("cache_pos", shape=(1,))
@@ -284,7 +295,8 @@ def get_decode_symbol(vocab_size, max_len, num_layers=2, num_heads=4,
                                         quantized=quantized,
                                         rope_positions=rope_positions,
                                         window=attention_window,
-                                        rolling=rolling_cache)
+                                        rolling=rolling_cache,
+                                        kv_quantize=kv_quantize)
         f = sym.LayerNorm(x, name=prefix + "ln2")
         # inference never capacity-drops: every token is served, so
         # the factor is raised to E (cap == token count). Training-time

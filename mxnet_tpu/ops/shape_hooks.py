@@ -321,3 +321,32 @@ set_param_shapes("_contrib_QuantizedEmbedding", _quant_embedding_shapes)
 
 set_param_shapes("_contrib_RollingCachedAttention",
                  _cached_attention_shapes)
+
+
+def _cached_attention_q8_shapes(shapes, attrs):
+    """Int8 variant: slots 3/4 are the int8 caches, 5/6 the per-token
+    (B, Hkv, Tmax) scale caches, 7 the pos scalar. NOTE on dtypes:
+    infer_type's same-dtype propagation cannot express the int8/f32
+    aux split — Generator._fresh_aux (the supported allocator for this
+    op) creates them by suffix; Executor-bound users must supply aux
+    explicitly."""
+    q = shapes[0]
+    k = shapes[1] if len(shapes) > 1 else None
+    out = list(shapes)
+    tmax = int(attrs.get("max_len", 0))
+    if q is not None and tmax:
+        heads = k[1] if k is not None else q[1]
+        cache = (q[0], heads, tmax, q[3])
+        for i in (3, 4):
+            if len(out) > i and out[i] is None:
+                out[i] = cache
+        for i in (5, 6):
+            if len(out) > i and out[i] is None:
+                out[i] = cache[:3]
+    if len(out) > 7 and out[7] is None:
+        out[7] = (1,)
+    return out
+
+
+set_param_shapes("_contrib_CachedAttentionQ8",
+                 _cached_attention_q8_shapes)

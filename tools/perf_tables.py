@@ -124,7 +124,7 @@ def decode_table(recs):
         if r.get("kv_heads"):
             mode += " gqa-%d" % r["kv_heads"]
         if r.get("quantize"):
-            mode += " int8"
+            mode += " " + str(r["quantize"])
         notes = ""
         if r.get("spec_accepted_per_round") is not None:
             notes = "%.2f accepted/round" % r["spec_accepted_per_round"]

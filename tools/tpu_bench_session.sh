@@ -75,6 +75,13 @@ cap "$OUT/decode_gqa4.json" decode_gqa4 \
         --decode
 cap "$OUT/decode_spec4.json" decode_spec4 \
     python bench.py --network transformer_lm --decode --speculative 4
+# int8 KV caches matter most at long prompts (cache reads dominate)
+cap "$OUT/decode_kv8.json" decode_kv8 \
+    python bench.py --network transformer_lm --decode --quantize kv8 \
+        --seq-len 1024
+cap "$OUT/decode_int8kv8.json" decode_int8kv8 \
+    python bench.py --network transformer_lm --decode \
+        --quantize int8+kv8 --seq-len 1024
 
 echo "== 3c. long-context sweep (batch 1) =="
 LCTX="$OUT/longcontext.jsonl.new"; : > "$LCTX"
