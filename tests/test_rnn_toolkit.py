@@ -333,6 +333,38 @@ class TestPTBShapedTraining:
         assert np.abs(m6).max() > 0  # training actually moved them
 
 
+class TestTimeMajorLayout:
+    def test_tnc_unroll_matches_ntc(self):
+        """The reference's example/rnn-time-major seam: layout='TNC'
+        (time-major — the faster layout for cuDNN there, a free
+        transpose choice under XLA) must be numerically identical to
+        the default NTC unroll on transposed data."""
+        B, T, F, H = 3, 5, 4, 6
+        rng = np.random.RandomState(0)
+        x = rng.randn(B, T, F).astype(np.float32)
+
+        def run(layout, arr):
+            cell = mx.rnn.LSTMCell(H, prefix="tm_")
+            data = mx.sym.Variable("data")
+            out, _ = cell.unroll(T, data, layout=layout,
+                                 merge_outputs=True)
+            ex = out.simple_bind(data=arr.shape)
+            args = ex.arg_dict
+            prng = np.random.RandomState(1)
+            for name in sorted(args):
+                if name != "data":
+                    args[name][:] = mx.nd.array(prng.uniform(
+                        -0.2, 0.2, args[name].shape).astype(
+                        np.float32))
+            args["data"][:] = mx.nd.array(arr)
+            return ex.forward()[0].asnumpy()
+
+        out_ntc = run("NTC", x)                        # (B, T, H)
+        out_tnc = run("TNC", x.transpose(1, 0, 2))     # (T, B, H)
+        np.testing.assert_allclose(out_tnc.transpose(1, 0, 2),
+                                   out_ntc, rtol=1e-5, atol=1e-6)
+
+
 class TestRNNCheckpoint:
     def test_fused_unfused_checkpoint_interop(self, tmp_path):
         """save with the fused cell, load into the unfused stack — the
