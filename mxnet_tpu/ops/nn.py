@@ -15,6 +15,9 @@ TPU-native notes:
 """
 from __future__ import annotations
 
+import functools
+import os
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -178,6 +181,18 @@ def _pooling(data, kernel=(), pool_type="max", stride=(), pad=(),
     if pool_type == "max":
         init = -jnp.inf if jnp.issubdtype(data.dtype, jnp.floating) else \
             jnp.iinfo(data.dtype).min
+        if nd == 2 and jnp.issubdtype(data.dtype, jnp.floating) and \
+                os.environ.get("MXNET_POOL_DENSE_BWD", "1") == "1":
+            # custom backward: XLA differentiates reduce_window into
+            # SelectAndScatter — a serialized scatter that traces show
+            # among the top non-matmul costs of conv nets. The dense
+            # formulation below replaces it with kh*kw vectorized
+            # passes built on the x==y routing idea of the reference's
+            # mshadow backward (pooling-inl.h) — with ties SPLIT, not
+            # duplicated; see _max_pool2d_dense_bwd. Reverse-mode only
+            # (custom_vjp): jvp users set MXNET_POOL_DENSE_BWD=0.
+            return _max_pool2d_dense_bwd(data, kernel, stride,
+                                         padding[2:])
         return lax.reduce_window(data, init, lax.max, window, strides,
                                  padding)
     if pool_type == "avg":
@@ -190,6 +205,82 @@ def _pooling(data, kernel=(), pool_type="max", stride=(), pad=(),
         return lax.reduce_window(data, 0.0, lax.add, window, strides,
                                  padding)
     raise ValueError("unknown pool_type %r" % pool_type)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1, 2, 3))
+def _max_pool2d_dense_bwd(x, kernel, stride, pad2):
+    """2-D max pooling whose BACKWARD avoids SelectAndScatter.
+
+    Forward: the normal reduce_window max. Backward: for each kernel
+    offset (a, b), the strided slice of the (-inf padded) input that
+    fed the windows is compared against the pooled output; matches
+    route dy there via an interior-padded (dilated) dense pad — kh*kw
+    fully-vectorized passes instead of XLA's serialized scatter.
+
+    Subgradient choice: a window with TIED maxima SPLITS dy equally
+    among them (dy/count each) — magnitude-preserving, so tie-heavy
+    data (integer-grid pixels!) trains like the one-winner
+    SelectAndScatter; off ties the two are gradient-identical. The
+    reference's mshadow x==y routing gave every tie the FULL dy,
+    which measurably inflates gradients on quantized inputs (caught
+    by the real-digits convergence gate)."""
+    return _max_pool2d_fwd_impl(x, kernel, stride, pad2)
+
+
+def _max_pool2d_fwd_impl(x, kernel, stride, pad2):
+    window = (1, 1) + tuple(kernel)
+    strides = (1, 1) + tuple(stride)
+    padding = ((0, 0), (0, 0)) + tuple(pad2)
+    return lax.reduce_window(x, -jnp.inf, lax.max, window, strides,
+                             padding)
+
+
+def _max_pool2d_fwd(x, kernel, stride, pad2):
+    y = _max_pool2d_fwd_impl(x, kernel, stride, pad2)
+    return y, (x, y)
+
+
+def _max_pool2d_bwd(kernel, stride, pad2, res, dy):
+    x, y = res
+    (kh, kw), (sh, sw) = kernel, stride
+    (pt, pb), (pl, pr) = pad2
+    OH, OW = y.shape[2], y.shape[3]
+    xp = jnp.pad(x.astype(jnp.float32),
+                 ((0, 0), (0, 0), (pt, pb), (pl, pr)),
+                 constant_values=-jnp.inf)
+    yf = y.astype(jnp.float32)
+    HP, WP = xp.shape[2], xp.shape[3]
+
+    def window_views():
+        for a in range(kh):
+            for b in range(kw):
+                # windows' (a, b) elements, aligned with the output
+                yield a, b, lax.slice(
+                    xp, (0, 0, a, b),
+                    (xp.shape[0], xp.shape[1],
+                     a + sh * (OH - 1) + 1, b + sw * (OW - 1) + 1),
+                    (1, 1, sh, sw))
+
+    # pass 1: per-window tie count (== 1 off ties)
+    count = jnp.zeros_like(yf)
+    for _a, _b, x_ab in window_views():
+        count = count + (x_ab == yf).astype(jnp.float32)
+    share = dy.astype(jnp.float32) / count
+    # pass 2: route dy/count to every maximum — dilate by the stride
+    # and place at the offset: a pure pad, no scatter
+    dxp = jnp.zeros_like(xp)
+    for a, b, x_ab in window_views():
+        contrib = jnp.where(x_ab == yf, share, 0.0)
+        dxp = dxp + lax.pad(
+            contrib, jnp.float32(0),
+            ((0, 0, 0), (0, 0, 0),
+             (a, HP - a - (sh * (OH - 1) + 1), sh - 1),
+             (b, WP - b - (sw * (OW - 1) + 1), sw - 1)))
+    dx = dxp[:, :, pt:HP - pb, pl:WP - pr]
+    return (dx.astype(x.dtype),)
+
+
+_max_pool2d_dense_bwd.defvjp(_max_pool2d_fwd, _max_pool2d_bwd)
 
 
 # ---------------------------------------------------------------------------

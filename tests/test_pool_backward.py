@@ -1,0 +1,86 @@
+"""Max-pool dense backward (ops/nn.py:_max_pool2d_dense_bwd): the
+custom VJP that replaces XLA's SelectAndScatter with kh*kw vectorized
+passes must produce gradients IDENTICAL to the reduce_window autodiff
+on tie-free data, across strides/pads/ceil-mode, and its
+split-among-maxima tie semantics (a deliberate deviation from
+mshadow's full-dy-per-tie routing) must hold."""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from mxnet_tpu.ops.nn import _pooling
+
+
+def _grads(x, dy, env, monkeypatch, **attrs):
+    monkeypatch.setenv("MXNET_POOL_DENSE_BWD", env)
+
+    def loss(x_):
+        return jnp.sum(_pooling(x_, pool_type="max", **attrs)
+                       * jnp.asarray(dy))
+
+    return np.asarray(jax.grad(loss)(jnp.asarray(x)))
+
+
+@pytest.mark.parametrize("kernel,stride,pad,convention", [
+    ((2, 2), (2, 2), (0, 0), "valid"),
+    ((3, 3), (2, 2), (1, 1), "valid"),      # the ResNet stem shape
+    ((3, 3), (1, 1), (1, 1), "valid"),
+    ((3, 2), (2, 3), (1, 0), "valid"),      # asymmetric
+    ((3, 3), (2, 2), (0, 0), "full"),       # ceil mode: extra hi pad
+])
+def test_dense_bwd_matches_select_and_scatter(kernel, stride, pad,
+                                              convention,
+                                              monkeypatch):
+    rng = np.random.RandomState(0)
+    x = rng.randn(2, 3, 9, 9).astype(np.float32)   # ties measure-zero
+    attrs = dict(kernel=kernel, stride=stride, pad=pad,
+                 pooling_convention=convention)
+    y_dense = _pooling(jnp.asarray(x), pool_type="max", **attrs)
+    dy = rng.randn(*y_dense.shape).astype(np.float32)
+    g_dense = _grads(x, dy, "1", monkeypatch, **attrs)
+    g_xla = _grads(x, dy, "0", monkeypatch, **attrs)
+    np.testing.assert_allclose(g_dense, g_xla, rtol=1e-6, atol=1e-6)
+    # forwards agree too (same reduce_window under both gates)
+    monkeypatch.setenv("MXNET_POOL_DENSE_BWD", "0")
+    y_xla = _pooling(jnp.asarray(x), pool_type="max", **attrs)
+    np.testing.assert_array_equal(np.asarray(y_dense),
+                                  np.asarray(y_xla))
+
+
+def test_tie_semantics_split_among_maxima(monkeypatch):
+    """A tied window SPLITS dy equally among its maxima (dy/count
+    each) — magnitude-preserving on tie-heavy quantized inputs, where
+    mshadow's full-dy-to-every-tie routing inflates gradients (caught
+    by the real-digits convergence gate) and SelectAndScatter picks
+    one winner. Total gradient mass is conserved either way."""
+    monkeypatch.setenv("MXNET_POOL_DENSE_BWD", "1")
+    x = jnp.ones((1, 1, 2, 2), jnp.float32)
+
+    def loss(x_):
+        return jnp.sum(_pooling(x_, pool_type="max", kernel=(2, 2),
+                                stride=(2, 2), pad=(0, 0)))
+
+    dx = np.asarray(jax.grad(loss)(x))
+    np.testing.assert_allclose(dx, np.full((1, 1, 2, 2), 0.25))
+    # partial tie: two maxima share, non-maxima get nothing
+    x2 = jnp.asarray([[[[2.0, 2.0], [1.0, 0.0]]]], jnp.float32)
+    dx2 = np.asarray(jax.grad(loss)(x2))
+    np.testing.assert_allclose(dx2, [[[[0.5, 0.5], [0.0, 0.0]]]])
+
+
+def test_int_and_3d_fall_back(monkeypatch):
+    """The dense path covers float 2-D pooling; int dtypes and 3-D
+    keep the reduce_window route (forward-only parity check)."""
+    monkeypatch.setenv("MXNET_POOL_DENSE_BWD", "1")
+    xi = jnp.asarray(np.arange(16).reshape(1, 1, 4, 4), jnp.int32)
+    yi = _pooling(xi, pool_type="max", kernel=(2, 2), stride=(2, 2),
+                  pad=(0, 0))
+    np.testing.assert_array_equal(
+        np.asarray(yi), [[[[5, 7], [13, 15]]]])
+    x3 = jnp.asarray(np.random.RandomState(1).randn(1, 1, 4, 4, 4),
+                     jnp.float32)
+    y3 = _pooling(x3, pool_type="max", kernel=(2, 2, 2),
+                  stride=(2, 2, 2), pad=(0, 0, 0))
+    assert y3.shape == (1, 1, 2, 2, 2)

@@ -99,6 +99,9 @@ capa "$LCTX" lctx:32768w4096 env BENCH_ITERS=5 python bench.py \
 echo "== 3d0. BatchNorm one-pass vs two-pass microbench =="
 cap "$OUT/bn_micro.jsonl" bn_micro python benchmark/bench_bn.py
 
+echo "== 3d1. max-pool dense backward vs SelectAndScatter =="
+cap "$OUT/pool_micro.jsonl" pool_micro python benchmark/bench_pool.py
+
 echo "== 3d. input-pipeline train overlap (net img/s with real decode) =="
 cap "$OUT/pipeline_overlap.json" pipeline_overlap \
     python benchmark/bench_input_pipeline.py --train-overlap \
