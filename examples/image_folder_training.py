@@ -1,0 +1,141 @@
+"""Image-folder -> im2rec -> ImageRecordIter -> fit: the full data
+plane end to end — reference example/kaggle-ndsb1 (+ the
+image-classification README's data-prep recipe): class-per-directory
+images packed into RecordIO with tools/im2rec, streamed back through
+the augmenting record iterator, trained with Module.fit.
+
+This is the one seam no other example drives whole: PNG files on disk
+-> im2rec listing/packing (multi-threaded JPEG re-encode) -> .rec +
+.lst -> ImageRecordIter (native C++ batched decode when available,
+PIL fallback otherwise) with mean-subtraction + mirror augmentation ->
+fit -> accuracy gate.
+
+Synthetic dataset: 3 classes of 24x24 shape images (filled disc,
+cross, stripes) with noise/jitter — drawn with numpy, saved as real
+PNGs via PIL, classified from PIXELS after the full encode/decode
+round trip.
+
+Self-checking: train accuracy > 0.88 after a few epochs, and the
+im2rec artifacts are structurally sound (.lst row count, .rec
+readable by the plain RecordIO reader).
+
+Run: python examples/image_folder_training.py
+"""
+import os
+import shutil
+import subprocess
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import recordio
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+N_PER_CLASS = 60
+SIZE = 24
+BATCH = 16
+
+
+def draw(cls, rng):
+    """One 24x24 RGB image of class `cls` with jitter + noise."""
+    img = rng.uniform(0, 40, (SIZE, SIZE, 3))
+    cx, cy = SIZE // 2 + rng.randint(-3, 4), \
+        SIZE // 2 + rng.randint(-3, 4)
+    color = rng.uniform(150, 255, 3)
+    yy, xx = np.mgrid[0:SIZE, 0:SIZE]
+    if cls == 0:                                  # filled disc
+        mask = (yy - cy) ** 2 + (xx - cx) ** 2 < rng.randint(
+            16, 36)
+    elif cls == 1:                                # cross
+        w = rng.randint(1, 3)
+        mask = (np.abs(yy - cy) < w) | (np.abs(xx - cx) < w)
+    else:                                         # stripes
+        mask = ((xx + rng.randint(0, 4)) // 3) % 2 == 0
+    img[mask] = color + rng.uniform(-20, 20, 3)
+    return np.clip(img, 0, 255).astype(np.uint8)
+
+
+def build_folder(root, rng):
+    from PIL import Image
+    names = ("disc", "cross", "stripes")
+    for c, name in enumerate(names):
+        d = os.path.join(root, name)
+        os.makedirs(d)
+        for i in range(N_PER_CLASS):
+            Image.fromarray(draw(c, rng)).save(
+                os.path.join(d, "%s_%03d.png" % (name, i)))
+    return names
+
+
+def main():
+    rng = np.random.RandomState(0)
+    tmp = tempfile.mkdtemp(prefix="imgfolder_")
+    img_root = os.path.join(tmp, "images")
+    os.makedirs(img_root)
+    build_folder(img_root, rng)
+
+    # the reference workflow, verbatim: list pass then packing pass
+    prefix = os.path.join(tmp, "shapes")
+    im2rec = os.path.join(REPO, "tools", "im2rec.py")
+    subprocess.run([sys.executable, im2rec, prefix, img_root,
+                    "--list"], check=True)
+    subprocess.run([sys.executable, im2rec, prefix, img_root,
+                    "--resize", str(SIZE), "--quality", "95"],
+                   check=True)
+
+    with open(prefix + ".lst") as f:
+        n_rows = sum(1 for _ in f)
+    assert n_rows == 3 * N_PER_CLASS, n_rows
+    # the packed file is plain RecordIO — readable without the iter
+    reader = recordio.MXRecordIO(prefix + ".rec", "r")
+    first = reader.read()
+    assert first and len(first) > 100
+    reader.close()
+
+    # mean/std normalization inside the iterator (the reference's
+    # mean_r/std_r knobs) — raw 0-255 pixels would need a far smaller
+    # learning rate
+    it = mx.io.ImageRecordIter(
+        path_imgrec=prefix + ".rec", data_shape=(3, SIZE, SIZE),
+        batch_size=BATCH, shuffle=True, rand_mirror=True,
+        mean_r=66, mean_g=66, mean_b=66,
+        std_r=70, std_g=70, std_b=70, preprocess_threads=2)
+
+    net = mx.sym.Variable("data")
+    net = mx.sym.Convolution(net, num_filter=16, kernel=(3, 3),
+                             pad=(1, 1), name="c1")
+    net = mx.sym.Activation(net, act_type="relu")
+    net = mx.sym.Pooling(net, kernel=(2, 2), stride=(2, 2),
+                         pool_type="max")
+    net = mx.sym.Convolution(net, num_filter=32, kernel=(3, 3),
+                             pad=(1, 1), name="c2")
+    net = mx.sym.Activation(net, act_type="relu")
+    net = mx.sym.Pooling(net, global_pool=True, pool_type="avg",
+                         kernel=(1, 1))
+    net = mx.sym.FullyConnected(mx.sym.Flatten(net), num_hidden=3,
+                                name="fc")
+    net = mx.sym.SoftmaxOutput(net, name="softmax")
+
+    mod = mx.mod.Module(net, context=mx.cpu())
+    mod.fit(it, num_epoch=10, optimizer="sgd",
+            initializer=mx.init.Xavier(factor_type="in",
+                                       magnitude=2.0),
+            optimizer_params={"learning_rate": 0.1, "momentum": 0.9,
+                              "rescale_grad": 1.0 / BATCH})
+    it.reset()
+    score = mod.score(it, "acc")
+    acc = score[0][1] if isinstance(score, list) else float(score)
+    print("train accuracy through the full record pipeline: %.3f"
+          % acc)
+    assert acc > 0.88, "pipeline training failed: %.3f" % acc
+    shutil.rmtree(tmp, ignore_errors=True)
+    print("image_folder_training OK")
+
+
+if __name__ == "__main__":
+    main()

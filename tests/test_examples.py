@@ -29,6 +29,7 @@ FAST_EXAMPLES = [
     "stochastic_depth.py",
     "sgld_bayes.py",
     "dsd_pruning.py",
+    "image_folder_training.py",
 ]
 
 
