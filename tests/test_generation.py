@@ -1018,3 +1018,27 @@ class TestQuantizedKVCache:
         want = (prompt[:, -1][:, None]
                 + strides[:, None] * np.arange(1, 7)) % vocab
         np.testing.assert_array_equal(outf[:, 4:], want)
+
+    def test_q8_composes_with_gqa_and_window(self):
+        """The int8 cache must compose with grouped-query heads and
+        sliding-window attention (the modes share the cache layout):
+        logits track the float path within quantization tolerance."""
+        sym = transformer.get_symbol(V, T, num_layers=L, num_heads=4,
+                                     dim=DIM, num_kv_heads=2,
+                                     attention_window=6)
+        step = make_train_step(sym, optimizer="sgd")
+        mx.random.seed(7)
+        state = step.init_state(Xavier(), {"data": (B, T),
+                                           "softmax_label": (B, T)})
+        kw = dict(num_layers=L, num_heads=4, dim=DIM, num_kv_heads=2,
+                  attention_window=6, batch_size=B, max_len=T)
+        gen8 = Generator(state[0], V, quantize_kv=True, **kw)
+        genf = Generator(state[0], V, **kw)
+        toks = np.arange(B * 8).reshape(B, 8) % V
+        l8, _ = gen8._forward(gen8._fresh_aux(), toks, 0)
+        lf, _ = genf._forward(genf._fresh_aux(), toks, 0)
+        np.testing.assert_allclose(np.asarray(l8), np.asarray(lf),
+                                   rtol=0.1, atol=0.05)
+        # and generation runs end to end under the combo
+        out = gen8.generate(toks[:, :4].astype(np.int64), 4)
+        assert out.shape == (B, 8)
