@@ -444,3 +444,62 @@ def test_dist_async_multiprocess(tmp_path):
         for p in workers + [server]:
             if p.poll() is None:
                 p.kill()
+
+
+def test_concurrent_push_stress_no_lost_updates():
+    """Race hunt for the per-key lock table: 4 client threads hammer 3
+    shared keys with constant-gradient SGD pushes. The update is
+    commutative for identical gradients, so ANY lost or torn update
+    changes the deterministic final value. (The old global lock was
+    trivially lossless; the point is that the parallel lock table must
+    be too.)"""
+    srv = AsyncPSServer(host="127.0.0.1", port=0, num_workers=4)
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    try:
+        boot = _client(srv)
+        boot.set_optimizer(mx.optimizer.SGD(learning_rate=0.01,
+                                            rescale_grad=1.0))
+        keys = ["wa", "wb", "wc"]
+        for k in keys:
+            boot.init(k, np.full((4,), 5.0, np.float32))
+
+        PUSHES = 50
+        errs = []
+
+        def worker():
+            try:
+                c = _client(srv)
+                rng = np.random.RandomState()
+                for _ in range(PUSHES):
+                    c.push(keys[rng.randint(3)],
+                           np.ones((4,), np.float32))
+                c.close()
+            except Exception as e:  # noqa: BLE001
+                errs.append(e)
+
+        threads = [threading.Thread(target=worker) for _ in range(4)]
+        for th in threads:
+            th.start()
+        for th in threads:
+            th.join(timeout=60)
+        # a deadlocked lock table would leave workers alive (and the
+        # later pull would hang forever) — fail loudly here instead
+        assert not any(th.is_alive() for th in threads), \
+            "worker threads stuck: server-side deadlock?"
+        assert not errs, errs
+
+        # every push moves its key by -lr, so the summed displacement
+        # counts the pushes: any LOST update is a whole missing unit,
+        # far outside f32 accumulation noise (~0.005 units observed)
+        total = 0.0
+        for k in keys:
+            w = np.asarray(boot.pull(k))
+            assert np.all(w == w[0])          # never torn
+            total += (5.0 - w[0]) / 0.01
+        assert abs(total - 4 * PUSHES) < 0.5, \
+            "lost/torn updates: counted %.3f of %d" % (total,
+                                                       4 * PUSHES)
+        boot.close()
+    finally:
+        srv.stop()
