@@ -453,7 +453,10 @@ def test_concurrent_push_stress_no_lost_updates():
     changes the deterministic final value. (The old global lock was
     trivially lossless; the point is that the parallel lock table must
     be too.)"""
-    srv = AsyncPSServer(host="127.0.0.1", port=0, num_workers=4)
+    # num_workers is the shutdown quorum: keep it above the client
+    # count so worker close()/byes can't stop the server before the
+    # final verification pulls
+    srv = AsyncPSServer(host="127.0.0.1", port=0, num_workers=99)
     t = threading.Thread(target=srv.serve_forever, daemon=True)
     t.start()
     try:
