@@ -717,17 +717,21 @@ class Generator:
 
     def generate_on_device(self, prompt, max_new_tokens,
                            temperature=0.0, top_k=None, top_p=None,
-                           seed=0):
-        """Whole-generation-on-device: prefill + a lax.scan over decode
-        steps compiled into ONE XLA program — a single dispatch instead
-        of one per token (the production-serving shape; through a
-        remote tunnel the per-token loop is round-trip-bound).
+                           eos_id=None, seed=0):
+        """Whole-generation-on-device: prefill + a compiled decode loop
+        in ONE XLA program — a single dispatch instead of one per token
+        (the production-serving shape; through a remote tunnel the
+        per-token loop is round-trip-bound).
 
-        Same sampling semantics as generate() but fixed length (no eos
-        early-exit — a scan has static trip count). Each distinct
-        (prompt_len, max_new_tokens, temperature, top_k, top_p)
-        tuple compiles once (the sampling knobs are baked into the
-        program)."""
+        Same sampling semantics as generate(). Without eos_id the loop
+        is a lax.scan with a static trip count. With eos_id it becomes
+        a lax.while_loop that EXITS as soon as every row has emitted
+        eos — the serving early-stop, still in one program; the output
+        keeps the static (B, P + max_new_tokens) shape with finished
+        rows padded by eos (the host generate() truncates instead —
+        same tokens, different tail). Each distinct
+        (prompt_len, max_new_tokens, temperature, top_k, top_p,
+        eos_id) tuple compiles once."""
         self._check_sampling(temperature, top_k, top_p)
         prompt, P = self._check_prompt(prompt, max_new_tokens)
         if int(max_new_tokens) == 0:
@@ -735,40 +739,51 @@ class Generator:
         toks = self._device_loop(P, int(max_new_tokens),
                                  float(temperature),
                                  int(top_k) if top_k else 0,
-                                 float(top_p) if top_p else 0.0)(
+                                 float(top_p) if top_p else 0.0,
+                                 None if eos_id is None
+                                 else int(eos_id))(
             jnp.asarray(prompt, jnp.float32),
             jax.random.PRNGKey(seed))
         return np.concatenate([prompt.astype(np.int64),
                                np.asarray(toks)], axis=1)
 
-    def _device_loop(self, P, n_steps, temperature, top_k, top_p=0.0):
-        key_ = (P, n_steps, temperature, top_k, top_p)
+    def _device_loop(self, P, n_steps, temperature, top_k, top_p=0.0,
+                     eos_id=None):
+        key_ = (P, n_steps, temperature, top_k, top_p, eos_id)
         cached = self._loop_cache.get(key_)
         if cached is not None:
             return cached
         eval_fn = self._eval_fn
         params = self._params
+        B = self.batch_size
 
-        def run(prompt, key):
+        def decode_fwd(aux, tok, i, sub):
+            args = dict(params)
+            args["data"] = tok[:, None].astype(jnp.float32)
+            args["positions"] = jnp.full((1,), P + i, jnp.float32)
+            args["cache_pos"] = jnp.full((1,), P + i, jnp.float32)
+            outs, aux = eval_fn(args, aux, sub, False)
+            return outs[0][:, -1], aux
+
+        def prefill(prompt, key):
             aux = self._fresh_aux()
             args = dict(params)
             args["data"] = prompt
             args["positions"] = jnp.arange(P, dtype=jnp.float32)
             args["cache_pos"] = jnp.zeros((1,), jnp.float32)
             outs, aux = eval_fn(args, aux, key, False)
-            last = outs[0][:, -1]
+            return outs[0][:, -1], aux
+
+        def run_scan(prompt, key):
+            last, aux = prefill(prompt, key)
 
             def body(carry, i):
                 aux, last, key = carry
                 key, sub = jax.random.split(key)
                 tok = _pick_token(last, temperature, top_k, sub,
                                   top_p)
-                args = dict(params)
-                args["data"] = tok[:, None].astype(jnp.float32)
-                args["positions"] = jnp.full((1,), P + i, jnp.float32)
-                args["cache_pos"] = jnp.full((1,), P + i, jnp.float32)
-                outs, aux = eval_fn(args, aux, sub, False)
-                return (aux, outs[0][:, -1], key), tok
+                last, aux = decode_fwd(aux, tok, i, sub)
+                return (aux, last, key), tok
 
             # the scan body samples token i from the PREVIOUS step's
             # logits and then runs a forward — so the n-th token needs
@@ -782,7 +797,36 @@ class Generator:
             toks = jnp.concatenate([toks, tok_f[None]], axis=0)
             return toks.T                        # (B, n_steps)
 
-        fn = jax.jit(run)
+        def run_eos(prompt, key):
+            last, aux = prefill(prompt, key)
+            buf = jnp.full((B, n_steps), eos_id, jnp.int32)
+
+            def cond(c):
+                _aux, _last, _key, _buf, i, done = c
+                return (i < n_steps) & ~jnp.all(done)
+
+            def body(c):
+                aux, last, key, buf, i, done = c
+                key, sub = jax.random.split(key)
+                tok = _pick_token(last, temperature, top_k, sub,
+                                  top_p).astype(jnp.int32)
+                # same emit rule as the host generate(): finished rows
+                # keep emitting eos
+                tok = jnp.where(done, eos_id, tok)
+                done = done | (tok == eos_id)
+                buf = jax.lax.dynamic_update_slice(
+                    buf, tok[:, None], (0, i))
+                # the final iteration's forward is wasted work (its
+                # logits are never sampled) — the price of the dynamic
+                # exit; everything SKIPPED after all-eos is the win
+                last, aux = decode_fwd(aux, tok, i, sub)
+                return (aux, last, key, buf, i + 1, done)
+
+            c = (aux, last, key, buf, jnp.int32(0),
+                 jnp.zeros((B,), bool))
+            return jax.lax.while_loop(cond, body, c)[3]
+
+        fn = jax.jit(run_scan if eos_id is None else run_eos)
         self._loop_cache[key_] = fn
         return fn
 

@@ -1042,3 +1042,50 @@ class TestQuantizedKVCache:
         # and generation runs end to end under the combo
         out = gen8.generate(toks[:, :4].astype(np.int64), 4)
         assert out.shape == (B, 8)
+
+
+class TestEosOnDevice:
+    def test_eos_while_loop_matches_host(self):
+        """generate_on_device(eos_id=...) — the serving early-stop as a
+        while_loop in one program — must emit exactly the host
+        generate(eos_id=...) tokens, with finished rows padded by eos
+        to the static length (the host truncates instead)."""
+        _, params = _trained_params(seed=2)
+        gen = Generator(params, V, max_len=T, num_layers=L,
+                        num_heads=H, dim=DIM, batch_size=B)
+        prompt = np.arange(B * 3).reshape(B, 3) % V
+        n = 6
+        free = gen.generate(prompt, n)           # no-eos greedy probe
+        # pick the token some row emits mid-stream so the exit binds
+        eos = int(free[0, 4])
+        host = gen.generate(prompt, n, eos_id=eos)
+        dev = gen.generate_on_device(prompt, n, eos_id=eos)
+        assert dev.shape == (B, 3 + n)           # static shape
+        # host may truncate once every row finished; token-for-token
+        # equality on the emitted region, eos padding after
+        np.testing.assert_array_equal(dev[:, :host.shape[1]], host)
+        assert np.all(dev[:, host.shape[1]:] == eos)
+        # and without eos_id the scan path is unchanged
+        np.testing.assert_array_equal(
+            gen.generate_on_device(prompt, n), free)
+
+    def test_eos_while_loop_matches_host_sampled(self):
+        """The SAMPLED path through the eos while_loop (per-iteration
+        key splits + _pick_token inside the carried loop) must track
+        host generate() token for token — the scan path's sampled
+        parity test doesn't cover this trace."""
+        _, params = _trained_params(seed=3)
+        gen = Generator(params, V, max_len=T, num_layers=L,
+                        num_heads=H, dim=DIM, batch_size=B)
+        prompt = np.arange(B * 3).reshape(B, 3) % V
+        n = 6
+        probe = gen.generate(prompt, n, temperature=1.0, top_k=5,
+                             seed=11)
+        eos = int(probe[0, 4])
+        host = gen.generate(prompt, n, temperature=1.0, top_k=5,
+                            eos_id=eos, seed=11)
+        dev = gen.generate_on_device(prompt, n, temperature=1.0,
+                                     top_k=5, eos_id=eos, seed=11)
+        assert dev.shape == (B, 3 + n)
+        np.testing.assert_array_equal(dev[:, :host.shape[1]], host)
+        assert np.all(dev[:, host.shape[1]:] == eos)
