@@ -408,6 +408,19 @@ def _batch_norm(data, gamma, beta, moving_mean, moving_var, eps=1e-3,
             from .bn_pallas import bn_train_pallas
             out, mean, var = bn_train_pallas(data, g, beta,
                                              float(eps))
+        elif _os.environ.get("MXNET_BN_IMPL") == "autodiff":
+            # A/B escape hatch: plain two-pass statistics with
+            # autodiff backward (no custom_vjp boundary), so whole-
+            # model benchmarks can isolate what the closed-form
+            # rewrite costs/saves inside XLA's fusion decisions
+            xf = data.astype(jnp.float32)
+            mean = jnp.mean(xf, axis=red)
+            var = jnp.var(xf, axis=red)
+            inv = lax.rsqrt(var.reshape(bshape) + eps)
+            out = ((xf - mean.reshape(bshape)) * inv
+                   * g.reshape(bshape).astype(jnp.float32)
+                   + beta.reshape(bshape).astype(jnp.float32)
+                   ).astype(data.dtype)
         else:
             out, mean, var = _bn_train_core(data, g, beta, float(eps),
                                             red, bshape)

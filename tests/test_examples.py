@@ -30,6 +30,7 @@ FAST_EXAMPLES = [
     "sgld_bayes.py",
     "dsd_pruning.py",
     "image_folder_training.py",
+    "memcost_remat.py",
 ]
 
 

@@ -1,0 +1,37 @@
+#!/bin/bash
+# Whole-model A/B on the live TPU: isolate which default flipped since
+# the round-3 capture (2387 img/s, 28.1% MFU) regressed ResNet-50.
+# Two suspects, each a custom_vjp boundary XLA cannot fuse across:
+#   - MXNET_POOL_DENSE_BWD (r5 default ON): kh*kw dense max-pool bwd
+#   - the r4 one-pass/closed-form BatchNorm (vs plain autodiff BN)
+#
+#   bash tools/tpu_ab_regression.sh [outfile]
+#
+# Appends one JSON line per config to <outfile> (default
+# bench_out/ab_regression.jsonl), tagging each with its env config.
+set -u -o pipefail
+cd "$(dirname "$0")/.."
+OUT="${1:-bench_out/ab_regression.jsonl}"
+mkdir -p "$(dirname "$OUT")"
+
+run() {  # run <tag> [ENV=V...] — pins ALL BN/pool knobs per config so
+         # an exported var in the operator's shell cannot mislabel runs
+  local tag="$1"; shift
+  echo "== $tag ==" >&2
+  local line
+  line="$(env MXNET_BN_PALLAS=0 MXNET_BN_IMPL= "$@" python bench.py)" \
+      || { echo "FAILED $tag" >&2; return 0; }
+  MXTPU_AB_LINE="$line" MXTPU_AB_TAG="$tag" python -c '
+import json, os
+rec = json.loads(os.environ["MXTPU_AB_LINE"])
+rec["ab_config"] = os.environ["MXTPU_AB_TAG"]
+print(json.dumps(rec))
+' >> "$OUT" || echo "TAG-FAILED $tag" >&2
+}
+
+run dense_pool+onepass_bn   MXNET_POOL_DENSE_BWD=1
+run sas_pool+onepass_bn     MXNET_POOL_DENSE_BWD=0
+run dense_pool+autodiff_bn  MXNET_POOL_DENSE_BWD=1 MXNET_BN_IMPL=autodiff
+run sas_pool+autodiff_bn    MXNET_POOL_DENSE_BWD=0 MXNET_BN_IMPL=autodiff
+run dense_pool+pallas_bn    MXNET_POOL_DENSE_BWD=1 MXNET_BN_PALLAS=1
+echo "== A/B done; results in $OUT =="
