@@ -372,7 +372,8 @@ class Generator:
             return np.asarray(prompt, np.int64)
         fn = self._beam_loop(P, n, W,
                              -1 if eos_id is None else int(eos_id))
-        tokens, scores = fn(jnp.asarray(prompt, jnp.float32))
+        tokens, scores = fn(self._params,
+                            jnp.asarray(prompt, jnp.float32))
         tokens = np.asarray(tokens)            # (B, W, n)
         scores = np.asarray(scores)            # (B, W)
 
@@ -398,10 +399,10 @@ class Generator:
         if cached is not None:
             return cached
         eval_fn = self._eval_fn
-        params = self._params
         B, V = self.batch_size, self.vocab_size
 
-        def fwd(aux, data, pos):
+        # params as jit arguments, not closures (see _device_loop)
+        def fwd(params, aux, data, pos):
             args = dict(params)
             args["data"] = data.astype(jnp.float32)
             args["positions"] = jnp.full((1,), pos, jnp.float32)
@@ -429,7 +430,7 @@ class Generator:
                     | (tok == eos)
             return top_scores, tokens, frozen, parent, tok
 
-        def run(prompt):
+        def run(params, prompt):
             aux = self._fresh_aux()
             args = dict(params)
             args["data"] = prompt
@@ -457,7 +458,8 @@ class Generator:
                             + parent).reshape(-1)
                 aux = {k: jnp.take(v, flat_idx, axis=0)
                        for k, v in aux.items()}
-                logp, aux = fwd(aux, tok.reshape(-1, 1), P + i)
+                logp, aux = fwd(params, aux, tok.reshape(-1, 1),
+                                P + i)
                 logp = logp.reshape(B, W, V)
                 return (aux, logp, scores, tokens, frozen), None
 
@@ -613,7 +615,8 @@ class Generator:
             self._loop_cache[key_] = (fn, draft)   # pin draft alive
         else:
             fn = cached[0]
-        out, rounds = fn(jnp.asarray(prompt, jnp.float32))
+        out, rounds = fn(self._params, draft._params,
+                         jnp.asarray(prompt, jnp.float32))
         toks = np.asarray(out[:, :P + n], np.int64)
         if return_rounds:
             # rounds -> acceptance: each round emits acc+1 tokens, so
@@ -623,8 +626,7 @@ class Generator:
 
     def _spec_loop(self, draft, P, n, g):
         B = self.batch_size
-        t_eval, t_params = self._eval_fn, self._params
-        d_eval, d_params = draft._eval_fn, draft._params
+        t_eval, d_eval = self._eval_fn, draft._eval_fn
         rng0 = jax.random.PRNGKey(0)
 
         def fwd(eval_fn, params, aux, tokens, pos, tn):
@@ -637,7 +639,8 @@ class Generator:
             outs, aux = eval_fn(args, aux, rng0, False)
             return outs[0], aux
 
-        def run(prompt):
+        # both models' params as jit arguments (see _device_loop)
+        def run(t_params, d_params, prompt):
             t_aux = self._fresh_aux()
             d_aux = draft._fresh_aux()
             prompt_i = prompt.astype(jnp.int32)
@@ -742,6 +745,7 @@ class Generator:
                                  float(top_p) if top_p else 0.0,
                                  None if eos_id is None
                                  else int(eos_id))(
+            self._params,
             jnp.asarray(prompt, jnp.float32),
             jax.random.PRNGKey(seed))
         return np.concatenate([prompt.astype(np.int64),
@@ -754,10 +758,14 @@ class Generator:
         if cached is not None:
             return cached
         eval_fn = self._eval_fn
-        params = self._params
         B = self.batch_size
 
-        def decode_fwd(aux, tok, i, sub):
+        # params flow through as jit ARGUMENTS, never closures: a
+        # closed-over weight dict would be baked into the lowered
+        # program as dense constants — a fresh compile per checkpoint,
+        # and a serialized module the size of the model (the axon
+        # tunnel's remote_compile rejects those outright, HTTP 413)
+        def decode_fwd(params, aux, tok, i, sub):
             args = dict(params)
             args["data"] = tok[:, None].astype(jnp.float32)
             args["positions"] = jnp.full((1,), P + i, jnp.float32)
@@ -765,7 +773,7 @@ class Generator:
             outs, aux = eval_fn(args, aux, sub, False)
             return outs[0][:, -1], aux
 
-        def prefill(prompt, key):
+        def prefill(params, prompt, key):
             aux = self._fresh_aux()
             args = dict(params)
             args["data"] = prompt
@@ -774,15 +782,15 @@ class Generator:
             outs, aux = eval_fn(args, aux, key, False)
             return outs[0][:, -1], aux
 
-        def run_scan(prompt, key):
-            last, aux = prefill(prompt, key)
+        def run_scan(params, prompt, key):
+            last, aux = prefill(params, prompt, key)
 
             def body(carry, i):
                 aux, last, key = carry
                 key, sub = jax.random.split(key)
                 tok = _pick_token(last, temperature, top_k, sub,
                                   top_p)
-                last, aux = decode_fwd(aux, tok, i, sub)
+                last, aux = decode_fwd(params, aux, tok, i, sub)
                 return (aux, last, key), tok
 
             # the scan body samples token i from the PREVIOUS step's
@@ -797,8 +805,8 @@ class Generator:
             toks = jnp.concatenate([toks, tok_f[None]], axis=0)
             return toks.T                        # (B, n_steps)
 
-        def run_eos(prompt, key):
-            last, aux = prefill(prompt, key)
+        def run_eos(params, prompt, key):
+            last, aux = prefill(params, prompt, key)
             buf = jnp.full((B, n_steps), eos_id, jnp.int32)
 
             def cond(c):
@@ -819,7 +827,7 @@ class Generator:
                 # the final iteration's forward is wasted work (its
                 # logits are never sampled) — the price of the dynamic
                 # exit; everything SKIPPED after all-eos is the win
-                last, aux = decode_fwd(aux, tok, i, sub)
+                last, aux = decode_fwd(params, aux, tok, i, sub)
                 return (aux, last, key, buf, i + 1, done)
 
             c = (aux, last, key, buf, jnp.int32(0),
