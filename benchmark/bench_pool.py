@@ -1,8 +1,10 @@
 """Max-pool fwd+bwd microbench: dense custom backward
-(MXNET_POOL_DENSE_BWD=1, the default) vs XLA's SelectAndScatter
-autodiff — the second-largest non-matmul cost in the conv-net traces
-after BatchNorm (docs/mfu_analysis.md). Shapes: the ResNet-50 stem
-pool plus inception-style grids. Run on TPU when the tunnel is up:
+(MXNET_POOL_DENSE_BWD=1, an off-by-default experiment) vs XLA's
+SelectAndScatter autodiff (the default). The first live run decided
+the default: dense is 10-12x slower at every conv-net pool shape
+(bench_out/pool_micro.jsonl) — each of its 2*kh*kw passes streams the
+full padded tensor from HBM. Shapes: the ResNet-50 stem pool plus
+inception-style grids. Run on TPU when the tunnel is up:
 
     python benchmark/bench_pool.py          # or BENCH_PLATFORM=cpu
 

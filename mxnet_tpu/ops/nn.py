@@ -182,15 +182,19 @@ def _pooling(data, kernel=(), pool_type="max", stride=(), pad=(),
         init = -jnp.inf if jnp.issubdtype(data.dtype, jnp.floating) else \
             jnp.iinfo(data.dtype).min
         if nd == 2 and jnp.issubdtype(data.dtype, jnp.floating) and \
-                os.environ.get("MXNET_POOL_DENSE_BWD", "1") == "1":
-            # custom backward: XLA differentiates reduce_window into
-            # SelectAndScatter — a serialized scatter that traces show
-            # among the top non-matmul costs of conv nets. The dense
-            # formulation below replaces it with kh*kw vectorized
-            # passes built on the x==y routing idea of the reference's
-            # mshadow backward (pooling-inl.h) — with ties SPLIT, not
-            # duplicated; see _max_pool2d_dense_bwd. Reverse-mode only
-            # (custom_vjp): jvp users set MXNET_POOL_DENSE_BWD=0.
+                os.environ.get("MXNET_POOL_DENSE_BWD", "0") == "1":
+            # OFF by default: measured on a real v5e chip, the kh*kw
+            # dense formulation below is 10-12x SLOWER than XLA's
+            # SelectAndScatter autodiff at conv-net pool shapes (38 ms
+            # vs 3.6 ms fwd+bwd at the ResNet stem, bench_out/
+            # pool_micro.jsonl) — each of the 2*kh*kw passes streams
+            # the full padded tensor from HBM, swamping whatever the
+            # scatter serialization costs. Kept behind the env knob
+            # for its tie-SPLITTING subgradient (ties share dy/count;
+            # SelectAndScatter picks one winner) and as the A/B
+            # harness for benchmark/bench_pool.py. Reverse-mode only
+            # (custom_vjp): the default path is also what jvp users
+            # get.
             return _max_pool2d_dense_bwd(data, kernel, stride,
                                          padding[2:])
         return lax.reduce_window(data, init, lax.max, window, strides,
@@ -408,11 +412,22 @@ def _batch_norm(data, gamma, beta, moving_mean, moving_var, eps=1e-3,
             from .bn_pallas import bn_train_pallas
             out, mean, var = bn_train_pallas(data, g, beta,
                                              float(eps))
-        elif _os.environ.get("MXNET_BN_IMPL") == "autodiff":
-            # A/B escape hatch: plain two-pass statistics with
-            # autodiff backward (no custom_vjp boundary), so whole-
-            # model benchmarks can isolate what the closed-form
-            # rewrite costs/saves inside XLA's fusion decisions
+        elif _os.environ.get("MXNET_BN_IMPL") == "onepass":
+            # the r4 one-pass/closed-form custom_vjp rewrite — kept as
+            # an experiment, NOT the default: measured on a real v5e
+            # it is never faster than the plain autodiff form below
+            # and falls off a cliff at the ResNet stem shape (1831 ms
+            # vs 3.1 ms fwd+bwd at (128,64,112,112), bench_out/
+            # bn_micro.jsonl) — the custom_vjp boundary blocks the
+            # surrounding fusion the "one pass" was meant to buy
+            out, mean, var = _bn_train_core(data, g, beta, float(eps),
+                                            red, bshape)
+        else:
+            # default: plain two-pass statistics, autodiff backward —
+            # no custom_vjp boundary, so XLA fuses BN into the
+            # neighboring convs' epilogues freely. On-chip microbench
+            # and whole-model A/B both prefer this over the one-pass
+            # rewrite (bench_out/{bn_micro,ab_regression}.jsonl).
             xf = data.astype(jnp.float32)
             mean = jnp.mean(xf, axis=red)
             var = jnp.var(xf, axis=red)
@@ -421,9 +436,6 @@ def _batch_norm(data, gamma, beta, moving_mean, moving_var, eps=1e-3,
                    * g.reshape(bshape).astype(jnp.float32)
                    + beta.reshape(bshape).astype(jnp.float32)
                    ).astype(data.dtype)
-        else:
-            out, mean, var = _bn_train_core(data, g, beta, float(eps),
-                                            red, bshape)
         new_mm = moving_mean * momentum + mean * (1 - momentum)
         new_mv = moving_var * momentum + var * (1 - momentum)
         use_mean, use_var = mean, var

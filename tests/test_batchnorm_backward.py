@@ -31,7 +31,14 @@ def _naive_bn(x, gamma, beta, eps, axis, fix_gamma):
 
 @pytest.mark.parametrize("axis", [1, 3])
 @pytest.mark.parametrize("fix_gamma", [False, True])
-def test_train_bn_matches_naive(axis, fix_gamma):
+@pytest.mark.parametrize("impl", ["", "onepass"])
+def test_train_bn_matches_naive(axis, fix_gamma, impl, monkeypatch):
+    """Default (two-pass autodiff) and MXNET_BN_IMPL=onepass (the r4
+    closed-form custom_vjp core) must both match the reference math —
+    the env parametrization also guards the routing itself, so the
+    A/B harness's *_onepass_bn configs cannot silently benchmark the
+    default path twice."""
+    monkeypatch.setenv("MXNET_BN_IMPL", impl)
     rng = np.random.RandomState(0)
     x = rng.randn(4, 5, 6, 7).astype(np.float32) * 2.0 + 0.5
     C = x.shape[axis]

@@ -2,8 +2,10 @@
 # Whole-model A/B on the live TPU: isolate which default flipped since
 # the round-3 capture (2387 img/s, 28.1% MFU) regressed ResNet-50.
 # Two suspects, each a custom_vjp boundary XLA cannot fuse across:
-#   - MXNET_POOL_DENSE_BWD (r5 default ON): kh*kw dense max-pool bwd
-#   - the r4 one-pass/closed-form BatchNorm (vs plain autodiff BN)
+#   - MXNET_POOL_DENSE_BWD: kh*kw dense max-pool bwd (r5 default,
+#     since reverted by this A/B's own result)
+#   - the r4 one-pass/closed-form BatchNorm (vs plain autodiff BN,
+#     the default again for the same reason)
 #
 #   bash tools/tpu_ab_regression.sh [outfile]
 #
@@ -19,7 +21,8 @@ run() {  # run <tag> [ENV=V...] — pins ALL BN/pool knobs per config so
   local tag="$1"; shift
   echo "== $tag ==" >&2
   local line
-  line="$(env MXNET_BN_PALLAS=0 MXNET_BN_IMPL= "$@" python bench.py)" \
+  line="$(env MXNET_BN_PALLAS=0 MXNET_BN_IMPL= MXNET_POOL_DENSE_BWD=0 \
+          "$@" python bench.py)" \
       || { echo "FAILED $tag" >&2; return 0; }
   MXTPU_AB_LINE="$line" MXTPU_AB_TAG="$tag" python -c '
 import json, os
@@ -29,9 +32,9 @@ print(json.dumps(rec))
 ' >> "$OUT" || echo "TAG-FAILED $tag" >&2
 }
 
-run dense_pool+onepass_bn   MXNET_POOL_DENSE_BWD=1
-run sas_pool+onepass_bn     MXNET_POOL_DENSE_BWD=0
-run dense_pool+autodiff_bn  MXNET_POOL_DENSE_BWD=1 MXNET_BN_IMPL=autodiff
-run sas_pool+autodiff_bn    MXNET_POOL_DENSE_BWD=0 MXNET_BN_IMPL=autodiff
-run dense_pool+pallas_bn    MXNET_POOL_DENSE_BWD=1 MXNET_BN_PALLAS=1
+run dense_pool+onepass_bn   MXNET_POOL_DENSE_BWD=1 MXNET_BN_IMPL=onepass
+run sas_pool+onepass_bn     MXNET_POOL_DENSE_BWD=0 MXNET_BN_IMPL=onepass
+run dense_pool+autodiff_bn  MXNET_POOL_DENSE_BWD=1
+run sas_pool+autodiff_bn    MXNET_POOL_DENSE_BWD=0
+run sas_pool+pallas_bn      MXNET_POOL_DENSE_BWD=0 MXNET_BN_PALLAS=1
 echo "== A/B done; results in $OUT =="
