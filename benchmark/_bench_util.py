@@ -1,0 +1,32 @@
+"""Shared microbench discipline for the axon-tunnel TPU (one copy, so
+every benchmark/ script means the same thing by a millisecond):
+
+chain ITERS dependent iterations of `step` on device inside one jitted
+fori_loop, warm it (compile + first run), then time ONE chain and read
+back a single scalar — `block_until_ready` does not drain the tunnel
+and a big-tensor device_get would bottleneck on ~28 MB/s, so the
+scalar readback is the only safe barrier (see the verify notes in
+docs/mfu_analysis.md).
+"""
+import time
+
+import jax
+import numpy as np
+
+
+def chain_time(step, x0, iters):
+    """Time `step` (array -> same-shape array) chained `iters` times.
+
+    Returns seconds per iteration. `step` must make iteration i+1
+    data-depend on i (feed its output forward) or the loop could
+    overlap in ways a training step would not.
+    """
+    @jax.jit
+    def chain(x):
+        return jax.lax.fori_loop(0, iters, lambda i, x_: step(x_), x)
+
+    scalar = jax.jit(lambda x: x.ravel()[0])
+    np.asarray(jax.device_get(scalar(chain(x0))))      # compile+warm
+    t0 = time.time()
+    np.asarray(jax.device_get(scalar(chain(x0))))
+    return (time.time() - t0) / iters

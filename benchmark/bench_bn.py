@@ -14,7 +14,6 @@ measurement discipline). Prints one JSON line per shape.
 import json
 import os
 import sys
-import time
 
 _platform = os.environ.get("BENCH_PLATFORM")
 if _platform:
@@ -26,9 +25,12 @@ if _platform:
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
 import jax.numpy as jnp  # noqa: E402
 import numpy as np  # noqa: E402
+
+from _bench_util import chain_time  # noqa: E402
 
 # (N, C, H, W) — ResNet-50 stage shapes at batch 128.
 # BENCH_BN_SMOKE=1 shrinks them for CPU CI (Pallas interpret mode runs
@@ -57,6 +59,11 @@ def naive_bn(x, gamma, beta, eps=1e-3):
 
 
 def framework_bn(x, gamma, beta, eps=1e-3):
+    """The r4 one-pass/closed-form core. Since the default flipped
+    back to two-pass autodiff (the 'two_pass'/naive column here IS the
+    default now), this column must pin the routing explicitly or the
+    A/B silently times the default twice."""
+    os.environ["MXNET_BN_IMPL"] = "onepass"
     from mxnet_tpu.ops.nn import _batch_norm
     C = x.shape[1]
     return _batch_norm(x, gamma, beta, jnp.zeros(C), jnp.ones(C),
@@ -70,9 +77,7 @@ def pallas_bn(x, gamma, beta, eps=1e-3):
 
 
 def timed(fn, shape):
-    """fwd+bwd step, CHAINED on device: the loop carries x so iteration
-    i+1 depends on i, and one scalar readback amortizes the tunnel
-    RTT over all iterations."""
+    """fwd+bwd step, chained on device via _bench_util.chain_time."""
     N, C, H, W = shape
     rng = np.random.RandomState(0)
     x0 = jnp.asarray(rng.randn(*shape), jnp.bfloat16)
@@ -87,15 +92,7 @@ def timed(fn, shape):
         dx, dg, db = jax.grad(loss, argnums=(0, 1, 2))(x, gamma, beta)
         return dx.astype(x.dtype)      # feeds the next iteration
 
-    @jax.jit
-    def chain(x):
-        return jax.lax.fori_loop(0, ITERS, lambda i, x_: step(x_), x)
-
-    scalar = jax.jit(lambda x: x.ravel()[0])
-    np.asarray(jax.device_get(scalar(chain(x0))))       # compile+warm
-    t0 = time.time()
-    np.asarray(jax.device_get(scalar(chain(x0))))
-    return (time.time() - t0) / ITERS
+    return chain_time(step, x0, ITERS)
 
 
 def main():

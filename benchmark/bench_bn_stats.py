@@ -27,7 +27,6 @@ One JSON line per shape.
 import json
 import os
 import sys
-import time
 
 _platform = os.environ.get("BENCH_PLATFORM")
 if _platform:
@@ -37,8 +36,12 @@ import jax  # noqa: E402
 if _platform:
     jax.config.update("jax_platforms", _platform)
 
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
 import jax.numpy as jnp  # noqa: E402
 import numpy as np  # noqa: E402
+
+from _bench_util import chain_time  # noqa: E402
 
 SHAPES = [
     (128, 64, 112, 112),
@@ -122,15 +125,7 @@ def timed(fn, shape):
         dx, dg, db = jax.grad(loss, argnums=(0, 1, 2))(x, gamma, beta)
         return dx.astype(x.dtype)
 
-    @jax.jit
-    def chain(x):
-        return jax.lax.fori_loop(0, ITERS, lambda i, x_: step(x_), x)
-
-    scalar = jax.jit(lambda x: x.ravel()[0])
-    np.asarray(jax.device_get(scalar(chain(x0))))       # compile+warm
-    t0 = time.time()
-    np.asarray(jax.device_get(scalar(chain(x0))))
-    return (time.time() - t0) / ITERS
+    return chain_time(step, x0, ITERS)
 
 
 def check_close():

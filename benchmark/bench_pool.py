@@ -14,7 +14,6 @@ One JSON line per shape.
 import json
 import os
 import sys
-import time
 
 _platform = os.environ.get("BENCH_PLATFORM")
 if _platform:
@@ -27,8 +26,12 @@ if _platform:
 sys.path.insert(0, os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))
 
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
 import jax.numpy as jnp  # noqa: E402
 import numpy as np  # noqa: E402
+
+from _bench_util import chain_time  # noqa: E402
 
 # (N, C, H, W, kernel, stride, pad)
 SHAPES = [
@@ -60,15 +63,7 @@ def timed(env, shape):
         dx = jax.grad(loss)(x)
         return dx.astype(x.dtype)     # feeds the next iteration
 
-    @jax.jit
-    def chain(x):
-        return jax.lax.fori_loop(0, ITERS, lambda i, x_: step(x_), x)
-
-    scalar = jax.jit(lambda x: x.ravel()[0])
-    np.asarray(jax.device_get(scalar(chain(x0))))      # compile+warm
-    t0 = time.time()
-    np.asarray(jax.device_get(scalar(chain(x0))))
-    return (time.time() - t0) / ITERS
+    return chain_time(step, x0, ITERS)
 
 
 def main():

@@ -44,11 +44,14 @@ _CATEGORIES = (
     ("collectives", ("all-reduce", "all-gather", "reduce-scatter",
                      "collective-permute", "all-to-all")),
     ("pooling", ("reduce-window", "select-and-scatter", "pool")),
+    # reductions BEFORE the convert row: bf16->f32 statistics lower as
+    # "%convert_reduce_fusion" — they are reduce work (the BN-stats
+    # share this table exists to expose), not layout casts
+    ("bn-stats / reductions", ("reduce", "variance", "norm")),
     # "convert" (dtype cast) before the "conv" substring would claim it
     ("copies / layout", ("convert",)),
     ("convolution", ("conv",)),
     ("matmul", ("dot", "einsum", "matmul")),
-    ("bn-stats / reductions", ("reduce", "variance", "norm")),
     ("copies / layout", ("copy", "transpose", "bitcast", "reshape",
                          "pad", "slice", "concatenate")),
     ("elementwise fusion", ("fusion", "add", "multiply", "subtract",
@@ -98,6 +101,7 @@ def summarize(trace_dir):
     per_cat = collections.Counter()
     per_op = collections.Counter()
     total = 0
+    async_ps = 0
     for path in paths:
         xspace = xplane_pb2.XSpace()
         with open(path, "rb") as f:
@@ -141,16 +145,31 @@ def summarize(trace_dir):
                                              "PyArray", "Thread")):
                         continue
                     dur = ev.duration_ps
+                    # async copy/slice pairs (HBM<->VMEM prefetches from
+                    # XLA's memory-space assignment, S(1) layouts) span
+                    # wall time OVERLAPPED with compute — counting them
+                    # as device work double-books the window (they
+                    # dominated this table as "copies / layout" before
+                    # this split). Track separately, out of the share
+                    # denominator.
+                    head = name.split(" = ", 1)[0]
+                    if re.search(r"%(copy|slice|collective-permute|"
+                                 r"all-reduce|all-gather|"
+                                 r"reduce-scatter|all-to-all)"
+                                 r"-(start|done)",
+                                 head):
+                        async_ps += dur
+                        continue
                     per_cat[_category(name)] += dur
                     per_op[name] += dur
                     total += dur
-    return per_cat, per_op, total
+    return per_cat, per_op, total, async_ps
 
 
 def main():
     if len(sys.argv) != 2:
         raise SystemExit("usage: xplane_summary.py <trace_dir>")
-    per_cat, per_op, total = summarize(sys.argv[1])
+    per_cat, per_op, total, async_ps = summarize(sys.argv[1])
     if not total:
         raise SystemExit("no device events found (trace too short, or "
                          "only host planes present)")
@@ -160,6 +179,10 @@ def main():
     for cat, ps in per_cat.most_common():
         print("| %s | %.2f | %.1f%% |" % (cat, ps / 1e9,
                                           100.0 * ps / total))
+    if async_ps:
+        print("(async copy/collective start-done spans — HBM<->VMEM "
+              "prefetches and in-flight comm, overlapped with compute "
+              "— excluded above: %.2f ms)" % (async_ps / 1e9))
     print("\ntop 15 ops:")
     for name, ps in per_op.most_common(15):
         print("  %8.2f ms  %4.1f%%  %s" % (
