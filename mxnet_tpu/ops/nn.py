@@ -428,9 +428,43 @@ def _batch_norm(data, gamma, beta, moving_mean, moving_var, eps=1e-3,
             # neighboring convs' epilogues freely. On-chip microbench
             # and whole-model A/B both prefer this over the one-pass
             # rewrite (bench_out/{bn_micro,ab_regression}.jsonl).
+            #
+            # MXNET_BN_STATS=dot|auto: statistics as MXU contractions
+            # (sum_nx x = ones-vector einsum, sum_nx x^2 = self inner
+            # product; bf16 x bf16 products are exact in the f32
+            # accumulator). The live micro A/B
+            # (bench_out/bn_stats_micro.jsonl) shows the VPU reduce
+            # wins at early-net shapes but LOSES at deep-stage shapes
+            # (C large, HW small: 1.8x at 1024x14^2) — 'auto' applies
+            # the contraction only there (C >= 2*H*W). One-pass
+            # E[x^2]-E[x]^2 in f32: fine for post-conv activations,
+            # degrades when |mean|/std > ~3e3 (the two-pass default
+            # has no such limit).
+            stats = _os.environ.get("MXNET_BN_STATS", "")
+            dot_ok = (stats in ("dot", "auto") and data.ndim == 4
+                      and axis == 1)
+            if dot_ok and stats == "auto":
+                # gate to the one measured crossover class (the
+                # 1024x14^2 row of bn_stats_micro.jsonl, 1.8x): big C
+                # with a not-tiny spatial extent. 2048x7^2 also has
+                # C >= 2*HW but measured 0.94x, hence the HW floor.
+                hw = data.shape[2] * data.shape[3]
+                dot_ok = data.shape[1] >= 2 * hw and hw >= 128
             xf = data.astype(jnp.float32)
-            mean = jnp.mean(xf, axis=red)
-            var = jnp.var(xf, axis=red)
+            if dot_ok:
+                N, C, H, W = data.shape
+                m = N * H * W
+                x3 = data.reshape(N, C, H * W)
+                ones = jnp.ones((N, H * W), data.dtype)
+                s1 = jnp.einsum("ncx,nx->c", x3, ones,
+                                preferred_element_type=jnp.float32)
+                s2 = jnp.einsum("ncx,ncx->c", x3, x3,
+                                preferred_element_type=jnp.float32)
+                mean = s1 / m
+                var = jnp.maximum(s2 / m - jnp.square(mean), 0.0)
+            else:
+                mean = jnp.mean(xf, axis=red)
+                var = jnp.var(xf, axis=red)
             inv = lax.rsqrt(var.reshape(bshape) + eps)
             out = ((xf - mean.reshape(bshape)) * inv
                    * g.reshape(bshape).astype(jnp.float32)

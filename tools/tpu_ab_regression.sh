@@ -22,7 +22,7 @@ run() {  # run <tag> [ENV=V...] — pins ALL BN/pool knobs per config so
   echo "== $tag ==" >&2
   local line
   line="$(env MXNET_BN_PALLAS=0 MXNET_BN_IMPL= MXNET_POOL_DENSE_BWD=0 \
-          "$@" python bench.py)" \
+          MXNET_BN_STATS= "$@" python bench.py)" \
       || { echo "FAILED $tag" >&2; return 0; }
   MXTPU_AB_LINE="$line" MXTPU_AB_TAG="$tag" python -c '
 import json, os
@@ -37,4 +37,6 @@ run sas_pool+onepass_bn     MXNET_POOL_DENSE_BWD=0 MXNET_BN_IMPL=onepass
 run dense_pool+autodiff_bn  MXNET_POOL_DENSE_BWD=1
 run sas_pool+autodiff_bn    MXNET_POOL_DENSE_BWD=0
 run sas_pool+pallas_bn      MXNET_POOL_DENSE_BWD=0 MXNET_BN_PALLAS=1
+run bn_stats_auto           MXNET_BN_STATS=auto
+run bn_stats_dot            MXNET_BN_STATS=dot
 echo "== A/B done; results in $OUT =="
