@@ -363,11 +363,16 @@ def bench_transformer(args):
         from mxnet_tpu.parallel import make_train_step
         from mxnet_tpu.initializer import Xavier
 
+        # BENCH_TLM_LOSS_CHUNK=N: chunked fused CE head — bounds the
+        # head's live memory at (N, vocab) instead of (B*T, vocab),
+        # the enabler for 64k-token training on one chip
+        loss_chunk = int(os.environ.get("BENCH_TLM_LOSS_CHUNK", "0"))
         sym = transformer.get_symbol(V, T, num_layers=L,
                                      num_heads=c["heads"], dim=D,
                                      ffn_hidden=F,
                                      num_kv_heads=kv_heads,
-                                     attention_window=args.window or 0)
+                                     attention_window=args.window or 0,
+                                     loss_chunk=loss_chunk)
         step = make_train_step(
             sym, optimizer="adam",
             optimizer_params={"rescale_grad": 1.0 / B},
@@ -417,6 +422,7 @@ def bench_transformer(args):
         "compute_dtype": dtype,
         "window": args.window,
         "remat": bool(args.remat),
+        "loss_chunk": loss_chunk or None,
         "device_kind": getattr(dev, "device_kind", "unknown"),
         "step_tflops": round(flops / 1e12, 2),
         "mfu": round(mfu, 4) if mfu is not None else None}))

@@ -317,7 +317,7 @@ def get_symbol(vocab_size, seq_len, num_layers=2, num_heads=4, dim=128,
                ffn_hidden=None, dropout=0.0, max_len=None,
                num_experts=0, seq_axis=None, expert_axis=None,
                moe_capacity_factor=1.25, pos_encoding="learned",
-               attention_window=0, num_kv_heads=None):
+               attention_window=0, num_kv_heads=None, loss_chunk=0):
     """GPT-style causal LM symbol.
 
     data: (B, T) token ids; softmax_label: (B, T) next-token targets
@@ -346,6 +346,17 @@ def get_symbol(vocab_size, seq_len, num_layers=2, num_heads=4, dim=128,
     "rope" — rotary embeddings applied to q/k inside every attention
     layer (no position parameters, graceful length extrapolation; the
     modern long-context choice).
+
+    loss_chunk: 0 (default) keeps the reference head — FullyConnected
+    logits + SoftmaxOutput, output = softmax probabilities per
+    position. A positive value swaps in the fused chunked-CE head
+    (`_contrib_ChunkedSoftmaxCE`): the OUTPUT CONTRACT CHANGES to the
+    per-token loss (B, T) in SoftmaxOutput's gradient scaling (no
+    probabilities are ever materialized — that (B*T, vocab) f32
+    buffer is what OOMs 64k-token training, not attention). Parameter
+    names/shapes are identical, so checkpoints interchange; parameter
+    gradients are bit-equal to the dense head's
+    (tests/test_transformer.py::test_chunked_loss_head_matches_dense).
     """
     ffn_hidden = ffn_hidden or 4 * dim
     max_len = max_len or seq_len
@@ -380,6 +391,23 @@ def get_symbol(vocab_size, seq_len, num_layers=2, num_heads=4, dim=128,
                          window=attention_window)
 
     x = sym.LayerNorm(x, name="ln_f")
+    if loss_chunk:
+        # chunked fused head: never materializes the (B*T, V) logits
+        # (8.6 GB in f32 at 64k tokens x 32k vocab — THE long-context
+        # OOM, not attention). Same parameter names as the
+        # FullyConnected head, so checkpoints interchange; output is
+        # the per-token loss (B, T) in SoftmaxOutput's gradient
+        # scaling, not the softmax probabilities.
+        w_head = sym.Variable("lm_head_weight",
+                              shape=(vocab_size, dim))
+        b_head = sym.Variable("lm_head_bias", shape=(vocab_size,))
+        x2 = sym.reshape(x, shape=(-3, -2))           # (B*T, D)
+        label_r = sym.reshape(label, shape=(-1,))
+        loss = sym._contrib_ChunkedSoftmaxCE(
+            x2, w_head, b_head, label_r, chunk=int(loss_chunk),
+            use_ignore=True, ignore_label=-1.0,
+            normalization="valid", name="softmax")
+        return sym.reshape(loss, shape=(-1, seq_len))
     logits = sym.FullyConnected(x, num_hidden=vocab_size, flatten=False,
                                 name="lm_head")
     logits = sym.reshape(logits, shape=(-3, -2))      # (B*T, V)

@@ -168,3 +168,78 @@ def _svm_output(data, label, margin=1.0, regularization_coefficient=1.0,
                     "momentum": 0.9})
 def _identity_kl(data, **_):
     return data
+
+
+@register("_contrib_ChunkedSoftmaxCE",
+          arg_names=("data", "weight", "bias", "label"),
+          nondiff_inputs=(3,),
+          defaults={"chunk": 2048, "grad_scale": 1.0,
+                    "ignore_label": -1.0, "use_ignore": False,
+                    "normalization": "valid"})
+def _chunked_softmax_ce(data, weight, bias, label, chunk=2048,
+                        grad_scale=1.0, ignore_label=-1.0,
+                        use_ignore=False, normalization="valid", **_):
+    """Fused projection + softmax cross-entropy, chunked over rows.
+
+    The monolithic LM head materializes (N, V) logits plus their f32
+    softmax — at 64k tokens x 32k vocab that is >8 GB and is what
+    OOMs long-context training on one chip (not attention: the flash
+    kernels are O(T)). This op never holds more than (chunk, V)
+    logits: a checkpointed `lax.map` over row chunks computes
+    per-token NLL forward, and the scan backward REPLAYS each chunk's
+    projection to form d(logits) locally, accumulating d(weight) in a
+    single (V, D) f32 buffer.
+
+    Semantics: MakeLoss-style — the op's output IS the per-token loss
+    (already scaled by grad_scale / norm like SoftmaxOutput's
+    backward, so head-grad ones gives the same parameter gradients as
+    the FullyConnected+SoftmaxOutput head), shaped like `label`.
+    No reference analogue (the reference predates LLM-scale vocab
+    heads); the seam it replaces is FullyConnected(lm_head) +
+    SoftmaxOutput (softmax_output.cc).
+    """
+    N = data.shape[0]
+    V = weight.shape[0]
+    chunk = max(1, min(int(chunk), N))
+    pad = (-N) % chunk
+    xf = data
+    lab = label.reshape(-1).astype(jnp.int32)
+    if pad:
+        xf = jnp.concatenate(
+            [xf, jnp.zeros((pad,) + xf.shape[1:], xf.dtype)])
+        lab = jnp.concatenate(
+            [lab, jnp.full((pad,), int(ignore_label), jnp.int32)])
+    keep = jnp.ones_like(lab, jnp.float32)
+    if use_ignore:
+        keep = (lab != int(ignore_label)).astype(jnp.float32)
+    elif pad:
+        keep = jnp.concatenate(
+            [jnp.ones((N,), jnp.float32), jnp.zeros((pad,),
+                                                    jnp.float32)])
+    if normalization == "batch":
+        norm = float(N)
+    elif normalization == "valid":
+        norm = jnp.maximum(jnp.sum(keep), 1.0)
+    else:
+        norm = 1.0
+    scale = grad_scale / norm
+
+    K = xf.shape[0] // chunk
+    xs = xf.reshape(K, chunk, -1)
+    ls = lab.reshape(K, chunk)
+    ks = keep.reshape(K, chunk)
+
+    @jax.checkpoint
+    def chunk_nll(args):
+        x_c, l_c, k_c = args
+        # bf16 inputs ride the MXU; f32 accumulate + f32 softmax math
+        logits = jnp.dot(x_c, weight.T,
+                         preferred_element_type=jnp.float32)
+        logits = logits + bias.astype(jnp.float32)
+        lse = jax.scipy.special.logsumexp(logits, axis=-1)
+        picked = jnp.take_along_axis(
+            logits, jnp.clip(l_c, 0, V - 1)[:, None], axis=-1)[:, 0]
+        return (lse - picked) * k_c * scale
+
+    out = jax.lax.map(chunk_nll, (xs, ls, ks)).reshape(-1)
+    return out[:N].astype(jnp.float32)

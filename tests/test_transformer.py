@@ -169,3 +169,69 @@ def test_moe_transformer_trains():
             mod.update()
         ppl.append(metric.get()[1])
     assert ppl[-1] < ppl[0] * 0.8, ppl
+
+
+def test_chunked_loss_head_matches_dense():
+    """loss_chunk replaces FullyConnected+SoftmaxOutput with the fused
+    chunked-CE head (`_contrib_ChunkedSoftmaxCE`) whose live memory is
+    (chunk, V) instead of (B*T, V) — the 64k-token single-chip
+    enabler. Parameter gradients must be EXACTLY SoftmaxOutput's
+    (same scaling, same ignore handling), proven by running one
+    train step from identical inits under both heads, with a chunk
+    that does NOT divide B*T (pad rows must contribute nothing)."""
+    V, T, B = 50, 12, 3
+    rng = np.random.RandomState(0)
+    batch = {"data": rng.randint(0, V, (B, T)).astype(np.float32),
+             "softmax_label":
+                 rng.randint(-1, V, (B, T)).astype(np.float32)}
+    results = {}
+    for tag, kw in (("dense", {}), ("chunk", {"loss_chunk": 7})):
+        mx.random.seed(3)
+        sym = transformer.get_symbol(V, T, num_layers=1, num_heads=2,
+                                     dim=16, **kw)
+        st = make_train_step(sym, optimizer="sgd", donate=False)
+        state = st.init_state(mx.init.Xavier(),
+                              {"data": (B, T),
+                               "softmax_label": (B, T)})
+        new_state, outs = st(state, st.place_batch(batch), 0.1,
+                             jax.random.PRNGKey(0))
+        results[tag] = (
+            {k: np.asarray(jax.device_get(v))
+             for k, v in new_state[0].items()},
+            np.asarray(jax.device_get(outs[0])))
+    dense_p, _ = results["dense"]
+    chunk_p, loss = results["chunk"]
+    assert loss.shape == (B, T)
+    assert np.isfinite(loss).all()
+    # ignored positions carry exactly zero loss
+    ignored = batch["softmax_label"] == -1
+    assert np.abs(loss[ignored]).max() == 0.0
+    for k in dense_p:
+        np.testing.assert_allclose(
+            dense_p[k], chunk_p[k], rtol=2e-5, atol=2e-5,
+            err_msg="param %s diverged between heads" % k)
+
+
+def test_chunked_loss_op_values():
+    """Op-level: per-token values equal the explicit log-softmax NLL
+    with SoftmaxOutput's valid-normalization scaling."""
+    from mxnet_tpu.ops.registry import get_op
+    rng = np.random.RandomState(1)
+    N, D, V = 11, 8, 13
+    x = jnp.asarray(rng.randn(N, D), jnp.float32)
+    w = jnp.asarray(rng.randn(V, D), jnp.float32)
+    b = jnp.asarray(rng.randn(V), jnp.float32)
+    lab = rng.randint(-1, V, N).astype(np.float32)
+    out = get_op("_contrib_ChunkedSoftmaxCE").fn(
+        x, w, b, jnp.asarray(lab), chunk=4, use_ignore=True,
+        ignore_label=-1.0, normalization="valid")
+    logits = np.asarray(x) @ np.asarray(w).T + np.asarray(b)
+    lse = np.log(np.exp(logits - logits.max(-1, keepdims=True))
+                 .sum(-1)) + logits.max(-1)
+    valid = lab >= 0
+    want = np.zeros(N)
+    want[valid] = (lse[valid]
+                   - logits[valid, lab[valid].astype(int)]) \
+        / max(valid.sum(), 1)
+    np.testing.assert_allclose(np.asarray(out), want, rtol=1e-5,
+                               atol=1e-5)
