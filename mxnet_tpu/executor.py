@@ -183,6 +183,17 @@ class Executor:
         self._mesh = mesh
         self._monitor_callback = None
         self._monitor_all = False
+        # host-python ops (CustomOp -> jax pure_callback) cannot run on
+        # remote/tunneled accelerators; recorded structurally here so
+        # the runtime-failure rewrite below does not depend on the
+        # backend's error WORDING surviving upgrades
+        try:
+            import json as _json
+            self._has_host_callback_ops = any(
+                n.get("op") == "Custom"
+                for n in _json.loads(symbol.tojson())["nodes"])
+        except Exception:  # noqa: BLE001
+            self._has_host_callback_ops = False
 
         arg_names = symbol.list_arguments()
         aux_names = symbol.list_auxiliary_states()
@@ -367,18 +378,41 @@ class Executor:
         from . import profiler
 
         self._cached_grads = None
-        with profiler.scope("executor_forward%s" %
-                            ("_train" if is_train else ""), "executor"):
-            if self._monitor_active():
-                outs, new_aux = self._run_monitored(arg_vals, aux_vals,
-                                                    rng, bool(is_train))
-            elif is_train and self._grad_names and self._prefer_fused:
-                outs, new_aux, grads = self._jit_fwd_bwd(arg_vals,
-                                                         aux_vals, rng)
-                self._cached_grads = grads
-            else:
-                outs, new_aux = self._jit_fwd(arg_vals, aux_vals, rng,
-                                              bool(is_train))
+        try:
+            with profiler.scope("executor_forward%s" %
+                                ("_train" if is_train else ""),
+                                "executor"):
+                if self._monitor_active():
+                    outs, new_aux = self._run_monitored(
+                        arg_vals, aux_vals, rng, bool(is_train))
+                elif is_train and self._grad_names and \
+                        self._prefer_fused:
+                    outs, new_aux, grads = self._jit_fwd_bwd(
+                        arg_vals, aux_vals, rng)
+                    self._cached_grads = grads
+                else:
+                    outs, new_aux = self._jit_fwd(arg_vals, aux_vals,
+                                                  rng, bool(is_train))
+        except Exception as e:  # noqa: BLE001
+            if "host send/recv callbacks" in str(e) or (
+                    self._has_host_callback_ops
+                    and "UNIMPLEMENTED" in str(e)):
+                # remote/tunneled accelerator backends (axon) cannot
+                # run jax host callbacks, which is how CustomOp /
+                # _contrib_* python ops execute their host python.
+                # Surface what the user can act on instead of a bare
+                # UNIMPLEMENTED from deep inside the runtime. (The
+                # structural _has_host_callback_ops arm keeps this
+                # working if the backend rewords its message.)
+                raise RuntimeError(
+                    "this graph contains a host-python op (CustomOp / "
+                    "pure_callback) but the active backend %r cannot "
+                    "run host callbacks (remote/tunneled accelerator). "
+                    "Run custom-op graphs on a host-attached backend — "
+                    "e.g. JAX_PLATFORMS=cpu for development, or a "
+                    "co-located TPU host in production." %
+                    jax.default_backend()) from e
+            raise
         if is_train:
             for n, a in zip(self._aux_names, self.aux_arrays):
                 a._set_data(new_aux[n])

@@ -163,3 +163,26 @@ class TestRegistry:
 
         with pytest.raises(NotImplementedError):
             mx.nd.Custom(mx.nd.zeros((2,)), op_type="test_auxful")
+
+
+def test_host_callback_failure_is_actionable(monkeypatch):
+    """Remote/tunneled backends (axon) cannot run pure_callback; the
+    executor must rewrite the runtime's bare UNIMPLEMENTED into an
+    error naming the cause and the fix. Guarded structurally
+    (graph-contains-Custom + UNIMPLEMENTED) so a backend rewording
+    the message does not silently lose the rewrite — simulated here
+    by making the jitted call raise the reworded form."""
+    data = mx.sym.Variable("data")
+    net = mx.sym.Custom(data, op_type="test_scale",
+                        factor="2.0", name="sc")
+    exe = net.simple_bind(ctx=mx.cpu(), data=(2, 3),
+                          grad_req="null")
+
+    def boom(*a, **k):
+        raise RuntimeError(
+            "UNIMPLEMENTED: Send/recv callbacks not supported")
+
+    monkeypatch.setattr(exe, "_jit_fwd", boom)
+    with pytest.raises(RuntimeError, match="host-attached backend"):
+        exe.forward(is_train=False,
+                    data=mx.nd.zeros((2, 3)))
