@@ -396,7 +396,8 @@ class Executor:
         except Exception as e:  # noqa: BLE001
             if "host send/recv callbacks" in str(e) or (
                     self._has_host_callback_ops
-                    and "UNIMPLEMENTED" in str(e)):
+                    and "UNIMPLEMENTED" in str(e)
+                    and "callback" in str(e).lower()):
                 # remote/tunneled accelerator backends (axon) cannot
                 # run jax host callbacks, which is how CustomOp /
                 # _contrib_* python ops execute their host python.

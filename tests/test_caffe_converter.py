@@ -1,0 +1,252 @@
+"""Caffe converter: prototxt + caffemodel -> symbol + params.
+
+The .caffemodel in these tests is ENCODED BY HAND with a ~30-line
+protobuf wire-format writer, so the test needs neither caffe nor
+compiled bindings — it exercises the converter's real binary path
+(varint fields, packed float blobs, BlobShape and legacy NCHW dims,
+BatchNorm scale_factor semantics, the BatchNorm+Scale fusion) against
+a numpy forward reference.
+"""
+import struct
+import sys
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+
+sys.path.insert(0, __file__.rsplit("/", 2)[0] + "/tools")
+import caffe_converter  # noqa: E402
+
+
+# ---- minimal protobuf wire writer -----------------------------------------
+
+def _v(n):
+    out = b""
+    while True:
+        b = n & 0x7F
+        n >>= 7
+        out += bytes([b | (0x80 if n else 0)])
+        if not n:
+            return out
+
+
+def _ld(field, payload):
+    return _v((field << 3) | 2) + _v(len(payload)) + payload
+
+
+def _varint_field(field, val):
+    return _v(field << 3) + _v(val)
+
+
+def _blob(arr, legacy=False):
+    arr = np.asarray(arr, "<f4")
+    data = _ld(5, arr.tobytes())           # packed floats
+    if legacy:
+        dims = list(arr.shape)
+        dims = [1] * (4 - len(dims)) + dims
+        shape = b"".join(_varint_field(f, d)
+                         for f, d in zip((1, 2, 3, 4), dims))
+        return shape + data
+    shape = _ld(7, b"".join(_varint_field(1, d) for d in arr.shape))
+    return shape + data
+
+
+def _layer(name, ltype, blobs=(), legacy_blob=False):
+    msg = _ld(1, name.encode()) + _ld(2, ltype.encode())
+    for b in blobs:
+        msg += _ld(7, _blob(b, legacy=legacy_blob))
+    return msg
+
+
+def _net(layers):
+    return b"".join(_ld(100, l) for l in layers)
+
+
+# ---- the network under test -----------------------------------------------
+
+PROTOTXT = """
+name: "tiny"  # comment survives tokenizer
+input: "data"
+layer { name: "conv1" type: "Convolution" bottom: "data" top: "conv1"
+  convolution_param { num_output: 4 kernel_size: 3 pad: 1 stride: 1 } }
+layer { name: "bn1" type: "BatchNorm" bottom: "conv1" top: "bn1" }
+layer { name: "scale1" type: "Scale" bottom: "bn1" top: "bn1"
+  scale_param { bias_term: true } }
+layer { name: "relu1" type: "ReLU" bottom: "bn1" top: "bn1" }
+layer { name: "pool1" type: "Pooling" bottom: "bn1" top: "pool1"
+  pooling_param { pool: MAX kernel_size: 2 stride: 2 } }
+layer { name: "fc" type: "InnerProduct" bottom: "pool1" top: "fc"
+  inner_product_param { num_output: 5 } }
+layer { name: "prob" type: "Softmax" bottom: "fc" top: "prob" }
+"""
+
+
+def _weights(rng):
+    w = {
+        "conv1_w": rng.randn(4, 3, 3, 3).astype(np.float32) * 0.3,
+        "conv1_b": rng.randn(4).astype(np.float32) * 0.1,
+        "bn_mean": rng.randn(4).astype(np.float32) * 0.2,
+        "bn_var": rng.rand(4).astype(np.float32) + 0.5,
+        "gamma": rng.rand(4).astype(np.float32) + 0.5,
+        "beta": rng.randn(4).astype(np.float32) * 0.1,
+    }
+    w["fc_w"] = rng.randn(5, 4 * 4 * 4).astype(np.float32) * 0.2
+    w["fc_b"] = rng.randn(5).astype(np.float32) * 0.1
+    return w
+
+
+def _caffemodel(w, scale_factor=2.0, legacy_blob=False):
+    # caffe stores UNSCALED accumulators: blob/scale_factor = stats
+    return _net([
+        _layer("conv1", "Convolution",
+               [w["conv1_w"], w["conv1_b"]], legacy_blob),
+        _layer("bn1", "BatchNorm",
+               [w["bn_mean"] * scale_factor, w["bn_var"] * scale_factor,
+                np.array([scale_factor], np.float32)]),
+        _layer("scale1", "Scale", [w["gamma"], w["beta"]]),
+        _layer("fc", "InnerProduct", [w["fc_w"], w["fc_b"]]),
+    ])
+
+
+def _numpy_forward(w, x):
+    N, _, H, W = x.shape
+    xp = np.pad(x, ((0, 0), (0, 0), (1, 1), (1, 1)))
+    conv = np.zeros((N, 4, H, W), np.float32)
+    for i in range(H):
+        for j in range(W):
+            patch = xp[:, :, i:i + 3, j:j + 3].reshape(N, -1)
+            conv[:, :, i, j] = patch @ w["conv1_w"].reshape(4, -1).T
+    conv += w["conv1_b"][None, :, None, None]
+    bn = (conv - w["bn_mean"][None, :, None, None]) / np.sqrt(
+        w["bn_var"][None, :, None, None] + 1e-5)
+    bn = bn * w["gamma"][None, :, None, None] \
+        + w["beta"][None, :, None, None]
+    relu = np.maximum(bn, 0)
+    pooled = relu.reshape(N, 4, H // 2, 2, W // 2, 2).max((3, 5))
+    logits = pooled.reshape(N, -1) @ w["fc_w"].T + w["fc_b"]
+    e = np.exp(logits - logits.max(-1, keepdims=True))
+    return e / e.sum(-1, keepdims=True)
+
+
+@pytest.mark.parametrize("legacy_blob", [False, True])
+def test_convert_matches_numpy(tmp_path, legacy_blob):
+    rng = np.random.RandomState(0)
+    w = _weights(rng)
+    proto = tmp_path / "net.prototxt"
+    proto.write_text(PROTOTXT)
+    model = tmp_path / "net.caffemodel"
+    model.write_bytes(_caffemodel(w, legacy_blob=legacy_blob))
+
+    sym, arg_params, aux_params = caffe_converter.convert(
+        str(proto), str(model))
+    assert set(arg_params) == {"conv1_weight", "conv1_bias",
+                               "bn1_gamma", "bn1_beta",
+                               "fc_weight", "fc_bias"}
+    assert set(aux_params) == {"bn1_moving_mean", "bn1_moving_var"}
+
+    x = rng.randn(2, 3, 8, 8).astype(np.float32)
+    mod = mx.mod.Module(sym, data_names=("data",),
+                        label_names=("softmax_label",),
+                        context=mx.cpu())
+    mod.bind(data_shapes=[("data", x.shape)], label_shapes=None,
+             for_training=False)
+    mod.set_params(arg_params, aux_params)
+    from mxnet_tpu import io
+    mod.forward(io.DataBatch(data=[mx.nd.array(x)]), is_train=False)
+    got = mod.get_outputs()[0].asnumpy()
+    np.testing.assert_allclose(got, _numpy_forward(w, x),
+                               rtol=2e-4, atol=2e-4)
+
+
+def test_checkpoint_roundtrip(tmp_path):
+    """CLI path: converted checkpoint loads via load_checkpoint."""
+    rng = np.random.RandomState(1)
+    w = _weights(rng)
+    proto = tmp_path / "net.prototxt"
+    proto.write_text(PROTOTXT)
+    model = tmp_path / "net.caffemodel"
+    model.write_bytes(_caffemodel(w))
+    prefix = str(tmp_path / "converted")
+    caffe_converter.main(["caffe_converter.py", str(proto),
+                          str(model), prefix])
+    sym, arg_params, aux_params = mx.model.load_checkpoint(prefix, 0)
+    assert "conv1_weight" in arg_params
+    assert "bn1_moving_mean" in aux_params
+    assert "softmax_output" in sym.list_outputs()
+
+
+def test_unsupported_layer_is_loud(tmp_path):
+    proto = tmp_path / "net.prototxt"
+    proto.write_text('input: "data"\n'
+                     'layer { name: "x" type: "Crazy" '
+                     'bottom: "data" top: "x" }\n')
+    with pytest.raises(NotImplementedError, match="Crazy"):
+        caffe_converter.convert(str(proto), None)
+
+
+def test_train_prototxt_with_label_top_and_lrn(tmp_path):
+    """The TRAIN prototxt shape: a multi-top Data layer
+    (top: "data" top: "label"), SoftmaxWithLoss consuming the label
+    bottom, an Accuracy tail that must not dangle, and an LRN layer
+    whose k parameter must reach the op (caffe k=1 vs the framework
+    default knorm=2 — silently wrong activations if dropped)."""
+    proto = tmp_path / "train.prototxt"
+    proto.write_text("""
+layer { name: "input" type: "Data" top: "data" top: "label" }
+layer { name: "conv1" type: "Convolution" bottom: "data" top: "conv1"
+  convolution_param { num_output: 4 kernel_size: 3 pad: 1 } }
+layer { name: "lrn1" type: "LRN" bottom: "conv1" top: "lrn1"
+  lrn_param { local_size: 3 alpha: 0.1 beta: 0.75 k: 1.0 } }
+layer { name: "fc" type: "InnerProduct" bottom: "lrn1" top: "fc"
+  inner_product_param { num_output: 5 } }
+layer { name: "loss" type: "SoftmaxWithLoss" bottom: "fc"
+  bottom: "label" top: "loss" }
+layer { name: "acc" type: "Accuracy" bottom: "fc" bottom: "label"
+  top: "acc" }
+""")
+    rng = np.random.RandomState(2)
+    w = {"conv1_w": rng.randn(4, 3, 3, 3).astype(np.float32) * 0.3,
+         "conv1_b": rng.randn(4).astype(np.float32) * 0.1,
+         "fc_w": rng.randn(5, 4 * 6 * 6).astype(np.float32) * 0.2,
+         "fc_b": rng.randn(5).astype(np.float32) * 0.1}
+    model = tmp_path / "train.caffemodel"
+    model.write_bytes(_net([
+        _layer("conv1", "Convolution", [w["conv1_w"], w["conv1_b"]]),
+        _layer("fc", "InnerProduct", [w["fc_w"], w["fc_b"]]),
+    ]))
+    sym, arg_params, aux_params = caffe_converter.convert(
+        str(proto), str(model))
+    # the label bottom becomes the loss's label input, not a param
+    assert "label" in sym.list_arguments()
+    assert "label" not in arg_params
+
+    x = rng.randn(2, 3, 6, 6).astype(np.float32)
+    mod = mx.mod.Module(sym, data_names=("data",),
+                        label_names=("label",), context=mx.cpu())
+    mod.bind(data_shapes=[("data", x.shape)], label_shapes=None,
+             for_training=False)
+    mod.set_params(arg_params, aux_params)
+    from mxnet_tpu import io
+    mod.forward(io.DataBatch(data=[mx.nd.array(x)]), is_train=False)
+    got = mod.get_outputs()[0].asnumpy()
+
+    # numpy reference incl. caffe LRN (k=1, across channels)
+    xp = np.pad(x, ((0, 0), (0, 0), (1, 1), (1, 1)))
+    conv = np.zeros((2, 4, 6, 6), np.float32)
+    for i in range(6):
+        for j in range(6):
+            patch = xp[:, :, i:i + 3, j:j + 3].reshape(2, -1)
+            conv[:, :, i, j] = patch @ w["conv1_w"].reshape(4, -1).T
+    conv += w["conv1_b"][None, :, None, None]
+    sq = conv ** 2
+    n = 3
+    den = np.zeros_like(conv)
+    for c in range(4):
+        lo, hi = max(0, c - n // 2), min(4, c + n // 2 + 1)
+        den[:, c] = sq[:, lo:hi].sum(1)
+    lrn = conv / (1.0 + (0.1 / n) * den) ** 0.75
+    logits = lrn.reshape(2, -1) @ w["fc_w"].T + w["fc_b"]
+    e = np.exp(logits - logits.max(-1, keepdims=True))
+    want = e / e.sum(-1, keepdims=True)
+    np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-4)

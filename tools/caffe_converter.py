@@ -1,0 +1,431 @@
+"""Convert Caffe models (.prototxt + .caffemodel) to this framework's
+checkpoint format (symbol.json + .params).
+
+Reference seam: tools/caffe_converter/ (convert_model.py /
+caffe_parser.py / convert_symbol.py). The reference shells out to
+caffe's generated protobuf bindings; here the .caffemodel is read with
+a ~60-line protobuf WIRE-FORMAT walker (varint / length-delimited
+field iteration against the well-known NetParameter field numbers), so
+the converter needs neither caffe nor a compiled caffe.proto — it runs
+in this repo's environment as-is.
+
+Supported layer types (the classic-CNN vocabulary the reference's
+converter handled): Convolution, InnerProduct, Pooling, ReLU, LRN,
+Dropout, Softmax/SoftmaxWithLoss, BatchNorm (+ its paired Scale),
+Eltwise (sum), Concat, Flatten, Input/Data. BatchNorm follows caffe's
+split convention: the BatchNorm layer's blobs are (mean, var,
+scale_factor) and the FOLLOWING Scale layer carries (gamma, beta);
+they fuse into one framework BatchNorm node.
+
+Usage:
+    python tools/caffe_converter.py net.prototxt net.caffemodel out
+    # writes out-symbol.json and out-0000.params; load with
+    # mx.model.load_checkpoint("out", 0)
+"""
+from __future__ import annotations
+
+import struct
+import sys
+
+
+# ---------------------------------------------------------------------------
+# protobuf wire format (the subset caffemodel files use)
+# ---------------------------------------------------------------------------
+
+def _varint(buf, i):
+    shift = 0
+    val = 0
+    while True:
+        b = buf[i]
+        i += 1
+        val |= (b & 0x7F) << shift
+        if not b & 0x80:
+            return val, i
+        shift += 7
+
+
+def _fields(buf):
+    """Yield (field_no, wire_type, payload) over a message buffer.
+    payload: int for varint/fixed, memoryview for length-delimited."""
+    i, n = 0, len(buf)
+    while i < n:
+        key, i = _varint(buf, i)
+        field, wt = key >> 3, key & 7
+        if wt == 0:                       # varint
+            val, i = _varint(buf, i)
+            yield field, wt, val
+        elif wt == 1:                     # fixed64
+            yield field, wt, struct.unpack_from("<q", buf, i)[0]
+            i += 8
+        elif wt == 2:                     # length-delimited
+            ln, i = _varint(buf, i)
+            yield field, wt, memoryview(buf)[i:i + ln]
+            i += ln
+        elif wt == 5:                     # fixed32
+            yield field, wt, struct.unpack_from("<i", buf, i)[0]
+            i += 4
+        else:
+            raise ValueError("unsupported wire type %d" % wt)
+
+
+def _floats(payload, packed):
+    import numpy as np
+    if packed:
+        return np.frombuffer(bytes(payload), "<f4")
+    return np.array([struct.unpack("<f", struct.pack("<i", payload))[0]],
+                    "<f4")
+
+
+def _parse_blob(buf):
+    """BlobProto: data=5 (packed float), shape=7 {dim=1}, legacy
+    num/channels/height/width = 1/2/3/4."""
+    import numpy as np
+    data, shape, legacy = [], [], {}
+    for f, wt, v in _fields(buf):
+        if f == 5:
+            data.append(_floats(v, wt == 2))
+        elif f == 7 and wt == 2:
+            shape = [val for ff, _, val in _fields(v) if ff == 1]
+        elif f in (1, 2, 3, 4) and wt == 0:
+            legacy[f] = v
+    arr = np.concatenate(data) if data else np.zeros((0,), "<f4")
+    if not shape and legacy:
+        shape = [legacy.get(k, 1) for k in (1, 2, 3, 4)]
+        while len(shape) > 1 and shape[0] == 1:
+            shape = shape[1:]
+    return arr.reshape(shape) if shape else arr
+
+
+def _parse_layer(buf):
+    """LayerParameter: name=1, type=2 (string; V1 uses enum), blobs=7."""
+    out = {"name": None, "type": None, "blobs": []}
+    for f, wt, v in _fields(buf):
+        if f == 1 and wt == 2:
+            out["name"] = bytes(v).decode()
+        elif f == 2 and wt == 2:
+            out["type"] = bytes(v).decode()
+        elif f == 7 and wt == 2:
+            out["blobs"].append(_parse_blob(v))
+    return out
+
+
+def parse_caffemodel(path):
+    """-> list of {name, type, blobs} for layers that carry weights."""
+    with open(path, "rb") as f:
+        buf = f.read()
+    layers = []
+    for field, wt, v in _fields(buf):
+        if field == 100 and wt == 2:          # layer (new format)
+            layers.append(_parse_layer(v))
+        elif field == 2 and wt == 2:          # layers (V1 format)
+            lay = _parse_layer(v)
+            if lay["name"] is not None:
+                layers.append(lay)
+    return [l for l in layers if l["blobs"]]
+
+
+# ---------------------------------------------------------------------------
+# prototxt (protobuf text format, the subset net definitions use)
+# ---------------------------------------------------------------------------
+
+def _tokenize(text):
+    out, i, n = [], 0, len(text)
+    while i < n:
+        c = text[i]
+        if c in " \t\r\n":
+            i += 1
+        elif c == "#":
+            while i < n and text[i] != "\n":
+                i += 1
+        elif c in "{}:":
+            out.append(c)
+            i += 1
+        elif c in "\"'":
+            j = text.index(c, i + 1)
+            out.append(("str", text[i + 1:j]))
+            i = j + 1
+        else:
+            j = i
+            while j < n and text[j] not in " \t\r\n{}:#\"'":
+                j += 1
+            out.append(("tok", text[i:j]))
+            i = j
+    return out
+
+
+def _parse_block(toks, i=0):
+    """-> (dict-of-lists, next_index); nested blocks recurse."""
+    out = {}
+    while i < len(toks):
+        t = toks[i]
+        if t == "}":
+            return out, i + 1
+        key = t[1]
+        i += 1
+        if toks[i] == ":":
+            i += 1
+            val = toks[i][1]
+            i += 1
+            if toks[i - 1][0] == "tok":
+                low = val.lower()
+                if low in ("true", "false"):
+                    val = low == "true"
+                else:
+                    try:
+                        val = int(val)
+                    except ValueError:
+                        try:
+                            val = float(val)
+                        except ValueError:
+                            pass
+        elif toks[i] == "{":
+            val, i = _parse_block(toks, i + 1)
+        else:
+            raise ValueError("expected ':' or '{' after %r" % key)
+        out.setdefault(key, []).append(val)
+    return out, i
+
+
+def parse_prototxt(path):
+    with open(path) as f:
+        net, _ = _parse_block(_tokenize(f.read()))
+    return net
+
+
+def _one(d, key, default=None):
+    v = d.get(key)
+    return v[0] if v else default
+
+
+def _pair(param, key, default=0):
+    """caffe kernel_size/pad/stride may repeat (h, w) or appear as
+    *_h/*_w; normalize to a (h, w) tuple."""
+    vals = param.get(key)
+    if vals:
+        return (vals[0], vals[-1]) if len(vals) > 1 \
+            else (vals[0], vals[0])
+    h = _one(param, key + "_h")
+    w = _one(param, key + "_w")
+    if h is not None or w is not None:
+        return (h or default, w or default)
+    return (default, default)
+
+
+# ---------------------------------------------------------------------------
+# symbol construction + weight mapping
+# ---------------------------------------------------------------------------
+
+def convert(prototxt, caffemodel=None):
+    """-> (symbol, arg_params, aux_params) — framework-native objects.
+
+    Layer name == our node name, so caffe blob k of layer L lands in
+    the parameter the symbol names (L_weight, L_bias, L_gamma, ...).
+    """
+    import numpy as np
+
+    import mxnet_tpu as mx
+
+    net = parse_prototxt(prototxt)
+    weights = {l["name"]: l for l in
+               parse_caffemodel(caffemodel)} if caffemodel else {}
+
+    tops = {}                       # caffe top name -> symbol
+    arg_params, aux_params = {}, {}
+    layers = net.get("layer") or net.get("layers") or []
+    # caffe pairs BatchNorm with a following Scale layer; fuse them
+    pending_bn = {}                 # top -> (name, mean, var, in, eps)
+    n_softmax = sum(1 for l in layers
+                    if _one(l, "type") in ("Softmax",
+                                           "SoftmaxWithLoss"))
+    last_syms = []                  # output heads, in layer order
+
+    def blob(lname, idx):
+        lay = weights.get(lname)
+        if lay is None or idx >= len(lay["blobs"]):
+            return None
+        return np.asarray(lay["blobs"][idx])
+
+    # net-level inputs (input: "data" / input_shape or input_dim)
+    for iname in net.get("input", []):
+        tops[iname] = mx.sym.Variable(iname)
+
+    for lay in layers:
+        ltype = _one(lay, "type")
+        name = _one(lay, "name")
+        bottoms = [tops[b] for b in lay.get("bottom", [])]
+        top = _one(lay, "top", name)
+
+        if ltype in ("Input", "Data"):
+            # train-prototxt Data layers declare BOTH tops
+            # (top: "data" top: "label"); register every one
+            for t in lay.get("top", [name]):
+                tops[t] = mx.sym.Variable(t)
+            continue
+        if ltype == "Convolution":
+            p = _one(lay, "convolution_param", {})
+            kh, kw = _pair(p, "kernel_size")
+            sh, sw = _pair(p, "stride", 1)
+            ph, pw = _pair(p, "pad", 0)
+            nf = _one(p, "num_output")
+            nobias = not _one(p, "bias_term", True)
+            group = _one(p, "group", 1)
+            sym = mx.sym.Convolution(
+                bottoms[0], num_filter=nf, kernel=(kh, kw),
+                stride=(sh, sw), pad=(ph, pw), no_bias=nobias,
+                num_group=group, name=name)
+            w = blob(name, 0)
+            if w is not None:
+                arg_params["%s_weight" % name] = mx.nd.array(w)
+            b = blob(name, 1)
+            if b is not None and not nobias:
+                arg_params["%s_bias" % name] = mx.nd.array(
+                    b.reshape(-1))
+        elif ltype == "InnerProduct":
+            p = _one(lay, "inner_product_param", {})
+            nh = _one(p, "num_output")
+            nobias = not _one(p, "bias_term", True)
+            sym = mx.sym.FullyConnected(
+                mx.sym.Flatten(bottoms[0]), num_hidden=nh,
+                no_bias=nobias, name=name)
+            w = blob(name, 0)
+            if w is not None:
+                arg_params["%s_weight" % name] = mx.nd.array(
+                    w.reshape(nh, -1))
+            b = blob(name, 1)
+            if b is not None and not nobias:
+                arg_params["%s_bias" % name] = mx.nd.array(
+                    b.reshape(-1))
+        elif ltype == "Pooling":
+            p = _one(lay, "pooling_param", {})
+            global_pool = bool(_one(p, "global_pooling", False))
+            kh, kw = _pair(p, "kernel_size")
+            sh, sw = _pair(p, "stride", 1)
+            ph, pw = _pair(p, "pad", 0)
+            # caffe pool enum/string: 0/MAX, 1/AVE
+            pt = _one(p, "pool", 0)
+            pool_type = "avg" if pt in (1, "AVE") else "max"
+            sym = mx.sym.Pooling(
+                bottoms[0], kernel=(kh or 1, kw or 1),
+                stride=(sh, sw), pad=(ph, pw), pool_type=pool_type,
+                global_pool=global_pool,
+                pooling_convention="full", name=name)
+        elif ltype == "ReLU":
+            sym = mx.sym.Activation(bottoms[0], act_type="relu",
+                                    name=name)
+        elif ltype == "LRN":
+            p = _one(lay, "lrn_param", {})
+            sym = mx.sym.LRN(
+                bottoms[0], nsize=_one(p, "local_size", 5),
+                alpha=_one(p, "alpha", 1e-4),
+                beta=_one(p, "beta", 0.75),
+                knorm=_one(p, "k", 1.0), name=name)
+        elif ltype == "Dropout":
+            p = _one(lay, "dropout_param", {})
+            sym = mx.sym.Dropout(
+                bottoms[0], p=_one(p, "dropout_ratio", 0.5),
+                name=name)
+        elif ltype == "BatchNorm":
+            bn_p = _one(lay, "batch_norm_param", {})
+            bn_eps = _one(bn_p, "eps", 1e-5)
+            mean, var = blob(name, 0), blob(name, 1)
+            sf = blob(name, 2)
+            if mean is not None and sf is not None and sf.size:
+                # caffe stores UNSCALED accumulators
+                scale = 1.0 / sf.reshape(-1)[0] if sf.reshape(-1)[0] \
+                    else 0.0
+                mean, var = mean * scale, var * scale
+            pending_bn[top] = (name, mean, var, bottoms[0], bn_eps)
+            tops[top] = bottoms[0]     # placeholder until Scale fuses
+            continue
+        elif ltype == "Scale":
+            src = lay.get("bottom", [None])[0]
+            if src in pending_bn:
+                bn_name, mean, var, bn_in, bn_eps = \
+                    pending_bn.pop(src)
+                sym = mx.sym.BatchNorm(bn_in, eps=bn_eps,
+                                       fix_gamma=False,
+                                       use_global_stats=True,
+                                       name=bn_name)
+                if mean is not None:
+                    aux_params["%s_moving_mean" % bn_name] = \
+                        mx.nd.array(mean.reshape(-1))
+                    aux_params["%s_moving_var" % bn_name] = \
+                        mx.nd.array(var.reshape(-1))
+                g, b = blob(name, 0), blob(name, 1)
+                if g is not None:
+                    arg_params["%s_gamma" % bn_name] = mx.nd.array(
+                        g.reshape(-1))
+                if b is not None:
+                    arg_params["%s_beta" % bn_name] = mx.nd.array(
+                        b.reshape(-1))
+            else:
+                raise NotImplementedError(
+                    "standalone Scale layer %r (only the "
+                    "BatchNorm+Scale pair is supported)" % name)
+        elif ltype == "Eltwise":
+            p = _one(lay, "eltwise_param", {})
+            op = _one(p, "operation", 1)
+            if op not in (1, "SUM"):
+                raise NotImplementedError(
+                    "Eltwise operation %r (only SUM)" % op)
+            sym = bottoms[0]
+            for b in bottoms[1:]:
+                sym = sym + b
+        elif ltype == "Concat":
+            p = _one(lay, "concat_param", {})
+            sym = mx.sym.Concat(*bottoms,
+                                dim=_one(p, "axis", 1), name=name)
+        elif ltype == "Flatten":
+            sym = mx.sym.Flatten(bottoms[0], name=name)
+        elif ltype in ("Softmax", "SoftmaxWithLoss"):
+            # single-head nets keep the conventional "softmax" name
+            # (so softmax_label matches Module defaults); multi-loss
+            # nets (GoogLeNet's three heads) keep their caffe names
+            # to avoid node collisions
+            sname = "softmax" if n_softmax == 1 else name
+            if len(bottoms) > 1:       # explicit label bottom
+                sym = mx.sym.SoftmaxOutput(bottoms[0], bottoms[1],
+                                           name=sname)
+            else:
+                sym = mx.sym.SoftmaxOutput(bottoms[0], name=sname)
+        elif ltype in ("Accuracy",):
+            continue
+        else:
+            raise NotImplementedError(
+                "caffe layer type %r (layer %r) has no converter"
+                % (ltype, name))
+        tops[top] = sym
+        # the net's output = the last symbol actually PRODUCED (an
+        # Accuracy/Data tail or a BN awaiting its Scale must not
+        # dangle); multi-head nets group every loss head
+        if ltype in ("Softmax", "SoftmaxWithLoss"):
+            last_syms.append(sym)
+        last_produced = sym
+
+    if pending_bn:
+        raise ValueError("BatchNorm layer(s) %r have no paired Scale"
+                         % [v[0] for v in pending_bn.values()])
+    if last_syms:
+        out = last_syms[0] if len(last_syms) == 1 \
+            else mx.sym.Group(last_syms)
+    else:
+        try:
+            out = last_produced
+        except UnboundLocalError:
+            raise ValueError("prototxt produced no layers")
+    return out, arg_params, aux_params
+
+
+def main(argv):
+    if len(argv) != 4:
+        raise SystemExit("usage: caffe_converter.py net.prototxt "
+                         "net.caffemodel out_prefix")
+    import mxnet_tpu as mx
+    sym, arg_params, aux_params = convert(argv[1], argv[2])
+    mx.model.save_checkpoint(argv[3], 0, sym, arg_params, aux_params)
+    print("wrote %s-symbol.json / %s-0000.params (%d args, %d aux)"
+          % (argv[3], argv[3], len(arg_params), len(aux_params)))
+
+
+if __name__ == "__main__":
+    main(sys.argv)
