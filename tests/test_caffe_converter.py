@@ -7,7 +7,6 @@ compiled bindings — it exercises the converter's real binary path
 BatchNorm scale_factor semantics, the BatchNorm+Scale fusion) against
 a numpy forward reference.
 """
-import struct
 import sys
 
 import numpy as np
