@@ -108,15 +108,22 @@ def _caffemodel(w, scale_factor=2.0, legacy_blob=False):
     ])
 
 
-def _numpy_forward(w, x):
+def _ref_conv3x3(x, kw, kb):
+    """Naive 3x3/pad-1/stride-1 conv, the shared numpy reference."""
+    C_out = kw.shape[0]
     N, _, H, W = x.shape
     xp = np.pad(x, ((0, 0), (0, 0), (1, 1), (1, 1)))
-    conv = np.zeros((N, 4, H, W), np.float32)
+    out = np.zeros((N, C_out, H, W), np.float32)
     for i in range(H):
         for j in range(W):
             patch = xp[:, :, i:i + 3, j:j + 3].reshape(N, -1)
-            conv[:, :, i, j] = patch @ w["conv1_w"].reshape(4, -1).T
-    conv += w["conv1_b"][None, :, None, None]
+            out[:, :, i, j] = patch @ kw.reshape(C_out, -1).T
+    return out + kb[None, :, None, None]
+
+
+def _numpy_forward(w, x):
+    N, _, H, W = x.shape
+    conv = _ref_conv3x3(x, w["conv1_w"], w["conv1_b"])
     bn = (conv - w["bn_mean"][None, :, None, None]) / np.sqrt(
         w["bn_var"][None, :, None, None] + 1e-5)
     bn = bn * w["gamma"][None, :, None, None] \
@@ -231,13 +238,7 @@ layer { name: "acc" type: "Accuracy" bottom: "fc" bottom: "label"
     got = mod.get_outputs()[0].asnumpy()
 
     # numpy reference incl. caffe LRN (k=1, across channels)
-    xp = np.pad(x, ((0, 0), (0, 0), (1, 1), (1, 1)))
-    conv = np.zeros((2, 4, 6, 6), np.float32)
-    for i in range(6):
-        for j in range(6):
-            patch = xp[:, :, i:i + 3, j:j + 3].reshape(2, -1)
-            conv[:, :, i, j] = patch @ w["conv1_w"].reshape(4, -1).T
-    conv += w["conv1_b"][None, :, None, None]
+    conv = _ref_conv3x3(x, w["conv1_w"], w["conv1_b"])
     sq = conv ** 2
     n = 3
     den = np.zeros_like(conv)
@@ -249,3 +250,58 @@ layer { name: "acc" type: "Accuracy" bottom: "fc" bottom: "label"
     e = np.exp(logits - logits.max(-1, keepdims=True))
     want = e / e.sum(-1, keepdims=True)
     np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-4)
+
+
+def test_resnet_style_eltwise_and_global_pool(tmp_path):
+    """Residual nets: Eltwise SUM joins two branches, global average
+    pooling feeds the classifier — the converter must wire both (and
+    a branch that reuses a bottom twice must not double-register)."""
+    proto = tmp_path / "res.prototxt"
+    proto.write_text("""
+input: "data"
+layer { name: "conv1" type: "Convolution" bottom: "data" top: "conv1"
+  convolution_param { num_output: 3 kernel_size: 3 pad: 1 } }
+layer { name: "conv2" type: "Convolution" bottom: "conv1" top: "conv2"
+  convolution_param { num_output: 3 kernel_size: 3 pad: 1 } }
+layer { name: "sum" type: "Eltwise" bottom: "conv1" bottom: "conv2"
+  top: "sum" eltwise_param { operation: SUM } }
+layer { name: "gap" type: "Pooling" bottom: "sum" top: "gap"
+  pooling_param { pool: AVE global_pooling: true } }
+layer { name: "fc" type: "InnerProduct" bottom: "gap" top: "fc"
+  inner_product_param { num_output: 4 } }
+layer { name: "prob" type: "Softmax" bottom: "fc" top: "prob" }
+""")
+    rng = np.random.RandomState(3)
+    w = {"c1": rng.randn(3, 3, 3, 3).astype(np.float32) * 0.3,
+         "b1": rng.randn(3).astype(np.float32) * 0.1,
+         "c2": rng.randn(3, 3, 3, 3).astype(np.float32) * 0.3,
+         "b2": rng.randn(3).astype(np.float32) * 0.1,
+         "fw": rng.randn(4, 3).astype(np.float32),
+         "fb": rng.randn(4).astype(np.float32)}
+    model = tmp_path / "res.caffemodel"
+    model.write_bytes(_net([
+        _layer("conv1", "Convolution", [w["c1"], w["b1"]]),
+        _layer("conv2", "Convolution", [w["c2"], w["b2"]]),
+        _layer("fc", "InnerProduct", [w["fw"], w["fb"]]),
+    ]))
+    sym, arg_params, aux_params = caffe_converter.convert(
+        str(proto), str(model))
+
+    x = rng.randn(2, 3, 5, 5).astype(np.float32)
+    mod = mx.mod.Module(sym, data_names=("data",),
+                        label_names=("softmax_label",),
+                        context=mx.cpu())
+    mod.bind(data_shapes=[("data", x.shape)], label_shapes=None,
+             for_training=False)
+    mod.set_params(arg_params, aux_params)
+    from mxnet_tpu import io
+    mod.forward(io.DataBatch(data=[mx.nd.array(x)]), is_train=False)
+    got = mod.get_outputs()[0].asnumpy()
+
+    c1 = _ref_conv3x3(x, w["c1"], w["b1"])
+    s = c1 + _ref_conv3x3(c1, w["c2"], w["b2"])
+    gap = s.mean((2, 3))
+    logits = gap @ w["fw"].T + w["fb"]
+    e = np.exp(logits - logits.max(-1, keepdims=True))
+    np.testing.assert_allclose(got, e / e.sum(-1, keepdims=True),
+                               rtol=2e-4, atol=2e-4)
