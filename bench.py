@@ -99,11 +99,14 @@ def _last_known(metric):
         rel = os.path.relpath(path, here)
         try:
             r = subprocess.run(
-                ["git", "log", "-1", "--format=%h %cI", "--", rel],
+                ["git", "log", "-1", "--format=%h %ct %cI", "--", rel],
                 cwd=here, capture_output=True, text=True, timeout=10)
             if r.returncode != 0 or not r.stdout.strip():
                 continue   # untracked: not a committed capture
-            commit, date = r.stdout.strip().split(None, 1)
+            commit, epoch, date = r.stdout.strip().split(None, 2)
+            # order by the EPOCH (%ct): ISO strings with mixed
+            # committer timezones don't sort chronologically
+            epoch = int(epoch)
         except Exception:  # noqa: BLE001
             continue
         try:
@@ -113,10 +116,15 @@ def _last_known(metric):
                     if not line or not line.startswith("{"):
                         continue
                     rec = json.loads(line)
+                    if rec.get("ab_config"):
+                        # experiment rows (tools/tpu_ab_regression.sh
+                        # tags) measure deliberately non-default
+                        # configs — never the record of record
+                        continue
                     if rec.get("metric") == metric and \
                             rec.get("value") is not None and \
-                            (best is None or date >= best[0]):
-                        best = (date, rec,
+                            (best is None or epoch >= best[0]):
+                        best = (epoch, rec,
                                 {"file": rel, "commit": commit,
                                  "captured": date})
         except Exception:  # noqa: BLE001
