@@ -84,6 +84,17 @@ _TLM = dict(vocab=32768, seq_len=2048, layers=4, heads=16, dim=2048,
 _DEFAULT_CONFIG = False
 
 
+def _is_experiment_row(rec):
+    """tools/perf_tables.is_experiment_row when importable (one
+    predicate for both consumers of bench_out records), else the same
+    rule inline (bench.py must stay standalone-runnable)."""
+    try:
+        from tools.perf_tables import is_experiment_row
+        return is_experiment_row(rec)
+    except ImportError:
+        return bool(rec.get("ab_config"))
+
+
 def _last_known(metric):
     """Most recent COMMITTED bench_out/ capture for this metric, so a
     tunnel outage at driver-run time never produces a contentless
@@ -116,10 +127,7 @@ def _last_known(metric):
                     if not line or not line.startswith("{"):
                         continue
                     rec = json.loads(line)
-                    if rec.get("ab_config"):
-                        # experiment rows (tools/tpu_ab_regression.sh
-                        # tags) measure deliberately non-default
-                        # configs — never the record of record
+                    if _is_experiment_row(rec):
                         continue
                     if rec.get("metric") == metric and \
                             rec.get("value") is not None and \

@@ -128,17 +128,22 @@ def test_bench_fail_exit_code_contract(monkeypatch, capsys):
     assert out["value"] is None
 
 
-def test_perf_tables_newest_capture_wins(tmp_path):
-    """Advisor r4: JSONL captures append chronologically; the rendered
-    table must show the LAST record per key, not the first."""
+def _load_perf_tables():
     import importlib.util
-    import json
     import os
     repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     spec = importlib.util.spec_from_file_location(
         "perf_tables", os.path.join(repo, "tools", "perf_tables.py"))
     pt = importlib.util.module_from_spec(spec)
     spec.loader.exec_module(pt)
+    return pt
+
+
+def test_perf_tables_newest_capture_wins(tmp_path):
+    """Advisor r4: JSONL captures append chronologically; the rendered
+    table must show the LAST record per key, not the first."""
+    import json
+    pt = _load_perf_tables()
     rec = {"metric": "resnet50_train_throughput", "unit": "img/s",
            "vs_baseline": 1.0, "mfu": 0.2, "step_time_ms": 50.0}
     lines = [dict(rec, value=1000.0), dict(rec, value=2222.0)]
@@ -151,15 +156,48 @@ def test_perf_tables_newest_capture_wins(tmp_path):
 def test_perf_tables_renders_from_committed_captures():
     """tools/perf_tables.py turns bench_out/ artifacts into the docs
     tables; must at least render the committed training captures."""
-    import importlib.util
     import os
     repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-    spec = importlib.util.spec_from_file_location(
-        "perf_tables", os.path.join(repo, "tools", "perf_tables.py"))
-    pt = importlib.util.module_from_spec(spec)
-    spec.loader.exec_module(pt)
+    pt = _load_perf_tables()
     recs = pt.load_records(os.path.join(repo, "bench_out"))
     assert any(r["metric"] == "resnet50_train_throughput"
                for r in recs)
     table = pt.training_table(recs)
     assert "resnet50" in table and "| workload |" in table
+
+
+def test_perf_tables_excludes_ab_experiment_rows(tmp_path):
+    """A/B rows (tools/tpu_ab_regression.sh tags ab_config) measure
+    deliberately non-default configs; a newer experiment row must
+    never shadow the headline capture."""
+    import json
+    pt = _load_perf_tables()
+    rec = {"metric": "resnet50_train_throughput", "unit": "img/s",
+           "vs_baseline": 1.0, "mfu": 0.2, "step_time_ms": 50.0}
+    (tmp_path / "resnet50.json").write_text(
+        json.dumps(dict(rec, value=2451.0)) + "\n")
+    (tmp_path / "ab_regression.jsonl").write_text(
+        json.dumps(dict(rec, value=1903.0,
+                        ab_config="bn_stats_dot")) + "\n")
+    # the jsonl is "newer" on disk
+    os.utime(tmp_path / "resnet50.json", (1, 1))
+    table = pt.training_table(pt.load_records(str(tmp_path)))
+    assert "2451" in table and "1903" not in table
+
+
+def test_bench_last_known_excludes_experiment_rows():
+    """bench.py's outage fallback shares is_experiment_row: against
+    the REAL committed bench_out (which contains ab_regression.jsonl
+    rows committed AFTER the headline), _last_known must still cite
+    the headline artifact, not a deliberately-slowed A/B row."""
+    import importlib.util
+    import os
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    spec = importlib.util.spec_from_file_location(
+        "bench", os.path.join(repo, "bench.py"))
+    bench = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(bench)
+    rec, prov = bench._last_known("resnet50_train_throughput")
+    assert rec is not None
+    assert not rec.get("ab_config")
+    assert prov["file"].endswith("resnet50.json")

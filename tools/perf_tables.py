@@ -9,7 +9,11 @@ session is one command:
 
 Reads every *.json / *.jsonl under bench_out/ (one JSON object per
 line), groups by metric, and prints the most recent record per
-(metric, variant-ish key). Records with value=null are skipped.
+(metric, variant-ish key). Records with value=null are skipped, and so
+are A/B experiment rows (`ab_config` tag from tpu_ab_regression.sh) —
+they measure deliberately non-default configs and must never shadow
+the numbers of record, in these tables or in bench.py's last_known
+outage fallback (which shares is_experiment_row below).
 """
 from __future__ import annotations
 
@@ -19,6 +23,14 @@ import json
 import os
 
 _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def is_experiment_row(rec):
+    """True for A/B experiment records (tools/tpu_ab_regression.sh
+    tags them ab_config) — deliberately non-default configurations
+    that must never be selected as a number of record. Shared by the
+    table renderer here and bench.py's last_known fallback."""
+    return bool(rec.get("ab_config"))
 
 
 def _mtime(path):
@@ -48,6 +60,8 @@ def load_records(out_dir):
                     except json.JSONDecodeError:
                         continue
                     if rec.get("value") is None:
+                        continue
+                    if is_experiment_row(rec):
                         continue
                     rec["_file"] = os.path.basename(path)
                     recs.append(rec)
