@@ -102,6 +102,10 @@ cap "$OUT/bn_micro.jsonl" bn_micro python benchmark/bench_bn.py
 echo "== 3d1. max-pool dense backward vs SelectAndScatter =="
 cap "$OUT/pool_micro.jsonl" pool_micro python benchmark/bench_pool.py
 
+echo "== 3d2. embedding-grad formulation (scatter vs segsum vs matmul) =="
+cap "$OUT/embgrad_micro.jsonl" embgrad_micro \
+    python benchmark/bench_embgrad.py
+
 echo "== 3d. input-pipeline train overlap (net img/s with real decode) =="
 cap "$OUT/pipeline_overlap.json" pipeline_overlap \
     python benchmark/bench_input_pipeline.py --train-overlap \
