@@ -91,9 +91,23 @@ for T in 4096 8192 16384; do
 done
 capa "$LCTX" lctx:32768 env BENCH_ITERS=5 python bench.py \
     --network transformer_lm --batch 1 --seq-len 32768 --remat
-# windowed attention: O(T*W) compute lets 32k train un-rematerialized
+# windowed attention cuts FLOPs, not activation residency: pair it
+# with remat (un-rematerialized 32k OOMs — measured round 5)
 capa "$LCTX" lctx:32768w4096 env BENCH_ITERS=5 python bench.py \
-    --network transformer_lm --batch 1 --seq-len 32768 --window 4096
+    --network transformer_lm --batch 1 --seq-len 32768 --window 4096 \
+    --remat
+# the chunked fused-CE head unlocks everything past 32k (the dense
+# head's (B*T, vocab) logits are the OOM); 49152 = the longest
+# single-chip config proven live round 5. 4-layer 65536 trips an
+# axon remote-compile size cap — do not stage it.
+capa "$LCTX" lctx:32768w4096chunk env BENCH_ITERS=5 \
+    BENCH_TLM_LOSS_CHUNK=4096 python bench.py \
+    --network transformer_lm --batch 1 --seq-len 32768 --window 4096 \
+    --remat
+capa "$LCTX" lctx:49152w4096chunk env BENCH_ITERS=3 \
+    BENCH_TLM_LOSS_CHUNK=4096 python bench.py \
+    --network transformer_lm --batch 1 --seq-len 49152 --window 4096 \
+    --remat
 [ -s "$LCTX" ] && mv "$LCTX" "$OUT/longcontext.jsonl" || rm -f "$LCTX"
 
 echo "== 3d0. BatchNorm one-pass vs two-pass microbench =="
