@@ -461,8 +461,20 @@ spec("InstanceNorm", [f32((2, 3, 4)), f32((3,), 0.5, 1.5), f32((3,))],
 spec("L2Normalization", [f32((2, 6))],
      oracle=lambda x: x / np.sqrt((x * x).sum(1, keepdims=True) + 1e-10),
      grad_args=(0,))
+def _lrn_oracle(x, nsize, alpha=1e-4, beta=0.75, knorm=2.0):
+    """Across-channel LRN, reference lrn-inl.h semantics."""
+    sq = x * x
+    half = nsize // 2
+    C = x.shape[1]
+    den = np.zeros_like(x)
+    for c in range(C):
+        lo, hi = max(0, c - half), min(C, c + half + 1)
+        den[:, c] = sq[:, lo:hi].sum(1)
+    return x / (knorm + alpha / nsize * den) ** beta
+
+
 spec("LRN", [f32((2, 5, 3, 3))], attrs={"nsize": 3},
-     oracle=None)  # formula checked via eager-vs-jit only
+     oracle=_lrn_oracle)
 spec("Activation", [f32((2, 3))], attrs={"act_type": "relu"},
      oracle=lambda x, act_type: np.maximum(x, 0))
 spec("Activation", [f32((2, 3))], attrs={"act_type": "tanh"},
@@ -548,17 +560,42 @@ spec("rmsprop_update", [_w0, _g0, _n0],
      attrs={"lr": 0.1, "gamma1": 0.95, "epsilon": 1e-8},
      oracle=lambda w, g, n, lr, gamma1, epsilon:
          w - lr * g / np.sqrt(gamma1 * n + (1 - gamma1) * g * g + epsilon))
+def _rmspropalex_oracle(w, g, n, gbar, delta, lr, gamma1=0.95,
+                        gamma2=0.9, epsilon=1e-8):
+    """Graves RMSProp (rmsprop_update's centered sibling)."""
+    nn_ = (1 - gamma1) * g * g + gamma1 * n
+    gb = (1 - gamma1) * g + gamma1 * gbar
+    d = gamma2 * delta - lr * g / np.sqrt(nn_ - gb * gb + epsilon)
+    return w + d          # states update in place at the nd level
+
+
 spec("rmspropalex_update",
      [_w0, _g0, _n0, f32((3, 2), 0.0, 0.1), f32((3, 2), 0.0, 0.1)],
-     attrs={"lr": 0.1}, oracle=None)
+     attrs={"lr": 0.1}, oracle=_rmspropalex_oracle)
+def _ftrl_oracle(w, g, z, n, lr, lamda1=0.01, beta=1.0, wd=0.0):
+    nn_ = n + g * g
+    sigma = (np.sqrt(nn_) - np.sqrt(n)) / lr
+    zz = z + g - sigma * w
+    return np.where(np.abs(zz) <= lamda1, 0.0,
+                    -(zz - np.sign(zz) * lamda1)
+                    / ((beta + np.sqrt(nn_)) / lr + wd))
+
+
 spec("ftrl_update", [_w0, _g0, f32((3, 2), 0.0, 0.1),
                      f32((3, 2), 0.0, 0.1)],
-     attrs={"lr": 0.1}, oracle=None)
+     attrs={"lr": 0.1}, oracle=_ftrl_oracle)
 spec("mp_sgd_update", [_w0, _g0, _w0.astype(np.float32)],
      attrs={"lr": 0.1, "wd": 0.01},
      oracle=lambda w, g, w32, lr, wd: (w32 - lr * (g + wd * w32)))
+def _mp_sgd_mom_oracle(w, g, m, w32, lr, momentum=0.9, wd=0.01):
+    gg = g.astype(np.float32) + wd * w32
+    mm = momentum * m - lr * gg
+    return (w32 + mm).astype(w.dtype)
+
+
 spec("mp_sgd_mom_update", [_w0, _g0, _m0, _w0.astype(np.float32)],
-     attrs={"lr": 0.1, "momentum": 0.9, "wd": 0.01}, oracle=None)
+     attrs={"lr": 0.1, "momentum": 0.9, "wd": 0.01},
+     oracle=_mp_sgd_mom_oracle)
 
 # -- init ops (no tensor inputs) --------------------------------------------
 spec("_zeros", [], attrs={"shape": (2, 3)},
