@@ -658,7 +658,6 @@ EXEMPT = {
     "shuffle",             # permutation checked below
     "cast_storage",        # sparse tests
     "_linalg_gelqf",       # property checked below
-    "LRN",                 # eager-vs-jit only
     "CTCLoss",             # tests/test_ctc.py
     "RNN",                 # tests/test_rnn_op.py
     "Custom",              # tests/test_custom_op.py
