@@ -117,8 +117,11 @@ echo "== 3d1. max-pool dense backward vs SelectAndScatter =="
 cap "$OUT/pool_micro.jsonl" pool_micro python benchmark/bench_pool.py
 
 echo "== 3d2. embedding-grad formulation (scatter vs segsum vs matmul) =="
+# BENCH_EMBGRAD_MODEL=1 adds the whole-model A/B (two bench.py runs):
+# the round-5 lesson is that micro wins routinely lose at model level,
+# so the staged capture must carry both or it cannot decide the knob
 cap "$OUT/embgrad_micro.jsonl" embgrad_micro \
-    python benchmark/bench_embgrad.py
+    env BENCH_EMBGRAD_MODEL=1 python benchmark/bench_embgrad.py
 
 echo "== 3d. input-pipeline train overlap (net img/s with real decode) =="
 cap "$OUT/pipeline_overlap.json" pipeline_overlap \
