@@ -44,7 +44,6 @@ class KVStoreServer:
         signal their children get an orderly exit."""
         import signal
         import threading
-        import time
         done = threading.Event()
 
         def _stop(_sig, _frm):
@@ -59,8 +58,11 @@ class KVStoreServer:
             "TPU — reductions run as in-step XLA collectives; waiting "
             "for the launcher's termination signal)",
             os.environ.get("DMLC_ROLE", "server"))
-        while not done.is_set():
-            time.sleep(0.5)
+        # block in one wait instead of a 0.5s poll: the parked role
+        # wakes the instant the handler sets the event (signals
+        # interrupt the wait to run the handler) and burns no wakeups
+        # while idle
+        done.wait()
 
 
 def _init_kvstore_server_module():

@@ -5,6 +5,7 @@ thin adapter over Module (the reference kept it for backward compat only).
 from __future__ import annotations
 
 import logging
+import os
 import time
 from collections import namedtuple
 
@@ -104,7 +105,12 @@ def save_checkpoint(prefix, epoch, symbol, arg_params, aux_params):
     save_dict.update({("aux:%s" % k): v.as_in_context(cpu())
                       for k, v in aux_params.items()})
     param_name = "%s-%04d.params" % (prefix, epoch)
-    nd.save(param_name, save_dict)
+    # atomic publish: a worker killed mid-save (the restart-and-resume
+    # story relies on checkpoints) must never leave a torn file as the
+    # newest checkpoint
+    tmp_name = param_name + ".tmp"
+    nd.save(tmp_name, save_dict)
+    os.replace(tmp_name, param_name)
     logging.info("Saved checkpoint to \"%s\"", param_name)
 
 

@@ -22,6 +22,48 @@ from ..model import BatchEndParam
 from ..initializer import Uniform
 
 
+def _newest_readable(candidates, loader, torn_excs, logger):
+    """Newest-first checkpoint scan: (path, loader(path)) for the
+    first candidate the loader can read, warning and falling back past
+    files torn by a crash mid-save (predating the atomic-rename
+    write) instead of killing the restarted worker. (None, None) when
+    nothing is readable. Which exceptions count as 'torn' is caller
+    policy — a model/optimizer MISMATCH must fail loudly, so put
+    ValueError in the torn set only when the loader's format raises it
+    for truncation."""
+    for path in reversed(candidates):
+        try:
+            return path, loader(path)
+        except torn_excs as e:
+            logger.warning("checkpoint %s unreadable (%s); trying the "
+                           "previous one", path, e)
+    return None, None
+
+
+def _latest_checkpoint(prefix, logger):
+    """Newest readable ``prefix-NNNN.params`` → (epochs_completed,
+    arg_params, aux_params), or (None, None, None)."""
+    import glob
+    import re
+    import zipfile
+
+    from .. import ndarray as nd_mod
+
+    found = sorted(p for p in glob.glob(prefix + "-*.params")
+                   if re.search(r"-\d{4}\.params$", p))
+    path, blob = _newest_readable(
+        found, nd_mod.load,
+        (OSError, ValueError, EOFError, zipfile.BadZipFile), logger)
+    if path is None:
+        return None, None, None
+    arg_params = {k.split(":", 1)[1]: v for k, v in blob.items()
+                  if k.startswith("arg:")}
+    aux_params = {k.split(":", 1)[1]: v for k, v in blob.items()
+                  if k.startswith("aux:")}
+    return int(path[:-len(".params")].rsplit("-", 1)[1]), \
+        arg_params, aux_params
+
+
 def _check_input_names(symbol, names, typename, throw):
     """Ensure each user-given input name exists among the symbol's
     arguments; suggest likely candidates otherwise."""
@@ -180,9 +222,30 @@ class BaseModule:
             initializer=Uniform(0.01), arg_params=None, aux_params=None,
             allow_missing=False, force_rebind=False, force_init=False,
             begin_epoch=0, num_epoch=None, validation_metric=None,
-            monitor=None):
-        """The training loop: bind, init, then per-epoch train+eval."""
+            monitor=None, checkpoint_prefix=None, checkpoint_period=1,
+            resume=True):
+        """The training loop: bind, init, then per-epoch train+eval.
+
+        checkpoint_prefix: save ``prefix-NNNN.params`` (NNNN = epochs
+        completed) every ``checkpoint_period`` epochs and, with
+        ``resume=True``, continue AFTER the newest readable checkpoint
+        on restart — the elastic-restart hook: a worker killed anywhere
+        and rerun with the same command rejoins the job. On the
+        dist_async kvstore the rejoining worker's ``init`` pushes are
+        first-writer-wins on the live server, so it adopts the
+        cohort's CURRENT weights rather than clobbering them."""
         assert num_epoch is not None, "please specify number of epochs"
+
+        if checkpoint_prefix and resume:
+            found_epoch, found_arg, found_aux = _latest_checkpoint(
+                checkpoint_prefix, self.logger)
+            if found_epoch is not None:
+                begin_epoch = found_epoch
+                arg_params, aux_params = found_arg, found_aux
+                force_init = True
+                self.logger.info(
+                    "resumed %s-%04d.params; continuing at epoch %d",
+                    checkpoint_prefix, found_epoch, begin_epoch)
 
         self.bind(data_shapes=train_data.provide_data,
                   label_shapes=train_data.provide_label,
@@ -212,6 +275,11 @@ class BaseModule:
             # pull trained values host-side (also re-syncs aux stats)
             arg_now, aux_now = self.get_params()
             self.set_params(arg_now, aux_now)
+            if checkpoint_prefix and \
+                    (epoch + 1) % checkpoint_period == 0:
+                from ..model import save_checkpoint
+                save_checkpoint(checkpoint_prefix, epoch + 1,
+                                self.symbol, arg_now, aux_now)
             for cb in _as_list(epoch_end_callback or []):
                 cb(epoch, self.symbol, arg_now, aux_now)
 

@@ -8,6 +8,7 @@ collectives (psum/all-gather/reduce-scatter) on ICI/DCN, and the optimizer
 update runs sharded next to the gradients (the analogue of
 update_on_kvstore server-side updates).
 """
+from .resilience import DeadWorkerError, FaultInjector, RetryPolicy
 from .trainer import make_train_step, TrainStep
 from .sharding import (data_parallel_mesh, make_mesh, param_sharding,
                        batch_sharding)

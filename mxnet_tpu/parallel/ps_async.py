@@ -38,13 +38,18 @@ Use through the normal surface:
 """
 from __future__ import annotations
 
+import logging
 import os
 import pickle
 import socket
 import struct
 import threading
+import time
 
 import numpy as np
+
+from .resilience import (DeadWorkerError, RetryPolicy, _env_float,
+                         active_injector)
 
 # imported at MODULE level on purpose: the server role starts inside
 # the mxnet_tpu package import (reference parity — import mxnet with
@@ -56,8 +61,13 @@ from .. import ndarray as _nd
 from .. import optimizer as _opt
 
 __all__ = ["AsyncPSServer", "AsyncPSClient", "ShardedPSClient",
-           "create_client", "server_endpoints", "shard_for_key",
-           "serve_forever"]
+           "DeadWorkerError", "create_client", "server_endpoints",
+           "shard_for_key", "serve_forever"]
+
+# ops the server must NOT apply twice when a reconnected client replays
+# its in-flight request (the server-side optimizer would double-apply a
+# retried push). pull/stats are idempotent and skip the dedup table.
+_MUTATING_OPS = frozenset(("init", "push", "set_optimizer", "barrier"))
 
 
 class _NoImportUnpickler(pickle.Unpickler):
@@ -81,12 +91,25 @@ def _loads(data):
     return _NoImportUnpickler(_io.BytesIO(data)).load()
 
 
-def _send_msg(sock, obj):
+def _send_msg(sock, obj, fault_point=None):
+    """Frame + send. ``fault_point`` names this call site for the
+    deterministic FaultInjector (resilience.py, MXNET_FAULT_SPEC);
+    None exempts the call (handshakes, heartbeat replies) so injection
+    counts stay reproducible."""
     payload = pickle.dumps(obj, protocol=4)
-    sock.sendall(struct.pack(">I", len(payload)) + payload)
+    frame = struct.pack(">I", len(payload)) + payload
+    if fault_point is not None:
+        inj = active_injector()
+        if inj is not None:
+            inj.on_send(fault_point, sock, frame)
+    sock.sendall(frame)
 
 
-def _recv_msg(sock):
+def _recv_msg(sock, fault_point=None):
+    if fault_point is not None:
+        inj = active_injector()
+        if inj is not None:
+            inj.on_recv(fault_point, sock)
     hdr = b""
     while len(hdr) < 4:
         chunk = sock.recv(4 - len(hdr))
@@ -124,13 +147,31 @@ class AsyncPSServer:
         self._lock = threading.Lock()          # metadata only
         self._key_locks = {}                   # key -> Lock
         self._num_workers = int(num_workers)
-        self._barrier_count = 0
+        self._base_workers = int(num_workers)  # configured cohort size
         self._barrier_gen = 0
+        self._barrier_waiters = {}             # client id -> worker id
+        self._barrier_abort = None             # DeadWorkerError reason
         self._barrier_cv = threading.Condition()
         self._done = threading.Event()
         self._byes = 0
         self._worker_ids = set()   # hello'd workers (stray conns don't count)
         self._active = 0
+        # -- resilience state (docs/robustness.md) --------------------------
+        # dedup: one entry per client — the client serializes its ops
+        # (including retry backoff, see AsyncPSClient._op_lock), so a
+        # reconnected client can only ever replay its LAST request
+        self._dedup = {}           # client id -> (seq, cached reply)
+        # mutating ops currently EXECUTING — a replay of one of these
+        # must wait for the original instead of re-executing it
+        self._inflight = {}        # client id -> (seq, Event)
+        self._last_seen = {}       # worker id -> monotonic time of last ping
+        self._dead_workers = set()
+        self._departed = set()     # wids that said bye (clean exits)
+        self._elastic = os.environ.get("MXNET_PS_ELASTIC") == "1"
+        self._hb_timeout = _env_float("MXNET_PS_HEARTBEAT_TIMEOUT", 15.0)
+        # a momentary zero-connection dip during a client's reconnect
+        # must not be read as job end — linger before declaring it over
+        self._linger = _env_float("MXNET_PS_LINGER", 2.0)
         self._srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         self._srv.bind((host, int(port)))
@@ -145,7 +186,7 @@ class AsyncPSServer:
             return lk
 
     # -- request handlers ---------------------------------------------------
-    def _handle(self, op, key, payload):
+    def _handle(self, op, key, payload, meta=None):
         if op == "init":
             with self._key_lock(key):
                 # first writer wins (reference InitImpl: rank 0
@@ -179,18 +220,7 @@ class AsyncPSServer:
                 self._updater = _opt.get_updater(optimizer)
             return True
         if op == "barrier":
-            with self._barrier_cv:
-                gen = self._barrier_gen
-                self._barrier_count += 1
-                if self._barrier_count >= self._num_workers:
-                    self._barrier_count = 0
-                    self._barrier_gen += 1
-                    self._barrier_cv.notify_all()
-                else:
-                    while self._barrier_gen == gen and \
-                            not self._done.is_set():
-                        self._barrier_cv.wait(timeout=1.0)
-            return True
+            return self._barrier(meta)
         if op == "stats":
             # observability: which keys this shard holds (tests assert
             # the sharded distribution; operators debug placement)
@@ -199,13 +229,47 @@ class AsyncPSServer:
         if op == "hello":
             # worker handshake: lifetime tracks DISTINCT worker ids, so
             # stray connections (port scans, health checks) and worker
-            # restarts can neither trigger nor block shutdown
+            # restarts can neither trigger nor block shutdown. A worker
+            # that was declared dead and reconnects (launcher restart)
+            # rejoins — elastically re-growing the cohort it shrank.
+            wid = int(key)
             with self._lock:
-                self._worker_ids.add(int(key))
+                self._departed.discard(wid)   # restart after a bye
+            self._revive(wid, "hello")
+            with self._lock:
+                self._worker_ids.add(wid)
+            return True
+        if op == "ping":
+            # heartbeat: liveness tracking keyed by worker id. Only
+            # workers that ever pinged are subject to dead-peer
+            # detection (heartbeat-less legacy clients never lapse).
+            # Departed (bye'd) workers are no longer tracked — a
+            # straggler ping from a closing client must not resurrect
+            # a liveness entry the monitor would later declare dead.
+            wid = int(key)
+            self._revive(wid, "ping")
+            with self._lock:
+                if wid not in self._departed:
+                    self._last_seen[wid] = time.monotonic()
             return True
         if op == "bye":
             with self._lock:
                 self._byes += 1
+                wid = meta.get("wid") if meta else None
+                if wid is not None:
+                    # clean departure: retire liveness tracking so the
+                    # monitor never reads the silence that follows a
+                    # polite exit as a heartbeat-lapse death
+                    self._departed.add(wid)
+                    self._last_seen.pop(wid, None)
+                cid = meta.get("cid") if meta else None
+                if cid is not None:
+                    # and the client's dedup/in-flight slots: a client
+                    # past its bye has no op left to replay, and a
+                    # long-lived server otherwise accrues one dead
+                    # entry per client ever connected
+                    self._dedup.pop(cid, None)
+                    self._inflight.pop(cid, None)
                 if self._byes >= self._num_workers:
                     self._done.set()
                     with self._barrier_cv:
@@ -223,20 +287,186 @@ class AsyncPSServer:
         self._updater(_hash_key(key), g, w)
         self._store[key] = np.asarray(w.asnumpy())
 
+    # -- cohort membership / barriers ---------------------------------------
+    def _barrier(self, meta):
+        """Counted barrier over DISTINCT clients (reference
+        ps::Postoffice Barrier). Membership is a set keyed by client
+        id, not a raw counter, so a reconnected client REPLAYING its
+        in-flight barrier request is idempotent — the old counter
+        double-counted a replay and released the cohort early. Waiters
+        are released either by the full cohort arriving, or by the
+        heartbeat monitor declaring a member dead: DeadWorkerError to
+        every waiter (default), or a cohort shrink that may satisfy the
+        barrier immediately (MXNET_PS_ELASTIC=1)."""
+        cid = meta.get("cid") if meta else object()   # legacy: unique
+        wid = meta.get("wid") if meta else None
+        if wid is not None:
+            # a barrier from a dead-marked worker proves it alive —
+            # readmit BEFORE counting waiters, or the shrunken elastic
+            # cohort releases without it and barriers desynchronize
+            self._revive(wid, "barrier")
+        with self._barrier_cv:
+            if self._barrier_abort:
+                raise DeadWorkerError(self._barrier_abort)
+            gen = self._barrier_gen
+            self._barrier_waiters[cid] = wid
+            if len(self._barrier_waiters) >= self._num_workers:
+                self._barrier_waiters = {}
+                self._barrier_gen += 1
+                self._barrier_cv.notify_all()
+            else:
+                while self._barrier_gen == gen and \
+                        not self._done.is_set():
+                    if self._barrier_abort:
+                        # leaving on abort removes OUR entry: a later
+                        # abort-clear must not count this departed
+                        # waiter toward a future release
+                        self._barrier_waiters.pop(cid, None)
+                        raise DeadWorkerError(self._barrier_abort)
+                    self._barrier_cv.wait(timeout=0.5)
+        return True
+
+    def _recompute_cohort_locked(self):
+        """(elastic) cohort = configured size minus currently-dead
+        workers, floored at 1. DERIVED each time, never incrementally
+        adjusted: a death racing the floor followed by a revive would
+        otherwise inflate the count past the number of live workers,
+        and an inflated cohort deadlocks every barrier."""
+        self._num_workers = max(
+            1, self._base_workers - len(self._dead_workers))
+
+    def _revive(self, wid, via):
+        """Traffic from a dead-marked worker falsifies the verdict — a
+        GC pause or VM stall can outlast the heartbeat timeout without
+        killing anyone. Readmit it so its pings count again and, under
+        elastic, regrow the cohort shrunk on its behalf; otherwise the
+        'dead' worker keeps pushing forever-invisible while the
+        shrunken barrier releases without it. In non-elastic mode the
+        barrier abort clears once NO declared-dead worker remains: a
+        false alarm that fully resolves must not keep failing the
+        barriers of a provably healthy cohort (a genuinely broken
+        cohort stays broken — its dead member never revives)."""
+        with self._lock:
+            if wid not in self._dead_workers or \
+                    wid in self._departed:
+                # a straggler ping from a worker that already said BYE
+                # must not resurrect it — the cohort would forever
+                # expect a worker that exited (hello clears _departed
+                # first, so a real restart still rejoins)
+                return
+            self._dead_workers.discard(wid)
+            self._last_seen.pop(wid, None)
+            self._worker_ids.add(wid)
+            grown = None
+            if self._elastic:
+                self._recompute_cohort_locked()
+                grown = self._num_workers
+            all_alive = not self._dead_workers
+        logging.info(
+            "async PS: worker %s revived via %s%s", wid, via,
+            "; cohort grown to %d" % grown if grown is not None else "")
+        if all_alive and not self._elastic:
+            with self._barrier_cv:
+                if self._barrier_abort:
+                    logging.info("async PS: full cohort alive again; "
+                                 "clearing barrier abort")
+                    # waiters that observed the abort removed their own
+                    # entries on the way out; entries still present
+                    # belong to threads that are STILL parked (they
+                    # woke after the clear, or never woke) and stay
+                    # legitimately counted
+                    self._barrier_abort = None
+                    self._barrier_cv.notify_all()
+
+    def _declare_dead(self, wid, reason):
+        """Heartbeat lapse: remove the worker from the cohort. Default
+        semantics fail every current and future barrier with
+        DeadWorkerError (surviving workers stop hanging and can
+        checkpoint/abort); MXNET_PS_ELASTIC=1 instead shrinks
+        _num_workers so the survivors keep training degraded."""
+        with self._lock:
+            if wid in self._dead_workers or self._done.is_set():
+                return
+            self._dead_workers.add(wid)
+            self._worker_ids.discard(wid)
+            self._last_seen.pop(wid, None)
+            if self._elastic:
+                self._recompute_cohort_locked()
+        logging.warning(
+            "async PS: worker %s declared dead (%s)%s", wid, reason,
+            "; cohort shrunk to %d" % self._num_workers
+            if self._elastic else "; failing barriers")
+        with self._barrier_cv:
+            if self._elastic:
+                for cid in [c for c, w in self._barrier_waiters.items()
+                            if w == wid]:
+                    del self._barrier_waiters[cid]
+                if self._barrier_waiters and \
+                        len(self._barrier_waiters) >= self._num_workers:
+                    self._barrier_waiters = {}
+                    self._barrier_gen += 1
+            else:
+                self._barrier_abort = (
+                    "worker %s declared dead: %s" % (wid, reason))
+            self._barrier_cv.notify_all()
+
+    def _monitor_loop(self):
+        """Dead-peer detector: a worker whose last ping is older than
+        MXNET_PS_HEARTBEAT_TIMEOUT is declared dead. Today the barrier
+        loop would otherwise spin until job end — surviving workers
+        hung forever on a dead peer."""
+        poll = max(0.05, min(1.0, self._hb_timeout / 4.0))
+        while not self._done.wait(poll):
+            now = time.monotonic()
+            with self._lock:
+                lapsed = [wid for wid, t in self._last_seen.items()
+                          if now - t > self._hb_timeout]
+            for wid in lapsed:
+                self._declare_dead(
+                    wid, "heartbeat lapse > %.1fs" % self._hb_timeout)
+
+    def _maybe_finish(self):
+        """Linger-delayed end-of-job check (see _client_loop)."""
+        with self._lock:
+            if self._done.is_set() or self._active != 0 or \
+                    len(self._worker_ids) + len(self._dead_workers) < \
+                    self._num_workers:
+                return
+            self._done.set()
+        with self._barrier_cv:
+            self._barrier_cv.notify_all()
+
     # -- socket plumbing ----------------------------------------------------
     def _client_loop(self, conn):
         try:
             while not self._done.is_set():
-                msg = _recv_msg(conn)
+                msg = _recv_msg(conn, fault_point="srv_recv")
                 if msg is None:
                     return
-                op, key, payload = msg
+                op, key, payload = msg[:3]
+                meta = msg[3] if len(msg) > 3 else None
                 try:
-                    result = self._handle(op, key, payload)
-                    _send_msg(conn, ("ok", result))
+                    cached = self._begin_op(op, meta)
+                    if cached is not None:
+                        _send_msg(conn, cached, fault_point="srv_send")
+                        continue
+                    try:
+                        result = self._handle(op, key, payload, meta)
+                    except Exception:
+                        self._finish_op(op, meta, failed=True)
+                        raise
+                    self._finish_op(op, meta, result)
+                    # ping replies are exempt from injection so the
+                    # srv_send count tracks only data traffic (srv_recv
+                    # can't be: the op is unknown until after the read
+                    # — docs/robustness.md flags that caveat)
+                    _send_msg(conn, ("ok", result),
+                              fault_point=None if op == "ping"
+                              else "srv_send")
                 except Exception as e:  # noqa: BLE001
                     _send_msg(conn, ("err", "%s: %s"
-                                     % (type(e).__name__, e)))
+                                     % (type(e).__name__, e)),
+                              fault_point="srv_send")
         finally:
             conn.close()
             with self._lock:
@@ -245,15 +475,75 @@ class AsyncPSServer:
                 # and every connection has drained, the job is over —
                 # interpreter teardown does not reliably deliver the
                 # explicit byes (reference: ps-lite's scheduler-tracked
-                # FINALIZE; here disconnect IS the signal)
-                if len(self._worker_ids) >= self._num_workers and \
-                        self._active == 0:
-                    self._done.set()
-                    with self._barrier_cv:
-                        self._barrier_cv.notify_all()
+                # FINALIZE; here disconnect IS the signal). The check is
+                # DELAYED by MXNET_PS_LINGER: a client reconnecting
+                # after a transport fault passes through a zero-
+                # connection instant that must not end the job.
+                if len(self._worker_ids) + len(self._dead_workers) >= \
+                        self._num_workers and self._active == 0:
+                    t = threading.Timer(self._linger, self._maybe_finish)
+                    t.daemon = True
+                    t.start()
+
+    def _begin_op(self, op, meta):
+        """Dedup + in-flight claim for a mutating op. Returns the
+        cached wire reply when this exact (cid, seq) already COMPLETED
+        (a reconnected client resent its in-flight request — the
+        server-side optimizer must not double-apply a retried push),
+        or None after claiming the op for execution.
+
+        A replay can also race the ORIGINAL: the client's per-attempt
+        timeout fires while the server is still applying the op (e.g.
+        queued on a contended key lock), and the replay arrives on a
+        new connection before the first execution finished. Executing
+        it again would double-apply, so the replay BLOCKS here until
+        the original completes, then serves its cached reply. If the
+        original failed without recording (application error), the
+        loop re-claims and re-executes — surfacing the same error."""
+        if op not in _MUTATING_OPS or not meta or \
+                meta.get("cid") is None:
+            return None
+        cid, seq = meta["cid"], meta["seq"]
+        while True:
+            with self._lock:
+                prev = self._dedup.get(cid)
+                if prev is not None and prev[0] == seq:
+                    return ("ok", prev[1])
+                inflight = self._inflight.get(cid)
+                if inflight is None or inflight[0] != seq:
+                    self._inflight[cid] = (seq, threading.Event())
+                    return None
+                event = inflight[1]
+            # timeout: safety net so a handler thread never parks
+            # forever on an event whose setter died with its connection
+            event.wait(timeout=0.5)
+
+    def _finish_op(self, op, meta, result=None, failed=False):
+        """Complete a claimed mutating op: cache the reply for replay
+        dedup (skipped when the op FAILED — a replay re-executes and
+        surfaces the same application error) and wake any replay
+        blocked in _begin_op. The dedup slot only moves forward: a
+        late finisher for an abandoned older seq must not evict a
+        newer op's entry."""
+        if op not in _MUTATING_OPS or not meta or \
+                meta.get("cid") is None:
+            return
+        cid, seq = meta["cid"], meta["seq"]
+        with self._lock:
+            if not failed:
+                prev = self._dedup.get(cid)
+                if prev is None or prev[0] <= seq:
+                    self._dedup[cid] = (seq, result)
+            inflight = self._inflight.get(cid)
+            if inflight is not None and inflight[0] == seq:
+                del self._inflight[cid]
+                inflight[1].set()
 
     def serve_forever(self):
         self._srv.settimeout(1.0)
+        monitor = threading.Thread(target=self._monitor_loop,
+                                   daemon=True)
+        monitor.start()
         threads = []
         while not self._done.is_set():
             try:
@@ -272,6 +562,8 @@ class AsyncPSServer:
 
     def stop(self):
         self._done.set()
+        with self._barrier_cv:
+            self._barrier_cv.notify_all()
 
 
 def _hash_key(key):
@@ -447,45 +739,199 @@ def create_client():
     return ShardedPSClient(eps)
 
 
+# a single connect() attempt never blocks longer than this, independent
+# of the overall MXNET_PS_CONNECT_TIMEOUT budget
+_CONNECT_ATTEMPT_CAP = 600.0
+
+_client_counter = [0]
+_client_counter_lock = threading.Lock()
+
+
+def _next_client_id():
+    """Process-unique client identity for the server's dedup table.
+    Two clients in one process (tests, sharded fan-out) must never
+    share an id — a shared id would alias their sequence numbers and
+    dedup away a legitimate op."""
+    with _client_counter_lock:
+        _client_counter[0] += 1
+        return "%d.%d" % (os.getpid(), _client_counter[0])
+
+
 class AsyncPSClient:
     """One worker's connection to the async server. Thread-safe per
     client via a lock (a worker's pushes are ordered on its own
-    connection — reference per-worker FIFO)."""
+    connection — reference per-worker FIFO).
+
+    Resilience (docs/robustness.md): every op carries a (client id,
+    sequence number); on a transient transport fault the client
+    reconnects under a RetryPolicy and REPLAYS the in-flight request
+    with the same sequence number, which the server deduplicates — a
+    retried push is applied exactly once. Non-barrier ops run under a
+    per-attempt socket timeout (MXNET_PS_OP_TIMEOUT) so a hung server
+    surfaces as a retry, not an infinite block; barriers wait
+    unboundedly by design (a worker may lag a slow epoch) and rely on
+    the server's dead-peer detection instead. A background heartbeat
+    thread pings the server on its OWN connection (a barrier holding
+    the op lock must not mute liveness), feeding that detection."""
 
     def __init__(self, host=None, port=None):
-        import time
-        host = host or os.environ.get("DMLC_PS_ROOT_URI", "127.0.0.1")
-        port = int(port or os.environ.get("DMLC_PS_ROOT_PORT", "9000"))
-        # the server re-execs + imports the framework before it binds;
-        # retry like ps-lite's connect loop did
-        deadline = time.time() + float(os.environ.get(
-            "MXNET_PS_CONNECT_TIMEOUT", "60"))
-        while True:
-            try:
-                self._sock = socket.create_connection((host, port),
-                                                      timeout=600)
-                break
-            except OSError:
-                if time.time() >= deadline:
-                    raise
-                time.sleep(0.5)
-        # barriers block indefinitely by design (a worker may lag a
-        # slow epoch); the 600s timeout applies to CONNECT only
-        self._sock.settimeout(None)
-        self._lock = threading.Lock()
-        self._call("hello", int(os.environ.get("DMLC_WORKER_ID", "0")))
-
-    def _call(self, op, key=None, payload=None):
+        self._host = host or os.environ.get("DMLC_PS_ROOT_URI",
+                                            "127.0.0.1")
+        self._port = int(port or os.environ.get("DMLC_PS_ROOT_PORT",
+                                                "9000"))
+        self._wid = int(os.environ.get("DMLC_WORKER_ID", "0"))
+        self._cid = _next_client_id()
+        self._seq = 0
+        self._lock = threading.Lock()      # socket + seq state
+        # ops are serial per client INCLUDING retry backoff (held for
+        # the whole seq-assign + attempt + sleep + replay span): the
+        # server's dedup keeps only the LATEST (seq, reply) per client,
+        # so another thread's op slipping in during a backoff sleep
+        # would evict this op's slot and its replay would re-apply.
+        self._op_lock = threading.Lock()
+        self._sock = None
+        self._retry = RetryPolicy(seed=self._cid)
+        op_timeout = _env_float("MXNET_PS_OP_TIMEOUT", 60.0)
+        self._op_timeout = op_timeout if op_timeout > 0 else None
         with self._lock:
-            _send_msg(self._sock, (op, key, payload))
-            reply = _recv_msg(self._sock)
-        if reply is None:
-            raise ConnectionError("async PS closed the connection")
-        status, result = reply
+            self._ensure_connected_locked()
+        self._hb_stop = threading.Event()
+        self._hb_thread = None
+        hb = _env_float("MXNET_PS_HEARTBEAT_INTERVAL", 5.0)
+        if hb > 0:
+            self._hb_thread = threading.Thread(
+                target=self._heartbeat_loop, args=(hb,), daemon=True)
+            self._hb_thread.start()
+
+    # -- connection management ---------------------------------------------
+    def _open_connection(self):
+        """Connect with retry until the MXNET_PS_CONNECT_TIMEOUT budget
+        runs out (the server re-execs + imports the framework before it
+        binds; ps-lite's connect loop did the same). Each attempt's
+        timeout is derived from the REMAINING budget, so a single
+        attempt can never outlive the overall deadline."""
+        budget = _env_float("MXNET_PS_CONNECT_TIMEOUT", 60.0)
+        deadline = time.monotonic() + budget
+        while True:
+            remaining = deadline - time.monotonic()
+            try:
+                sock = socket.create_connection(
+                    (self._host, self._port),
+                    timeout=max(0.1, min(_CONNECT_ATTEMPT_CAP,
+                                         remaining)))
+                sock.settimeout(None)
+                return sock
+            except OSError:
+                if time.monotonic() + 0.5 >= deadline:
+                    raise
+                time.sleep(min(0.5, max(0.0,
+                                        deadline - time.monotonic())))
+
+    def _ensure_connected_locked(self):
+        """(Re)connect + hello. Caller holds self._lock. The hello is
+        exempt from fault injection and dedup: it is idempotent and
+        must not disturb the data-op sequence the server dedups on."""
+        if self._sock is not None:
+            return
+        sock = self._open_connection()
+        try:
+            # the hello exchange runs under the per-op timeout too: a
+            # server that accepts the TCP handshake but then hangs must
+            # surface as a retryable socket.timeout, not block forever
+            # holding self._lock (which would also wedge close())
+            sock.settimeout(self._op_timeout)
+            _send_msg(sock, ("hello", self._wid, None,
+                             {"cid": self._cid, "wid": self._wid}))
+            reply = _recv_msg(sock)
+        except BaseException:
+            sock.close()
+            raise
+        if reply is None or reply[0] != "ok":
+            sock.close()
+            raise ConnectionError("async PS rejected hello: %r"
+                                  % (reply,))
+        self._sock = sock
+
+    def _drop_connection_locked(self):
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            except OSError as e:
+                logging.debug("async PS: close after fault failed: %s",
+                              e)
+            self._sock = None
+
+    # -- the op path ---------------------------------------------------------
+    def _call(self, op, key=None, payload=None):
+        barrier = op == "barrier"
+
+        def on_retry(exc, n, delay):
+            logging.warning(
+                "async PS %s(%r): transient %s: %s — retry %d/%d in "
+                "%.2fs", op, key, type(exc).__name__, exc, n,
+                self._retry.max_retries, delay)
+
+        with self._op_lock:
+            with self._lock:
+                self._seq += 1
+                meta = {"cid": self._cid, "wid": self._wid,
+                        "seq": self._seq}
+
+            def attempt():
+                with self._lock:
+                    self._ensure_connected_locked()
+                    try:
+                        self._sock.settimeout(
+                            None if barrier else self._op_timeout)
+                        _send_msg(self._sock, (op, key, payload, meta),
+                                  fault_point="send")
+                        reply = _recv_msg(self._sock,
+                                          fault_point="recv")
+                    except BaseException:
+                        self._drop_connection_locked()
+                        raise
+                    if reply is None:
+                        self._drop_connection_locked()
+                        raise ConnectionError(
+                            "async PS closed the connection")
+                    return reply
+
+            status, result = self._retry.run(
+                attempt, describe="%s(%r)" % (op, key),
+                on_retry=on_retry)
         if status != "ok":
+            if "DeadWorkerError" in str(result):
+                raise DeadWorkerError(result)
             raise RuntimeError("async PS error: %s" % result)
         return result
 
+    # -- heartbeat -----------------------------------------------------------
+    def _heartbeat_loop(self, interval):
+        """Ping on a dedicated connection every `interval` seconds so
+        the server's dead-peer monitor sees this worker as live even
+        while the main connection is parked in a barrier. Transport
+        errors just drop the ping socket and retry next tick (the
+        server may be restarting); the loop ends at close()."""
+        sock = None
+        while not self._hb_stop.wait(interval):
+            try:
+                if sock is None:
+                    sock = socket.create_connection(
+                        (self._host, self._port), timeout=5)
+                    sock.settimeout(10)
+                _send_msg(sock, ("ping", self._wid, None, None),
+                          fault_point="ping")
+                if _recv_msg(sock) is None:
+                    raise ConnectionError("ping EOF")
+            except (OSError, ConnectionError) as e:
+                logging.debug("async PS heartbeat: %s (will retry)", e)
+                if sock is not None:
+                    sock.close()
+                    sock = None
+        if sock is not None:
+            sock.close()
+
+    # -- surface -------------------------------------------------------------
     def init(self, key, value):
         self._call("init", key, np.asarray(value))
 
@@ -508,11 +954,26 @@ class AsyncPSClient:
         self._call("barrier")
 
     def close(self):
+        self._hb_stop.set()
         try:
-            self._call("bye")
-        except Exception:  # noqa: BLE001
-            pass
-        self._sock.close()
+            with self._lock:
+                if self._sock is not None:
+                    # bye is fire-once: no retry/replay — a replayed
+                    # bye would double-count in the shutdown quorum.
+                    # It carries the wid so the server retires this
+                    # worker's liveness tracking (a clean departure
+                    # must not read as a heartbeat-lapse death).
+                    _send_msg(self._sock, ("bye", None, None,
+                                           {"cid": self._cid,
+                                            "wid": self._wid}))
+                    _recv_msg(self._sock)
+        except (OSError, ConnectionError) as e:
+            # the server may already be gone at teardown; disconnect
+            # itself is a bye signal, so departing silently is correct
+            logging.debug("async PS bye skipped: %s", e)
+        finally:
+            with self._lock:
+                self._drop_connection_locked()
 
 
 def serve_forever():

@@ -1,0 +1,315 @@
+"""Fault tolerance for the distributed KVStore path (docs/robustness.md).
+
+The reference delegated resilience to ps-lite: a connect/retry loop at
+worker start and the scheduler-tracked FINALIZE protocol at job end.
+Everything in between — a dropped TCP connection mid-push, a hung
+server, a worker that died holding a barrier — was fatal or a hang.
+This module supplies the missing middle for the host-side async PS
+(`parallel/ps_async.py`):
+
+* :class:`RetryPolicy` — exponential backoff with *deterministic*
+  jitter (seeded, so two runs of the same job retry on the same
+  schedule), per-op deadlines, and transient-vs-fatal error
+  classification. Transport faults (reset, refused, timeout, EOF) are
+  transient and worth a reconnect; application errors the server
+  *replied* with (bad key, :class:`DeadWorkerError`) are fatal — the
+  transport demonstrably works, retrying cannot help.
+
+* :class:`FaultInjector` — deterministic fault injection wrapped
+  around the socket send/recv plumbing, driven by the
+  ``MXNET_FAULT_SPEC`` env var (or installed programmatically in
+  tests). Injects drops, delays, and mid-message disconnects at exact
+  call counts, so failure-path tests need no real process kills, no
+  sleeps-and-hope, and reproduce bit-identically.
+
+* :class:`DeadWorkerError` — raised to barrier waiters when the
+  server's heartbeat monitor declares a cohort member dead (the
+  alternative, which this replaces, was every surviving worker
+  spinning in the barrier until job end).
+"""
+from __future__ import annotations
+
+import errno
+import os
+import re
+import socket
+import threading
+import time
+import zlib
+
+__all__ = ["DeadWorkerError", "FaultInjected", "FaultInjector",
+           "RetryPolicy", "active_injector", "install_fault_injector"]
+
+
+class DeadWorkerError(RuntimeError):
+    """A worker in the cohort was declared dead (heartbeat lapse).
+
+    Raised server-side to every barrier waiter — the cohort can never
+    complete, so surviving workers fail loudly instead of hanging.
+    Under ``MXNET_PS_ELASTIC=1`` the server shrinks the cohort instead
+    and this error is not raised."""
+
+
+class FaultInjected(ConnectionError):
+    """The error a :class:`FaultInjector` rule raises — a subclass of
+    ConnectionError so retry classification treats it exactly like the
+    real transport fault it simulates."""
+
+
+# errno values that indicate a transport-level (retryable) failure
+_TRANSIENT_ERRNOS = frozenset(
+    getattr(errno, name) for name in
+    ("ECONNREFUSED", "ECONNRESET", "ECONNABORTED", "EPIPE", "ETIMEDOUT",
+     "EHOSTUNREACH", "ENETUNREACH", "ENETRESET", "EAGAIN")
+    if hasattr(errno, name))
+
+
+def _env_float(name, default):
+    try:
+        return float(os.environ.get(name, "") or default)
+    except ValueError:
+        return float(default)
+
+
+class RetryPolicy:
+    """Exponential backoff with deterministic jitter and a per-op
+    deadline.
+
+    delay(attempt) = min(base * multiplier^(attempt-1), max_delay)
+                     * (0.5 + 0.5 * jitter_frac(seed, attempt))
+
+    The jitter fraction is a crc32 of ``(seed, attempt)`` — spread
+    across workers (each seeds with its worker id) yet bit-reproducible
+    run to run, so a fault-injection test replays the exact schedule.
+
+    Env defaults: ``MXNET_PS_RETRY_MAX`` (8 retries),
+    ``MXNET_PS_RETRY_BASE`` (0.05s), ``MXNET_PS_RETRY_MAX_DELAY`` (2s),
+    ``MXNET_PS_OP_DEADLINE`` (120s; 0 = unlimited) — the total budget
+    for one op *including* its retries and backoff sleeps."""
+
+    def __init__(self, max_retries=None, base_delay=None, max_delay=None,
+                 multiplier=2.0, deadline=None, seed=0):
+        self.max_retries = int(max_retries if max_retries is not None
+                               else _env_float("MXNET_PS_RETRY_MAX", 8))
+        self.base_delay = float(base_delay if base_delay is not None
+                                else _env_float("MXNET_PS_RETRY_BASE",
+                                                0.05))
+        self.max_delay = float(max_delay if max_delay is not None
+                               else _env_float("MXNET_PS_RETRY_MAX_DELAY",
+                                               2.0))
+        self.multiplier = float(multiplier)
+        self.deadline = float(deadline if deadline is not None
+                              else _env_float("MXNET_PS_OP_DEADLINE",
+                                              120.0))
+        self.seed = seed
+
+    # -- classification -----------------------------------------------------
+    @staticmethod
+    def is_transient(exc):
+        """True when retrying can plausibly succeed: the TRANSPORT
+        failed. False when the server answered (application error) or
+        the cohort is dead — a retry would re-fail identically or,
+        worse, re-apply a non-idempotent op."""
+        if isinstance(exc, DeadWorkerError):
+            return False
+        if isinstance(exc, (ConnectionError, BrokenPipeError,
+                            socket.timeout, TimeoutError, EOFError)):
+            return True
+        if isinstance(exc, OSError):
+            return exc.errno in _TRANSIENT_ERRNOS
+        return False
+
+    # -- schedule -----------------------------------------------------------
+    def delay(self, attempt):
+        """Backoff before retry #attempt (1-based). Deterministic."""
+        d = self.base_delay * (self.multiplier ** (max(1, attempt) - 1))
+        d = min(d, self.max_delay)
+        frac = (zlib.crc32(("%s:%d" % (self.seed, attempt))
+                           .encode("utf-8")) % 1024) / 1024.0
+        return d * (0.5 + 0.5 * frac)
+
+    def run(self, fn, describe="op", on_retry=None):
+        """Call ``fn()`` until it succeeds, a fatal error occurs, the
+        retry count is exhausted, or the deadline would be overrun by
+        the next backoff sleep. ``on_retry(exc, attempt, delay)`` fires
+        before each sleep (the client uses it to drop the broken
+        connection and to log)."""
+        start = time.monotonic()
+        attempt = 0
+        while True:
+            try:
+                return fn()
+            except Exception as exc:  # noqa: BLE001 — classified below
+                if not self.is_transient(exc):
+                    raise
+                attempt += 1
+                if attempt > self.max_retries:
+                    raise
+                d = self.delay(attempt)
+                if self.deadline > 0 and \
+                        time.monotonic() - start + d > self.deadline:
+                    raise
+                if on_retry is not None:
+                    on_retry(exc, attempt, d)
+                time.sleep(d)
+
+
+# ---------------------------------------------------------------------------
+# fault injection
+# ---------------------------------------------------------------------------
+
+_RULE_RE = re.compile(
+    r"^(?P<point>\w+):(?P<action>drop|disconnect|delay)"
+    r"@(?P<nth>\d+)(?:x(?P<count>\d+|\*))?(?::(?P<arg>[0-9.]+))?$")
+
+
+class _Rule:
+    __slots__ = ("point", "action", "nth", "count", "arg")
+
+    def __init__(self, point, action, nth, count, arg):
+        self.point = point
+        self.action = action
+        self.nth = nth          # first matching call (1-based)
+        self.count = count      # how many consecutive calls (None = ∞)
+        self.arg = arg          # delay seconds
+
+    def matches(self, n):
+        if n < self.nth:
+            return False
+        if self.count is None:
+            return True
+        return n < self.nth + self.count
+
+
+class FaultInjector:
+    """Deterministic fault injection on the PS socket plumbing.
+
+    Spec grammar (``MXNET_FAULT_SPEC``, rules joined by ``;``)::
+
+        point:action@nth[xcount][:arg]
+
+    * ``point`` — where the hook fires: ``send`` / ``recv`` (worker
+      client request/reply plumbing), ``ping`` (worker heartbeat
+      sends), ``srv_send`` / ``srv_recv`` (server-side plumbing, for a
+      server process running with the env set).
+    * ``action`` — ``drop`` (close the socket and fail before any
+      bytes move), ``disconnect`` (transmit *half* the frame, then
+      close — the peer sees a torn message; on recv points identical
+      to drop), ``delay`` (sleep ``arg`` seconds, then proceed).
+    * ``@nth`` — fire on the nth call of that point (1-based), counted
+      per point from injector installation.
+    * ``xcount`` — fire for that many consecutive calls (``x*`` =
+      every call from nth on).
+
+    Example: ``send:disconnect@4;recv:drop@6`` tears the 4th request
+    frame mid-message and severs the connection before the 6th reply
+    read. Counting is process-wide per point, under a lock, so a
+    single-client test replays identically every run.
+
+    ``fired`` records every injection as ``(point, n, action)`` for
+    test assertions."""
+
+    def __init__(self, spec):
+        self.spec = spec or ""
+        self._rules = []
+        for raw in filter(None,
+                          (s.strip() for s in self.spec.split(";"))):
+            m = _RULE_RE.match(raw)
+            if m is None:
+                raise ValueError(
+                    "bad MXNET_FAULT_SPEC rule %r (want "
+                    "point:action@nth[xcount][:seconds])" % raw)
+            count = m.group("count")
+            self._rules.append(_Rule(
+                m.group("point"), m.group("action"), int(m.group("nth")),
+                None if count == "*" else int(count or 1),
+                float(m.group("arg") or 0.0)))
+        self._counts = {}
+        self._lock = threading.Lock()
+        self.fired = []
+
+    def _step(self, point):
+        """Advance the point's call counter; return the rule to apply
+        (or None)."""
+        with self._lock:
+            n = self._counts.get(point, 0) + 1
+            self._counts[point] = n
+            for rule in self._rules:
+                if rule.point == point and rule.matches(n):
+                    self.fired.append((point, n, rule.action))
+                    return rule
+        return None
+
+    @staticmethod
+    def _sever(sock):
+        try:
+            sock.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass  # already dead — severing twice is the point, not a bug
+        sock.close()
+
+    # -- hooks (called from ps_async._send_msg/_recv_msg) -------------------
+    def on_send(self, point, sock, frame):
+        """Before a frame is written. May sleep, or sever the
+        connection (optionally after leaking half the frame) and raise
+        FaultInjected — the caller must not then write."""
+        rule = self._step(point)
+        if rule is None:
+            return
+        if rule.action == "delay":
+            time.sleep(rule.arg)
+            return
+        if rule.action == "disconnect":
+            # mid-message disconnect: the peer receives a torn frame
+            try:
+                sock.sendall(frame[:max(1, len(frame) // 2)])
+            except OSError:
+                pass  # peer already gone; the sever below still holds
+        self._sever(sock)
+        raise FaultInjected("injected %s at %s #%d"
+                            % (rule.action, point,
+                               self._counts.get(point, 0)))
+
+    def on_recv(self, point, sock):
+        """Before a frame is read. drop/disconnect sever the socket and
+        raise; delay sleeps."""
+        rule = self._step(point)
+        if rule is None:
+            return
+        if rule.action == "delay":
+            time.sleep(rule.arg)
+            return
+        self._sever(sock)
+        raise FaultInjected("injected %s at %s #%d"
+                            % (rule.action, point,
+                               self._counts.get(point, 0)))
+
+
+_installed = None          # explicitly installed injector (tests)
+_env_injector = None       # injector built from MXNET_FAULT_SPEC
+_env_spec = None           # the spec string _env_injector was built from
+_env_lock = threading.Lock()
+
+
+def install_fault_injector(injector):
+    """Install (or, with None, remove) the process-wide injector.
+    Explicit installation overrides ``MXNET_FAULT_SPEC``."""
+    global _installed
+    _installed = injector
+    return injector
+
+
+def active_injector():
+    """The injector in effect: the explicitly installed one, else one
+    lazily built from ``MXNET_FAULT_SPEC`` (rebuilt if the env value
+    changes), else None."""
+    global _env_injector, _env_spec
+    if _installed is not None:
+        return _installed
+    spec = os.environ.get("MXNET_FAULT_SPEC") or None
+    if spec != _env_spec:
+        with _env_lock:
+            if spec != _env_spec:
+                _env_injector = FaultInjector(spec) if spec else None
+                _env_spec = spec
+    return _env_injector

@@ -13,6 +13,7 @@ Reference call stack being replaced: SURVEY.md §3.1 (fit loop internals).
 from __future__ import annotations
 
 import functools
+import os
 
 import jax
 import jax.numpy as jnp
@@ -245,13 +246,22 @@ class TrainStep:
         begin_epoch = 0
         n_update = 0
         if checkpoint_prefix and resume:
+            import zipfile as _zipfile
+
+            from ..module.base_module import _newest_readable
+
             found = sorted(
                 p for p in _glob.glob(checkpoint_prefix + "_*.npz")
                 if _re.search(r"_\d{4}\.npz$", p))
-            if found:
-                latest = found[-1][:-len(".npz")]
+            # model/optimizer MISMATCH (ValueError) is NOT in the torn
+            # set: it must fail loudly, not fall back silently
+            path, loaded = _newest_readable(
+                found, lambda p: self.load_state(p[:-len(".npz")]),
+                (OSError, EOFError, _zipfile.BadZipFile), log)
+            if path is not None:
+                state = loaded
+                latest = path[:-len(".npz")]
                 begin_epoch = int(latest.rsplit("_", 1)[1]) + 1
-                state = self.load_state(latest)
                 try:
                     with open(latest + ".meta.json") as f:
                         n_update = int(_json.load(f)["n_update"])
@@ -300,8 +310,10 @@ class TrainStep:
                     (epoch + 1) % checkpoint_period == 0:
                 ck = "%s_%04d" % (checkpoint_prefix, epoch)
                 self.save_state(ck, state)
-                with open(ck + ".meta.json", "w") as f:
+                tmp = ck + ".meta.json.tmp"
+                with open(tmp, "w") as f:
                     _json.dump({"n_update": n_update}, f)
+                os.replace(tmp, ck + ".meta.json")
             if epoch_end_callback:
                 epoch_end_callback(epoch, state)
         return state, metric.get()[1]
@@ -324,7 +336,12 @@ class TrainStep:
                 blob["o%d:%s" % (i, n)] = np.asarray(s)
         for n, v in aux.items():
             blob["a:%s" % n] = np.asarray(v)
-        np.savez(prefix + ".npz", **blob)
+        # atomic publish: the crash-resume story depends on the newest
+        # checkpoint never being a torn file — write aside, then rename
+        tmp = prefix + ".npz.tmp"
+        with open(tmp, "wb") as f:
+            np.savez(f, **blob)
+        os.replace(tmp, prefix + ".npz")
         return prefix + ".npz"
 
     def load_state(self, prefix):

@@ -19,6 +19,9 @@ import pytest
 
 import mxnet_tpu as mx
 from mxnet_tpu.parallel.ps_async import AsyncPSClient, AsyncPSServer
+from mxnet_tpu.parallel.resilience import (DeadWorkerError, FaultInjected,
+                                           FaultInjector, RetryPolicy,
+                                           install_fault_injector)
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
@@ -506,3 +509,547 @@ def test_concurrent_push_stress_no_lost_updates():
         boot.close()
     finally:
         srv.stop()
+
+
+# ---------------------------------------------------------------------------
+# failure paths (resilience layer): driven by the deterministic
+# FaultInjector — no real process kills needed for the fast tier; the
+# multi-process variant at the bottom is marked slow.
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(autouse=True)
+def _no_leaked_injector():
+    """A failing fault test must not leak its injector into the next
+    test's socket traffic."""
+    yield
+    install_fault_injector(None)
+
+
+@pytest.mark.faults
+def test_retry_policy_deterministic_backoff_and_classification():
+    import socket as socket_mod
+
+    a, b = RetryPolicy(seed="w3"), RetryPolicy(seed="w3")
+    assert [a.delay(i) for i in range(1, 6)] == \
+        [b.delay(i) for i in range(1, 6)], "jitter must be deterministic"
+    # backoff grows (up to the cap) and jitter never exceeds the raw delay
+    raw = RetryPolicy(seed=0, base_delay=0.1, max_delay=60.0)
+    assert raw.delay(4) > raw.delay(1)
+    assert raw.delay(1) <= 0.1
+    # transport faults retry; cohort death and application errors do not
+    assert RetryPolicy.is_transient(ConnectionResetError())
+    assert RetryPolicy.is_transient(socket_mod.timeout())
+    assert RetryPolicy.is_transient(FaultInjected("x"))
+    assert not RetryPolicy.is_transient(DeadWorkerError("x"))
+    assert not RetryPolicy.is_transient(ValueError("x"))
+    assert not RetryPolicy.is_transient(RuntimeError("async PS error"))
+
+
+@pytest.mark.faults
+def test_fault_spec_parsing_and_counting():
+    with pytest.raises(ValueError, match="MXNET_FAULT_SPEC"):
+        FaultInjector("send:explode@1")
+    with pytest.raises(ValueError, match="MXNET_FAULT_SPEC"):
+        FaultInjector("send@1")
+
+    class _Sock:
+        def shutdown(self, *_a):
+            pass
+
+        def close(self):
+            pass
+
+    inj = FaultInjector("send:drop@2x2")
+    hits = []
+    for _ in range(5):
+        try:
+            inj.on_send("send", _Sock(), b"xx")
+            hits.append(False)
+        except FaultInjected:
+            hits.append(True)
+    assert hits == [False, True, True, False, False]
+    assert inj.fired == [("send", 2, "drop"), ("send", 3, "drop")]
+    # x*: every call from nth on; counts are per point
+    inj = FaultInjector("recv:drop@2x*")
+    inj._step("send")            # other points don't advance 'recv'
+    with pytest.raises(FaultInjected):
+        [inj.on_recv("recv", _Sock()) for _ in range(2)]
+
+
+@pytest.mark.faults
+def test_mid_push_disconnect_same_final_weights(monkeypatch):
+    """The acceptance gate: with MXNET_FAULT_SPEC-style injection
+    tearing a push frame mid-message (and severing a pull reply), a
+    training-style push loop lands on the SAME final weights as the
+    fault-free run — the seq-number dedup proves the server never
+    double-applies a retried gradient."""
+    monkeypatch.setenv("MXNET_PS_RETRY_BASE", "0.01")
+
+    def run(spec):
+        srv = AsyncPSServer(host="127.0.0.1", port=0, num_workers=1)
+        threading.Thread(target=srv.serve_forever, daemon=True).start()
+        c = _client(srv)
+        c.set_optimizer(mx.optimizer.SGD(learning_rate=0.1,
+                                         rescale_grad=1.0))
+        c.init("w", np.ones((4,), np.float32))
+        inj = None
+        if spec:
+            inj = install_fault_injector(FaultInjector(spec))
+        try:
+            for i in range(8):
+                c.push("w", np.full((4,), float(i % 3), np.float32))
+        finally:
+            install_fault_injector(None)
+        w = np.asarray(c.pull("w"))
+        c.close()
+        srv.stop()
+        return w, inj
+
+    w_plain, _ = run(None)
+    w_fault, inj = run("send:disconnect@3;recv:drop@6")
+    assert inj.fired == [("send", 3, "disconnect"),
+                         ("recv", 6, "drop")]
+    np.testing.assert_allclose(w_fault, w_plain)
+
+
+@pytest.mark.faults
+def test_drop_connection_mid_pull_retries(monkeypatch):
+    """Severing the connection between the pull request and its reply
+    must transparently reconnect and re-pull (pull is idempotent — no
+    dedup involvement)."""
+    monkeypatch.setenv("MXNET_PS_RETRY_BASE", "0.01")
+    srv = AsyncPSServer(host="127.0.0.1", port=0, num_workers=1)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    try:
+        c = _client(srv)
+        c.init("w", np.full((3,), 7.0, np.float32))
+        inj = install_fault_injector(FaultInjector("recv:drop@1"))
+        try:
+            np.testing.assert_allclose(c.pull("w"), 7.0)
+        finally:
+            install_fault_injector(None)
+        assert inj.fired == [("recv", 1, "drop")]
+        c.close()
+    finally:
+        srv.stop()
+
+
+@pytest.mark.faults
+def test_dead_server_push_fails_cleanly_after_bounded_retries(
+        monkeypatch):
+    """kill-server-mid-push: when every (re)send fails, the client must
+    surface a ConnectionError after its bounded retry schedule — never
+    hang, never succeed silently."""
+    import time as time_mod
+
+    monkeypatch.setenv("MXNET_PS_RETRY_MAX", "2")
+    monkeypatch.setenv("MXNET_PS_RETRY_BASE", "0.01")
+    srv = AsyncPSServer(host="127.0.0.1", port=0, num_workers=1)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    try:
+        c = _client(srv)
+        c.init("w", np.zeros((2,), np.float32))
+        inj = install_fault_injector(FaultInjector("send:drop@1x*"))
+        t0 = time_mod.time()
+        with pytest.raises(ConnectionError):
+            c.push("w", np.ones((2,), np.float32))
+        install_fault_injector(None)
+        assert time_mod.time() - t0 < 30
+        # initial attempt + exactly max_retries replays
+        assert len(inj.fired) == 3
+        # the value never moved: no partial application happened
+        np.testing.assert_allclose(c.pull("w"), 0.0)
+        c.close()
+    finally:
+        install_fault_injector(None)
+        srv.stop()
+
+
+def _two_workers(srv, monkeypatch):
+    monkeypatch.setenv("DMLC_WORKER_ID", "0")
+    a = _client(srv)
+    monkeypatch.setenv("DMLC_WORKER_ID", "1")
+    b = _client(srv)
+    return a, b
+
+
+def _kill_without_bye(c):
+    """Simulate a worker death: heartbeat stops and the socket closes
+    with no bye (what a SIGKILL'd process looks like to the server)."""
+    c._hb_stop.set()
+    if c._hb_thread is not None:
+        c._hb_thread.join(timeout=10)
+    with c._lock:
+        c._drop_connection_locked()
+
+
+@pytest.mark.faults
+def test_worker_death_during_barrier_releases_with_error(monkeypatch):
+    """A dead peer used to leave survivors spinning in the barrier
+    until job end; now the heartbeat monitor releases them with an
+    explicit DeadWorkerError within the heartbeat timeout."""
+    import time as time_mod
+
+    monkeypatch.setenv("MXNET_PS_HEARTBEAT_INTERVAL", "0.2")
+    monkeypatch.setenv("MXNET_PS_HEARTBEAT_TIMEOUT", "1.0")
+    srv = AsyncPSServer(host="127.0.0.1", port=0, num_workers=2)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    try:
+        a, b = _two_workers(srv, monkeypatch)
+        time_mod.sleep(0.6)          # b must have pinged at least once
+        _kill_without_bye(b)
+        t0 = time_mod.time()
+        with pytest.raises(DeadWorkerError):
+            a.barrier()
+        assert time_mod.time() - t0 < 10
+        # the cohort is broken for good: later barriers fail fast
+        with pytest.raises(DeadWorkerError):
+            a.barrier()
+        a.close()
+    finally:
+        srv.stop()
+
+
+@pytest.mark.faults
+def test_worker_death_elastic_shrinks_cohort(monkeypatch):
+    """MXNET_PS_ELASTIC=1: instead of failing the job, a dead worker
+    shrinks _num_workers — the survivor's barrier RELEASES and training
+    degrades gracefully."""
+    import time as time_mod
+
+    monkeypatch.setenv("MXNET_PS_HEARTBEAT_INTERVAL", "0.2")
+    monkeypatch.setenv("MXNET_PS_HEARTBEAT_TIMEOUT", "1.0")
+    monkeypatch.setenv("MXNET_PS_ELASTIC", "1")
+    srv = AsyncPSServer(host="127.0.0.1", port=0, num_workers=2)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    try:
+        a, b = _two_workers(srv, monkeypatch)
+        time_mod.sleep(0.6)
+        _kill_without_bye(b)
+
+        done = []
+        t = threading.Thread(target=lambda: (a.barrier(),
+                                             done.append(True)),
+                             daemon=True)
+        t.start()
+        t.join(timeout=15)
+        assert done == [True], \
+            "elastic cohort shrink did not release the barrier"
+        assert srv._num_workers == 1
+        # pushes keep applying for the survivor
+        a.init("w", np.zeros((2,), np.float32))
+        a.push("w", np.full((2,), 3.0, np.float32))
+        np.testing.assert_allclose(a.pull("w"), 3.0)
+        a.close()
+    finally:
+        srv.stop()
+
+
+@pytest.mark.faults
+def test_barrier_replay_is_idempotent(monkeypatch):
+    """A client whose connection dies while it WAITS in a barrier
+    replays the same barrier op on reconnect; membership is a set
+    keyed by client id, so the replay must not double-count (a raw
+    counter would release the barrier with a worker missing)."""
+    monkeypatch.setenv("MXNET_PS_RETRY_BASE", "0.01")
+    srv = AsyncPSServer(host="127.0.0.1", port=0, num_workers=2)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    try:
+        a, b = _two_workers(srv, monkeypatch)
+        released = []
+
+        def barrier_through_fault():
+            # sever a's connection right before it reads the barrier
+            # release — forcing reconnect + replay of the SAME barrier
+            install_fault_injector(FaultInjector("recv:drop@1"))
+            try:
+                a.barrier()
+            finally:
+                install_fault_injector(None)
+            released.append("a")
+
+        t = threading.Thread(target=barrier_through_fault, daemon=True)
+        t.start()
+        import time as time_mod
+        time_mod.sleep(0.7)   # a has entered (and replayed) the barrier
+        assert not released, \
+            "barrier released before the second worker arrived"
+        b.barrier()
+        t.join(timeout=15)
+        assert released == ["a"]
+        a.close()
+        b.close()
+    finally:
+        srv.stop()
+
+
+@pytest.mark.faults
+def test_replay_of_inflight_push_waits_not_reexecutes(monkeypatch):
+    """A per-attempt timeout can fire while the server is STILL
+    applying the push (slow optimizer, contended key). The client's
+    replay must then block until the original completes and reuse its
+    cached reply — re-executing would double-apply the gradient."""
+    import time as time_mod
+    from mxnet_tpu import optimizer as opt_mod
+
+    monkeypatch.setenv("MXNET_PS_RETRY_BASE", "0.01")
+    monkeypatch.setenv("MXNET_PS_OP_TIMEOUT", "0.3")
+    srv = AsyncPSServer(host="127.0.0.1", port=0, num_workers=1)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    try:
+        c = _client(srv)
+        c.init("w", np.zeros((2,), np.float32))
+        real = opt_mod.get_updater(
+            opt_mod.SGD(learning_rate=1.0, rescale_grad=1.0))
+        applies = []
+
+        def slow_updater(index, grad, weight):
+            applies.append(index)
+            time_mod.sleep(0.8)          # > MXNET_PS_OP_TIMEOUT
+            real(index, grad, weight)
+
+        srv._updater = slow_updater
+        c.push("w", np.ones((2,), np.float32))
+        assert len(applies) == 1, applies
+        srv._updater = None
+        np.testing.assert_allclose(c.pull("w"), -1.0)
+        c.close()
+    finally:
+        srv.stop()
+
+
+@pytest.mark.faults
+def test_concurrent_op_cannot_evict_dedup_during_backoff(monkeypatch):
+    """Two threads share one client. Thread A's push reply is lost, so
+    A backs off and replays; thread B's ops must NOT reach the wire in
+    between — the server's one-slot dedup would forget A's completed
+    push and A's replay would apply it a second time."""
+    monkeypatch.setenv("MXNET_PS_RETRY_BASE", "0.05")
+    srv = AsyncPSServer(host="127.0.0.1", port=0, num_workers=1)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    try:
+        c = _client(srv)
+        c.set_optimizer(mx.optimizer.SGD(learning_rate=1.0,
+                                         rescale_grad=1.0))
+        c.init("w", np.zeros((2,), np.float32))
+        inj = install_fault_injector(FaultInjector("recv:drop@1"))
+        try:
+            threads = [threading.Thread(
+                target=lambda: [c.push("w", np.ones((2,), np.float32))
+                                for _ in range(3)]) for _ in range(2)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(timeout=30)
+        finally:
+            install_fault_injector(None)
+        assert inj.fired == [("recv", 1, "drop")]
+        # exactly-once: 6 pushes of grad 1 at lr 1 from w0=0
+        np.testing.assert_allclose(c.pull("w"), -6.0)
+        c.close()
+    finally:
+        srv.stop()
+
+
+@pytest.mark.faults
+def test_clean_bye_is_not_a_death(monkeypatch):
+    """A worker that says BYE and leaves stops pinging — the monitor
+    must read that silence as a clean departure, not a heartbeat-lapse
+    death (which would abort the survivors' barriers)."""
+    import time as time_mod
+
+    monkeypatch.setenv("MXNET_PS_HEARTBEAT_INTERVAL", "0.2")
+    monkeypatch.setenv("MXNET_PS_HEARTBEAT_TIMEOUT", "1.0")
+    srv = AsyncPSServer(host="127.0.0.1", port=0, num_workers=2)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    try:
+        a, b = _two_workers(srv, monkeypatch)
+        time_mod.sleep(0.6)          # both have pinged
+        b.close()                    # clean bye
+        time_mod.sleep(2.0)          # well past the heartbeat timeout
+        assert not srv._dead_workers
+        assert srv._barrier_abort is None
+        a.close()
+    finally:
+        srv.stop()
+
+
+@pytest.mark.faults
+def test_false_death_revives_on_next_ping_elastic(monkeypatch):
+    """A worker stalled past the heartbeat timeout (GC/VM pause) gets
+    declared dead — but it is NOT dead. Its next ping must readmit it
+    and regrow the elastic cohort, and barriers must again require the
+    full cohort (a stale 'dead' marking would let either worker's
+    barrier release alone)."""
+    import time as time_mod
+
+    monkeypatch.setenv("MXNET_PS_HEARTBEAT_INTERVAL", "0.2")
+    monkeypatch.setenv("MXNET_PS_HEARTBEAT_TIMEOUT", "1.2")
+    monkeypatch.setenv("MXNET_PS_ELASTIC", "1")
+    srv = AsyncPSServer(host="127.0.0.1", port=0, num_workers=2)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    try:
+        a, b = _two_workers(srv, monkeypatch)
+        time_mod.sleep(0.5)
+        # simulate the pause: b's heartbeat stops, but b never died
+        b._hb_stop.set()
+        b._hb_thread.join(timeout=10)
+        deadline = time_mod.time() + 15
+        while 1 not in srv._dead_workers and \
+                time_mod.time() < deadline:
+            time_mod.sleep(0.05)
+        assert 1 in srv._dead_workers
+        assert srv._num_workers == 1
+        # b resumes: one ping readmits it and regrows the cohort
+        b._call("ping", b._wid)
+        assert 1 not in srv._dead_workers
+        assert srv._num_workers == 2
+        # barriers synchronize over the FULL cohort again
+        released = []
+        t = threading.Thread(target=lambda: (a.barrier(),
+                                             released.append("a")),
+                             daemon=True)
+        t.start()
+        time_mod.sleep(0.5)
+        assert not released, "barrier released with one worker missing"
+        b.barrier()
+        t.join(timeout=15)
+        assert released == ["a"]
+        a.close()
+        b.close()
+    finally:
+        srv.stop()
+
+
+@pytest.mark.faults
+def test_elastic_floor_death_then_revive_does_not_inflate(monkeypatch):
+    """A sole-worker elastic cohort is floored at 1 on death; the
+    revive must NOT regrow past the configured size (an inflated
+    cohort would deadlock every later barrier waiting for a worker
+    that cannot exist)."""
+    import time as time_mod
+
+    monkeypatch.setenv("MXNET_PS_HEARTBEAT_INTERVAL", "0.2")
+    monkeypatch.setenv("MXNET_PS_HEARTBEAT_TIMEOUT", "1.2")
+    monkeypatch.setenv("MXNET_PS_ELASTIC", "1")
+    monkeypatch.setenv("DMLC_WORKER_ID", "0")
+    srv = AsyncPSServer(host="127.0.0.1", port=0, num_workers=1)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    try:
+        a = _client(srv)
+        time_mod.sleep(0.4)
+        a._hb_stop.set()
+        a._hb_thread.join(timeout=10)
+        deadline = time_mod.time() + 15
+        while 0 not in srv._dead_workers and \
+                time_mod.time() < deadline:
+            time_mod.sleep(0.05)
+        assert 0 in srv._dead_workers
+        assert srv._num_workers == 1     # floored, never 0
+        a._call("ping", a._wid)
+        assert 0 not in srv._dead_workers
+        assert srv._num_workers == 1     # revive must not inflate to 2
+        a.barrier()                      # sole worker releases alone
+        a.close()
+    finally:
+        srv.stop()
+
+
+@pytest.mark.faults
+def test_full_cohort_revival_clears_barrier_abort(monkeypatch):
+    """Non-elastic: a false death (GC stall) sets the barrier abort,
+    but once EVERY declared-dead worker provably revives the abort
+    must clear — a healthy cohort must not keep failing barriers."""
+    import time as time_mod
+
+    monkeypatch.setenv("MXNET_PS_HEARTBEAT_INTERVAL", "0.2")
+    monkeypatch.setenv("MXNET_PS_HEARTBEAT_TIMEOUT", "1.2")
+    srv = AsyncPSServer(host="127.0.0.1", port=0, num_workers=2)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    try:
+        a, b = _two_workers(srv, monkeypatch)
+        time_mod.sleep(0.5)
+        b._hb_stop.set()                 # b stalls, but never died
+        b._hb_thread.join(timeout=10)
+        with pytest.raises(DeadWorkerError):
+            a.barrier()
+        # b resumes: its ping falsifies the verdict and clears the abort
+        b._call("ping", b._wid)
+        assert srv._barrier_abort is None
+        released = []
+        t = threading.Thread(target=lambda: (a.barrier(),
+                                             released.append("a")),
+                             daemon=True)
+        t.start()
+        time_mod.sleep(0.3)
+        assert not released
+        b.barrier()
+        t.join(timeout=15)
+        assert released == ["a"]
+        a.close()
+        b.close()
+    finally:
+        srv.stop()
+
+
+_FAULT_WORKER_SRC = r"""
+import os, sys
+sys.path.insert(0, os.environ["REPO"])
+import numpy as np
+import mxnet_tpu as mx
+
+kv = mx.kv.create("dist_async")
+kv.set_optimizer(mx.optimizer.SGD(learning_rate=0.1, rescale_grad=1.0))
+kv.init("w", mx.nd.ones((2, 3)))
+for _ in range(10):
+    kv.push("w", mx.nd.ones((2, 3)))
+out = mx.nd.zeros((2, 3))
+kv.pull("w", out=out)
+# exactly-once application: 10 pushes of grad 1 at lr .1 from w0=1
+np.testing.assert_allclose(out.asnumpy(), 0.0, atol=1e-6)
+print("FAULT_WORKER_OK")
+"""
+
+
+@pytest.mark.slow
+@pytest.mark.faults
+def test_dist_async_multiprocess_with_fault_spec(tmp_path):
+    """The full mx.kv.create('dist_async') surface under
+    MXNET_FAULT_SPEC: the worker process's transport is torn mid-push
+    and mid-pull, and the job still lands on the exact fault-free
+    weights (server-side dedup, reconnect-and-replay)."""
+    port = _free_port()
+    base_env = dict(os.environ)
+    base_env.update({
+        "REPO": REPO,
+        "PYTHONPATH": REPO,
+        "JAX_PLATFORMS": "cpu",
+        "DMLC_PS_ROOT_URI": "127.0.0.1",
+        "DMLC_PS_ROOT_PORT": str(port),
+        "DMLC_NUM_WORKER": "1",
+        "MXNET_KVSTORE_TYPE": "dist_async",
+        "MXNET_PS_RETRY_BASE": "0.01",
+    })
+    (tmp_path / "server.py").write_text(_SERVER_SRC)
+    (tmp_path / "worker.py").write_text(_FAULT_WORKER_SRC)
+
+    server = subprocess.Popen(
+        [sys.executable, str(tmp_path / "server.py")],
+        env=dict(base_env, DMLC_ROLE="server"),
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True)
+    worker = subprocess.Popen(
+        [sys.executable, str(tmp_path / "worker.py")],
+        env=dict(base_env, DMLC_ROLE="worker", DMLC_WORKER_ID="0",
+                 MXNET_FAULT_SPEC="send:disconnect@4;recv:drop@7"),
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True)
+    try:
+        out, _ = worker.communicate(timeout=180)
+        assert worker.returncode == 0, "worker:\n%s" % out[-900:]
+        assert "FAULT_WORKER_OK" in out
+        sout, _ = server.communicate(timeout=60)
+        assert server.returncode == 0, "server:\n%s" % sout[-900:]
+    finally:
+        for p in (worker, server):
+            if p.poll() is None:
+                p.kill()

@@ -471,3 +471,38 @@ def test_train_step_export_compiled_roundtrip(tmp_path):
         raise AssertionError("expected shape error")
     except ValueError as e:
         assert "shape" in str(e)
+
+
+def test_train_step_resume_skips_torn_checkpoint(tmp_path):
+    """Crash-resume robustness (docs/robustness.md): save_state
+    publishes atomically (write-aside + rename), and fit's resume scan
+    falls back past a torn newest checkpoint instead of crashing the
+    restarted worker. Model-mismatch errors still fail loudly."""
+    from mxnet_tpu import io
+
+    X, y = _toy(n=96)
+    prefix = str(tmp_path / "ck")
+
+    def make():
+        train = io.NDArrayIter(X, y, batch_size=32, shuffle=True)
+        step = make_train_step(_mlp(), optimizer="sgd")
+        return step, train
+
+    step, train = make()
+    step.fit(train, num_epoch=2, initializer=Xavier(), lr=0.5,
+             checkpoint_prefix=prefix)
+    # simulate a crash mid-save predating the atomic rename: a torn
+    # .npz as the NEWEST checkpoint
+    with open(prefix + "_0002.npz", "wb") as f:
+        f.write(b"PK\x03\x04torn")
+    resumed = []
+    step2, train2 = make()
+    step2.fit(train2, num_epoch=4, initializer=Xavier(), lr=0.5,
+              checkpoint_prefix=prefix,
+              epoch_end_callback=lambda e, s: resumed.append(e))
+    # fell back to ck_0001 (epoch 1 done) -> trained epochs 2 and 3;
+    # the torn 0002 was overwritten by a good one along the way
+    assert resumed == [2, 3], resumed
+    step3, _ = make()
+    state3 = step3.load_state(prefix + "_0002")
+    assert state3 is not None
