@@ -62,12 +62,22 @@ def framework_bn(x, gamma, beta, eps=1e-3):
     """The r4 one-pass/closed-form core. Since the default flipped
     back to two-pass autodiff (the 'two_pass'/naive column here IS the
     default now), this column must pin the routing explicitly or the
-    A/B silently times the default twice."""
-    os.environ["MXNET_BN_IMPL"] = "onepass"
+    A/B silently times the default twice. The routing env var is read
+    at trace time inside _batch_norm, so save/restore around the call
+    keeps the override from leaking into the rest of the process (the
+    naive/pallas columns, or anything importing this module)."""
     from mxnet_tpu.ops.nn import _batch_norm
     C = x.shape[1]
-    return _batch_norm(x, gamma, beta, jnp.zeros(C), jnp.ones(C),
-                       eps=eps, fix_gamma=False, is_train=True)[0]
+    prev = os.environ.get("MXNET_BN_IMPL")
+    os.environ["MXNET_BN_IMPL"] = "onepass"
+    try:
+        return _batch_norm(x, gamma, beta, jnp.zeros(C), jnp.ones(C),
+                           eps=eps, fix_gamma=False, is_train=True)[0]
+    finally:
+        if prev is None:
+            os.environ.pop("MXNET_BN_IMPL", None)
+        else:
+            os.environ["MXNET_BN_IMPL"] = prev
 
 
 def pallas_bn(x, gamma, beta, eps=1e-3):

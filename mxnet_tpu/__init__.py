@@ -23,6 +23,21 @@ if _prec != "default":
                        {"high": "bfloat16_3x", "highest": "float32"}.get(
                            _prec, _prec))
 
+# MXNET_COMPILE_CACHE: persistent XLA compilation cache so a warm
+# restart (crash-resume, elastic rejoin, repeated bench sessions) skips
+# the 20-40 s per-shape compile. Thresholds dropped to cache everything
+# — the knob is an explicit opt-in, so "cache all of it" is the intent.
+_cc = _config.get("MXNET_COMPILE_CACHE")
+if _cc:
+    for _k, _v in (("jax_compilation_cache_dir", _cc),
+                   ("jax_persistent_cache_min_compile_time_secs", 0.0),
+                   ("jax_persistent_cache_min_entry_size_bytes", -1)):
+        try:
+            _jax.config.update(_k, _v)
+        except (AttributeError, ValueError):
+            # older jax without this knob: best-effort, never fatal
+            pass
+
 from . import base
 from .base import MXNetError
 

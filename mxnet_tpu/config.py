@@ -119,3 +119,11 @@ define("MXNET_PROFILER_MODE", bool, False,
        "False = symbolic executor events only, True = every eager op")
 define("MXNET_PROFILER_XPLANE", str, "",
        "directory for jax.profiler device traces (empty = disabled)")
+define("MXNET_DISPATCH_AHEAD", int, 2,
+       "bounded async-dispatch window for the fit hot loops: how many "
+       "steps may be in flight before the loop blocks on the step K "
+       "back (1 = fully synchronous stepping)")
+define("MXNET_COMPILE_CACHE", str, "",
+       "directory for JAX's persistent compilation cache — warm "
+       "restarts skip XLA recompiles (wired at package import; empty "
+       "= disabled)")

@@ -174,13 +174,26 @@ class ResizeIter(_BatchDelegate, DataIter):
         return True
 
 
+class _WorkerError:
+    """Carrier for a non-StopIteration worker failure: re-raised in the
+    consumer thread instead of starving its queue forever."""
+
+    def __init__(self, exc):
+        self.exc = exc
+
+
 class _PrefetchWorker(threading.Thread):
     """One background thread per wrapped iterator: serves 'next'/'reset'
-    commands so batch assembly overlaps device compute."""
+    commands so batch assembly overlaps device compute. With a
+    place_fn, the worker also DISPATCHES the batch's device placement
+    (an async H2D) before handing it over — the double-buffer stage:
+    batch t+1's transfer is in flight while the consumer's step t
+    computes."""
 
-    def __init__(self, it):
+    def __init__(self, it, place_fn=None):
         super().__init__(daemon=True)
         self.it = it
+        self.place_fn = place_fn
         self.cmds = queue.Queue()
         self.outs = queue.Queue()
         self.start()
@@ -195,26 +208,49 @@ class _PrefetchWorker(threading.Thread):
                 self.outs.put(None)
             else:  # "next"
                 try:
-                    self.outs.put(self.it.next())
+                    item = self.it.next()
                 except StopIteration:
-                    self.outs.put(StopIteration)
+                    item = StopIteration
+                except Exception as e:  # noqa: BLE001 — surface it
+                    item = _WorkerError(e)
+                else:
+                    # outside the StopIteration guard: a StopIteration
+                    # escaping place_fn is a BUG to surface, not an
+                    # epoch end (only it.next() may signal that)
+                    if self.place_fn is not None:
+                        try:
+                            item.placed = self.place_fn(item)
+                        except Exception as e:  # noqa: BLE001
+                            item = _WorkerError(e)
+                self.outs.put(item)
 
 
 class PrefetchingIter(_BatchDelegate, DataIter):
     """Thread-backed prefetcher over one or more iterators (reference
     io.py:PrefetchingIter; C++ analogue iter_prefetcher.h). One worker
     thread per inner iterator; a 'next' command is always in flight so
-    the next batch is being assembled while the device computes."""
+    the next batch is being assembled while the device computes.
 
-    def __init__(self, iters, rename_data=None, rename_label=None):
+    place_fn (the device-prefetch stage): a callable applied to each
+    assembled DataBatch whose result lands on ``batch.placed`` — use
+    ``TrainStep.make_placer()`` to shard/place the feed on device. With
+    a single inner iterator it runs on the worker thread, so the H2D
+    dispatch itself is off the step loop; with multiple inner iterators
+    it runs at merge time (the merged batch is what needs placing)."""
+
+    def __init__(self, iters, rename_data=None, rename_label=None,
+                 place_fn=None):
         super().__init__()
         self.iters = iters if isinstance(iters, list) else [iters]
         if not self.iters:
             raise ValueError("need at least one iterator")
         self.rename_data = rename_data
         self.rename_label = rename_label
+        self._place_fn = place_fn
         self.batch_size = self.provide_data[0][1][0]
-        self._workers = [_PrefetchWorker(it) for it in self.iters]
+        worker_place = place_fn if len(self.iters) == 1 else None
+        self._workers = [_PrefetchWorker(it, worker_place)
+                         for it in self.iters]
         self._inflight = False
         self._request()
 
@@ -263,6 +299,9 @@ class PrefetchingIter(_BatchDelegate, DataIter):
         if not self._inflight:
             self._request()
         batches = self._collect()
+        for b in batches:
+            if isinstance(b, _WorkerError):
+                raise b.exc
         ended = [b is StopIteration for b in batches]
         if any(ended):
             if not all(ended):
@@ -278,6 +317,11 @@ class PrefetchingIter(_BatchDelegate, DataIter):
             batches[0].pad, batches[0].index,
             provide_data=self.provide_data,
             provide_label=self.provide_label)
+        if self._place_fn is not None:
+            placed = getattr(batches[0], "placed", None) \
+                if len(batches) == 1 else None
+            self.current_batch.placed = placed if placed is not None \
+                else self._place_fn(self.current_batch)
         self._request()          # keep the pipeline primed
         return True
 

@@ -294,8 +294,19 @@ class BaseModule:
 
     def _fit_epoch(self, train_data, epoch, eval_metric,
                    batch_end_callback, monitor):
-        """One epoch of the fit loop, with one-batch host prefetch so IO
-        overlaps the async device step."""
+        """One pipelined epoch of the fit loop: batch t+1 is staged
+        (prepare() dispatches its device placement) while step t runs,
+        the metric accumulates on device when it has a device impl (no
+        per-step host read — ``get()`` does the one blocking read), and
+        a bounded dispatch window (MXNET_DISPATCH_AHEAD) blocks on the
+        step K back so async dispatch can't run away from the device."""
+        from collections import deque
+
+        from .. import config as _config
+        from .. import profiler as _profiler
+
+        ahead = max(1, int(_config.get("MXNET_DISPATCH_AHEAD")))
+        inflight = deque()
         batches = iter(train_data)
         pending = next(batches, None)
         nbatch = 0
@@ -303,12 +314,20 @@ class BaseModule:
             batch = pending
             if monitor is not None:
                 monitor.tic()
-            self.forward_backward(batch)
-            self.update()
+            with _profiler.step_scope(nbatch):
+                self.forward_backward(batch)
+                self.update()
             pending = next(batches, None)
             if pending is not None:
-                self.prepare(pending)
+                self.prepare(pending)     # H2D of t+1 overlaps step t
             self.update_metric(eval_metric, batch.label)
+            outs = self.get_outputs()
+            if outs and hasattr(outs[0], "wait_to_read"):
+                inflight.append(outs[0])
+            while len(inflight) > ahead:
+                # the ONE allowed blocking sync per step: back-pressure
+                # on the step K back (counted via wait_to_read)
+                inflight.popleft().wait_to_read()
             if monitor is not None:
                 monitor.toc_print()
             if batch_end_callback is not None:

@@ -78,6 +78,7 @@ class DataParallelExecutorGroup:
             self.grad_req = {k: "null" for k in self.arg_names}
 
         self._mesh = self._build_mesh(contexts)
+        self._staged = None   # (batch-object, feeds) placed ahead
         self._total_exec_bytes = 0
         self.batch_size = None
         self.execs = []       # kept 1-long for API parity
@@ -288,13 +289,9 @@ class DataParallelExecutorGroup:
         return jax.device_put(array_data,
                               NamedSharding(self._mesh, P(*spec)))
 
-    def forward(self, data_batch, is_train=None):
-        """Split (=shard) and load data, run forward (reference
-        executor_group.py:forward)."""
-        if is_train is None:
-            is_train = self.for_training
-
-        executor = self.execs[0]
+    def _build_feeds(self, data_batch, is_train):
+        """Shard/place a batch's arrays for the executor (async H2D
+        dispatch — nothing blocks here)."""
         feeds = {}
         for name, arr in zip(self.data_names, data_batch.data):
             data = arr._data if isinstance(arr, NDArray) else \
@@ -306,6 +303,31 @@ class DataParallelExecutorGroup:
                     data = arr._data if isinstance(arr, NDArray) else \
                         _nd.array(arr)._data
                     feeds[name] = _wrap(self._shard(data))
+        return feeds
+
+    def stage_batch(self, data_batch, is_train=None):
+        """Dispatch the device placement of an UPCOMING batch now, so
+        its H2D overlaps the in-flight step; forward() adopts the
+        staged feed when handed the same batch object (the batch is
+        held by reference, so identity can't be recycled)."""
+        if is_train is None:
+            is_train = self.for_training
+        self._staged = (data_batch, self._build_feeds(data_batch,
+                                                      is_train))
+
+    def forward(self, data_batch, is_train=None):
+        """Split (=shard) and load data, run forward (reference
+        executor_group.py:forward)."""
+        if is_train is None:
+            is_train = self.for_training
+
+        executor = self.execs[0]
+        staged = self._staged
+        if staged is not None and staged[0] is data_batch:
+            feeds = staged[1]
+            self._staged = None
+        else:
+            feeds = self._build_feeds(data_batch, is_train)
         executor.forward(is_train=is_train, **feeds)
 
     def backward(self, out_grads=None):
@@ -338,11 +360,14 @@ class DataParallelExecutorGroup:
 
     def update_metric(self, eval_metric, labels):
         """Update metric with current outputs (reference
-        executor_group.py:update_metric)."""
+        executor_group.py:update_metric). Routed through the device
+        accumulator: metrics with a device impl stay on device (no
+        blocking host read per batch); the rest fall back to the host
+        path unchanged."""
         labels_ = {name: l for name, l in zip(self.label_names, labels or [])}
         preds = dict(zip(self.symbol.list_outputs(),
                          self.execs[0].outputs))
-        eval_metric.update_dict(labels_, preds)
+        eval_metric.update_dict(labels_, preds, device=True)
 
     def install_monitor(self, mon):
         for exe in self.execs:

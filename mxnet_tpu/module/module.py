@@ -398,7 +398,11 @@ class Module(BaseModule):
         self._exec_group.install_monitor(mon)
 
     def prepare(self, data_batch):
-        """No-op: jit specializations are created on demand in forward."""
+        """Stage the upcoming batch: dispatch its (sharded) device
+        placement now so the H2D overlaps the in-flight step (jit
+        specializations themselves are created on demand in forward)."""
+        if self.binded and self._exec_group is not None:
+            self._exec_group.stage_batch(data_batch)
 
 
 def _view(attr, needs_bind=False):

@@ -113,6 +113,8 @@ class NDArray:
         self._data = data if isinstance(data, jax.Array) else jnp.asarray(data)
 
     def asnumpy(self):
+        from .. import profiler
+        profiler.count_host_sync("asnumpy")
         arr = np.asarray(jax.device_get(self._data))
         if self._data.dtype == jnp.bfloat16:
             arr = arr.astype(np.float32)
@@ -133,9 +135,13 @@ class NDArray:
         return self.asnumpy().tolist()
 
     def wait_to_read(self):
+        from .. import profiler
+        profiler.count_host_sync("wait")
         self._data.block_until_ready()
 
     def wait_to_write(self):
+        from .. import profiler
+        profiler.count_host_sync("wait")
         self._data.block_until_ready()
 
     def copy(self):

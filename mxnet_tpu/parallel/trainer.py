@@ -134,6 +134,7 @@ class TrainStep:
         # sharding constraints inside the step
         self._eval_fn = _graph_eval_fn(symbol, mesh=mesh)
 
+        self._donate = bool(donate)
         step = self._build_step()
         self._jit_step = jax.jit(
             step, donate_argnums=(0, 1, 2) if donate else ())
@@ -209,15 +210,91 @@ class TrainStep:
             aux[n] = self._place_rep(init_v)
         return params, opt_state, aux
 
+    def _raw_feed(self, batch):
+        """Named feed dict from a DataBatch with NO host round trip:
+        NDArrays unwrap to their backing device arrays (the old path
+        paid an asnumpy D2H + re-upload per batch)."""
+        from ..ndarray import NDArray as _ND
+        feed = dict(zip(self.data_names, batch.data))
+        if batch.label is not None:
+            feed.update(zip(self.label_names, batch.label))
+        return {k: (v._data if isinstance(v, _ND) else v)
+                for k, v in feed.items()}
+
+    def make_placer(self):
+        """place_fn for ``io.PrefetchingIter(place_fn=...)``: assembles
+        the named feed and dispatches its device placement, so the H2D
+        for batch t+1 runs on the prefetch thread while step t
+        computes. ``fit`` picks the result up from ``batch.placed``."""
+        def place(batch):
+            return self.place_batch(self._raw_feed(batch))
+        return place
+
+    def _stage(self, batch):
+        """(batch, placed-feed): reuse an io-layer placement when the
+        iterator staged one, else dispatch it now."""
+        placed = getattr(batch, "placed", None)
+        if placed is None:
+            placed = self.place_batch(self._raw_feed(batch))
+        return batch, placed
+
+    def _metric_fused_step(self, metric):
+        """One compiled program: train step + on-device metric update.
+        The metric stats tree rides along as an extra carry, so a full
+        epoch dispatches without a single device→host read."""
+        raw_step = self._build_step()
+        label_names = list(self.label_names)
+
+        def step_with_metric(params, opt_state, aux, batch, lr, rng,
+                             mstats):
+            (p, o, a), outs = raw_step(params, opt_state, aux, batch,
+                                       lr, rng)
+            stats = metric.device_update(
+                [batch[n] for n in label_names], list(outs))
+            return (p, o, a), outs, jax.tree.map(jnp.add, mstats, stats)
+
+        return raw_step, jax.jit(
+            step_with_metric,
+            donate_argnums=(0, 1, 2) if self._donate else ())
+
+    def _zero_metric_stats(self, raw_step, metric, state, placed, lr,
+                           rng):
+        """Zeros with the exact structure/dtypes of the metric's stats
+        tree, via abstract evaluation only (no compile, no execute)."""
+        params, opt_state, aux = state
+        _, outs_s = jax.eval_shape(raw_step, params, opt_state, aux,
+                                   placed, jnp.asarray(lr, jnp.float32),
+                                   rng)
+        stats_s = jax.eval_shape(
+            metric.device_update,
+            [placed[n] for n in self.label_names], list(outs_s))
+        return jax.tree.map(lambda s: jnp.zeros(s.shape, s.dtype),
+                            stats_s)
+
     def fit(self, train_data, num_epoch, initializer=None, lr=0.01,
             lr_scheduler=None, eval_metric="acc", state=None,
             arg_params=None, aux_params=None, checkpoint_prefix=None,
             checkpoint_period=1, resume=True, batch_end_callback=None,
-            epoch_end_callback=None, seed=0, logger=None):
+            epoch_end_callback=None, seed=0, logger=None,
+            fuse_metric=None, dispatch_ahead=None):
         """Module.fit for the SPMD path: epochs over a DataIter, metric
         tracking, periodic checkpointing, and crash resume — the
         reference fit-loop UX (base_module.py:fit) on the compiled
         train step.
+
+        The hot loop is pipelined: batch t+1 is placed (async H2D)
+        while step t runs, metrics accumulate ON DEVICE (fused into
+        the compiled step when the metric supports it — the single
+        host read happens in ``metric.get()`` at epoch end), and a
+        bounded dispatch window keeps at most MXNET_DISPATCH_AHEAD
+        steps in flight by blocking on the step K back — an
+        instrumented epoch performs at most one blocking host sync
+        per step.
+
+        fuse_metric: None (auto: fuse when the metric has a device
+            impl) | True | False (False = host metric path, as before).
+        dispatch_ahead: in-flight step window; default the
+            MXNET_DISPATCH_AHEAD env knob (2).
 
         train_data: DataIter yielding DataBatch (batch size must match
             across batches — one compiled program).
@@ -284,27 +361,75 @@ class TrainStep:
                                     shapes, arg_params=arg_params,
                                     aux_params=aux_params)
 
+        from collections import deque
+
+        from .. import config as _config
+        from .. import profiler as _profiler
+
+        ahead = dispatch_ahead if dispatch_ahead is not None \
+            else _config.get("MXNET_DISPATCH_AHEAD")
+        ahead = max(1, int(ahead))
+        use_dev = bool(getattr(metric, "supports_device_update", False))
+        fuse = use_dev if fuse_metric is None else bool(fuse_metric)
+        fuse = fuse and use_dev
+        raw_step = fused_step = None
+        if fuse:
+            raw_step, fused_step = self._metric_fused_step(metric)
+
         rng = jax.random.PRNGKey(seed)
+        inflight = deque()
         for epoch in range(begin_epoch, num_epoch):
             train_data.reset()
             metric.reset()
-            for nbatch, batch in enumerate(train_data):
-                feed = dict(zip(self.data_names, batch.data))
-                feed.update(zip(self.label_names, batch.label))
+            mstats = None
+            batches = iter(train_data)
+            nxt = next(batches, None)
+            staged = None if nxt is None else self._stage(nxt)
+            nbatch = 0
+            while staged is not None:
+                batch, placed = staged
                 cur_lr = lr_scheduler(n_update) if lr_scheduler else lr
-                placed = self.place_batch(
-                    {k: v.asnumpy() if hasattr(v, "asnumpy") else v
-                     for k, v in feed.items()})
-                state, outs = self(state, placed,
-                                   cur_lr, jax.random.fold_in(
-                                       rng, n_update))
+                step_rng = jax.random.fold_in(rng, n_update)
+                with _profiler.step_scope(n_update):
+                    if fuse:
+                        if mstats is None:
+                            mstats = self._zero_metric_stats(
+                                raw_step, metric, state, placed,
+                                cur_lr, step_rng)
+                        params, opt_state, aux = state
+                        (params, opt_state, aux), outs, mstats = \
+                            fused_step(params, opt_state, aux, placed,
+                                       jnp.asarray(cur_lr, jnp.float32),
+                                       step_rng, mstats)
+                        state = (params, opt_state, aux)
+                        # the metric VIEWS the live epoch totals, so
+                        # get() works mid-epoch (Speedometer) at the
+                        # cost of that caller's one sync
+                        metric.set_device_stats(mstats)
+                    else:
+                        state, outs = self(state, placed, cur_lr,
+                                           step_rng)
                 n_update += 1
-                metric.update(batch.label,
-                              [_nd_wrap(o) for o in outs])
+                # stage batch t+1: its H2D overlaps the step just
+                # dispatched (async)
+                nxt = next(batches, None)
+                staged = None if nxt is None else self._stage(nxt)
+                if not fuse:
+                    # fuse=False is the host metric path (device
+                    # accumulation on this loop is always fused)
+                    metric.update(batch.label,
+                                  [_nd_wrap(o) for o in outs])
+                # bounded dispatch: block on the step K back so async
+                # dispatch can't run arbitrarily ahead of the device
+                inflight.append(outs[0])
+                while len(inflight) > ahead:
+                    _profiler.count_host_sync("dispatch_window")
+                    inflight.popleft().block_until_ready()
                 if batch_end_callback:
                     batch_end_callback(_SimpleBatchEnd(
                         epoch, nbatch, metric))
-            name, val = metric.get()
+                nbatch += 1
+            name, val = metric.get()     # the single blocking read
             log.info("Epoch[%d] Train-%s=%f", epoch, name, val)
             if checkpoint_prefix and \
                     (epoch + 1) % checkpoint_period == 0:

@@ -305,3 +305,144 @@ layer { name: "prob" type: "Softmax" bottom: "fc" top: "prob" }
     e = np.exp(logits - logits.max(-1, keepdims=True))
     np.testing.assert_allclose(got, e / e.sum(-1, keepdims=True),
                                rtol=2e-4, atol=2e-4)
+
+
+def test_relu_negative_slope_becomes_leaky(tmp_path):
+    """relu_param.negative_slope must survive conversion as a
+    LeakyReLU — plain ReLU silently zeroes every negative activation."""
+    proto = tmp_path / "leaky.prototxt"
+    proto.write_text("""
+input: "data"
+layer { name: "fc" type: "InnerProduct" bottom: "data" top: "fc"
+  inner_product_param { num_output: 4 } }
+layer { name: "relu1" type: "ReLU" bottom: "fc" top: "fc"
+  relu_param { negative_slope: 0.1 } }
+layer { name: "prob" type: "Softmax" bottom: "fc" top: "prob" }
+""")
+    rng = np.random.RandomState(5)
+    w = {"fc_w": rng.randn(4, 6).astype(np.float32),
+         "fc_b": rng.randn(4).astype(np.float32)}
+    model = tmp_path / "leaky.caffemodel"
+    model.write_bytes(_net([
+        _layer("fc", "InnerProduct", [w["fc_w"], w["fc_b"]])]))
+    sym, arg_params, aux_params = caffe_converter.convert(
+        str(proto), str(model))
+
+    x = rng.randn(3, 6).astype(np.float32)
+    mod = mx.mod.Module(sym, data_names=("data",),
+                        label_names=("softmax_label",), context=mx.cpu())
+    mod.bind(data_shapes=[("data", x.shape)], label_shapes=None,
+             for_training=False)
+    mod.set_params(arg_params, aux_params)
+    from mxnet_tpu import io
+    mod.forward(io.DataBatch(data=[mx.nd.array(x)]), is_train=False)
+    got = mod.get_outputs()[0].asnumpy()
+
+    z = x @ w["fc_w"].T + w["fc_b"]
+    act = np.where(z >= 0, z, 0.1 * z)        # leaky, NOT rectified
+    e = np.exp(act - act.max(-1, keepdims=True))
+    np.testing.assert_allclose(got, e / e.sum(-1, keepdims=True),
+                               rtol=2e-4, atol=2e-4)
+
+
+def test_eltwise_coeff_applied(tmp_path):
+    """Eltwise SUM coeff multipliers must be applied (coeff: 1, -1 is
+    caffe's subtraction idiom); mismatched arity is loud."""
+    proto = tmp_path / "coef.prototxt"
+    proto.write_text("""
+input: "data"
+layer { name: "f1" type: "InnerProduct" bottom: "data" top: "f1"
+  inner_product_param { num_output: 4 } }
+layer { name: "f2" type: "InnerProduct" bottom: "data" top: "f2"
+  inner_product_param { num_output: 4 } }
+layer { name: "diff" type: "Eltwise" bottom: "f1" bottom: "f2"
+  top: "diff" eltwise_param { operation: SUM coeff: 1.0 coeff: -1.0 } }
+layer { name: "prob" type: "Softmax" bottom: "diff" top: "prob" }
+""")
+    rng = np.random.RandomState(6)
+    w = {"w1": rng.randn(4, 6).astype(np.float32),
+         "b1": rng.randn(4).astype(np.float32),
+         "w2": rng.randn(4, 6).astype(np.float32),
+         "b2": rng.randn(4).astype(np.float32)}
+    model = tmp_path / "coef.caffemodel"
+    model.write_bytes(_net([
+        _layer("f1", "InnerProduct", [w["w1"], w["b1"]]),
+        _layer("f2", "InnerProduct", [w["w2"], w["b2"]])]))
+    sym, arg_params, aux_params = caffe_converter.convert(
+        str(proto), str(model))
+
+    x = rng.randn(3, 6).astype(np.float32)
+    mod = mx.mod.Module(sym, data_names=("data",),
+                        label_names=("softmax_label",), context=mx.cpu())
+    mod.bind(data_shapes=[("data", x.shape)], label_shapes=None,
+             for_training=False)
+    mod.set_params(arg_params, aux_params)
+    from mxnet_tpu import io
+    mod.forward(io.DataBatch(data=[mx.nd.array(x)]), is_train=False)
+    got = mod.get_outputs()[0].asnumpy()
+
+    d = (x @ w["w1"].T + w["b1"]) - (x @ w["w2"].T + w["b2"])
+    e = np.exp(d - d.max(-1, keepdims=True))
+    np.testing.assert_allclose(got, e / e.sum(-1, keepdims=True),
+                               rtol=2e-4, atol=2e-4)
+
+    bad = tmp_path / "bad.prototxt"
+    bad.write_text("""
+input: "data"
+layer { name: "c1" type: "Convolution" bottom: "data" top: "c1"
+  convolution_param { num_output: 2 kernel_size: 1 } }
+layer { name: "s" type: "Eltwise" bottom: "c1" bottom: "data"
+  top: "s" eltwise_param { operation: SUM coeff: 0.5 } }
+""")
+    with pytest.raises(ValueError, match="coeff"):
+        caffe_converter.convert(str(bad), None)
+
+
+def test_v1_enum_layer_types_convert(tmp_path):
+    """V1 prototxts (enum layer types, `layers { ... }`) get a real
+    conversion; unsupported V1 enums get the upgrade-your-prototxt
+    error instead of a generic unknown-layer message."""
+    proto = tmp_path / "v1.prototxt"
+    proto.write_text("""
+input: "data"
+layers { name: "conv1" type: CONVOLUTION bottom: "data" top: "conv1"
+  convolution_param { num_output: 4 kernel_size: 3 pad: 1 } }
+layers { name: "relu1" type: RELU bottom: "conv1" top: "conv1" }
+layers { name: "fc" type: INNER_PRODUCT bottom: "conv1" top: "fc"
+  inner_product_param { num_output: 5 } }
+layers { name: "prob" type: SOFTMAX bottom: "fc" top: "prob" }
+""")
+    rng = np.random.RandomState(8)
+    w = {"conv1_w": rng.randn(4, 3, 3, 3).astype(np.float32) * 0.3,
+         "conv1_b": rng.randn(4).astype(np.float32) * 0.1,
+         "fc_w": rng.randn(5, 4 * 6 * 6).astype(np.float32) * 0.2,
+         "fc_b": rng.randn(5).astype(np.float32) * 0.1}
+    model = tmp_path / "v1.caffemodel"
+    model.write_bytes(_net([
+        _layer("conv1", "Convolution", [w["conv1_w"], w["conv1_b"]]),
+        _layer("fc", "InnerProduct", [w["fc_w"], w["fc_b"]])]))
+    sym, arg_params, aux_params = caffe_converter.convert(
+        str(proto), str(model))
+
+    x = rng.randn(2, 3, 6, 6).astype(np.float32)
+    mod = mx.mod.Module(sym, data_names=("data",),
+                        label_names=("softmax_label",), context=mx.cpu())
+    mod.bind(data_shapes=[("data", x.shape)], label_shapes=None,
+             for_training=False)
+    mod.set_params(arg_params, aux_params)
+    from mxnet_tpu import io
+    mod.forward(io.DataBatch(data=[mx.nd.array(x)]), is_train=False)
+    got = mod.get_outputs()[0].asnumpy()
+
+    conv = np.maximum(_ref_conv3x3(x, w["conv1_w"], w["conv1_b"]), 0)
+    logits = conv.reshape(2, -1) @ w["fc_w"].T + w["fc_b"]
+    e = np.exp(logits - logits.max(-1, keepdims=True))
+    np.testing.assert_allclose(got, e / e.sum(-1, keepdims=True),
+                               rtol=2e-4, atol=2e-4)
+
+    bad = tmp_path / "v1bad.prototxt"
+    bad.write_text('input: "data"\n'
+                   'layers { name: "p" type: POWER bottom: "data" '
+                   'top: "p" }\n')
+    with pytest.raises(NotImplementedError, match="upgrade"):
+        caffe_converter.convert(str(bad), None)

@@ -197,6 +197,52 @@ def _one(d, key, default=None):
     return v[0] if v else default
 
 
+# V1 (upgrade-era) prototxts name layer types with enum tokens; map the
+# ones this converter supports onto their modern string names so old
+# nets get a real conversion instead of a KeyError.
+_V1_LAYER_TYPES = {
+    "ACCURACY": "Accuracy",
+    "CONCAT": "Concat",
+    "CONVOLUTION": "Convolution",
+    "DATA": "Data",
+    "DROPOUT": "Dropout",
+    "ELTWISE": "Eltwise",
+    "FLATTEN": "Flatten",
+    "INNER_PRODUCT": "InnerProduct",
+    "LRN": "LRN",
+    "POOLING": "Pooling",
+    "RELU": "ReLU",
+    "SOFTMAX": "Softmax",
+    "SOFTMAX_LOSS": "SoftmaxWithLoss",
+}
+
+# the rest of the V1 enum vocabulary (caffe.proto V1LayerParameter) —
+# recognized so the error says "old prototxt" instead of a generic
+# unknown-layer message
+_V1_KNOWN_UNSUPPORTED = {
+    "ARGMAX", "BNLL", "DUMMY_DATA", "EUCLIDEAN_LOSS", "EXP",
+    "HDF5_DATA", "HDF5_OUTPUT", "HINGE_LOSS", "IM2COL", "IMAGE_DATA",
+    "INFOGAIN_LOSS", "MEMORY_DATA", "MULTINOMIAL_LOGISTIC_LOSS", "MVN",
+    "POWER", "SIGMOID", "SIGMOID_CROSS_ENTROPY_LOSS", "SILENCE",
+    "SLICE", "SPLIT", "TANH", "THRESHOLD", "WINDOW_DATA",
+}
+
+
+def _canonical_type(ltype):
+    """Modern string type for a layer's declared type, mapping V1 enum
+    tokens; unsupported V1 enums get an actionable upgrade error."""
+    if not isinstance(ltype, str):
+        return ltype
+    if ltype in _V1_LAYER_TYPES:
+        return _V1_LAYER_TYPES[ltype]
+    if ltype in _V1_KNOWN_UNSUPPORTED:
+        raise NotImplementedError(
+            "V1 enum layer type %r has no converter here — upgrade "
+            "your prototxt (caffe's upgrade_net_proto_text) to the "
+            "string-typed format, or port the layer" % ltype)
+    return ltype
+
+
 def _pair(param, key, default=0):
     """caffe kernel_size/pad/stride may repeat (h, w) or appear as
     *_h/*_w; normalize to a (h, w) tuple."""
@@ -234,9 +280,10 @@ def convert(prototxt, caffemodel=None):
     layers = net.get("layer") or net.get("layers") or []
     # caffe pairs BatchNorm with a following Scale layer; fuse them
     pending_bn = {}                 # top -> (name, mean, var, in, eps)
-    n_softmax = sum(1 for l in layers
-                    if _one(l, "type") in ("Softmax",
-                                           "SoftmaxWithLoss"))
+    n_softmax = sum(
+        1 for l in layers
+        if _V1_LAYER_TYPES.get(_one(l, "type"), _one(l, "type"))
+        in ("Softmax", "SoftmaxWithLoss"))
     last_syms = []                  # output heads, in layer order
 
     def blob(lname, idx):
@@ -250,7 +297,7 @@ def convert(prototxt, caffemodel=None):
         tops[iname] = mx.sym.Variable(iname)
 
     for lay in layers:
-        ltype = _one(lay, "type")
+        ltype = _canonical_type(_one(lay, "type"))
         name = _one(lay, "name")
         bottoms = [tops[b] for b in lay.get("bottom", [])]
         top = _one(lay, "top", name)
@@ -310,8 +357,17 @@ def convert(prototxt, caffemodel=None):
                 global_pool=global_pool,
                 pooling_convention="full", name=name)
         elif ltype == "ReLU":
-            sym = mx.sym.Activation(bottoms[0], act_type="relu",
-                                    name=name)
+            p = _one(lay, "relu_param", {})
+            slope = float(_one(p, "negative_slope", 0) or 0)
+            if slope:
+                # caffe's leaky ReLU lives on the ReLU layer as
+                # negative_slope; dropping it silently rectified
+                # every negative activation
+                sym = mx.sym.LeakyReLU(bottoms[0], act_type="leaky",
+                                       slope=slope, name=name)
+            else:
+                sym = mx.sym.Activation(bottoms[0], act_type="relu",
+                                        name=name)
         elif ltype == "LRN":
             p = _one(lay, "lrn_param", {})
             sym = mx.sym.LRN(
@@ -368,8 +424,16 @@ def convert(prototxt, caffemodel=None):
             if op not in (1, "SUM"):
                 raise NotImplementedError(
                     "Eltwise operation %r (only SUM)" % op)
-            sym = bottoms[0]
-            for b in bottoms[1:]:
+            coeffs = [float(c) for c in p.get("coeff", [])]
+            if coeffs and len(coeffs) != len(bottoms):
+                raise ValueError(
+                    "Eltwise layer %r: %d coeff values for %d bottoms"
+                    % (name, len(coeffs), len(bottoms)))
+            terms = bottoms if not coeffs else \
+                [b if c == 1.0 else b * c
+                 for b, c in zip(bottoms, coeffs)]
+            sym = terms[0]
+            for b in terms[1:]:
                 sym = sym + b
         elif ltype == "Concat":
             p = _one(lay, "concat_param", {})

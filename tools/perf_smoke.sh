@@ -1,0 +1,31 @@
+#!/usr/bin/env bash
+# Hot-loop perf smoke: the pipelining + device-metric-parity test
+# subset (tests/test_hotloop.py, CPU backend) plus a lint that keeps
+# the step loops honest. Run from anywhere.
+#
+#   tools/perf_smoke.sh
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+# -- lint: no blocking host reads inside the step loops ------------------
+# The pipelining claim (docs/performance.md "Pipelined training hot
+# loop") dies one .asnumpy() at a time: a single D2H read per batch
+# re-serializes host and device. The SPMD fit loop and the executor
+# group's feed path must stay free of them (metric fallbacks and
+# checkpoint/save paths live elsewhere).
+lint_hits=$(grep -n "\.asnumpy()" \
+    mxnet_tpu/parallel/trainer.py \
+    mxnet_tpu/module/executor_group.py || true)
+if [ -n "$lint_hits" ]; then
+    echo "PERF LINT FAIL: blocking .asnumpy() in a step-loop file" >&2
+    echo "$lint_hits" >&2
+    echo "Feed device arrays (NDArray._data / place_batch) instead, or" >&2
+    echo "move the read outside the per-step path." >&2
+    exit 1
+fi
+echo "perf lint: OK (no .asnumpy() in trainer.py / executor_group.py)"
+
+# -- the pipelining + metric-parity subset -------------------------------
+exec env JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" \
+    python -m pytest tests/test_hotloop.py tests/test_metric.py -q \
+    -p no:cacheprovider "$@"
