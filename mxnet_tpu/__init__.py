@@ -92,6 +92,7 @@ from . import kvstore_server as _kvstore_server
 # server/scheduler-role processes park here (reference: mxnet/__init__
 # starts the server loop at import when DMLC_ROLE=server)
 _kvstore_server._init_kvstore_server_module()
+from . import guardrail
 from . import profiler
 from . import predictor
 from .predictor import Predictor

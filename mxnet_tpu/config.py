@@ -127,3 +127,25 @@ define("MXNET_COMPILE_CACHE", str, "",
        "directory for JAX's persistent compilation cache — warm "
        "restarts skip XLA recompiles (wired at package import; empty "
        "= disabled)")
+define("MXNET_GUARDRAIL", bool, True,
+       "device-side non-finite step detection in the fit hot loops: "
+       "the compiled step carries an all-finite flag and masks bad "
+       "updates on device (weights never ingest a NaN); adds zero "
+       "blocking host syncs")
+define("MXNET_LOSS_SCALE", str, "",
+       "loss scaling for the TrainStep path: empty = off | 'dynamic' "
+       "= grow/halve DynamicLossScaler | <float> = static scale; "
+       "scaler state lives in the step's aux pytree and rides "
+       "checkpoints")
+define("MXNET_LOSS_SCALE_WINDOW", int, 200,
+       "dynamic loss scaling: consecutive finite steps before the "
+       "scale doubles (overflow always halves it immediately)")
+define("MXNET_MAX_BAD_STEPS", int, 10,
+       "consecutive device-masked (non-finite) steps before the fit "
+       "loop rolls back to the newest readable checkpoint")
+define("MXNET_MAX_ROLLBACKS", int, 2,
+       "checkpoint rollbacks the guardrail may perform before raising "
+       "NumericalDivergence")
+define("MXNET_ROLLBACK_LR_FACTOR", float, 1.0,
+       "learning-rate multiplier applied on every guardrail rollback "
+       "(e.g. 0.5 halves the LR after each divergence rollback)")

@@ -414,6 +414,15 @@ class Executor:
                     "co-located TPU host in production." %
                     jax.default_backend()) from e
             raise
+        if self._has_host_callback_ops:
+            # Custom-op graphs run host-python callbacks on the runtime's
+            # execution threads, and that host code dispatches jax ops of
+            # its own. Letting the program run async while the caller
+            # keeps dispatching eagerly can deadlock the CPU client (the
+            # callback's dispatch waits on the pool the still-running
+            # program occupies). Custom ops are a host round trip by
+            # design ("escape hatch, not a fast path") — serialize them.
+            jax.block_until_ready((outs, new_aux, self._cached_grads))
         if is_train:
             for n, a in zip(self._aux_names, self.aux_arrays):
                 a._set_data(new_aux[n])
@@ -469,10 +478,12 @@ class Executor:
         """Backprop through the bound graph (reference MXExecutorBackwardEx).
 
         With no `out_grads`, each head receives an all-ones cotangent —
-        matching the reference where loss-layer ops (SoftmaxOutput, MakeLoss)
-        ignore the incoming head gradient entirely. In that default case the
-        gradients were already produced by the fused forward program and
-        this only writes them out."""
+        the reference's head-grad convention for loss-layer ops
+        (SoftmaxOutput, MakeLoss). Heads propagate the incoming
+        cotangent as a scale (identity under the ones default; the
+        hook dynamic loss scaling rides on, ops/loss.py). In the
+        default case the gradients were already produced by the fused
+        forward program and this only writes them out."""
         if self._fwd_inputs is None:
             raise MXNetError("backward() requires a prior "
                              "forward(is_train=True)")
@@ -494,6 +505,10 @@ class Executor:
                           else jnp.asarray(g) for g in out_grads]
             grads = self._jit_bwd(arg_vals, aux_vals, rng,
                                   tuple(head_grads))
+        if self._has_host_callback_ops:
+            # see forward(): host-callback programs are serialized so
+            # their callbacks can't deadlock against eager dispatch
+            jax.block_until_ready(grads)
         for n, gbuf in zip(self._arg_names, self.grad_arrays):
             if gbuf is None or self._grad_req[n] == "null":
                 continue

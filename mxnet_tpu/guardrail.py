@@ -1,0 +1,474 @@
+"""Training guardrails — numerical-fault containment and preemption
+safety for the fit hot loops (docs/robustness.md §"Numerical faults &
+preemption").
+
+The reference delegated all of this to the user: a NaN gradient walked
+straight into the weights, a bf16 overflow silently zeroed a run, and a
+SIGTERM from a preempted VM lost everything since the last periodic
+checkpoint. Here the framework detects, contains, and recovers itself:
+
+* **Device-side non-finite detection** — an all-reduce ``isfinite``
+  flag over loss outputs and gradients is fused into the compiled step
+  (XLA fusion makes the check nearly free, cf. arXiv:2301.13062) and
+  carried in the step's output pytree. On a bad step the update is
+  masked out ON DEVICE (``jnp.where`` — parameters, optimizer state
+  and BN statistics all keep their pre-step values), so the weights
+  never ingest the NaN. The host learns about the bad step from the
+  flag it reads at the bounded-dispatch-window wait it was already
+  paying — detection adds **zero extra blocking host syncs** (asserted
+  against ``profiler.host_sync_count``).
+
+* :class:`DynamicLossScaler` — grow-on-N-good-steps / halve-on-overflow
+  loss scaling (the cross-replica overflow-handling fold-in of
+  arXiv:2004.13336), enabled via ``MXNET_LOSS_SCALE=dynamic|<float>``.
+  Scaler state rides in the step's aux pytree under reserved
+  ``__gr_*`` keys, so it lives on device, updates inside the compiled
+  step, and is saved/restored by the existing checkpoint format.
+  Scales are powers of two, so scale/unscale is numerically exact.
+
+* :class:`EscalationPolicy` — after ``MXNET_MAX_BAD_STEPS`` consecutive
+  masked steps the fit loop rolls back to the newest readable
+  checkpoint (optionally dropping LR by ``MXNET_ROLLBACK_LR_FACTOR``);
+  after ``MXNET_MAX_ROLLBACKS`` rollbacks it raises the typed
+  :class:`NumericalDivergence` instead of looping forever.
+
+* :class:`GracefulShutdown` — a SIGTERM/SIGINT handler that *chains*
+  the previously-installed handler (never clobbers it; enforced by the
+  ``tools/fault_smoke.sh`` lint) and requests checkpoint-at-next-step-
+  boundary. The fit loop writes the boundary checkpoint and exits with
+  :data:`EXIT_PREEMPTED` so a relauncher can key on the code and rerun
+  the same command — the existing ``resume=`` path continues from the
+  exact step.
+
+* **Deterministic fault injection** — ``nan@N`` / ``sigterm@N`` rules
+  in the ``MXNET_FAULT_SPEC`` grammar (``parallel/resilience.py``)
+  drive both paths in tests with no real divergence and no real kills.
+
+* :func:`durable_replace` — crash-durable atomic publish (fsync file,
+  rename, fsync directory) for checkpoints; auto-rollback makes
+  checkpoint integrity load-bearing, and a bare ``os.replace`` is not
+  durable across power loss.
+"""
+from __future__ import annotations
+
+import contextlib
+import logging
+import os
+import signal
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from . import config as _config
+
+__all__ = ["NumericalDivergence", "RollbackNeeded", "PreemptionSignal",
+           "DynamicLossScaler", "EscalationPolicy", "GracefulShutdown",
+           "FitGuard", "GuardSpec", "all_finite", "mask_stats",
+           "check_and_mask", "durable_replace", "fsync_file",
+           "EXIT_PREEMPTED", "GR_PREFIX", "SCALE_KEY", "GOOD_KEY"]
+
+# process exit code of a preemption-triggered boundary-checkpoint exit.
+# Distinctive on purpose: a relauncher distinguishes "resume me" (this)
+# from a crash (anything else). 128+15 (shell SIGTERM death) is NOT used
+# — that would be indistinguishable from an unhandled kill.
+EXIT_PREEMPTED = 83
+
+# reserved aux-pytree key space for guardrail state carried through the
+# compiled step (saved in checkpoints as ordinary aux entries)
+GR_PREFIX = "__gr_"
+SCALE_KEY = "__gr_loss_scale__"
+GOOD_KEY = "__gr_good_steps__"
+
+
+class NumericalDivergence(RuntimeError):
+    """Training diverged numerically and the guardrails are exhausted:
+    MXNET_MAX_BAD_STEPS consecutive steps produced non-finite loss or
+    gradients even after MXNET_MAX_ROLLBACKS checkpoint rollbacks (or
+    there was no checkpoint to roll back to). The weights are still
+    finite — every bad update was masked on device — but continuing
+    would just mask forever, so fail loudly and typed."""
+
+
+class RollbackNeeded(Exception):
+    """Internal control flow: the consecutive-bad-step threshold fired;
+    the fit loop must restore the newest readable checkpoint. Never
+    escapes fit (it converts to NumericalDivergence when rollback is
+    impossible or exhausted)."""
+
+
+class PreemptionSignal(Exception):
+    """Internal control flow: a graceful-shutdown request was observed
+    at a step boundary inside an epoch loop; carries the number of
+    batches already trained this epoch so the boundary checkpoint can
+    record the exact resume point."""
+
+    def __init__(self, nbatch):
+        super().__init__("preemption requested at batch %d" % nbatch)
+        self.nbatch = nbatch
+
+
+# ---------------------------------------------------------------------------
+# device-side helpers (jit-compatible)
+# ---------------------------------------------------------------------------
+
+def all_finite(arrays):
+    """Scalar bool: every element of every array is finite. Pure jnp —
+    safe inside a traced step; XLA fuses the reduction into the
+    producers (near-free, the arXiv:2301.13062 property)."""
+    flags = [jnp.isfinite(a).all() for a in arrays]
+    ok = flags[0] if flags else jnp.bool_(True)
+    for f in flags[1:]:
+        ok = jnp.logical_and(ok, f)
+    return ok
+
+
+def mask_stats(stats, ok):
+    """Zero a metric stats pytree where ``ok`` is False — masked steps
+    contribute to neither ``sum`` nor ``num``, so metrics exclude them
+    entirely instead of averaging a NaN in."""
+    return jax.tree.map(
+        lambda s: jnp.where(ok, s, jnp.zeros_like(s)), stats)
+
+
+@jax.jit
+def _check_and_mask_jit(grads, outs):
+    ok = all_finite(list(grads) + list(outs))
+    return ok, [jnp.where(ok, g, jnp.zeros_like(g)) for g in grads]
+
+
+def check_and_mask(grads, outs):
+    """Eager-path guardrail core (Module fit loop): all-finite flag over
+    grads + outputs, and the grads zeroed on device where the flag is
+    False (``nan * 0`` is NaN — ``where`` is mandatory). One jitted
+    program so the whole check dispatches as a single async call."""
+    return _check_and_mask_jit(grads, outs)
+
+
+# ---------------------------------------------------------------------------
+# dynamic loss scaling
+# ---------------------------------------------------------------------------
+
+class DynamicLossScaler:
+    """Grow/halve loss-scale state machine, evaluated inside the
+    compiled step (device-resident state, no host syncs).
+
+    The scale multiplies the head cotangent (every loss head propagates
+    the incoming head-grad scale, ops/loss.py), so the whole
+    low-precision backprop chain carries it; gradients are unscaled
+    (exactly — scales are powers of two) before clipping and the
+    optimizer update. Overflow (a non-finite scaled gradient) halves
+    the scale and masks the step; ``window`` consecutive good steps
+    double it, up to ``max_scale``."""
+
+    def __init__(self, init_scale=2.0 ** 16, window=None, dynamic=True,
+                 max_scale=2.0 ** 24, min_scale=1.0):
+        self.init_scale = float(init_scale)
+        self.window = int(window if window is not None
+                          else _config.get("MXNET_LOSS_SCALE_WINDOW"))
+        self.dynamic = bool(dynamic)
+        self.max_scale = float(max_scale)
+        self.min_scale = float(min_scale)
+
+    @staticmethod
+    def from_env():
+        """None (off), a dynamic scaler, or a static one — from
+        ``MXNET_LOSS_SCALE`` ('', 'dynamic', or a float literal)."""
+        raw = str(_config.get("MXNET_LOSS_SCALE")).strip()
+        if not raw:
+            return None
+        if raw.lower() == "dynamic":
+            return DynamicLossScaler()
+        try:
+            scale = float(raw)
+        except ValueError:
+            raise ValueError(
+                "MXNET_LOSS_SCALE must be '', 'dynamic', or a float, "
+                "got %r" % raw)
+        if not scale > 0:
+            raise ValueError("MXNET_LOSS_SCALE must be positive, got %r"
+                             % raw)
+        # snap to the nearest power of two: the whole-chain exactness
+        # guarantee (scale/unscale cancels bit-for-bit) only holds for
+        # exponent-shift scales
+        pow2 = 2.0 ** round(np.log2(scale))
+        if pow2 != scale:
+            logging.getLogger(__name__).warning(
+                "MXNET_LOSS_SCALE=%s rounded to the nearest power of "
+                "two (%g) to keep scale/unscale numerically exact",
+                raw, pow2)
+        return DynamicLossScaler(init_scale=pow2, dynamic=False)
+
+    def init_aux(self):
+        """Fresh device-state entries for the step's aux pytree."""
+        return {SCALE_KEY: jnp.float32(self.init_scale),
+                GOOD_KEY: jnp.float32(0.0)}
+
+    def next_state(self, scale, good, finite):
+        """Traced update rule: (new_scale, new_good_steps)."""
+        if not self.dynamic:
+            return scale, good
+        good_next = jnp.where(finite, good + 1.0, 0.0)
+        grow = good_next >= float(self.window)
+        new_scale = jnp.where(
+            finite,
+            jnp.where(grow, jnp.minimum(scale * 2.0, self.max_scale),
+                      scale),
+            jnp.maximum(scale * 0.5, self.min_scale))
+        good_next = jnp.where(jnp.logical_or(grow, ~finite), 0.0,
+                              good_next)
+        return new_scale, good_next
+
+
+class GuardSpec:
+    """What the compiled step needs to know: detection is implied by
+    the spec's existence; ``scaler`` is the optional loss scaler."""
+
+    def __init__(self, scaler=None):
+        self.scaler = scaler
+
+
+# ---------------------------------------------------------------------------
+# host-side escalation
+# ---------------------------------------------------------------------------
+
+class EscalationPolicy:
+    """Consecutive-bad-step accounting and the rollback budget.
+
+    ``record(finite)`` is fed every drained step flag; it raises
+    :class:`RollbackNeeded` when the streak reaches ``max_bad_steps``.
+    The fit loop then calls :meth:`begin_rollback` (which raises
+    :class:`NumericalDivergence` once the budget is spent) before
+    restoring the newest readable checkpoint."""
+
+    def __init__(self, max_bad_steps=None, max_rollbacks=None,
+                 lr_factor=None, logger=None):
+        self.max_bad_steps = int(
+            max_bad_steps if max_bad_steps is not None
+            else _config.get("MXNET_MAX_BAD_STEPS"))
+        self.max_rollbacks = int(
+            max_rollbacks if max_rollbacks is not None
+            else _config.get("MXNET_MAX_ROLLBACKS"))
+        self.lr_factor = float(
+            lr_factor if lr_factor is not None
+            else _config.get("MXNET_ROLLBACK_LR_FACTOR"))
+        self.log = logger or logging.getLogger(__name__)
+        self.bad_streak = 0
+        self.masked_steps = 0
+        self.rollbacks_done = 0
+        self.lr_mult = 1.0
+
+    def record(self, finite):
+        """Feed one drained step flag; raises RollbackNeeded when the
+        consecutive-bad-step threshold fires."""
+        if finite:
+            self.bad_streak = 0
+            return
+        self.masked_steps += 1
+        self.bad_streak += 1
+        self.log.warning(
+            "guardrail: non-finite step detected and masked on device "
+            "(%d consecutive, %d total)", self.bad_streak,
+            self.masked_steps)
+        if self.bad_streak >= self.max_bad_steps:
+            raise RollbackNeeded()
+
+    def begin_rollback(self):
+        """Account one rollback attempt; NumericalDivergence when the
+        budget is exhausted. On success the LR multiplier shrinks by
+        ``lr_factor`` and the streak resets."""
+        if self.rollbacks_done >= self.max_rollbacks:
+            raise NumericalDivergence(
+                "training diverged: %d consecutive non-finite steps "
+                "after %d rollback(s) (%d masked steps total); "
+                "MXNET_MAX_ROLLBACKS exhausted"
+                % (self.bad_streak, self.rollbacks_done,
+                   self.masked_steps))
+        self.rollbacks_done += 1
+        self.bad_streak = 0
+        self.lr_mult *= self.lr_factor
+
+    def no_checkpoint(self, why):
+        """Rollback is needed but impossible — typed failure."""
+        raise NumericalDivergence(
+            "training diverged: %d consecutive non-finite steps and no "
+            "checkpoint to roll back to (%s)" % (self.bad_streak, why))
+
+    def report(self):
+        return {"masked_steps": self.masked_steps,
+                "rollbacks": self.rollbacks_done,
+                "lr_mult": self.lr_mult}
+
+
+# ---------------------------------------------------------------------------
+# graceful shutdown (preemption safety)
+# ---------------------------------------------------------------------------
+
+class GracefulShutdown:
+    """Chaining SIGTERM/SIGINT handler requesting a boundary checkpoint.
+
+    The handler only sets a flag — the fit loop does the actual
+    checkpoint write at the next step boundary (a signal handler must
+    not run XLA). The previously-installed handler is CHAINED, not
+    clobbered (except SIG_DFL — immediate death would defeat the
+    boundary checkpoint — and the default SIGINT KeyboardInterrupt
+    raiser, which would tear the loop mid-step). Installation from a
+    non-main thread degrades to a no-op instead of raising."""
+
+    def __init__(self, signals=None, logger=None):
+        self._signals = tuple(signals if signals is not None
+                              else (signal.SIGTERM, signal.SIGINT))
+        self._prev = {}
+        self._installed = False
+        self._log = logger or logging.getLogger(__name__)
+        self.requested = False
+
+    def _handler(self, signum, frame):
+        self.requested = True
+        self._log.warning(
+            "guardrail: received signal %d — will checkpoint at the "
+            "next step boundary and exit %d", signum, EXIT_PREEMPTED)
+        prev = self._prev.get(signum)
+        if callable(prev) and prev is not signal.default_int_handler:
+            prev(signum, frame)
+
+    @property
+    def installed(self):
+        return self._installed
+
+    def install(self):
+        if self._installed:
+            return self
+        try:
+            for sig in self._signals:
+                self._prev[sig] = signal.getsignal(sig)
+                signal.signal(sig, self._handler)
+            self._installed = True
+        except ValueError:
+            # non-main thread: signals can't be installed here; the
+            # run simply has no graceful-shutdown window
+            self._prev.clear()
+        return self
+
+    def uninstall(self):
+        if not self._installed:
+            return
+        for sig, prev in self._prev.items():
+            try:
+                signal.signal(sig, prev)
+            except (ValueError, TypeError):
+                pass
+        self._prev.clear()
+        self._installed = False
+
+    def __enter__(self):
+        return self.install()
+
+    def __exit__(self, *exc):
+        self.uninstall()
+        return False
+
+
+# ---------------------------------------------------------------------------
+# per-fit runtime
+# ---------------------------------------------------------------------------
+
+class FitGuard:
+    """Everything a fit loop needs, bundled: the compiled-step spec
+    (None = detection off), the host escalation policy, the graceful
+    shutdown handler (None when the run has no checkpoint_prefix to
+    write a boundary checkpoint to), and the deterministic step-fault
+    poller."""
+
+    def __init__(self, spec, policy, shutdown, logger=None):
+        self.spec = spec
+        self.policy = policy
+        self.shutdown = shutdown
+        self.log = logger or logging.getLogger(__name__)
+
+    @classmethod
+    def create(cls, logger=None, checkpointing=False):
+        detect = bool(_config.get("MXNET_GUARDRAIL"))
+        scaler = DynamicLossScaler.from_env()
+        if scaler is not None:
+            detect = True    # scaling needs the overflow flag
+        spec = GuardSpec(scaler=scaler) if detect else None
+        policy = EscalationPolicy(logger=logger) if detect else None
+        shutdown = GracefulShutdown(logger=logger) if checkpointing \
+            else None
+        return cls(spec, policy, shutdown, logger=logger)
+
+    @property
+    def lr_mult(self):
+        return self.policy.lr_mult if self.policy is not None else 1.0
+
+    def preempt_requested(self):
+        return self.shutdown is not None and self.shutdown.requested
+
+    def shutdown_scope(self):
+        """Context manager installing the chaining handlers for the
+        duration of fit (no-op when shutdown is disabled)."""
+        if self.shutdown is None:
+            return contextlib.nullcontext()
+        return self.shutdown
+
+    def poll_faults(self):
+        """Once per training step: consult the active FaultInjector's
+        step-indexed rules. A ``sigterm@N`` hit raises a REAL SIGTERM
+        through the installed chaining handler (no-op without a
+        shutdown window — counting still advances, deterministically).
+        Returns the gradient-injection multiplier for this step: 1.0
+        normally, NaN on a ``nan@N`` hit — the poison rides into the
+        compiled step and exercises the real detection path."""
+        from .parallel import resilience
+        inj = resilience.active_injector()
+        if inj is None:
+            return np.float32(1.0)
+        fire_nan = inj.on_train_step("nan")
+        if inj.on_train_step("sigterm") and self.shutdown is not None \
+                and self.shutdown.installed:
+            # only raise when the chaining handler is REALLY installed:
+            # install() degrades to a no-op off the main thread, and a
+            # raw SIGTERM there would kill the process uncheckpointed —
+            # the exact outcome the graceful path exists to prevent
+            signal.raise_signal(signal.SIGTERM)
+        return np.float32("nan") if fire_nan else np.float32(1.0)
+
+    def report(self):
+        return self.policy.report() if self.policy is not None else {}
+
+
+# ---------------------------------------------------------------------------
+# crash-durable checkpoint publish
+# ---------------------------------------------------------------------------
+
+def fsync_file(path):
+    """fsync a file by path (works regardless of which fd wrote it)."""
+    fd = os.open(path, os.O_RDONLY)
+    try:
+        os.fsync(fd)
+    finally:
+        os.close(fd)
+
+
+def durable_replace(tmp_path, final_path):
+    """Crash-durable atomic publish: fsync the tmp file's bytes, rename
+    over the destination, then fsync the containing directory so the
+    rename itself survives power loss. A bare ``os.replace`` only
+    guarantees atomicity against concurrent readers — after a crash the
+    directory entry (or the file's data) may still be lost, and the
+    guardrail's auto-rollback makes the newest checkpoint load-bearing."""
+    fsync_file(tmp_path)
+    os.replace(tmp_path, final_path)
+    dir_path = os.path.dirname(os.path.abspath(final_path)) or "."
+    try:
+        dfd = os.open(dir_path, os.O_RDONLY)
+    except OSError:          # platforms that can't open directories
+        return
+    try:
+        os.fsync(dfd)
+    except OSError:
+        pass
+    finally:
+        os.close(dfd)

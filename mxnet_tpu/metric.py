@@ -95,17 +95,22 @@ class EvalMetric:
         self._dev_stats = None
 
     # -- feeding -------------------------------------------------------------
-    def update_dict(self, label, pred, device=False):
+    def update_dict(self, label, pred, device=False, ok=None):
         """Update from {name: array} dicts, selecting the configured
         output/label names (all values when unset). device=True routes
         through the on-device accumulator (host fallback when the
-        metric has no device impl)."""
+        metric has no device impl). ``ok`` (a device bool scalar) masks
+        the batch's device stats — the guardrail's masked-step
+        exclusion."""
         def pick(d, names):
             return list(d.values()) if names is None \
                 else [d[n] for n in names]
-        fn = self.update_device if device else self.update
-        fn(pick(label, self.label_names),
-           pick(pred, self.output_names))
+        labels = pick(label, self.label_names)
+        preds = pick(pred, self.output_names)
+        if device:
+            self.update_device(labels, preds, ok=ok)
+        else:
+            self.update(labels, preds)
 
     def update(self, labels, preds):
         check_label_shapes(labels, preds)
@@ -141,17 +146,25 @@ class EvalMetric:
         """Per-(label, pred) device stats -> (sum, num) f32 scalars."""
         raise NotImplementedError()
 
-    def update_device(self, labels, preds):
+    def update_device(self, labels, preds, ok=None):
         """Accumulate one batch ON DEVICE (async dispatch, no host
         sync); metrics without a device impl fall back to the blocking
-        host path unchanged."""
+        host path unchanged. ``ok`` (device bool scalar) masks the
+        batch's stats — a guardrail-masked step contributes to neither
+        sum nor num (host-fallback metrics cannot mask without a sync
+        and accumulate unmasked)."""
         if not self.supports_device_update:
             return self.update(labels, preds)
-        self.accumulate_device_stats(self.device_update(labels, preds))
+        self.accumulate_device_stats(self.device_update(labels, preds),
+                                     ok=ok)
 
-    def accumulate_device_stats(self, stats):
+    def accumulate_device_stats(self, stats, ok=None):
         """Fold a device_update stats pytree into the on-device
-        accumulator (a jnp add — dispatched, not synced)."""
+        accumulator (a jnp add — dispatched, not synced), optionally
+        masked by the guardrail's all-finite flag."""
+        if ok is not None:
+            stats = jax.tree.map(
+                lambda s: jnp.where(ok, s, jnp.zeros_like(s)), stats)
         if self._dev_stats is None:
             self._dev_stats = stats
         else:
@@ -234,7 +247,7 @@ class CompositeEvalMetric(EvalMetric):
             return ValueError("Metric index {} is out of range 0 and {}"
                               .format(index, len(self.metrics)))
 
-    def update_dict(self, labels, preds, device=False):
+    def update_dict(self, labels, preds, device=False, ok=None):
         if self.label_names is not None:
             labels = {k: v for k, v in labels.items()
                       if k in self.label_names}
@@ -242,7 +255,7 @@ class CompositeEvalMetric(EvalMetric):
             preds = {k: v for k, v in preds.items()
                      if k in self.output_names}
         for m in self.metrics:
-            m.update_dict(labels, preds, device=device)
+            m.update_dict(labels, preds, device=device, ok=ok)
 
     def update(self, labels, preds):
         for m in self.metrics:
@@ -258,13 +271,13 @@ class CompositeEvalMetric(EvalMetric):
     def device_update(self, labels, preds):
         return [m.device_update(labels, preds) for m in self.metrics]
 
-    def update_device(self, labels, preds):
+    def update_device(self, labels, preds, ok=None):
         for m in self.metrics:
-            m.update_device(labels, preds)
+            m.update_device(labels, preds, ok=ok)
 
-    def accumulate_device_stats(self, stats):
+    def accumulate_device_stats(self, stats, ok=None):
         for m, s in zip(self.metrics, stats):
-            m.accumulate_device_stats(s)
+            m.accumulate_device_stats(s, ok=ok)
 
     def set_device_stats(self, stats):
         for m, s in zip(self.metrics, stats):

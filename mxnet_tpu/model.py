@@ -105,12 +105,15 @@ def save_checkpoint(prefix, epoch, symbol, arg_params, aux_params):
     save_dict.update({("aux:%s" % k): v.as_in_context(cpu())
                       for k, v in aux_params.items()})
     param_name = "%s-%04d.params" % (prefix, epoch)
-    # atomic publish: a worker killed mid-save (the restart-and-resume
-    # story relies on checkpoints) must never leave a torn file as the
-    # newest checkpoint
+    # durable atomic publish: a worker killed mid-save (the
+    # restart-and-resume story — and now the guardrail's auto-rollback
+    # — relies on checkpoints) must never leave a torn file as the
+    # newest checkpoint, and the rename itself must survive power loss
+    # (fsync file + rename + fsync directory)
     tmp_name = param_name + ".tmp"
     nd.save(tmp_name, save_dict)
-    os.replace(tmp_name, param_name)
+    from . import guardrail
+    guardrail.durable_replace(tmp_name, param_name)
     logging.info("Saved checkpoint to \"%s\"", param_name)
 
 

@@ -11,6 +11,7 @@ the whole computation interface.
 from __future__ import annotations
 
 import logging
+import os
 import time
 
 import numpy as np
@@ -62,6 +63,26 @@ def _latest_checkpoint(prefix, logger):
                   if k.startswith("aux:")}
     return int(path[:-len(".params")].rsplit("-", 1)[1]), \
         arg_params, aux_params
+
+
+def _read_resume_sidecar(prefix, epoch, logger=None):
+    """Batches already trained in the (preempted) epoch recorded by a
+    boundary checkpoint's ``prefix-NNNN.resume.json`` sidecar; 0 when
+    there is none (a normal end-of-epoch checkpoint)."""
+    import json
+    try:
+        with open("%s-%04d.resume.json" % (prefix, epoch)) as f:
+            return int(json.load(f).get("nbatch", 0))
+    except (OSError, ValueError):
+        return 0
+
+
+def _clear_resume_sidecar(prefix, epoch):
+    """A normal end-of-epoch checkpoint supersedes any boundary
+    checkpoint of the same index — drop its stale sidecar."""
+    import contextlib
+    with contextlib.suppress(OSError):
+        os.remove("%s-%04d.resume.json" % (prefix, epoch))
 
 
 def _check_input_names(symbol, names, typename, throw):
@@ -233,9 +254,22 @@ class BaseModule:
         and rerun with the same command rejoins the job. On the
         dist_async kvstore the rejoining worker's ``init`` pushes are
         first-writer-wins on the live server, so it adopts the
-        cohort's CURRENT weights rather than clobbering them."""
-        assert num_epoch is not None, "please specify number of epochs"
+        cohort's CURRENT weights rather than clobbering them.
 
+        Guardrails (docs/robustness.md, MXNET_GUARDRAIL default on):
+        non-finite gradients are zeroed on device before update() (the
+        weights never ingest a NaN) and device-path metrics exclude the
+        masked step; after MXNET_MAX_BAD_STEPS consecutive masked steps
+        the newest readable checkpoint is restored (NumericalDivergence
+        once MXNET_MAX_ROLLBACKS is spent). With a checkpoint_prefix,
+        SIGTERM/SIGINT writes a boundary checkpoint (plus a
+        ``.resume.json`` sidecar recording the exact batch) and exits
+        with code guardrail.EXIT_PREEMPTED; a rerun resumes from that
+        step."""
+        assert num_epoch is not None, "please specify number of epochs"
+        from .. import guardrail as _guardrail
+
+        skip_batches = 0
         if checkpoint_prefix and resume:
             found_epoch, found_arg, found_aux = _latest_checkpoint(
                 checkpoint_prefix, self.logger)
@@ -243,9 +277,12 @@ class BaseModule:
                 begin_epoch = found_epoch
                 arg_params, aux_params = found_arg, found_aux
                 force_init = True
+                skip_batches = _read_resume_sidecar(checkpoint_prefix,
+                                                    found_epoch)
                 self.logger.info(
-                    "resumed %s-%04d.params; continuing at epoch %d",
-                    checkpoint_prefix, found_epoch, begin_epoch)
+                    "resumed %s-%04d.params; continuing at epoch %d%s",
+                    checkpoint_prefix, found_epoch, begin_epoch,
+                    ", batch %d" % skip_batches if skip_batches else "")
 
         self.bind(data_shapes=train_data.provide_data,
                   label_shapes=train_data.provide_label,
@@ -262,72 +299,190 @@ class BaseModule:
             eval_metric = metric_mod.create(eval_metric)
         validation_metric = validation_metric or eval_metric
 
-        for epoch in range(begin_epoch, num_epoch):
-            tic = time.time()
-            eval_metric.reset()
-            self._fit_epoch(train_data, epoch, eval_metric,
-                            batch_end_callback, monitor)
-            for name, val in eval_metric.get_name_value():
-                self.logger.info("Epoch[%d] Train-%s=%f", epoch, name, val)
-            self.logger.info("Epoch[%d] Time cost=%.3f", epoch,
-                             time.time() - tic)
-
-            # pull trained values host-side (also re-syncs aux stats)
-            arg_now, aux_now = self.get_params()
-            self.set_params(arg_now, aux_now)
-            if checkpoint_prefix and \
-                    (epoch + 1) % checkpoint_period == 0:
-                from ..model import save_checkpoint
-                save_checkpoint(checkpoint_prefix, epoch + 1,
-                                self.symbol, arg_now, aux_now)
-            for cb in _as_list(epoch_end_callback or []):
-                cb(epoch, self.symbol, arg_now, aux_now)
-
-            if eval_data is not None:
-                for name, val in self.score(
-                        eval_data, validation_metric, epoch=epoch,
-                        batch_end_callback=eval_batch_end_callback,
-                        score_end_callback=eval_end_callback):
-                    self.logger.info("Epoch[%d] Validation-%s=%f", epoch,
+        guard = _guardrail.FitGuard.create(
+            logger=self.logger, checkpointing=bool(checkpoint_prefix))
+        with guard.shutdown_scope():
+            epoch = begin_epoch
+            while epoch < num_epoch:
+                tic = time.time()
+                eval_metric.reset()
+                try:
+                    self._fit_epoch(train_data, epoch, eval_metric,
+                                    batch_end_callback, monitor,
+                                    guard=guard,
+                                    skip_batches=skip_batches)
+                    skip_batches = 0
+                except _guardrail.RollbackNeeded:
+                    epoch, skip_batches = self._guard_rollback(
+                        checkpoint_prefix, guard)
+                    train_data.reset()
+                    continue
+                except _guardrail.PreemptionSignal as preempted:
+                    self._guard_preempt(checkpoint_prefix, epoch,
+                                        preempted.nbatch)
+                for name, val in eval_metric.get_name_value():
+                    self.logger.info("Epoch[%d] Train-%s=%f", epoch,
                                      name, val)
-            train_data.reset()
+                self.logger.info("Epoch[%d] Time cost=%.3f", epoch,
+                                 time.time() - tic)
+
+                # pull trained values host-side (also re-syncs aux
+                # stats)
+                arg_now, aux_now = self.get_params()
+                self.set_params(arg_now, aux_now)
+                if checkpoint_prefix and \
+                        (epoch + 1) % checkpoint_period == 0:
+                    from ..model import save_checkpoint
+                    save_checkpoint(checkpoint_prefix, epoch + 1,
+                                    self.symbol, arg_now, aux_now)
+                    _clear_resume_sidecar(checkpoint_prefix, epoch + 1)
+                for cb in _as_list(epoch_end_callback or []):
+                    cb(epoch, self.symbol, arg_now, aux_now)
+
+                if eval_data is not None:
+                    for name, val in self.score(
+                            eval_data, validation_metric, epoch=epoch,
+                            batch_end_callback=eval_batch_end_callback,
+                            score_end_callback=eval_end_callback):
+                        self.logger.info("Epoch[%d] Validation-%s=%f",
+                                         epoch, name, val)
+                train_data.reset()
+                epoch += 1
+
+    def _guard_rollback(self, checkpoint_prefix, guard):
+        """Escalation: restore the newest readable checkpoint after the
+        consecutive-bad-step threshold fired. Returns (epoch to restart
+        at, batches to skip). NumericalDivergence when rollback is
+        impossible or the budget is spent."""
+        if not checkpoint_prefix:
+            guard.policy.no_checkpoint("no checkpoint_prefix "
+                                       "configured")
+        guard.policy.begin_rollback()
+        found_epoch, found_arg, found_aux = _latest_checkpoint(
+            checkpoint_prefix, self.logger)
+        if found_epoch is None:
+            guard.policy.no_checkpoint(
+                "no readable checkpoint under %r" % checkpoint_prefix)
+        self.set_params(found_arg, found_aux)
+        optimizer = getattr(self, "_optimizer", None)
+        if optimizer is not None and guard.policy.lr_factor != 1.0:
+            if optimizer.lr_scheduler is None:
+                optimizer.lr *= guard.policy.lr_factor
+            else:
+                self.logger.warning(
+                    "guardrail: MXNET_ROLLBACK_LR_FACTOR ignored — "
+                    "this optimizer's lr is driven by an LRScheduler")
+        self.logger.warning(
+            "guardrail: rolled back to checkpoint %s-%04d.params "
+            "(rollback %d/%d)", checkpoint_prefix, found_epoch,
+            guard.policy.rollbacks_done, guard.policy.max_rollbacks)
+        return found_epoch, _read_resume_sidecar(checkpoint_prefix,
+                                                 found_epoch)
+
+    def _guard_preempt(self, checkpoint_prefix, epoch, nbatch):
+        """Graceful-shutdown endgame: publish the boundary checkpoint
+        (sidecar records the exact batch) and exit EXIT_PREEMPTED so a
+        relauncher rerunning the same command resumes seamlessly."""
+        import json
+
+        from .. import guardrail as _guardrail
+        from ..model import save_checkpoint
+
+        arg_now, aux_now = self.get_params()
+        save_checkpoint(checkpoint_prefix, epoch, self.symbol,
+                        arg_now, aux_now)
+        sidecar = "%s-%04d.resume.json" % (checkpoint_prefix, epoch)
+        tmp = sidecar + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump({"epoch": epoch, "nbatch": nbatch}, f)
+        _guardrail.durable_replace(tmp, sidecar)
+        self.logger.warning(
+            "preemption: boundary checkpoint %s-%04d.params written at "
+            "epoch %d batch %d; exiting with code %d",
+            checkpoint_prefix, epoch, epoch, nbatch,
+            _guardrail.EXIT_PREEMPTED)
+        raise SystemExit(_guardrail.EXIT_PREEMPTED)
 
     def _fit_epoch(self, train_data, epoch, eval_metric,
-                   batch_end_callback, monitor):
+                   batch_end_callback, monitor, guard=None,
+                   skip_batches=0):
         """One pipelined epoch of the fit loop: batch t+1 is staged
         (prepare() dispatches its device placement) while step t runs,
         the metric accumulates on device when it has a device impl (no
         per-step host read — ``get()`` does the one blocking read), and
         a bounded dispatch window (MXNET_DISPATCH_AHEAD) blocks on the
-        step K back so async dispatch can't run away from the device."""
+        step K back so async dispatch can't run away from the device.
+
+        With a guard (fit passes one): non-finite gradients are masked
+        to zero on device before update(), the step's all-finite flag
+        rides the dispatch window in place of the output handle (the
+        flag read IS the window wait — no extra sync), device metrics
+        exclude masked steps, and a shutdown request surfaces as
+        PreemptionSignal at the next step boundary."""
+        import numpy as _np
         from collections import deque
 
         from .. import config as _config
+        from .. import guardrail as _guardrail
         from .. import profiler as _profiler
 
         ahead = max(1, int(_config.get("MXNET_DISPATCH_AHEAD")))
         inflight = deque()
+        masker = getattr(self, "_mask_nonfinite", None) \
+            if guard is not None and guard.spec is not None else None
+
+        def drain_one():
+            item = inflight.popleft()
+            if masker is not None:
+                # the window wait doubles as the guardrail flag read
+                _profiler.count_host_sync("dispatch_window")
+                guard.policy.record(bool(_np.asarray(item)))
+            else:
+                item.wait_to_read()
+
         batches = iter(train_data)
+        if skip_batches:
+            self.logger.info(
+                "mid-epoch resume: skipping %d already-trained batches "
+                "of epoch %d", skip_batches, epoch)
+            for _ in range(skip_batches):
+                if next(batches, None) is None:
+                    break
         pending = next(batches, None)
-        nbatch = 0
+        nbatch = skip_batches
         while pending is not None:
             batch = pending
+            inject = None
+            if guard is not None:
+                if guard.spec is not None or guard.shutdown is not None:
+                    inject = guard.poll_faults()
+                if guard.preempt_requested():
+                    raise _guardrail.PreemptionSignal(nbatch)
             if monitor is not None:
                 monitor.tic()
+            ok = None
             with _profiler.step_scope(nbatch):
                 self.forward_backward(batch)
+                if masker is not None:
+                    ok = masker(inject=inject)
                 self.update()
             pending = next(batches, None)
             if pending is not None:
                 self.prepare(pending)     # H2D of t+1 overlaps step t
-            self.update_metric(eval_metric, batch.label)
-            outs = self.get_outputs()
-            if outs and hasattr(outs[0], "wait_to_read"):
-                inflight.append(outs[0])
+            if ok is not None:
+                self.update_metric(eval_metric, batch.label, ok=ok)
+            else:
+                self.update_metric(eval_metric, batch.label)
+            if ok is not None:
+                inflight.append(ok)
+            else:
+                outs = self.get_outputs()
+                if outs and hasattr(outs[0], "wait_to_read"):
+                    inflight.append(outs[0])
             while len(inflight) > ahead:
                 # the ONE allowed blocking sync per step: back-pressure
-                # on the step K back (counted via wait_to_read)
-                inflight.popleft().wait_to_read()
+                # on the step K back
+                drain_one()
             if monitor is not None:
                 monitor.toc_print()
             if batch_end_callback is not None:
@@ -337,6 +492,11 @@ class BaseModule:
                 for cb in _as_list(batch_end_callback):
                     cb(info)
             nbatch += 1
+        if masker is not None:
+            # drain the window so a bad tail is seen BEFORE this
+            # epoch's checkpoint is published
+            while inflight:
+                drain_one()
 
     # -- symbol/params accessors -------------------------------------------
     @property

@@ -358,16 +358,50 @@ class DataParallelExecutorGroup:
     def set_states(self, states=None, value=None):
         assert not states and not value
 
-    def update_metric(self, eval_metric, labels):
+    def mask_nonfinite_update(self, inject=None):
+        """Device-side guardrail for the Module fit path: an all-finite
+        flag over this step's param gradients and outputs, with
+        non-finite gradients zeroed ON DEVICE (``jnp.where`` — ``nan *
+        0`` is still NaN) so update() cannot ingest them. Everything
+        dispatches async — no host sync; the fit loop reads the
+        returned flag at the bounded-dispatch-window wait it already
+        pays. ``inject`` (the ``nan@N`` fault hook) poisons the
+        gradients first so the real detection path is exercised.
+        Returns the flag as a device bool scalar (None when nothing has
+        gradients)."""
+        from .. import guardrail as _guardrail
+
+        exe = self.execs[0]
+        grad_dict = exe.grad_dict
+        holders, grads = [], []
+        for n in self.param_names:
+            g = grad_dict.get(n)
+            if g is None:
+                continue
+            holders.append(g)
+            grads.append(g._data)
+        if inject is not None and not np.isfinite(inject):
+            grads = [g * np.float32(inject) for g in grads]
+        outs = [o._data if isinstance(o, NDArray) else jax.numpy.asarray(o)
+                for o in exe.outputs]
+        if not grads and not outs:
+            return None
+        ok, masked = _guardrail.check_and_mask(grads, outs)
+        for holder, m in zip(holders, masked):
+            holder._set_data(m)
+        return ok
+
+    def update_metric(self, eval_metric, labels, ok=None):
         """Update metric with current outputs (reference
         executor_group.py:update_metric). Routed through the device
         accumulator: metrics with a device impl stay on device (no
         blocking host read per batch); the rest fall back to the host
-        path unchanged."""
+        path unchanged. ``ok`` (the guardrail's all-finite flag) masks
+        the batch's device stats so masked steps are excluded."""
         labels_ = {name: l for name, l in zip(self.label_names, labels or [])}
         preds = dict(zip(self.symbol.list_outputs(),
                          self.execs[0].outputs))
-        eval_metric.update_dict(labels_, preds, device=True)
+        eval_metric.update_dict(labels_, preds, device=True, ok=ok)
 
     def install_monitor(self, mon):
         for exe in self.execs:

@@ -366,8 +366,14 @@ class Module(BaseModule):
         self._require()
         self._exec_group.set_states(states, value)
 
-    def update_metric(self, eval_metric, labels):
-        self._exec_group.update_metric(eval_metric, labels)
+    def update_metric(self, eval_metric, labels, ok=None):
+        self._exec_group.update_metric(eval_metric, labels, ok=ok)
+
+    def _mask_nonfinite(self, inject=None):
+        """Guardrail hook for the fit loop (docs/robustness.md): zero
+        non-finite gradients on device before update() and return the
+        all-finite flag (async device scalar; no host sync)."""
+        return self._exec_group.mask_nonfinite_update(inject=inject)
 
     def _sync_params_from_devices(self):
         self._exec_group.get_params(self._arg_params, self._aux_params)

@@ -87,15 +87,34 @@ class Monitor:
 
         if self.sort:
             self.queue.sort(key=lambda row: row[1])
+        # ONE batched device→host read for every stat of the step,
+        # counted in the profiler's host-sync budget — the old path
+        # paid (and hid) one blocking .asnumpy() PER STAT, silently
+        # re-serializing the hot loop on Monitor-enabled runs
+        import jax
+
+        from . import profiler
+
+        flat = []
+        for _step, _name, value in self.queue:
+            values = value if isinstance(value, list) else [value]
+            for v in values:
+                assert isinstance(v, NDArray)
+                flat.append(v._data)
+        host = jax.device_get(flat)
+        profiler.count_host_sync("monitor_toc")
+
         rows = []
+        i = 0
         for step, name, value in self.queue:
             values = value if isinstance(value, list) else [value]
             rendered = ""
             for v in values:
-                assert isinstance(v, NDArray)
+                arr = host[i]
+                i += 1
                 scalar = v.shape in ((), (1,))
-                rendered += (str(v.asscalar()) if scalar
-                             else str(v.asnumpy())) + "\t"
+                rendered += (str(arr.reshape(())[()]) if scalar
+                             else str(arr)) + "\t"
             rows.append((step, name, rendered))
         self.queue = []
         return rows

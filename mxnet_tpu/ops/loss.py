@@ -5,6 +5,14 @@ make_loss.cc, svm_output.cc. These ops' backward passes are NOT the vjp of
 their forward (SoftmaxOutput forwards softmax but backprops cross-entropy
 gradient) — implemented with ``jax.custom_vjp`` so both the eager tape and
 jitted executors get the reference semantics.
+
+Head-grad convention: every head multiplies its emitted gradient by the
+incoming cotangent. All framework call sites pass all-ones head grads
+(Executor.backward default, TrainStep), so results are unchanged there —
+but a scaled cotangent now propagates through the head, which is what
+lets dynamic loss scaling (mxnet_tpu/guardrail.py, cotangent =
+``full(loss_scale)``) scale the whole low-precision backprop chain and
+unscale exactly afterwards.
 """
 from __future__ import annotations
 
@@ -73,6 +81,7 @@ def _softmax_output(data, label, grad_scale=1.0, ignore_label=-1.0,
                 keep_b = keep.reshape(kshape + [1] * (p.ndim - l.ndim))
             grad = grad * keep_b
         grad = grad * (grad_scale / _norm_factor(normalization, l, valid))
+        grad = grad * g.astype(grad.dtype)
         return grad.astype(p.dtype), jnp.zeros_like(l)
 
     f.defvjp(fwd, bwd)
@@ -93,6 +102,7 @@ def _regression(name, fwd_fn, grad_fn):
         def bwd(res, g):
             out, l = res
             grad = grad_fn(out, l.reshape(out.shape)) * grad_scale
+            grad = grad * g.astype(grad.dtype)
             return grad.astype(out.dtype), jnp.zeros_like(l)
 
         f.defvjp(fwd, bwd)
@@ -125,7 +135,7 @@ def _make_loss(data, grad_scale=1.0, valid_thresh=0.0,
                 jnp.sum((d > valid_thresh).astype(d.dtype)), 1.0)
         else:
             scale = grad_scale
-        return (jnp.full_like(d, 1.0) * scale,)
+        return (g.astype(d.dtype) * scale,)
 
     f.defvjp(fwd, bwd)
     return f(data)
@@ -157,7 +167,8 @@ def _svm_output(data, label, margin=1.0, regularization_coefficient=1.0,
             grad = 2 * jnp.maximum(margin - (score_y - d), 0) * \
                 viol.astype(d.dtype)
         grad = grad - onehot * jnp.sum(grad, axis=-1, keepdims=True)
-        return grad * regularization_coefficient, jnp.zeros_like(l)
+        grad = grad * regularization_coefficient * g.astype(grad.dtype)
+        return grad, jnp.zeros_like(l)
 
     f.defvjp(fwd, bwd)
     return f(data, label)

@@ -162,6 +162,14 @@ _RULE_RE = re.compile(
     r"^(?P<point>\w+):(?P<action>drop|disconnect|delay)"
     r"@(?P<nth>\d+)(?:x(?P<count>\d+|\*))?(?::(?P<arg>[0-9.]+))?$")
 
+# step-indexed guardrail rules (mxnet_tpu/guardrail.py): the "call" being
+# counted is one training step of a fit loop, and the point name IS the
+# action — `nan@5` poisons the 5th step's gradients (exercising the real
+# on-device detection/masking path), `sigterm@3` raises a real SIGTERM
+# through the chaining GracefulShutdown handler at the 3rd step boundary.
+_STEP_RULE_RE = re.compile(
+    r"^(?P<point>nan|sigterm)@(?P<nth>\d+)(?:x(?P<count>\d+|\*))?$")
+
 
 class _Rule:
     __slots__ = ("point", "action", "nth", "count", "arg")
@@ -201,6 +209,12 @@ class FaultInjector:
     * ``xcount`` — fire for that many consecutive calls (``x*`` =
       every call from nth on).
 
+    Step-indexed guardrail rules use the short form ``nan@nth[xcount]``
+    / ``sigterm@nth[xcount]`` — the "call" counted is one training step
+    of a fit loop (``on_train_step``): ``nan@5`` poisons the 5th step's
+    gradients, ``sigterm@3`` raises a real SIGTERM at the 3rd step
+    boundary (mxnet_tpu/guardrail.py).
+
     Example: ``send:disconnect@4;recv:drop@6`` tears the 4th request
     frame mid-message and severs the connection before the 6th reply
     read. Counting is process-wide per point, under a lock, so a
@@ -212,18 +226,26 @@ class FaultInjector:
     def __init__(self, spec):
         self.spec = spec or ""
         self._rules = []
+        def add_rule(m, action, arg):
+            count = m.group("count")
+            self._rules.append(_Rule(
+                m.group("point"), action, int(m.group("nth")),
+                None if count == "*" else int(count or 1), arg))
+
         for raw in filter(None,
                           (s.strip() for s in self.spec.split(";"))):
             m = _RULE_RE.match(raw)
+            if m is not None:
+                add_rule(m, m.group("action"),
+                         float(m.group("arg") or 0.0))
+                continue
+            m = _STEP_RULE_RE.match(raw)
             if m is None:
                 raise ValueError(
                     "bad MXNET_FAULT_SPEC rule %r (want "
-                    "point:action@nth[xcount][:seconds])" % raw)
-            count = m.group("count")
-            self._rules.append(_Rule(
-                m.group("point"), m.group("action"), int(m.group("nth")),
-                None if count == "*" else int(count or 1),
-                float(m.group("arg") or 0.0)))
+                    "point:action@nth[xcount][:seconds] or "
+                    "nan@nth[xcount] / sigterm@nth[xcount])" % raw)
+            add_rule(m, m.group("point"), 0.0)
         self._counts = {}
         self._lock = threading.Lock()
         self.fired = []
@@ -283,6 +305,14 @@ class FaultInjector:
         raise FaultInjected("injected %s at %s #%d"
                             % (rule.action, point,
                                self._counts.get(point, 0)))
+
+    # -- hook (called once per fit-loop step, mxnet_tpu/guardrail.py) -------
+    def on_train_step(self, point):
+        """Step-indexed guardrail points (``nan`` / ``sigterm``):
+        advance the per-point counter by one training step; True when a
+        rule fires this step. The caller performs the fault (the
+        injector has no socket to act on here)."""
+        return self._step(point) is not None
 
 
 _installed = None          # explicitly installed injector (tests)

@@ -20,6 +20,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec as P
 
+from .. import guardrail as _guardrail
 from ..executor import _graph_eval_fn
 from ..ops.registry import get_op
 from . import sharding as shd
@@ -135,6 +136,9 @@ class TrainStep:
         self._eval_fn = _graph_eval_fn(symbol, mesh=mesh)
 
         self._donate = bool(donate)
+        # last fit's guardrail outcome: masked_steps/rollbacks/lr_mult
+        # ({} until a guarded fit ran) — tests and relaunchers read it
+        self.guard_report = {}
         step = self._build_step()
         self._jit_step = jax.jit(
             step, donate_argnums=(0, 1, 2) if donate else ())
@@ -238,33 +242,53 @@ class TrainStep:
             placed = self.place_batch(self._raw_feed(batch))
         return batch, placed
 
-    def _metric_fused_step(self, metric):
+    def _metric_fused_step(self, metric, guard=None):
         """One compiled program: train step + on-device metric update.
         The metric stats tree rides along as an extra carry, so a full
-        epoch dispatches without a single device→host read."""
-        raw_step = self._build_step()
+        epoch dispatches without a single device→host read. Guarded
+        steps additionally mask the batch's stats by the step's
+        all-finite flag — a masked step contributes to neither ``sum``
+        nor ``num``, so metrics exclude it entirely."""
+        raw_step = self._build_step(guard=guard)
         label_names = list(self.label_names)
 
-        def step_with_metric(params, opt_state, aux, batch, lr, rng,
-                             mstats):
-            (p, o, a), outs = raw_step(params, opt_state, aux, batch,
-                                       lr, rng)
-            stats = metric.device_update(
-                [batch[n] for n in label_names], list(outs))
-            return (p, o, a), outs, jax.tree.map(jnp.add, mstats, stats)
+        if guard is not None:
+            def step_with_metric(params, opt_state, aux, batch, lr,
+                                 rng, mstats, inject):
+                (p, o, a), outs, ok = raw_step(
+                    params, opt_state, aux, batch, lr, rng, inject)
+                stats = metric.device_update(
+                    [batch[n] for n in label_names], list(outs))
+                stats = _guardrail.mask_stats(stats, ok)
+                return (p, o, a), outs, \
+                    jax.tree.map(jnp.add, mstats, stats), ok
+        else:
+            def step_with_metric(params, opt_state, aux, batch, lr,
+                                 rng, mstats):
+                (p, o, a), outs = raw_step(params, opt_state, aux,
+                                           batch, lr, rng)
+                stats = metric.device_update(
+                    [batch[n] for n in label_names], list(outs))
+                return (p, o, a), outs, \
+                    jax.tree.map(jnp.add, mstats, stats)
 
         return raw_step, jax.jit(
             step_with_metric,
             donate_argnums=(0, 1, 2) if self._donate else ())
 
     def _zero_metric_stats(self, raw_step, metric, state, placed, lr,
-                           rng):
+                           rng, guarded=False):
         """Zeros with the exact structure/dtypes of the metric's stats
         tree, via abstract evaluation only (no compile, no execute)."""
         params, opt_state, aux = state
-        _, outs_s = jax.eval_shape(raw_step, params, opt_state, aux,
-                                   placed, jnp.asarray(lr, jnp.float32),
-                                   rng)
+        args = (params, opt_state, aux, placed,
+                jnp.asarray(lr, jnp.float32), rng)
+        if guarded:
+            shapes = jax.eval_shape(raw_step, *args,
+                                    jnp.asarray(1.0, jnp.float32))
+        else:
+            shapes = jax.eval_shape(raw_step, *args)
+        outs_s = shapes[1]
         stats_s = jax.eval_shape(
             metric.device_update,
             [placed[n] for n in self.label_names], list(outs_s))
@@ -306,14 +330,31 @@ class TrainStep:
             it (the elastic-restart story — kill the process anywhere,
             rerun the same command; the scheduler/rng update counter
             resumes too, via the checkpoint's sidecar meta file).
+
+        Guardrails (docs/robustness.md, MXNET_GUARDRAIL default on):
+        the compiled step carries a device-side all-finite flag over
+        loss and gradients; a non-finite step's update is masked out on
+        device (weights never ingest the NaN) and fused metrics exclude
+        it. The host reads the flag at the dispatch-window wait it
+        already pays — zero extra blocking syncs. After
+        MXNET_MAX_BAD_STEPS consecutive masked steps the loop restores
+        the newest readable checkpoint (MXNET_ROLLBACK_LR_FACTOR drops
+        the lr per rollback) and raises NumericalDivergence once
+        MXNET_MAX_ROLLBACKS is spent. With a checkpoint_prefix, SIGTERM
+        or SIGINT requests a checkpoint at the next step boundary and
+        the process exits with code guardrail.EXIT_PREEMPTED; a rerun
+        with resume=True continues from that exact step.
+        MXNET_LOSS_SCALE enables (dynamic) loss scaling, its state
+        riding the checkpointed aux pytree.
+
         Returns (state, final_metric_value) — metric is None when a
         resumed run has no epochs left."""
-        import glob as _glob
-        import json as _json
         import logging
-        import re as _re
+        from collections import deque
 
+        from .. import config as _config
         from .. import metric as metric_mod
+        from .. import profiler as _profiler
         from ..initializer import Uniform
 
         log = logger or logging.getLogger(__name__)
@@ -322,32 +363,11 @@ class TrainStep:
 
         begin_epoch = 0
         n_update = 0
+        skip_batches = 0
         if checkpoint_prefix and resume:
-            import zipfile as _zipfile
-
-            from ..module.base_module import _newest_readable
-
-            found = sorted(
-                p for p in _glob.glob(checkpoint_prefix + "_*.npz")
-                if _re.search(r"_\d{4}\.npz$", p))
-            # model/optimizer MISMATCH (ValueError) is NOT in the torn
-            # set: it must fail loudly, not fall back silently
-            path, loaded = _newest_readable(
-                found, lambda p: self.load_state(p[:-len(".npz")]),
-                (OSError, EOFError, _zipfile.BadZipFile), log)
-            if path is not None:
-                state = loaded
-                latest = path[:-len(".npz")]
-                begin_epoch = int(latest.rsplit("_", 1)[1]) + 1
-                try:
-                    with open(latest + ".meta.json") as f:
-                        n_update = int(_json.load(f)["n_update"])
-                except (OSError, ValueError, KeyError):
-                    log.warning(
-                        "%s.meta.json missing/unreadable; lr schedule "
-                        "and rng folds restart from update 0", latest)
-                log.info("resumed %s (continuing at epoch %d, "
-                         "update %d)", latest, begin_epoch, n_update)
+            found = self._scan_checkpoints(checkpoint_prefix, log)
+            if found is not None:
+                state, begin_epoch, n_update, skip_batches = found
         if begin_epoch >= num_epoch:
             log.info("checkpoints already cover all %d epochs; "
                      "nothing to train", num_epoch)
@@ -361,10 +381,10 @@ class TrainStep:
                                     shapes, arg_params=arg_params,
                                     aux_params=aux_params)
 
-        from collections import deque
-
-        from .. import config as _config
-        from .. import profiler as _profiler
+        guard = _guardrail.FitGuard.create(
+            logger=log, checkpointing=bool(checkpoint_prefix))
+        spec = guard.spec
+        state = self._ensure_scaler_state(state, spec)
 
         ahead = dispatch_ahead if dispatch_ahead is not None \
             else _config.get("MXNET_DISPATCH_AHEAD")
@@ -372,76 +392,257 @@ class TrainStep:
         use_dev = bool(getattr(metric, "supports_device_update", False))
         fuse = use_dev if fuse_metric is None else bool(fuse_metric)
         fuse = fuse and use_dev
-        raw_step = fused_step = None
+        raw_step = fused_step = guarded_step = None
         if fuse:
-            raw_step, fused_step = self._metric_fused_step(metric)
+            raw_step, fused_step = self._metric_fused_step(metric, spec)
+        elif spec is not None:
+            guarded_step = jax.jit(
+                self._build_step(guard=spec),
+                donate_argnums=(0, 1, 2) if self._donate else ())
 
         rng = jax.random.PRNGKey(seed)
         inflight = deque()
-        for epoch in range(begin_epoch, num_epoch):
-            train_data.reset()
-            metric.reset()
-            mstats = None
-            batches = iter(train_data)
-            nxt = next(batches, None)
-            staged = None if nxt is None else self._stage(nxt)
-            nbatch = 0
-            while staged is not None:
-                batch, placed = staged
-                cur_lr = lr_scheduler(n_update) if lr_scheduler else lr
-                step_rng = jax.random.fold_in(rng, n_update)
-                with _profiler.step_scope(n_update):
-                    if fuse:
-                        if mstats is None:
-                            mstats = self._zero_metric_stats(
-                                raw_step, metric, state, placed,
-                                cur_lr, step_rng)
-                        params, opt_state, aux = state
-                        (params, opt_state, aux), outs, mstats = \
-                            fused_step(params, opt_state, aux, placed,
-                                       jnp.asarray(cur_lr, jnp.float32),
-                                       step_rng, mstats)
-                        state = (params, opt_state, aux)
-                        # the metric VIEWS the live epoch totals, so
-                        # get() works mid-epoch (Speedometer) at the
-                        # cost of that caller's one sync
-                        metric.set_device_stats(mstats)
-                    else:
-                        state, outs = self(state, placed, cur_lr,
-                                           step_rng)
-                n_update += 1
-                # stage batch t+1: its H2D overlaps the step just
-                # dispatched (async)
+
+        def drain_one():
+            # the one blocking sync per step either way: the bounded-
+            # dispatch-window wait. With the guardrail on it reads the
+            # step's finite flag — the value the wait was already
+            # materializing — so detection adds zero extra syncs.
+            item = inflight.popleft()
+            _profiler.count_host_sync("dispatch_window")
+            if spec is not None:
+                guard.policy.record(bool(np.asarray(item)))
+            else:
+                item.block_until_ready()
+
+        last_val = None
+        with guard.shutdown_scope():
+            epoch = begin_epoch
+            while epoch < num_epoch:
+                train_data.reset()
+                metric.reset()
+                mstats = None
+                batches = iter(train_data)
+                if skip_batches:
+                    log.info("mid-epoch resume: skipping %d already-"
+                             "trained batches of epoch %d",
+                             skip_batches, epoch)
+                    for _ in range(skip_batches):
+                        if next(batches, None) is None:
+                            break
+                    skip_batches = 0
                 nxt = next(batches, None)
                 staged = None if nxt is None else self._stage(nxt)
-                if not fuse:
-                    # fuse=False is the host metric path (device
-                    # accumulation on this loop is always fused)
-                    metric.update(batch.label,
-                                  [_nd_wrap(o) for o in outs])
-                # bounded dispatch: block on the step K back so async
-                # dispatch can't run arbitrarily ahead of the device
-                inflight.append(outs[0])
-                while len(inflight) > ahead:
-                    _profiler.count_host_sync("dispatch_window")
-                    inflight.popleft().block_until_ready()
-                if batch_end_callback:
-                    batch_end_callback(_SimpleBatchEnd(
-                        epoch, nbatch, metric))
-                nbatch += 1
-            name, val = metric.get()     # the single blocking read
-            log.info("Epoch[%d] Train-%s=%f", epoch, name, val)
-            if checkpoint_prefix and \
-                    (epoch + 1) % checkpoint_period == 0:
-                ck = "%s_%04d" % (checkpoint_prefix, epoch)
-                self.save_state(ck, state)
-                tmp = ck + ".meta.json.tmp"
-                with open(tmp, "w") as f:
-                    _json.dump({"n_update": n_update}, f)
-                os.replace(tmp, ck + ".meta.json")
-            if epoch_end_callback:
-                epoch_end_callback(epoch, state)
-        return state, metric.get()[1]
+                nbatch = 0
+                try:
+                    while staged is not None:
+                        inject = guard.poll_faults() \
+                            if spec is not None or \
+                            guard.shutdown is not None else None
+                        if guard.preempt_requested():
+                            self._preempt_exit(
+                                checkpoint_prefix, epoch, nbatch,
+                                state, n_update, log)
+                        batch, placed = staged
+                        cur_lr = (lr_scheduler(n_update) if lr_scheduler
+                                  else lr) * guard.lr_mult
+                        step_rng = jax.random.fold_in(rng, n_update)
+                        flag = None
+                        with _profiler.step_scope(n_update):
+                            lr_arr = jnp.asarray(cur_lr, jnp.float32)
+                            if fuse:
+                                if mstats is None:
+                                    mstats = self._zero_metric_stats(
+                                        raw_step, metric, state, placed,
+                                        cur_lr, step_rng,
+                                        guarded=spec is not None)
+                                params, opt_state, aux = state
+                                if spec is not None:
+                                    (params, opt_state, aux), outs, \
+                                        mstats, flag = fused_step(
+                                            params, opt_state, aux,
+                                            placed, lr_arr, step_rng,
+                                            mstats,
+                                            jnp.asarray(inject,
+                                                        jnp.float32))
+                                else:
+                                    (params, opt_state, aux), outs, \
+                                        mstats = fused_step(
+                                            params, opt_state, aux,
+                                            placed, lr_arr, step_rng,
+                                            mstats)
+                                state = (params, opt_state, aux)
+                                # the metric VIEWS the live epoch
+                                # totals, so get() works mid-epoch
+                                # (Speedometer) at the cost of that
+                                # caller's one sync
+                                metric.set_device_stats(mstats)
+                            elif spec is not None:
+                                params, opt_state, aux = state
+                                (params, opt_state, aux), outs, flag = \
+                                    guarded_step(
+                                        params, opt_state, aux, placed,
+                                        lr_arr, step_rng,
+                                        jnp.asarray(inject,
+                                                    jnp.float32))
+                                state = (params, opt_state, aux)
+                            else:
+                                state, outs = self(state, placed,
+                                                   cur_lr, step_rng)
+                        n_update += 1
+                        # stage batch t+1: its H2D overlaps the step
+                        # just dispatched (async)
+                        nxt = next(batches, None)
+                        staged = None if nxt is None \
+                            else self._stage(nxt)
+                        if not fuse:
+                            # fuse=False is the host metric path
+                            # (device accumulation on this loop is
+                            # always fused)
+                            metric.update(batch.label,
+                                          [_nd_wrap(o) for o in outs])
+                        # bounded dispatch: block on the step K back so
+                        # async dispatch can't run arbitrarily ahead of
+                        # the device; the guarded item is the step's
+                        # finite flag
+                        inflight.append(flag if flag is not None
+                                        else outs[0])
+                        while len(inflight) > ahead:
+                            drain_one()
+                        if batch_end_callback:
+                            batch_end_callback(_SimpleBatchEnd(
+                                epoch, nbatch, metric))
+                        nbatch += 1
+                    if spec is not None:
+                        # drain the window so a bad tail is seen BEFORE
+                        # this epoch's checkpoint is published
+                        while inflight:
+                            drain_one()
+                except _guardrail.RollbackNeeded:
+                    state, epoch, n_update, skip_batches = \
+                        self._rollback(checkpoint_prefix, guard, log)
+                    state = self._ensure_scaler_state(state, spec)
+                    inflight.clear()
+                    continue
+                name, val = metric.get()     # the single blocking read
+                last_val = val
+                log.info("Epoch[%d] Train-%s=%f", epoch, name, val)
+                if checkpoint_prefix and \
+                        (epoch + 1) % checkpoint_period == 0:
+                    self._save_fit_checkpoint(checkpoint_prefix, epoch,
+                                              state, n_update)
+                if epoch_end_callback:
+                    epoch_end_callback(epoch, state)
+                epoch += 1
+        self.guard_report = guard.report()
+        return state, last_val
+
+    # -- fit plumbing (checkpoint scan / publish / rollback / preempt) -----
+    def _ensure_scaler_state(self, state, spec):
+        """Seed the loss scaler's device state into aux when enabled
+        and absent (fresh runs and checkpoints from unscaled runs)."""
+        if spec is None or spec.scaler is None:
+            return state
+        params, opt_state, aux = state
+        if _guardrail.SCALE_KEY in aux:
+            return state
+        aux = dict(aux)
+        for k, v in spec.scaler.init_aux().items():
+            aux[k] = self._place_rep(v)
+        return params, opt_state, aux
+
+    def _scan_checkpoints(self, checkpoint_prefix, log):
+        """Newest readable ``prefix_NNNN.npz`` → (state, begin_epoch,
+        n_update, skip_batches), or None. A preemption boundary
+        checkpoint (meta carries epoch/nbatch) resumes INSIDE the epoch
+        it interrupted, at the exact step."""
+        import glob as _glob
+        import json as _json
+        import re as _re
+        import zipfile as _zipfile
+
+        from ..module.base_module import _newest_readable
+
+        found = sorted(
+            p for p in _glob.glob(checkpoint_prefix + "_*.npz")
+            if _re.search(r"_\d{4}\.npz$", p))
+        # model/optimizer MISMATCH (ValueError) is NOT in the torn
+        # set: it must fail loudly, not fall back silently
+        path, loaded = _newest_readable(
+            found, lambda p: self.load_state(p[:-len(".npz")]),
+            (OSError, EOFError, _zipfile.BadZipFile), log)
+        if path is None:
+            return None
+        latest = path[:-len(".npz")]
+        begin_epoch = int(latest.rsplit("_", 1)[1]) + 1
+        n_update = 0
+        skip_batches = 0
+        try:
+            with open(latest + ".meta.json") as f:
+                meta = _json.load(f)
+            n_update = int(meta["n_update"])
+            if "nbatch" in meta:
+                begin_epoch = int(meta["epoch"])
+                skip_batches = int(meta["nbatch"])
+        except (OSError, ValueError, KeyError):
+            log.warning(
+                "%s.meta.json missing/unreadable; lr schedule "
+                "and rng folds restart from update 0", latest)
+        log.info("resumed %s (continuing at epoch %d, update %d%s)",
+                 latest, begin_epoch, n_update,
+                 ", batch %d" % skip_batches if skip_batches else "")
+        return loaded, begin_epoch, n_update, skip_batches
+
+    def _save_fit_checkpoint(self, prefix, epoch, state, n_update,
+                             extra_meta=None):
+        import json as _json
+        ck = "%s_%04d" % (prefix, epoch)
+        self.save_state(ck, state)
+        meta = {"n_update": n_update}
+        if extra_meta:
+            meta.update(extra_meta)
+        tmp = ck + ".meta.json.tmp"
+        with open(tmp, "w") as f:
+            _json.dump(meta, f)
+        _guardrail.durable_replace(tmp, ck + ".meta.json")
+        return ck
+
+    def _rollback(self, checkpoint_prefix, guard, log):
+        """Escalation: restore the newest readable checkpoint after
+        MXNET_MAX_BAD_STEPS consecutive masked steps. Raises
+        NumericalDivergence when no checkpoint exists or the rollback
+        budget is spent."""
+        if not checkpoint_prefix:
+            guard.policy.no_checkpoint("no checkpoint_prefix "
+                                       "configured")
+        guard.policy.begin_rollback()
+        found = self._scan_checkpoints(checkpoint_prefix, log)
+        if found is None:
+            guard.policy.no_checkpoint(
+                "no readable checkpoint under %r" % checkpoint_prefix)
+        state, begin_epoch, n_update, skip = found
+        log.warning(
+            "guardrail: rolled back to the newest finite checkpoint "
+            "(epoch %d, update %d); lr multiplier now %g "
+            "(rollback %d/%d)", begin_epoch, n_update,
+            guard.policy.lr_mult, guard.policy.rollbacks_done,
+            guard.policy.max_rollbacks)
+        return state, begin_epoch, n_update, skip
+
+    def _preempt_exit(self, prefix, epoch, nbatch, state, n_update,
+                      log):
+        """Graceful-shutdown endgame: publish the boundary checkpoint
+        (meta records the exact step) and exit EXIT_PREEMPTED so a
+        relauncher rerunning the same command resumes seamlessly."""
+        if prefix:
+            ck = self._save_fit_checkpoint(
+                prefix, epoch, state, n_update,
+                {"epoch": epoch, "nbatch": nbatch})
+            log.warning(
+                "preemption: boundary checkpoint %s written at epoch "
+                "%d batch %d (update %d); exiting with code %d",
+                ck, epoch, nbatch, n_update, _guardrail.EXIT_PREEMPTED)
+        raise SystemExit(_guardrail.EXIT_PREEMPTED)
 
     def save_state(self, prefix, state):
         """Checkpoint (params, opt_state, aux) to ``prefix.npz`` —
@@ -461,12 +662,14 @@ class TrainStep:
                 blob["o%d:%s" % (i, n)] = np.asarray(s)
         for n, v in aux.items():
             blob["a:%s" % n] = np.asarray(v)
-        # atomic publish: the crash-resume story depends on the newest
-        # checkpoint never being a torn file — write aside, then rename
+        # durable atomic publish: the crash-resume story (and now the
+        # guardrail's auto-rollback) depends on the newest checkpoint
+        # never being torn OR lost — write aside, fsync, rename, fsync
+        # the directory (a bare rename is not crash-durable)
         tmp = prefix + ".npz.tmp"
         with open(tmp, "wb") as f:
             np.savez(f, **blob)
-        os.replace(tmp, prefix + ".npz")
+        _guardrail.durable_replace(tmp, prefix + ".npz")
         return prefix + ".npz"
 
     def load_state(self, prefix):
@@ -499,11 +702,15 @@ class TrainStep:
             _mismatch("is missing params" if missing else
                       "has unknown params",
                       missing or set(params) - set(self.param_names))
-        if set(aux) != set(self.aux_names):
-            missing = set(self.aux_names) - set(aux)
+        # guardrail state (loss scale etc.) rides aux under reserved
+        # __gr_* keys; it is optional — not part of the model contract
+        aux_model = {n for n in aux
+                     if not n.startswith(_guardrail.GR_PREFIX)}
+        if aux_model != set(self.aux_names):
+            missing = set(self.aux_names) - aux_model
             _mismatch("is missing aux states" if missing else
                       "has unknown aux states",
-                      missing or set(aux) - set(self.aux_names))
+                      missing or aux_model - set(self.aux_names))
         for n in self.param_names:
             saved = slots.get(n, {})
             if sorted(saved) != list(range(self._n_state)):
@@ -555,7 +762,17 @@ class TrainStep:
             for k, v in batch.items()}
 
     # -- the step ----------------------------------------------------------
-    def _build_step(self):
+    def _build_step(self, guard=None):
+        """The step function. ``guard`` (a ``guardrail.GuardSpec``)
+        fuses the non-finite guardrail into the compiled program: an
+        all-finite flag over loss outputs and gradients is computed on
+        device and returned as a THIRD result, the whole update
+        (params, optimizer state, BN statistics) is masked out with
+        ``jnp.where`` when the flag is false, and — when the spec
+        carries a loss scaler — the head cotangent is scaled and the
+        gradients exactly unscaled around the overflow check. Guarded
+        steps take a 7th ``inject`` scalar (1.0, or NaN to poison the
+        gradients — the deterministic ``nan@N`` fault-injection path)."""
         eval_fn = self._eval_fn
         param_names = self.param_names
         opt_attrs = dict(self.opt_params)
@@ -569,8 +786,18 @@ class TrainStep:
         id_inputs = self._id_inputs
         clip_norm = self.clip_norm
         constrain = jax.lax.with_sharding_constraint
+        scaler = guard.scaler if guard is not None else None
 
-        def step(params, opt_state, aux, batch, lr, rng):
+        def step(params, opt_state, aux, batch, lr, rng, inject=None):
+            # guardrail state (loss scale, good-step count) rides the
+            # aux pytree under reserved __gr_* keys: device-resident,
+            # checkpointed with the rest of aux, but stripped before
+            # the graph ever sees aux and merged back after
+            gr_state = {k: v for k, v in aux.items()
+                        if k.startswith(_guardrail.GR_PREFIX)}
+            if gr_state:
+                aux = {k: v for k, v in aux.items()
+                       if not k.startswith(_guardrail.GR_PREFIX)}
             # Module.init_optimizer defaults rescale_grad=1/batch; match
             # that here so the SPMD path's effective lr does not scale with
             # global batch unless the caller overrides (ADVICE r1). Local
@@ -608,11 +835,33 @@ class TrainStep:
 
             fwd_fn = jax.checkpoint(fwd) if remat else fwd
             outs, vjp, new_aux = jax.vjp(fwd_fn, params, has_aux=True)
-            # loss heads (SoftmaxOutput & co) define custom vjps that
-            # ignore the incoming cotangent — ones matches the reference's
-            # head-grad convention (Executor.backward)
-            cot = tuple(jnp.ones_like(o) for o in outs)
+            # ones is the reference's head-grad convention
+            # (Executor.backward); heads propagate the cotangent as a
+            # scale, so the loss scaler rides it: the whole backprop
+            # chain carries the (power-of-two) scale and the gradients
+            # unscale exactly afterwards
+            scale = gr_state[_guardrail.SCALE_KEY] \
+                if scaler is not None else None
+            cot = tuple(jnp.full_like(o, scale) if scale is not None
+                        else jnp.ones_like(o) for o in outs)
             grads = vjp(cot)[0]
+
+            finite = None
+            if guard is not None:
+                if inject is not None:
+                    # deterministic nan@N injection: the poison rides
+                    # the real detection/masking path below
+                    grads = {n: g_ * inject for n, g_ in grads.items()}
+                # the overflow check runs on the SCALED gradients (the
+                # signal dynamic scaling reacts to) plus the loss
+                # outputs; fused into the step, it piggybacks on work
+                # XLA already scheduled — no extra host sync ever
+                finite = _guardrail.all_finite(
+                    list(grads.values()) + list(outs))
+                if scale is not None:
+                    inv = 1.0 / scale
+                    grads = {n: (g_ * inv).astype(g_.dtype)
+                             for n, g_ in grads.items()}
 
             if clip_norm is not None:
                 # bound the EFFECTIVE gradient's global norm (after the
@@ -653,6 +902,28 @@ class TrainStep:
                     new_s = tuple(constrain(s, zs) for s in new_s)
                 new_params[n] = new_p
                 new_opt[n] = new_s
+            if guard is not None:
+                # mask the whole update out on device: a non-finite
+                # step leaves params, optimizer state AND BN statistics
+                # exactly as they were — the weights never ingest a NaN
+                new_params = {n: jnp.where(finite, new_params[n],
+                                           params[n])
+                              for n in param_names}
+                new_opt = {n: tuple(
+                    jnp.where(finite, s_new, s_old)
+                    for s_new, s_old in zip(new_opt[n], opt_state[n]))
+                    for n in param_names}
+                new_aux = {k: jnp.where(finite, v, aux[k])
+                           for k, v in new_aux.items()}
+                if scaler is not None:
+                    new_scale, new_good = scaler.next_state(
+                        gr_state[_guardrail.SCALE_KEY],
+                        gr_state[_guardrail.GOOD_KEY], finite)
+                    gr_state = {_guardrail.SCALE_KEY: new_scale,
+                                _guardrail.GOOD_KEY: new_good}
+            new_aux = {**new_aux, **gr_state}
+            if guard is not None:
+                return (new_params, new_opt, new_aux), outs, finite
             return (new_params, new_opt, new_aux), outs
 
         return step

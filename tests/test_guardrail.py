@@ -1,0 +1,508 @@
+"""Training guardrails (ISSUE 7): device-side non-finite detection and
+masked updates, dynamic loss scaling, checkpoint auto-rollback, and
+preemption-safe (SIGTERM) boundary checkpointing — all deterministically
+driven by the ``nan@N`` / ``sigterm@N`` MXNET_FAULT_SPEC rules.
+
+The load-bearing assertions (acceptance):
+- an injected-NaN run keeps finite weights, completes, and performs the
+  SAME number of blocking host syncs as a clean run (the finite flag is
+  read at the dispatch-window wait the loop already pays);
+- Perplexity/CrossEntropy exclude masked steps from their ``num``;
+- a SIGTERM mid-epoch run exits with guardrail.EXIT_PREEMPTED and a
+  boundary checkpoint, and a relaunch with resume= continues from the
+  exact step (no update lost, none double-run).
+"""
+import json
+import os
+import signal
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import jax
+
+import mxnet_tpu as mx
+from mxnet_tpu import config, guardrail, io, metric, profiler
+from mxnet_tpu.initializer import Xavier
+from mxnet_tpu.parallel import make_train_step
+from mxnet_tpu.parallel.resilience import (FaultInjector,
+                                           install_fault_injector)
+
+pytestmark = pytest.mark.guardrail
+
+
+def _mlp():
+    net = mx.sym.Variable("data")
+    net = mx.sym.FullyConnected(net, name="fc1", num_hidden=32)
+    net = mx.sym.Activation(net, act_type="relu")
+    net = mx.sym.FullyConnected(net, name="fc2", num_hidden=2)
+    return mx.sym.SoftmaxOutput(net, name="softmax")
+
+
+def _toy(n=96, d=16, seed=0):
+    rng = np.random.default_rng(seed)
+    X = rng.standard_normal((n, d)).astype(np.float32)
+    y = (X @ rng.standard_normal(d) > 0).astype(np.float32)
+    return X, y
+
+
+def _step(**kwargs):
+    kwargs.setdefault("optimizer", "sgd")
+    kwargs.setdefault("optimizer_params", {"rescale_grad": 1.0 / 32})
+    return make_train_step(_mlp(), **kwargs)
+
+
+@pytest.fixture
+def no_injector():
+    yield
+    install_fault_injector(None)
+
+
+@pytest.fixture
+def knobs():
+    """set_override-based knob scoping (restores on exit)."""
+    touched = []
+
+    def set_knob(name, value):
+        touched.append(name)
+        config.set_override(name, value)
+
+    yield set_knob
+    for name in touched:
+        config.clear_override(name)
+
+
+# ---------------------------------------------------------------------------
+# fault-spec grammar
+# ---------------------------------------------------------------------------
+
+def test_fault_spec_step_rules_parse_and_count():
+    inj = FaultInjector("nan@3x2;sigterm@5")
+    hits = [inj.on_train_step("nan") for _ in range(6)]
+    assert hits == [False, False, True, True, False, False]
+    sig = [inj.on_train_step("sigterm") for _ in range(5)]
+    assert sig == [False, False, False, False, True]
+    assert ("nan", 3, "nan") in inj.fired
+    assert ("sigterm", 5, "sigterm") in inj.fired
+    # socket rules coexist with step rules in one spec
+    FaultInjector("send:drop@2;nan@1")
+    with pytest.raises(ValueError):
+        FaultInjector("sigsegv@2")         # unknown step point
+
+
+# ---------------------------------------------------------------------------
+# non-finite detection + masking (TrainStep path)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("metric_name,kwargs", [
+    ("ce", {}),
+    ("perplexity", {"ignore_label": -1}),
+])
+def test_nan_step_masked_weights_finite_metric_excludes(
+        no_injector, metric_name, kwargs):
+    """nan@2 poisons step 2 of 3: the update is masked on device (final
+    weights finite), training completes, and the fused metric's num
+    counts only the 2 unmasked batches."""
+    X, y = _toy()
+    inj = install_fault_injector(FaultInjector("nan@2"))
+    m = metric.create(metric_name, **kwargs)
+    step = _step()
+    train = io.NDArrayIter(X, y, batch_size=32)   # 3 steps/epoch
+    state, _ = step.fit(train, num_epoch=1, initializer=Xavier(),
+                        lr=0.5, eval_metric=m)
+    assert inj.fired == [("nan", 2, "nan")]
+    assert step.guard_report["masked_steps"] == 1
+    for name, p in state[0].items():
+        assert np.isfinite(jax.device_get(p)).all(), name
+    stats = jax.device_get(m._dev_stats)
+    assert stats["num"] == 64.0, stats    # 2 x 32, masked step excluded
+    assert np.isfinite(stats["sum"]), stats
+
+
+def test_nan_detection_adds_zero_host_syncs(no_injector):
+    """Acceptance gate: host_sync_count over an instrumented epoch is
+    IDENTICAL between a clean run and an injected-NaN run — the finite
+    flag is read at the dispatch-window wait the loop already pays."""
+    X, y = _toy()
+
+    def one_epoch(spec):
+        step = _step()
+        train = io.NDArrayIter(X, y, batch_size=32)
+        # warm epoch: compiles (not the measured regime)
+        state, _ = step.fit(train, num_epoch=1, initializer=Xavier(),
+                            lr=0.1)
+        if spec:
+            install_fault_injector(FaultInjector(spec))
+        base = profiler.host_sync_count()
+        step.fit(train, num_epoch=1, state=state, lr=0.1)
+        syncs = profiler.host_sync_count() - base
+        install_fault_injector(None)
+        return syncs
+
+    clean, injected = one_epoch(None), one_epoch("nan@2")
+    assert clean == injected, (clean, injected)
+    assert clean <= 3 + 1      # the PR 2 budget still holds
+
+
+def test_guardrail_off_restores_unguarded_loop(no_injector, knobs):
+    knobs("MXNET_GUARDRAIL", False)
+    X, y = _toy()
+    step = _step()
+    train = io.NDArrayIter(X, y, batch_size=32)
+    _, acc = step.fit(train, num_epoch=6, initializer=Xavier(), lr=0.5)
+    assert acc > 0.9
+    assert step.guard_report == {}
+
+
+# ---------------------------------------------------------------------------
+# dynamic loss scaling
+# ---------------------------------------------------------------------------
+
+def test_dynamic_loss_scaler_rule():
+    s = guardrail.DynamicLossScaler(init_scale=1024.0, window=2)
+    scale = jax.numpy.float32(1024.0)
+    good = jax.numpy.float32(0.0)
+    ok = jax.numpy.bool_(True)
+    bad = jax.numpy.bool_(False)
+    scale, good = s.next_state(scale, good, bad)       # overflow halves
+    assert float(scale) == 512.0 and float(good) == 0.0
+    scale, good = s.next_state(scale, good, ok)
+    assert float(scale) == 512.0 and float(good) == 1.0
+    scale, good = s.next_state(scale, good, ok)        # window hit
+    assert float(scale) == 1024.0 and float(good) == 0.0
+    static = guardrail.DynamicLossScaler(init_scale=8.0, dynamic=False)
+    s2, g2 = static.next_state(scale, good, bad)
+    assert s2 is scale and g2 is good
+
+    assert guardrail.DynamicLossScaler.from_env() is None
+    config.set_override("MXNET_LOSS_SCALE", "dynamic")
+    try:
+        assert guardrail.DynamicLossScaler.from_env().dynamic
+        # static scales snap to the nearest power of two (the
+        # exact-unscale guarantee only holds for exponent shifts)
+        config.set_override("MXNET_LOSS_SCALE", "1000")
+        snapped = guardrail.DynamicLossScaler.from_env()
+        assert not snapped.dynamic and snapped.init_scale == 1024.0
+    finally:
+        config.clear_override("MXNET_LOSS_SCALE")
+
+
+def test_static_loss_scale_training_parity(knobs):
+    """A power-of-two static scale flows through the heads (cotangent)
+    and unscales exactly — the trajectory matches the unscaled run."""
+    X, y = _toy()
+
+    def run(scale):
+        mx.random.seed(11)
+        np.random.seed(11)
+        if scale:
+            config.set_override("MXNET_LOSS_SCALE", scale)
+        else:
+            config.clear_override("MXNET_LOSS_SCALE")
+        try:
+            step = _step()
+            train = io.NDArrayIter(X, y, batch_size=32)
+            state, acc = step.fit(train, num_epoch=3,
+                                  initializer=Xavier(), lr=0.5, seed=3)
+        finally:
+            config.clear_override("MXNET_LOSS_SCALE")
+        return state, acc
+
+    s0, a0 = run(None)
+    s1, a1 = run("1024")
+    assert abs(a0 - a1) <= 1e-6
+    np.testing.assert_allclose(jax.device_get(s0[0]["fc1_weight"]),
+                               jax.device_get(s1[0]["fc1_weight"]),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_scaler_state_halves_on_injected_overflow_and_checkpoints(
+        no_injector, knobs, tmp_path):
+    """Overflow (injected NaN) halves the scale; the scaler state rides
+    the checkpoint and restores through load_state."""
+    knobs("MXNET_LOSS_SCALE", "dynamic")
+    X, y = _toy()
+    inj = install_fault_injector(FaultInjector("nan@2"))
+    step = _step()
+    train = io.NDArrayIter(X, y, batch_size=32)
+    pfx = str(tmp_path / "ck")
+    state, _ = step.fit(train, num_epoch=1, initializer=Xavier(),
+                        lr=0.5, checkpoint_prefix=pfx)
+    assert inj.fired
+    aux = state[2]
+    scale = float(jax.device_get(aux[guardrail.SCALE_KEY]))
+    assert scale == 2.0 ** 15          # one halving of the 2**16 init
+    loaded = step.load_state(pfx + "_0000")
+    assert float(jax.device_get(
+        loaded[2][guardrail.SCALE_KEY])) == scale
+    # a checkpoint from an unscaled run still loads (keys are optional)
+    config.clear_override("MXNET_LOSS_SCALE")
+    step.load_state(pfx + "_0000")
+
+
+# ---------------------------------------------------------------------------
+# rollback escalation
+# ---------------------------------------------------------------------------
+
+def test_rollback_restores_newest_checkpoint_then_recovers(
+        no_injector, knobs, tmp_path):
+    knobs("MXNET_MAX_BAD_STEPS", 2)
+    X, y = _toy()
+    pfx = str(tmp_path / "ck")
+    step = _step()
+    train = io.NDArrayIter(X, y, batch_size=32)
+    state, _ = step.fit(train, num_epoch=2, initializer=Xavier(),
+                        lr=0.5, checkpoint_prefix=pfx)
+    # resume + 3 consecutive bad steps -> one rollback, then recovery
+    install_fault_injector(FaultInjector("nan@1x3"))
+    state, acc = step.fit(train, num_epoch=4, lr=0.5,
+                          checkpoint_prefix=pfx)
+    install_fault_injector(None)
+    assert step.guard_report["rollbacks"] == 1
+    assert acc is not None and np.isfinite(acc)
+    for name, p in state[0].items():
+        assert np.isfinite(jax.device_get(p)).all(), name
+
+
+def test_rollback_exhaustion_raises_numerical_divergence(
+        no_injector, knobs, tmp_path):
+    knobs("MXNET_MAX_BAD_STEPS", 2)
+    knobs("MXNET_MAX_ROLLBACKS", 1)
+    X, y = _toy()
+    pfx = str(tmp_path / "ck")
+    step = _step()
+    train = io.NDArrayIter(X, y, batch_size=32)
+    step.fit(train, num_epoch=1, initializer=Xavier(), lr=0.5,
+             checkpoint_prefix=pfx)
+    install_fault_injector(FaultInjector("nan@1x*"))
+    with pytest.raises(guardrail.NumericalDivergence):
+        step.fit(train, num_epoch=3, lr=0.5, checkpoint_prefix=pfx)
+
+
+def test_divergence_without_checkpoint_is_typed(no_injector, knobs):
+    """No checkpoint_prefix -> nothing to roll back to -> the typed
+    error fires on the first threshold hit."""
+    knobs("MXNET_MAX_BAD_STEPS", 2)
+    X, y = _toy()
+    step = _step()
+    train = io.NDArrayIter(X, y, batch_size=32)
+    install_fault_injector(FaultInjector("nan@1x*"))
+    with pytest.raises(guardrail.NumericalDivergence):
+        step.fit(train, num_epoch=2, initializer=Xavier(), lr=0.5)
+
+
+def test_rollback_lr_factor_applies(no_injector, knobs, tmp_path):
+    knobs("MXNET_MAX_BAD_STEPS", 2)
+    knobs("MXNET_ROLLBACK_LR_FACTOR", 0.5)
+    X, y = _toy()
+    pfx = str(tmp_path / "ck")
+    step = _step()
+    train = io.NDArrayIter(X, y, batch_size=32)
+    step.fit(train, num_epoch=1, initializer=Xavier(), lr=0.5,
+             checkpoint_prefix=pfx)
+    install_fault_injector(FaultInjector("nan@1x3"))
+    step.fit(train, num_epoch=3, lr=0.5, checkpoint_prefix=pfx)
+    install_fault_injector(None)
+    assert step.guard_report["lr_mult"] == 0.5
+
+
+# ---------------------------------------------------------------------------
+# preemption (SIGTERM) safety
+# ---------------------------------------------------------------------------
+
+def test_trainstep_sigterm_boundary_checkpoint_and_resume(
+        no_injector, tmp_path):
+    """sigterm@2 (a REAL signal through the chaining handler): fit
+    exits EXIT_PREEMPTED with a boundary checkpoint recording the exact
+    step; a rerun resumes there and runs exactly the remaining steps."""
+    X, y = _toy()
+    pfx = str(tmp_path / "ck")
+    step = _step()
+    train = io.NDArrayIter(X, y, batch_size=32)   # 3 steps/epoch
+    install_fault_injector(FaultInjector("sigterm@2"))
+    with pytest.raises(SystemExit) as exc:
+        step.fit(train, num_epoch=3, initializer=Xavier(), lr=0.5,
+                 checkpoint_prefix=pfx)
+    install_fault_injector(None)
+    assert exc.value.code == guardrail.EXIT_PREEMPTED
+    with open(pfx + "_0000.meta.json") as f:
+        meta = json.load(f)
+    assert meta == {"n_update": 1, "epoch": 0, "nbatch": 1}
+    # relaunch with the same command: continues at epoch 0 batch 1
+    step2 = _step()
+    train2 = io.NDArrayIter(X, y, batch_size=32)
+    state, acc = step2.fit(train2, num_epoch=3, initializer=Xavier(),
+                           lr=0.5, checkpoint_prefix=pfx)
+    with open(pfx + "_0002.meta.json") as f:
+        assert json.load(f)["n_update"] == 9   # no step lost or doubled
+    assert acc is not None
+
+
+def test_module_sigterm_boundary_checkpoint_and_resume(no_injector,
+                                                       tmp_path):
+    X, y = _toy()
+    pfx = str(tmp_path / "mod")
+    train = io.NDArrayIter(X, y, batch_size=32)
+    mod = mx.mod.Module(_mlp(), context=mx.cpu())
+    install_fault_injector(FaultInjector("sigterm@2"))
+    with pytest.raises(SystemExit) as exc:
+        mod.fit(train, num_epoch=3, optimizer="sgd",
+                optimizer_params={"learning_rate": 0.5},
+                checkpoint_prefix=pfx)
+    install_fault_injector(None)
+    assert exc.value.code == guardrail.EXIT_PREEMPTED
+    with open(pfx + "-0000.resume.json") as f:
+        assert json.load(f) == {"epoch": 0, "nbatch": 1}
+    mod2 = mx.mod.Module(_mlp(), context=mx.cpu())
+    train2 = io.NDArrayIter(X, y, batch_size=32)
+    mod2.fit(train2, num_epoch=3, optimizer="sgd",
+             optimizer_params={"learning_rate": 0.5},
+             checkpoint_prefix=pfx)
+    assert os.path.exists(pfx + "-0003.params")
+    w = mod2.get_params()[0]["fc1_weight"].asnumpy()
+    assert np.isfinite(w).all()
+
+
+@pytest.mark.slow
+def test_sigterm_subprocess_exits_preempted_and_resumes(tmp_path):
+    """Whole-process acceptance: the interpreter exits with code 83 and
+    the relaunched command completes the run."""
+    script = tmp_path / "run.py"
+    script.write_text(
+        "import os, sys\n"
+        "os.environ['JAX_PLATFORMS'] = 'cpu'\n"
+        "import numpy as np\n"
+        "import mxnet_tpu as mx\n"
+        "from mxnet_tpu import io\n"
+        "from mxnet_tpu.initializer import Xavier\n"
+        "from mxnet_tpu.parallel import make_train_step\n"
+        "net = mx.sym.Variable('data')\n"
+        "net = mx.sym.FullyConnected(net, name='fc1', num_hidden=32)\n"
+        "net = mx.sym.SoftmaxOutput(net, name='softmax')\n"
+        "rng = np.random.default_rng(0)\n"
+        "X = rng.standard_normal((96, 16)).astype(np.float32)\n"
+        "y = (X @ rng.standard_normal(16) > 0).astype(np.float32)\n"
+        "step = make_train_step(net, optimizer='sgd',\n"
+        "                       optimizer_params={'rescale_grad': 1/32})\n"
+        "train = io.NDArrayIter(X, y, batch_size=32)\n"
+        "state, acc = step.fit(train, num_epoch=2,\n"
+        "                      initializer=Xavier(), lr=0.5,\n"
+        "                      checkpoint_prefix=sys.argv[1])\n"
+        "print('COMPLETED')\n")
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               MXNET_FAULT_SPEC="sigterm@2")
+    env["PYTHONPATH"] = os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__)))
+    pfx = str(tmp_path / "ck")
+    first = subprocess.run([sys.executable, str(script), pfx], env=env,
+                           capture_output=True, text=True, timeout=240)
+    assert first.returncode == guardrail.EXIT_PREEMPTED, first.stderr
+    env.pop("MXNET_FAULT_SPEC")
+    second = subprocess.run([sys.executable, str(script), pfx], env=env,
+                            capture_output=True, text=True, timeout=240)
+    assert second.returncode == 0, second.stderr
+    assert "COMPLETED" in second.stdout
+
+
+def test_graceful_shutdown_chains_previous_handler():
+    seen = []
+    prev = signal.getsignal(signal.SIGTERM)
+    try:
+        signal.signal(signal.SIGTERM, lambda s, f: seen.append(s))
+        with guardrail.GracefulShutdown() as shutdown:
+            assert not shutdown.requested
+            signal.raise_signal(signal.SIGTERM)
+            assert shutdown.requested
+            assert seen == [signal.SIGTERM]    # chained, not clobbered
+        # uninstall restored the user handler
+        signal.raise_signal(signal.SIGTERM)
+        assert seen == [signal.SIGTERM, signal.SIGTERM]
+    finally:
+        signal.signal(signal.SIGTERM, prev)
+
+
+# ---------------------------------------------------------------------------
+# Module-path masking
+# ---------------------------------------------------------------------------
+
+def test_module_fit_nan_masked_weights_finite(no_injector):
+    X, y = _toy()
+    inj = install_fault_injector(FaultInjector("nan@2"))
+    train = io.NDArrayIter(X, y, batch_size=32)
+    mod = mx.mod.Module(_mlp(), context=mx.cpu())
+    mod.fit(train, num_epoch=2, optimizer="sgd",
+            optimizer_params={"learning_rate": 0.5})
+    assert inj.fired == [("nan", 2, "nan")]
+    for name, arr in mod.get_params()[0].items():
+        assert np.isfinite(arr.asnumpy()).all(), name
+
+
+def test_metric_device_ok_mask_excludes_batch():
+    m = metric.create("acc")
+    pred = mx.nd.array(np.eye(4, dtype=np.float32))
+    label = mx.nd.array(np.arange(4, dtype=np.float32))
+    m.update_device([label], [pred], ok=jax.numpy.bool_(True))
+    m.update_device([label], [pred], ok=jax.numpy.bool_(False))
+    stats = jax.device_get(m._dev_stats)
+    assert stats["num"] == 4.0 and stats["sum"] == 4.0
+
+
+# ---------------------------------------------------------------------------
+# checkpoint durability (fsync satellite)
+# ---------------------------------------------------------------------------
+
+def test_trainstep_save_state_fsyncs_file_and_dir(tmp_path,
+                                                  monkeypatch):
+    calls = []
+    real_fsync = os.fsync
+    monkeypatch.setattr(os, "fsync",
+                        lambda fd: (calls.append(fd), real_fsync(fd)))
+    X, y = _toy()
+    step = _step()
+    state = step.init_state(Xavier(), {"data": X.shape,
+                                       "softmax_label": y.shape})
+    step.save_state(str(tmp_path / "ck"), state)
+    assert len(calls) >= 2     # tmp file + directory
+    assert (tmp_path / "ck.npz").exists()
+
+
+def test_module_save_checkpoint_fsyncs(tmp_path, monkeypatch):
+    calls = []
+    real_fsync = os.fsync
+    monkeypatch.setattr(os, "fsync",
+                        lambda fd: (calls.append(fd), real_fsync(fd)))
+    from mxnet_tpu.model import save_checkpoint
+    sym = _mlp()
+    args = {"fc1_weight": mx.nd.zeros((32, 16)),
+            "fc1_bias": mx.nd.zeros((32,)),
+            "fc2_weight": mx.nd.zeros((2, 32)),
+            "fc2_bias": mx.nd.zeros((2,))}
+    save_checkpoint(str(tmp_path / "m"), 1, sym, args, {})
+    assert len(calls) >= 2     # tmp file + directory
+    assert (tmp_path / "m-0001.params").exists()
+
+
+# ---------------------------------------------------------------------------
+# monitor batched reads (satellite)
+# ---------------------------------------------------------------------------
+
+def test_monitor_toc_is_one_batched_host_sync():
+    from mxnet_tpu.monitor import Monitor
+    sym = _mlp()
+    exe = sym.simple_bind(ctx=mx.cpu(), data=(4, 16),
+                          softmax_label=(4,))
+    mon = Monitor(interval=1)
+    mon.install(exe)
+    mon.tic()
+    exe.forward(is_train=True,
+                data=np.random.RandomState(0).randn(4, 16)
+                .astype(np.float32),
+                softmax_label=np.zeros(4, np.float32))
+    base = profiler.host_sync_count()
+    rows = mon.toc()
+    assert profiler.host_sync_count() - base == 1   # ONE device_get
+    assert rows and all(r[2].strip() for r in rows)
+    floats = [float(r[2].split("\t")[0]) for r in rows]
+    assert all(np.isfinite(f) for f in floats)

@@ -23,11 +23,32 @@ if [ -n "$lint_hits" ]; then
 fi
 echo "fault lint: OK (no silent exception swallowing in mxnet_tpu/parallel/)"
 
-# -- the fault-injection test subset -------------------------------------
+# -- lint: signal handlers must chain, not clobber -----------------------
+# guardrail.GracefulShutdown chains the previous handler; a stray
+# signal.signal() anywhere else clobbers it (and every other handler in
+# the process). New registrations go through GracefulShutdown or get an
+# explicit allowlist entry here.
+sig_hits=$(grep -rn "signal\.signal(" mxnet_tpu/ \
+    | grep -v "mxnet_tpu/guardrail\.py" \
+    | grep -v "mxnet_tpu/kvstore_server\.py" || true)
+if [ -n "$sig_hits" ]; then
+    echo "SIGNAL LINT FAIL: raw signal.signal() outside guardrail.py/kvstore_server.py" >&2
+    echo "$sig_hits" >&2
+    echo "Use guardrail.GracefulShutdown (chains the previous handler) instead of clobbering." >&2
+    exit 1
+fi
+echo "signal lint: OK (no unguarded signal.signal registration)"
+
+# -- the fault-injection + guardrail test subsets ------------------------
 marker="faults and not slow"
+gmarker="guardrail and not slow"
 if [ "${FAULT_SMOKE_SLOW:-0}" = "1" ]; then
     marker="faults"
+    gmarker="guardrail"
 fi
-exec env JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" \
+env JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" \
     python -m pytest tests/test_dist_async.py -q -m "$marker" \
+    -p no:cacheprovider "$@"
+exec env JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" \
+    python -m pytest tests/test_guardrail.py -q -m "$gmarker" \
     -p no:cacheprovider "$@"
