@@ -253,7 +253,10 @@ def _timed_loop(jax, step, state, batch_dev, iters, metric, lr=0.1):
     the axon tunnel, block_until_ready alone does not guarantee device
     completion, and reading the full output tensor would measure tunnel
     transfer bandwidth, not the step (the transformer head's softmax
-    output is ~2 GB; pulling it once cost more than 30 training steps)."""
+    output is ~2 GB; pulling it once cost more than 30 training steps).
+
+    Returns (elapsed seconds, live state) — the state handed in is
+    donated by the step, so the caller must carry the returned one."""
     rng = jax.random.PRNGKey(0)
     scalar = jax.jit(lambda x: x.ravel()[0])
     try:
@@ -267,7 +270,52 @@ def _timed_loop(jax, step, state, batch_dev, iters, metric, lr=0.1):
     for _ in range(iters):
         state, outs = step(state, batch_dev, lr, rng)
     np.asarray(jax.device_get(scalar(outs[0])))  # completion barrier
-    return time.time() - t0
+    return time.time() - t0, state
+
+
+def _telemetry_pass(jax, step, state, batch_dev, lr, iters, samples,
+                    metric):
+    """Per-step telemetry journal for the run (ISSUE 8 satellite):
+    a short extra pass where each step blocks on a scalar readback, so
+    the recorded wall times are true per-step times (the headline
+    timed loop stays sync-free and is untouched). Writes the journal
+    (MXNET_TELEMETRY, else a temp dir) and returns the summary dict
+    folded into the BENCH json. Never fails the bench."""
+    try:
+        import tempfile
+
+        from mxnet_tpu import telemetry
+        from tools.telemetry_report import load, summarize
+
+        jr = telemetry.journal()
+        if jr is None:
+            jr = telemetry.start_journal(
+                tempfile.mkdtemp(prefix="bench-telemetry-"), run=metric)
+        rng = jax.random.PRNGKey(0)
+        scalar = jax.jit(lambda x: x.ravel()[0])
+        n = max(3, min(int(iters), 10))
+        # prime: the fresh scalar-readback jit compiles here, not
+        # inside the first recorded step
+        state, outs = step(state, batch_dev, lr, rng)
+        np.asarray(jax.device_get(scalar(outs[0])))
+        last = telemetry.now_ms()
+        for i in range(n):
+            state, outs = step(state, batch_dev, lr, rng)
+            np.asarray(jax.device_get(scalar(outs[0])))
+            now = telemetry.now_ms()
+            telemetry.journal_step(loop="bench", run=metric, step=i,
+                                   wall_ms=round(now - last, 3),
+                                   samples=samples)
+            last = now
+        recs = [r for r in load(jr.path)
+                if r.get("kind") == "step" and r.get("run") == metric]
+        s = summarize(recs)
+        return {"journal": jr.path, "synced_steps": n,
+                "step_ms_p50": s["step_ms"]["p50"],
+                "step_ms_p95": s["step_ms"]["p95"],
+                "samples_per_sec": s["samples_per_sec"]}
+    except Exception as e:  # noqa: BLE001 — telemetry never fails a bench
+        return {"error": str(e)[:200]}
 
 
 def _mfu(step, state, batch_vals, dev, sec_per_step, fallback_flops,
@@ -334,13 +382,16 @@ def bench_image(name, args):
         _fail(metric, "param_init", e)
 
     iters = args.iters or int(os.environ.get("BENCH_ITERS", "20"))
-    dt = _timed_loop(jax, step, state, batch_dev, iters, metric)
+    dt, state = _timed_loop(jax, step, state, batch_dev, iters, metric)
 
     img_s = batch * iters / dt
     # fwd GMACs x2 flops/MAC x3 (fwd + ~2x bwd)
     fallback = 3 * 2 * gmacs * 1e9 * batch
     mfu, _flops = _mfu(step, state, batch_vals, dev, dt / iters,
                        fallback, jax, model_flops_only=args.remat)
+    # after _mfu: the telemetry pass keeps stepping (donating) the state
+    telemetry = _telemetry_pass(jax, step, state, batch_dev, 0.1,
+                                iters, batch, metric)
     print(json.dumps({
         "metric": metric,
         "value": round(img_s, 2),
@@ -352,7 +403,8 @@ def bench_image(name, args):
         "window": args.window,
         "remat": bool(args.remat),
         "device_kind": getattr(dev, "device_kind", "unknown"),
-        "mfu": round(mfu, 4) if mfu is not None else None}))
+        "mfu": round(mfu, 4) if mfu is not None else None,
+        "telemetry": telemetry}))
 
 
 def bench_transformer(args):
@@ -410,8 +462,8 @@ def bench_transformer(args):
         _fail(metric, "param_init", e)
 
     iters = args.iters or int(os.environ.get("BENCH_ITERS", "20"))
-    dt = _timed_loop(jax, step, state, batch_dev, iters, metric,
-                     lr=1e-4)
+    dt, state = _timed_loop(jax, step, state, batch_dev, iters, metric,
+                            lr=1e-4)
 
     tok_s = B * T * iters / dt
     # analytic train flops (fwd x3): dense projections 8D^2+4DF per
@@ -428,6 +480,9 @@ def bench_transformer(args):
                    + 2 * D * V)
     mfu, flops = _mfu(step, state, batch_vals, dev, dt / iters, 3 * fwd,
                       jax, model_flops_only=args.remat)
+    # samples = tokens for the LM metric (tokens/s is the unit)
+    telemetry = _telemetry_pass(jax, step, state, batch_dev, 1e-4,
+                                iters, B * T, metric)
     print(json.dumps({
         "metric": metric,
         "value": round(tok_s, 2),
@@ -441,7 +496,8 @@ def bench_transformer(args):
         "loss_chunk": loss_chunk or None,
         "device_kind": getattr(dev, "device_kind", "unknown"),
         "step_tflops": round(flops / 1e12, 2),
-        "mfu": round(mfu, 4) if mfu is not None else None}))
+        "mfu": round(mfu, 4) if mfu is not None else None,
+        "telemetry": telemetry}))
 
 
 def bench_decode(args):

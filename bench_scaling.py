@@ -163,6 +163,48 @@ def build_step(network, mesh, global_batch, zero1, seq_parallel=False,
     return step, state, shapes
 
 
+def _telemetry_row(step, state, bd, rng, iters, gb, n):
+    """Per-step telemetry journal for one device count (ISSUE 8
+    satellite): a short extra pass where each step blocks on a scalar
+    readback, so the recorded walls are true per-step times. Returns
+    (summary dict for the JSON row, live state). Never fails the
+    bench."""
+    import jax
+    import numpy as np
+    from mxnet_tpu import telemetry
+    try:
+        import tempfile
+        jr = telemetry.journal()
+        if jr is None:
+            jr = telemetry.start_journal(
+                tempfile.mkdtemp(prefix="bench-scaling-telemetry-"),
+                run="bench_scaling")
+        walls = []
+        # prime: the scalar-readback program compiles here, not inside
+        # the first recorded step
+        state, outs = step(state, bd, 0.1, rng)
+        np.asarray(jax.device_get(outs[0].ravel()[0]))
+        # short pass — each step pays a blocking readback, so don't
+        # repeat the whole headline iteration count (same cap bench.py
+        # uses)
+        for i in range(max(3, min(int(iters), 10))):
+            t0 = telemetry.now_ms()
+            state, outs = step(state, bd, 0.1, rng)
+            np.asarray(jax.device_get(outs[0].ravel()[0]))
+            walls.append(telemetry.now_ms() - t0)
+            telemetry.journal_step(loop="bench_scaling", devices=n,
+                                   step=i, wall_ms=round(walls[-1], 3),
+                                   samples=gb)
+        walls.sort()
+        return {"journal": jr.path,
+                "step_ms_p50": round(telemetry.quantile(walls, 0.5), 3),
+                "step_ms_p95": round(telemetry.quantile(walls, 0.95), 3),
+                "samples_per_sec": round(
+                    gb * len(walls) / (sum(walls) / 1e3), 1)}, state
+    except Exception as e:  # noqa: BLE001 — telemetry never fails a bench
+        return {"error": str(e)[:200]}, state
+
+
 def main():
     args = _parse_args()
     counts = sorted({int(c) for c in args.devices.split(",")})
@@ -256,12 +298,15 @@ def main():
             state, outs = step(state, bd, 0.1, rng)
         np.asarray(jax.device_get(outs[0]))
         dt = (time.time() - t0) / args.iters
+        telemetry_row, state = _telemetry_row(step, state, bd, rng,
+                                              args.iters, gb, n)
 
         row = {"devices": n, "global_batch": gb,
                "step_ms": round(dt * 1e3, 2),
                "samples_s": round(gb / dt, 1),
                "collective_bytes_per_dev": coll,
-               "zero1": bool(args.zero1)}
+               "zero1": bool(args.zero1),
+               "telemetry": telemetry_row}
         if args.network == "transformer_lm":
             # under --seq-parallel the per-sample token count grows
             # with n, so tokens/s is the honest weak-scaling metric

@@ -38,6 +38,8 @@ if _cc:
             # older jax without this knob: best-effort, never fatal
             pass
 
+from . import telemetry
+
 from . import base
 from .base import MXNetError
 

@@ -60,7 +60,14 @@ def log_train_metric(period, auto_reset=False):
 
 class Speedometer:
     """Batch-end callback logging samples/sec (and the running metric)
-    every `frequent` batches."""
+    every `frequent` batches.
+
+    When a telemetry run journal is active (``MXNET_TELEMETRY``,
+    docs/observability.md) the throughput is sourced from the journal's
+    per-step records — one timing source of truth with
+    ``tools/telemetry_report.py`` — and the line additionally reports
+    the window's mean and p95 batch time. Without a journal it falls
+    back to its own wall-clock timer, exactly as before."""
 
     def __init__(self, batch_size, frequent=50, auto_reset=True):
         self.batch_size = batch_size
@@ -68,6 +75,32 @@ class Speedometer:
         self.auto_reset = auto_reset
         self._last_time = None
         self._last_count = 0
+
+    def _telemetry_timing(self):
+        """(speed, extra-text) from the last `frequent` journal step
+        records, or None when telemetry is off / hasn't seen enough
+        steps yet (then the wall-clock fallback runs)."""
+        from . import telemetry
+        if telemetry.journal() is None:
+            return None
+        steps = telemetry.recent_steps(self.frequent)
+        if len(steps) < self.frequent:
+            return None
+        # compile-flagged steps carry one-off XLA compile wall, not
+        # steady-state batch time — same exclusion the report applies
+        steps = [s for s in steps if not s.get("compile")]
+        if len(steps) < max(2, self.frequent // 2):
+            return None
+        walls = sorted(float(s.get("wall_ms", 0.0)) for s in steps)
+        total_s = sum(walls) / 1000.0
+        if total_s <= 0.0:
+            return None
+        samples = sum(int(s.get("samples", self.batch_size))
+                      for s in steps)
+        p95 = telemetry.quantile(walls, 0.95)
+        return samples / total_s, \
+            "\tmean-batch: %.2f ms\tp95-batch: %.2f ms" \
+            % (sum(walls) / len(walls), p95)
 
     def __call__(self, param):
         count = param.nbatch
@@ -81,19 +114,25 @@ class Speedometer:
         if count % self.frequent != 0:
             return
 
-        elapsed = time.time() - self._last_time
-        speed = self.frequent * self.batch_size / elapsed if elapsed else 0.0
+        sourced = self._telemetry_timing()
+        if sourced is not None:
+            speed, timing = sourced
+        else:
+            elapsed = time.time() - self._last_time
+            speed = self.frequent * self.batch_size / elapsed \
+                if elapsed else 0.0
+            timing = ""
         metric = param.eval_metric
         if metric is not None:
             pairs = metric.get_name_value()
             if self.auto_reset:
                 metric.reset()
             text = "".join("\t%s=%f" % pair for pair in pairs)
-            logging.info("Epoch[%d] Batch [%d]\tSpeed: %.2f samples/sec%s",
-                         param.epoch, count, speed, text)
+            logging.info("Epoch[%d] Batch [%d]\tSpeed: %.2f samples/sec%s%s",
+                         param.epoch, count, speed, timing, text)
         else:
-            logging.info("Iter[%d] Batch [%d]\tSpeed: %.2f samples/sec",
-                         param.epoch, count, speed)
+            logging.info("Iter[%d] Batch [%d]\tSpeed: %.2f samples/sec%s",
+                         param.epoch, count, speed, timing)
         self._last_time = time.time()
 
 

@@ -149,3 +149,17 @@ define("MXNET_MAX_ROLLBACKS", int, 2,
 define("MXNET_ROLLBACK_LR_FACTOR", float, 1.0,
        "learning-rate multiplier applied on every guardrail rollback "
        "(e.g. 0.5 halves the LR after each divergence rollback)")
+define("MXNET_TELEMETRY", str, "",
+       "directory (or explicit *.jsonl path) for the telemetry run "
+       "journal: one schema-versioned JSONL record per training step "
+       "and per notable event (retries, dead workers, masked steps, "
+       "rollbacks, compiles). Empty = no journal; the metrics "
+       "registry still counts either way")
+define("MXNET_TELEMETRY_PROM", str, "",
+       "path for the Prometheus textfile export of the telemetry "
+       "registry, atomically republished (durable_replace) every "
+       "MXNET_TELEMETRY_PERIOD seconds while a journal is active; "
+       "empty = disabled")
+define("MXNET_TELEMETRY_PERIOD", float, 10.0,
+       "seconds between periodic Prometheus textfile exports "
+       "(piggybacked on journal step writes)")

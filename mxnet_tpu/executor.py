@@ -235,6 +235,7 @@ class Executor:
                             if self._grad_req[n] != "null"]
         self._jit_fwd_bwd = jax.jit(self._fwd_bwd_impl)
         self._jit_bwd = jax.jit(self._bwd_impl)
+        self._compile_logged = set()   # telemetry compile events, per fn
         self.outputs = []
         self._fwd_inputs = None
         self._cached_grads = None
@@ -376,6 +377,21 @@ class Executor:
         rng = self._current_rng()
 
         from . import profiler
+        from . import telemetry as _telemetry
+
+        # telemetry compile events: the FIRST call of each jitted
+        # variant blocks through XLA trace+compile, so its wall time IS
+        # the compile cost. Monitored (un-jitted) runs are excluded.
+        jr = _telemetry.journal()
+        if self._monitor_active():
+            variant = None
+        elif is_train and self._grad_names and self._prefer_fused:
+            variant = "fwd_bwd"
+        else:
+            variant = "train_fwd" if is_train else "infer_fwd"
+        log_compile = jr is not None and variant is not None \
+            and variant not in self._compile_logged
+        t_compile = _telemetry.now_ms() if log_compile else 0.0
 
         self._cached_grads = None
         try:
@@ -393,6 +409,11 @@ class Executor:
                 else:
                     outs, new_aux = self._jit_fwd(arg_vals, aux_vals,
                                                   rng, bool(is_train))
+            if log_compile:
+                self._compile_logged.add(variant)
+                _telemetry.journal_event(
+                    "compile", site="Executor.forward", variant=variant,
+                    wall_ms=round(_telemetry.now_ms() - t_compile, 3))
         except Exception as e:  # noqa: BLE001
             if "host send/recv callbacks" in str(e) or (
                     self._has_host_callback_ops

@@ -62,6 +62,7 @@ import jax
 import jax.numpy as jnp
 
 from . import config as _config
+from . import telemetry as _telemetry
 
 __all__ = ["NumericalDivergence", "RollbackNeeded", "PreemptionSignal",
            "DynamicLossScaler", "EscalationPolicy", "GracefulShutdown",
@@ -267,6 +268,10 @@ class EscalationPolicy:
             return
         self.masked_steps += 1
         self.bad_streak += 1
+        _telemetry.counter("guardrail.masked_steps").inc()
+        _telemetry.journal_event("guardrail.masked_step",
+                                 streak=self.bad_streak,
+                                 total=self.masked_steps)
         self.log.warning(
             "guardrail: non-finite step detected and masked on device "
             "(%d consecutive, %d total)", self.bad_streak,
@@ -279,6 +284,11 @@ class EscalationPolicy:
         budget is exhausted. On success the LR multiplier shrinks by
         ``lr_factor`` and the streak resets."""
         if self.rollbacks_done >= self.max_rollbacks:
+            _telemetry.journal_event(
+                "guardrail.divergence",
+                reason="MXNET_MAX_ROLLBACKS exhausted",
+                rollbacks=self.rollbacks_done,
+                masked_steps=self.masked_steps)
             raise NumericalDivergence(
                 "training diverged: %d consecutive non-finite steps "
                 "after %d rollback(s) (%d masked steps total); "
@@ -288,9 +298,15 @@ class EscalationPolicy:
         self.rollbacks_done += 1
         self.bad_streak = 0
         self.lr_mult *= self.lr_factor
+        _telemetry.counter("guardrail.rollbacks").inc()
+        _telemetry.journal_event("guardrail.rollback",
+                                 rollback=self.rollbacks_done,
+                                 lr_mult=self.lr_mult)
 
     def no_checkpoint(self, why):
         """Rollback is needed but impossible — typed failure."""
+        _telemetry.journal_event("guardrail.divergence", reason=why,
+                                 masked_steps=self.masked_steps)
         raise NumericalDivergence(
             "training diverged: %d consecutive non-finite steps and no "
             "checkpoint to roll back to (%s)" % (self.bad_streak, why))
@@ -325,6 +341,10 @@ class GracefulShutdown:
         self.requested = False
 
     def _handler(self, signum, frame):
+        # deliberately NO telemetry here: the handler can interrupt a
+        # thread holding the journal/counter lock mid-write, and those
+        # locks are not reentrant — the boundary-checkpoint path records
+        # the guardrail.preempt_checkpoint event safely instead
         self.requested = True
         self._log.warning(
             "guardrail: received signal %d — will checkpoint at the "

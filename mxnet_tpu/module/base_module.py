@@ -268,6 +268,7 @@ class BaseModule:
         step."""
         assert num_epoch is not None, "please specify number of epochs"
         from .. import guardrail as _guardrail
+        from .. import telemetry as _telemetry
 
         skip_batches = 0
         if checkpoint_prefix and resume:
@@ -301,6 +302,9 @@ class BaseModule:
 
         guard = _guardrail.FitGuard.create(
             logger=self.logger, checkpointing=bool(checkpoint_prefix))
+        _telemetry.journal_event("fit.start", loop="module",
+                                 num_epoch=num_epoch,
+                                 begin_epoch=begin_epoch)
         with guard.shutdown_scope():
             epoch = begin_epoch
             while epoch < num_epoch:
@@ -386,6 +390,7 @@ class BaseModule:
         import json
 
         from .. import guardrail as _guardrail
+        from .. import telemetry as _telemetry
         from ..model import save_checkpoint
 
         arg_now, aux_now = self.get_params()
@@ -396,6 +401,10 @@ class BaseModule:
         with open(tmp, "w") as f:
             json.dump({"epoch": epoch, "nbatch": nbatch}, f)
         _guardrail.durable_replace(tmp, sidecar)
+        _telemetry.counter("guardrail.preempt_checkpoints").inc()
+        _telemetry.journal_event("guardrail.preempt_checkpoint",
+                                 loop="module", epoch=epoch,
+                                 nbatch=nbatch)
         self.logger.warning(
             "preemption: boundary checkpoint %s-%04d.params written at "
             "epoch %d batch %d; exiting with code %d",
@@ -425,6 +434,14 @@ class BaseModule:
         from .. import config as _config
         from .. import guardrail as _guardrail
         from .. import profiler as _profiler
+        from .. import telemetry as _telemetry
+
+        # telemetry: hoisted handle — zero cost when off; all timing
+        # below is host wall-clock (no blocking syncs added, asserted
+        # in tests/test_telemetry.py)
+        jr = _telemetry.journal()
+        step_hist = _telemetry.histogram("module.step_ms") \
+            if jr is not None else None
 
         ahead = max(1, int(_config.get("MXNET_DISPATCH_AHEAD")))
         inflight = deque()
@@ -450,6 +467,7 @@ class BaseModule:
                     break
         pending = next(batches, None)
         nbatch = skip_batches
+        t_iter = _telemetry.now_ms() if jr is not None else 0.0
         while pending is not None:
             batch = pending
             inject = None
@@ -466,9 +484,11 @@ class BaseModule:
                 if masker is not None:
                     ok = masker(inject=inject)
                 self.update()
+            t0 = _telemetry.now_ms() if jr is not None else 0.0
             pending = next(batches, None)
             if pending is not None:
                 self.prepare(pending)     # H2D of t+1 overlaps step t
+            data_ms = _telemetry.now_ms() - t0 if jr is not None else 0.0
             if ok is not None:
                 self.update_metric(eval_metric, batch.label, ok=ok)
             else:
@@ -479,10 +499,22 @@ class BaseModule:
                 outs = self.get_outputs()
                 if outs and hasattr(outs[0], "wait_to_read"):
                     inflight.append(outs[0])
+            t0 = _telemetry.now_ms() if jr is not None else 0.0
             while len(inflight) > ahead:
                 # the ONE allowed blocking sync per step: back-pressure
                 # on the step K back
                 drain_one()
+            if jr is not None:
+                now_ = _telemetry.now_ms()
+                step_hist.observe(now_ - t_iter)
+                _telemetry.journal_step(
+                    loop="module", step=nbatch, epoch=epoch,
+                    wall_ms=round(now_ - t_iter, 3),
+                    data_wait_ms=round(data_ms, 3),
+                    window_wait_ms=round(now_ - t0, 3),
+                    samples=int(batch.data[0].shape[0])
+                    if batch.data else 0)
+                t_iter = now_
             if monitor is not None:
                 monitor.toc_print()
             if batch_end_callback is not None:
@@ -497,6 +529,9 @@ class BaseModule:
             # epoch's checkpoint is published
             while inflight:
                 drain_one()
+        if jr is not None:
+            _telemetry.journal_event("epoch.end", loop="module",
+                                     epoch=epoch, steps=nbatch)
 
     # -- symbol/params accessors -------------------------------------------
     @property

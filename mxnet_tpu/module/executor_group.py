@@ -25,6 +25,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from .. import context as ctx_mod
 from .. import io
+from .. import telemetry as _telemetry
 from ..base import MXNetError
 from ..executor import Executor
 from ..ndarray import NDArray, zeros, _wrap
@@ -309,11 +310,14 @@ class DataParallelExecutorGroup:
         """Dispatch the device placement of an UPCOMING batch now, so
         its H2D overlaps the in-flight step; forward() adopts the
         staged feed when handed the same batch object (the batch is
-        held by reference, so identity can't be recycled)."""
+        held by reference, so identity can't be recycled). Staging
+        wall time (H2D *dispatch*, not the async transfer) feeds the
+        ``module.stage_ms`` telemetry histogram."""
         if is_train is None:
             is_train = self.for_training
-        self._staged = (data_batch, self._build_feeds(data_batch,
-                                                      is_train))
+        with _telemetry.histogram("module.stage_ms").timer():
+            self._staged = (data_batch,
+                            self._build_feeds(data_batch, is_train))
 
     def forward(self, data_batch, is_train=None):
         """Split (=shard) and load data, run forward (reference

@@ -51,6 +51,12 @@ import numpy as np
 from .resilience import (DeadWorkerError, RetryPolicy, _env_float,
                          active_injector)
 
+# telemetry (docs/observability.md): lightweight — pulls only config,
+# safe at this file's unusual import time (server role starts inside
+# the package import). Counters/histograms replace what used to be
+# bare log lines; journal events ride MXNET_TELEMETRY when set.
+from .. import telemetry as _telemetry
+
 # imported at MODULE level on purpose: the server role starts inside
 # the mxnet_tpu package import (reference parity — import mxnet with
 # DMLC_ROLE=server enters the server loop), which holds the package
@@ -289,6 +295,14 @@ class AsyncPSServer:
 
     # -- cohort membership / barriers ---------------------------------------
     def _barrier(self, meta):
+        """See :meth:`_barrier_impl`; this wrapper times how long the
+        caller's handler thread was parked in the barrier into the
+        ``ps.barrier_wait_ms`` histogram (aborted waits included — a
+        DeadWorkerError release is still a wait that ended)."""
+        with _telemetry.histogram("ps.barrier_wait_ms").timer():
+            return self._barrier_impl(meta)
+
+    def _barrier_impl(self, meta):
         """Counted barrier over DISTINCT clients (reference
         ps::Postoffice Barrier). Membership is a set keyed by client
         id, not a raw counter, so a reconnected client REPLAYING its
@@ -365,6 +379,9 @@ class AsyncPSServer:
         logging.info(
             "async PS: worker %s revived via %s%s", wid, via,
             "; cohort grown to %d" % grown if grown is not None else "")
+        _telemetry.counter("ps.revives").inc()
+        _telemetry.journal_event("ps.revive", wid=wid, via=via,
+                                 cohort=grown)
         if all_alive and not self._elastic:
             with self._barrier_cv:
                 if self._barrier_abort:
@@ -396,6 +413,11 @@ class AsyncPSServer:
             "async PS: worker %s declared dead (%s)%s", wid, reason,
             "; cohort shrunk to %d" % self._num_workers
             if self._elastic else "; failing barriers")
+        _telemetry.counter("ps.dead_workers").inc()
+        if "heartbeat" in reason:
+            _telemetry.counter("ps.heartbeat_lapses").inc()
+        _telemetry.journal_event("ps.dead_worker", wid=wid,
+                                 reason=reason, elastic=self._elastic)
         with self._barrier_cv:
             if self._elastic:
                 for cid in [c for c, w in self._barrier_waiters.items()
@@ -790,6 +812,7 @@ class AsyncPSClient:
         # would evict this op's slot and its replay would re-apply.
         self._op_lock = threading.Lock()
         self._sock = None
+        self._connected_once = False
         self._retry = RetryPolicy(seed=self._cid)
         op_timeout = _env_float("MXNET_PS_OP_TIMEOUT", 60.0)
         self._op_timeout = op_timeout if op_timeout > 0 else None
@@ -833,6 +856,7 @@ class AsyncPSClient:
         must not disturb the data-op sequence the server dedups on."""
         if self._sock is not None:
             return
+        was_reconnect = self._connected_once
         sock = self._open_connection()
         try:
             # the hello exchange runs under the per-op timeout too: a
@@ -851,6 +875,14 @@ class AsyncPSClient:
             raise ConnectionError("async PS rejected hello: %r"
                                   % (reply,))
         self._sock = sock
+        self._connected_once = True
+        if was_reconnect:
+            # counted only once the hello SUCCEEDED: a reconnect is a
+            # re-established session, not a connect attempt (a dead
+            # server's whole retry budget must not read as N recoveries)
+            _telemetry.counter("ps.reconnects").inc()
+            _telemetry.journal_event("ps.reconnect", wid=self._wid,
+                                     host=self._host, port=self._port)
 
     def _drop_connection_locked(self):
         if self._sock is not None:
@@ -864,8 +896,16 @@ class AsyncPSClient:
     # -- the op path ---------------------------------------------------------
     def _call(self, op, key=None, payload=None):
         barrier = op == "barrier"
+        # per-op latency (includes queueing on the op lock, retries and
+        # backoff — the latency a caller actually experiences)
+        t_op = _telemetry.now_ms()
 
         def on_retry(exc, n, delay):
+            _telemetry.counter("ps.retries").inc()
+            _telemetry.journal_event("ps.retry", op=op,
+                                     attempt=n,
+                                     delay_s=round(delay, 3),
+                                     error=type(exc).__name__)
             logging.warning(
                 "async PS %s(%r): transient %s: %s — retry %d/%d in "
                 "%.2fs", op, key, type(exc).__name__, exc, n,
@@ -896,9 +936,13 @@ class AsyncPSClient:
                             "async PS closed the connection")
                     return reply
 
-            status, result = self._retry.run(
-                attempt, describe="%s(%r)" % (op, key),
-                on_retry=on_retry)
+            try:
+                status, result = self._retry.run(
+                    attempt, describe="%s(%r)" % (op, key),
+                    on_retry=on_retry)
+            finally:
+                _telemetry.histogram("ps.op_ms." + op).observe(
+                    _telemetry.now_ms() - t_op)
         if status != "ok":
             if "DeadWorkerError" in str(result):
                 raise DeadWorkerError(result)

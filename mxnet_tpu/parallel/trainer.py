@@ -21,6 +21,7 @@ import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from .. import guardrail as _guardrail
+from .. import telemetry as _telemetry
 from ..executor import _graph_eval_fn
 from ..ops.registry import get_op
 from . import sharding as shd
@@ -400,6 +401,19 @@ class TrainStep:
                 self._build_step(guard=spec),
                 donate_argnums=(0, 1, 2) if self._donate else ())
 
+        # telemetry (docs/observability.md): the journal handle is
+        # hoisted out of the hot loop — when telemetry is off, the loop
+        # pays literally nothing. All instrumentation below is host-side
+        # wall-clock only: it adds ZERO blocking host syncs (asserted
+        # against profiler.host_sync_count in tests/test_telemetry.py).
+        jr = _telemetry.journal()
+        step_hist = _telemetry.histogram("trainstep.step_ms") \
+            if jr is not None else None
+        _telemetry.journal_event("fit.start", loop="trainstep",
+                                 num_epoch=num_epoch,
+                                 begin_epoch=begin_epoch)
+        compile_logged = False
+
         rng = jax.random.PRNGKey(seed)
         inflight = deque()
 
@@ -434,6 +448,7 @@ class TrainStep:
                 nxt = next(batches, None)
                 staged = None if nxt is None else self._stage(nxt)
                 nbatch = 0
+                t_iter = _telemetry.now_ms() if jr is not None else 0.0
                 try:
                     while staged is not None:
                         inject = guard.poll_faults() \
@@ -448,6 +463,8 @@ class TrainStep:
                                   else lr) * guard.lr_mult
                         step_rng = jax.random.fold_in(rng, n_update)
                         flag = None
+                        t_disp = _telemetry.now_ms() if jr is not None \
+                            else 0.0
                         with _profiler.step_scope(n_update):
                             lr_arr = jnp.asarray(cur_lr, jnp.float32)
                             if fuse:
@@ -490,11 +507,24 @@ class TrainStep:
                                 state, outs = self(state, placed,
                                                    cur_lr, step_rng)
                         n_update += 1
+                        if jr is not None and not compile_logged:
+                            # the first dispatch blocks through XLA
+                            # trace+compile; later dispatches return
+                            # async — its wall IS the compile cost
+                            compile_logged = True
+                            _telemetry.journal_event(
+                                "compile", site="TrainStep.fit",
+                                wall_ms=round(
+                                    _telemetry.now_ms() - t_disp, 3))
                         # stage batch t+1: its H2D overlaps the step
                         # just dispatched (async)
+                        t0 = _telemetry.now_ms() if jr is not None \
+                            else 0.0
                         nxt = next(batches, None)
                         staged = None if nxt is None \
                             else self._stage(nxt)
+                        data_ms = _telemetry.now_ms() - t0 \
+                            if jr is not None else 0.0
                         if not fuse:
                             # fuse=False is the host metric path
                             # (device accumulation on this loop is
@@ -507,8 +537,27 @@ class TrainStep:
                         # finite flag
                         inflight.append(flag if flag is not None
                                         else outs[0])
+                        t0 = _telemetry.now_ms() if jr is not None \
+                            else 0.0
                         while len(inflight) > ahead:
                             drain_one()
+                        if jr is not None:
+                            # boundary-to-boundary iteration wall: the
+                            # sum over an epoch is the epoch's wall, so
+                            # the report's samples/sec matches a
+                            # Speedometer-style measurement
+                            now_ = _telemetry.now_ms()
+                            step_hist.observe(now_ - t_iter)
+                            _telemetry.journal_step(
+                                loop="trainstep", step=n_update - 1,
+                                epoch=epoch,
+                                wall_ms=round(now_ - t_iter, 3),
+                                data_wait_ms=round(data_ms, 3),
+                                window_wait_ms=round(now_ - t0, 3),
+                                samples=int(placed[
+                                    self.data_names[0]].shape[0])
+                                if self.data_names else 0)
+                            t_iter = now_
                         if batch_end_callback:
                             batch_end_callback(_SimpleBatchEnd(
                                 epoch, nbatch, metric))
@@ -527,6 +576,10 @@ class TrainStep:
                 name, val = metric.get()     # the single blocking read
                 last_val = val
                 log.info("Epoch[%d] Train-%s=%f", epoch, name, val)
+                if jr is not None:
+                    _telemetry.journal_event("epoch.end",
+                                             loop="trainstep",
+                                             epoch=epoch, steps=nbatch)
                 if checkpoint_prefix and \
                         (epoch + 1) % checkpoint_period == 0:
                     self._save_fit_checkpoint(checkpoint_prefix, epoch,
@@ -549,6 +602,8 @@ class TrainStep:
         aux = dict(aux)
         for k, v in spec.scaler.init_aux().items():
             aux[k] = self._place_rep(v)
+        _telemetry.gauge("guardrail.loss_scale").set(
+            spec.scaler.init_scale)
         return params, opt_state, aux
 
     def _scan_checkpoints(self, checkpoint_prefix, log):
@@ -638,6 +693,10 @@ class TrainStep:
             ck = self._save_fit_checkpoint(
                 prefix, epoch, state, n_update,
                 {"epoch": epoch, "nbatch": nbatch})
+            _telemetry.counter("guardrail.preempt_checkpoints").inc()
+            _telemetry.journal_event("guardrail.preempt_checkpoint",
+                                     loop="trainstep", epoch=epoch,
+                                     nbatch=nbatch)
             log.warning(
                 "preemption: boundary checkpoint %s written at epoch "
                 "%d batch %d (update %d); exiting with code %d",
@@ -654,6 +713,12 @@ class TrainStep:
         # one device_get on the whole pytree: batched D2H instead of a
         # blocking round trip per tensor
         params, opt_state, aux = jax.device_get(state)
+        if _guardrail.SCALE_KEY in aux:
+            # the checkpoint read already materialized the scale on
+            # host — the one place the gauge can update without adding
+            # a blocking sync of its own
+            _telemetry.gauge("guardrail.loss_scale").set(
+                float(np.asarray(aux[_guardrail.SCALE_KEY])))
         blob = {}
         for n, v in params.items():
             blob["p:%s" % n] = np.asarray(v)

@@ -26,6 +26,8 @@ import os
 import threading
 import time
 
+from . import telemetry as _telemetry
+
 __all__ = ["profiler_set_config", "profiler_set_state", "dump_profile",
            "set_config", "set_state", "dump", "State", "record_event",
            "scope", "is_running", "mode", "step_scope", "count_host_sync",
@@ -41,7 +43,6 @@ class _ProfilerState:
         self.events = []
         self.lock = threading.Lock()
         self._tracing = False
-        self.host_syncs = 0               # blocking host syncs, always on
 
 
 _P = _ProfilerState()
@@ -111,13 +112,18 @@ def record_event(name, category, start_us, dur_us, tid=0, args=None):
 # (one locked int increment — noise next to the transfer it counts).
 # Counted sites: NDArray.asnumpy / wait_to_read / wait_to_write, the
 # metric device-accumulator read in EvalMetric.get, and the fit loops'
-# bounded-dispatch-window waits.
+# bounded-dispatch-window waits. The count lives in the telemetry
+# registry (ISSUE 8) — same always-on semantics, but it now also rides
+# the Prometheus export and the dump_profile snapshot; this API is the
+# stable surface the tests keep using.
+
+_HOST_SYNCS = _telemetry.counter("host_syncs")
+
 
 def count_host_sync(kind="sync"):
     """Count one blocking host synchronization (a D2H transfer or a
     block-until-ready wait); records a timeline event when running."""
-    with _P.lock:
-        _P.host_syncs += 1
+    _HOST_SYNCS.inc()
     if _P.running:
         record_event("host_sync:" + kind, "sync",
                      time.perf_counter_ns() // 1000, 1)
@@ -126,12 +132,11 @@ def count_host_sync(kind="sync"):
 def host_sync_count():
     """Monotonic count of blocking host syncs since import (tests take
     deltas around the region under scrutiny)."""
-    return _P.host_syncs
+    return _HOST_SYNCS.value
 
 
 def reset_host_sync_count():
-    with _P.lock:
-        _P.host_syncs = 0
+    _HOST_SYNCS.reset()
 
 
 class scope:
@@ -196,7 +201,10 @@ def dump_profile(filename=None):
     path = filename or _P.filename
     with _P.lock:
         events = list(_P.events)
-    payload = {"traceEvents": events, "displayTimeUnit": "ms"}
+    # the telemetry registry snapshot rides the dump as metadata, so a
+    # trace capture carries the run's counters/quantiles with it
+    payload = {"traceEvents": events, "displayTimeUnit": "ms",
+               "telemetry": _telemetry.snapshot()}
     with open(path, "w") as f:
         json.dump(payload, f)
     return path

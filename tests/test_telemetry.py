@@ -1,0 +1,475 @@
+"""Unified runtime telemetry (ISSUE 8): metrics registry, run journal,
+exporters, and the instrumented hot loops.
+
+The load-bearing assertions (acceptance):
+- a TrainStep.fit and a Module.fit run with MXNET_TELEMETRY set each
+  produce a journal from which tools/telemetry_report.py reconstructs
+  samples/sec within 5% of the Speedometer figure;
+- a fault-injected run's journal contains the matching retry /
+  dead-worker / masked-step counters;
+- telemetry-on vs telemetry-off host-sync counts are IDENTICAL in the
+  hot loop (journal writes are host-side wall clock only);
+- disabled mode is a no-op: no journal file, counter calls cheap;
+- concurrent counter/histogram updates are exact; histogram quantiles
+  match numpy on known data.
+"""
+import json
+import logging
+import os
+import re
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import callback, config, io, metric, profiler, telemetry
+from mxnet_tpu.initializer import Xavier
+from mxnet_tpu.parallel import make_train_step
+from mxnet_tpu.parallel.ps_async import AsyncPSClient, AsyncPSServer
+from mxnet_tpu.parallel.resilience import (FaultInjector,
+                                           install_fault_injector)
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+from tools.telemetry_report import format_report, load, summarize  # noqa: E402
+
+pytestmark = pytest.mark.telemetry
+
+_SPEED_RE = re.compile(r"Speed: ([0-9.]+) samples/sec")
+
+
+def _mlp():
+    net = mx.sym.Variable("data")
+    net = mx.sym.FullyConnected(net, name="fc1", num_hidden=32)
+    net = mx.sym.Activation(net, act_type="relu")
+    net = mx.sym.FullyConnected(net, name="fc2", num_hidden=2)
+    return mx.sym.SoftmaxOutput(net, name="softmax")
+
+
+def _toy(n=96, d=16, seed=0):
+    rng = np.random.default_rng(seed)
+    X = rng.standard_normal((n, d)).astype(np.float32)
+    y = (X @ rng.standard_normal(d) > 0).astype(np.float32)
+    return X, y
+
+
+def _step(**kwargs):
+    kwargs.setdefault("optimizer", "sgd")
+    kwargs.setdefault("optimizer_params", {"rescale_grad": 1.0 / 32})
+    return make_train_step(_mlp(), **kwargs)
+
+
+@pytest.fixture
+def journal_dir(tmp_path):
+    """Telemetry scoped to this test: fresh journal dir via override,
+    journal closed + override cleared on exit."""
+    telemetry.close_journal()
+    d = str(tmp_path / "tele")
+    config.set_override("MXNET_TELEMETRY", d)
+    yield d
+    telemetry.close_journal()
+    config.clear_override("MXNET_TELEMETRY")
+    config.clear_override("MXNET_TELEMETRY_PROM")
+
+
+@pytest.fixture
+def no_injector():
+    yield
+    install_fault_injector(None)
+
+
+def _measured_records(path, loop):
+    """Step records of the LAST fit in a journal (after the final
+    fit.start event of that loop), plus the full record list."""
+    recs = load(path)
+    idx = max(i for i, r in enumerate(recs)
+              if r.get("kind") == "event" and r.get("event") == "fit.start"
+              and r.get("fields", {}).get("loop") == loop)
+    steps = [r for r in recs[idx + 1:]
+             if r.get("kind") == "step" and r.get("loop") == loop]
+    return steps, recs
+
+
+# ---------------------------------------------------------------------------
+# registry
+# ---------------------------------------------------------------------------
+
+def test_registry_counter_gauge_histogram_basics():
+    c = telemetry.counter("t.basic_counter")
+    base = c.value
+    c.inc()
+    c.inc(5)
+    assert c.value - base == 6
+    g = telemetry.gauge("t.basic_gauge")
+    g.set(3.5)
+    assert g.value == 3.5
+    with pytest.raises(TypeError):
+        telemetry.gauge("t.basic_counter")    # name is the identity
+    h = telemetry.histogram("t.basic_hist")
+    with h.timer():
+        pass
+    assert h.count >= 1
+    snap = telemetry.snapshot()
+    assert snap["t.basic_counter"]["type"] == "counter"
+    assert snap["t.basic_hist"]["count"] >= 1
+
+
+def test_disabled_mode_no_journal_and_cheap_counters(tmp_path):
+    """With MXNET_TELEMETRY unset: journal() is None, journal_step /
+    journal_event are no-ops (no file, no recent-steps buffer), and a
+    counter inc is cheap enough to sit on the host-sync path."""
+    if os.environ.get("MXNET_TELEMETRY"):
+        pytest.skip("MXNET_TELEMETRY set in the environment")
+    telemetry.close_journal()
+    config.clear_override("MXNET_TELEMETRY")
+    assert telemetry.journal() is None
+    telemetry.journal_step(loop="test", step=0, wall_ms=1.0, samples=1)
+    telemetry.journal_event("test.event")
+    assert telemetry.journal() is None
+    assert telemetry.recent_steps() == []
+    c = telemetry.counter("t.cheap")
+    t0 = time.perf_counter()
+    for _ in range(100_000):
+        c.inc()
+    assert time.perf_counter() - t0 < 2.0   # ~µs/inc with huge slack
+    assert c.value >= 100_000
+
+
+def test_concurrent_updates_are_exact():
+    c = telemetry.counter("t.concurrent")
+    h = telemetry.histogram("t.concurrent_hist")
+    base_c, base_h = c.value, h.count
+    n_threads, per_thread = 8, 5000
+
+    def work():
+        for _ in range(per_thread):
+            c.inc()
+            h.observe(1.0)
+
+    threads = [threading.Thread(target=work) for _ in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert c.value - base_c == n_threads * per_thread
+    assert h.count - base_h == n_threads * per_thread
+
+
+def test_histogram_quantiles_match_numpy():
+    rng = np.random.default_rng(3)
+    data = rng.uniform(0.0, 100.0, 5000)
+    h = telemetry.histogram("t.quantiles",
+                            buckets=np.linspace(0.5, 100.0, 200))
+    for v in data:
+        h.observe(v)
+    for q in (0.5, 0.95, 0.99):
+        want = float(np.percentile(data, q * 100.0))
+        got = h.quantile(q)
+        assert abs(got - want) <= 1.0, (q, got, want)   # ~bucket width
+    snap = h.snapshot()
+    assert snap["count"] == 5000
+    assert abs(snap["mean"] - float(data.mean())) <= 0.01
+
+
+# ---------------------------------------------------------------------------
+# journal + report round trip
+# ---------------------------------------------------------------------------
+
+def test_journal_schema_and_report_roundtrip(journal_dir):
+    for i in range(10):
+        telemetry.journal_step(loop="test", step=i, epoch=0,
+                               wall_ms=10.0, data_wait_ms=1.0,
+                               window_wait_ms=2.0, samples=32)
+    telemetry.journal_event("ps.retry", op="push", attempt=1)
+    path = telemetry.close_journal()
+    assert path and os.path.exists(path)
+
+    recs = load(path)
+    kinds = {r["kind"] for r in recs}
+    assert kinds == {"run_start", "step", "event", "snapshot"}
+    for r in recs:
+        assert r["v"] == telemetry.SCHEMA_VERSION
+        assert isinstance(r["t"], float)
+
+    s = summarize(recs)
+    assert s["steps"] == 10 and s["samples"] == 320
+    assert s["step_ms"]["p50"] == 10.0 and s["step_ms"]["p95"] == 10.0
+    # 320 samples over 100 ms of step wall
+    assert abs(s["samples_per_sec"] - 3200.0) < 1e-6
+    assert s["events"]["ps.retry"] == 1
+    assert "host_syncs" in s["counters"]
+    report = format_report(s)
+    assert "step time (ms)" in report and "ps.retry" in report
+
+    # a torn FINAL line (crash signature) is tolerated...
+    with open(path, "a") as f:
+        f.write('{"v": 1, "kind": "st')
+    assert len(load(path)) == len(recs)
+    # ...corruption anywhere earlier is not
+    bad = path + ".bad"
+    lines = open(path).read().splitlines()
+    lines[1] = "not json"
+    with open(bad, "w") as f:
+        f.write("\n".join(lines))
+    with pytest.raises(ValueError, match="corrupt"):
+        load(bad)
+
+
+def test_compile_flag_only_marks_the_owning_step(journal_dir):
+    """A compile event outside a step's wall window (e.g. score()'s
+    infer compile between epochs) must NOT flag the next step — only a
+    step whose own boundary-to-boundary wall covers the event is
+    flagged."""
+    telemetry.journal_event("compile", site="test", wall_ms=1.0)
+    time.sleep(0.05)
+    # this step's window is 5 ms: the compile 50 ms ago is not in it
+    telemetry.journal_step(loop="test", step=0, wall_ms=5.0, samples=1)
+    # a compile inside the window (5000 ms covers "just now") flags it
+    telemetry.journal_event("compile", site="test", wall_ms=1.0)
+    telemetry.journal_step(loop="test", step=1, wall_ms=5000.0,
+                           samples=1)
+    path = telemetry.close_journal()
+    steps = [r for r in load(path) if r["kind"] == "step"]
+    assert "compile" not in steps[0]
+    assert steps[1].get("compile") is True
+
+
+def test_prom_export_atomic(journal_dir, tmp_path):
+    prom = str(tmp_path / "metrics.prom")
+    config.set_override("MXNET_TELEMETRY_PROM", prom)
+    telemetry.counter("t.prom_counter").inc()
+    telemetry.gauge("t.prom_gauge").set(7.0)
+    telemetry.histogram("t.prom_hist").observe(5.0)
+    out = telemetry.write_prom()
+    assert out == prom
+    text = open(prom).read()
+    assert "# TYPE mxnet_t_prom_counter counter" in text
+    assert "# TYPE mxnet_t_prom_gauge gauge" in text
+    assert "# TYPE mxnet_t_prom_hist summary" in text
+    assert 'mxnet_t_prom_hist{quantile="0.5"}' in text
+    assert "mxnet_t_prom_hist_count 1" in text
+    assert not os.path.exists(prom + ".tmp")   # atomic publish
+
+
+# ---------------------------------------------------------------------------
+# instrumented fit loops (acceptance)
+# ---------------------------------------------------------------------------
+
+def test_trainstep_fit_report_matches_speedometer(journal_dir, caplog):
+    """Acceptance: the journal of a TrainStep.fit run reconstructs
+    samples/sec within 5% of Speedometer's figure — both read the same
+    per-step wall records (one timing source of truth), Speedometer
+    over its last-`frequent` window, the report over the whole run."""
+    X, y = _toy(n=3232)                    # 101 steps/epoch @ batch 32
+    step = _step()
+    train = io.NDArrayIter(X, y, batch_size=32)
+    # warm fit: compile + init (its records are filtered out below)
+    state, _ = step.fit(train, num_epoch=1, initializer=Xavier(), lr=0.1)
+
+    speedo = callback.Speedometer(32, frequent=100, auto_reset=False)
+    with caplog.at_level(logging.INFO):
+        step.fit(train, num_epoch=1, state=state, lr=0.1,
+                 batch_end_callback=speedo)
+    path = telemetry.close_journal()
+
+    steps, recs = _measured_records(path, "trainstep")
+    assert len(steps) == 101
+    for rec in steps:
+        for key in ("wall_ms", "data_wait_ms", "window_wait_ms",
+                    "samples"):
+            assert key in rec, rec
+    assert any(r.get("kind") == "event" and r.get("event") == "compile"
+               for r in recs)
+    # the step that carried the (re)compile is flagged in its record
+    assert any(r.get("compile") for r in steps)
+
+    speeds = [float(m.group(1)) for m in
+              (_SPEED_RE.search(r.message) for r in caplog.records)
+              if m is not None]
+    assert len(speeds) == 1
+    # telemetry-sourced ticks also report batch-time quantiles
+    assert any("p95-batch:" in r.message for r in caplog.records)
+
+    s = summarize(steps)
+    assert abs(s["samples_per_sec"] - speeds[0]) <= 0.05 * speeds[0], \
+        (s["samples_per_sec"], speeds)
+
+
+def test_module_fit_report_matches_speedometer(journal_dir, caplog):
+    """Same acceptance gate for the Module.fit hot loop."""
+    X, y = _toy(n=3232)                    # 101 steps/epoch @ batch 32
+    train = io.NDArrayIter(X, y, batch_size=32)
+    mod = mx.mod.Module(_mlp(), context=mx.cpu())
+    # warm fit (bind/init/compile)
+    mod.fit(train, num_epoch=1, optimizer="sgd",
+            optimizer_params={"learning_rate": 0.1})
+
+    speedo = callback.Speedometer(32, frequent=100, auto_reset=False)
+    with caplog.at_level(logging.INFO):
+        mod.fit(train, num_epoch=1, optimizer="sgd",
+                optimizer_params={"learning_rate": 0.1},
+                batch_end_callback=speedo, force_init=True,
+                force_rebind=True)
+    path = telemetry.close_journal()
+
+    steps, _recs = _measured_records(path, "module")
+    assert len(steps) == 101
+    speeds = [float(m.group(1)) for m in
+              (_SPEED_RE.search(r.message) for r in caplog.records)
+              if m is not None]
+    assert len(speeds) == 1
+    s = summarize(steps)
+    assert abs(s["samples_per_sec"] - speeds[0]) <= 0.05 * speeds[0], \
+        (s["samples_per_sec"], speeds)
+
+
+def test_fit_telemetry_adds_zero_host_syncs(tmp_path):
+    """Acceptance: MXNET_TELEMETRY on vs off — the instrumented epoch
+    performs the IDENTICAL number of blocking host syncs (telemetry is
+    host wall-clock + file appends only)."""
+    if os.environ.get("MXNET_TELEMETRY"):
+        pytest.skip("MXNET_TELEMETRY set in the environment")
+    telemetry.close_journal()
+    config.clear_override("MXNET_TELEMETRY")
+    X, y = _toy()
+    step = _step()
+    train = io.NDArrayIter(X, y, batch_size=32)   # 3 steps/epoch
+    state, _ = step.fit(train, num_epoch=1, initializer=Xavier(),
+                        lr=0.1)                   # warm (compiles)
+
+    base = profiler.host_sync_count()
+    state, _ = step.fit(train, num_epoch=1, state=state, lr=0.1)
+    syncs_off = profiler.host_sync_count() - base
+
+    config.set_override("MXNET_TELEMETRY", str(tmp_path / "tele"))
+    try:
+        base = profiler.host_sync_count()
+        state, _ = step.fit(train, num_epoch=1, state=state, lr=0.1)
+        syncs_on = profiler.host_sync_count() - base
+    finally:
+        path = telemetry.close_journal()
+        config.clear_override("MXNET_TELEMETRY")
+    assert syncs_on == syncs_off, (syncs_on, syncs_off)
+    # and the journal really recorded the epoch it watched
+    steps = [r for r in load(path) if r.get("kind") == "step"]
+    assert len(steps) == 3
+
+
+# ---------------------------------------------------------------------------
+# fault-injected runs land in the journal (acceptance)
+# ---------------------------------------------------------------------------
+
+def test_fault_injected_run_journal_counters(journal_dir, no_injector):
+    """Retry (injected transport fault), dead-worker (heartbeat-lapse
+    declaration) and masked-step (nan@N) events all land in ONE run's
+    journal, with the matching registry counters in its final
+    snapshot."""
+    retries0 = telemetry.counter("ps.retries").value
+    reconnects0 = telemetry.counter("ps.reconnects").value
+    dead0 = telemetry.counter("ps.dead_workers").value
+    masked0 = telemetry.counter("guardrail.masked_steps").value
+
+    # -- retry + reconnect: a dropped push replays on a new connection
+    srv = AsyncPSServer(host="127.0.0.1", port=0, num_workers=1)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    client = AsyncPSClient("127.0.0.1", srv.port)
+    try:
+        client.init("w", np.ones(4, np.float32))
+        # counts are per injector install: the next send (the push) drops
+        install_fault_injector(FaultInjector("send:drop@1"))
+        client.push("w", np.ones(4, np.float32))
+        install_fault_injector(None)
+    finally:
+        client.close()
+        srv.stop()
+    assert telemetry.counter("ps.retries").value > retries0
+    assert telemetry.counter("ps.reconnects").value > reconnects0
+
+    # -- dead worker: heartbeat-lapse declaration path
+    srv2 = AsyncPSServer(host="127.0.0.1", port=0, num_workers=2)
+    try:
+        srv2._declare_dead(7, "heartbeat lapse > 0.1s (test)")
+    finally:
+        srv2.stop()
+    assert telemetry.counter("ps.dead_workers").value > dead0
+    assert telemetry.counter("ps.heartbeat_lapses").value > 0
+
+    # -- masked step: nan@2 through the real fit guardrail path
+    X, y = _toy()
+    install_fault_injector(FaultInjector("nan@2"))
+    step = _step()
+    train = io.NDArrayIter(X, y, batch_size=32)
+    step.fit(train, num_epoch=1, initializer=Xavier(), lr=0.5)
+    install_fault_injector(None)
+    assert telemetry.counter("guardrail.masked_steps").value > masked0
+
+    path = telemetry.close_journal()
+    recs = load(path)
+    events = {r["event"] for r in recs if r.get("kind") == "event"}
+    assert {"ps.retry", "ps.reconnect", "ps.dead_worker",
+            "guardrail.masked_step"} <= events
+    counters = summarize(recs)["counters"]
+    assert counters["ps.retries"] > retries0
+    assert counters["ps.dead_workers"] > dead0
+    assert counters["guardrail.masked_steps"] > masked0
+    # the per-op latency histograms saw the ops
+    snap = [r for r in recs if r.get("kind") == "snapshot"][-1]["metrics"]
+    assert snap["ps.op_ms.push"]["count"] >= 1
+    assert snap["ps.op_ms.init"]["count"] >= 1
+
+
+# ---------------------------------------------------------------------------
+# satellites
+# ---------------------------------------------------------------------------
+
+def test_speedometer_falls_back_without_telemetry(caplog):
+    """No journal: Speedometer times with its own clock (no batch-time
+    quantiles in the line) — unchanged legacy behavior."""
+    if os.environ.get("MXNET_TELEMETRY"):
+        pytest.skip("MXNET_TELEMETRY set in the environment")
+    telemetry.close_journal()
+    config.clear_override("MXNET_TELEMETRY")
+
+    class P:
+        epoch = 0
+        eval_metric = None
+
+        def __init__(self, nbatch):
+            self.nbatch = nbatch
+
+    speedo = callback.Speedometer(4, frequent=2)
+    with caplog.at_level(logging.INFO):
+        for n in range(5):
+            speedo(P(n))
+    lines = [r.message for r in caplog.records
+             if "Speed:" in r.message]
+    assert lines and all("p95-batch" not in ln for ln in lines)
+
+
+def test_profiler_dump_embeds_telemetry_snapshot(tmp_path):
+    """dump_profile metadata carries the registry snapshot — a trace
+    capture ships its run's counters/quantiles."""
+    out = str(tmp_path / "prof.json")
+    profiler.profiler_set_config(mode="all", filename=out)
+    profiler.profiler_set_state("run")
+    try:
+        mx.nd.ones((4,)).asnumpy()
+    finally:
+        profiler.profiler_set_state("stop")
+    payload = json.load(open(profiler.dump_profile()))
+    assert "telemetry" in payload
+    assert payload["telemetry"]["host_syncs"]["type"] == "counter"
+    assert payload["telemetry"]["host_syncs"]["value"] > 0
+
+
+def test_host_sync_counter_is_a_telemetry_counter():
+    """The PR 2 host-sync counter migrated into the registry behind
+    the unchanged profiler API (tests keep working; the count now also
+    rides the Prometheus export and dump_profile snapshot)."""
+    base = profiler.host_sync_count()
+    mx.nd.ones((2,)).asnumpy()
+    assert profiler.host_sync_count() == base + 1
+    assert telemetry.counter("host_syncs").value == \
+        profiler.host_sync_count()

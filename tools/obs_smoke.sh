@@ -1,0 +1,35 @@
+#!/usr/bin/env bash
+# Observability smoke: the telemetry test subset (pytest marker
+# `telemetry`, docs/observability.md) plus a lint that keeps the one
+# timing source of truth honest. Run from anywhere.
+#
+#   tools/obs_smoke.sh                 # fast tier
+#   OBS_SMOKE_SLOW=1 tools/obs_smoke.sh
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+# -- lint: ad-hoc timing must go through the telemetry registry ----------
+# A raw time.time()/time.perf_counter() call site in mxnet_tpu/parallel/
+# is a measurement nobody can see: it bypasses the registry (no
+# histogram, no journal, no Prometheus export). telemetry.py and
+# profiler.py are the sanctioned clock owners — instrumented code uses
+# telemetry.now_ms() / Histogram.timer() instead.
+lint_hits=$(grep -rn "time\.time()\|time\.perf_counter()" mxnet_tpu/parallel/ \
+    | grep -v "/telemetry\.py:" | grep -v "/profiler\.py:" || true)
+if [ -n "$lint_hits" ]; then
+    echo "OBS LINT FAIL: ad-hoc timing call site in mxnet_tpu/parallel/" >&2
+    echo "$lint_hits" >&2
+    echo "Route the measurement through mxnet_tpu/telemetry.py" >&2
+    echo "(telemetry.now_ms(), telemetry.histogram(...).timer())." >&2
+    exit 1
+fi
+echo "obs lint: OK (no ad-hoc time.time()/perf_counter() in mxnet_tpu/parallel/)"
+
+# -- the telemetry test subset -------------------------------------------
+marker="telemetry and not slow"
+if [ "${OBS_SMOKE_SLOW:-0}" = "1" ]; then
+    marker="telemetry"
+fi
+exec env JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" \
+    python -m pytest tests/test_telemetry.py -q -m "$marker" \
+    -p no:cacheprovider "$@"

@@ -1,0 +1,222 @@
+"""Turn a telemetry run journal into a human-readable run summary.
+
+The journal (schema v1, ``mxnet_tpu/telemetry.py``, written when
+``MXNET_TELEMETRY`` names a directory) holds one JSONL record per
+training step plus one per notable event. This tool reconstructs:
+
+* step-time quantiles (p50/p95/p99, exact — computed over the raw
+  per-step records, not histogram buckets) and the data-wait vs
+  window-wait breakdown;
+* the throughput curve (samples/sec over the run, bucketed);
+* a fault/guardrail event table (retries, reconnects, dead workers,
+  masked steps, rollbacks, preemption checkpoints, compiles);
+* the final metrics-registry snapshot, when the journal was closed
+  cleanly.
+
+    python tools/telemetry_report.py runs/telemetry-1234.jsonl
+    python tools/telemetry_report.py --json runs/telemetry-1234.jsonl
+
+The summary's ``samples_per_sec`` is sum(samples) / sum(wall_ms):
+step walls are measured boundary-to-boundary in the fit loops, so the
+figure reconstructs what a Speedometer callback reports (asserted
+within 5% in tests/test_telemetry.py).
+"""
+import argparse
+import json
+
+SCHEMA_VERSION = 1
+
+_CURVE_BUCKETS = 20
+
+
+def load(path):
+    """Parse a journal into a record list. A crash can tear at most the
+    FINAL line mid-write (records are flushed one line at a time), so a
+    parse failure there is tolerated; anywhere earlier it is real
+    corruption and raises. Unknown schema versions raise too."""
+    with open(path) as f:
+        lines = [ln.strip() for ln in f]
+    while lines and not lines[-1]:
+        lines.pop()
+    records = []
+    for i, line in enumerate(lines):
+        if not line:
+            continue
+        try:
+            rec = json.loads(line)
+        except ValueError:
+            if i == len(lines) - 1:
+                break            # torn final line: the crash signature
+            raise ValueError("%s:%d: corrupt journal record" % (path, i + 1))
+        v = rec.get("v")
+        if v != SCHEMA_VERSION:
+            raise ValueError(
+                "%s:%d: journal schema v%r, this reader understands v%d"
+                % (path, i + 1, v, SCHEMA_VERSION))
+        records.append(rec)
+    return records
+
+
+def _quantile(sorted_vals, q):
+    """Exact quantile of an already-sorted list (nearest-rank with the
+    numpy 'linear' convention's index rounding). Mirrors
+    mxnet_tpu.telemetry.quantile — kept standalone so this tool (and
+    xplane_summary, which imports it) never drags the framework/jax
+    import."""
+    if not sorted_vals:
+        return None
+    idx = int(round(q * (len(sorted_vals) - 1)))
+    return sorted_vals[idx]
+
+
+def _curve(steps):
+    """samples/sec over the run, in up to _CURVE_BUCKETS equal step
+    spans: [{"step": first step of span, "samples_per_sec": ...}]."""
+    if not steps:
+        return []
+    span = max(1, (len(steps) + _CURVE_BUCKETS - 1) // _CURVE_BUCKETS)
+    out = []
+    for i in range(0, len(steps), span):
+        chunk = steps[i:i + span]
+        wall_s = sum(float(s.get("wall_ms", 0.0)) for s in chunk) / 1e3
+        samples = sum(int(s.get("samples", 0)) for s in chunk)
+        out.append({
+            "step": i,
+            "samples_per_sec": round(samples / wall_s, 2) if wall_s
+            else None})
+    return out
+
+
+def summarize(records):
+    """Aggregate a record list (from :func:`load`, optionally filtered
+    by the caller — e.g. to one run's records) into the summary dict
+    format_report renders."""
+    steps = [r for r in records if r.get("kind") == "step"]
+    events = [r for r in records if r.get("kind") == "event"]
+    snap = next((r.get("metrics") for r in reversed(records)
+                 if r.get("kind") == "snapshot"), None)
+
+    out = {"schema": SCHEMA_VERSION, "steps": len(steps),
+           "events": {}}
+    for e in events:
+        name = e.get("event", "?")
+        out["events"][name] = out["events"].get(name, 0) + 1
+
+    if steps:
+        # steady-state view: steps flagged compile=True carried an XLA
+        # compile (the journal marks them at write time) — one-off wall
+        # that would otherwise poison every quantile and the
+        # throughput figure. They are reported separately below.
+        steady = [s for s in steps if not s.get("compile")] or steps
+        compile_ms = sum(float(s.get("wall_ms", 0.0)) for s in steps
+                         if s.get("compile"))
+        walls = sorted(float(s.get("wall_ms", 0.0)) for s in steady)
+        total_s = sum(walls) / 1e3
+        samples = sum(int(s.get("samples", 0)) for s in steady)
+        out["samples"] = samples
+        out["wall_s"] = round(total_s, 3)
+        out["compile_steps"] = sum(1 for s in steps
+                                   if s.get("compile"))
+        out["compile_ms"] = round(compile_ms, 3)
+        out["samples_per_sec"] = round(samples / total_s, 3) \
+            if total_s else None
+        out["step_ms"] = {
+            "mean": round(sum(walls) / len(walls), 3),
+            "p50": round(_quantile(walls, 0.50), 3),
+            "p95": round(_quantile(walls, 0.95), 3),
+            "p99": round(_quantile(walls, 0.99), 3),
+            "min": round(walls[0], 3),
+            "max": round(walls[-1], 3)}
+        for key in ("data_wait_ms", "window_wait_ms"):
+            tot = sum(float(s.get(key, 0.0)) for s in steady)
+            out[key + "_total"] = round(tot, 3)
+            out[key + "_share"] = round(tot / (total_s * 1e3), 4) \
+                if total_s else None
+        out["throughput_curve"] = _curve(steady)
+
+    if snap is not None:
+        out["counters"] = {k: v["value"] for k, v in sorted(snap.items())
+                           if v.get("type") == "counter"}
+        out["gauges"] = {k: v["value"] for k, v in sorted(snap.items())
+                         if v.get("type") == "gauge"
+                         and v.get("value") is not None}
+    return out
+
+
+def format_report(summary):
+    """The summary dict as a human-readable text report."""
+    lines = ["telemetry run summary (journal schema v%d)"
+             % summary["schema"],
+             "=" * 46, ""]
+    if summary["steps"]:
+        sm = summary["step_ms"]
+        lines += [
+            "steps: %d   samples: %d   wall: %.2fs   throughput: "
+            "%.1f samples/sec (steady state)"
+            % (summary["steps"], summary["samples"], summary["wall_s"],
+               summary["samples_per_sec"] or 0.0)]
+        if summary.get("compile_steps"):
+            lines.append(
+                "compile: %d step(s) carried an XLA compile "
+                "(%.1f ms total) — excluded from the figures above"
+                % (summary["compile_steps"], summary["compile_ms"]))
+        lines += [
+            "",
+            "step time (ms):",
+            "| mean | p50 | p95 | p99 | min | max |",
+            "|---|---|---|---|---|---|",
+            "| %.2f | %.2f | %.2f | %.2f | %.2f | %.2f |"
+            % (sm["mean"], sm["p50"], sm["p95"], sm["p99"], sm["min"],
+               sm["max"]),
+            "",
+            "wait breakdown: data %.1f%%, dispatch window %.1f%% of "
+            "step wall"
+            % (100.0 * (summary.get("data_wait_ms_share") or 0.0),
+               100.0 * (summary.get("window_wait_ms_share") or 0.0)),
+        ]
+        curve = summary.get("throughput_curve") or []
+        if len(curve) > 1:
+            lines += ["", "throughput curve (samples/sec by step span):"]
+            for pt in curve:
+                lines.append("  step %5d+  %s" % (
+                    pt["step"],
+                    "%.1f" % pt["samples_per_sec"]
+                    if pt["samples_per_sec"] is not None else "-"))
+    else:
+        lines.append("no step records (events-only journal)")
+
+    if summary["events"]:
+        lines += ["", "events:",
+                  "| event | count |", "|---|---|"]
+        for name in sorted(summary["events"]):
+            lines.append("| %s | %d |" % (name, summary["events"][name]))
+
+    if summary.get("counters"):
+        lines += ["", "final counters (registry snapshot):"]
+        for name, val in summary["counters"].items():
+            lines.append("  %-36s %d" % (name, val))
+    if summary.get("gauges"):
+        lines += ["", "gauges:"]
+        for name, val in summary["gauges"].items():
+            lines.append("  %-36s %g" % (name, val))
+    return "\n".join(lines)
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("journal", help="path to a telemetry *.jsonl journal")
+    p.add_argument("--json", action="store_true",
+                   help="emit the summary dict as JSON instead of text")
+    args = p.parse_args(argv)
+    summary = summarize(load(args.journal))
+    try:
+        if args.json:
+            print(json.dumps(summary, indent=2))
+        else:
+            print(format_report(summary))
+    except BrokenPipeError:        # `... | head` is a normal usage
+        pass
+
+
+if __name__ == "__main__":
+    main()

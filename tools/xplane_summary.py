@@ -36,6 +36,14 @@ if __name__ == "__main__" and \
     os.environ["PROTOCOL_BUFFERS_PYTHON_IMPLEMENTATION"] = "python"
     os.execv(sys.executable, [sys.executable] + sys.argv)
 
+# one quantile rule across the observability tools (run as a script,
+# sys.path[0] is tools/; imported as a package module, it is the repo
+# root — hence the two spellings)
+try:
+    from tools.telemetry_report import _quantile
+except ImportError:
+    from telemetry_report import _quantile
+
 
 # op-name -> coarse category. Order matters: first match wins, so the
 # specific multi-word keys (all-reduce, reduce-window) must precede the
@@ -58,6 +66,20 @@ _CATEGORIES = (
                             "divide", "tanh", "exp", "maximum")),
     ("custom / pallas", ("custom-call",)),
 )
+
+
+def _step_label(name, ev, stat_names):
+    """Group key for one StepTraceAnnotation event: the annotation
+    name plus its step_num/group_id stat when the plane carries one
+    ('train_step#12'); TraceMe-encoded metadata ('name#k=v#') falls
+    back to the raw name."""
+    base = name.split("#", 1)[0]
+    for st in ev.stats:
+        if stat_names.get(st.metadata_id) in ("step_num", "group_id",
+                                              "step_id"):
+            v = st.int64_value or st.uint64_value
+            return "%s#%d" % (base, v)
+    return name
 
 
 def _category(name):
@@ -100,6 +122,7 @@ def summarize(trace_dir):
 
     per_cat = collections.Counter()
     per_op = collections.Counter()
+    step_ps = collections.Counter()     # StepTraceAnnotation groups
     total = 0
     async_ps = 0
     for path in paths:
@@ -124,6 +147,21 @@ def summarize(trace_dir):
                 continue
             ev_names = {eid: em.name
                         for eid, em in plane.event_metadata.items()}
+            stat_names = {sid: sm.name
+                          for sid, sm in plane.stat_metadata.items()}
+            # step groups (ISSUE 8 satellite): device planes carry a
+            # "Steps" line with one event per StepTraceAnnotation (the
+            # markers PR 2's profiler.step_scope emits) — aggregate
+            # them into the per-step device-time table. These lines
+            # overlap the per-op line, so they stay OUT of the
+            # category/total tally below.
+            for line in plane.lines:
+                if line.name.lower() != "steps":
+                    continue
+                for ev in line.events:
+                    name = ev_names.get(ev.metadata_id, "?")
+                    step_ps[_step_label(name, ev, stat_names)] += \
+                        ev.duration_ps
             # device planes carry overlapping lines: XLA Modules / Steps
             # span the same wall time as the per-op line, and "Async XLA
             # Ops" holds in-flight copy spans that overlap compute — keep
@@ -144,6 +182,14 @@ def summarize(trace_dir):
                                              "PjitFunction",
                                              "PyArray", "Thread")):
                         continue
+                    if name.split("#", 1)[0].split(" = ", 1)[0] == \
+                            "train_step":
+                        # a step marker leaking onto an op/host line
+                        # (CPU backend has no Steps line): count it as
+                        # a step group, never as device op work
+                        step_ps[_step_label(name, ev, stat_names)] += \
+                            ev.duration_ps
+                        continue
                     dur = ev.duration_ps
                     # async copy/slice pairs (HBM<->VMEM prefetches from
                     # XLA's memory-space assignment, S(1) layouts) span
@@ -163,30 +209,56 @@ def summarize(trace_dir):
                     per_cat[_category(name)] += dur
                     per_op[name] += dur
                     total += dur
-    return per_cat, per_op, total, async_ps
+    return per_cat, per_op, total, async_ps, dict(step_ps)
+
+
+def _print_steps(step_ps):
+    """Per-step device-time table from the StepTraceAnnotation groups
+    (empty when the trace carries no step markers)."""
+    if not step_ps:
+        return
+    print("\nstep groups (StepTraceAnnotation):")
+    print("| step | device ms |")
+    print("|---|---|")
+    def _key(item):
+        base, _, num = item[0].partition("#")
+        return (base, int(num)) if num.isdigit() else (item[0], -1)
+
+    shown = sorted(step_ps.items(), key=_key)
+    for name, ps in shown[:30]:
+        print("| %s | %.2f |" % (name, ps / 1e9))
+    if len(shown) > 30:
+        print("| ... %d more steps ... | |" % (len(shown) - 30))
+    durs = sorted(ps / 1e9 for _, ps in shown)
+    print("(%d steps; mean %.2f ms, p50 %.2f, p95 %.2f)"
+          % (len(durs), sum(durs) / len(durs),
+             _quantile(durs, 0.50), _quantile(durs, 0.95)))
 
 
 def main():
     if len(sys.argv) != 2:
         raise SystemExit("usage: xplane_summary.py <trace_dir>")
-    per_cat, per_op, total, async_ps = summarize(sys.argv[1])
-    if not total:
+    per_cat, per_op, total, async_ps, step_ps = summarize(sys.argv[1])
+    if not total and not step_ps:
         raise SystemExit("no device events found (trace too short, or "
                          "only host planes present)")
-    print("device time by category:")
-    print("| category | ms | share |")
-    print("|---|---|---|")
-    for cat, ps in per_cat.most_common():
-        print("| %s | %.2f | %.1f%% |" % (cat, ps / 1e9,
-                                          100.0 * ps / total))
-    if async_ps:
-        print("(async copy/collective start-done spans — HBM<->VMEM "
-              "prefetches and in-flight comm, overlapped with compute "
-              "— excluded above: %.2f ms)" % (async_ps / 1e9))
-    print("\ntop 15 ops:")
-    for name, ps in per_op.most_common(15):
-        print("  %8.2f ms  %4.1f%%  %s" % (
-            ps / 1e9, 100.0 * ps / total, name[:90]))
+    if total:
+        print("device time by category:")
+        print("| category | ms | share |")
+        print("|---|---|---|")
+        for cat, ps in per_cat.most_common():
+            print("| %s | %.2f | %.1f%% |" % (cat, ps / 1e9,
+                                              100.0 * ps / total))
+        if async_ps:
+            print("(async copy/collective start-done spans — HBM<->VMEM "
+                  "prefetches and in-flight comm, overlapped with compute "
+                  "— excluded above: %.2f ms)" % (async_ps / 1e9))
+    _print_steps(step_ps)
+    if total:
+        print("\ntop 15 ops:")
+        for name, ps in per_op.most_common(15):
+            print("  %8.2f ms  %4.1f%%  %s" % (
+                ps / 1e9, 100.0 * ps / total, name[:90]))
 
 
 if __name__ == "__main__":
