@@ -1,0 +1,165 @@
+"""Serving benchmark: closed-loop load generation against the
+ServeEngine (docs/serving.md), structured like bench.py — ONE JSON
+line {"metric", "value", "unit", "vs_baseline", ...}.
+
+Offered-load sweep: for each concurrency level C, C closed-loop
+clients each run `requests` submit→wait round trips against a fresh
+engine; the sweep rows report throughput, request-latency
+p50/p95/p99, and the mean batch fill the batcher achieved (the
+whole point of the engine — fill should rise with C while per-request
+latency stays bounded by the coalesce window + one forward).
+
+    python bench_serve.py                       # default sweep 1,2,4,8,16
+    python bench_serve.py --concurrency 1,8,32 --requests 200
+    python bench_serve.py --buckets 1,4,16 --wait-ms 2
+
+The headline `value` is the best throughput across the sweep (req/s);
+`vs_baseline` is the batching gain — best throughput over the C=1
+(unbatched closed-loop) throughput — when the sweep includes C=1.
+"""
+import argparse
+import json
+import os
+import sys
+import threading
+import time
+
+os.environ.setdefault("MXNET_MATMUL_PRECISION", "default")
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+import numpy as np  # noqa: E402
+
+
+def _build_predictor(feat, hidden, classes, seed=7):
+    import mxnet_tpu as mx
+    from mxnet_tpu.initializer import Xavier
+    from mxnet_tpu.predictor import Predictor
+
+    net = mx.sym.Variable("data")
+    net = mx.sym.FullyConnected(net, name="fc1", num_hidden=hidden)
+    net = mx.sym.Activation(net, act_type="relu")
+    net = mx.sym.FullyConnected(net, name="fc2", num_hidden=classes)
+    net = mx.sym.SoftmaxOutput(net, name="softmax")
+    arg_shapes, _, _ = net.infer_shape(data=(1, feat))
+    init = Xavier()
+    args = {}
+    for name, shp in zip(net.list_arguments(), arg_shapes):
+        if name in ("data", "softmax_label"):
+            continue
+        arr = mx.nd.zeros(shp)
+        init(name, arr)
+        args[name] = arr
+    return Predictor(net, args)
+
+
+def _run_level(pred, feat, buckets, wait_ms, conc, requests):
+    """One closed-loop level: conc clients x requests round trips
+    against a FRESH engine (clean per-level stats). Returns the sweep
+    row."""
+    from mxnet_tpu import telemetry
+    from mxnet_tpu.serve import ServeEngine
+
+    eng = ServeEngine(pred, buckets=buckets, max_wait_ms=wait_ms,
+                      feature_shapes=[(feat,)],
+                      install_sigterm=False)
+    eng.warmup()
+    lat = [[] for _ in range(conc)]
+    errs = [0] * conc
+    x = np.random.RandomState(0).standard_normal(
+        (1, feat)).astype(np.float32)
+
+    def client(ci):
+        for _ in range(requests):
+            t0 = telemetry.now_ms()
+            try:
+                eng.infer(x, timeout=60.0)
+            except Exception:  # noqa: BLE001 — shed/timeout counts,
+                errs[ci] += 1  # the row reports them
+                continue
+            lat[ci].append(telemetry.now_ms() - t0)
+
+    threads = [threading.Thread(target=client, args=(i,))
+               for i in range(conc)]
+    t0 = time.perf_counter()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    wall = time.perf_counter() - t0
+    eng.close()
+    st = eng.stats()
+    flat = sorted(v for row in lat for v in row)
+    done = len(flat)
+    return {
+        "concurrency": conc,
+        "requests": done,
+        "errors": sum(errs),
+        "throughput_rps": round(done / wall, 2) if wall else None,
+        "latency_ms": {
+            "p50": round(telemetry.quantile(flat, 0.50), 3),
+            "p95": round(telemetry.quantile(flat, 0.95), 3),
+            "p99": round(telemetry.quantile(flat, 0.99), 3),
+            "mean": round(sum(flat) / done, 3),
+        } if done else None,
+        "forwards": st["forwards"],
+        "mean_batch_fill": round(st["mean_fill"], 3)
+        if st["mean_fill"] else None,
+    }
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--concurrency", default="1,2,4,8,16",
+                   help="comma-separated closed-loop client counts")
+    p.add_argument("--requests", type=int,
+                   default=int(os.environ.get("BENCH_SERVE_REQUESTS",
+                                              "100")),
+                   help="round trips per client per level")
+    p.add_argument("--buckets", default=None,
+                   help="engine buckets (default MXNET_SERVE_BUCKETS)")
+    p.add_argument("--wait-ms", type=float, default=None,
+                   help="coalesce window (default "
+                        "MXNET_SERVE_MAX_WAIT_MS)")
+    p.add_argument("--features", type=int, default=64)
+    p.add_argument("--hidden", type=int, default=256)
+    p.add_argument("--classes", type=int, default=16)
+    args = p.parse_args(argv)
+
+    if os.environ.get("BENCH_PLATFORM"):
+        os.environ["JAX_PLATFORMS"] = os.environ["BENCH_PLATFORM"]
+    levels = sorted({int(c) for c in
+                     args.concurrency.replace(",", " ").split()})
+    buckets = tuple(int(b) for b in
+                    args.buckets.replace(",", " ").split()) \
+        if args.buckets else None
+
+    try:
+        pred = _build_predictor(args.features, args.hidden,
+                                args.classes)
+        sweep = [_run_level(pred, args.features, buckets, args.wait_ms,
+                            c, args.requests) for c in levels]
+    except Exception as e:  # noqa: BLE001 — diagnostic line, like
+        # bench.py: the driver gets a parseable failure, not a trace
+        print(json.dumps({"metric": "serve_throughput", "value": None,
+                          "unit": "req/s", "vs_baseline": None,
+                          "error": "%s: %s" % (type(e).__name__, e)}))
+        sys.exit(1)
+
+    best = max(sweep, key=lambda r: r["throughput_rps"] or 0.0)
+    base = next((r for r in sweep if r["concurrency"] == 1), None)
+    gain = (round(best["throughput_rps"] / base["throughput_rps"], 3)
+            if base and base["throughput_rps"] else None)
+    print(json.dumps({
+        "metric": "serve_throughput",
+        "value": best["throughput_rps"],
+        "unit": "req/s",
+        "vs_baseline": gain,          # batching gain over C=1
+        "best_concurrency": best["concurrency"],
+        "best_latency_ms": best["latency_ms"],
+        "best_mean_batch_fill": best["mean_batch_fill"],
+        "sweep": sweep}))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

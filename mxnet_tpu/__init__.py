@@ -100,6 +100,7 @@ from . import predictor
 from .predictor import Predictor
 from . import generation
 from .generation import Generator
+from . import serve
 from . import rtc
 from . import visualization
 from . import visualization as viz

@@ -163,3 +163,22 @@ define("MXNET_TELEMETRY_PROM", str, "",
 define("MXNET_TELEMETRY_PERIOD", float, 10.0,
        "seconds between periodic Prometheus textfile exports "
        "(piggybacked on journal step writes)")
+define("MXNET_SERVE_BUCKETS", str, "1,2,4,8",
+       "serving batch buckets (comma-separated, ascending): the "
+       "ServeEngine batcher pads each coalesced request group to the "
+       "smallest bucket that fits, so XLA compiles one forward per "
+       "bucket instead of one per arrival pattern")
+define("MXNET_SERVE_MAX_WAIT_MS", float, 5.0,
+       "serving coalesce window: how long the batcher holds the "
+       "oldest queued request waiting for more to arrive before it "
+       "dispatches a partially-filled bucket (0 = dispatch "
+       "immediately, no batching across concurrent arrivals)")
+define("MXNET_SERVE_QUEUE_CAP", int, 128,
+       "serving admission bound: requests queued beyond this are shed "
+       "with the typed Overloaded error (fast-fail backpressure — "
+       "never a silent drop, never an unbounded queue)")
+define("MXNET_SERVE_DEADLINE_MS", float, 0.0,
+       "default per-request serving deadline: a request still queued "
+       "past it fails with the typed RequestTimeout instead of "
+       "occupying a batch slot (0 = no deadline; submit(deadline_ms=) "
+       "overrides per request)")

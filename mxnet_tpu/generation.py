@@ -79,15 +79,19 @@ class Generator:
         self._rolling = bool(rolling_cache)
         head_dim = dim // num_heads
         kv_heads = int(num_kv_heads or num_heads)
-        sym = transformer.get_decode_symbol(
-            vocab_size, max_len, num_layers=num_layers,
-            num_heads=num_heads, dim=dim, ffn_hidden=ffn_hidden,
-            num_experts=num_experts, quantized=quantize is not None,
+        # kept for twin-symbol builders (serve/decode.py rebuilds this
+        # graph with per_row_pos=True against the SAME parameters)
+        self._decode_opts = dict(
+            vocab_size=vocab_size, max_len=max_len,
+            num_layers=num_layers, num_heads=num_heads, dim=dim,
+            ffn_hidden=ffn_hidden, num_experts=num_experts,
+            quantized=quantize is not None,
             compute_dtype=str(dtype) if dtype else None,
             pos_encoding=pos_encoding,
             attention_window=attention_window,
             rolling_cache=rolling_cache, num_kv_heads=num_kv_heads,
             kv_quantize=quantize_kv)
+        sym = transformer.get_decode_symbol(**self._decode_opts)
         if quantize:
             arg_params = _quantize_weights(
                 arg_params, sym.list_arguments())
@@ -837,6 +841,15 @@ class Generator:
         fn = jax.jit(run_scan if eos_id is None else run_eos)
         self._loop_cache[key_] = fn
         return fn
+
+    def serving_decoder(self, **kwargs):
+        """A continuous-batching decoder over this model's weights: a
+        fixed slot pool (one slot per batch row) over the on-device KV
+        cache, admitting queued prompts the step after a sequence
+        finishes (mxnet_tpu/serve/decode.py has the semantics).
+        kwargs forward to :class:`~mxnet_tpu.serve.ContinuousDecoder`."""
+        from .serve.decode import ContinuousDecoder
+        return ContinuousDecoder(self, **kwargs)
 
     def generate(self, prompt, max_new_tokens, temperature=0.0,
                  top_k=None, top_p=None, eos_id=None, seed=0):

@@ -322,22 +322,35 @@ class EscalationPolicy:
 # ---------------------------------------------------------------------------
 
 class GracefulShutdown:
-    """Chaining SIGTERM/SIGINT handler requesting a boundary checkpoint.
+    """Chaining SIGTERM/SIGINT handler requesting a graceful stop.
 
     The handler only sets a flag — the fit loop does the actual
-    checkpoint write at the next step boundary (a signal handler must
-    not run XLA). The previously-installed handler is CHAINED, not
-    clobbered (except SIG_DFL — immediate death would defeat the
-    boundary checkpoint — and the default SIGINT KeyboardInterrupt
-    raiser, which would tear the loop mid-step). Installation from a
-    non-main thread degrades to a no-op instead of raising."""
+    checkpoint write at the next step boundary, and a serving engine
+    drains its queue (a signal handler must not run XLA). The
+    previously-installed handler is CHAINED, not clobbered (except
+    SIG_DFL — immediate death would defeat the graceful path — and the
+    default SIGINT KeyboardInterrupt raiser, which would tear the loop
+    mid-step). Installation from a non-main thread degrades to a no-op
+    instead of raising.
 
-    def __init__(self, signals=None, logger=None):
+    on_request: optional callable invoked FROM THE HANDLER when a
+    signal arrives (before chaining). It must be signal-safe: set
+    flags/events only — no locks that user threads hold, no telemetry,
+    no XLA. The serving engine uses it to flip its drain flag
+    (mxnet_tpu/serve/engine.py); action describes the graceful path in
+    the handler's log line."""
+
+    def __init__(self, signals=None, logger=None, on_request=None,
+                 action=None):
         self._signals = tuple(signals if signals is not None
                               else (signal.SIGTERM, signal.SIGINT))
         self._prev = {}
         self._installed = False
         self._log = logger or logging.getLogger(__name__)
+        self._on_request = on_request
+        self._action = action or (
+            "will checkpoint at the next step boundary and exit %d"
+            % EXIT_PREEMPTED)
         self.requested = False
 
     def _handler(self, signum, frame):
@@ -346,9 +359,15 @@ class GracefulShutdown:
         # locks are not reentrant — the boundary-checkpoint path records
         # the guardrail.preempt_checkpoint event safely instead
         self.requested = True
-        self._log.warning(
-            "guardrail: received signal %d — will checkpoint at the "
-            "next step boundary and exit %d", signum, EXIT_PREEMPTED)
+        if self._on_request is not None:
+            try:
+                self._on_request()
+            except Exception:
+                # a signal handler must never propagate — the chained
+                # handler below still runs, and `requested` is set
+                pass
+        self._log.warning("guardrail: received signal %d — %s",
+                          signum, self._action)
         prev = self._prev.get(signum)
         if callable(prev) and prev is not signal.default_int_handler:
             prev(signum, frame)

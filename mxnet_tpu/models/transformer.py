@@ -234,7 +234,7 @@ def get_decode_symbol(vocab_size, max_len, num_layers=2, num_heads=4,
                       quantized=False, compute_dtype=None,
                       pos_encoding="learned", attention_window=0,
                       rolling_cache=False, num_kv_heads=None,
-                      kv_quantize=False):
+                      kv_quantize=False, per_row_pos=False):
     """Autoregressive-decode twin of get_symbol.
 
     Inputs: data (B, Tnew) token ids for the tokens being appended
@@ -245,6 +245,13 @@ def get_decode_symbol(vocab_size, max_len, num_layers=2, num_heads=4,
     auxiliary states shaped (B, Hkv, max_len, head_dim) where Hkv =
     num_kv_heads or num_heads (grouped-query attention stores only the
     kv heads — the cache memory/bandwidth win).
+
+    per_row_pos=True builds the CONTINUOUS-BATCHING variant: positions
+    becomes (B, Tnew) and cache_pos (B,) — every batch row decodes at
+    its own depth, which is what lets a serving slot pool
+    (mxnet_tpu/serve/decode.py) retire a finished sequence and admit a
+    queued prompt without draining the whole batch. Parameter names
+    are unchanged, so the same checkpoint binds both variants.
 
     New TPU-native capability (the 2017 reference's decode story was
     rnn.RNNCell step-wise unrolling); mxnet_tpu.generation.Generator
@@ -261,9 +268,18 @@ def get_decode_symbol(vocab_size, max_len, num_layers=2, num_heads=4,
         raise ValueError("kv_quantize is not supported with "
                          "rolling_cache (no int8 variant of the "
                          "circular-buffer op)")
+    if per_row_pos and rolling_cache:
+        raise ValueError("per_row_pos is not supported with "
+                         "rolling_cache (the circular-buffer op has "
+                         "no per-row-position variant)")
+    if per_row_pos and kv_quantize:
+        raise ValueError("per_row_pos is not supported with "
+                         "kv_quantize (the int8-cache op has no "
+                         "per-row-position variant)")
     data = sym.Variable("data")
     positions = sym.Variable("positions")
-    cache_pos = sym.Variable("cache_pos", shape=(1,))
+    cache_pos = sym.Variable("cache_pos") if per_row_pos \
+        else sym.Variable("cache_pos", shape=(1,))
 
     if quantized:
         # per-row int8 token table (the largest parameter at serving)
@@ -280,8 +296,14 @@ def get_decode_symbol(vocab_size, max_len, num_layers=2, num_heads=4,
     elif pos_encoding == "learned":
         pos_table = sym.Variable("pos_embed_weight",
                                  shape=(max_len, dim))
-        pos_vec = sym.take(pos_table, positions)      # (Tnew, dim)
-        x = sym.broadcast_add(x, sym.expand_dims(pos_vec, axis=0))
+        if per_row_pos:
+            # (B, Tnew) ids -> (B, Tnew, dim): each row looks up its
+            # own depth's rows of the table
+            x = sym.broadcast_add(x, sym.take(pos_table, positions))
+        else:
+            pos_vec = sym.take(pos_table, positions)  # (Tnew, dim)
+            x = sym.broadcast_add(x,
+                                  sym.expand_dims(pos_vec, axis=0))
     else:
         raise ValueError("pos_encoding must be 'learned' or 'rope', "
                          "got %r" % (pos_encoding,))

@@ -652,6 +652,13 @@ def cached_attention(query, key, value, k_cache, v_cache, pos,
     `jax.disable_jit()` — this framework's NaiveEngine-style debug mode
     — pos is concrete and the op raises on violation.
 
+    PER-ROW POSITIONS (continuous batching): pos may instead be (B,) —
+    one cache position per batch row. Each row's new k/v land at its
+    own offset and its causal window masks against its own position,
+    which is what lets a serving slot pool hold sequences at different
+    decode depths in ONE compiled step (mxnet_tpu/serve/decode.py).
+    A (1,) pos keeps the shared-position fast path bit-for-bit.
+
     Decode is bandwidth-bound (one (Tnew, Tmax) strip per head), so
     this is a plain jnp composition — XLA fuses the mask+softmax; the
     MXU-dense training path stays with the Pallas flash kernel.
@@ -666,6 +673,15 @@ def cached_attention(query, key, value, k_cache, v_cache, pos,
     G = H // Hkv
     if scale is None:
         scale = D ** -0.5
+    pos = jnp.asarray(pos)
+    if pos.ndim >= 1 and pos.size > 1:
+        if pos.size != B:
+            raise ValueError(
+                "per-row pos must have one entry per batch row: got "
+                "%r for batch %d" % (pos.shape, B))
+        return _cached_attention_per_row(
+            query, key, value, k_cache, v_cache,
+            jnp.reshape(pos, (B,)), float(scale), int(window or 0))
     p0 = jnp.reshape(pos, ()).astype(jnp.int32)
     if not isinstance(p0, jax.core.Tracer) and \
             int(p0) + Tn > k_cache.shape[2]:
@@ -700,10 +716,55 @@ def cached_attention(query, key, value, k_cache, v_cache, pos,
             k_cache, v_cache)
 
 
+def _cached_attention_per_row(query, key, value, k_cache, v_cache, pb,
+                              scale, window):
+    """cached_attention's per-row-position core: pb (B,) int — row b's
+    new tokens land at [pb[b], pb[b]+Tn) and mask against pb[b]. The
+    write is a vmapped dynamic_update_slice (one per-row offset each);
+    same capacity contract as the scalar path, enforced per row."""
+    B, H, Tn, D = query.shape
+    Hkv = k_cache.shape[1]
+    G = H // Hkv
+    C = k_cache.shape[2]
+    pb = pb.astype(jnp.int32)
+    if not isinstance(pb, jax.core.Tracer):
+        import numpy as _np
+        worst = int(_np.asarray(pb).max())
+        if worst + Tn > C:
+            raise ValueError(
+                "cached_attention overrun: row pos (%d) + Tnew (%d) "
+                "exceeds cache capacity Tmax=%d" % (worst, Tn, C))
+
+    def _upd(cache, new, p):
+        return jax.lax.dynamic_update_slice(cache, new, (0, p, 0))
+
+    k_cache = jax.vmap(_upd)(k_cache, key.astype(k_cache.dtype), pb)
+    v_cache = jax.vmap(_upd)(v_cache, value.astype(v_cache.dtype), pb)
+    qg = query.reshape(B, Hkv, G, Tn, D)
+    s = jnp.einsum("bhgqd,bhkd->bhgqk", qg, k_cache,
+                   precision=jax.lax.Precision.DEFAULT,
+                   preferred_element_type=jnp.float32) * scale
+    cols = jnp.arange(C)[None, None, :]            # (1, 1, C)
+    rows = jnp.arange(Tn)[None, :, None]           # (1, Tn, 1)
+    prow = pb[:, None, None]                       # (B, 1, 1)
+    valid = cols <= prow + rows                    # (B, Tn, C)
+    if window:
+        valid = valid & (prow + rows - cols < window)
+    s = jnp.where(valid[:, None, None], s, _NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    out = jnp.einsum("bhgqk,bhkd->bhgqd", p.astype(v_cache.dtype),
+                     v_cache,
+                     precision=jax.lax.Precision.DEFAULT)
+    return (out.reshape(B, H, Tn, D).astype(query.dtype),
+            k_cache, v_cache)
+
+
 def rope(x, positions, base=10000.0):
     """Rotary position embedding over (B, H, T, hd).
 
-    positions: (T,) absolute position ids. HALF-SPLIT pairing (GPT
+    positions: (T,) absolute position ids shared across the batch, or
+    (B, T) per-row ids (the continuous-batching decode path, where
+    each serving slot sits at its own depth). HALF-SPLIT pairing (GPT
     -NeoX convention): (x[i], x[i+hd/2]) rotate together by
     pos * base^(-2i/hd) — NOT the interleaved (x[2i], x[2i+1])
     RoFormer/LLaMA layout; checkpoints crossing implementations must
@@ -715,9 +776,13 @@ def rope(x, positions, base=10000.0):
     half = D // 2
     freqs = jnp.power(
         float(base), -jnp.arange(0, half, dtype=jnp.float32) / half)
-    ang = positions.astype(jnp.float32)[:, None] * freqs[None, :]
-    cos = jnp.cos(ang)[None, None]            # (1, 1, T, half)
-    sin = jnp.sin(ang)[None, None]
+    ang = positions.astype(jnp.float32)[..., None] * freqs
+    if ang.ndim == 2:                         # shared (T, half)
+        cos = jnp.cos(ang)[None, None]        # (1, 1, T, half)
+        sin = jnp.sin(ang)[None, None]
+    else:                                     # per-row (B, T, half)
+        cos = jnp.cos(ang)[:, None]           # (B, 1, T, half)
+        sin = jnp.sin(ang)[:, None]
     x1 = x[..., :half].astype(jnp.float32)
     x2 = x[..., half:].astype(jnp.float32)
     out = jnp.concatenate([x1 * cos - x2 * sin,

@@ -8,10 +8,31 @@ try:                                     # jax>=0.6 moved shard_map up
 except ImportError:                      # pragma: no cover
     from jax.experimental.shard_map import shard_map  # noqa: F401
 
+_HAS_VARY_MARKER = hasattr(lax, "pcast") or hasattr(lax, "pvary")
+
+if not _HAS_VARY_MARKER:                 # jax 0.4
+    # jax 0.4 has no varying-marker op at all, but its shard_map still
+    # runs the static replication checker (check_rep=True default) —
+    # which then rejects exactly the mixed-replication patterns pvary
+    # exists to bless (ppermute carries in a scan, cond branches).
+    # With no marker to teach it, the faithful shim is to turn the
+    # checker off; the collectives themselves are unaffected.
+    _shard_map_raw = shard_map
+
+    def shard_map(f, *args, **kwargs):   # noqa: F811
+        kwargs.setdefault("check_rep", False)
+        return _shard_map_raw(f, *args, **kwargs)
+
 
 def pvary(x, axes):
     """Mark a value device-varying over mesh axes (jax 0.9 deprecates
-    lax.pvary in favour of lax.pcast(x, axes, to='varying'))."""
+    lax.pvary in favour of lax.pcast(x, axes, to='varying')). jax 0.4
+    has NEITHER — its shard_map does not track varying-over-mesh-axes
+    types (the compat shard_map above disables its replication
+    checker), so the identity is the correct shim, not an
+    approximation."""
     if hasattr(lax, "pcast"):
         return lax.pcast(x, axes, to="varying")
-    return lax.pvary(x, axes)            # pragma: no cover - jax<0.9
+    if hasattr(lax, "pvary"):
+        return lax.pvary(x, axes)        # pragma: no cover - jax 0.5-0.8
+    return x

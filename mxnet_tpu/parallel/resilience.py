@@ -199,7 +199,11 @@ class FaultInjector:
     * ``point`` — where the hook fires: ``send`` / ``recv`` (worker
       client request/reply plumbing), ``ping`` (worker heartbeat
       sends), ``srv_send`` / ``srv_recv`` (server-side plumbing, for a
-      server process running with the env set).
+      server process running with the env set). The serving front end
+      (``mxnet_tpu/serve/net.py``) exposes the same grammar under its
+      own points — ``serve_send`` / ``serve_recv`` (client) and
+      ``serve_srv_send`` / ``serve_srv_recv`` (server) — so serving
+      fault tests never perturb PS injection counts.
     * ``action`` — ``drop`` (close the socket and fail before any
       bytes move), ``disconnect`` (transmit *half* the frame, then
       close — the peer sees a torn message; on recv points identical

@@ -114,6 +114,39 @@ class Predictor:
                        "dtype": dtype}, f)
         return prefix + ".stablehlo"
 
+    def export_buckets(self, prefix, feature_shapes, buckets=None,
+                       dtype="float32"):
+        """Serve-ready AOT export: one StableHLO artifact per batch
+        bucket (``prefix.b<K>.stablehlo``) plus a ``prefix.serve.json``
+        manifest, so :meth:`~mxnet_tpu.serve.ServeEngine.from_export`
+        can serve the model headlessly with every bucket specialization
+        compiled ahead of time.
+
+        feature_shapes: one per-input shape WITHOUT the batch axis, in
+        ``data_names`` order. buckets: ascending batch sizes (default
+        ``MXNET_SERVE_BUCKETS``). Returns the manifest path."""
+        from . import config as _config
+        if buckets is None:
+            from .serve.engine import _parse_buckets
+            buckets = _parse_buckets(_config.get("MXNET_SERVE_BUCKETS"))
+        buckets = sorted(int(b) for b in buckets)
+        feats = [tuple(int(d) for d in s) for s in feature_shapes]
+        if len(feats) != len(self._data_names):
+            raise ValueError(
+                "feature_shapes must have one entry per data input %r"
+                % (self._data_names,))
+        for b in buckets:
+            self.export("%s.b%d" % (prefix, b),
+                        {n: (b,) + s for n, s in
+                         zip(self._data_names, feats)}, dtype=dtype)
+        manifest = prefix + ".serve.json"
+        with open(manifest, "w") as f:
+            json.dump({"buckets": buckets,
+                       "data_names": self._data_names,
+                       "feature_shapes": [list(s) for s in feats],
+                       "dtype": dtype}, f)
+        return manifest
+
 
 class CompiledPredictor:
     """Runs an exported StableHLO artifact — the headless deployment

@@ -1,0 +1,29 @@
+"""Inference serving engine — dynamic batching, continuous decode,
+backpressure (docs/serving.md).
+
+Three layers, each usable alone:
+
+* :class:`ServeEngine` (``engine.py``) — in-process dynamic batching
+  over any forward-capable deploy artifact (``Predictor``, the
+  bucketed AOT export, or a custom wrapper): bounded queue, bucketed
+  coalescing, typed backpressure, graceful drain, full telemetry.
+* :class:`ContinuousDecoder` (``decode.py``) — continuous-batching
+  token generation for the transformer ``Generator``: a fixed slot
+  pool over the on-device KV cache where finished sequences free their
+  slot and queued prompts are admitted the following step.
+* :class:`ServeServer` / :class:`ServeClient` (``net.py``) — a thin
+  TCP front end on the async-PS wire plumbing, so the
+  ``MXNET_FAULT_SPEC`` fault grammar tests the serving path unchanged.
+
+Raw ``socket`` use is confined to ``net.py`` by the
+``tools/serve_smoke.sh`` lint — everything else in this package is
+transport-free by construction.
+"""
+from .decode import ContinuousDecoder, DecodeFuture
+from .engine import (EngineClosed, Overloaded, RequestTimeout,
+                     ServeEngine, ServeError, ServeFuture)
+from .net import ServeClient, ServeServer
+
+__all__ = ["ServeEngine", "ServeFuture", "ServeError", "Overloaded",
+           "RequestTimeout", "EngineClosed", "ContinuousDecoder",
+           "DecodeFuture", "ServeClient", "ServeServer"]

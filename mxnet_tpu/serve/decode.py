@@ -1,0 +1,364 @@
+"""Continuous-batching decode for the transformer ``Generator``
+(docs/serving.md §continuous decode).
+
+Static-batch generation dies with its slowest sequence: a (B,) batch
+holds every slot until the LAST row finishes, so mean device
+utilization decays toward 1/B as lengths diverge. Continuous batching
+(the O(1)-per-token cached-decode serving model, arXiv:2603.09555)
+fixes the shape instead of the membership: a fixed slot pool over the
+on-device KV cache, where a finished sequence frees its slot at the
+step it finishes and the next queued prompt is admitted at the
+following step. Decode throughput then tracks offered load, not the
+longest request in flight.
+
+What makes the single compiled step possible is the per-row-position
+decode graph (``get_decode_symbol(per_row_pos=True)`` →
+``cached_attention`` with a (B,) ``pos``): every slot decodes at its
+own depth inside ONE (B, 1) XLA program, so slot membership changes
+never recompile. Prompt admission reuses the Generator's ordinary
+shared-position prefill (all admitted rows start at position 0) and
+merges the prefilled cache rows into the pool with a batch-axis
+scatter.
+
+Exactness contract: greedy decode (temperature 0) emits token-for-token
+what ``Generator.generate`` emits for the same prompt — the per-row
+graph computes the same per-row math and rows are independent (pinned
+in tests/test_serve_decode.py). Sampled requests are reproducible per
+request (each carries its own PRNG stream keyed by ``seed``, split once
+per emitted token exactly like ``generate``'s loop) and match a
+``batch_size=1`` ``Generator.generate(seed=...)``, but not a
+multi-row static batch — ``jax.random.categorical`` draws one noise
+tensor per CALL, so row b of a (B, V) batch and the same logits alone
+see different noise.
+"""
+from __future__ import annotations
+
+import logging
+import threading
+from collections import deque
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from .. import telemetry as _telemetry
+from ..executor import _graph_eval_fn
+from ..generation import _pick_token
+from ..models import transformer
+from .engine import EngineClosed, Overloaded, RequestTimeout
+
+__all__ = ["ContinuousDecoder", "DecodeFuture"]
+
+
+class DecodeFuture:
+    """One sequence's pending result: the full token row
+    (prompt + generated, eos included when hit) or a typed error."""
+
+    __slots__ = ("prompt", "max_new", "eos_id", "temperature", "top_k",
+                 "top_p", "_key", "t_enq", "emitted", "pending",
+                 "n_cached", "_ev", "_value", "_exc")
+
+    def __init__(self, prompt, max_new, eos_id, temperature, top_k,
+                 top_p, seed):
+        self.prompt = prompt               # (P,) int64
+        self.max_new = max_new
+        self.eos_id = eos_id
+        self.temperature = float(temperature or 0.0)
+        self.top_k = top_k
+        self.top_p = top_p
+        # one PRNG stream per request, split once per emitted token —
+        # the exact key discipline of Generator.generate's loop, so a
+        # sampled request reproduces independently of what else shares
+        # the pool
+        self._key = jax.random.PRNGKey(seed) \
+            if self.temperature > 0 else None
+        self.t_enq = _telemetry.now_ms()
+        self.emitted = []
+        self.pending = None                # sampled but not yet fed
+        self.n_cached = 0
+        self._ev = threading.Event()
+        self._value = None
+        self._exc = None
+
+    def _pick(self, row_logits):
+        """Next token id from this row's last-position logits."""
+        if self.temperature > 0:
+            self._key, sub = jax.random.split(self._key)
+            return int(np.asarray(_pick_token(
+                row_logits[None], self.temperature, self.top_k, sub,
+                self.top_p))[0])
+        return int(np.argmax(np.asarray(row_logits)))
+
+    def _finish_ok(self):
+        self._value = np.concatenate(
+            [self.prompt, np.asarray(self.emitted, np.int64)])
+        self._ev.set()
+
+    def _fail(self, exc):
+        self._exc = exc
+        self._ev.set()
+
+    def done(self):
+        return self._ev.is_set()
+
+    def result(self, timeout=None):
+        if not self._ev.wait(timeout):
+            raise RequestTimeout(
+                "sequence still decoding after %.3fs" % timeout)
+        if self._exc is not None:
+            raise self._exc
+        return self._value
+
+
+class ContinuousDecoder:
+    """Fixed-slot continuous batching over a Generator's KV cache.
+
+    The pool width is the Generator's ``batch_size``; its ``max_len``
+    caps prompt + max_new_tokens per request. Requests queue FIFO
+    (bounded by ``queue_cap`` → typed ``Overloaded``); ``close()``
+    drains: admitted sequences finish, new submissions raise
+    ``EngineClosed``.
+
+    Not supported: rolling caches and int8 KV caches (the per-row
+    position op has no variant for either — the Generator raises at
+    construction here, not mid-request)."""
+
+    def __init__(self, generator, queue_cap=64, logger=None):
+        if getattr(generator, "_rolling", False):
+            raise ValueError("continuous batching does not support "
+                             "rolling caches")
+        if getattr(generator, "_quantize_kv", False):
+            raise ValueError("continuous batching does not support "
+                             "int8 KV caches (quantize_kv)")
+        self._gen = generator
+        self._B = int(generator.batch_size)
+        self._log = logger or logging.getLogger(__name__)
+        self._cap = int(queue_cap)
+
+        # the per-row-position twin of the generator's decode graph —
+        # same parameter names, so the generator's own (placed, maybe
+        # quantized) param dict binds unchanged
+        opts = dict(generator._decode_opts, per_row_pos=True)
+        sym_p = transformer.get_decode_symbol(**opts)
+        if sym_p.list_arguments() != generator._sym.list_arguments():
+            # checkpoint-binding contract: both variants must bind the
+            # same parameter names (a bare assert would vanish under -O)
+            raise ValueError(
+                "per-row decode symbol drifted from the scalar twin: "
+                "%r vs %r" % (sym_p.list_arguments(),
+                              generator._sym.list_arguments()))
+        eval_fn = _graph_eval_fn(sym_p, mesh=generator.mesh)
+        self._step_fn = jax.jit(
+            lambda args, aux, rng: eval_fn(args, aux, rng, False))
+        self._rng0 = jax.random.PRNGKey(0)
+
+        self._aux = generator._fresh_aux()     # the pool caches
+        self._slots = [None] * self._B         # DecodeFuture per slot
+        self._queue = deque()
+        self._lock = threading.Lock()
+        self._cond = threading.Condition(self._lock)
+        self._draining = False
+        self._closed = False
+
+        self._admitted = 0
+        self._finished = 0
+        self._steps = 0
+        self._prefills = 0
+        self._g_active = _telemetry.gauge("serve.decode.active_slots")
+        self._h_slotfill = _telemetry.histogram(
+            "serve.decode.slot_fill", buckets=_telemetry.COUNT_BUCKETS)
+        self._h_req = _telemetry.histogram("serve.decode.request_ms")
+        self._c_admitted = _telemetry.counter("serve.decode.admitted")
+        self._c_finished = _telemetry.counter("serve.decode.finished")
+        self._c_steps = _telemetry.counter("serve.decode.steps")
+
+        self._thread = threading.Thread(
+            target=self._loop, name="mxnet-serve-decode", daemon=True)
+        self._thread.start()
+
+    # -- admission ----------------------------------------------------------
+    def submit(self, prompt, max_new_tokens, eos_id=None,
+               temperature=0.0, top_k=None, top_p=None, seed=0):
+        """Queue one sequence; returns a :class:`DecodeFuture` whose
+        result is the full (prompt + generated) id row, exactly as
+        ``Generator.generate`` would emit it for this prompt alone."""
+        self._gen._check_sampling(temperature, top_k, top_p)
+        prompt = np.asarray(prompt, np.int64).reshape(-1)
+        P, n = int(prompt.shape[0]), int(max_new_tokens)
+        if P < 1:
+            raise ValueError("empty prompt")
+        if P + n > self._gen.max_len:
+            raise ValueError(
+                "prompt (%d) + max_new_tokens (%d) exceeds the cache "
+                "capacity max_len=%d" % (P, n, self._gen.max_len))
+        if self._gen._pos_rows is not None and \
+                P + n > self._gen._pos_rows:
+            raise ValueError(
+                "prompt (%d) + max_new_tokens (%d) exceeds the "
+                "trained position table (%d rows)"
+                % (P, n, self._gen._pos_rows))
+        req = DecodeFuture(prompt, n, eos_id, temperature, top_k,
+                           top_p, seed)
+        if n == 0:                        # generate()'s n=0 contract
+            req._finish_ok()
+            return req
+        with self._cond:
+            if self._draining or self._closed:
+                raise EngineClosed(
+                    "decoder is draining — sequence rejected")
+            if len(self._queue) >= self._cap:
+                _telemetry.counter("serve.shed").inc()
+                raise Overloaded(
+                    "decode queue full (%d sequences)"
+                    % len(self._queue))
+            self._queue.append(req)
+            self._admitted += 1
+            self._c_admitted.inc()
+            self._cond.notify_all()
+        return req
+
+    def generate_many(self, prompts, max_new_tokens, eos_id=None,
+                      timeout=None, **kwargs):
+        """Submit a batch of (possibly ragged) prompts and wait for all
+        results — the closed-loop convenience wrapper; returns a list
+        of id rows (ragged lengths when eos fires early)."""
+        futs = [self.submit(p, max_new_tokens, eos_id=eos_id, **kwargs)
+                for p in prompts]
+        return [f.result(timeout) for f in futs]
+
+    # -- the decode loop ----------------------------------------------------
+    def _free_slots(self):
+        return [i for i, s in enumerate(self._slots) if s is None]
+
+    def _admit(self):
+        """Move queued prompts into free slots. One shared-position
+        prefill per distinct prompt length per round (all admitted rows
+        start at position 0, so the Generator's ordinary prefill graph
+        serves); cache rows merge into the pool by a batch-axis
+        scatter."""
+        with self._lock:
+            free = self._free_slots()
+            if not free or not self._queue:
+                return
+            batch = [self._queue.popleft()
+                     for _ in range(min(len(free), len(self._queue)))]
+        by_len = {}
+        for req in batch:
+            by_len.setdefault(len(req.prompt), []).append(req)
+        for P, reqs in sorted(by_len.items()):
+            rows = np.stack([r.prompt for r in reqs] +
+                            [reqs[0].prompt] * (self._B - len(reqs)))
+            logits, pref_aux = self._gen._forward(
+                self._gen._fresh_aux(), rows.astype(np.float32), 0)
+            self._prefills += 1
+            last = np.asarray(logits[:, -1].astype(jnp.float32))
+            idx = jnp.asarray(
+                np.array(free[:len(reqs)], np.int32))
+            self._aux = {
+                name: self._aux[name].at[idx].set(
+                    pref_aux[name][:len(reqs)])
+                for name in self._aux}
+            for i, req in enumerate(reqs):
+                slot = free.pop(0)
+                self._slots[slot] = req
+                req.n_cached = P
+                tok = req._pick(last[i])
+                req.emitted.append(tok)
+                req.pending = tok
+                self._maybe_finish(slot, tok)
+
+    def _maybe_finish(self, slot, tok):
+        """Retire the slot's sequence if this emission ended it (eos or
+        budget) — the slot frees NOW, so the next admission round can
+        reuse it at the following step."""
+        req = self._slots[slot]
+        if (req.eos_id is not None and tok == req.eos_id) or \
+                len(req.emitted) >= req.max_new:
+            req._finish_ok()
+            self._h_req.observe(_telemetry.now_ms() - req.t_enq)
+            self._finished += 1
+            self._c_finished.inc()
+            _telemetry.journal_event(
+                "serve.decode.finish",
+                tokens=len(req.emitted),
+                ms=round(_telemetry.now_ms() - req.t_enq, 3))
+            self._slots[slot] = None
+
+    def _step(self):
+        """One (B, 1) per-row-position decode step: every active slot
+        ingests its pending token at its own depth and samples the
+        next; inactive slots feed a dummy token at position 0 (their
+        cache rows are garbage until the next admission overwrites
+        them wholesale)."""
+        active = [i for i, s in enumerate(self._slots) if s is not None]
+        if not active:
+            return
+        toks = np.zeros((self._B, 1), np.float32)
+        pos = np.zeros((self._B,), np.float32)
+        for i in active:
+            toks[i, 0] = float(self._slots[i].pending)
+            pos[i] = float(self._slots[i].n_cached)
+        args = dict(self._gen._params)
+        args["data"] = jnp.asarray(toks)
+        args["positions"] = jnp.asarray(pos[:, None])
+        args["cache_pos"] = jnp.asarray(pos)
+        outs, self._aux = self._step_fn(args, self._aux, self._rng0)
+        last = np.asarray(outs[0][:, -1].astype(jnp.float32))
+        self._steps += 1
+        self._c_steps.inc()
+        self._h_slotfill.observe(len(active))
+        self._g_active.set(len(active))
+        for i in active:
+            req = self._slots[i]
+            req.n_cached += 1
+            tok = req._pick(last[i])
+            req.emitted.append(tok)
+            req.pending = tok
+            self._maybe_finish(i, tok)
+
+    def _loop(self):
+        while True:
+            with self._cond:
+                while not self._queue and not self._draining and \
+                        all(s is None for s in self._slots):
+                    self._cond.wait(0.05)
+                if self._draining and not self._queue and \
+                        all(s is None for s in self._slots):
+                    break
+            self._admit()
+            self._step()
+        self._g_active.set(0)
+        _telemetry.journal_event("serve.decode.stop")
+
+    # -- lifecycle ----------------------------------------------------------
+    @property
+    def draining(self):
+        return self._draining or self._closed
+
+    def close(self, timeout=60.0):
+        """Drain: admitted sequences decode to completion, new
+        submissions raise EngineClosed, then the loop thread exits."""
+        with self._cond:
+            already = self._closed
+            self._draining = True
+            pending = len(self._queue)
+            self._cond.notify_all()
+        if not already:
+            _telemetry.journal_event("serve.decode.drain",
+                                     pending=pending)
+        self._thread.join(timeout)
+        self._closed = True
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False
+
+    def stats(self):
+        return {"admitted": self._admitted, "finished": self._finished,
+                "steps": self._steps, "prefills": self._prefills,
+                "active": sum(s is not None for s in self._slots),
+                "queued": len(self._queue)}

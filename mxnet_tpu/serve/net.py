@@ -1,0 +1,271 @@
+"""Thin TCP front end for the serving engine.
+
+Deliberately REUSES the async-PS wire plumbing instead of inventing a
+second transport: the 4-byte length-prefixed pickle framing
+(``parallel/ps_async._send_msg`` / ``_recv_msg``), the
+``RetryPolicy`` transient/fatal classification, and the deterministic
+``FaultInjector`` — so the whole ``MXNET_FAULT_SPEC`` fault grammar
+works unchanged against the serving path, under the serve-specific
+point names:
+
+* ``serve_send`` / ``serve_recv`` — client request/reply plumbing
+* ``serve_srv_send`` / ``serve_srv_recv`` — server-side plumbing
+
+e.g. ``MXNET_FAULT_SPEC="serve_send:disconnect@3;serve_recv:drop@5"``
+tears the 3rd request frame mid-message and severs before the 5th
+reply read — and the client's retry/reconnect must still deliver
+exactly one response per request (inference is pure, so a replayed
+request is safe — no dedup table needed, unlike the PS push path).
+
+Typed engine errors (Overloaded, RequestTimeout, EngineClosed) cross
+the wire BY NAME and re-raise as themselves client-side; they are
+application replies over a working transport, so RetryPolicy correctly
+classifies them fatal (retrying an Overloaded against the same full
+queue is how retry storms are born — the client backs off or routes
+elsewhere, its call).
+
+Trusted-cluster assumption, exactly like the PS: the wire unpickles.
+The server binds 127.0.0.1 unless told otherwise; exposing it is an
+explicit operator decision, never the default.
+"""
+from __future__ import annotations
+
+import logging
+import socket
+import threading
+
+import numpy as np
+
+from .. import telemetry as _telemetry
+from ..parallel.ps_async import _recv_msg, _send_msg
+from ..parallel.resilience import RetryPolicy
+from . import engine as _engine
+
+__all__ = ["ServeServer", "ServeClient"]
+
+
+class ServeServer:
+    """Accept loop + one handler thread per connection, each feeding
+    the shared :class:`~mxnet_tpu.serve.ServeEngine`. Requests on one
+    connection serialize (reply order = request order, like the PS
+    client plumbing); concurrency comes from concurrent connections —
+    which is exactly what the engine's batcher wants to coalesce."""
+
+    def __init__(self, engine, host="127.0.0.1", port=0, logger=None):
+        self._engine = engine
+        self._log = logger or logging.getLogger(__name__)
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._sock.bind((host, port))
+        self._sock.listen(64)
+        # accept() must notice close(): on Linux closing the listener
+        # does NOT unblock a blocked accept, so the loop polls
+        self._sock.settimeout(0.2)
+        self.host, self.port = self._sock.getsockname()[:2]
+        self._stop = False
+        self._conns = set()
+        self._conn_threads = set()         # live handler threads only
+        self._conn_lock = threading.Lock()
+        self._c_conns = _telemetry.counter("serve.net.connections")
+        self._accept_thread = threading.Thread(
+            target=self._accept_loop, name="mxnet-serve-accept",
+            daemon=True)
+        self._accept_thread.start()
+
+    @property
+    def address(self):
+        return "%s:%d" % (self.host, self.port)
+
+    def _accept_loop(self):
+        while not self._stop:
+            try:
+                conn, _ = self._sock.accept()
+            except socket.timeout:
+                continue                  # poll the stop flag
+            except OSError:
+                break                     # listener closed
+            conn.settimeout(None)         # inherit-from-listener trap
+            conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            self._c_conns.inc()
+            with self._conn_lock:
+                self._conns.add(conn)
+            t = threading.Thread(target=self._serve_conn, args=(conn,),
+                                 name="mxnet-serve-conn", daemon=True)
+            with self._conn_lock:
+                self._conn_threads.add(t)
+            t.start()
+
+    def _serve_conn(self, conn):
+        try:
+            while not self._stop:
+                msg = _recv_msg(conn, "serve_srv_recv")
+                if msg is None:           # clean EOF or torn frame
+                    break
+                reply = self._handle(msg)
+                _send_msg(conn, reply, "serve_srv_send")
+        except (ConnectionError, OSError) as exc:
+            # includes injected FaultInjected severs: this connection
+            # is gone, the client's RetryPolicy reconnects and replays
+            self._log.debug("serve conn dropped: %s", exc)
+        finally:
+            try:
+                conn.close()
+            except OSError:
+                pass
+            with self._conn_lock:
+                self._conns.discard(conn)
+                self._conn_threads.discard(threading.current_thread())
+
+    def _handle(self, msg):
+        try:
+            op, payload = msg
+        except (TypeError, ValueError):
+            return ("err", "ServeError", "malformed request frame")
+        if op == "ping":
+            return ("ok", None)
+        if op != "infer":
+            return ("err", "ServeError", "unknown op %r" % (op,))
+        try:
+            fut = self._engine.submit(
+                *payload["inputs"],
+                deadline_ms=payload.get("deadline_ms"))
+            return ("ok", fut.result())
+        except _engine.ServeError as exc:
+            return ("err", type(exc).__name__, str(exc))
+        except Exception as exc:          # noqa: BLE001 — the reply IS
+            # the error report; the client re-raises it typed
+            self._log.exception("serve: request handling failed")
+            return ("err", "ServeError",
+                    "%s: %s" % (type(exc).__name__, exc))
+
+    def close(self):
+        """Stop accepting, sever open connections, leave the engine to
+        its own drain (callers own the engine lifecycle)."""
+        self._stop = True
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+        with self._conn_lock:
+            conns = list(self._conns)
+            threads = list(self._conn_threads)
+        for c in conns:
+            try:
+                c.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            try:
+                c.close()
+            except OSError:
+                pass
+        for t in [self._accept_thread] + threads:
+            t.join(5.0)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False
+
+
+class ServeClient:
+    """Blocking request client with reconnect-and-replay.
+
+    Transport faults (drops, torn frames, resets — real or injected)
+    are transient: the broken socket is dropped and the request is
+    REPLAYED on a fresh connection under the RetryPolicy's
+    deterministic backoff. Inference is pure, so replay is safe without
+    a dedup table. Typed engine errors arrive as replies and re-raise
+    as themselves (fatal: the transport demonstrably works)."""
+
+    def __init__(self, host, port, retry=None, timeout=None,
+                 logger=None):
+        self._addr = (host, int(port))
+        self._retry = retry or RetryPolicy(seed="serve:%s:%d"
+                                           % (host, int(port)))
+        self._timeout = timeout
+        self._log = logger or logging.getLogger(__name__)
+        self._sock = None
+        self._lock = threading.Lock()
+        self._c_retries = _telemetry.counter("serve.net.retries")
+
+    def _ensure(self):
+        if self._sock is None:
+            s = socket.create_connection(self._addr,
+                                         timeout=self._timeout)
+            s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            self._sock = s
+        return self._sock
+
+    def _drop(self):
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+            self._sock = None
+
+    def _on_retry(self, exc, attempt, delay):
+        self._c_retries.inc()
+        self._log.debug("serve client retry #%d in %.3fs after %s",
+                        attempt, delay, exc)
+        self._drop()
+
+    def request(self, inputs, deadline_ms=None):
+        """One inference round trip; returns the per-request output
+        list. Retries transport faults; raises the engine's typed
+        error otherwise."""
+        payload = {"inputs": [np.asarray(a) for a in inputs]}
+        if deadline_ms is not None:
+            payload["deadline_ms"] = deadline_ms
+
+        def attempt():
+            sock = self._ensure()
+            try:
+                _send_msg(sock, ("infer", payload), "serve_send")
+                reply = _recv_msg(sock, "serve_recv")
+            except Exception:
+                self._drop()
+                raise
+            if reply is None:
+                self._drop()
+                raise ConnectionError(
+                    "server closed the connection mid-reply")
+            return reply
+
+        with self._lock:
+            reply = self._retry.run(attempt, describe="serve.infer",
+                                    on_retry=self._on_retry)
+        if reply[0] == "ok":
+            return reply[1]
+        _, kind, msg = reply
+        raise _engine.typed_error(kind, msg)
+
+    def ping(self):
+        with self._lock:
+            def attempt():
+                sock = self._ensure()
+                try:
+                    _send_msg(sock, ("ping", None), "serve_send")
+                    reply = _recv_msg(sock, "serve_recv")
+                except Exception:
+                    self._drop()
+                    raise
+                if reply is None:
+                    self._drop()
+                    raise ConnectionError("no pong")
+                return reply
+            return self._retry.run(attempt, describe="serve.ping",
+                                   on_retry=self._on_retry)[0] == "ok"
+
+    def close(self):
+        with self._lock:
+            self._drop()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False

@@ -57,7 +57,7 @@ __all__ = ["Counter", "Gauge", "Histogram", "Registry", "registry",
            "Journal", "journal", "start_journal", "close_journal",
            "journal_step", "journal_event", "recent_steps",
            "render_prom", "write_prom", "SCHEMA_VERSION",
-           "LATENCY_BUCKETS_MS"]
+           "LATENCY_BUCKETS_MS", "COUNT_BUCKETS"]
 
 # bump when a journal record's required keys change; readers
 # (tools/telemetry_report.py) refuse schemas they don't know
@@ -68,6 +68,11 @@ SCHEMA_VERSION = 1
 LATENCY_BUCKETS_MS = (0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 25.0, 50.0,
                       100.0, 250.0, 500.0, 1000.0, 2500.0, 5000.0,
                       10000.0, 30000.0, 60000.0)
+
+# small-count buckets (batch fill, slot occupancy): powers of two up to
+# the largest serving bucket anyone sane would configure
+COUNT_BUCKETS = (1.0, 2.0, 4.0, 8.0, 16.0, 32.0, 64.0, 128.0, 256.0,
+                 512.0, 1024.0)
 
 
 def now_ms():

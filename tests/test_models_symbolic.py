@@ -37,8 +37,17 @@ CASES = [
 ]
 
 
-@pytest.mark.parametrize("name,build,shape",
-                         CASES, ids=[c[0] for c in CASES])
+# the two deepest variants take >60s of CPU compile+run each — the
+# "large sweeps" tier (the fast tier keeps inception-bn/v3 and
+# googlenet covering the family)
+_SLOW_CASES = {"inception_v4", "inception_resnet_v2"}
+
+
+@pytest.mark.parametrize(
+    "name,build,shape",
+    [pytest.param(*c, id=c[0],
+                  marks=(pytest.mark.slow,) if c[0] in _SLOW_CASES
+                  else ()) for c in CASES])
 def test_model_forward_backward(name, build, shape):
     net = build()
     arg_shapes, out_shapes, _ = net.infer_shape(

@@ -134,12 +134,59 @@ def summarize(records):
                 if total_s else None
         out["throughput_curve"] = _curve(steady)
 
+    serving = _serving_section(events, snap)
+    if serving:
+        out["serving"] = serving
+
     if snap is not None:
         out["counters"] = {k: v["value"] for k, v in sorted(snap.items())
                            if v.get("type") == "counter"}
         out["gauges"] = {k: v["value"] for k, v in sorted(snap.items())
                          if v.get("type") == "gauge"
                          and v.get("value") is not None}
+    return out
+
+
+def _serving_section(events, snap):
+    """Aggregate the serving engine's journal events (serve.batch /
+    serve.shed / serve.timeout / serve.decode.finish) and serve.*
+    snapshot counters into the report's serving block. Empty dict =
+    no serving activity in this journal."""
+    out = {}
+    batches = [e.get("fields", {}) for e in events
+               if e.get("event") == "serve.batch"]
+    if batches:
+        fills = sorted(float(b.get("fill", 0)) for b in batches)
+        waits = sorted(float(b.get("wait_ms", 0.0)) for b in batches)
+        fwd = sorted(float(b.get("forward_ms", 0.0)) for b in batches)
+        out["forwards"] = len(batches)
+        out["rows"] = int(sum(fills))
+        out["mean_fill"] = round(sum(fills) / len(fills), 3)
+        out["batch_wait_ms"] = {
+            "p50": round(_quantile(waits, 0.50), 3),
+            "p95": round(_quantile(waits, 0.95), 3)}
+        out["forward_ms"] = {
+            "p50": round(_quantile(fwd, 0.50), 3),
+            "p95": round(_quantile(fwd, 0.95), 3)}
+    finishes = [e.get("fields", {}) for e in events
+                if e.get("event") == "serve.decode.finish"]
+    if finishes:
+        toks = sorted(int(f.get("tokens", 0)) for f in finishes)
+        ms = sorted(float(f.get("ms", 0.0)) for f in finishes)
+        out["decode_sequences"] = len(finishes)
+        out["decode_tokens"] = int(sum(toks))
+        out["decode_ms"] = {"p50": round(_quantile(ms, 0.50), 3),
+                            "p95": round(_quantile(ms, 0.95), 3)}
+    if snap is not None:
+        counters = {k: v["value"] for k, v in snap.items()
+                    if k.startswith("serve.")
+                    and v.get("type") == "counter" and v.get("value")}
+        if counters:
+            out["counters"] = dict(sorted(counters.items()))
+    for name in ("serve.shed", "serve.timeout", "serve.drain"):
+        n = sum(1 for e in events if e.get("event") == name)
+        if n:
+            out[name.split(".", 1)[1] + "_events"] = n
     return out
 
 
@@ -184,6 +231,39 @@ def format_report(summary):
                     if pt["samples_per_sec"] is not None else "-"))
     else:
         lines.append("no step records (events-only journal)")
+
+    serving = summary.get("serving")
+    if serving:
+        lines += ["", "serving:"]
+        if "forwards" in serving:
+            lines.append(
+                "  %d engine forward(s) served %d row(s) — mean batch "
+                "fill %.2f" % (serving["forwards"], serving["rows"],
+                               serving["mean_fill"]))
+            lines.append(
+                "  batch wait p50/p95: %.2f/%.2f ms   forward p50/p95: "
+                "%.2f/%.2f ms"
+                % (serving["batch_wait_ms"]["p50"],
+                   serving["batch_wait_ms"]["p95"],
+                   serving["forward_ms"]["p50"],
+                   serving["forward_ms"]["p95"]))
+        if "decode_sequences" in serving:
+            lines.append(
+                "  continuous decode: %d sequence(s), %d token(s), "
+                "request p50/p95: %.1f/%.1f ms"
+                % (serving["decode_sequences"],
+                   serving["decode_tokens"],
+                   serving["decode_ms"]["p50"],
+                   serving["decode_ms"]["p95"]))
+        for key, label in (("shed_events", "shed"),
+                           ("timeout_events", "timed out"),
+                           ("drain_events", "drain(s)")):
+            if serving.get(key):
+                lines.append("  %d request(s) %s"
+                             % (serving[key], label))
+        if serving.get("counters"):
+            for name, val in serving["counters"].items():
+                lines.append("  %-36s %d" % (name, val))
 
     if summary["events"]:
         lines += ["", "events:",
