@@ -163,6 +163,17 @@ define("MXNET_TELEMETRY_PROM", str, "",
 define("MXNET_TELEMETRY_PERIOD", float, 10.0,
        "seconds between periodic Prometheus textfile exports "
        "(piggybacked on journal step writes)")
+define("MXNET_TRACE", str, "",
+       "directory (or explicit *.jsonl path) for the distributed-trace "
+       "span spill file: causal spans across the fit loops, the PS "
+       "wire and the serve path, sharing one trace_id across "
+       "processes; tools/trace_report.py merges spill files into "
+       "Perfetto JSON. Empty = tracing off (no-op fast path)")
+define("MXNET_PEAK_FLOPS", float, 0.0,
+       "peak accelerator FLOP/s hint for MFU reporting: with it set, "
+       "tools/telemetry_report.py prints achieved FLOP/s and MFU from "
+       "the step.model_flops gauge (docs/mfu_analysis.md methodology; "
+       "0 = unset)")
 define("MXNET_SERVE_BUCKETS", str, "1,2,4,8",
        "serving batch buckets (comma-separated, ascending): the "
        "ServeEngine batcher pads each coalesced request group to the "

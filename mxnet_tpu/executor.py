@@ -411,9 +411,24 @@ class Executor:
                                                   rng, bool(is_train))
             if log_compile:
                 self._compile_logged.add(variant)
+                # per-variant model FLOPs from XLA cost analysis: a
+                # one-off re-trace + lower at the compile event (the
+                # executable itself is already cached — no second XLA
+                # compile, no execution, no host sync). Feeds the MFU
+                # line in tools/telemetry_report.py (MXNET_PEAK_FLOPS).
+                flops = self._variant_flops(variant, arg_vals,
+                                            aux_vals, rng)
+                # only the FUSED step variant feeds the MFU gauge: it
+                # is the one whole-step program. train_fwd alone would
+                # undercount a split fwd+bwd step ~3x and infer_fwd
+                # isn't a training step at all (both still record
+                # their flops on the compile event below).
+                if flops and variant == "fwd_bwd":
+                    _telemetry.gauge("step.model_flops").set(flops)
                 _telemetry.journal_event(
                     "compile", site="Executor.forward", variant=variant,
-                    wall_ms=round(_telemetry.now_ms() - t_compile, 3))
+                    wall_ms=round(_telemetry.now_ms() - t_compile, 3),
+                    flops=flops)
         except Exception as e:  # noqa: BLE001
             if "host send/recv callbacks" in str(e) or (
                     self._has_host_callback_ops
@@ -454,6 +469,25 @@ class Executor:
             self._fwd_inputs = None
         self.outputs = [_wrap(o) for o in outs]
         return self.outputs
+
+    def _variant_flops(self, variant, arg_vals, aux_vals, rng):
+        """XLA ``cost_analysis()`` FLOPs of one jit variant (trace +
+        lower only; see TrainStep.cost_analysis for the same trick).
+        None when the backend reports nothing."""
+        try:
+            if variant == "fwd_bwd":
+                lowered = self._jit_fwd_bwd.lower(arg_vals, aux_vals,
+                                                  rng)
+            else:
+                lowered = self._jit_fwd.lower(arg_vals, aux_vals, rng,
+                                              variant == "train_fwd")
+            ca = lowered.cost_analysis()
+            if isinstance(ca, (list, tuple)):
+                ca = ca[0] if ca else {}
+            flops = float((ca or {}).get("flops", 0.0))
+            return flops or None
+        except Exception:    # noqa: BLE001 — cost analysis is advisory
+            return None
 
     def _fwd_bwd_impl(self, arg_vals, aux_vals, rng):
         """One XLA program: outputs + new aux + grads (ones cotangent —

@@ -63,6 +63,7 @@ import jax.numpy as jnp
 
 from . import config as _config
 from . import telemetry as _telemetry
+from . import trace as _trace
 
 __all__ = ["NumericalDivergence", "RollbackNeeded", "PreemptionSignal",
            "DynamicLossScaler", "EscalationPolicy", "GracefulShutdown",
@@ -272,6 +273,10 @@ class EscalationPolicy:
         _telemetry.journal_event("guardrail.masked_step",
                                  streak=self.bad_streak,
                                  total=self.masked_steps)
+        # instant trace annotation: the mark lands inside the step span
+        # whose window wait drained the flag (no-op when tracing off)
+        _trace.instant("guardrail.masked_step", streak=self.bad_streak,
+                       total=self.masked_steps)
         self.log.warning(
             "guardrail: non-finite step detected and masked on device "
             "(%d consecutive, %d total)", self.bad_streak,
@@ -302,6 +307,9 @@ class EscalationPolicy:
         _telemetry.journal_event("guardrail.rollback",
                                  rollback=self.rollbacks_done,
                                  lr_mult=self.lr_mult)
+        _trace.instant("guardrail.rollback",
+                       rollback=self.rollbacks_done,
+                       lr_mult=self.lr_mult)
 
     def no_checkpoint(self, why):
         """Rollback is needed but impossible — typed failure."""

@@ -317,6 +317,8 @@ class BaseModule:
                                     skip_batches=skip_batches)
                     skip_batches = 0
                 except _guardrail.RollbackNeeded:
+                    from .. import trace as _trace
+                    _trace.unwind()   # drop the abandoned step span
                     epoch, skip_batches = self._guard_rollback(
                         checkpoint_prefix, guard)
                     train_data.reset()
@@ -435,11 +437,15 @@ class BaseModule:
         from .. import guardrail as _guardrail
         from .. import profiler as _profiler
         from .. import telemetry as _telemetry
+        from .. import trace as _trace
 
         # telemetry: hoisted handle — zero cost when off; all timing
         # below is host wall-clock (no blocking syncs added, asserted
-        # in tests/test_telemetry.py)
+        # in tests/test_telemetry.py). The trace handle is hoisted the
+        # same way; `timed` gates the shared timestamp capture.
         jr = _telemetry.journal()
+        tr = _trace.tracer()
+        timed = jr is not None or tr is not None
         step_hist = _telemetry.histogram("module.step_ms") \
             if jr is not None else None
 
@@ -467,14 +473,22 @@ class BaseModule:
                     break
         pending = next(batches, None)
         nbatch = skip_batches
-        t_iter = _telemetry.now_ms() if jr is not None else 0.0
+        t_iter = _telemetry.now_ms() if timed else 0.0
         while pending is not None:
             batch = pending
+            # step span: annotated with the journal's step seq (nbatch
+            # == the record's `step`) so traces and the telemetry
+            # report cross-reference; open (not retroactive) so the
+            # kvstore's ps.op spans dispatched inside update() join it
+            ssp = _trace.start_span("train.step", loop="module",
+                                    step=nbatch, epoch=epoch) \
+                if tr is not None else None
             inject = None
             if guard is not None:
                 if guard.spec is not None or guard.shutdown is not None:
                     inject = guard.poll_faults()
                 if guard.preempt_requested():
+                    _trace.end_span(ssp, preempted=True)
                     raise _guardrail.PreemptionSignal(nbatch)
             if monitor is not None:
                 monitor.tic()
@@ -484,11 +498,11 @@ class BaseModule:
                 if masker is not None:
                     ok = masker(inject=inject)
                 self.update()
-            t0 = _telemetry.now_ms() if jr is not None else 0.0
+            t_data = _telemetry.now_ms() if timed else 0.0
             pending = next(batches, None)
             if pending is not None:
                 self.prepare(pending)     # H2D of t+1 overlaps step t
-            data_ms = _telemetry.now_ms() - t0 if jr is not None else 0.0
+            data_ms = _telemetry.now_ms() - t_data if timed else 0.0
             if ok is not None:
                 self.update_metric(eval_metric, batch.label, ok=ok)
             else:
@@ -499,22 +513,31 @@ class BaseModule:
                 outs = self.get_outputs()
                 if outs and hasattr(outs[0], "wait_to_read"):
                     inflight.append(outs[0])
-            t0 = _telemetry.now_ms() if jr is not None else 0.0
+            t_win = _telemetry.now_ms() if timed else 0.0
             while len(inflight) > ahead:
                 # the ONE allowed blocking sync per step: back-pressure
                 # on the step K back
                 drain_one()
-            if jr is not None:
+            if timed:
                 now_ = _telemetry.now_ms()
-                step_hist.observe(now_ - t_iter)
-                _telemetry.journal_step(
-                    loop="module", step=nbatch, epoch=epoch,
-                    wall_ms=round(now_ - t_iter, 3),
-                    data_wait_ms=round(data_ms, 3),
-                    window_wait_ms=round(now_ - t0, 3),
-                    samples=int(batch.data[0].shape[0])
-                    if batch.data else 0)
+                if jr is not None:
+                    step_hist.observe(now_ - t_iter)
+                    _telemetry.journal_step(
+                        loop="module", step=nbatch, epoch=epoch,
+                        wall_ms=round(now_ - t_iter, 3),
+                        data_wait_ms=round(data_ms, 3),
+                        window_wait_ms=round(now_ - t_win, 3),
+                        samples=int(batch.data[0].shape[0])
+                        if batch.data else 0)
+                if tr is not None:
+                    # wait children reconstructed from the timestamps
+                    # already taken — no extra clock reads
+                    _trace.add_span("step.data_wait", t_data,
+                                    t_data + data_ms, parent=ssp)
+                    _trace.add_span("step.window_wait", t_win, now_,
+                                    parent=ssp)
                 t_iter = now_
+            _trace.end_span(ssp)
             if monitor is not None:
                 monitor.toc_print()
             if batch_end_callback is not None:
@@ -532,6 +555,8 @@ class BaseModule:
         if jr is not None:
             _telemetry.journal_event("epoch.end", loop="module",
                                      epoch=epoch, steps=nbatch)
+        # HBM watermark: boundary-only sample, never per step
+        _profiler.sample_device_memory("epoch.end")
 
     # -- symbol/params accessors -------------------------------------------
     @property

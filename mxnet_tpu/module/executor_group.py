@@ -26,6 +26,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from .. import context as ctx_mod
 from .. import io
 from .. import telemetry as _telemetry
+from .. import trace as _trace
 from ..base import MXNetError
 from ..executor import Executor
 from ..ndarray import NDArray, zeros, _wrap
@@ -315,7 +316,8 @@ class DataParallelExecutorGroup:
         ``module.stage_ms`` telemetry histogram."""
         if is_train is None:
             is_train = self.for_training
-        with _telemetry.histogram("module.stage_ms").timer():
+        with _telemetry.histogram("module.stage_ms").timer(), \
+                _trace.span("module.stage"):
             self._staged = (data_batch,
                             self._build_feeds(data_batch, is_train))
 

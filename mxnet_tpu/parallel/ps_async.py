@@ -56,6 +56,12 @@ from .resilience import (DeadWorkerError, RetryPolicy, _env_float,
 # the package import). Counters/histograms replace what used to be
 # bare log lines; journal events ride MXNET_TELEMETRY when set.
 from .. import telemetry as _telemetry
+# tracing (docs/observability.md §tracing): also config-only at import.
+# Client ops carry their TraceContext in the request meta dict under
+# "tc" — a plain extra key old servers never read, so the wire format
+# stays backward compatible — and the server's handler span adopts it,
+# joining both processes under one trace_id.
+from .. import trace as _trace
 
 # imported at MODULE level on purpose: the server role starts inside
 # the mxnet_tpu package import (reference parity — import mxnet with
@@ -299,7 +305,8 @@ class AsyncPSServer:
         caller's handler thread was parked in the barrier into the
         ``ps.barrier_wait_ms`` histogram (aborted waits included — a
         DeadWorkerError release is still a wait that ended)."""
-        with _telemetry.histogram("ps.barrier_wait_ms").timer():
+        with _telemetry.histogram("ps.barrier_wait_ms").timer(), \
+                _trace.span("ps.barrier.wait"):
             return self._barrier_impl(meta)
 
     def _barrier_impl(self, meta):
@@ -467,9 +474,21 @@ class AsyncPSServer:
                     return
                 op, key, payload = msg[:3]
                 meta = msg[3] if len(msg) > 3 else None
+                # handler span: adopts the client op span's wire
+                # context ("tc" in meta) so both sides of the push
+                # share one trace_id; pings are liveness noise and
+                # never carry one. No-op when tracing is off here.
+                hsp = None
+                if op != "ping" and _trace.enabled():
+                    hsp = _trace.start_span(
+                        "ps.handle." + op,
+                        parent=_trace.TraceContext.from_wire(
+                            meta.get("tc")) if meta else None)
                 try:
                     cached = self._begin_op(op, meta)
                     if cached is not None:
+                        _trace.end_span(hsp, replay=True)
+                        hsp = None
                         _send_msg(conn, cached, fault_point="srv_send")
                         continue
                     try:
@@ -478,6 +497,8 @@ class AsyncPSServer:
                         self._finish_op(op, meta, failed=True)
                         raise
                     self._finish_op(op, meta, result)
+                    _trace.end_span(hsp)
+                    hsp = None
                     # ping replies are exempt from injection so the
                     # srv_send count tracks only data traffic (srv_recv
                     # can't be: the op is unknown until after the read
@@ -486,6 +507,8 @@ class AsyncPSServer:
                               fault_point=None if op == "ping"
                               else "srv_send")
                 except Exception as e:  # noqa: BLE001
+                    _trace.end_span(hsp, error=type(e).__name__)
+                    hsp = None
                     _send_msg(conn, ("err", "%s: %s"
                                      % (type(e).__name__, e)),
                               fault_point="srv_send")
@@ -899,6 +922,12 @@ class AsyncPSClient:
         # per-op latency (includes queueing on the op lock, retries and
         # backoff — the latency a caller actually experiences)
         t_op = _telemetry.now_ms()
+        # op span: covers lock queueing + every attempt + backoff, the
+        # same window as ps.op_ms.<op>. Its context rides the request
+        # meta so the server-side handler span joins this trace.
+        tsp = _trace.start_span(
+            "ps.op." + op, wid=self._wid,
+            **({"key": str(key)} if key is not None else {}))
 
         def on_retry(exc, n, delay):
             _telemetry.counter("ps.retries").inc()
@@ -906,6 +935,9 @@ class AsyncPSClient:
                                      attempt=n,
                                      delay_s=round(delay, 3),
                                      error=type(exc).__name__)
+            _trace.instant("ps.retry", parent=tsp, op=op, attempt=n,
+                           delay_s=round(delay, 3),
+                           error=type(exc).__name__)
             logging.warning(
                 "async PS %s(%r): transient %s: %s — retry %d/%d in "
                 "%.2fs", op, key, type(exc).__name__, exc, n,
@@ -916,6 +948,8 @@ class AsyncPSClient:
                 self._seq += 1
                 meta = {"cid": self._cid, "wid": self._wid,
                         "seq": self._seq}
+                if tsp is not None:
+                    meta["tc"] = tsp.context().to_wire()
 
             def attempt():
                 with self._lock:
@@ -943,6 +977,7 @@ class AsyncPSClient:
             finally:
                 _telemetry.histogram("ps.op_ms." + op).observe(
                     _telemetry.now_ms() - t_op)
+                _trace.end_span(tsp)
         if status != "ok":
             if "DeadWorkerError" in str(result):
                 raise DeadWorkerError(result)

@@ -151,7 +151,14 @@ class RetryPolicy:
                     raise
                 if on_retry is not None:
                     on_retry(exc, attempt, d)
+                # the backoff sleep as a trace span (no-op when tracing
+                # is off): in a trace of a retried op the wait between
+                # attempts is visible, not an unexplained gap
+                from .. import trace as _trace
+                bsp = _trace.start_span("retry.backoff", op=describe,
+                                        attempt=attempt)
                 time.sleep(d)
+                _trace.end_span(bsp)
 
 
 # ---------------------------------------------------------------------------

@@ -22,6 +22,7 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 
 from .. import guardrail as _guardrail
 from .. import telemetry as _telemetry
+from .. import trace as _trace
 from ..executor import _graph_eval_fn
 from ..ops.registry import get_op
 from . import sharding as shd
@@ -406,7 +407,11 @@ class TrainStep:
         # pays literally nothing. All instrumentation below is host-side
         # wall-clock only: it adds ZERO blocking host syncs (asserted
         # against profiler.host_sync_count in tests/test_telemetry.py).
+        # The trace handle (docs/observability.md §tracing) is hoisted
+        # the same way; `timed` gates the shared timestamp capture.
         jr = _telemetry.journal()
+        tr = _trace.tracer()
+        timed = jr is not None or tr is not None
         step_hist = _telemetry.histogram("trainstep.step_ms") \
             if jr is not None else None
         _telemetry.journal_event("fit.start", loop="trainstep",
@@ -448,7 +453,7 @@ class TrainStep:
                 nxt = next(batches, None)
                 staged = None if nxt is None else self._stage(nxt)
                 nbatch = 0
-                t_iter = _telemetry.now_ms() if jr is not None else 0.0
+                t_iter = _telemetry.now_ms() if timed else 0.0
                 try:
                     while staged is not None:
                         inject = guard.poll_faults() \
@@ -459,6 +464,15 @@ class TrainStep:
                                 checkpoint_prefix, epoch, nbatch,
                                 state, n_update, log)
                         batch, placed = staged
+                        # step span: annotated with the journal's step
+                        # seq (n_update pre-increment == the record's
+                        # `step`), so traces and the telemetry report
+                        # cross-reference. Open (not retroactive) so
+                        # any RPC spans dispatched inside join it.
+                        ssp = _trace.start_span(
+                            "train.step", loop="trainstep",
+                            step=n_update, epoch=epoch) \
+                            if tr is not None else None
                         cur_lr = (lr_scheduler(n_update) if lr_scheduler
                                   else lr) * guard.lr_mult
                         step_rng = jax.random.fold_in(rng, n_update)
@@ -518,13 +532,12 @@ class TrainStep:
                                     _telemetry.now_ms() - t_disp, 3))
                         # stage batch t+1: its H2D overlaps the step
                         # just dispatched (async)
-                        t0 = _telemetry.now_ms() if jr is not None \
-                            else 0.0
+                        t_data = _telemetry.now_ms() if timed else 0.0
                         nxt = next(batches, None)
                         staged = None if nxt is None \
                             else self._stage(nxt)
-                        data_ms = _telemetry.now_ms() - t0 \
-                            if jr is not None else 0.0
+                        data_ms = _telemetry.now_ms() - t_data \
+                            if timed else 0.0
                         if not fuse:
                             # fuse=False is the host metric path
                             # (device accumulation on this loop is
@@ -537,27 +550,40 @@ class TrainStep:
                         # finite flag
                         inflight.append(flag if flag is not None
                                         else outs[0])
-                        t0 = _telemetry.now_ms() if jr is not None \
-                            else 0.0
+                        t_win = _telemetry.now_ms() if timed else 0.0
                         while len(inflight) > ahead:
                             drain_one()
-                        if jr is not None:
+                        if timed:
                             # boundary-to-boundary iteration wall: the
                             # sum over an epoch is the epoch's wall, so
                             # the report's samples/sec matches a
                             # Speedometer-style measurement
                             now_ = _telemetry.now_ms()
-                            step_hist.observe(now_ - t_iter)
-                            _telemetry.journal_step(
-                                loop="trainstep", step=n_update - 1,
-                                epoch=epoch,
-                                wall_ms=round(now_ - t_iter, 3),
-                                data_wait_ms=round(data_ms, 3),
-                                window_wait_ms=round(now_ - t0, 3),
-                                samples=int(placed[
-                                    self.data_names[0]].shape[0])
-                                if self.data_names else 0)
+                            if jr is not None:
+                                step_hist.observe(now_ - t_iter)
+                                _telemetry.journal_step(
+                                    loop="trainstep", step=n_update - 1,
+                                    epoch=epoch,
+                                    wall_ms=round(now_ - t_iter, 3),
+                                    data_wait_ms=round(data_ms, 3),
+                                    window_wait_ms=round(now_ - t_win,
+                                                         3),
+                                    samples=int(placed[
+                                        self.data_names[0]].shape[0])
+                                    if self.data_names else 0)
+                            if tr is not None:
+                                # wait children reconstructed from the
+                                # timestamps already taken — no extra
+                                # clock reads, no extra syncs
+                                _trace.add_span("step.data_wait",
+                                                t_data,
+                                                t_data + data_ms,
+                                                parent=ssp)
+                                _trace.add_span("step.window_wait",
+                                                t_win, now_,
+                                                parent=ssp)
                             t_iter = now_
+                        _trace.end_span(ssp)
                         if batch_end_callback:
                             batch_end_callback(_SimpleBatchEnd(
                                 epoch, nbatch, metric))
@@ -568,6 +594,9 @@ class TrainStep:
                         while inflight:
                             drain_one()
                 except _guardrail.RollbackNeeded:
+                    # the control-flow jump abandoned the open step
+                    # span — drop it so later spans can't mis-parent
+                    _trace.unwind()
                     state, epoch, n_update, skip_batches = \
                         self._rollback(checkpoint_prefix, guard, log)
                     state = self._ensure_scaler_state(state, spec)
@@ -580,6 +609,8 @@ class TrainStep:
                     _telemetry.journal_event("epoch.end",
                                              loop="trainstep",
                                              epoch=epoch, steps=nbatch)
+                # HBM watermark: boundary-only sample, never per step
+                _profiler.sample_device_memory("epoch.end")
                 if checkpoint_prefix and \
                         (epoch + 1) % checkpoint_period == 0:
                     self._save_fit_checkpoint(checkpoint_prefix, epoch,

@@ -31,7 +31,8 @@ from . import telemetry as _telemetry
 __all__ = ["profiler_set_config", "profiler_set_state", "dump_profile",
            "set_config", "set_state", "dump", "State", "record_event",
            "scope", "is_running", "mode", "step_scope", "count_host_sync",
-           "host_sync_count", "reset_host_sync_count"]
+           "host_sync_count", "reset_host_sync_count",
+           "sample_device_memory"]
 
 
 class _ProfilerState:
@@ -137,6 +138,39 @@ def host_sync_count():
 
 def reset_host_sync_count():
     _HOST_SYNCS.reset()
+
+
+def sample_device_memory(site="boundary"):
+    """HBM watermark sample into the ``mem.hbm_bytes_in_use`` /
+    ``mem.hbm_peak_bytes`` gauges, from
+    ``jax.local_devices()[0].memory_stats()`` when the backend provides
+    it (TPU/GPU runtimes do; CPU usually returns nothing). Called at
+    EPOCH boundaries and serve ``warmup()`` only — never per step: the
+    stats read is a runtime API call, cheap but not free, and the
+    watermark is a boundary-scale signal anyway. A host-side API read
+    — no device sync, no transfer. Returns the raw stats dict (None
+    when the backend has none)."""
+    try:
+        import jax
+        devices = jax.local_devices()
+        if not devices:
+            return None
+        stats = getattr(devices[0], "memory_stats", None)
+        stats = stats() if callable(stats) else None
+    except Exception:    # noqa: BLE001 — absent API/backend = no sample
+        return None
+    if not stats:
+        return None
+    in_use = stats.get("bytes_in_use")
+    peak = stats.get("peak_bytes_in_use")
+    if in_use is not None:
+        _telemetry.gauge("mem.hbm_bytes_in_use").set(in_use)
+    if peak is not None:
+        _telemetry.gauge("mem.hbm_peak_bytes").set(peak)
+    if in_use is not None or peak is not None:
+        _telemetry.journal_event("mem.sample", site=site,
+                                 bytes_in_use=in_use, peak_bytes=peak)
+    return stats
 
 
 class scope:

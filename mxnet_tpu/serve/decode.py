@@ -43,6 +43,7 @@ import jax
 import jax.numpy as jnp
 
 from .. import telemetry as _telemetry
+from .. import trace as _trace
 from ..executor import _graph_eval_fn
 from ..generation import _pick_token
 from ..models import transformer
@@ -56,8 +57,8 @@ class DecodeFuture:
     (prompt + generated, eos included when hit) or a typed error."""
 
     __slots__ = ("prompt", "max_new", "eos_id", "temperature", "top_k",
-                 "top_p", "_key", "t_enq", "emitted", "pending",
-                 "n_cached", "_ev", "_value", "_exc")
+                 "top_p", "_key", "t_enq", "t_admit", "tc", "emitted",
+                 "pending", "n_cached", "_ev", "_value", "_exc")
 
     def __init__(self, prompt, max_new, eos_id, temperature, top_k,
                  top_p, seed):
@@ -74,6 +75,8 @@ class DecodeFuture:
         self._key = jax.random.PRNGKey(seed) \
             if self.temperature > 0 else None
         self.t_enq = _telemetry.now_ms()
+        self.t_admit = None                # set when a slot is claimed
+        self.tc = _trace.current_context()  # submitter's span, if any
         self.emitted = []
         self.pending = None                # sampled but not yet fed
         self.n_cached = 0
@@ -262,6 +265,7 @@ class ContinuousDecoder:
             for i, req in enumerate(reqs):
                 slot = free.pop(0)
                 self._slots[slot] = req
+                req.t_admit = _telemetry.now_ms()
                 req.n_cached = P
                 tok = req._pick(last[i])
                 req.emitted.append(tok)
@@ -276,13 +280,30 @@ class ContinuousDecoder:
         if (req.eos_id is not None and tok == req.eos_id) or \
                 len(req.emitted) >= req.max_new:
             req._finish_ok()
-            self._h_req.observe(_telemetry.now_ms() - req.t_enq)
+            now = _telemetry.now_ms()
+            self._h_req.observe(now - req.t_enq)
             self._finished += 1
             self._c_finished.inc()
             _telemetry.journal_event(
                 "serve.decode.finish",
                 tokens=len(req.emitted),
-                ms=round(_telemetry.now_ms() - req.t_enq, 3))
+                ms=round(now - req.t_enq, 3))
+            if _trace.enabled():
+                # sequence lifecycle spans, retroactive from the
+                # timestamps already taken: queue wait, then the slot
+                # occupancy from admission to the finishing emission
+                ctx = _trace.add_span(
+                    "serve.decode.seq", req.t_enq, now, parent=req.tc,
+                    tokens=len(req.emitted), prompt=len(req.prompt))
+                if req.t_admit is not None:
+                    _trace.add_span("serve.decode.queue", req.t_enq,
+                                    req.t_admit, parent=ctx)
+                    _trace.add_span("serve.decode.slot", req.t_admit,
+                                    now, parent=ctx, slot=slot,
+                                    tokens=len(req.emitted))
+                # the decode thread holds no open span — flush the
+                # retired sequence's records as one write
+                _trace.flush()
             self._slots[slot] = None
 
     def _step(self):

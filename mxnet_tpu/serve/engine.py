@@ -54,6 +54,7 @@ import numpy as np
 
 from .. import config as _config
 from .. import telemetry as _telemetry
+from .. import trace as _trace
 
 __all__ = ["ServeEngine", "ServeFuture", "ServeError", "Overloaded",
            "RequestTimeout", "EngineClosed", "typed_error"]
@@ -98,14 +99,15 @@ class ServeFuture:
     of per-request output arrays) or a typed error, set by the batcher
     thread."""
 
-    __slots__ = ("inputs", "rows", "t_enq", "deadline", "_ev", "_value",
-                 "_exc")
+    __slots__ = ("inputs", "rows", "t_enq", "deadline", "tc", "_ev",
+                 "_value", "_exc")
 
-    def __init__(self, inputs, rows, t_enq, deadline):
+    def __init__(self, inputs, rows, t_enq, deadline, tc=None):
         self.inputs = inputs
         self.rows = rows
         self.t_enq = t_enq
         self.deadline = deadline           # now_ms scale; None = none
+        self.tc = tc                       # TraceContext of the caller
         self._ev = threading.Event()
         self._value = None
         self._exc = None
@@ -225,6 +227,7 @@ class ServeEngine:
         self._forwards = 0
         self._completed = 0
         self._fill_sum = 0
+        self._warmed = []                 # buckets pre-compiled by warmup()
 
         # telemetry handles hoisted once (name-is-identity registry)
         self._g_depth = _telemetry.gauge("serve.queue_depth")
@@ -254,7 +257,7 @@ class ServeEngine:
         self._thread.start()
 
     # -- admission ----------------------------------------------------------
-    def submit(self, *inputs, deadline_ms=None):
+    def submit(self, *inputs, deadline_ms=None, tc=None):
         """Enqueue one request; returns a :class:`ServeFuture`.
 
         ``inputs``: one array per model input, each with a leading
@@ -262,7 +265,12 @@ class ServeEngine:
         may carry several rows, up to the largest bucket. Raises
         :class:`Overloaded` when the queue is full and
         :class:`EngineClosed` while draining — both BEFORE any work is
-        queued, so backpressure is immediate."""
+        queued, so backpressure is immediate.
+
+        ``tc``: an explicit :class:`~mxnet_tpu.trace.TraceContext` the
+        batcher's lifecycle spans should parent to (the TCP front end
+        hands in the remote caller's); defaults to the submitting
+        thread's current span."""
         arrays = [np.asarray(a) for a in inputs]
         if not arrays:
             raise ValueError("submit needs at least one input array")
@@ -292,7 +300,9 @@ class ServeEngine:
         if deadline_ms is None:
             deadline_ms = self._default_deadline
         deadline = t_enq + float(deadline_ms) if deadline_ms else None
-        req = ServeFuture(arrays, rows, t_enq, deadline)
+        if tc is None:
+            tc = _trace.current_context()
+        req = ServeFuture(arrays, rows, t_enq, deadline, tc=tc)
         with self._cond:
             if self._draining or self._closed:
                 raise EngineClosed(
@@ -388,6 +398,8 @@ class ServeEngine:
         self._c_timeouts.inc()
         _telemetry.journal_event("serve.timeout",
                                  wait_ms=round(now - r.t_enq, 3))
+        _trace.add_span("serve.queue", r.t_enq, now, parent=r.tc,
+                        timeout=True)
         r._fail(RequestTimeout(
             "deadline exceeded after %.1f ms in queue"
             % (now - r.t_enq)))
@@ -415,6 +427,7 @@ class ServeEngine:
                 feed = [np.concatenate(
                     [a, np.zeros((bucket - rows,) + a.shape[1:],
                                  a.dtype)], axis=0) for a in feed]
+            t_fwd = _telemetry.now_ms()   # pad/concat vs forward split
             outs = [self._to_np(o)
                     for o in self._forward(bucket, feed)]
         except Exception as exc:           # noqa: BLE001 — every
@@ -432,12 +445,34 @@ class ServeEngine:
         self._fill_sum += rows
         self._h_fill.observe(rows)
         end = _telemetry.now_ms()
+        t_done = t0 + fwd_ms
         off = 0
         for r in live:
             r._finish([o[off:off + r.rows] for o in outs])
             self._h_req.observe(end - r.t_enq)
             off += r.rows
         self._completed += len(live)
+        if _trace.enabled():
+            # request lifecycle, reconstructed from the timestamps
+            # already taken and parented to each request's own caller
+            # span (across threads — the report draws the arrows):
+            # queue -> batch(pad) -> forward -> respond. respond ends
+            # AFTER the finish loop — it covers the output slicing and
+            # the future wakeups, not just bookkeeping.
+            t_resp = _telemetry.now_ms()
+            for r in live:
+                _trace.add_span("serve.queue", r.t_enq, now,
+                                parent=r.tc)
+                _trace.add_span("serve.pad", t0, t_fwd, parent=r.tc,
+                                bucket=bucket, fill=rows)
+                _trace.add_span("serve.forward", t_fwd, t_done,
+                                parent=r.tc, bucket=bucket, fill=rows,
+                                requests=len(live))
+                _trace.add_span("serve.respond", t_done, t_resp,
+                                parent=r.tc)
+            # one spill write per batch, not one per record (the
+            # batcher thread has no open span to trigger a flush)
+            _trace.flush()
         _telemetry.journal_event(
             "serve.batch", bucket=bucket, fill=rows,
             requests=len(live), forward_ms=round(fwd_ms, 3),
@@ -456,8 +491,14 @@ class ServeEngine:
             feed = [np.zeros((b,) + s, self._dtype)
                     for s in self._feature_shapes]
             self._forward(b, feed)
+            if b not in self._warmed:
+                self._warmed.append(b)
         _telemetry.journal_event("serve.warmup",
                                  buckets=list(self._buckets))
+        # HBM watermark with every bucket specialization resident —
+        # the serving steady-state footprint (boundary-only sample)
+        from .. import profiler as _profiler
+        _profiler.sample_device_memory("serve.warmup")
 
     def _request_drain(self):
         # called from the signal handler: set-a-flag only (the batcher
@@ -500,6 +541,22 @@ class ServeEngine:
                 "mean_fill": (self._fill_sum / self._forwards
                               if self._forwards else None),
                 "queued": len(self._queue)}
+
+    @property
+    def warmed_buckets(self):
+        """Buckets whose XLA specialization warmup() pre-compiled."""
+        return list(self._warmed)
+
+    def introspect(self):
+        """Live engine state for the ``stats`` introspection frame
+        (serve/net.py): queue depth, drain state, bucket config and
+        which buckets are warmed, on top of :meth:`stats`."""
+        out = self.stats()
+        out["queue_depth"] = out.pop("queued")
+        out["draining"] = self.draining
+        out["buckets"] = list(self._buckets)
+        out["warmed"] = self.warmed_buckets
+        return out
 
     # -- AOT deploy chain ---------------------------------------------------
     @classmethod

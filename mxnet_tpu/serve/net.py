@@ -37,6 +37,7 @@ import threading
 import numpy as np
 
 from .. import telemetry as _telemetry
+from .. import trace as _trace
 from ..parallel.ps_async import _recv_msg, _send_msg
 from ..parallel.resilience import RetryPolicy
 from . import engine as _engine
@@ -123,12 +124,32 @@ class ServeServer:
             return ("err", "ServeError", "malformed request frame")
         if op == "ping":
             return ("ok", None)
+        if op == "stats":
+            # introspection frame: the telemetry registry snapshot +
+            # live engine state (queue depth, warmed buckets). Read by
+            # ServeClient.stats() and `tools/telemetry_report.py
+            # --stats host:port`.
+            try:
+                return ("ok", {"telemetry": _telemetry.snapshot(),
+                               "engine": self._engine_state()})
+            except Exception as exc:      # noqa: BLE001 — reply = report
+                return ("err", "ServeError",
+                        "%s: %s" % (type(exc).__name__, exc))
         if op != "infer":
             return ("err", "ServeError", "unknown op %r" % (op,))
+        # handler span: adopts the remote caller's trace context ("tc"
+        # in the payload — an extra key old servers never read) so
+        # client and server share one trace_id; the engine's lifecycle
+        # spans parent to this handler through submit(tc=).
+        rtc = _trace.TraceContext.from_wire(payload.get("tc")) \
+            if isinstance(payload, dict) else None
+        hsp = _trace.start_span("serve.handle", parent=rtc) \
+            if _trace.enabled() else None
         try:
             fut = self._engine.submit(
                 *payload["inputs"],
-                deadline_ms=payload.get("deadline_ms"))
+                deadline_ms=payload.get("deadline_ms"),
+                tc=hsp.context() if hsp is not None else rtc)
             return ("ok", fut.result())
         except _engine.ServeError as exc:
             return ("err", type(exc).__name__, str(exc))
@@ -137,6 +158,18 @@ class ServeServer:
             self._log.exception("serve: request handling failed")
             return ("err", "ServeError",
                     "%s: %s" % (type(exc).__name__, exc))
+        finally:
+            _trace.end_span(hsp)
+
+    def _engine_state(self):
+        """The engine's live state for the ``stats`` frame — duck-typed
+        so any forward-capable wrapper with a stats() works."""
+        eng = self._engine
+        introspect = getattr(eng, "introspect", None)
+        if callable(introspect):
+            return introspect()
+        stats = getattr(eng, "stats", None)
+        return dict(stats()) if callable(stats) else {}
 
     def close(self):
         """Stop accepting, sever open connections, leave the engine to
@@ -219,6 +252,14 @@ class ServeClient:
         payload = {"inputs": [np.asarray(a) for a in inputs]}
         if deadline_ms is not None:
             payload["deadline_ms"] = deadline_ms
+        # request span + wire trace context: the server's handler span
+        # (and the engine's queue/forward lifecycle) joins this trace.
+        # Old servers never read the extra "tc" key.
+        rsp = _trace.start_span("serve.request",
+                                rows=int(payload["inputs"][0].shape[0])
+                                if payload["inputs"][0].ndim else 0)
+        if rsp is not None:
+            payload["tc"] = rsp.context().to_wire()
 
         def attempt():
             sock = self._ensure()
@@ -234,9 +275,13 @@ class ServeClient:
                     "server closed the connection mid-reply")
             return reply
 
-        with self._lock:
-            reply = self._retry.run(attempt, describe="serve.infer",
-                                    on_retry=self._on_retry)
+        try:
+            with self._lock:
+                reply = self._retry.run(attempt,
+                                        describe="serve.infer",
+                                        on_retry=self._on_retry)
+        finally:
+            _trace.end_span(rsp)
         if reply[0] == "ok":
             return reply[1]
         _, kind, msg = reply
@@ -258,6 +303,31 @@ class ServeClient:
                 return reply
             return self._retry.run(attempt, describe="serve.ping",
                                    on_retry=self._on_retry)[0] == "ok"
+
+    def stats(self):
+        """Server introspection via the ``stats`` frame:
+        ``{"telemetry": <registry snapshot>, "engine": <queue depth,
+        drain state, buckets warmed, counters>}`` — the remote twin of
+        ``telemetry.snapshot()`` + ``ServeEngine.introspect()``."""
+        with self._lock:
+            def attempt():
+                sock = self._ensure()
+                try:
+                    _send_msg(sock, ("stats", None), "serve_send")
+                    reply = _recv_msg(sock, "serve_recv")
+                except Exception:
+                    self._drop()
+                    raise
+                if reply is None:
+                    self._drop()
+                    raise ConnectionError("no stats reply")
+                return reply
+            reply = self._retry.run(attempt, describe="serve.stats",
+                                    on_retry=self._on_retry)
+        if reply[0] == "ok":
+            return reply[1]
+        _, kind, msg = reply
+        raise _engine.typed_error(kind, msg)
 
     def close(self):
         with self._lock:

@@ -43,6 +43,7 @@ from __future__ import annotations
 
 import bisect
 import json
+import logging
 import os
 import re
 import threading
@@ -324,19 +325,37 @@ class Journal:
         self.path = path
         self._lock = threading.Lock()
         self._f = open(path, "a")
+        self._broken = False
         self.write({"kind": "run_start", "pid": os.getpid(),
                     "run": run, "schema": SCHEMA_VERSION})
 
     def write(self, record):
+        if self._broken:
+            return
         rec = {"v": SCHEMA_VERSION, "t": round(time.time(), 3)}
         rec.update(record)
         line = json.dumps(rec) + "\n"
         with self._lock:
+            if self._broken:
+                return
             try:
                 self._f.write(line)
                 self._f.flush()
             except ValueError:    # closed underneath us at teardown
                 pass
+            except OSError as e:
+                # ENOSPC / a dir yanked mid-run: observability must
+                # never poison the training step — disable this
+                # journal with ONE warning and keep training
+                self._broken = True
+                try:
+                    self._f.close()
+                except (OSError, ValueError):
+                    pass
+                logging.getLogger(__name__).warning(
+                    "telemetry journal %s unwritable (%s); journal "
+                    "writes disabled for the rest of this run",
+                    self.path, e)
 
     def close(self):
         with self._lock:
@@ -349,6 +368,10 @@ class Journal:
 
 _STATE_LOCK = threading.Lock()
 _JOURNAL = None
+# the periodic Prometheus republish disables itself (one warning) when
+# the destination becomes unwritable mid-run — ENOSPC on the metrics
+# volume must not fail training steps. Reset by close_journal().
+_PROM_BROKEN = [False]
 # last journal step records, for in-process consumers (Speedometer
 # sources its throughput from here when a journal is active)
 _RECENT = deque(maxlen=4096)
@@ -414,6 +437,7 @@ def close_journal():
     jr.write({"kind": "snapshot", "metrics": snapshot()})
     jr.close()
     _RECENT.clear()
+    _PROM_BROKEN[0] = False     # a fresh run gets a fresh chance
     try:
         write_prom()
     except OSError:
@@ -522,7 +546,12 @@ def write_prom(path=None):
 
 def _maybe_export():
     """Opportunistic periodic Prometheus export, piggybacking on
-    journal step writes (no background thread to manage/leak)."""
+    journal step writes (no background thread to manage/leak). An
+    export failure after startup (ENOSPC, dir made unwritable)
+    disables further periodic exports with one warning instead of
+    re-failing on every step."""
+    if _PROM_BROKEN[0]:
+        return
     path = _config.get("MXNET_TELEMETRY_PROM")
     if not path:
         return
@@ -533,5 +562,8 @@ def _maybe_export():
     _LAST_EXPORT[0] = now
     try:
         write_prom(path)
-    except OSError:
-        pass
+    except OSError as e:
+        _PROM_BROKEN[0] = True
+        logging.getLogger(__name__).warning(
+            "telemetry: Prometheus export to %s failed (%s); periodic "
+            "export disabled for the rest of this run", path, e)

@@ -319,6 +319,39 @@ class TestNet:
                 c.request([np.zeros((1, FEAT), np.float32)])
             c.close()
 
+    def test_stats_introspection_rpc(self, pred):
+        """Satellite: the `stats` frame answers with the telemetry
+        registry snapshot + live engine state, via ServeClient.stats()
+        AND tools/telemetry_report.py's --stats fetch path."""
+        import os
+        import sys
+        sys.path.insert(0, os.path.join(os.path.dirname(__file__),
+                                        ".."))
+        from tools.telemetry_report import fetch_stats, format_stats
+
+        with ServeEngine(pred, buckets=(1, 2, 4), max_wait_ms=0.0,
+                         feature_shapes=[(FEAT,)],
+                         install_sigterm=False) as eng, \
+                ServeServer(eng) as srv:
+            eng.warmup()
+            c = ServeClient(srv.host, srv.port,
+                            retry=RetryPolicy(base_delay=0.01))
+            c.request([np.zeros((1, FEAT), np.float32)])
+            stats = c.stats()
+            c.close()
+            # the standalone tool speaks the wire without the framework
+            tool_stats = fetch_stats("%s:%d" % (srv.host, srv.port))
+        for got in (stats, tool_stats):
+            assert set(got) == {"telemetry", "engine"}
+            eng_state = got["engine"]
+            assert eng_state["buckets"] == [1, 2, 4]
+            assert eng_state["warmed"] == [1, 2, 4]
+            assert eng_state["queue_depth"] == 0
+            assert eng_state["admitted"] >= 1
+            assert "serve.admitted" in got["telemetry"]
+        text = format_stats(tool_stats)
+        assert "warmed" in text and "serve.admitted" in text
+
     @pytest.mark.faults
     def test_exactly_one_response_under_faults(self, pred,
                                                no_injector):

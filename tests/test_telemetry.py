@@ -424,6 +424,108 @@ def test_fault_injected_run_journal_counters(journal_dir, no_injector):
 # satellites
 # ---------------------------------------------------------------------------
 
+def test_journal_write_failure_disables_with_one_warning(journal_dir,
+                                                         caplog):
+    """ENOSPC / a dir yanked mid-run: the journal disables itself with
+    ONE warning instead of raising into the training step."""
+    telemetry.journal_step(loop="test", step=0, wall_ms=1.0, samples=1)
+    jr = telemetry.journal()
+    assert jr is not None and not jr._broken
+
+    class Boom:
+        def write(self, *_a):
+            raise OSError(28, "No space left on device")
+
+        def flush(self):
+            pass
+
+        def close(self):
+            pass
+
+    jr._f = Boom()
+    with caplog.at_level(logging.WARNING):
+        for i in range(5):     # repeated steps must not re-warn/raise
+            telemetry.journal_step(loop="test", step=i + 1,
+                                   wall_ms=1.0, samples=1)
+            telemetry.journal_event("test.event")
+    warned = [r for r in caplog.records
+              if "journal writes disabled" in r.message]
+    assert len(warned) == 1
+    assert jr._broken
+
+
+def test_prom_republish_failure_disables_with_one_warning(journal_dir,
+                                                          tmp_path,
+                                                          caplog):
+    """The periodic Prometheus republish tolerates its directory going
+    unwritable mid-run: one warning, then the export path goes quiet
+    (the journal and the step keep working). The dir is replaced by a
+    plain file to break it mid-run — a permission flip doesn't bind
+    when tests run as root, but the OSError path is identical."""
+    blocker = tmp_path / "ro"
+    blocker.write_text("now a file, not a dir")
+    prom = str(blocker / "sub" / "metrics.prom")
+    config.set_override("MXNET_TELEMETRY_PROM", prom)
+    telemetry._PROM_BROKEN[0] = False
+    try:
+        telemetry._LAST_EXPORT[0] = 0.0  # force the period expired
+        with caplog.at_level(logging.WARNING):
+            for i in range(5):
+                telemetry._LAST_EXPORT[0] = 0.0
+                telemetry.journal_step(loop="test", step=i,
+                                       wall_ms=1.0, samples=1)
+        warned = [r for r in caplog.records
+                  if "periodic export disabled" in r.message]
+        assert len(warned) == 1
+        assert telemetry._PROM_BROKEN[0]
+    finally:
+        telemetry._PROM_BROKEN[0] = False
+        config.clear_override("MXNET_TELEMETRY_PROM")
+
+
+def test_mfu_gauge_and_report(journal_dir, monkeypatch):
+    """Satellite: the Executor's compile-event path records the step
+    variant's cost-analysis FLOPs into the step.model_flops gauge, and
+    the report prints achieved FLOP/s + MFU under MXNET_PEAK_FLOPS."""
+    X, y = _toy()
+    train = io.NDArrayIter(X, y, batch_size=32)
+    mod = mx.mod.Module(_mlp(), context=mx.cpu())
+    mod.fit(train, num_epoch=1, optimizer="sgd",
+            optimizer_params={"learning_rate": 0.1})
+    path = telemetry.close_journal()
+    flops = telemetry.gauge("step.model_flops").value
+    assert flops and flops > 0
+    recs = load(path)
+    compiles = [r for r in recs if r.get("kind") == "event"
+                and r.get("event") == "compile"]
+    assert any(c.get("fields", {}).get("flops") for c in compiles)
+    monkeypatch.setenv("MXNET_PEAK_FLOPS", "1e12")
+    s = summarize(recs)
+    assert s["model_flops"] == flops
+    assert s["flops_per_sec"] > 0
+    assert s["peak_flops"] == 1e12
+    assert s["mfu"] == pytest.approx(s["flops_per_sec"] / 1e12,
+                                     abs=1e-4)
+    report = format_report(s)
+    assert "MFU" in report and "MXNET_PEAK_FLOPS" in report
+    # without the hint: achieved FLOP/s still prints, no MFU claim
+    monkeypatch.delenv("MXNET_PEAK_FLOPS")
+    s2 = summarize(recs)
+    assert "mfu" not in s2 and s2["flops_per_sec"] > 0
+
+
+def test_device_memory_watermark_sample(journal_dir):
+    """Satellite: boundary-only HBM watermark sampling is safe on any
+    backend (CPU usually reports nothing) and feeds the mem.* gauges
+    when stats exist. Exercised at epoch boundaries by both fit loops
+    (this covers the helper's contract)."""
+    stats = profiler.sample_device_memory("test")
+    assert stats is None or isinstance(stats, dict)
+    if stats is not None and stats.get("bytes_in_use") is not None:
+        assert telemetry.gauge("mem.hbm_bytes_in_use").value == \
+            stats["bytes_in_use"]
+
+
 def test_speedometer_falls_back_without_telemetry(caplog):
     """No journal: Speedometer times with its own clock (no batch-time
     quantiles in the line) — unchanged legacy behavior."""

@@ -15,14 +15,25 @@ training step plus one per notable event. This tool reconstructs:
 
     python tools/telemetry_report.py runs/telemetry-1234.jsonl
     python tools/telemetry_report.py --json runs/telemetry-1234.jsonl
+    python tools/telemetry_report.py --stats 127.0.0.1:9911
 
 The summary's ``samples_per_sec`` is sum(samples) / sum(wall_ms):
 step walls are measured boundary-to-boundary in the fit loops, so the
 figure reconstructs what a Speedometer callback reports (asserted
 within 5% in tests/test_telemetry.py).
+
+With ``MXNET_PEAK_FLOPS`` set (peak accelerator FLOP/s), the
+steady-state section also prints achieved FLOP/s and MFU from the
+``step.model_flops`` gauge the Executor records at each compile event
+(docs/mfu_analysis.md methodology).
+
+``--stats host:port`` instead queries a live ``ServeServer``'s
+introspection frame (telemetry registry snapshot + engine queue/bucket
+state) — same trusted-cluster pickle wire as the serving transport.
 """
 import argparse
 import json
+import os
 
 SCHEMA_VERSION = 1
 
@@ -134,6 +145,23 @@ def summarize(records):
                 if total_s else None
         out["throughput_curve"] = _curve(steady)
 
+        # MFU (docs/mfu_analysis.md): achieved FLOP/s = the compiled
+        # step's cost-analysis FLOPs (step.model_flops gauge) times
+        # steady-state steps/sec; MFU against the MXNET_PEAK_FLOPS
+        # hint (read here, at report time — the journal predates it)
+        g = (snap or {}).get("step.model_flops", {})
+        flops = g.get("value") if g.get("type") == "gauge" else None
+        if flops and total_s:
+            out["model_flops"] = flops
+            out["flops_per_sec"] = flops * len(steady) / total_s
+            try:
+                peak = float(os.environ.get("MXNET_PEAK_FLOPS") or 0.0)
+            except ValueError:
+                peak = 0.0
+            if peak > 0:
+                out["peak_flops"] = peak
+                out["mfu"] = round(out["flops_per_sec"] / peak, 4)
+
     serving = _serving_section(events, snap)
     if serving:
         out["serving"] = serving
@@ -221,6 +249,16 @@ def format_report(summary):
             % (100.0 * (summary.get("data_wait_ms_share") or 0.0),
                100.0 * (summary.get("window_wait_ms_share") or 0.0)),
         ]
+        if summary.get("flops_per_sec"):
+            mfu_line = ("model FLOPs/step: %.4g — achieved %.4g "
+                        "FLOP/s" % (summary["model_flops"],
+                                    summary["flops_per_sec"]))
+            if summary.get("mfu") is not None:
+                mfu_line += ("   MFU: %.1f%% of %.4g peak "
+                             "(MXNET_PEAK_FLOPS)"
+                             % (100.0 * summary["mfu"],
+                                summary["peak_flops"]))
+            lines.append(mfu_line)
         curve = summary.get("throughput_curve") or []
         if len(curve) > 1:
             lines += ["", "throughput curve (samples/sec by step span):"]
@@ -282,14 +320,81 @@ def format_report(summary):
     return "\n".join(lines)
 
 
+def fetch_stats(addr, timeout=10.0):
+    """Query a live ServeServer's ``stats`` introspection frame.
+    Speaks the serving wire directly (4-byte length prefix + pickle) so
+    this tool still needs no framework import. Trusted cluster only —
+    the reply unpickles, exactly like the serving transport itself."""
+    import pickle
+    import socket
+    import struct
+
+    host, _, port = str(addr).rpartition(":")
+    if not host:
+        raise ValueError("--stats wants HOST:PORT, got %r" % (addr,))
+    with socket.create_connection((host, int(port)),
+                                  timeout=timeout) as sock:
+        payload = pickle.dumps(("stats", None), protocol=4)
+        sock.sendall(struct.pack(">I", len(payload)) + payload)
+        hdr = b""
+        while len(hdr) < 4:
+            chunk = sock.recv(4 - len(hdr))
+            if not chunk:
+                raise ConnectionError("server closed during stats reply")
+            hdr += chunk
+        (n,) = struct.unpack(">I", hdr)
+        buf = bytearray()
+        while len(buf) < n:
+            chunk = sock.recv(min(1 << 20, n - len(buf)))
+            if not chunk:
+                raise ConnectionError("server closed mid stats reply")
+            buf += chunk
+    reply = pickle.loads(bytes(buf))
+    if not reply or reply[0] != "ok":
+        raise RuntimeError("stats query failed: %r" % (reply,))
+    return reply[1]
+
+
+def format_stats(stats):
+    """A live-server stats reply as a text report."""
+    lines = ["serve server stats", "=" * 46, "", "engine:"]
+    for key, val in sorted((stats.get("engine") or {}).items()):
+        lines.append("  %-24s %s" % (key, val))
+    snap = stats.get("telemetry") or {}
+    counters = {k: v["value"] for k, v in sorted(snap.items())
+                if v.get("type") == "counter" and v.get("value")}
+    if counters:
+        lines += ["", "counters:"]
+        for name, val in counters.items():
+            lines.append("  %-36s %d" % (name, val))
+    gauges = {k: v["value"] for k, v in sorted(snap.items())
+              if v.get("type") == "gauge" and v.get("value") is not None}
+    if gauges:
+        lines += ["", "gauges:"]
+        for name, val in gauges.items():
+            lines.append("  %-36s %g" % (name, val))
+    return "\n".join(lines)
+
+
 def main(argv=None):
     p = argparse.ArgumentParser(description=__doc__)
-    p.add_argument("journal", help="path to a telemetry *.jsonl journal")
+    p.add_argument("journal", nargs="?",
+                   help="path to a telemetry *.jsonl journal")
     p.add_argument("--json", action="store_true",
                    help="emit the summary dict as JSON instead of text")
+    p.add_argument("--stats", metavar="HOST:PORT",
+                   help="query a live ServeServer's stats frame "
+                        "instead of reading a journal")
     args = p.parse_args(argv)
-    summary = summarize(load(args.journal))
     try:
+        if args.stats:
+            stats = fetch_stats(args.stats)
+            print(json.dumps(stats, indent=2, default=str)
+                  if args.json else format_stats(stats))
+            return
+        if not args.journal:
+            p.error("give a journal path (or --stats HOST:PORT)")
+        summary = summarize(load(args.journal))
         if args.json:
             print(json.dumps(summary, indent=2))
         else:

@@ -1,0 +1,209 @@
+"""Merge distributed-trace spill files into Chrome trace-event /
+Perfetto JSON, plus a text critical-path summary per trace.
+
+The spill files (schema v1, ``mxnet_tpu/trace.py``, written when
+``MXNET_TRACE`` names a directory — one ``trace-<pid>.jsonl`` per
+process) hold one JSON line per finished span or instant event. This
+tool:
+
+* merges any number of spill files (client + server processes of one
+  job) into ONE Chrome trace-event JSON: a lane per (process, thread),
+  complete ``X`` events for spans, ``i`` events for instants, and flow
+  arrows (``s``/``f``) wherever a span's parent lives on a different
+  thread or process — the wire/thread hops a single ``trace_id``
+  causally stitches together;
+* prints a critical-path summary per trace: from each root span, the
+  longest-duration child chain, with durations, share of the root, and
+  the process/thread transitions along the way.
+
+    python tools/trace_report.py runs/trace-*.jsonl -o merged.json
+    python tools/trace_report.py --text-only runs/trace-1234.jsonl
+
+Open ``merged.json`` in https://ui.perfetto.dev or chrome://tracing.
+Standalone on purpose: no framework import, so it runs anywhere the
+spill files land. Torn-line tolerance matches the telemetry journal:
+a crash tears at most a file's FINAL line and that is tolerated;
+corruption anywhere earlier raises.
+"""
+import argparse
+import json
+
+SCHEMA_VERSION = 1
+
+
+def load(path):
+    """Parse one spill file into a record list (torn final line
+    tolerated, unknown schema refused — mirrors
+    tools/telemetry_report.py:load)."""
+    with open(path) as f:
+        lines = [ln.strip() for ln in f]
+    while lines and not lines[-1]:
+        lines.pop()
+    records = []
+    for i, line in enumerate(lines):
+        if not line:
+            continue
+        try:
+            rec = json.loads(line)
+        except ValueError:
+            if i == len(lines) - 1:
+                break            # torn final line: the crash signature
+            raise ValueError("%s:%d: corrupt trace record"
+                             % (path, i + 1))
+        v = rec.get("v")
+        if v != SCHEMA_VERSION:
+            raise ValueError(
+                "%s:%d: trace schema v%r, this reader understands v%d"
+                % (path, i + 1, v, SCHEMA_VERSION))
+        records.append(rec)
+    return records
+
+
+def merge(paths):
+    """All records of all spill files, in file order."""
+    records = []
+    for p in paths:
+        records.extend(load(p))
+    return records
+
+
+def _span_index(spans):
+    return {(r["trace"], r["span"]): r for r in spans}
+
+
+def to_chrome(records):
+    """The merged records as a Chrome trace-event JSON object
+    (``{"traceEvents": [...], "displayTimeUnit": "ms"}``)."""
+    spans = [r for r in records if r.get("kind") == "span"]
+    instants = [r for r in records if r.get("kind") == "instant"]
+    index = _span_index(spans)
+    events = []
+
+    # process/thread lane labels
+    lanes = {}
+    for r in spans + instants:
+        lanes.setdefault((r["pid"], r["tid"]),
+                         r.get("tname", "thread %d" % r["tid"]))
+    for pid in sorted({pid for pid, _ in lanes}):
+        events.append({"ph": "M", "name": "process_name", "pid": pid,
+                       "tid": 0, "args": {"name": "pid %d" % pid}})
+    for (pid, tid), tname in sorted(lanes.items()):
+        events.append({"ph": "M", "name": "thread_name", "pid": pid,
+                       "tid": tid, "args": {"name": tname}})
+
+    for r in spans:
+        args = {"trace": r["trace"], "span": r["span"]}
+        args.update(r.get("attrs") or {})
+        events.append({"name": r["name"], "cat": "span", "ph": "X",
+                       "ts": r["ts_us"], "dur": max(r.get("dur_us", 1), 1),
+                       "pid": r["pid"], "tid": r["tid"], "args": args})
+    for r in instants:
+        args = dict(r.get("attrs") or {})
+        if r.get("trace"):
+            args["trace"] = r["trace"]
+        events.append({"name": r["name"], "cat": "instant", "ph": "i",
+                       "s": "t", "ts": r["ts_us"], "pid": r["pid"],
+                       "tid": r["tid"], "args": args})
+
+    # flow arrows: a span whose parent lives on another thread/process
+    # is a causal hop (the PS/serve wire, or a cross-thread handoff in
+    # the serve engine) — bind parent -> child with an s/f pair
+    for r in spans:
+        parent = index.get((r["trace"], r.get("parent")))
+        if parent is None:
+            continue
+        if (parent["pid"], parent["tid"]) == (r["pid"], r["tid"]):
+            continue
+        fid = "%s:%s" % (r["trace"], r["span"])
+        # the s event must sit inside the source slice and the f event
+        # inside the destination slice for viewers to draw the arrow
+        src_ts = min(max(parent["ts_us"], r["ts_us"]),
+                     parent["ts_us"] + max(parent.get("dur_us", 1), 1))
+        events.append({"name": "wire", "cat": "wire", "ph": "s",
+                       "id": fid, "ts": src_ts, "pid": parent["pid"],
+                       "tid": parent["tid"]})
+        events.append({"name": "wire", "cat": "wire", "ph": "f",
+                       "bp": "e", "id": fid, "ts": r["ts_us"],
+                       "pid": r["pid"], "tid": r["tid"]})
+
+    events.sort(key=lambda e: e.get("ts", 0))
+    return {"traceEvents": events, "displayTimeUnit": "ms"}
+
+
+def critical_path(records, max_traces=None):
+    """Text critical-path summary: per trace, walk from the root span
+    down the longest-duration child at every level."""
+    spans = [r for r in records if r.get("kind") == "span"]
+    index = _span_index(spans)
+    by_trace = {}
+    for r in spans:
+        by_trace.setdefault(r["trace"], []).append(r)
+
+    lines = ["critical path per trace (%d trace(s), %d span(s))"
+             % (len(by_trace), len(spans)), "=" * 52]
+    traces = sorted(by_trace.items(),
+                    key=lambda kv: min(s["ts_us"] for s in kv[1]))
+    if max_traces is not None and len(traces) > max_traces:
+        lines.append("(showing the first %d of %d traces)"
+                     % (max_traces, len(traces)))
+        traces = traces[:max_traces]
+    for trace_id, trace_spans in traces:
+        children = {}
+        for s in trace_spans:
+            children.setdefault(s.get("parent"), []).append(s)
+        roots = [s for s in trace_spans
+                 if (trace_id, s.get("parent")) not in index]
+        for root in sorted(roots, key=lambda s: s["ts_us"]):
+            root_ms = root.get("dur_us", 1) / 1000.0
+            lines.append("")
+            lines.append("trace %s  root %s  %.3f ms"
+                         % (trace_id, root["name"], root_ms))
+            cur, depth = root, 0
+            while True:
+                kids = children.get(cur["span"])
+                if not kids:
+                    break
+                nxt = max(kids, key=lambda s: s.get("dur_us", 0))
+                depth += 1
+                hop = ""
+                if (nxt["pid"], nxt["tid"]) != (cur["pid"], cur["tid"]):
+                    hop = "  [-> pid %d/%s]" % (
+                        nxt["pid"], nxt.get("tname", nxt["tid"]))
+                ms = nxt.get("dur_us", 1) / 1000.0
+                share = 100.0 * ms / root_ms if root_ms else 0.0
+                lines.append("  %s%s  %.3f ms  (%.1f%% of root)%s"
+                             % ("  " * depth, nxt["name"], ms, share,
+                                hop))
+                cur = nxt
+    return "\n".join(lines)
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("spills", nargs="+",
+                   help="trace-*.jsonl spill file(s) to merge")
+    p.add_argument("-o", "--out", default="trace.json",
+                   help="merged Chrome trace-event JSON output "
+                        "(default trace.json)")
+    p.add_argument("--text-only", action="store_true",
+                   help="print only the critical-path summary, write "
+                        "no JSON")
+    p.add_argument("--max-traces", type=int, default=50,
+                   help="cap the summary's trace count (default 50)")
+    args = p.parse_args(argv)
+    records = merge(args.spills)
+    try:
+        if not args.text_only:
+            payload = to_chrome(records)
+            with open(args.out, "w") as f:
+                json.dump(payload, f)
+            print("wrote %s (%d events) — open in ui.perfetto.dev or "
+                  "chrome://tracing" % (args.out,
+                                        len(payload["traceEvents"])))
+        print(critical_path(records, max_traces=args.max_traces))
+    except BrokenPipeError:        # `... | head` is a normal usage
+        pass
+
+
+if __name__ == "__main__":
+    main()
