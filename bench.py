@@ -84,62 +84,18 @@ _TLM = dict(vocab=32768, seq_len=2048, layers=4, heads=16, dim=2048,
 _DEFAULT_CONFIG = False
 
 
-def _is_experiment_row(rec):
-    """tools/perf_tables.is_experiment_row when importable (one
-    predicate for both consumers of bench_out records), else the same
-    rule inline (bench.py must stay standalone-runnable)."""
-    try:
-        from tools.perf_tables import is_experiment_row
-        return is_experiment_row(rec)
-    except ImportError:
-        return bool(rec.get("ab_config"))
-
-
 def _last_known(metric):
     """Most recent COMMITTED bench_out/ capture for this metric, so a
     tunnel outage at driver-run time never produces a contentless
-    artifact. Only git-tracked files count, ordered by commit date.
-    Returns (record, provenance) or (None, None)."""
-    import glob
-    import subprocess
-
-    here = os.path.dirname(os.path.abspath(__file__))
-    out_dir = os.path.join(here, "bench_out")
-    best = None           # (commit_date, record, provenance)
-    for path in sorted(glob.glob(os.path.join(out_dir, "*.json*"))):
-        rel = os.path.relpath(path, here)
-        try:
-            r = subprocess.run(
-                ["git", "log", "-1", "--format=%h %ct %cI", "--", rel],
-                cwd=here, capture_output=True, text=True, timeout=10)
-            if r.returncode != 0 or not r.stdout.strip():
-                continue   # untracked: not a committed capture
-            commit, epoch, date = r.stdout.strip().split(None, 2)
-            # order by the EPOCH (%ct): ISO strings with mixed
-            # committer timezones don't sort chronologically
-            epoch = int(epoch)
-        except Exception:  # noqa: BLE001
-            continue
-        try:
-            with open(path) as f:
-                for line in f:
-                    line = line.strip()
-                    if not line or not line.startswith("{"):
-                        continue
-                    rec = json.loads(line)
-                    if _is_experiment_row(rec):
-                        continue
-                    if rec.get("metric") == metric and \
-                            rec.get("value") is not None and \
-                            (best is None or epoch >= best[0]):
-                        best = (epoch, rec,
-                                {"file": rel, "commit": commit,
-                                 "captured": date})
-        except Exception:  # noqa: BLE001
-            continue
-    if best is None:
+    artifact (implementation shared with bench_serve.py /
+    bench_scaling.py via bench_common.py — only git-tracked files
+    count, ordered by commit date). Returns (record, provenance) or
+    (None, None)."""
+    try:
+        from bench_common import last_known
+    except ImportError:      # moved/renamed sibling: degrade, don't die
         return None, None
-    return best[1], best[2]
+    return last_known(metric)
 
 
 def _fail(metric, stage, err):
@@ -163,11 +119,9 @@ def _fail(metric, stage, err):
     rc = 1
     rec, prov = _last_known(metric)
     if rec is not None:
-        payload["last_known"] = {k: rec.get(k) for k in
-                                 ("value", "unit", "vs_baseline", "mfu",
-                                  "step_time_ms", "device_kind")
-                                 if rec.get(k) is not None}
-        payload["last_known"].update(prov or {})
+        # _last_known returning a record proves bench_common imported
+        from bench_common import carry_fields
+        payload["last_known"] = carry_fields(rec, prov)
         if stage == "backend_init" and isinstance(err, TimeoutError) \
                 and _DEFAULT_CONFIG:
             if os.environ.get("BENCH_ALLOW_LAST_KNOWN") == "1":

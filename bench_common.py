@@ -1,0 +1,114 @@
+"""Shared last-known-capture fallback for the bench family (bench.py,
+bench_serve.py, bench_scaling.py — ROADMAP item 5).
+
+BENCH_r01–r05 lost 4 of 5 rounds to the TPU tunnel being down; the
+fallback pattern (bench.py pioneered it) makes a tunnel outage produce
+a diagnostic JSON line with the most recent COMMITTED ``bench_out/``
+capture attached as a ``last_known`` SUB-OBJECT — never silently
+promoted into the top-level ``value`` (the driver opts in with
+BENCH_ALLOW_LAST_KNOWN=1 where that behavior exists). Only git-tracked
+captures count, ordered by commit date, so an uncommitted scratch run
+can never stand in for a published number.
+"""
+import glob
+import json
+import os
+import subprocess
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+
+
+def is_experiment_row(rec):
+    """tools/perf_tables.is_experiment_row when importable (one
+    predicate for every consumer of bench_out records), else the same
+    rule inline (the benches must stay standalone-runnable)."""
+    try:
+        from tools.perf_tables import is_experiment_row as _impl
+        return _impl(rec)
+    except ImportError:
+        return bool(rec.get("ab_config"))
+
+
+def last_known(metric, here=_HERE):
+    """Most recent COMMITTED bench_out/ capture for this metric.
+    Returns (record, provenance) or (None, None)."""
+    out_dir = os.path.join(here, "bench_out")
+    best = None           # (commit_epoch, record, provenance)
+    for path in sorted(glob.glob(os.path.join(out_dir, "*.json*"))):
+        rel = os.path.relpath(path, here)
+        try:
+            r = subprocess.run(
+                ["git", "log", "-1", "--format=%h %ct %cI", "--", rel],
+                cwd=here, capture_output=True, text=True, timeout=10)
+            if r.returncode != 0 or not r.stdout.strip():
+                continue   # untracked: not a committed capture
+            commit, epoch, date = r.stdout.strip().split(None, 2)
+            # order by the EPOCH (%ct): ISO strings with mixed
+            # committer timezones don't sort chronologically
+            epoch = int(epoch)
+        except Exception:  # noqa: BLE001
+            continue
+        try:
+            with open(path) as f:
+                for line in f:
+                    line = line.strip()
+                    if not line or not line.startswith("{"):
+                        continue
+                    rec = json.loads(line)
+                    if is_experiment_row(rec):
+                        continue
+                    if rec.get("metric") == metric and \
+                            rec.get("value") is not None and \
+                            (best is None or epoch >= best[0]):
+                        best = (epoch, rec,
+                                {"file": rel, "commit": commit,
+                                 "captured": date})
+        except Exception:  # noqa: BLE001
+            continue
+    if best is None:
+        return None, None
+    return best[1], best[2]
+
+
+# fields worth carrying from a stale capture into the diagnostic line
+_CARRY = ("value", "unit", "vs_baseline", "mfu", "step_time_ms",
+          "device_kind", "best_concurrency", "devices", "samples_s")
+
+
+def carry_fields(rec, prov):
+    """The ``last_known`` sub-object for an already-fetched capture —
+    THE single definition of which fields a stale capture carries into
+    a diagnostic line (bench.py needs the raw record too for its rc=3
+    promotion logic, so it calls this rather than attach_last_known)."""
+    out = {k: rec.get(k) for k in _CARRY if rec.get(k) is not None}
+    out.update(prov or {})
+    return out
+
+
+def attach_last_known(payload, metric, here=_HERE):
+    """Fold the newest committed capture for ``metric`` into
+    ``payload["last_known"]`` (sub-object only; the top-level value is
+    untouched). Returns True when a capture was found."""
+    rec, prov = last_known(metric, here=here)
+    if rec is None:
+        return False
+    payload["last_known"] = carry_fields(rec, prov)
+    return True
+
+
+def fail_payload(metric, unit, err, **extra):
+    """The shared diagnostic-line shape for a failed bench run:
+    null value, the error, live:false, and the newest committed
+    capture attached (never promoted). One place to evolve the
+    contract the driver parses."""
+    import traceback
+    payload = {"metric": metric, "value": None, "unit": unit,
+               "vs_baseline": None, "live": False,
+               "error": "".join(traceback.format_exception_only(
+                   type(err), err)).strip()[:500]}
+    payload.update(extra)
+    try:
+        attach_last_known(payload, metric)
+    except Exception:  # noqa: BLE001 — fallback never masks the error
+        pass
+    return payload

@@ -17,7 +17,14 @@ correctness-grade only. On a real slice run it unchanged:
     python bench_scaling.py --devices 1,4,8 --network transformer_lm
     python bench_scaling.py --zero1              # + sharded optimizer
 
-Prints one JSON line per device count, then a markdown table.
+Prints one JSON line per device count, a GSPMD one-jit row (the
+`data × fsdp` SpecLayout + ZeRO-sharded optimizer path of
+docs/parallelism.md "One-jit GSPMD path"; --skip-gspmd drops it), a
+summary line {"metric": "scaling_sweep", ...} the driver can archive,
+then a markdown table. On backend failure the summary line carries the
+newest COMMITTED bench_out/ capture as a `last_known` sub-object
+(bench.py's tunnel-outage pattern via bench_common.py) instead of a
+stack trace.
 """
 import argparse
 import json
@@ -39,8 +46,10 @@ def _parse_args():
                    help="transformer_lm over an 'sp' mesh (ring "
                         "attention) instead of a data mesh")
     p.add_argument("--window", type=int, default=0,
-                   help="with --seq-parallel: banded ring attention "
-                        "(communication scales with the window)")
+                   help="banded (windowed) attention for "
+                        "transformer_lm, all rows incl. the GSPMD one; "
+                        "with --seq-parallel the ring communication "
+                        "scales with the window")
     p.add_argument("--expert-parallel", action="store_true",
                    help="transformer_lm MoE over an 'expert' mesh "
                         "(all_to_all token exchange); experts = 2x "
@@ -49,6 +58,12 @@ def _parse_args():
     p.add_argument("--iters", type=int, default=10)
     p.add_argument("--zero1", action="store_true",
                    help="shard optimizer state (ZeRO-1)")
+    p.add_argument("--skip-gspmd", action="store_true",
+                   help="drop the one-jit GSPMD (data x fsdp "
+                        "SpecLayout + sharded-optimizer) row")
+    p.add_argument("--fsdp", type=int, default=0,
+                   help="fsdp axis size for the GSPMD row (0 = auto: "
+                        "largest of 4/2/1 dividing the device count)")
     p.add_argument("--full-size", action="store_true",
                    help="the REAL bench.py configs (resnet-50 224px "
                         "batch 128/dev; transformer dim 2048): exact "
@@ -117,14 +132,20 @@ def collective_bytes(hlo_text):
 
 
 def build_step(network, mesh, global_batch, zero1, seq_parallel=False,
-               seq_len=64, num_experts=0, full_size=False, window=0):
+               seq_len=64, num_experts=0, full_size=False, window=0,
+               layout=None):
     from mxnet_tpu import models
     from mxnet_tpu.initializer import Xavier
     from mxnet_tpu.parallel import make_train_step
 
     kw = dict(optimizer="sgd", optimizer_params={"momentum": 0.9},
               mesh=mesh)
-    if zero1:
+    if layout is not None:
+        # the GSPMD one-jit row: SpecLayout placement + the optimizer
+        # state folded across the data x fsdp replicas
+        kw = dict(optimizer="adam", optimizer_params={},
+                  layout=layout, optimizer_sharding="zero1")
+    elif zero1:
         kw.update(optimizer="adam", optimizer_params={},
                   optimizer_sharding="zero1")
     if full_size:
@@ -148,14 +169,16 @@ def build_step(network, mesh, global_batch, zero1, seq_parallel=False,
                 seq_len=seq_len, num_layers=4, num_heads=16, dim=2048,
                 seq_axis="sp" if seq_parallel else None,
                 num_experts=num_experts,
-                expert_axis="expert" if num_experts else None)
+                expert_axis="expert" if num_experts else None,
+                attention_window=window)
         else:
             sym = models.get_symbol(
                 network="transformer", vocab_size=256, seq_len=seq_len,
                 num_layers=2, num_heads=4, dim=64,
                 seq_axis="sp" if seq_parallel else None,
                 num_experts=num_experts,
-                expert_axis="expert" if num_experts else None)
+                expert_axis="expert" if num_experts else None,
+                attention_window=window)
         shapes = {"data": (global_batch, seq_len),
                   "softmax_label": (global_batch, seq_len)}
     step = make_train_step(sym, **kw)
@@ -205,6 +228,104 @@ def _telemetry_row(step, state, bd, rng, iters, gb, n):
         return {"error": str(e)[:200]}, state
 
 
+def _make_batch(network, shapes, gb):
+    import numpy as np
+    rng_np = np.random.RandomState(0)
+    if network == "resnet":
+        return {"data": rng_np.standard_normal(
+            shapes["data"]).astype(np.float32),
+            "softmax_label": rng_np.randint(
+                0, 10, gb).astype(np.float32)}
+    toks = rng_np.randint(0, 256, shapes["data"]).astype(np.float32)
+    return {"data": toks, "softmax_label": np.roll(toks, -1, axis=1)}
+
+
+def _measure(step, state, bd, rng, iters):
+    """Warmup + the headline timed loop (readback barrier, not
+    block_until_ready: through the axon tunnel the latter does not
+    guarantee device completion). Returns (sec/step, live state)."""
+    import jax
+    import numpy as np
+    state, outs = step(state, bd, 0.1, rng)   # warmup (cached)
+    np.asarray(jax.device_get(outs[0]))
+    t0 = time.time()
+    for _ in range(iters):
+        state, outs = step(state, bd, 0.1, rng)
+    np.asarray(jax.device_get(outs[0]))
+    return (time.time() - t0) / iters, state
+
+
+def _gspmd_row(args, devices, n):
+    """The one-jit GSPMD row (docs/parallelism.md "One-jit GSPMD
+    path"): data x fsdp mesh, SpecLayout auto rules, optimizer state
+    folded across ALL replicas — the trajectory row for the
+    28.8% -> 45% MFU target next tunnel window."""
+    import jax
+    import numpy as np
+    from mxnet_tpu import telemetry
+    from mxnet_tpu.parallel import make_mesh
+    from mxnet_tpu.parallel.sharding import SpecLayout
+
+    # explicit --fsdp divisibility was validated in _run, pre-sweep;
+    # the auto pick divides by construction
+    f = args.fsdp or max(d for d in (4, 2, 1) if n % d == 0)
+    mesh = make_mesh({"data": n // f, "fsdp": f},
+                     devices=devices[:n])
+    # min_shard_size=0: the smoke-size nets are tiny — on a real run
+    # the MXNET_FSDP_MIN_SIZE default keeps tiny tensors replicated
+    layout = SpecLayout(mesh, min_shard_size=0 if not args.full_size
+                        else None)
+    gb = args.per_device_batch * n
+    seq_len = 2048 if (args.full_size
+                       and args.network == "transformer_lm") else 64
+    step, state, shapes = build_step(
+        args.network, None, gb, False, seq_len=seq_len,
+        full_size=args.full_size, window=args.window, layout=layout)
+    opt_bytes = int(telemetry.gauge(
+        "gspmd.opt_state_bytes_per_dev").value or 0)
+    bd = step.place_batch(_make_batch(args.network, shapes, gb))
+    rng = jax.random.PRNGKey(0)
+
+    lowered = step.lower(state, bd, 0.1, rng)
+    compiled = lowered.compile()
+    coll = collective_bytes(compiled.as_text())
+    row = {"devices": n, "mode": "gspmd",
+           "mesh": {"data": n // f, "fsdp": f},
+           "global_batch": gb, "zero1": True,
+           "opt_state_bytes_per_dev": opt_bytes,
+           "collective_bytes_per_dev": coll,
+           "full_size": bool(args.full_size)}
+    if args.compile_only:
+        row["step_ms"] = None
+        return row
+    dt, state = _measure(step, state, bd, rng, args.iters)
+    telemetry_row, state = _telemetry_row(step, state, bd, rng,
+                                          args.iters, gb, n)
+    row.update(step_ms=round(dt * 1e3, 2),
+               samples_s=round(gb / dt, 1), telemetry=telemetry_row)
+    if args.network == "transformer_lm":
+        row["seq_len"] = seq_len
+        row["tokens_s"] = round(gb * seq_len / dt, 1)
+    return row
+
+
+def _fail_summary(err):
+    """Diagnostic summary line instead of a stack trace, with the
+    newest committed capture attached (the bench.py last_known
+    pattern, ROADMAP item 5) — a dead tunnel still yields a
+    contentful, parseable artifact."""
+    try:
+        from bench_common import fail_payload
+        payload = fail_payload("scaling_sweep", "samples/s", err)
+    except ImportError:
+        payload = {"metric": "scaling_sweep", "value": None,
+                   "unit": "samples/s", "vs_baseline": None,
+                   "live": False, "error": "%s: %s"
+                   % (type(err).__name__, err)}
+    print(json.dumps(payload))
+    raise SystemExit(1)
+
+
 def main():
     args = _parse_args()
     counts = sorted({int(c) for c in args.devices.split(",")})
@@ -220,9 +341,59 @@ def main():
     # explicitly picked a platform (BENCH_PLATFORM=tpu on a real slice)
     platform = os.environ.get("BENCH_PLATFORM", "cpu")
     os.environ["JAX_PLATFORMS"] = platform
+    try:
+        rows, gspmd_row = _run(args, counts, platform)
+    except SystemExit:
+        raise
+    except Exception as e:  # noqa: BLE001 — tunnel/backend outage path
+        _fail_summary(e)
+
+    best = max((r for r in rows + ([gspmd_row] if gspmd_row else [])
+                if r.get("samples_s")),
+               key=lambda r: r["samples_s"], default=None)
+    rate = "tokens_s" if rows and "tokens_s" in rows[0] else "samples_s"
+    try:
+        import jax
+        kind = jax.devices()[0].device_kind
+    except Exception:  # noqa: BLE001
+        kind = "unknown"
+    print(json.dumps({
+        "metric": "scaling_sweep",
+        "value": best["samples_s"] if best else None,
+        "unit": "samples/s", "vs_baseline": None, "live": True,
+        "device_kind": kind, "network": args.network,
+        "rows": rows, "gspmd": gspmd_row}))
+
+    if args.compile_only or not rows:
+        return
+    base = rows[0]["step_ms"]
+    print("\n| devices | global batch | step ms | %s | "
+          "weak-scaling eff | collective bytes/dev |"
+          % rate.replace("_s", "/s"))
+    print("|---|---|---|---|---|---|")
+    for r in rows + ([gspmd_row] if gspmd_row else []):
+        if r.get("step_ms") is None:
+            continue
+        if r.get("mode") == "gspmd" and not args.zero1:
+            # the GSPMD row always runs adam+zero1; without --zero1
+            # the baseline row ran sgd+momentum, and a step-time ratio
+            # would charge the optimizer difference to scaling loss
+            eff_cell = "n/a (adam vs sgd base)"
+        else:
+            eff_cell = "%.0f%%" % (base / r["step_ms"] * 100)
+        tot = sum(r["collective_bytes_per_dev"].values())
+        print("| %s | %d | %.2f | %.1f | %s | %s |" % (
+            "%d (gspmd)" % r["devices"] if r.get("mode") == "gspmd"
+            else "%d" % r["devices"],
+            r["global_batch"], r["step_ms"],
+            r[rate if rate in r else "samples_s"], eff_cell,
+            "{:,}".format(tot)))
+
+
+def _run(args, counts, platform):
     import jax
     jax.config.update("jax_platforms", platform)
-    import numpy as np
+    import numpy as np  # noqa: F401 (helpers import their own)
     from mxnet_tpu.parallel import make_mesh
 
     devices = jax.devices()
@@ -237,6 +408,14 @@ def main():
     if args.seq_parallel and args.expert_parallel:
         raise SystemExit("pick one of --seq-parallel/--expert-parallel "
                          "(composition lives in the test suite)")
+    # pure arg math — fail BEFORE the sweep burns a tunnel window,
+    # not in _gspmd_row after every count has been measured
+    if args.fsdp and not args.skip_gspmd and not args.seq_parallel \
+            and not args.expert_parallel \
+            and max(counts) % args.fsdp != 0:
+        raise SystemExit("--fsdp %d does not divide %d devices (the "
+                         "GSPMD row runs at the largest sweep count)"
+                         % (args.fsdp, max(counts)))
 
     rows = []
     for n in counts:
@@ -262,18 +441,7 @@ def main():
                                          args.zero1, args.seq_parallel,
                                          seq_len, num_experts,
                                          args.full_size, args.window)
-        rng_np = np.random.RandomState(0)
-        if args.network == "resnet":
-            batch = {"data": rng_np.standard_normal(
-                shapes["data"]).astype(np.float32),
-                "softmax_label": rng_np.randint(
-                    0, 10, gb).astype(np.float32)}
-        else:
-            toks = rng_np.randint(0, 256, shapes["data"]).astype(
-                np.float32)
-            batch = {"data": toks,
-                     "softmax_label": np.roll(toks, -1, axis=1)}
-        bd = step.place_batch(batch)
+        bd = step.place_batch(_make_batch(args.network, shapes, gb))
         rng = jax.random.PRNGKey(0)
 
         lowered = step.lower(state, bd, 0.1, rng)
@@ -289,15 +457,7 @@ def main():
             print(json.dumps(rows[-1]))
             continue
 
-        state, outs = step(state, bd, 0.1, rng)   # warmup (cached)
-        # readback barrier, not block_until_ready: through the axon
-        # tunnel the latter does not guarantee device completion
-        np.asarray(jax.device_get(outs[0]))
-        t0 = time.time()
-        for _ in range(args.iters):
-            state, outs = step(state, bd, 0.1, rng)
-        np.asarray(jax.device_get(outs[0]))
-        dt = (time.time() - t0) / args.iters
+        dt, state = _measure(step, state, bd, rng, args.iters)
         telemetry_row, state = _telemetry_row(step, state, bd, rng,
                                               args.iters, gb, n)
 
@@ -315,21 +475,22 @@ def main():
         rows.append(row)
         print(json.dumps(rows[-1]))
 
-    if args.compile_only:
-        return
-    base = rows[0]["step_ms"]
-    rate = "tokens_s" if "tokens_s" in rows[0] else "samples_s"
-    print("\n| devices | global batch | step ms | %s | "
-          "weak-scaling eff | collective bytes/dev |"
-          % rate.replace("_s", "/s"))
-    print("|---|---|---|---|---|---|")
-    for r in rows:
-        eff = base / r["step_ms"]
-        tot = sum(r["collective_bytes_per_dev"].values())
-        print("| %d | %d | %.2f | %.1f | %.0f%% | %s |" % (
-            r["devices"], r["global_batch"], r["step_ms"],
-            r[rate], eff * 100,
-            "{:,}".format(tot)))
+    gspmd_row = None
+    if not args.skip_gspmd and not args.seq_parallel and \
+            not args.expert_parallel:
+        try:
+            gspmd_row = _gspmd_row(args, devices, max(counts))
+        except SystemExit:
+            raise
+        except Exception as e:  # noqa: BLE001 — a GSPMD-row failure
+            # must not discard the sweep already measured above (the
+            # rows are the scarce tunnel-window artifact)
+            gspmd_row = {"devices": max(counts), "mode": "gspmd",
+                         "step_ms": None,
+                         "error": "%s: %s" % (type(e).__name__,
+                                              str(e)[:300])}
+        print(json.dumps(gspmd_row))
+    return rows, gspmd_row
 
 
 if __name__ == "__main__":

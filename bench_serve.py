@@ -139,10 +139,19 @@ def main(argv=None):
         sweep = [_run_level(pred, args.features, buckets, args.wait_ms,
                             c, args.requests) for c in levels]
     except Exception as e:  # noqa: BLE001 — diagnostic line, like
-        # bench.py: the driver gets a parseable failure, not a trace
-        print(json.dumps({"metric": "serve_throughput", "value": None,
-                          "unit": "req/s", "vs_baseline": None,
-                          "error": "%s: %s" % (type(e).__name__, e)}))
+        # bench.py: the driver gets a parseable failure, not a trace,
+        # with the newest committed capture attached (bench_common —
+        # the bench.py last_known pattern, ROADMAP item 5) so a tunnel
+        # outage still yields a contentful artifact
+        try:
+            from bench_common import fail_payload
+            payload = fail_payload("serve_throughput", "req/s", e)
+        except ImportError:
+            payload = {"metric": "serve_throughput", "value": None,
+                       "unit": "req/s", "vs_baseline": None,
+                       "live": False, "error": "%s: %s"
+                       % (type(e).__name__, e)}
+        print(json.dumps(payload))
         sys.exit(1)
 
     best = max(sweep, key=lambda r: r["throughput_rps"] or 0.0)

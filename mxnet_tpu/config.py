@@ -110,9 +110,11 @@ define("MXNET_BN_PALLAS", bool, False,
        "route 4-D NCHW training BatchNorm through the explicit-pass "
        "Pallas kernels (measured slower on v5e; experiment)")
 define("MXNET_EMBED_GRAD", str, "",
-       "Embedding backward: empty = autodiff scatter-add (default) | "
-       "segsum = sort + segment-sum (staged experiment for the traced "
-       "embedding-update headroom; unmeasured on chip)")
+       "Embedding backward: empty = the measured default (scatter-add; "
+       "won the staged A/B at the flagship LM shape, "
+       "bench_out/embgrad.json) | scatter | segsum = sort + "
+       "segment-sum (kept for the next TPU window's re-measure of the "
+       "traced embedding-update headroom)")
 define("MXNET_PROFILER_AUTOSTART", bool, False,
        "start profiler collection at import")
 define("MXNET_PROFILER_MODE", bool, False,
@@ -127,6 +129,16 @@ define("MXNET_COMPILE_CACHE", str, "",
        "directory for JAX's persistent compilation cache — warm "
        "restarts skip XLA recompiles (wired at package import; empty "
        "= disabled)")
+define("MXNET_FSDP_MIN_SIZE", int, 1024,
+       "SpecLayout auto-rule threshold: parameters with fewer elements "
+       "than this replicate instead of sharding over the 'fsdp' mesh "
+       "axis (a per-layer all-gather costs more than the memory a tiny "
+       "tensor saves)")
+define("MXNET_GSPMD_CONSTRAIN_ACTS", bool, True,
+       "with a SpecLayout bound, pin activation batch dims to the "
+       "data axes at module boundaries (lenient sharding constraints "
+       "at FullyConnected/Convolution/... outputs) so GSPMD "
+       "propagation can't drift activations off the batch sharding")
 define("MXNET_GUARDRAIL", bool, True,
        "device-side non-finite step detection in the fit hot loops: "
        "the compiled step carries an all-finite flag and masks bad "

@@ -40,15 +40,12 @@ __all__ = ["Executor"]
 
 
 def _parse_pspec(spec):
-    """'data,model' / '(data, None)' / 'model' -> tuple for PartitionSpec.
-    None/'None'/'' entries mean unsharded dims."""
-    if isinstance(spec, (tuple, list)):
-        parts = list(spec)
-    else:
-        parts = [p.strip() for p in
-                 str(spec).strip().strip("()").split(",")]
-    return tuple(None if p in (None, "", "None", "none") else str(p)
-                 for p in parts)
+    """'data,model' / '(data, None)' / 'model' / 'data+fsdp,None' ->
+    tuple for PartitionSpec. None/'None'/'' entries mean unsharded
+    dims; '+' joins multiple axes on one dim (and tuple entries pass
+    through) — shared grammar with parallel.sharding.parse_spec."""
+    from .parallel.sharding import parse_spec
+    return parse_spec(spec)
 
 
 def _shard_constraint(mesh, spec, val, strict=True):
@@ -66,19 +63,22 @@ def _shard_constraint(mesh, spec, val, strict=True):
     for dim, axis in enumerate(parts):
         if axis is None:
             continue
-        if axis not in mesh.axis_names:
+        axes = axis if isinstance(axis, tuple) else (axis,)
+        missing = [a for a in axes if a not in mesh.axis_names]
+        if missing:
             if not strict:
                 return val
             raise MXNetError(
                 "__shard__ axis %r not in mesh axes %r"
-                % (axis, mesh.axis_names))
-        if val.shape[dim] % mesh.shape[axis] != 0:
+                % (missing[0], mesh.axis_names))
+        n_shards = int(np.prod([mesh.shape[a] for a in axes]))
+        if val.shape[dim] % n_shards != 0:
             if not strict:
                 return val
             raise MXNetError(
                 "__shard__=%r: dim %d of shape %r not divisible by mesh "
-                "axis %r (size %d)" % (spec, dim, tuple(val.shape), axis,
-                                       mesh.shape[axis]))
+                "axes %r (total shards %d)"
+                % (spec, dim, tuple(val.shape), axes, n_shards))
     return jax.lax.with_sharding_constraint(
         val, NamedSharding(mesh, P(*parts)))
 
@@ -96,17 +96,28 @@ def _node_shard_spec(node, group2spec):
     return None
 
 
-def _graph_eval_fn(symbol, mesh=None, group2spec=None, capture=None):
+def _graph_eval_fn(symbol, mesh=None, group2spec=None, capture=None,
+                   layout=None):
     """Build the pure function evaluating `symbol`'s graph.
 
     Returns fn(arg_vals: dict name->array, aux_vals: dict, rng, is_train)
       -> (tuple outputs, dict new_aux).
 
     mesh/group2spec: lower ctx_group/__shard__ annotations to sharding
-    constraints (the PlaceDevice analogue). capture: debugging hook called
-    with (node_name, [outputs]) for every op node — only useful un-jitted
-    (Monitor path)."""
+    constraints (the PlaceDevice analogue). layout (a
+    parallel.sharding.SpecLayout): additionally pins activation batch
+    dims at module boundaries (sharding.BOUNDARY_OPS) with LENIENT
+    constraints — explicit __shard__/__shard_hint__ annotations win.
+    capture: debugging hook called with (node_name, [outputs]) for
+    every op node — only useful un-jitted (Monitor path)."""
     from .symbol.symbol import _topo_order
+
+    boundary_ops = None
+    if mesh is not None and layout is not None:
+        from .parallel import sharding as _shd
+        if getattr(layout, "act_parts", None) is not None and \
+                layout.act_parts(2) is not None:
+            boundary_ops = _shd.BOUNDARY_OPS
 
     entries = symbol._entries
     order = _topo_order(entries)
@@ -162,6 +173,16 @@ def _graph_eval_fn(symbol, mesh=None, group2spec=None, capture=None):
                         outs = [_shard_constraint(mesh, hint, o,
                                                   strict=False)
                                 for o in outs]
+                    elif boundary_ops is not None and \
+                            node.op.name in boundary_ops:
+                        # module boundary: pin the batch dim to the
+                        # layout's data axes (lenient — indivisible or
+                        # batchless tensors pass through untouched)
+                        outs = [o if layout.act_parts(np.ndim(o)) is None
+                                else _shard_constraint(
+                                    mesh, layout.act_parts(np.ndim(o)),
+                                    o, strict=False)
+                                for o in outs]
             if capture is not None:
                 capture(node.name, outs)
             env[id(node)] = outs
@@ -176,11 +197,12 @@ class Executor:
 
     def __init__(self, symbol, ctx=None, args=None, args_grad=None,
                  grad_req="write", aux_states=None, group2ctx=None,
-                 mesh=None):
+                 mesh=None, layout=None):
         self._symbol = symbol
         self._ctx = ctx if ctx is not None else current_context()
         self._group2ctx = group2ctx or {}
         self._mesh = mesh
+        self._layout = layout
         self._monitor_callback = None
         self._monitor_all = False
         # host-python ops (CustomOp -> jax pure_callback) cannot run on
@@ -229,7 +251,8 @@ class Executor:
         self._group2spec = {g: v for g, v in self._group2ctx.items()
                             if not isinstance(v, Context)}
         self._eval_fn = _graph_eval_fn(symbol, mesh=mesh,
-                                       group2spec=self._group2spec)
+                                       group2spec=self._group2spec,
+                                       layout=layout)
         self._jit_fwd = jax.jit(self._eval_fn, static_argnums=(3,))
         self._grad_names = [n for n in arg_names
                             if self._grad_req[n] != "null"]
@@ -353,7 +376,8 @@ class Executor:
                 cb(label, _wrap(jnp.asarray(o)))
 
         fn = _graph_eval_fn(self._symbol, mesh=self._mesh,
-                            group2spec=self._group2spec, capture=capture)
+                            group2spec=self._group2spec, capture=capture,
+                            layout=self._layout)
         return fn(arg_vals, aux_vals, rng, is_train)
 
     def forward(self, is_train=False, **kwargs):
@@ -591,7 +615,7 @@ class Executor:
         return Executor(self._symbol, self._ctx, args=new_args,
                         grad_req={n: r for n, r in self._grad_req.items()},
                         aux_states=new_aux, group2ctx=self._group2ctx,
-                        mesh=self._mesh)
+                        mesh=self._mesh, layout=self._layout)
 
     def debug_str(self):
         return self._symbol.debug_str()

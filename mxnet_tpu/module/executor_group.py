@@ -24,6 +24,7 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from .. import context as ctx_mod
+from ..parallel import sharding as shd
 from .. import io
 from .. import telemetry as _telemetry
 from .. import trace as _trace
@@ -46,7 +47,7 @@ class DataParallelExecutorGroup:
     def __init__(self, symbol, contexts, workload, data_shapes, label_shapes,
                  param_names, for_training, inputs_need_grad,
                  shared_group=None, logger=logging, fixed_param_names=None,
-                 grad_req="write", state_names=None):
+                 grad_req="write", state_names=None, layout=None):
         self.param_names = param_names
         self.arg_names = symbol.list_arguments()
         self.aux_names = symbol.list_auxiliary_states()
@@ -79,7 +80,13 @@ class DataParallelExecutorGroup:
         else:
             self.grad_req = {k: "null" for k in self.arg_names}
 
-        self._mesh = self._build_mesh(contexts)
+        # layout (a parallel.sharding.SpecLayout): the GSPMD placement
+        # registry — its mesh replaces the contexts-derived 1-D data
+        # mesh, params/opt-state place per its rules and batches shard
+        # over its data axes (docs/parallelism.md "One-jit GSPMD path")
+        self._layout = layout
+        self._mesh = layout.mesh if layout is not None \
+            else self._build_mesh(contexts)
         self._staged = None   # (batch-object, feeds) placed ahead
         self._total_exec_bytes = 0
         self.batch_size = None
@@ -124,11 +131,15 @@ class DataParallelExecutorGroup:
             if isinstance(data_shapes[0], io.DataDesc) \
             else data_shapes[0][1][0]
         if self._mesh is not None:
-            n_dev = len(self.contexts)
+            if self._layout is not None:
+                n_dev = int(np.prod([self._mesh.shape[a] for a in
+                                     self._layout.batch_axes] or [1]))
+            else:
+                n_dev = len(self.contexts)
             if self.batch_size % n_dev != 0:
                 raise MXNetError(
                     "batch size %d must be divisible by the number of "
-                    "devices %d (mesh data-parallel)" %
+                    "batch shards %d (mesh data-parallel)" %
                     (self.batch_size, n_dev))
 
         self.data_shapes = [x if isinstance(x, io.DataDesc)
@@ -234,8 +245,9 @@ class DataParallelExecutorGroup:
                             args=[args[n] for n in self.arg_names],
                             args_grad=args_grad,
                             grad_req=self.grad_req, aux_states=aux,
-                            mesh=self._mesh)
+                            mesh=self._mesh, layout=self._layout)
         self.execs = [executor]
+        self._replace_params()
 
         # views, kept in reference shapes: list (over params) of list
         # (over devices — length 1: grads are already reduced on-mesh)
@@ -271,6 +283,29 @@ class DataParallelExecutorGroup:
         executor_group.py:set_params)."""
         self.execs[0].copy_params_from(arg_params, aux_params,
                                        allow_extra_params=allow_extra)
+        # a host push lands as plain device arrays — restore the
+        # layout's placements so training keeps the registry shardings
+        self._replace_params()
+
+    def _replace_params(self):
+        """(Re)place the executor's param/grad/aux arrays per the bound
+        layout — the module path's NamedSharding seam. No-op without a
+        layout (single-device and legacy mesh binds are untouched)."""
+        if self._layout is None or not self.execs:
+            return
+        exe = self.execs[0]
+        for name in self.param_names:
+            arr = exe.arg_dict.get(name)
+            if arr is None:
+                continue
+            ns = self._layout.param_nsharding(name, tuple(arr.shape))
+            arr._set_data(shd.place(arr._data, ns))
+            g = exe.grad_dict.get(name)
+            if g is not None:
+                g._set_data(shd.place(g._data, ns))
+        rep = self._layout.replicated_nsharding()
+        for arr in exe.aux_arrays:
+            arr._set_data(shd.place(arr._data, rep))
 
     def get_params(self, arg_params, aux_params):
         """Copy current params out into the given dicts (reference
@@ -282,14 +317,18 @@ class DataParallelExecutorGroup:
 
     # -- compute -----------------------------------------------------------
     def _shard(self, array_data, batch_axis=0):
-        """Place a batch array on the mesh, sharded along the data axis."""
+        """Place a batch array on the mesh, sharded along the data
+        axes (through the placement layer — no raw device_put here)."""
         if self._mesh is None:
             return array_data
+        if self._layout is not None:
+            return shd.place(array_data, self._layout.batch_nsharding(
+                array_data.ndim, batch_axis))
         spec = [None] * array_data.ndim
         if array_data.ndim > 0:
             spec[batch_axis] = "data"
-        return jax.device_put(array_data,
-                              NamedSharding(self._mesh, P(*spec)))
+        return shd.place(array_data,
+                         NamedSharding(self._mesh, P(*spec)))
 
     def _build_feeds(self, data_batch, is_train):
         """Shard/place a batch's arrays for the executor (async H2D

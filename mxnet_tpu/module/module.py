@@ -29,8 +29,15 @@ class Module(BaseModule):
     def __init__(self, symbol, data_names=("data",),
                  label_names=("softmax_label",), logger=logging,
                  context=None, work_load_list=None, fixed_param_names=None,
-                 state_names=None):
+                 state_names=None, layout=None):
+        """layout: a ``parallel.sharding.SpecLayout`` — the GSPMD
+        partition-spec registry. Binds the executor group over the
+        layout's own mesh (instead of the contexts-derived 1-D data
+        mesh), places parameters per its rules and shards batches over
+        its data axes; see docs/parallelism.md "One-jit GSPMD
+        path"."""
         super().__init__(logger=logger)
+        self._layout = layout
 
         ctxs = context if context is not None else ctx_mod.current_context()
         self._context = [ctxs] if isinstance(ctxs, Context) else list(ctxs)
@@ -207,7 +214,8 @@ class Module(BaseModule):
             self._data_shapes, self._label_shapes, self._param_names,
             for_training, inputs_need_grad, shared_group,
             logger=self.logger, fixed_param_names=self._fixed_param_names,
-            grad_req=grad_req, state_names=self._state_names)
+            grad_req=grad_req, state_names=self._state_names,
+            layout=self._layout)
         self._total_exec_bytes = self._exec_group._total_exec_bytes
 
         if shared_module is not None:

@@ -13,21 +13,32 @@ from jax import lax
 from .registry import register
 
 
+# Embedding backward default, decided by the staged A/B
+# (benchmark/bench_embgrad.py at the flagship LM shape; capture:
+# bench_out/embgrad.json). scatter-add beat sort+segment-sum 123.9 ms
+# vs 129.2 ms (one-hot matmul 300x off) on the only live backend of the
+# round (CPU — the TPU tunnel has been down since 2026-08-01); the
+# segsum formulation stays one env var away for the next TPU window,
+# where the traced ~8x-off-roofline scatter+Adam update
+# (bench_out/trace_tlm_summary.txt) is still the open question.
+_EMBED_GRAD_DEFAULT = "scatter"
+
+
 @register("Embedding", arg_names=("data", "weight"), nondiff_inputs=(0,),
           defaults={"input_dim": 0, "output_dim": 0, "dtype": "float32"})
 def _embedding(data, weight, **_):
-    import os as _os
-    if _os.environ.get("MXNET_EMBED_GRAD") == "segsum":
-        # staged experiment for the flagged embedding-update headroom
-        # (the round-5 transformer trace measured the fused
-        # scatter-grad + Adam update on the (V, D) table ~8x off its
-        # bandwidth roofline, bench_out/trace_tlm_summary.txt):
+    from .. import config as _config
+    choice = _config.get("MXNET_EMBED_GRAD") or _EMBED_GRAD_DEFAULT
+    if choice == "segsum":
         # backward as sort + segment-sum instead of autodiff's
         # scatter-add. Same values (duplicate ids accumulate in id
-        # order after a stable sort); measure on chip before judging
-        # — every hand rewrite this round lost to XLA's default until
-        # proven otherwise.
+        # order after a stable sort).
         return _embedding_segsum(data, weight)
+    if choice != "scatter":
+        raise ValueError(
+            "MXNET_EMBED_GRAD must be 'scatter', 'segsum' or unset "
+            "(measured default: %r), got %r"
+            % (_EMBED_GRAD_DEFAULT, choice))
     return jnp.take(weight, data.astype(jnp.int32), axis=0)
 
 

@@ -11,7 +11,7 @@ update_on_kvstore server-side updates).
 from .resilience import DeadWorkerError, FaultInjector, RetryPolicy
 from .trainer import make_train_step, TrainStep
 from .sharding import (data_parallel_mesh, make_mesh, param_sharding,
-                       batch_sharding)
+                       batch_sharding, SpecLayout)
 from .ring import ring_attention
 from .pipeline import pipeline_apply, pipeline_from_symbol
 from .moe import moe_ffn

@@ -19,7 +19,7 @@ import os
 
 import jax
 
-__all__ = ["init", "rank", "size", "is_initialized"]
+__all__ = ["init", "rank", "size", "is_initialized", "default_mesh"]
 
 _initialized = False
 
@@ -49,6 +49,34 @@ def init(coordinator_address=None, num_processes=None, process_id=None):
 
 def is_initialized():
     return _initialized
+
+
+def default_mesh(axis_sizes=None):
+    """The sensible pod-scale ``data × fsdp`` mesh for the GSPMD
+    one-jit path (docs/parallelism.md): ``fsdp`` spans the devices of
+    one host/slice (parameter all-gathers ride ICI, the fast fabric),
+    ``data`` spans hosts (only grad reduce-scatters cross DCN) —
+    the topology split arXiv 2004.13336's weight-update sharding
+    assumes. Single-process runs get ``data=1, fsdp=all``.
+
+    axis_sizes: optional override dict forwarded to
+    ``sharding.make_mesh`` (e.g. add ``{"tp": 2}``); validated against
+    the visible device count with an actionable ValueError.
+    """
+    from .sharding import make_mesh
+    if axis_sizes is not None:
+        return make_mesh(axis_sizes)
+    # jax.devices() id order is NOT guaranteed to group by host; the
+    # (hosts, n//hosts) reshape below only puts one host's devices in
+    # one fsdp group if we sort them that way first
+    devs = sorted(jax.devices(),
+                  key=lambda d: (d.process_index, d.id))
+    n = len(devs)
+    hosts = jax.process_count()
+    if n % hosts != 0:
+        # heterogeneous host/device split: fall back to pure fsdp
+        return make_mesh({"data": 1, "fsdp": n}, devices=devs)
+    return make_mesh({"data": hosts, "fsdp": n // hosts}, devices=devs)
 
 
 def rank():

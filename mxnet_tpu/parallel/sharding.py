@@ -1,16 +1,43 @@
-"""Mesh + sharding rules.
+"""Mesh construction + sharding rules — THE placement layer.
 
 Reference mapping (SURVEY.md §2.3): contexts -> mesh axes. The reference
 placed whole layers on devices (group2ctx + PlaceDevice inserting
 _CrossDeviceCopy); here placement is a sharding annotation and XLA inserts
 the transfers/collectives.
 
+Every device placement in the training stack routes through this module:
+``place``/``constrain`` are the only sanctioned ``jax.device_put`` /
+``with_sharding_constraint`` call sites for ``module/`` and
+``parallel/trainer.py`` (tools/perf_smoke.sh lints those files against raw
+calls), and a *layout* object decides every parameter / optimizer-state /
+batch sharding. Two layouts implement one interface:
+
+- ``_HeuristicLayout`` — the original name-suffix heuristics
+  (``param_sharding`` / ``zero1_sharding`` / ``batch_sharding`` below);
+  what a bare ``mesh=`` argument binds to. Semantics unchanged.
+- ``SpecLayout`` — the GSPMD partition-spec REGISTRY over a named
+  ``data × fsdp × tp`` mesh (docs/parallelism.md "One-jit GSPMD path"):
+  ordered rules mapping parameter names (exact or glob, first match
+  wins) to PartitionSpecs, an auto-rule fallback (shard the largest
+  divisible dim over ``fsdp``), optimizer state folded across the
+  ``data × fsdp`` replicas (the ZeRO weight-update sharding of arXiv
+  2004.13336, generalizing ``zero1_sharding``), and a ``describe()``
+  report of which rule claimed each parameter.
+
 Axes convention (scaling-book style):
-  data  — batch dimension (DP). Grad all-reduce rides this axis.
-  model — hidden dimension (TP). Matmul partials psum over this axis.
-More axes (pipe, seq, expert) are added by the specific parallel modules.
+  data  — pure data parallelism: batch shards over it, grad all-reduce
+          rides it, params replicate along it.
+  fsdp  — data parallelism with parameter sharding (ZeRO-3 flavored):
+          the batch ALSO shards over it, but params/opt state live
+          1/|fsdp| per device and XLA all-gathers weights where used.
+  tp    — tensor parallelism (hidden dimension). Matmul partials psum
+          over this axis.
+  model — legacy name for the heuristic layout's TP axis.
+More axes (pipe, sp, expert) are added by the specific parallel modules.
 """
 from __future__ import annotations
+
+import fnmatch
 
 import numpy as np
 
@@ -18,22 +45,87 @@ import jax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 __all__ = ["make_mesh", "data_parallel_mesh", "param_sharding",
-           "batch_sharding", "replicated", "zero1_sharding"]
+           "batch_sharding", "replicated", "zero1_sharding",
+           "SpecLayout", "place", "constrain", "parse_spec",
+           "REPLICA_AXES", "BOUNDARY_OPS"]
+
+# axes the batch dimension shards over and optimizer state folds across
+# (in this order). Everything else (tp/model/sp/expert/pipe) partitions
+# the model itself, never the batch.
+REPLICA_AXES = ("data", "fsdp")
+
+# ops whose outputs mark a module boundary: with a SpecLayout bound, the
+# graph evaluator pins their batch dimension with a LENIENT
+# with_sharding_constraint so GSPMD's propagation can't drift
+# activations off the data axes mid-network (executor._graph_eval_fn).
+BOUNDARY_OPS = frozenset({
+    "FullyConnected", "Convolution", "BatchNorm", "Activation",
+    "Pooling", "Embedding", "Dropout", "SoftmaxOutput", "LayerNorm",
+})
+
+
+def place(value, sharding=None):
+    """Place an array on device — the placement layer's single
+    sanctioned ``jax.device_put`` call site for the training stack
+    (async dispatch; never blocks)."""
+    if sharding is None:
+        return jax.device_put(value)
+    return jax.device_put(value, sharding)
+
+
+def constrain(value, sharding):
+    """Pin an in-graph value's layout — the single sanctioned
+    ``with_sharding_constraint`` call site for the training stack."""
+    return jax.lax.with_sharding_constraint(value, sharding)
+
+
+def _ns(mesh, parts):
+    """NamedSharding with trailing replicated (None) dims stripped.
+    XLA normalizes the shardings it assigns to step OUTPUTS that way,
+    and NamedSharding equality is syntactic (P('fsdp', None) !=
+    P('fsdp')) — an un-normalized placement would differ from the
+    step's own output sharding and cost a spurious step-2 recompile
+    when the state feeds back (review finding on the GSPMD bench
+    row)."""
+    parts = tuple(parts)
+    while parts and parts[-1] is None:
+        parts = parts[:-1]
+    return NamedSharding(mesh, P(*parts))
 
 
 def make_mesh(axis_sizes, devices=None):
-    """Build a Mesh from {'data': N, 'model': M, ...}. Sizes must multiply
-    to the device count (pass -1 for one axis to infer)."""
+    """Build a Mesh from {'data': N, 'fsdp': M, ...}. Sizes must
+    multiply to the device count; pass -1 for (at most) one axis to
+    infer it. Raises ValueError (never a stripped-under-``python -O``
+    assert) with the sizes and device count on any mismatch."""
     names = tuple(axis_sizes.keys())
     sizes = list(axis_sizes.values())
     if devices is None:
         devices = jax.devices()
     n = len(devices)
+    bad = [(k, v) for k, v in axis_sizes.items()
+           if not isinstance(v, (int, np.integer)) or (v < 1 and v != -1)]
+    if bad:
+        raise ValueError(
+            "mesh axis sizes must be positive ints (or one -1 to "
+            "infer), got %r in %r" % (bad, axis_sizes))
+    if sizes.count(-1) > 1:
+        raise ValueError("at most one mesh axis may be -1 (inferred), "
+                         "got %r" % (axis_sizes,))
     if -1 in sizes:
         known = int(np.prod([s for s in sizes if s != -1]))
+        if known == 0 or n % known != 0:
+            raise ValueError(
+                "cannot infer the -1 axis of %r: the known sizes "
+                "multiply to %d, which does not divide the %d visible "
+                "devices" % (axis_sizes, known, n))
         sizes[sizes.index(-1)] = n // known
-    assert int(np.prod(sizes)) == n, \
-        "mesh axes %r don't multiply to %d devices" % (sizes, n)
+    if int(np.prod(sizes)) != n:
+        raise ValueError(
+            "mesh axes %r (sizes %r, product %d) don't multiply to the "
+            "%d visible devices — fix the sizes, use -1 for one axis, "
+            "or pass an explicit devices= subset"
+            % (names, sizes, int(np.prod(sizes)), n))
     arr = np.asarray(devices).reshape(sizes)
     return Mesh(arr, names)
 
@@ -53,7 +145,7 @@ def batch_sharding(mesh, ndim, batch_axis=0):
     """Batch arrays: shard the batch axis over 'data' (+ nothing else)."""
     spec = [None] * ndim
     spec[batch_axis] = "data"
-    return NamedSharding(mesh, P(*spec))
+    return _ns(mesh, spec)
 
 
 def zero1_sharding(mesh, name, shape):
@@ -74,14 +166,14 @@ def zero1_sharding(mesh, name, shape):
     """
     base = param_sharding(mesh, name, shape).spec
     if "data" not in mesh.axis_names:
-        return NamedSharding(mesh, base)
+        return _ns(mesh, base)
     dsize = mesh.shape["data"]
     spec = list(base) + [None] * (len(shape) - len(base))
     for d in range(len(shape)):
         if spec[d] is None and shape[d] % dsize == 0 and shape[d] >= dsize:
             spec[d] = "data"
-            return NamedSharding(mesh, P(*spec))
-    return NamedSharding(mesh, base)
+            return _ns(mesh, spec)
+    return _ns(mesh, base)
 
 
 def param_sharding(mesh, name, shape):
@@ -101,16 +193,317 @@ def param_sharding(mesh, name, shape):
     """
     if "expert" in mesh.axis_names and "expert" in name and \
             len(shape) >= 1 and shape[0] % mesh.shape["expert"] == 0:
-        return NamedSharding(
-            mesh, P(*(["expert"] + [None] * (len(shape) - 1))))
+        return _ns(mesh, ["expert"] + [None] * (len(shape) - 1))
     if "model" not in mesh.axis_names:
         return NamedSharding(mesh, P())
     msize = mesh.shape["model"]
     if len(shape) >= 2 and shape[0] % msize == 0 and (
             name.endswith("_weight") or name.endswith("weight")):
-        spec = ["model"] + [None] * (len(shape) - 1)
-        return NamedSharding(mesh, P(*spec))
+        return _ns(mesh, ["model"] + [None] * (len(shape) - 1))
     if len(shape) == 1 and shape[0] % msize == 0 and \
             name.endswith("_bias"):
         return NamedSharding(mesh, P("model"))
     return NamedSharding(mesh, P())
+
+
+# ---------------------------------------------------------------------------
+# the layout interface
+# ---------------------------------------------------------------------------
+
+def parse_spec(spec):
+    """Rule grammar -> tuple of per-dim entries (None | axis | tuple).
+
+    Accepts a PartitionSpec, a tuple/list (entries: None, 'axis', or a
+    tuple of axes sharing one dim), or a string: comma-separated dims,
+    '+'-joined axes within one dim, None/'' for replicated dims —
+    ``"fsdp,None"``, ``"data+fsdp"``, ``"fsdp,tp"``.
+    """
+    if isinstance(spec, P):
+        parts = list(spec)
+    elif isinstance(spec, (tuple, list)):
+        parts = list(spec)
+    else:
+        parts = [p.strip() for p in
+                 str(spec).strip().strip("()").split(",")]
+        parts = [tuple(a.strip() for a in p.split("+")) if "+" in p
+                 else p for p in parts]
+    out = []
+    for p in parts:
+        if p is None or p in ("", "None", "none"):
+            out.append(None)
+        elif isinstance(p, (tuple, list)):
+            sub = tuple(str(a) for a in p
+                        if a not in (None, "", "None", "none"))
+            out.append(sub if len(sub) > 1 else
+                       (sub[0] if sub else None))
+        else:
+            out.append(str(p))
+    return tuple(out)
+
+
+def _entry_axes(entry):
+    """A spec entry as a tuple of axis names (possibly empty)."""
+    if entry is None:
+        return ()
+    return entry if isinstance(entry, tuple) else (entry,)
+
+
+class _HeuristicLayout:
+    """The pre-registry name-suffix heuristics behind a bare ``mesh=``
+    argument (param_sharding / zero1_sharding / batch_sharding) — kept
+    bit-for-bit so existing mesh users are untouched, but expressed as
+    a layout so TrainStep/Module have ONE placement path."""
+
+    def __init__(self, mesh):
+        self.mesh = mesh
+
+    @property
+    def batch_axes(self):
+        return ("data",) if "data" in self.mesh.axis_names else ()
+
+    # optimizer state folds over the same axes the batch shards over
+    zero_axes = batch_axes
+
+    def param_nsharding(self, name, shape):
+        return param_sharding(self.mesh, name, shape)
+
+    def opt_nsharding(self, name, shape, zero=False):
+        if zero:
+            return zero1_sharding(self.mesh, name, shape)
+        return param_sharding(self.mesh, name, shape)
+
+    def batch_nsharding(self, ndim, batch_axis=0):
+        if not self.batch_axes:
+            # sp/pipe/expert-only meshes: batch enters replicated and
+            # the mesh-aware ops (ring attention etc.) shard as needed
+            return replicated(self.mesh)
+        return batch_sharding(self.mesh, ndim, batch_axis)
+
+    def replicated_nsharding(self):
+        return replicated(self.mesh)
+
+    def act_parts(self, ndim):
+        """No boundary constraints on the heuristic path (unchanged
+        legacy behavior; __shard__/__shard_hint__ attrs still apply)."""
+        return None
+
+    def describe(self):
+        return "heuristic layout over mesh %r (param_sharding " \
+            "name-suffix rules; __shard__ attrs override)" \
+            % dict(self.mesh.shape)
+
+
+class SpecLayout:
+    """Ordered partition-spec registry over a named mesh.
+
+    rules: sequence of ``(pattern, spec)`` — pattern matches parameter
+    names exactly or as a glob (``fnmatch``: ``*``, ``?``, ``[...]``),
+    FIRST match wins; spec is a PartitionSpec / tuple / grammar string
+    (see ``parse_spec``). Parameters no rule claims fall to the auto
+    rule: shard the largest dim divisible by the ``fsdp`` axis over it,
+    replicate the rest; tensors under ``min_shard_size`` elements
+    (default MXNET_FSDP_MIN_SIZE) replicate — a per-layer all-gather
+    costs more than the memory it saves on tiny tensors.
+
+    Validation raises ValueError (never an assert): unknown axes at
+    construction, rank/divisibility violations at first placement —
+    each message names the rule, the parameter and the offending sizes.
+
+    ``describe()`` (after placement, e.g. ``TrainStep.init_state``)
+    reports which rule claimed each parameter and the per-device shard.
+    """
+
+    def __init__(self, mesh, rules=(), min_shard_size=None,
+                 constrain_activations=None):
+        from .. import config as _config
+        self.mesh = mesh
+        self.min_shard_size = int(
+            _config.get("MXNET_FSDP_MIN_SIZE")
+            if min_shard_size is None else min_shard_size)
+        self.constrain_activations = bool(
+            _config.get("MXNET_GSPMD_CONSTRAIN_ACTS")
+            if constrain_activations is None else constrain_activations)
+        self.rules = []
+        for i, rule in enumerate(rules):
+            try:
+                pat, spec = rule
+            except (TypeError, ValueError):
+                raise ValueError(
+                    "SpecLayout rule %d must be a (pattern, spec) "
+                    "pair, got %r" % (i, rule))
+            parts = parse_spec(spec)
+            seen = set()
+            for entry in parts:
+                for ax in _entry_axes(entry):
+                    if ax not in mesh.axis_names:
+                        raise ValueError(
+                            "SpecLayout rule %d (%r -> %r): axis %r is "
+                            "not a mesh axis %r"
+                            % (i, pat, spec, ax, mesh.axis_names))
+                    if ax in seen:
+                        raise ValueError(
+                            "SpecLayout rule %d (%r -> %r): axis %r "
+                            "appears on more than one dim"
+                            % (i, pat, spec, ax))
+                    seen.add(ax)
+            self.rules.append((str(pat), parts))
+        self._claims = {}   # name -> (label, parts, shape)
+
+    @property
+    def batch_axes(self):
+        return tuple(a for a in REPLICA_AXES
+                     if a in self.mesh.axis_names)
+
+    # the replica axes optimizer state folds over under zero1 — the
+    # data×fsdp product is the ZeRO shard count N
+    zero_axes = batch_axes
+
+    # -- rule resolution ---------------------------------------------------
+    def spec_for(self, name, shape):
+        """(per-dim parts, rule label) for a parameter. Explicit rules
+        that cannot apply (rank/divisibility) fail loudly — first-match-
+        wins means a bad glob silently falling through would mask a
+        layout bug."""
+        shape = tuple(shape)
+        for i, (pat, parts) in enumerate(self.rules):
+            if not fnmatch.fnmatchcase(name, pat):
+                continue
+            label = "rule[%d] %r" % (i, pat)
+            if len(parts) > len(shape):
+                raise ValueError(
+                    "SpecLayout %s claims %r (shape %r) but its spec "
+                    "%r has more dims than the parameter — narrow the "
+                    "pattern or shorten the spec"
+                    % (label, name, shape, parts))
+            for d, entry in enumerate(parts):
+                axes = _entry_axes(entry)
+                if not axes:
+                    continue
+                n = int(np.prod([self.mesh.shape[a] for a in axes]))
+                if shape[d] % n != 0:
+                    raise ValueError(
+                        "SpecLayout %s claims %r but dim %d (size %d) "
+                        "is not divisible by %r (total shards %d) — "
+                        "put a more specific rule first or replicate "
+                        "this parameter"
+                        % (label, name, d, shape[d], entry, n))
+            return parts + (None,) * (len(shape) - len(parts)), label
+        return self._auto(shape)
+
+    def _auto(self, shape):
+        """Auto rule: shard the LARGEST divisible dim over 'fsdp',
+        replicate the rest; tiny tensors replicate outright."""
+        shape = tuple(shape)
+        rep = (None,) * len(shape)
+        if "fsdp" not in self.mesh.axis_names or not shape:
+            return rep, "auto:replicated (no fsdp axis)"
+        if int(np.prod(shape)) < self.min_shard_size:
+            return rep, "auto:replicated (< %d elements)" \
+                % self.min_shard_size
+        f = self.mesh.shape["fsdp"]
+        best = None
+        for d, s in enumerate(shape):
+            if s % f == 0 and s >= f and (best is None
+                                          or s > shape[best]):
+                best = d
+        if best is None:
+            return rep, "auto:replicated (no dim divisible by fsdp=%d)" \
+                % f
+        parts = list(rep)
+        parts[best] = "fsdp"
+        return tuple(parts), "auto:fsdp@dim%d" % best
+
+    # -- the layout interface ---------------------------------------------
+    def param_nsharding(self, name, shape):
+        parts, label = self.spec_for(name, shape)
+        self._claims[name] = (label, parts, tuple(shape))
+        return _ns(self.mesh, parts)
+
+    def opt_nsharding(self, name, shape, zero=False):
+        """Optimizer-state sharding. ``zero=True`` (the sharded-
+        optimizer path) starts from the parameter's own spec and folds
+        every still-unused replica axis (data, fsdp) into the first dim
+        it divides — the weight update then runs on a
+        1/(data·fsdp) slice per device and XLA inserts the
+        reduce-scatter/all-gather pair (arXiv 2004.13336)."""
+        parts, _ = self.spec_for(name, shape)
+        if not zero:
+            return _ns(self.mesh, parts)
+        parts = list(parts)
+        used = {a for e in parts for a in _entry_axes(e)}
+        for ax in self.zero_axes:
+            if ax in used:
+                continue
+            axn = self.mesh.shape[ax]
+            for d in range(len(parts)):
+                cur = _entry_axes(parts[d])
+                have = int(np.prod([self.mesh.shape[a] for a in cur])) \
+                    if cur else 1
+                if shape[d] % (have * axn) == 0 and \
+                        shape[d] >= have * axn:
+                    merged = cur + (ax,)
+                    parts[d] = merged if len(merged) > 1 else merged[0]
+                    used.add(ax)
+                    break
+        return _ns(self.mesh, parts)
+
+    def batch_nsharding(self, ndim, batch_axis=0):
+        axes = self.batch_axes
+        parts = [None] * ndim
+        if axes and ndim > 0:
+            parts[batch_axis] = axes if len(axes) > 1 else axes[0]
+        return _ns(self.mesh, parts)
+
+    def replicated_nsharding(self):
+        return replicated(self.mesh)
+
+    def act_parts(self, ndim):
+        """Lenient per-dim parts pinning an activation's batch dim to
+        the data axes at module boundaries (BOUNDARY_OPS), or None when
+        constraints are off / there is nothing to pin. The executor
+        applies these with strict=False: an indivisible or lower-rank
+        tensor is skipped, never an error."""
+        if not self.constrain_activations or ndim == 0:
+            return None
+        axes = self.batch_axes
+        if not axes:
+            return None
+        head = axes if len(axes) > 1 else axes[0]
+        return (head,) + (None,) * (ndim - 1)
+
+    def describe(self):
+        """Human-readable placement report: one line per parameter the
+        layout has claimed (global shape → per-device shard, claiming
+        rule), plus any rule that matched nothing."""
+        lines = ["SpecLayout over mesh %r (%d devices)"
+                 % (dict(self.mesh.shape), self.mesh.size)]
+        matched = set()
+        for name in sorted(self._claims):
+            label, parts, shape = self._claims[name]
+            if label.startswith("rule["):
+                matched.add(label.split()[0])
+            shard = NamedSharding(self.mesh, P(*parts)) \
+                .shard_shape(shape)
+            lines.append("  %-32s %s -> %s  spec=%r  [%s]"
+                         % (name, "x".join(map(str, shape)) or "()",
+                            "x".join(map(str, shard)) or "()",
+                            tuple(parts), label))
+        for i, (pat, _parts) in enumerate(self.rules):
+            if "rule[%d]" % i not in matched:
+                lines.append("  rule[%d] %r matched no parameter"
+                             % (i, pat))
+        if not self._claims:
+            lines.append("  (no parameters placed yet — call "
+                         "init_state/bind first)")
+        return "\n".join(lines)
+
+
+def as_layout(mesh_or_layout):
+    """Normalize a mesh-or-layout argument to a layout (None stays
+    None): the single seam through which TrainStep and the module
+    executor group bind placement."""
+    if mesh_or_layout is None:
+        return None
+    if isinstance(mesh_or_layout, Mesh):
+        return _HeuristicLayout(mesh_or_layout)
+    return mesh_or_layout

@@ -18,7 +18,6 @@ import os
 import jax
 import jax.numpy as jnp
 import numpy as np
-from jax.sharding import NamedSharding, PartitionSpec as P
 
 from .. import guardrail as _guardrail
 from .. import telemetry as _telemetry
@@ -66,7 +65,7 @@ class TrainStep:
                  label_names=("softmax_label",), optimizer="sgd",
                  optimizer_params=None, mesh=None, donate=True,
                  compute_dtype=None, remat=None, optimizer_sharding=None,
-                 clip_norm=None):
+                 clip_norm=None, layout=None):
         """compute_dtype: cast params+data to this dtype for fwd/bwd
         (e.g. 'bfloat16' for MXU-rate compute) while master weights,
         gradients, optimizer state and BN statistics stay float32 — the
@@ -88,6 +87,16 @@ class TrainStep:
         replicated path, equal up to float reduction order (tests
         assert allclose).
 
+        layout: a ``sharding.SpecLayout`` — the GSPMD partition-spec
+        registry (docs/parallelism.md "One-jit GSPMD path"). Carries
+        its own mesh (don't also pass ``mesh=``); params/opt state are
+        placed per its rules, batches shard over its data axes
+        (data × fsdp), activations are pinned at module boundaries,
+        and ``optimizer_sharding='zero1'`` folds optimizer state
+        across the data × fsdp replicas (1/N state + update per
+        device). A bare ``mesh=`` keeps the original name-suffix
+        heuristics — both paths run through the same placement layer.
+
         clip_norm: clip gradients by GLOBAL norm before the optimizer
         (the LM-training standard; the per-element clip_gradient knob
         on the optimizer still applies inside the fused update). The
@@ -96,7 +105,20 @@ class TrainStep:
         gradient pytree, inside the compiled step."""
         from ..base import env_flag
         self.symbol = symbol
+        if layout is not None:
+            if mesh is not None and mesh is not layout.mesh:
+                raise ValueError(
+                    "pass either layout= or mesh=, not both — the "
+                    "layout carries its own mesh")
+            mesh = layout.mesh
         self.mesh = mesh
+        # ONE placement seam for both the registry (SpecLayout) and the
+        # legacy heuristic path; None = single device, no placement
+        self._layout = layout if layout is not None \
+            else shd.as_layout(mesh)
+        # SpecLayout-only extras (activation pinning, describe report,
+        # layout telemetry) key off this
+        self._spec_layout = layout
         self.compute_dtype = (None if compute_dtype is None
                               else jnp.dtype(compute_dtype))
         self.remat = bool(remat) if remat is not None else \
@@ -117,9 +139,13 @@ class TrainStep:
             raise ValueError("optimizer_sharding must be None or 'zero1', "
                              "got %r" % (optimizer_sharding,))
         if optimizer_sharding == "zero1" and (
-                mesh is None or "data" not in mesh.axis_names):
-            raise ValueError("optimizer_sharding='zero1' needs a mesh "
-                             "with a 'data' axis to shard over")
+                self._layout is None or not self._layout.zero_axes):
+            raise ValueError(
+                "optimizer_sharding='zero1' needs a replica axis to "
+                "shard the optimizer state over: a bare mesh= with a "
+                "'data' axis, or a layout=SpecLayout(...) (which folds "
+                "over 'data' and 'fsdp') — got mesh axes %r"
+                % (None if mesh is None else list(mesh.axis_names)))
         if clip_norm is not None and not float(clip_norm) > 0:
             # "not > 0" (rather than "<= 0") also rejects NaN, which
             # would silently poison every gradient inside the jit
@@ -133,9 +159,10 @@ class TrainStep:
         # aliases ids >= 256. Found from the graph, not by name.
         self._id_inputs = self._embedding_fed_inputs(symbol) \
             & set(self.data_names)
-        # mesh passed through so __shard__/ctx_group annotations lower to
-        # sharding constraints inside the step
-        self._eval_fn = _graph_eval_fn(symbol, mesh=mesh)
+        # mesh passed through so __shard__/ctx_group annotations lower
+        # to sharding constraints inside the step; a SpecLayout
+        # additionally pins activation batch dims at module boundaries
+        self._eval_fn = _graph_eval_fn(symbol, mesh=mesh, layout=layout)
 
         self._donate = bool(donate)
         # last fit's guardrail outcome: masked_steps/rollbacks/lr_mult
@@ -214,7 +241,39 @@ class TrainStep:
                     if n.endswith("var") else jnp.zeros(aux2shape[n],
                                                         jnp.float32)
             aux[n] = self._place_rep(init_v)
+        if self._spec_layout is not None:
+            self._report_layout(params, opt_state)
         return params, opt_state, aux
+
+    def _report_layout(self, params, opt_state):
+        """GSPMD layout telemetry at placement time: rule-claim counts
+        and the per-device optimizer-state bytes, all host-side shape
+        math (zero device syncs). The full per-parameter report is
+        ``describe_layout()``."""
+        lay = self._spec_layout
+        sharded = sum(
+            1 for v in params.values()
+            if np.prod(v.sharding.shard_shape(v.shape))
+            < np.prod(v.shape))
+        opt_bytes = sum(
+            int(np.prod(s.sharding.shard_shape(s.shape)))
+            * s.dtype.itemsize
+            for states in opt_state.values() for s in states)
+        _telemetry.gauge("gspmd.sharded_params").set(sharded)
+        _telemetry.gauge("gspmd.opt_state_bytes_per_dev").set(opt_bytes)
+        _telemetry.journal_event(
+            "layout.bind", mesh=dict(lay.mesh.shape),
+            params=len(params), sharded_params=sharded,
+            opt_state_bytes_per_dev=opt_bytes,
+            rules=len(lay.rules))
+
+    def describe_layout(self):
+        """The layout's per-parameter placement report (which rule
+        claimed each parameter, global -> per-device shard shapes).
+        Populated by ``init_state``/``load_state``."""
+        if self._layout is None:
+            return "no mesh/layout bound (single-device step)"
+        return self._layout.describe()
 
     def _raw_feed(self, batch):
         """Named feed dict from a DataBatch with NO host round trip:
@@ -819,42 +878,42 @@ class TrainStep:
             opt_state[n] = tuple(
                 self._place_opt(n, saved[i])
                 for i in range(self._n_state))
+        if self._spec_layout is not None:
+            # a resumed run reports the same gauges/journal event an
+            # init_state-started run does
+            self._report_layout(params, opt_state)
         return params, opt_state, aux
 
     def _place_param(self, name, value):
-        if self.mesh is None:
+        if self._layout is None:
             return value
-        return jax.device_put(
-            value, shd.param_sharding(self.mesh, name, value.shape))
+        return shd.place(
+            value, self._layout.param_nsharding(name, value.shape))
 
     def _place_opt(self, name, value):
-        """Optimizer state: ZeRO-1 shards it 1/N over 'data'."""
-        if self.mesh is None:
+        """Optimizer state: 'zero1' folds it 1/N across the layout's
+        replica axes (data × fsdp); otherwise it follows the param."""
+        if self._layout is None:
             return value
-        if self.optimizer_sharding == "zero1":
-            return jax.device_put(
-                value, shd.zero1_sharding(self.mesh, name, value.shape))
-        return self._place_param(name, value)
+        return shd.place(value, self._layout.opt_nsharding(
+            name, value.shape, zero=self.optimizer_sharding == "zero1"))
 
     def _place_rep(self, value):
-        if self.mesh is None:
+        if self._layout is None:
             return value
-        return jax.device_put(value, shd.replicated(self.mesh))
+        return shd.place(value, self._layout.replicated_nsharding())
 
     def place_batch(self, batch):
-        """Move batch arrays to device once (sharded along the data axis
-        when a mesh is set) — call before the step loop so the H2D
-        transfer isn't repaid every iteration."""
-        if self.mesh is None:
-            return {k: jax.device_put(jnp.asarray(v))
+        """Move batch arrays to device once (sharded along the layout's
+        data axes when a mesh/layout is set; meshes with no replica
+        axis — sp/pipe/expert — replicate, and the mesh-aware ops shard
+        what they need) — call before the step loop so the H2D transfer
+        isn't repaid every iteration."""
+        if self._layout is None:
+            return {k: shd.place(jnp.asarray(v))
                     for k, v in batch.items()}
-        if "data" not in self.mesh.axis_names:
-            # sp/pipe/expert-only meshes: batch enters replicated and the
-            # mesh-aware ops (ring attention etc.) shard what they need
-            return {k: jax.device_put(v, shd.replicated(self.mesh))
-                    for k, v in batch.items()}
-        return {k: jax.device_put(
-            v, shd.batch_sharding(self.mesh, np.ndim(v)))
+        return {k: shd.place(
+            v, self._layout.batch_nsharding(np.ndim(v)))
             for k, v in batch.items()}
 
     # -- the step ----------------------------------------------------------
@@ -874,14 +933,14 @@ class TrainStep:
         opt_attrs = dict(self.opt_params)
         opt_fn = get_op(self._opt_op).fn
         n_state = self._n_state
-        mesh = self.mesh
+        layout = self._layout
+        pin_state = self._spec_layout is not None
         data_names = self.data_names
         cdt = self.compute_dtype
         remat = self.remat
         zero1 = self.optimizer_sharding == "zero1"
         id_inputs = self._id_inputs
         clip_norm = self.clip_norm
-        constrain = jax.lax.with_sharding_constraint
         scaler = guard.scaler if guard is not None else None
 
         def step(params, opt_state, aux, batch, lr, rng, inject=None):
@@ -903,12 +962,12 @@ class TrainStep:
             if "rescale_grad" not in attrs and data_names:
                 attrs["rescale_grad"] = 1.0 / batch[
                     data_names[0]].shape[0]
-            if mesh is not None and "data" in mesh.axis_names:
+            if layout is not None and layout.batch_axes:
                 # pin batch layout so sharding does not rest only on input
                 # propagation; params keep their init_state placement
-                # (meshes without a data axis replicate the batch)
-                batch = {k: jax.lax.with_sharding_constraint(
-                    v, shd.batch_sharding(mesh, jnp.ndim(v)))
+                # (meshes without a replica axis replicate the batch)
+                batch = {k: shd.constrain(
+                    v, layout.batch_nsharding(jnp.ndim(v)))
                     for k, v in batch.items()}
 
             def fwd(p):
@@ -980,10 +1039,11 @@ class TrainStep:
                     # run the fused update there, all-gather the result
                     # back to the parameter's own layout. XLA turns the
                     # psum+constraint pair into a reduce_scatter and the
-                    # final constraint into an all_gather over 'data'.
-                    zs = shd.zero1_sharding(mesh, n, p.shape)
-                    p = constrain(p, zs)
-                    g = constrain(g, zs)
+                    # final constraint into an all_gather over the
+                    # replica axes (data × fsdp under a SpecLayout).
+                    zs = layout.opt_nsharding(n, p.shape, zero=True)
+                    p = shd.constrain(p, zs)
+                    g = shd.constrain(g, zs)
                 res = opt_fn(p, g, *opt_state[n], lr=lr, **attrs)
                 new_p = res[0] if n_state else res
                 new_s = tuple(res[1:]) if n_state else ()
@@ -993,11 +1053,30 @@ class TrainStep:
                     # in the 1/N slice (don't leave it to GSPMD output
                     # propagation — a replicated choice would both break
                     # the memory claim and force a step-2 recompile)
-                    new_p = constrain(
-                        new_p, shd.param_sharding(mesh, n, new_p.shape))
-                    new_s = tuple(constrain(s, zs) for s in new_s)
+                    new_p = shd.constrain(
+                        new_p, layout.param_nsharding(n, new_p.shape))
+                    new_s = tuple(shd.constrain(s, zs) for s in new_s)
+                elif pin_state:
+                    # registry path, unsharded optimizer: still pin the
+                    # outgoing state to the layout so donated buffers
+                    # keep their shardings across steps (no layout
+                    # drift, no step-2 recompile)
+                    new_p = shd.constrain(
+                        new_p, layout.param_nsharding(n, new_p.shape))
+                    new_s = tuple(shd.constrain(
+                        s_, layout.opt_nsharding(n, s_.shape))
+                        for s_ in new_s)
                 new_params[n] = new_p
                 new_opt[n] = new_s
+            if pin_state:
+                # aux (BN moving stats) must come back REPLICATED like
+                # init_state placed it — left to propagation, the
+                # boundary constraints shard it over fsdp and the
+                # drifted layout misses the jit cache (a full step-2
+                # recompile, measured ~2 s on the CPU mesh)
+                new_aux = {k: shd.constrain(
+                    v, layout.replicated_nsharding())
+                    for k, v in new_aux.items()}
             if guard is not None:
                 # mask the whole update out on device: a non-finite
                 # step leaves params, optimizer state AND BN statistics

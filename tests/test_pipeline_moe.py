@@ -219,12 +219,13 @@ def test_moe_data_expert_zero1_composition():
                            optimizer_sharding="zero1")
     state = step.init_state(Xavier(), {"data": (B, T),
                                        "softmax_label": (B, T)})
+    # trailing replicated dims are normalized away by the placement
+    # layer (sharding._ns: placements must compare equal to XLA's own
+    # normalized output shardings or step 2 pays a spurious recompile)
     w1 = state[0]["layer0_experts_w1_weight"]
-    assert str(w1.sharding.spec) == \
-        "PartitionSpec('expert', None, None)", w1.sharding
+    assert tuple(w1.sharding.spec) == ("expert",), w1.sharding
     m1 = state[1]["layer0_experts_w1_weight"][0]
-    assert str(m1.sharding.spec) == \
-        "PartitionSpec('expert', 'data', None)", m1.sharding
+    assert tuple(m1.sharding.spec) == ("expert", "data"), m1.sharding
 
     toks, labels = arith_corpus(B, T, vocab)
     batch = step.place_batch({"data": toks, "softmax_label": labels})

@@ -137,6 +137,22 @@ capa "$CTRL" raw_jax_inception \
     python benchmark/raw_jax_controls.py --network inception-v3
 [ -s "$CTRL" ] && mv "$CTRL" "$OUT/raw_jax_control.txt" || rm -f "$CTRL"
 
+echo "== 4b. serve engine offered-load sweep =="
+# full-suite auto-capture (ROADMAP item 5): bench_serve/bench_scaling
+# now carry the same last_known fallback as bench.py, and every tunnel
+# window refreshes their committed captures here
+cap "$OUT/serve.json" serve python bench_serve.py
+
+echo "== 4c. scaling sweep + GSPMD one-jit row =="
+# single chip unless the slice offers more (BENCH_SCALING_DEVICES=1,4,8
+# on a multi-chip window); the gspmd row is the 28.8%->45% MFU
+# trajectory anchor (docs/parallelism.md "One-jit GSPMD path")
+# bench_scaling defaults its platform to cpu (dead-tunnel hang guard):
+# hand it the session's real backend explicitly
+cap "$OUT/scaling.json" scaling \
+    env BENCH_PLATFORM="${BENCH_PLATFORM:-${JAX_PLATFORMS:-tpu}}" \
+    python bench_scaling.py --devices "${BENCH_SCALING_DEVICES:-1}"
+
 echo "== 5. device trace + breakdown =="
 python - <<'PY'
 import os, sys
