@@ -297,7 +297,7 @@ def _mfu(step, state, batch_vals, dev, sec_per_step, fallback_flops,
 
 
 def bench_image(name, args):
-    metric = "%s_train_throughput" % name.replace("-", "")
+    metric = _metric_for(name)
     net_kwargs, def_batch, baseline, gmacs, image = _IMAGE_NETS[name]
     jax, dev = _probe_backend(metric)
 
@@ -361,13 +361,33 @@ def bench_image(name, args):
         "telemetry": telemetry}))
 
 
-def bench_transformer(args):
-    """Compute-dense LM workload: tokens/s + MFU. vs_baseline = measured
-    MFU / 0.45 north star (BASELINE.md; the reference has no transformer)."""
-    metric = "transformer_lm_train_throughput"
+def _metric_for(network, decode=False, beam=0, spec=0):
+    """The payload metric name for a bench configuration — ONE place,
+    shared by the branch benches and the death stub (a drifted copy
+    files a killed run's diagnostic under the wrong metric). The
+    ``_gqa%d`` suffix follows BENCH_TLM_KV_HEADS like the live
+    branches always did."""
+    if network != "transformer_lm":
+        return "%s_train_throughput" % network.replace("-", "")
+    if not decode:
+        metric = "transformer_lm_train_throughput"
+    elif beam:
+        metric = "transformer_lm_beam%d_decode_throughput" % beam
+    elif spec:
+        metric = "transformer_lm_spec%d_decode_throughput" % spec
+    else:
+        metric = "transformer_lm_decode_throughput"
     kv_heads = int(os.environ.get("BENCH_TLM_KV_HEADS", "0")) or None
     if kv_heads:
         metric += "_gqa%d" % kv_heads
+    return metric
+
+
+def bench_transformer(args):
+    """Compute-dense LM workload: tokens/s + MFU. vs_baseline = measured
+    MFU / 0.45 north star (BASELINE.md; the reference has no transformer)."""
+    metric = _metric_for("transformer_lm")
+    kv_heads = int(os.environ.get("BENCH_TLM_KV_HEADS", "0")) or None
     jax, dev = _probe_backend(metric)
 
     c = dict(_TLM)
@@ -463,19 +483,13 @@ def bench_decode(args):
     (the reference predates transformer serving)."""
     beam = int(args.beam or 0)
     spec = int(args.speculative or 0)
-    if beam:
-        metric = "transformer_lm_beam%d_decode_throughput" % beam
-    elif spec:
-        metric = "transformer_lm_spec%d_decode_throughput" % spec
-    else:
-        metric = "transformer_lm_decode_throughput"
     # BENCH_TLM_KV_HEADS: grouped-query decode (cache holds Hkv heads
     # instead of H — the decode path is cache-bandwidth-bound, so this
     # measures the GQA win directly). Named before the probe so early
     # failures report under the right metric.
+    metric = _metric_for("transformer_lm", decode=True, beam=beam,
+                         spec=spec)
     kv_heads = int(os.environ.get("BENCH_TLM_KV_HEADS", "0")) or None
-    if kv_heads:
-        metric += "_gqa%d" % kv_heads
     jax, dev = _probe_backend(metric)
 
     c = dict(_TLM)
@@ -647,6 +661,19 @@ def main():
                                   "BENCH_TLM_", "BENCH_DECODE_",
                                   "BENCH_ITERS"))
                     for k in os.environ))
+    # killed mid-run -> still exactly one parseable JSON line with the
+    # branch's real metric name (bench_common.install_death_stub;
+    # _metric_for is the same naming the branch benches use)
+    stub_metric = _metric_for(
+        args.network, decode=bool(args.decode),
+        beam=int(args.beam or 0), spec=int(args.speculative or 0))
+    try:
+        from bench_common import install_death_stub
+        install_death_stub(stub_metric,
+                           "tokens/s" if args.network ==
+                           "transformer_lm" else "img/s")
+    except ImportError:
+        pass
     if args.network == "transformer_lm":
         if args.decode:
             if args.remat:

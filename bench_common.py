@@ -96,6 +96,50 @@ def attach_last_known(payload, metric, here=_HERE):
     return True
 
 
+def install_death_stub(metric, unit, **extra):
+    """SIGTERM/SIGINT -> one parseable diagnostic JSON line, then
+    exit 1. A bench killed mid-run (tunnel watchdog, CI timeout,
+    tools/tpu_bench_session.sh moving on) previously died with a bare
+    KeyboardInterrupt / nothing on stdout — no journal, no capture,
+    nothing the driver could parse. With the stub installed the dying
+    bench still emits the same ``fail_payload`` contract as any other
+    failure path (value null, live:false, newest committed capture
+    attached), so every exit of a bench process yields exactly one
+    JSON line. Install it in main() BEFORE the heavy imports/workload:
+    the whole point is covering the window where nothing else can.
+
+    SIGKILL cannot be caught — that contract stops at the shell
+    (tpu_bench_session.sh installs captures only on rc=0).
+
+    Test hook: ``BENCH_TEST_HANG_AFTER_ARM=<seconds>`` prints
+    ``BENCH_DEATH_STUB_ARMED`` to stderr and sleeps, so the
+    kill-mid-run test (tests/test_bench_tools.py) has a deterministic
+    window to deliver the signal in."""
+    import signal
+    import sys
+    import time
+
+    def _die(signum, _frame):
+        err = RuntimeError(
+            "killed by signal %d mid-run (no capture produced)"
+            % signum)
+        payload = fail_payload(metric, unit, err, signal=signum,
+                               **extra)
+        try:
+            sys.stdout.write(json.dumps(payload) + "\n")
+            sys.stdout.flush()
+        finally:
+            os._exit(1)
+
+    for sig in (signal.SIGTERM, signal.SIGINT):
+        signal.signal(sig, _die)
+    hang = float(os.environ.get("BENCH_TEST_HANG_AFTER_ARM", 0) or 0)
+    if hang:
+        sys.stderr.write("BENCH_DEATH_STUB_ARMED\n")
+        sys.stderr.flush()
+        time.sleep(hang)
+
+
 def fail_payload(metric, unit, err, **extra):
     """The shared diagnostic-line shape for a failed bench run:
     null value, the error, live:false, and the newest committed

@@ -329,6 +329,11 @@ def _fail_summary(err):
 def main():
     args = _parse_args()
     counts = sorted({int(c) for c in args.devices.split(",")})
+    try:   # killed mid-run -> still exactly one parseable JSON line
+        from bench_common import install_death_stub
+        install_death_stub("scaling_sweep", "samples/s")
+    except ImportError:
+        pass
 
     # force the host platform BEFORE backend init (a dead TPU tunnel
     # hangs; and the virtual mesh needs the flag locked in first)

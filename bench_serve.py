@@ -125,6 +125,11 @@ def main(argv=None):
     p.add_argument("--classes", type=int, default=16)
     args = p.parse_args(argv)
 
+    try:   # killed mid-run -> still exactly one parseable JSON line
+        from bench_common import install_death_stub
+        install_death_stub("serve_throughput", "req/s")
+    except ImportError:
+        pass
     if os.environ.get("BENCH_PLATFORM"):
         os.environ["JAX_PLATFORMS"] = os.environ["BENCH_PLATFORM"]
     levels = sorted({int(c) for c in

@@ -312,6 +312,21 @@ class TrainStep:
         nor ``num``, so metrics exclude it entirely."""
         raw_step = self._build_step(guard=guard)
         label_names = list(self.label_names)
+        layout = self._layout
+        pin_state = self._spec_layout is not None
+
+        def accumulate(mstats, stats):
+            new = jax.tree.map(jnp.add, mstats, stats)
+            if pin_state:
+                # the stats carry is donated like params/opt-state; left
+                # to GSPMD output propagation it comes back sharded,
+                # misses the jit cache and recompiles at every epoch
+                # boundary (tools/perf_gate.py gspmd scenario gauges
+                # trainstep.jit_cache_size == 1 against exactly this)
+                new = jax.tree.map(
+                    lambda v: shd.constrain(
+                        v, layout.replicated_nsharding()), new)
+            return new
 
         if guard is not None:
             def step_with_metric(params, opt_state, aux, batch, lr,
@@ -321,8 +336,7 @@ class TrainStep:
                 stats = metric.device_update(
                     [batch[n] for n in label_names], list(outs))
                 stats = _guardrail.mask_stats(stats, ok)
-                return (p, o, a), outs, \
-                    jax.tree.map(jnp.add, mstats, stats), ok
+                return (p, o, a), outs, accumulate(mstats, stats), ok
         else:
             def step_with_metric(params, opt_state, aux, batch, lr,
                                  rng, mstats):
@@ -330,8 +344,7 @@ class TrainStep:
                                            batch, lr, rng)
                 stats = metric.device_update(
                     [batch[n] for n in label_names], list(outs))
-                return (p, o, a), outs, \
-                    jax.tree.map(jnp.add, mstats, stats)
+                return (p, o, a), outs, accumulate(mstats, stats)
 
         return raw_step, jax.jit(
             step_with_metric,
@@ -353,8 +366,13 @@ class TrainStep:
         stats_s = jax.eval_shape(
             metric.device_update,
             [placed[n] for n in self.label_names], list(outs_s))
-        return jax.tree.map(lambda s: jnp.zeros(s.shape, s.dtype),
-                            stats_s)
+        zeros = jax.tree.map(lambda s: jnp.zeros(s.shape, s.dtype),
+                             stats_s)
+        if self._spec_layout is not None:
+            # match the layout the fused step pins the carry to, so the
+            # epoch's first step shares the steady-state executable
+            zeros = jax.tree.map(self._place_rep, zeros)
+        return zeros
 
     def fit(self, train_data, num_epoch, initializer=None, lr=0.01,
             lr_scheduler=None, eval_metric="acc", state=None,
@@ -665,6 +683,17 @@ class TrainStep:
                 last_val = val
                 log.info("Epoch[%d] Train-%s=%f", epoch, name, val)
                 if jr is not None:
+                    # fingerprint-friendly jit-cache gauge: donated-
+                    # buffer sharding drift shows up as a second cached
+                    # executable (the step-2-recompile class of
+                    # regression tools/perf_gate.py gates on)
+                    step_fn = fused_step if fuse else (
+                        guarded_step if spec is not None
+                        else self._jit_step)
+                    cache_size = getattr(step_fn, "_cache_size", None)
+                    if cache_size is not None:
+                        _telemetry.gauge(
+                            "trainstep.jit_cache_size").set(cache_size())
                     _telemetry.journal_event("epoch.end",
                                              loop="trainstep",
                                              epoch=epoch, steps=nbatch)
@@ -1045,38 +1074,8 @@ class TrainStep:
                     p = shd.constrain(p, zs)
                     g = shd.constrain(g, zs)
                 res = opt_fn(p, g, *opt_state[n], lr=lr, **attrs)
-                new_p = res[0] if n_state else res
-                new_s = tuple(res[1:]) if n_state else ()
-                if zero1:
-                    # pin layouts explicitly: fresh params all-gather back
-                    # to the parameter layout; persistent opt state STAYS
-                    # in the 1/N slice (don't leave it to GSPMD output
-                    # propagation — a replicated choice would both break
-                    # the memory claim and force a step-2 recompile)
-                    new_p = shd.constrain(
-                        new_p, layout.param_nsharding(n, new_p.shape))
-                    new_s = tuple(shd.constrain(s, zs) for s in new_s)
-                elif pin_state:
-                    # registry path, unsharded optimizer: still pin the
-                    # outgoing state to the layout so donated buffers
-                    # keep their shardings across steps (no layout
-                    # drift, no step-2 recompile)
-                    new_p = shd.constrain(
-                        new_p, layout.param_nsharding(n, new_p.shape))
-                    new_s = tuple(shd.constrain(
-                        s_, layout.opt_nsharding(n, s_.shape))
-                        for s_ in new_s)
-                new_params[n] = new_p
-                new_opt[n] = new_s
-            if pin_state:
-                # aux (BN moving stats) must come back REPLICATED like
-                # init_state placed it — left to propagation, the
-                # boundary constraints shard it over fsdp and the
-                # drifted layout misses the jit cache (a full step-2
-                # recompile, measured ~2 s on the CPU mesh)
-                new_aux = {k: shd.constrain(
-                    v, layout.replicated_nsharding())
-                    for k, v in new_aux.items()}
+                new_params[n] = res[0] if n_state else res
+                new_opt[n] = tuple(res[1:]) if n_state else ()
             if guard is not None:
                 # mask the whole update out on device: a non-finite
                 # step leaves params, optimizer state AND BN statistics
@@ -1096,6 +1095,35 @@ class TrainStep:
                         gr_state[_guardrail.GOOD_KEY], finite)
                     gr_state = {_guardrail.SCALE_KEY: new_scale,
                                 _guardrail.GOOD_KEY: new_good}
+            if zero1 or pin_state:
+                # pin the OUTGOING layouts explicitly, and pin them
+                # LAST — after the guardrail masking, so the pinned
+                # value IS the jit output (a constraint upstream of the
+                # jnp.where mask pins only the where's operand; the
+                # partitioner then re-chooses the output layout and the
+                # donated buffers miss the jit cache on the next step —
+                # the step-2-recompile class tools/perf_gate.py gates
+                # via the trainstep.jit_cache_size gauge). Fresh params
+                # all-gather back to the parameter layout; persistent
+                # optimizer state STAYS in its 1/N zero1 slice (a
+                # propagated replicated choice would also break the
+                # sharded-optimizer memory claim).
+                new_params = {n: shd.constrain(
+                    v, layout.param_nsharding(n, v.shape))
+                    for n, v in new_params.items()}
+                new_opt = {n: tuple(
+                    shd.constrain(s_, layout.opt_nsharding(
+                        n, s_.shape, zero=zero1))
+                    for s_ in ss) for n, ss in new_opt.items()}
+            if pin_state:
+                # aux (BN moving stats) must come back REPLICATED like
+                # init_state placed it — left to propagation, the
+                # boundary constraints shard it over fsdp and the
+                # drifted layout misses the jit cache (a full step-2
+                # recompile, measured ~2 s on the CPU mesh)
+                new_aux = {k: shd.constrain(
+                    v, layout.replicated_nsharding())
+                    for k, v in new_aux.items()}
             new_aux = {**new_aux, **gr_state}
             if guard is not None:
                 return (new_params, new_opt, new_aux), outs, finite

@@ -468,12 +468,16 @@ def journal_step(**fields):
 
 def journal_event(event, **fields):
     """Append one notable-event record (kind=event). No-op without an
-    active journal."""
+    active journal. ``compile`` events additionally bump the
+    ``compile.events`` counter, so the final registry snapshot carries
+    a fingerprint-friendly compile count (``tools/perf_gate.py``
+    asserts steady-state steps never recompile against it)."""
     jr = journal()
     if jr is None:
         return
     if event == "compile":
         _COMPILE_PENDING[0] = now_ms()
+        counter("compile.events").inc()
     rec = {"kind": "event", "event": event}
     if fields:
         rec["fields"] = fields

@@ -53,7 +53,8 @@ from . import config as _config
 __all__ = ["TRACE_SCHEMA_VERSION", "TraceContext", "Span", "span",
            "start_span", "end_span", "instant", "add_span",
            "current_context", "wire_context", "tracer", "enabled",
-           "start_tracing", "stop_tracing", "flush", "unwind"]
+           "start_tracing", "stop_tracing", "flush", "unwind",
+           "span_shape"]
 
 # bump when a spill record's required keys change; the reader
 # (tools/trace_report.py) refuses schemas it doesn't know
@@ -437,6 +438,41 @@ def add_span(name, t0_ms, t1_ms, parent=None, **attrs):
         rec["attrs"] = attrs
     _emit(rec, t)
     return TraceContext(trace_id, span_id)
+
+
+def span_shape(records):
+    """Deterministic structural summary of parsed spill records (the
+    same dicts ``tools/trace_report.py`` reads): the span and instant
+    name vocabularies, the ``parent>child`` nesting edges resolved to
+    NAMES, and the root-span names. Ids, timestamps, pids and counts
+    are all dropped, so two runs of the same deterministic workload
+    produce the IDENTICAL shape — this is the trace half of a
+    ``tools/perf_gate.py`` gate fingerprint: a span that stops being
+    emitted (or re-parents) changes the shape and fails the gate.
+
+    Returns ``{"spans": [...], "instants": [...], "roots": [...],
+    "edges": ["parent>child", ...]}`` with every list sorted. An edge
+    whose parent id was never emitted (a torn spill tail, a peer in
+    another file) resolves to ``"?"`` rather than erroring."""
+    names = {}
+    for r in records:
+        if r.get("kind") == "span" and r.get("span") is not None:
+            names[r["span"]] = r.get("name", "?")
+    shape = {"spans": set(), "instants": set(), "roots": set(),
+             "edges": set()}
+    for r in records:
+        kind = r.get("kind")
+        if kind not in ("span", "instant"):
+            continue
+        name = r.get("name", "?")
+        shape["spans" if kind == "span" else "instants"].add(name)
+        parent = r.get("parent")
+        if parent is None:
+            if kind == "span":
+                shape["roots"].add(name)
+        else:
+            shape["edges"].add("%s>%s" % (names.get(parent, "?"), name))
+    return {k: sorted(v) for k, v in sorted(shape.items())}
 
 
 def current_context():

@@ -4,8 +4,10 @@ import os
 import sys
 
 import numpy as np
+import pytest
 
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
 def test_collective_bytes_parser():
@@ -183,6 +185,56 @@ def test_perf_tables_excludes_ab_experiment_rows(tmp_path):
     os.utime(tmp_path / "resnet50.json", (1, 1))
     table = pt.training_table(pt.load_records(str(tmp_path)))
     assert "2451" in table and "1903" not in table
+
+
+@pytest.mark.gate
+def test_bench_killed_mid_run_emits_parseable_stub():
+    """ISSUE 12 satellite: a bench killed mid-run BEFORE producing any
+    journal/capture must still emit one parseable diagnostic JSON line
+    (bench_common.install_death_stub). Deterministic via the
+    BENCH_TEST_HANG_AFTER_ARM hook: the bench arms its handlers, tells
+    us on stderr, and hangs until we deliver the SIGTERM."""
+    import json
+    import signal
+    import subprocess
+    import time
+
+    env = dict(os.environ, BENCH_TEST_HANG_AFTER_ARM="60")
+    proc = subprocess.Popen(
+        [sys.executable, os.path.join(REPO, "bench_serve.py"),
+         "--requests", "1"],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+        env=env, cwd=REPO)
+    try:
+        deadline = time.time() + 60
+        armed = False
+        while time.time() < deadline:
+            line = proc.stderr.readline()
+            if "BENCH_DEATH_STUB_ARMED" in line:
+                armed = True
+                break
+            if line == "" and proc.poll() is not None:
+                break
+        assert armed, "bench never armed its death stub"
+        proc.send_signal(signal.SIGTERM)
+        out, _ = proc.communicate(timeout=60)
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.communicate()
+    assert proc.returncode == 1
+    lines = [ln for ln in out.strip().splitlines() if ln.strip()]
+    assert lines, "killed bench printed nothing"
+    rec = json.loads(lines[-1])          # parseable — the contract
+    assert rec["metric"] == "serve_throughput"
+    assert rec["value"] is None and rec["live"] is False
+    assert "signal" in rec["error"]
+    assert rec["signal"] == int(signal.SIGTERM)
+    # last_known rides along when a committed serve capture exists
+    # (none is committed until the first live tunnel window) — when it
+    # does, it must stay a sub-object, never promoted
+    if "last_known" in rec:
+        assert rec["value"] is None
 
 
 def test_bench_last_known_excludes_experiment_rows():

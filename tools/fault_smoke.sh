@@ -1,54 +1,8 @@
 #!/usr/bin/env bash
-# Fault-injection smoke: the failure-path test subset (pytest marker
-# `faults`, docs/robustness.md) plus a lint that keeps the resilience
-# layer honest. Run from anywhere; exercises only the fast in-thread
-# tier unless FAULT_SMOKE_SLOW=1 adds the multi-process variants.
+# Thin wrapper (kept for muscle memory / existing docs): the fault
+# lints + `faults`/`guardrail` test subsets now live in
+# tools/perf_gate.sh — the one superset entrypoint (docs/perf_gates.md).
 #
 #   tools/fault_smoke.sh            # fast tier (deterministic, no kills)
 #   FAULT_SMOKE_SLOW=1 tools/fault_smoke.sh
-set -euo pipefail
-cd "$(dirname "$0")/.."
-
-# -- lint: no silent exception swallowing in the parallel layer ----------
-# Bare `except Exception: pass` is how the pre-resilience hangs were
-# born: a swallowed transport error leaves a peer waiting forever.
-# Handle it, classify it, or at minimum log it.
-lint_hits=$(grep -rn -A1 "except Exception" mxnet_tpu/parallel/ \
-    | grep -B1 "^[^:]*[-:][0-9]*[-:] *pass *$" || true)
-if [ -n "$lint_hits" ]; then
-    echo "FAULT LINT FAIL: bare 'except Exception: pass' in mxnet_tpu/parallel/" >&2
-    echo "$lint_hits" >&2
-    echo "Classify the error (resilience.RetryPolicy.is_transient), re-raise, or log it." >&2
-    exit 1
-fi
-echo "fault lint: OK (no silent exception swallowing in mxnet_tpu/parallel/)"
-
-# -- lint: signal handlers must chain, not clobber -----------------------
-# guardrail.GracefulShutdown chains the previous handler; a stray
-# signal.signal() anywhere else clobbers it (and every other handler in
-# the process). New registrations go through GracefulShutdown or get an
-# explicit allowlist entry here.
-sig_hits=$(grep -rn "signal\.signal(" mxnet_tpu/ \
-    | grep -v "mxnet_tpu/guardrail\.py" \
-    | grep -v "mxnet_tpu/kvstore_server\.py" || true)
-if [ -n "$sig_hits" ]; then
-    echo "SIGNAL LINT FAIL: raw signal.signal() outside guardrail.py/kvstore_server.py" >&2
-    echo "$sig_hits" >&2
-    echo "Use guardrail.GracefulShutdown (chains the previous handler) instead of clobbering." >&2
-    exit 1
-fi
-echo "signal lint: OK (no unguarded signal.signal registration)"
-
-# -- the fault-injection + guardrail test subsets ------------------------
-marker="faults and not slow"
-gmarker="guardrail and not slow"
-if [ "${FAULT_SMOKE_SLOW:-0}" = "1" ]; then
-    marker="faults"
-    gmarker="guardrail"
-fi
-env JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" \
-    python -m pytest tests/test_dist_async.py -q -m "$marker" \
-    -p no:cacheprovider "$@"
-exec env JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" \
-    python -m pytest tests/test_guardrail.py -q -m "$gmarker" \
-    -p no:cacheprovider "$@"
+exec "$(dirname "$0")/perf_gate.sh" --only fault "$@"

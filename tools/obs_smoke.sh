@@ -1,53 +1,8 @@
 #!/usr/bin/env bash
-# Observability smoke: the telemetry + trace test subsets (pytest
-# markers `telemetry` and `trace`, docs/observability.md) plus the
-# lints that keep the timing/id discipline honest. Run from anywhere.
+# Thin wrapper (kept for muscle memory / existing docs): the timing/id
+# lints and the `telemetry`/`trace` test subsets now live in
+# tools/perf_gate.sh — the one superset entrypoint (docs/perf_gates.md).
 #
 #   tools/obs_smoke.sh                 # fast tier
 #   OBS_SMOKE_SLOW=1 tools/obs_smoke.sh
-set -euo pipefail
-cd "$(dirname "$0")/.."
-
-# -- lint: ad-hoc timing must go through the telemetry registry ----------
-# A raw time.time()/time.perf_counter() call site in the instrumented
-# hot layers (mxnet_tpu/parallel/, mxnet_tpu/serve/) is a measurement
-# nobody can see: it bypasses the registry (no histogram, no journal,
-# no Prometheus export) and the trace spill. trace.py, telemetry.py and
-# profiler.py are the sanctioned clock owners — instrumented code uses
-# telemetry.now_ms() / Histogram.timer() / trace spans instead.
-lint_hits=$(grep -rn "time\.time()\|time\.perf_counter()" \
-    mxnet_tpu/parallel/ mxnet_tpu/serve/ \
-    | grep -v "/telemetry\.py:" | grep -v "/profiler\.py:" \
-    | grep -v "/trace\.py:" || true)
-if [ -n "$lint_hits" ]; then
-    echo "OBS LINT FAIL: ad-hoc timing call site in the instrumented tree" >&2
-    echo "$lint_hits" >&2
-    echo "Route the measurement through mxnet_tpu/telemetry.py" >&2
-    echo "(telemetry.now_ms(), telemetry.histogram(...).timer())" >&2
-    echo "or mxnet_tpu/trace.py spans." >&2
-    exit 1
-fi
-echo "obs lint: OK (no ad-hoc timing in mxnet_tpu/parallel/ or mxnet_tpu/serve/)"
-
-# -- lint: trace ids must be deterministic -------------------------------
-# uuid / random.random in the trace layer would make span/trace ids
-# irreproducible — a fault-injection test could no longer replay the
-# identical trace structure, and two runs of one job would diverge.
-id_hits=$(grep -nE "import uuid|uuid\.uuid|random\.random\(" \
-    mxnet_tpu/trace.py || true)
-if [ -n "$id_hits" ]; then
-    echo "OBS LINT FAIL: nondeterministic id source in mxnet_tpu/trace.py" >&2
-    echo "$id_hits" >&2
-    echo "Trace ids come from the seeded per-process counter (_next_id)." >&2
-    exit 1
-fi
-echo "obs lint: OK (no uuid/random.random in mxnet_tpu/trace.py)"
-
-# -- the telemetry + trace test subsets ----------------------------------
-marker="(telemetry or trace) and not slow"
-if [ "${OBS_SMOKE_SLOW:-0}" = "1" ]; then
-    marker="telemetry or trace"
-fi
-exec env JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" \
-    python -m pytest tests/test_telemetry.py tests/test_trace.py -q \
-    -m "$marker" -p no:cacheprovider "$@"
+exec "$(dirname "$0")/perf_gate.sh" --only obs "$@"

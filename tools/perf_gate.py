@@ -1,0 +1,748 @@
+"""Journal-backed perf-regression gate: CPU-deterministic scenarios
+checked against committed baselines (docs/perf_gates.md, ROADMAP 5).
+
+The live-TPU bench lost 4 of 5 rounds to the tunnel being down
+(BENCH_r01-r05), so the measured wins of earlier PRs — PR 2's ≤1
+blocking host sync per step, PR 11's one-executable donated-buffer
+steps, PR 8/10's journal + trace vocabulary — were protected only by
+scattered per-PR tests. This tool turns the telemetry journal and
+trace spill those PRs built into ONE enforcement surface:
+
+* each **scenario** (TrainStep fit, Module fit, GSPMD layout step,
+  PS push/pull under fault injection, ServeEngine request path,
+  ContinuousDecoder) runs a short deterministic workload in a fresh
+  subprocess on the CPU backend with ``MXNET_TELEMETRY`` +
+  ``MXNET_TRACE`` on;
+* a **gate fingerprint** is extracted from the journal + spill:
+  per-step blocking-host-sync counts, compile-event counts and which
+  step carries them, the jit-cache size across donated steps, the
+  trace-span vocabulary/nesting shape, the journal schema version,
+  key counter values (ps.retries, guardrail.masked_steps, serve.shed)
+  and noise-tolerant CPU step-time figures;
+* the fingerprint is compared against the committed baseline in
+  ``perf_baselines/<scenario>.json`` — EXACT match for every count and
+  shape field, a ratio tolerance (default 3x, env
+  ``MXNET_GATE_TIME_RATIO``) for wall-clock times;
+* a failure prints which field diverged AND which PR-won property that
+  field protects, so a gate failure reads as "you reintroduced a
+  per-step host sync", not as a JSON diff.
+
+    python tools/perf_gate.py                    # all scenarios
+    python tools/perf_gate.py --scenario trainstep,gspmd
+    python tools/perf_gate.py --bless            # regenerate baselines
+    python tools/perf_gate.py --keep /tmp/gate   # keep run artifacts
+    python tools/perf_gate.py --no-time          # skip the time bounds
+
+``tools/perf_gate.sh`` runs this gate plus every smoke-lint and marker
+test subset — the one builder entrypoint. Count/shape fields are
+deterministic run-to-run (asserted in tests/test_perf_gate.py, marker
+``gate``); after an INTENDED behavior change, re-bless and commit the
+new baselines with the change that caused them.
+"""
+import argparse
+import json
+import os
+import subprocess
+import sys
+
+_SELF = os.path.abspath(__file__)
+_REPO = os.path.dirname(os.path.dirname(_SELF))
+sys.path.insert(0, _REPO)
+
+GATE_SCHEMA = 1
+DEFAULT_TIME_RATIO = 3.0
+BASELINE_DIR = os.path.join(_REPO, "perf_baselines")
+
+
+# ---------------------------------------------------------------------------
+# scenario workloads (run in a fresh child process; see _child_main)
+# ---------------------------------------------------------------------------
+# Every workload must be CPU-deterministic: fixed seeds, fixed fault
+# specs, sequential request submission where concurrency would make
+# event counts racy. Each emits a `gate.probe` journal event carrying
+# the in-process measurements a journal record can't (host-sync deltas);
+# everything else is read back from the journal + trace spill.
+
+def _mlp(classes=2, hidden=32):
+    import mxnet_tpu as mx
+    net = mx.sym.Variable("data")
+    net = mx.sym.FullyConnected(net, name="fc1", num_hidden=hidden)
+    net = mx.sym.Activation(net, act_type="relu")
+    net = mx.sym.FullyConnected(net, name="fc2", num_hidden=classes)
+    return mx.sym.SoftmaxOutput(net, name="softmax")
+
+
+def _toy(n=96, d=16, classes=2, seed=0):
+    import numpy as np
+    rng = np.random.default_rng(seed)
+    X = rng.standard_normal((n, d)).astype(np.float32)
+    y = rng.integers(0, classes, n).astype(np.float32)
+    return X, y
+
+
+def _sync_marks_probe(marks, steps_per_epoch, warm_epochs=1):
+    """Per-step host-sync figures from cumulative counter marks taken
+    at each batch end. Steady state = epochs after `warm_epochs`;
+    deltas are only taken WITHIN an epoch (the epoch boundary pays the
+    metric read + window drain by design)."""
+    steady_deltas = []
+    for e in range(warm_epochs, len(marks) // steps_per_epoch):
+        base = e * steps_per_epoch
+        for i in range(1, steps_per_epoch):
+            steady_deltas.append(marks[base + i] - marks[base + i - 1])
+    return {
+        "max_step_syncs_steady": max(steady_deltas) if steady_deltas
+        else None,
+        "fit_total_syncs": marks[-1] - marks[0] if marks else None,
+    }
+
+
+def _scn_trainstep():
+    """PR 2/3 surface: pipelined TrainStep.fit with the guardrail on
+    and one deterministically injected NaN step (nan@6 of 12)."""
+    from mxnet_tpu import io, profiler, telemetry
+    from mxnet_tpu.initializer import Xavier
+    from mxnet_tpu.parallel import make_train_step
+    from mxnet_tpu.parallel.resilience import (FaultInjector,
+                                               install_fault_injector)
+    X, y = _toy()
+    step = make_train_step(_mlp(), optimizer="sgd",
+                           optimizer_params={"rescale_grad": 1.0 / 24})
+    train = io.NDArrayIter(X, y, batch_size=24)     # 4 steps/epoch
+    marks = []
+    install_fault_injector(FaultInjector("nan@6"))  # epoch 2, step 2
+    try:
+        step.fit(train, num_epoch=3, initializer=Xavier(), lr=0.1,
+                 seed=0, batch_end_callback=lambda _p: marks.append(
+                     profiler.host_sync_count()))
+    finally:
+        install_fault_injector(None)
+    telemetry.journal_event("gate.probe",
+                            **_sync_marks_probe(marks, 4))
+
+
+def _scn_module():
+    """The Module fit path (executor group + device metrics)."""
+    import mxnet_tpu as mx
+    from mxnet_tpu import io, profiler, telemetry
+    X, y = _toy()
+    train = io.NDArrayIter(X, y, batch_size=24)
+    mod = mx.mod.Module(_mlp(), context=mx.cpu())
+    marks = []
+    mod.fit(train, num_epoch=3, optimizer="sgd",
+            optimizer_params={"learning_rate": 0.1,
+                              "rescale_grad": 1.0 / 24},
+            batch_end_callback=lambda _p: marks.append(
+                profiler.host_sync_count()))
+    telemetry.journal_event("gate.probe",
+                            **_sync_marks_probe(marks, 4))
+
+
+def _scn_gspmd():
+    """PR 11 surface: one-jit GSPMD fit over the forced-8-device
+    data×fsdp mesh with zero1 optimizer sharding — the jit-cache gauge
+    must stay at ONE executable across donated steps."""
+    from mxnet_tpu import io, profiler, telemetry
+    from mxnet_tpu.initializer import Xavier
+    from mxnet_tpu.parallel import SpecLayout, make_mesh, make_train_step
+    X, y = _toy(classes=8)
+    mesh = make_mesh({"data": 2, "fsdp": 4})
+    layout = SpecLayout(mesh, min_shard_size=0)
+    step = make_train_step(_mlp(classes=8), layout=layout,
+                           optimizer="adam", optimizer_sharding="zero1",
+                           optimizer_params={"rescale_grad": 1.0 / 24})
+    train = io.NDArrayIter(X, y, batch_size=24)     # 24 % 8 == 0
+    marks = []
+    step.fit(train, num_epoch=3, initializer=Xavier(), lr=0.05,
+             seed=0, batch_end_callback=lambda _p: marks.append(
+                 profiler.host_sync_count()))
+    telemetry.journal_event("gate.probe",
+                            **_sync_marks_probe(marks, 4))
+
+
+def _scn_ps_faults():
+    """PR 1 surface: async PS push/pull under a deterministic
+    mid-push disconnect + dropped pull reply — exactly-once replay
+    means the retry counters are exact, not flaky."""
+    import threading
+
+    import numpy as np
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import telemetry
+    from mxnet_tpu.parallel.ps_async import AsyncPSClient, AsyncPSServer
+    from mxnet_tpu.parallel.resilience import (FaultInjector,
+                                               install_fault_injector)
+    t0 = telemetry.now_ms()
+    srv = AsyncPSServer(host="127.0.0.1", port=0, num_workers=1)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    c = AsyncPSClient(host="127.0.0.1", port=srv.port)
+    c.set_optimizer(mx.optimizer.SGD(learning_rate=0.1,
+                                     rescale_grad=1.0))
+    c.init("w", np.ones((4,), np.float32))
+    inj = install_fault_injector(
+        FaultInjector("send:disconnect@3;recv:drop@6"))
+    try:
+        for i in range(8):
+            c.push("w", np.full((4,), float(i % 3), np.float32))
+        c.pull("w")
+    finally:
+        install_fault_injector(None)
+    c.close()
+    srv.stop()
+    assert inj.fired == [("send", 3, "disconnect"),
+                         ("recv", 6, "drop")], inj.fired
+    telemetry.journal_event("gate.probe",
+                            ps_elapsed_ms=round(
+                                telemetry.now_ms() - t0, 3))
+
+
+def _serve_predictor(feat=8, classes=4):
+    import mxnet_tpu as mx
+    from mxnet_tpu.initializer import Xavier
+    from mxnet_tpu.predictor import Predictor
+    net = mx.sym.Variable("data")
+    net = mx.sym.FullyConnected(net, name="fc1", num_hidden=16)
+    net = mx.sym.Activation(net, act_type="tanh")
+    net = mx.sym.FullyConnected(net, name="fc2", num_hidden=classes)
+    net = mx.sym.SoftmaxOutput(net, name="softmax")
+    arg_shapes, _, _ = net.infer_shape(data=(2, feat))
+    mx.random.seed(7)
+    init = Xavier()
+    args = {}
+    for name, shp in zip(net.list_arguments(), arg_shapes):
+        if name in ("data", "softmax_label"):
+            continue
+        arr = mx.nd.zeros(shp)
+        init(name, arr)
+        args[name] = arr
+    return Predictor(net, args, data_names=("data",))
+
+
+def _scn_serve():
+    """PR 9 surface: warmed buckets + sequential requests (each its
+    own deterministic batch), then a zero-capacity engine so the shed
+    count is exact."""
+    import numpy as np
+
+    from mxnet_tpu import telemetry
+    from mxnet_tpu.serve import Overloaded, ServeEngine
+    t0 = telemetry.now_ms()
+    pred = _serve_predictor()
+    x = np.zeros((1, 8), np.float32)
+    with ServeEngine(pred, buckets=(1, 2, 4), max_wait_ms=0.0,
+                     feature_shapes=[(8,)],
+                     install_sigterm=False) as eng:
+        eng.warmup()
+        for _ in range(4):                  # sequential: fill=1 each
+            eng.infer(x, timeout=60.0)
+    with ServeEngine(pred, buckets=(1,), max_wait_ms=0.0, queue_cap=0,
+                     feature_shapes=[(8,)],
+                     install_sigterm=False) as eng:
+        for _ in range(2):                  # cap 0: every submit sheds
+            try:
+                eng.submit(x)
+            except Overloaded:
+                pass
+    telemetry.journal_event("gate.probe",
+                            serve_elapsed_ms=round(
+                                telemetry.now_ms() - t0, 3))
+
+
+def _scn_decode():
+    """PR 9 surface: continuous-batching decode, sequential ragged
+    requests so admissions/steps/finishes are exact."""
+    import numpy as np
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import telemetry
+    from mxnet_tpu.generation import Generator
+    from mxnet_tpu.initializer import Xavier
+    from mxnet_tpu.models import transformer
+    from mxnet_tpu.parallel import make_train_step
+    t0 = telemetry.now_ms()
+    V, L, H, DIM, T = 50, 2, 2, 32, 24
+    sym = transformer.get_symbol(V, 12, num_layers=L, num_heads=H,
+                                 dim=DIM, max_len=T,
+                                 pos_encoding="learned")
+    step = make_train_step(sym, optimizer="sgd")
+    mx.random.seed(0)
+    state = step.init_state(Xavier(), {"data": (2, 12),
+                                       "softmax_label": (2, 12)})
+    gen = Generator(state[0], V, T, num_layers=L, num_heads=H,
+                    dim=DIM, batch_size=3)
+    with gen.serving_decoder() as dec:
+        for length, max_new in ((4, 5), (6, 3), (3, 4)):
+            dec.submit(np.arange(length), max_new,
+                       eos_id=None).result(300.0)
+    telemetry.journal_event("gate.probe",
+                            decode_elapsed_ms=round(
+                                telemetry.now_ms() - t0, 3))
+
+
+# which PR-won property each gauge protects is resolved through
+# _PROPERTY_NOTES below; `gauges` lists the gauge names a scenario
+# REQUIRES in the final snapshot (absence is itself a gate failure),
+# `noisy_counters`/`noisy_events` name snapshot fields excluded from
+# the exact compare because their values are timing-dependent.
+SCENARIOS = {
+    "trainstep": {
+        "fn": _scn_trainstep,
+        "desc": "pipelined TrainStep.fit + guardrail NaN masking",
+        "gauges": ("trainstep.jit_cache_size",),
+        "noisy_counters": (), "noisy_events": (),
+    },
+    "module": {
+        "fn": _scn_module,
+        "desc": "Module.fit executor-group path",
+        "gauges": ("step.model_flops",),
+        "noisy_counters": (), "noisy_events": (),
+    },
+    "gspmd": {
+        "fn": _scn_gspmd,
+        "desc": "one-jit GSPMD fit (data×fsdp, zero1)",
+        "gauges": ("trainstep.jit_cache_size", "gspmd.sharded_params"),
+        "noisy_counters": (), "noisy_events": (),
+    },
+    "ps_faults": {
+        "fn": _scn_ps_faults,
+        "desc": "PS push/pull under injected disconnect+drop",
+        "gauges": (),
+        "noisy_counters": (), "noisy_events": (),
+    },
+    "serve": {
+        "fn": _scn_serve,
+        "desc": "ServeEngine request path + exact shed",
+        "gauges": (),
+        "noisy_counters": (), "noisy_events": (),
+    },
+    "decode": {
+        "fn": _scn_decode,
+        "desc": "ContinuousDecoder sequential ragged requests",
+        "gauges": (),
+        "noisy_counters": (), "noisy_events": (),
+    },
+}
+
+# field-path prefix -> the protected property a regression names.
+# Ordered most-specific first; the first match wins.
+_PROPERTY_NOTES = (
+    ("counts.probe.max_step_syncs_steady",
+     "PR 2 pipelined hot loop: at most ONE blocking host sync per "
+     "steady-state step (a stray .asnumpy()/wait in the step loop "
+     "re-serializes host and device)"),
+    ("counts.probe.fit_total_syncs",
+     "PR 2 pipelined hot loop: total blocking host syncs across the "
+     "fit are budgeted (window drains + epoch metric reads only)"),
+    ("counts.gauges.trainstep.jit_cache_size",
+     "PR 11 donated-buffer sharding: ONE cached executable across "
+     "donated steps (a growing jit cache is the step-2-recompile "
+     "regression — outgoing state lost its pinned sharding)"),
+    ("counts.gauges.gspmd.sharded_params",
+     "PR 11 SpecLayout placement: the expected parameter count is "
+     "sharded over the data×fsdp mesh"),
+    ("counts.compile",
+     "compile discipline: XLA compiles happen exactly where the "
+     "baseline says (first step / per jit variant); extra compile "
+     "events or a later compile-flagged step mean steady-state "
+     "recompilation"),
+    ("counts.counters.ps.retries",
+     "PR 1 resilience: deterministic fault injection produces the "
+     "exact retry count (exactly-once replay, no hidden extra "
+     "round trips)"),
+    ("counts.counters.ps.reconnects",
+     "PR 1 resilience: reconnect-and-replay count under injected "
+     "disconnects is exact"),
+    ("counts.counters.guardrail.masked_steps",
+     "PR 3 guardrails: the injected non-finite step is masked on "
+     "device and counted exactly once"),
+    ("counts.counters.serve.shed",
+     "PR 9 backpressure: a full queue sheds with the typed "
+     "Overloaded, counted exactly"),
+    ("counts.counters.serve.",
+     "PR 9 serving engine: admission/forward/decode counters are "
+     "exact for a deterministic request sequence"),
+    ("counts.counters.host_syncs",
+     "PR 2 sync budget: the process-wide blocking-host-sync total "
+     "for this deterministic workload is exact"),
+    ("counts.journal_schema",
+     "PR 8 journal schema version: readers refuse unknown schemas — "
+     "bump SCHEMA_VERSION and re-bless deliberately, never drift"),
+    ("counts.events",
+     "PR 8/10 event vocabulary: every journal event the scenario "
+     "used to emit must still be emitted, exactly as often"),
+    ("counts.steps",
+     "journal step records: the fit loops journal one record per "
+     "step"),
+    ("trace.",
+     "PR 10 tracing: the span vocabulary / nesting shape of this "
+     "path (a span that disappears or re-parents breaks trace "
+     "consumers and usually marks deleted instrumentation)"),
+    ("times.",
+     "noise-tolerant CPU time bound (ratio tolerance, not exact — "
+     "see --no-time / MXNET_GATE_TIME_RATIO)"),
+)
+
+
+def property_note(path):
+    for prefix, note in _PROPERTY_NOTES:
+        if path.startswith(prefix):
+            return note
+    return "gate fingerprint field (see docs/perf_gates.md)"
+
+
+# ---------------------------------------------------------------------------
+# fingerprint extraction
+# ---------------------------------------------------------------------------
+
+# the one torn-final-line-tolerant JSONL loader (the journal/spill
+# write contract's read side) is shared across the tools — schema
+# checked per file kind at the call sites in run_scenario
+try:
+    from tools.telemetry_report import load_jsonl
+except ImportError:
+    from telemetry_report import load_jsonl
+
+
+def _intish(v):
+    if isinstance(v, float) and v.is_integer():
+        return int(v)
+    return v
+
+
+def extract_fingerprint(scenario, journal_records, trace_records):
+    """The gate fingerprint: counts/shapes (exact-compared) + times
+    (ratio-compared) from one scenario run's journal and trace spill."""
+    from mxnet_tpu.trace import span_shape
+
+    cfg = SCENARIOS[scenario]
+    counts, times = {}, {}
+    run_start = next((r for r in journal_records
+                      if r.get("kind") == "run_start"), None)
+    counts["journal_schema"] = (run_start or {}).get("schema")
+    steps = [r for r in journal_records if r.get("kind") == "step"]
+    counts["steps"] = len(steps)
+    counts["compile_steps"] = sorted(
+        int(s.get("step", -1)) for s in steps if s.get("compile"))
+
+    events, probe = {}, {}
+    for r in journal_records:
+        if r.get("kind") != "event":
+            continue
+        ev = r.get("event", "?")
+        events[ev] = events.get(ev, 0) + 1
+        if ev == "gate.probe":
+            for k, v in (r.get("fields") or {}).items():
+                if k.endswith("_ms"):
+                    times[k] = v
+                else:
+                    probe[k] = v
+    counts["compile_events"] = events.get("compile", 0)
+    counts["events"] = {k: v for k, v in sorted(events.items())
+                        if k not in cfg["noisy_events"]}
+    counts["probe"] = dict(sorted(probe.items()))
+
+    snap = next((r.get("metrics") for r in reversed(journal_records)
+                 if r.get("kind") == "snapshot"), None) or {}
+    counts["counters"] = {
+        k: _intish(v.get("value")) for k, v in sorted(snap.items())
+        if v.get("type") == "counter" and k not in cfg["noisy_counters"]}
+    counts["gauges"] = {}
+    for g in cfg["gauges"]:
+        val = snap.get(g, {}).get("value")
+        # model_flops is workload-determined but large; presence +
+        # exact value are both deterministic, so keep it exact
+        counts["gauges"][g] = _intish(val) if val is not None else None
+
+    steady = sorted(float(s.get("wall_ms", 0.0)) for s in steps
+                    if not s.get("compile"))
+    if steady:
+        times["step_ms_p50"] = round(
+            steady[int(round(0.5 * (len(steady) - 1)))], 3)
+
+    return {"gate_schema": GATE_SCHEMA, "scenario": scenario,
+            "counts": counts, "trace": span_shape(trace_records),
+            "times": times}
+
+
+# ---------------------------------------------------------------------------
+# compare
+# ---------------------------------------------------------------------------
+
+class Failure:
+    def __init__(self, path, baseline, live, why=None):
+        self.path, self.baseline, self.live = path, baseline, live
+        self.why = why
+
+    def format(self):
+        head = "%s: baseline %r -> live %r" % (
+            self.path, self.baseline, self.live)
+        if self.why:
+            head += "  (%s)" % self.why
+        return head + "\n      regressed property: %s" \
+            % property_note(self.path)
+
+
+def _cmp_tree(path, base, live, fails):
+    if isinstance(base, dict) or isinstance(live, dict):
+        bkeys = set(base or {}) if isinstance(base, dict) else set()
+        lkeys = set(live or {}) if isinstance(live, dict) else set()
+        for k in sorted(bkeys | lkeys):
+            sub = "%s.%s" % (path, k)
+            if k not in lkeys:
+                fails.append(Failure(sub, (base or {}).get(k), None,
+                                     "missing from live run"))
+            elif k not in bkeys:
+                fails.append(Failure(sub, None, (live or {}).get(k),
+                                     "not in baseline — re-bless if "
+                                     "intended"))
+            else:
+                _cmp_tree(sub, base[k], live[k], fails)
+        return
+    if base != live:
+        fails.append(Failure(path, base, live))
+
+
+def time_ratio_for(baseline, override=None):
+    if override is not None:
+        return float(override)
+    env = os.environ.get("MXNET_GATE_TIME_RATIO")
+    if env:
+        return float(env)
+    return float(baseline.get("time_ratio") or DEFAULT_TIME_RATIO)
+
+
+def compare(baseline, live, time_ratio=None, check_times=True):
+    """Baseline record (the perf_baselines/*.json dict) vs a live
+    fingerprint -> list of Failure. Counts and trace shape are exact;
+    times fail only beyond `time_ratio` x baseline."""
+    fails = []
+    bfp = baseline["fingerprint"]
+    if bfp.get("gate_schema") != live.get("gate_schema"):
+        fails.append(Failure("gate_schema", bfp.get("gate_schema"),
+                             live.get("gate_schema")))
+        return fails
+    _cmp_tree("counts", bfp.get("counts"), live.get("counts"), fails)
+    _cmp_tree("trace", bfp.get("trace"), live.get("trace"), fails)
+    if check_times:
+        ratio = time_ratio_for(baseline, time_ratio)
+        for k, bv in sorted((bfp.get("times") or {}).items()):
+            lv = (live.get("times") or {}).get(k)
+            if lv is None:
+                # a vanished time field means the probe/step records
+                # that produced it stopped being emitted — deleted
+                # instrumentation, not noise
+                fails.append(Failure("times." + k, bv, None,
+                                     "missing from live run"))
+            elif bv and float(lv) > float(bv) * ratio:
+                fails.append(Failure(
+                    "times." + k, bv, lv,
+                    "exceeds %.2gx ratio tolerance" % ratio))
+    return fails
+
+
+# ---------------------------------------------------------------------------
+# the runner (parent side)
+# ---------------------------------------------------------------------------
+
+def scenario_env(out_dir):
+    """The child's env: deterministic by construction. EVERY MXNET_*
+    and BENCH_* knob from the operator's shell is dropped (a stray
+    MXNET_DISPATCH_AHEAD=1 would shift the sync-count fingerprint and
+    read as a false PR 2 regression) and XLA_FLAGS is pinned to
+    exactly the forced-8-device mesh; then the six knobs the gate
+    itself needs are set."""
+    env = {k: v for k, v in os.environ.items()
+           if not k.startswith(("MXNET_", "BENCH_"))}
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
+    env["MXNET_TELEMETRY"] = os.path.join(out_dir, "journal.jsonl")
+    env["MXNET_TRACE"] = os.path.join(out_dir, "trace.jsonl")
+    env["PYTHONHASHSEED"] = "0"
+    env["MXNET_PS_RETRY_BASE"] = "0.01"
+    # no heartbeat may fire inside a scenario window (its ping count
+    # would be timing-dependent)
+    env["MXNET_PS_HEARTBEAT_INTERVAL"] = "600"
+    return env
+
+
+def run_scenario(name, out_dir, timeout=600):
+    """Run one scenario subprocess; returns (fingerprint, None) or
+    (None, failure_text). A scenario that dies before producing any
+    journal is a GATE FAILURE with the child's stderr attached, never
+    an unhandled traceback (the bench_common error-stub contract)."""
+    os.makedirs(out_dir, exist_ok=True)
+    env = scenario_env(out_dir)
+    # journal + spill open in APPEND mode; a reused --keep dir must
+    # not accumulate the previous run's records into this fingerprint
+    for stale in (env["MXNET_TELEMETRY"], env["MXNET_TRACE"]):
+        if os.path.exists(stale):
+            os.unlink(stale)
+    try:
+        proc = subprocess.run(
+            [sys.executable, _SELF, "--run-scenario", name],
+            env=env, cwd=_REPO, capture_output=True, text=True,
+            timeout=timeout)
+    except subprocess.TimeoutExpired:
+        return None, "scenario %r timed out after %ds" % (name, timeout)
+    tail = "\n".join(proc.stderr.strip().splitlines()[-8:])
+    if proc.returncode != 0:
+        return None, "scenario %r exited rc=%d before completing:\n%s" \
+            % (name, proc.returncode, tail)
+    jpath = env["MXNET_TELEMETRY"]
+    tpath = env["MXNET_TRACE"]
+    if not os.path.exists(jpath):
+        return None, "scenario %r produced no journal at %s:\n%s" \
+            % (name, jpath, tail)
+    try:
+        fp = extract_fingerprint(name, load_jsonl(jpath),
+                                 load_jsonl(tpath)
+                                 if os.path.exists(tpath) else [])
+    except ValueError as e:
+        return None, "scenario %r journal/trace unreadable: %s" \
+            % (name, e)
+    return fp, None
+
+
+def baseline_path(name, baselines=None):
+    return os.path.join(baselines or BASELINE_DIR, name + ".json")
+
+
+def load_baseline(name, baselines=None):
+    with open(baseline_path(name, baselines)) as f:
+        return json.load(f)
+
+
+def bless(name, fingerprint, baselines=None):
+    path = baseline_path(name, baselines)
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    rec = {"scenario": name,
+           "description": SCENARIOS[name]["desc"],
+           "time_ratio": DEFAULT_TIME_RATIO,
+           "bless_cmd": "python tools/perf_gate.py --bless "
+                        "--scenario " + name,
+           "fingerprint": fingerprint}
+    with open(path, "w") as f:
+        json.dump(rec, f, indent=2, sort_keys=True)
+        f.write("\n")
+    return path
+
+
+def _child_main(name):
+    """Scenario body, run in the fresh subprocess the parent spawned
+    (journal/trace destinations arrive via env). The name resolves
+    BEFORE the journal opens, so a bad scenario dies with no journal —
+    the exact before-any-journal failure the parent must report as a
+    gate failure, not a traceback."""
+    fn = SCENARIOS[name]["fn"]
+    from mxnet_tpu import telemetry, trace
+    t0 = telemetry.now_ms()
+    telemetry.start_journal()
+    trace.start_tracing()
+    fn()
+    telemetry.journal_event(
+        "gate.probe", elapsed_ms=round(telemetry.now_ms() - t0, 3))
+    trace.stop_tracing()
+    telemetry.close_journal()
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(
+        description="journal-backed perf-regression gate "
+                    "(docs/perf_gates.md)")
+    p.add_argument("--scenario", default=None,
+                   help="comma-separated subset (default: all)")
+    p.add_argument("--bless", action="store_true",
+                   help="regenerate the baselines instead of comparing")
+    p.add_argument("--baselines", default=None,
+                   help="baseline dir (default perf_baselines/)")
+    p.add_argument("--keep", default=None, metavar="DIR",
+                   help="keep per-scenario journals/traces under DIR")
+    p.add_argument("--no-time", action="store_true",
+                   help="skip the wall-clock ratio checks")
+    p.add_argument("--time-ratio", type=float, default=None,
+                   help="override the time ratio tolerance")
+    p.add_argument("--json", action="store_true",
+                   help="emit a machine-readable result")
+    p.add_argument("--run-scenario", default=None,
+                   help=argparse.SUPPRESS)   # internal: child mode
+    args = p.parse_args(argv)
+
+    if args.run_scenario:
+        _child_main(args.run_scenario)
+        return 0
+
+    names = list(SCENARIOS) if not args.scenario else [
+        s.strip() for s in args.scenario.split(",") if s.strip()]
+    for n in names:
+        if n not in SCENARIOS:
+            p.error("unknown scenario %r (have: %s)"
+                    % (n, ", ".join(SCENARIOS)))
+
+    import tempfile
+    work = args.keep or tempfile.mkdtemp(prefix="perf_gate_")
+    results = {}
+    failed = False
+    mode = "bless" if args.bless else "check"
+    print("== perf gate (%s): %d scenario(s), baselines in %s =="
+          % (mode, len(names), args.baselines or BASELINE_DIR))
+    for name in names:
+        fp, err = run_scenario(name, os.path.join(work, name))
+        if err is not None:
+            failed = True
+            results[name] = {"status": "error", "error": err}
+            print("  %-10s ERROR\n    %s" % (name,
+                                             err.replace("\n", "\n    ")))
+            continue
+        if args.bless:
+            path = bless(name, fp, args.baselines)
+            results[name] = {"status": "blessed", "baseline": path}
+            print("  %-10s blessed -> %s"
+                  % (name, os.path.relpath(path, _REPO)))
+            continue
+        try:
+            base = load_baseline(name, args.baselines)
+        except (OSError, ValueError) as e:
+            failed = True
+            results[name] = {"status": "error",
+                             "error": "no readable baseline: %s" % e}
+            print("  %-10s ERROR no readable baseline (%s) — run "
+                  "--bless and commit it" % (name, e))
+            continue
+        fails = compare(base, fp, time_ratio=args.time_ratio,
+                        check_times=not args.no_time)
+        if fails:
+            failed = True
+            results[name] = {"status": "fail",
+                             "failures": [f.format() for f in fails]}
+            print("  %-10s FAIL (%d divergence(s))" % (name, len(fails)))
+            for f in fails:
+                print("    - " + f.format())
+        else:
+            results[name] = {"status": "ok"}
+            c = fp["counts"]
+            print("  %-10s OK (steps=%d, %d compile event(s), %d "
+                  "span name(s))"
+                  % (name, c["steps"], c["compile_events"],
+                     len(fp["trace"]["spans"])))
+    if not args.keep and not failed:
+        import shutil
+        shutil.rmtree(work, ignore_errors=True)
+    elif failed and not args.keep:
+        print("artifacts kept for inspection under %s" % work)
+    if args.json:
+        print(json.dumps(results, indent=2))
+    if failed:
+        print("PERF GATE: FAIL — a committed-baseline property "
+              "regressed (or changed intentionally: re-bless with "
+              "tools/perf_gate.py --bless and commit the new "
+              "baselines)")
+        return 1
+    print("PERF GATE: OK" if not args.bless else
+          "PERF GATE: baselines regenerated — review + commit "
+          "perf_baselines/")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,240 @@
+#!/usr/bin/env bash
+# THE one builder entrypoint (docs/perf_gates.md): every smoke lint,
+# every marker test subset the four *_smoke.sh scripts used to own, the
+# `gate` test subset, and the journal-backed perf-regression gate
+# (tools/perf_gate.py vs the committed perf_baselines/). The four
+# *_smoke.sh scripts are kept as thin delegating wrappers — a lint
+# below rejects any new *_smoke.sh that does not route through here.
+#
+#   tools/perf_gate.sh                  # everything
+#   tools/perf_gate.sh --only fault     # exactly what fault_smoke.sh ran
+#   tools/perf_gate.sh --only perf|obs|serve|gate
+#   tools/perf_gate.sh --skip-gate      # lints + test subsets only
+#
+# Extra args pass through to pytest. Slow tiers: FAULT_SMOKE_SLOW=1,
+# OBS_SMOKE_SLOW=1, SERVE_SMOKE_SLOW=1 (unchanged from the wrappers).
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+ONLY=all
+SKIP_GATE=0
+while [ $# -gt 0 ]; do
+    case "$1" in
+        --only) ONLY="$2"; shift 2 ;;
+        --skip-gate) SKIP_GATE=1; shift ;;
+        *) break ;;
+    esac
+done
+PLATFORM="${JAX_PLATFORMS:-cpu}"
+
+# ---------------------------------------------------------------------------
+# lints
+# ---------------------------------------------------------------------------
+
+lint_fault() {
+    # -- no silent exception swallowing in the parallel layer ------------
+    # Bare `except Exception: pass` is how the pre-resilience hangs were
+    # born: a swallowed transport error leaves a peer waiting forever.
+    local hits
+    hits=$(grep -rn -A1 "except Exception" mxnet_tpu/parallel/ \
+        | grep -B1 "^[^:]*[-:][0-9]*[-:] *pass *$" || true)
+    if [ -n "$hits" ]; then
+        echo "FAULT LINT FAIL: bare 'except Exception: pass' in mxnet_tpu/parallel/" >&2
+        echo "$hits" >&2
+        echo "Classify the error (resilience.RetryPolicy.is_transient), re-raise, or log it." >&2
+        exit 1
+    fi
+    echo "fault lint: OK (no silent exception swallowing in mxnet_tpu/parallel/)"
+
+    # -- signal handlers must chain, not clobber -------------------------
+    hits=$(grep -rn "signal\.signal(" mxnet_tpu/ \
+        | grep -v "mxnet_tpu/guardrail\.py" \
+        | grep -v "mxnet_tpu/kvstore_server\.py" || true)
+    if [ -n "$hits" ]; then
+        echo "SIGNAL LINT FAIL: raw signal.signal() outside guardrail.py/kvstore_server.py" >&2
+        echo "$hits" >&2
+        echo "Use guardrail.GracefulShutdown (chains the previous handler) instead of clobbering." >&2
+        exit 1
+    fi
+    echo "signal lint: OK (no unguarded signal.signal registration)"
+}
+
+lint_perf() {
+    # -- no blocking host reads inside the step loops --------------------
+    # The pipelining claim (docs/performance.md) dies one .asnumpy() at
+    # a time: a single D2H read per batch re-serializes host and device.
+    local hits
+    hits=$(grep -n "\.asnumpy()" \
+        mxnet_tpu/parallel/trainer.py \
+        mxnet_tpu/module/executor_group.py || true)
+    if [ -n "$hits" ]; then
+        echo "PERF LINT FAIL: blocking .asnumpy() in a step-loop file" >&2
+        echo "$hits" >&2
+        echo "Feed device arrays (NDArray._data / place_batch) instead, or" >&2
+        echo "move the read outside the per-step path." >&2
+        exit 1
+    fi
+    echo "perf lint: OK (no .asnumpy() in trainer.py / executor_group.py)"
+
+    # -- one placement layer --------------------------------------------
+    # All mesh placement routes through parallel/sharding.py; a raw
+    # device_put/with_sharding_constraint elsewhere bypasses SpecLayout.
+    hits=$(grep -rn "jax\.device_put\|with_sharding_constraint" \
+        mxnet_tpu/module/*.py \
+        mxnet_tpu/parallel/trainer.py || true)
+    if [ -n "$hits" ]; then
+        echo "PLACEMENT LINT FAIL: raw device_put/with_sharding_constraint" >&2
+        echo "outside the placement layer (mxnet_tpu/parallel/sharding.py)" >&2
+        echo "$hits" >&2
+        echo "Route it through sharding.place / sharding.constrain / the" >&2
+        echo "bound layout instead." >&2
+        exit 1
+    fi
+    echo "placement lint: OK (no raw device_put/with_sharding_constraint" \
+         "in module/ or trainer.py)"
+}
+
+lint_obs() {
+    # -- ad-hoc timing must go through the telemetry registry ------------
+    # A raw time.time()/time.perf_counter() call site in the hot layers
+    # is a measurement nobody can see: it bypasses the registry, the
+    # journal and the trace spill.
+    local hits
+    hits=$(grep -rn "time\.time()\|time\.perf_counter()" \
+        mxnet_tpu/parallel/ mxnet_tpu/serve/ \
+        | grep -v "/telemetry\.py:" | grep -v "/profiler\.py:" \
+        | grep -v "/trace\.py:" || true)
+    if [ -n "$hits" ]; then
+        echo "OBS LINT FAIL: ad-hoc timing call site in the instrumented tree" >&2
+        echo "$hits" >&2
+        echo "Route the measurement through mxnet_tpu/telemetry.py" >&2
+        echo "(telemetry.now_ms(), telemetry.histogram(...).timer())" >&2
+        echo "or mxnet_tpu/trace.py spans." >&2
+        exit 1
+    fi
+    echo "obs lint: OK (no ad-hoc timing in mxnet_tpu/parallel/ or mxnet_tpu/serve/)"
+
+    # -- trace ids must be deterministic ---------------------------------
+    hits=$(grep -nE "import uuid|uuid\.uuid|random\.random\(" \
+        mxnet_tpu/trace.py || true)
+    if [ -n "$hits" ]; then
+        echo "OBS LINT FAIL: nondeterministic id source in mxnet_tpu/trace.py" >&2
+        echo "$hits" >&2
+        echo "Trace ids come from the seeded per-process counter (_next_id)." >&2
+        exit 1
+    fi
+    echo "obs lint: OK (no uuid/random.random in mxnet_tpu/trace.py)"
+}
+
+lint_serve() {
+    # -- raw sockets only in serve/net.py --------------------------------
+    # Every byte on the serving wire goes through serve/net.py (ps_async
+    # framing + FaultInjector hooks); a raw `socket.` call site anywhere
+    # else bypasses the fault grammar and its tests.
+    local hits
+    hits=$(grep -rn "socket\." mxnet_tpu/serve/ \
+        | grep -v "mxnet_tpu/serve/net\.py:" || true)
+    if [ -n "$hits" ]; then
+        echo "SERVE LINT FAIL: raw socket. usage in mxnet_tpu/serve/ outside net.py" >&2
+        echo "$hits" >&2
+        echo "Route transport through mxnet_tpu/serve/net.py (ps_async framing" >&2
+        echo "+ FaultInjector hooks) so MXNET_FAULT_SPEC keeps covering it." >&2
+        exit 1
+    fi
+    echo "serve lint: OK (no raw socket. usage in mxnet_tpu/serve/ outside net.py)"
+}
+
+lint_gate() {
+    # -- every smoke script routes through this entrypoint ---------------
+    # A new *_smoke.sh with its own lints/subsets re-fragments the build
+    # checks this script exists to unify (ROADMAP item 5): add a section
+    # here and make the new script a thin `exec perf_gate.sh --only X`
+    # wrapper like the four existing ones.
+    local f
+    for f in tools/*_smoke.sh; do
+        # require the actual delegation form, not a mere mention in a
+        # comment: an exec line handing control to perf_gate.sh
+        if ! grep -Eq '^[[:space:]]*exec .*perf_gate\.sh"? --only' "$f"; then
+            echo "SMOKE LINT FAIL: $f does not route through tools/perf_gate.sh" >&2
+            echo "Make it a thin wrapper (exec tools/perf_gate.sh --only <section>)" >&2
+            echo "and put its lints/test subsets in a perf_gate.sh section." >&2
+            exit 1
+        fi
+    done
+    echo "smoke lint: OK (every tools/*_smoke.sh routes through perf_gate.sh)"
+}
+
+# ---------------------------------------------------------------------------
+# test subsets (exactly what the four smoke scripts ran)
+# ---------------------------------------------------------------------------
+
+tests_fault() {
+    local marker="faults and not slow" gmarker="guardrail and not slow"
+    if [ "${FAULT_SMOKE_SLOW:-0}" = "1" ]; then
+        marker="faults"; gmarker="guardrail"
+    fi
+    env JAX_PLATFORMS="$PLATFORM" \
+        python -m pytest tests/test_dist_async.py -q -m "$marker" \
+        -p no:cacheprovider "$@"
+    env JAX_PLATFORMS="$PLATFORM" \
+        python -m pytest tests/test_guardrail.py -q -m "$gmarker" \
+        -p no:cacheprovider "$@"
+}
+
+tests_perf() {
+    env JAX_PLATFORMS="$PLATFORM" \
+        python -m pytest tests/ -q -m gspmd -p no:cacheprovider "$@"
+    env JAX_PLATFORMS="$PLATFORM" \
+        python -m pytest tests/test_hotloop.py tests/test_metric.py -q \
+        -p no:cacheprovider "$@"
+}
+
+tests_obs() {
+    local marker="(telemetry or trace) and not slow"
+    if [ "${OBS_SMOKE_SLOW:-0}" = "1" ]; then
+        marker="telemetry or trace"
+    fi
+    env JAX_PLATFORMS="$PLATFORM" \
+        python -m pytest tests/test_telemetry.py tests/test_trace.py -q \
+        -m "$marker" -p no:cacheprovider "$@"
+}
+
+tests_serve() {
+    local marker="serve and not slow"
+    if [ "${SERVE_SMOKE_SLOW:-0}" = "1" ]; then
+        marker="serve"
+    fi
+    env JAX_PLATFORMS="$PLATFORM" \
+        python -m pytest tests/test_serve.py tests/test_serve_decode.py \
+        -q -m "$marker" -p no:cacheprovider "$@"
+}
+
+tests_gate() {
+    env JAX_PLATFORMS="$PLATFORM" \
+        python -m pytest tests/ -q -m "gate and not slow" \
+        -p no:cacheprovider "$@"
+}
+
+run_gate() {
+    # the journal-backed regression gate itself, against the COMMITTED
+    # baselines (docs/perf_gates.md; --bless + commit after an intended
+    # behavior change)
+    env JAX_PLATFORMS="$PLATFORM" python tools/perf_gate.py
+}
+
+case "$ONLY" in
+    fault)  lint_fault; tests_fault "$@" ;;
+    perf)   lint_perf;  tests_perf "$@" ;;
+    obs)    lint_obs;   tests_obs "$@" ;;
+    serve)  lint_serve; tests_serve "$@" ;;
+    gate)   lint_gate;  tests_gate "$@"; [ "$SKIP_GATE" = "1" ] || run_gate ;;
+    all)
+        lint_fault; lint_perf; lint_obs; lint_serve; lint_gate
+        tests_fault "$@"; tests_perf "$@"; tests_obs "$@"
+        tests_serve "$@"; tests_gate "$@"
+        [ "$SKIP_GATE" = "1" ] || run_gate
+        ;;
+    *) echo "unknown --only section: $ONLY (fault|perf|obs|serve|gate)" >&2
+       exit 2 ;;
+esac
+echo "== perf_gate.sh ($ONLY): all checks passed =="

@@ -1,56 +1,8 @@
 #!/usr/bin/env bash
-# Hot-loop perf smoke: the pipelining + device-metric-parity test
-# subset (tests/test_hotloop.py, CPU backend), the GSPMD one-jit
-# subset (pytest marker `gspmd`), plus lints that keep the step loops
-# and the placement layer honest. Run from anywhere.
+# Thin wrapper (kept for muscle memory / existing docs): the perf +
+# placement lints and the `gspmd`/hotloop/metric test subsets now live
+# in tools/perf_gate.sh — the one superset entrypoint
+# (docs/perf_gates.md).
 #
 #   tools/perf_smoke.sh
-set -euo pipefail
-cd "$(dirname "$0")/.."
-
-# -- lint: no blocking host reads inside the step loops ------------------
-# The pipelining claim (docs/performance.md "Pipelined training hot
-# loop") dies one .asnumpy() at a time: a single D2H read per batch
-# re-serializes host and device. The SPMD fit loop and the executor
-# group's feed path must stay free of them (metric fallbacks and
-# checkpoint/save paths live elsewhere).
-lint_hits=$(grep -n "\.asnumpy()" \
-    mxnet_tpu/parallel/trainer.py \
-    mxnet_tpu/module/executor_group.py || true)
-if [ -n "$lint_hits" ]; then
-    echo "PERF LINT FAIL: blocking .asnumpy() in a step-loop file" >&2
-    echo "$lint_hits" >&2
-    echo "Feed device arrays (NDArray._data / place_batch) instead, or" >&2
-    echo "move the read outside the per-step path." >&2
-    exit 1
-fi
-echo "perf lint: OK (no .asnumpy() in trainer.py / executor_group.py)"
-
-# -- lint: one placement layer ------------------------------------------
-# All mesh placement routes through parallel/sharding.py
-# (place/constrain + the layout objects). A raw jax.device_put or
-# with_sharding_constraint in the module executors or the SPMD trainer
-# bypasses the SpecLayout registry — exactly the drift the one-jit
-# GSPMD path exists to prevent (docs/parallelism.md).
-lint_hits=$(grep -rn "jax\.device_put\|with_sharding_constraint" \
-    mxnet_tpu/module/*.py \
-    mxnet_tpu/parallel/trainer.py || true)
-if [ -n "$lint_hits" ]; then
-    echo "PLACEMENT LINT FAIL: raw device_put/with_sharding_constraint" >&2
-    echo "outside the placement layer (mxnet_tpu/parallel/sharding.py)" >&2
-    echo "$lint_hits" >&2
-    echo "Route it through sharding.place / sharding.constrain / the" >&2
-    echo "bound layout instead." >&2
-    exit 1
-fi
-echo "placement lint: OK (no raw device_put/with_sharding_constraint" \
-     "in module/ or trainer.py)"
-
-# -- the GSPMD one-jit subset (marker: gspmd) ----------------------------
-env JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" \
-    python -m pytest tests/ -q -m gspmd -p no:cacheprovider "$@"
-
-# -- the pipelining + metric-parity subset -------------------------------
-exec env JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" \
-    python -m pytest tests/test_hotloop.py tests/test_metric.py -q \
-    -p no:cacheprovider "$@"
+exec "$(dirname "$0")/perf_gate.sh" --only perf "$@"

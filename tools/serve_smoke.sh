@@ -1,34 +1,8 @@
 #!/usr/bin/env bash
-# Serving smoke: the serving-engine test subset (pytest marker
-# `serve`, docs/serving.md) plus a lint that keeps the transport
-# boundary honest. Run from anywhere.
+# Thin wrapper (kept for muscle memory / existing docs): the transport
+# lint and the `serve` test subset now live in tools/perf_gate.sh —
+# the one superset entrypoint (docs/perf_gates.md).
 #
 #   tools/serve_smoke.sh                 # fast tier
 #   SERVE_SMOKE_SLOW=1 tools/serve_smoke.sh
-set -euo pipefail
-cd "$(dirname "$0")/.."
-
-# -- lint: raw sockets only in serve/net.py ------------------------------
-# The serving engine and the continuous decoder are transport-free by
-# design: every byte on the wire goes through serve/net.py, which
-# reuses the ps_async framing + FaultInjector hooks — a raw `socket.`
-# call site anywhere else bypasses the fault grammar (and its tests).
-lint_hits=$(grep -rn "socket\." mxnet_tpu/serve/ \
-    | grep -v "mxnet_tpu/serve/net\.py:" || true)
-if [ -n "$lint_hits" ]; then
-    echo "SERVE LINT FAIL: raw socket. usage in mxnet_tpu/serve/ outside net.py" >&2
-    echo "$lint_hits" >&2
-    echo "Route transport through mxnet_tpu/serve/net.py (ps_async framing" >&2
-    echo "+ FaultInjector hooks) so MXNET_FAULT_SPEC keeps covering it." >&2
-    exit 1
-fi
-echo "serve lint: OK (no raw socket. usage in mxnet_tpu/serve/ outside net.py)"
-
-# -- the serving test subset ---------------------------------------------
-marker="serve and not slow"
-if [ "${SERVE_SMOKE_SLOW:-0}" = "1" ]; then
-    marker="serve"
-fi
-exec env JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" \
-    python -m pytest tests/test_serve.py tests/test_serve_decode.py \
-    -q -m "$marker" -p no:cacheprovider "$@"
+exec "$(dirname "$0")/perf_gate.sh" --only serve "$@"

@@ -16,6 +16,13 @@ training step plus one per notable event. This tool reconstructs:
     python tools/telemetry_report.py runs/telemetry-1234.jsonl
     python tools/telemetry_report.py --json runs/telemetry-1234.jsonl
     python tools/telemetry_report.py --stats 127.0.0.1:9911
+    python tools/telemetry_report.py --diff old.jsonl new.jsonl
+
+``--diff OLD NEW`` compares two journals regression-first: step-time
+quantile and throughput deltas, the wait-breakdown shift, per-counter
+deltas, and event-vocabulary changes (events that appeared or
+disappeared between the runs) — the human companion to the automated
+``tools/perf_gate.py`` gate (docs/perf_gates.md).
 
 The summary's ``samples_per_sec`` is sum(samples) / sum(wall_ms):
 step walls are measured boundary-to-boundary in the fit loops, so the
@@ -40,11 +47,14 @@ SCHEMA_VERSION = 1
 _CURVE_BUCKETS = 20
 
 
-def load(path):
-    """Parse a journal into a record list. A crash can tear at most the
-    FINAL line mid-write (records are flushed one line at a time), so a
-    parse failure there is tolerated; anywhere earlier it is real
-    corruption and raises. Unknown schema versions raise too."""
+def load_jsonl(path, schema=None, what="record"):
+    """Torn-final-line-tolerant JSONL loader — THE one read side of
+    the journal/spill write contract (one flushed line per record, so
+    a crash tears at most the FINAL line; a parse failure there is
+    tolerated, anywhere earlier is real corruption and raises). With
+    ``schema`` set, every record's ``v`` must match or the file is
+    refused. Shared by this tool, ``tools/trace_report.py`` and
+    ``tools/perf_gate.py`` — evolve the contract here, once."""
     with open(path) as f:
         lines = [ln.strip() for ln in f]
     while lines and not lines[-1]:
@@ -58,14 +68,19 @@ def load(path):
         except ValueError:
             if i == len(lines) - 1:
                 break            # torn final line: the crash signature
-            raise ValueError("%s:%d: corrupt journal record" % (path, i + 1))
-        v = rec.get("v")
-        if v != SCHEMA_VERSION:
+            raise ValueError("%s:%d: corrupt %s" % (path, i + 1, what))
+        if schema is not None and rec.get("v") != schema:
             raise ValueError(
-                "%s:%d: journal schema v%r, this reader understands v%d"
-                % (path, i + 1, v, SCHEMA_VERSION))
+                "%s:%d: %s schema v%r, this reader understands v%d"
+                % (path, i + 1, what, rec.get("v"), schema))
         records.append(rec)
     return records
+
+
+def load(path):
+    """Parse a journal into a record list (schema-checked)."""
+    return load_jsonl(path, schema=SCHEMA_VERSION,
+                      what="journal record")
 
 
 def _quantile(sorted_vals, q):
@@ -320,6 +335,121 @@ def format_report(summary):
     return "\n".join(lines)
 
 
+def _pct(old, new):
+    """Signed percent change new vs old; None when undefined."""
+    if old is None or new is None or not old:
+        return None
+    return round(100.0 * (float(new) - float(old)) / float(old), 1)
+
+
+def diff_summaries(old, new):
+    """Regression-oriented diff of two :func:`summarize` outputs.
+    Positive step-time deltas and negative throughput deltas are the
+    regression directions; ``suspects`` collects the headline fields
+    that moved the wrong way by more than 10%."""
+    out = {"steps": [old.get("steps"), new.get("steps")],
+           "suspects": []}
+    for key, worse_when in (("samples_per_sec", "down"),
+                            ("wall_s", "up"),
+                            ("compile_steps", "up"),
+                            ("compile_ms", "up")):
+        o, n = old.get(key), new.get(key)
+        if o is None and n is None:
+            continue
+        pct = _pct(o, n)
+        out[key] = {"old": o, "new": n, "pct": pct}
+        if pct is not None and (pct < -10 if worse_when == "down"
+                                else pct > 10):
+            out["suspects"].append(key)
+    sm_o, sm_n = old.get("step_ms") or {}, new.get("step_ms") or {}
+    if sm_o or sm_n:
+        out["step_ms"] = {}
+        for q in ("mean", "p50", "p95", "p99", "min", "max"):
+            pct = _pct(sm_o.get(q), sm_n.get(q))
+            out["step_ms"][q] = {"old": sm_o.get(q), "new": sm_n.get(q),
+                                 "pct": pct}
+            if q in ("p50", "p95") and pct is not None and pct > 10:
+                out["suspects"].append("step_ms." + q)
+    for key in ("data_wait_ms_share", "window_wait_ms_share"):
+        o, n = old.get(key), new.get(key)
+        if o is not None or n is not None:
+            out[key] = {"old": o, "new": n}
+    # counter deltas over the union (a counter that disappears entirely
+    # usually marks deleted instrumentation — a gate-worthy smell)
+    co = old.get("counters") or {}
+    cn = new.get("counters") or {}
+    deltas = {}
+    for k in sorted(set(co) | set(cn)):
+        ov, nv = co.get(k), cn.get(k)
+        if ov != nv:
+            deltas[k] = {"old": ov, "new": nv}
+    if deltas:
+        out["counter_deltas"] = deltas
+    ev_o = set(old.get("events") or {})
+    ev_n = set(new.get("events") or {})
+    out["events_added"] = sorted(ev_n - ev_o)
+    out["events_removed"] = sorted(ev_o - ev_n)
+    if out["events_removed"]:
+        out["suspects"].append("events_removed")
+    ev_counts = {}
+    for k in sorted(ev_o & ev_n):
+        ov = (old.get("events") or {}).get(k)
+        nv = (new.get("events") or {}).get(k)
+        if ov != nv:
+            ev_counts[k] = {"old": ov, "new": nv}
+    if ev_counts:
+        out["event_count_changes"] = ev_counts
+    return out
+
+
+def format_diff(diff, old_path="OLD", new_path="NEW"):
+    """The diff dict as a regression-oriented text table."""
+    lines = ["telemetry journal diff", "=" * 46,
+             "  old: %s" % old_path, "  new: %s" % new_path, ""]
+
+    def row(label, o, n, pct=None):
+        tail = "" if pct is None else "  (%+.1f%%)" % pct
+        return "| %-18s | %10s | %10s |%s" % (label, o, n, tail)
+
+    lines += ["| field              |        old |        new |",
+              "|---|---|---|",
+              row("steps", diff["steps"][0], diff["steps"][1])]
+    for key in ("samples_per_sec", "wall_s", "compile_steps",
+                "compile_ms"):
+        if key in diff:
+            d = diff[key]
+            lines.append(row(key, d["old"], d["new"], d["pct"]))
+    for q, d in (diff.get("step_ms") or {}).items():
+        lines.append(row("step_ms." + q, d["old"], d["new"], d["pct"]))
+    for key in ("data_wait_ms_share", "window_wait_ms_share"):
+        if key in diff:
+            d = diff[key]
+            lines.append(row(key, d["old"], d["new"]))
+    if diff.get("counter_deltas"):
+        lines += ["", "counters that changed:",
+                  "| counter | old | new |", "|---|---|---|"]
+        for k, d in diff["counter_deltas"].items():
+            lines.append("| %s | %s | %s |" % (k, d["old"], d["new"]))
+    if diff.get("event_count_changes"):
+        lines += ["", "event counts that changed:",
+                  "| event | old | new |", "|---|---|---|"]
+        for k, d in diff["event_count_changes"].items():
+            lines.append("| %s | %s | %s |" % (k, d["old"], d["new"]))
+    if diff.get("events_added"):
+        lines += ["", "events only in new: "
+                  + ", ".join(diff["events_added"])]
+    if diff.get("events_removed"):
+        lines += ["", "events only in old (deleted instrumentation?): "
+                  + ", ".join(diff["events_removed"])]
+    lines.append("")
+    if diff.get("suspects"):
+        lines.append("regression suspects (>10%% the wrong way): %s"
+                     % ", ".join(diff["suspects"]))
+    else:
+        lines.append("no regression suspects (>10% thresholds)")
+    return "\n".join(lines)
+
+
 def fetch_stats(addr, timeout=10.0):
     """Query a live ServeServer's ``stats`` introspection frame.
     Speaks the serving wire directly (4-byte length prefix + pickle) so
@@ -385,15 +515,27 @@ def main(argv=None):
     p.add_argument("--stats", metavar="HOST:PORT",
                    help="query a live ServeServer's stats frame "
                         "instead of reading a journal")
+    p.add_argument("--diff", nargs=2, metavar=("OLD", "NEW"),
+                   help="compare two journals (regression-oriented "
+                        "table; the human companion to tools/"
+                        "perf_gate.py)")
     args = p.parse_args(argv)
     try:
+        if args.diff:
+            old_p, new_p = args.diff
+            diff = diff_summaries(summarize(load(old_p)),
+                                  summarize(load(new_p)))
+            print(json.dumps(diff, indent=2) if args.json
+                  else format_diff(diff, old_p, new_p))
+            return
         if args.stats:
             stats = fetch_stats(args.stats)
             print(json.dumps(stats, indent=2, default=str)
                   if args.json else format_stats(stats))
             return
         if not args.journal:
-            p.error("give a journal path (or --stats HOST:PORT)")
+            p.error("give a journal path (or --stats HOST:PORT, or "
+                    "--diff OLD NEW)")
         summary = summarize(load(args.journal))
         if args.json:
             print(json.dumps(summary, indent=2))

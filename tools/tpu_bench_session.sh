@@ -23,7 +23,40 @@ cd "$(dirname "$0")/.."
 export OUT="${1:-bench_out}"
 mkdir -p "$OUT"
 FAILED=()
+REFRESHED=()
 note() { [ "$1" -ne 0 ] && FAILED+=("$2 (rc=$1)"); true; }
+
+# Validate a would-be JSON capture BEFORE install: a diagnostic line
+# (value null / live:false — the bench_common fail_payload contract,
+# including the SIGTERM death stub) or torn/garbled output must never
+# overwrite a previously-committed good capture that last_known cites.
+# Non-JSON artifacts (trace_summary.txt etc.) skip the check.
+ok_capture() {  # ok_capture <dest-name> <content-file>
+  case "$1" in *.json|*.jsonl|*.jsonl.new) ;; *) return 0 ;; esac
+  python - "$2" <<'PY'
+import json, sys
+ok = False
+with open(sys.argv[1]) as f:
+    for line in f:
+        line = line.strip()
+        if not line or not line.startswith("{"):
+            continue
+        try:
+            rec = json.loads(line)
+        except ValueError:
+            sys.exit(1)            # torn output: not installable
+        # the fail_payload/death-stub diagnostic signature is
+        # live:false (every whole-run failure path sets it). Anything
+        # else that parses counts as a capture: micro benches carry
+        # their own keys (one_pass_ms etc.), and a per-row error stub
+        # WITHOUT live:false (the gspmd row) rides inside an otherwise
+        # good sweep by design.
+        if rec.get("live") is False:
+            sys.exit(1)
+        ok = True
+sys.exit(0 if ok else 1)
+PY
+}
 
 # stdout ONLY goes through tee into the artifact (stderr stays on the
 # console/session log — backend warnings must never land inside a
@@ -33,7 +66,8 @@ cap() {   # cap <outfile> <label> <cmd...>: install output on success only
   local tmp; tmp="$(mktemp)"
   "$@" | tee "$tmp"
   local rc=${PIPESTATUS[0]}
-  if [ "$rc" -eq 0 ] && [ -s "$tmp" ]; then mv "$tmp" "$out"
+  if [ "$rc" -eq 0 ] && [ -s "$tmp" ] && ok_capture "$out" "$tmp"; then
+    mv "$tmp" "$out"; REFRESHED+=("$out")
   else rm -f "$tmp"; fi
   note "$rc" "$label"
 }
@@ -42,7 +76,9 @@ capa() {  # capa <outfile> <label> <cmd...>: append on success only
   local tmp; tmp="$(mktemp)"
   "$@" | tee "$tmp"
   local rc=${PIPESTATUS[0]}
-  if [ "$rc" -eq 0 ] && [ -s "$tmp" ]; then cat "$tmp" >> "$out"; fi
+  if [ "$rc" -eq 0 ] && [ -s "$tmp" ] && ok_capture "$out" "$tmp"; then
+    cat "$tmp" >> "$out"; REFRESHED+=("$out")
+  fi
   rm -f "$tmp"
   note "$rc" "$label"
 }
@@ -185,6 +221,21 @@ print("trace done")
 PY
 cap "$OUT/trace_summary.txt" trace_summary \
     python tools/xplane_summary.py "$OUT/trace"
+
+# -- refresh summary (ROADMAP item 5): the full-suite auto-capture -----
+# Every tunnel window that got this far refreshed its captures above;
+# COMMITTING them is what makes bench_common.last_known able to cite
+# this window after the tunnel dies again — only git-tracked captures
+# count. Deduplicate (capa appends touch the same file repeatedly).
+if [ ${#REFRESHED[@]} -gt 0 ]; then
+  UNIQ=$(printf '%s\n' "${REFRESHED[@]}" | sort -u)
+  echo "== refreshed captures this window =="
+  printf '  %s\n' $UNIQ
+  echo "commit them so the last_known fallback can cite this window:"
+  echo "  git add $(echo $UNIQ | tr '\n' ' ')"
+else
+  echo "== no captures refreshed (nothing installable this window) =="
+fi
 
 if [ ${#FAILED[@]} -gt 0 ]; then
   echo "== session FINISHED WITH FAILURES: ${FAILED[*]}; artifacts in $OUT =="

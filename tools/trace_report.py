@@ -28,35 +28,22 @@ corruption anywhere earlier raises.
 import argparse
 import json
 
+# the one torn-line-tolerant loader lives in telemetry_report (both
+# tools stay framework-import-free); sys.path[0] is tools/ when run as
+# a program, the repo root when imported as a package module
+try:
+    from tools.telemetry_report import load_jsonl
+except ImportError:
+    from telemetry_report import load_jsonl
+
 SCHEMA_VERSION = 1
 
 
 def load(path):
     """Parse one spill file into a record list (torn final line
-    tolerated, unknown schema refused — mirrors
-    tools/telemetry_report.py:load)."""
-    with open(path) as f:
-        lines = [ln.strip() for ln in f]
-    while lines and not lines[-1]:
-        lines.pop()
-    records = []
-    for i, line in enumerate(lines):
-        if not line:
-            continue
-        try:
-            rec = json.loads(line)
-        except ValueError:
-            if i == len(lines) - 1:
-                break            # torn final line: the crash signature
-            raise ValueError("%s:%d: corrupt trace record"
-                             % (path, i + 1))
-        v = rec.get("v")
-        if v != SCHEMA_VERSION:
-            raise ValueError(
-                "%s:%d: trace schema v%r, this reader understands v%d"
-                % (path, i + 1, v, SCHEMA_VERSION))
-        records.append(rec)
-    return records
+    tolerated, unknown schema refused — the shared
+    telemetry_report.load_jsonl contract)."""
+    return load_jsonl(path, schema=SCHEMA_VERSION, what="trace record")
 
 
 def merge(paths):
