@@ -1,0 +1,156 @@
+"""Decode KV-cache HBM A/B: bf16 vs int8 (quantize_kv) caches through
+the per-row continuous-batching path (mxnet_tpu/serve/decode.py).
+
+Why: decode is bandwidth-bound and the KV cache is its dominant HBM
+stream — re-read every step while each weight is read once
+(ops/attention.py cached_attention). The int8 cache + per-token f32
+scales cut bytes per slot to ~0.52x bf16 at hd=128, which directly
+raises ContinuousDecoder slots per chip. This bench measures both
+sides of that trade at the serve path's real shape: decode step ms
+and tokens/s through a slot pool with turnover (A/B at identical
+pool geometry), bytes per slot from the cache pytree, and how many
+slots each variant fits under an HBM budget.
+
+    python benchmark/bench_decode.py           # or BENCH_PLATFORM=cpu
+    BENCH_DECODE_SMOKE=1 ...                   # tiny shape for tests
+
+One BENCH-style JSON line (bench_common fail_payload/last_known
+contract on every failure path, SIGTERM death stub armed): value =
+int8-cache tokens/s, vs_baseline = int8/bf16 throughput ratio, with
+per-variant sub-objects and the bytes/step ratios the acceptance
+criteria read.
+"""
+import json
+import os
+import sys
+import time
+
+_platform = os.environ.get("BENCH_PLATFORM")
+if _platform:
+    os.environ["JAX_PLATFORMS"] = _platform
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_REPO = os.path.dirname(_HERE)
+sys.path.insert(0, _REPO)
+
+from bench_common import fail_payload, install_death_stub  # noqa: E402
+
+METRIC = "decode_kv_ab"
+UNIT = "tokens/s"
+
+# hd = DIM // HEADS stays 128 in both shapes — the bytes math the
+# acceptance criterion quotes (int8+scales = 264 B vs bf16 = 512 B
+# per token per kv head) is an hd=128 statement
+if os.environ.get("BENCH_DECODE_SMOKE") == "1":
+    V, LAYERS, HEADS, DIM = 64, 1, 2, 256
+    MAXLEN, PROMPT, MAXNEW, SLOTS = 64, 16, 6, 2
+else:
+    V = int(os.environ.get("BENCH_DECODE_VOCAB", "512"))
+    LAYERS = int(os.environ.get("BENCH_DECODE_LAYERS", "2"))
+    HEADS = int(os.environ.get("BENCH_DECODE_HEADS", "4"))
+    DIM = int(os.environ.get("BENCH_DECODE_DIM", "512"))
+    MAXLEN = int(os.environ.get("BENCH_DECODE_MAXLEN", "512"))
+    PROMPT = int(os.environ.get("BENCH_DECODE_PROMPT", "256"))
+    MAXNEW = int(os.environ.get("BENCH_DECODE_MAXNEW", "32"))
+    SLOTS = int(os.environ.get("BENCH_DECODE_SLOTS", "4"))
+REQUESTS = 2 * SLOTS      # two waves: every request is a slot turnover
+BUDGET = float(os.environ.get("BENCH_DECODE_HBM_BUDGET", "16e9"))
+
+
+def _params():
+    """Random weights at the bench shape (numerics are irrelevant to a
+    bandwidth A/B; training a checkpoint here would dominate runtime)."""
+    import numpy as np
+
+    from mxnet_tpu.models import transformer
+    sym = transformer.get_symbol(V, 8, num_layers=LAYERS,
+                                 num_heads=HEADS, dim=DIM,
+                                 max_len=MAXLEN)
+    shapes, _, _ = sym.infer_shape(data=(2, 8), softmax_label=(2, 8))
+    rng = np.random.RandomState(0)
+    return {name: (0.02 * rng.standard_normal(shp)).astype(np.float32)
+            for name, shp in zip(sym.list_arguments(), shapes)
+            if name not in ("data", "softmax_label")}
+
+
+def run_variant(params, quantize_kv):
+    import numpy as np
+
+    from mxnet_tpu.generation import Generator
+    gen = Generator(params, V, MAXLEN, num_layers=LAYERS,
+                    num_heads=HEADS, dim=DIM, batch_size=SLOTS,
+                    dtype="bfloat16", quantize_kv=quantize_kv)
+    bytes_per_slot = gen.kv_cache_bytes() // SLOTS
+    rng = np.random.RandomState(7)
+    prompts = [rng.randint(0, V, (PROMPT,)) for _ in range(REQUESTS)]
+
+    with gen.serving_decoder() as dec:
+        # warm at the measured prompt length: compiles the prefill
+        # bucket AND the (B, 1) per-row step before the clock starts
+        dec.submit(prompts[0], 2).result(600.0)
+
+        def wave(n_new):
+            st0 = dec.stats()
+            t0 = time.time()
+            futs = [dec.submit(p, n_new) for p in prompts]
+            for f in futs:
+                f.result(600.0)
+            elapsed = time.time() - t0
+            st1 = dec.stats()
+            return (elapsed, st1["steps"] - st0["steps"],
+                    st1["prefills"] - st0["prefills"],
+                    REQUESTS * n_new)
+
+        # decode step time by DIFFERENCING two waves that differ only
+        # in max_new: prefill forwards and queue/admission overhead
+        # appear identically in both and cancel, so step_ms measures
+        # the (B, 1) per-row step alone (the bench.py --decode
+        # marginal-rate methodology)
+        short = max(2, MAXNEW // 4)
+        e1, s1, _p1, tok1 = wave(short)
+        e2, s2, p2, tok2 = wave(MAXNEW)
+    if e2 - e1 <= 0 or s2 - s1 <= 0:
+        # degenerate differencing window (tiny smoke shapes, where
+        # admission overhead swamps the wave delta): fall back to the
+        # whole long wave rather than report a jitter artifact
+        d_elapsed, d_steps, d_tokens = e2, s2, tok2
+    else:
+        d_elapsed, d_steps, d_tokens = e2 - e1, s2 - s1, tok2 - tok1
+    return {"tokens_s": round(d_tokens / d_elapsed, 1),
+            "end_to_end_tokens_s": round(tok2 / e2, 1),
+            "step_ms": round(1e3 * d_elapsed / d_steps, 3),
+            "steps": s2,
+            "prefills": p2,
+            "bytes_per_slot": bytes_per_slot,
+            "slots_in_budget": int(BUDGET // bytes_per_slot)}
+
+
+def main():
+    install_death_stub(METRIC, UNIT)
+    import jax
+    try:
+        params = _params()
+        bf16 = run_variant(params, quantize_kv=False)
+        q8 = run_variant(params, quantize_kv=True)
+        rec = {"metric": METRIC, "unit": UNIT,
+               "value": q8["tokens_s"], "live": True,
+               "vs_baseline": round(q8["tokens_s"] / bf16["tokens_s"],
+                                    3),
+               "device_kind": jax.devices()[0].device_kind,
+               "hd": DIM // HEADS, "layers": LAYERS,
+               "max_len": MAXLEN, "prompt": PROMPT,
+               "max_new": MAXNEW, "slots": SLOTS,
+               "requests": REQUESTS, "hbm_budget": BUDGET,
+               "bf16": bf16, "int8": q8,
+               "bytes_ratio": round(q8["bytes_per_slot"]
+                                    / bf16["bytes_per_slot"], 4),
+               "step_ms_ratio": round(q8["step_ms"] / bf16["step_ms"],
+                                      3)}
+        print(json.dumps(rec))
+    except Exception as e:  # noqa: BLE001 — one parseable line always
+        print(json.dumps(fail_payload(METRIC, UNIT, e)))
+        sys.exit(1)
+
+
+if __name__ == "__main__":
+    main()

@@ -200,6 +200,14 @@ define("MXNET_SERVE_QUEUE_CAP", int, 128,
        "serving admission bound: requests queued beyond this are shed "
        "with the typed Overloaded error (fast-fail backpressure — "
        "never a silent drop, never an unbounded queue)")
+define("MXNET_DECODE_SLOTS", str, "",
+       "decode slot-pool sizing hint: 'auto' logs a "
+       "ContinuousDecoder.describe() report at construction — cache "
+       "bytes per slot (int8 + per-token scales under quantize_kv) "
+       "and how many slots fit the device's reported HBM limit at "
+       "the configured max_len; 'auto:<bytes>' sizes against an "
+       "explicit budget (e.g. auto:16e9). Empty = no report; the "
+       "serve.decode.kv_bytes_per_slot gauge is published either way")
 define("MXNET_SERVE_DEADLINE_MS", float, 0.0,
        "default per-request serving deadline: a request still queued "
        "past it fails with the typed RequestTimeout instead of "

@@ -19,6 +19,7 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
+from . import telemetry as _telemetry
 from .executor import _graph_eval_fn
 from .models import transformer
 
@@ -163,6 +164,40 @@ class Generator:
         # halves decode's dominant HBM stream (the cache is re-read
         # every step; each weight only once)
         self._quantize_kv = bool(quantize_kv)
+        # static sizing gauge: bytes of KV-cache state one batch row
+        # (= one serving slot) owns across the whole aux pytree —
+        # ContinuousDecoder re-publishes the same gauge from its live
+        # pool, and the MXNET_DECODE_SLOTS sizing hint divides an HBM
+        # budget by it (shape math only, no allocation)
+        _telemetry.gauge("serve.decode.kv_bytes_per_slot").set(
+            self.kv_cache_bytes() // self.batch_size)
+
+    def _aux_spec(self, name):
+        """(shape, dtype) of one KV-cache aux state — THE single
+        classification both _fresh_aux (allocation) and
+        kv_cache_bytes (sizing) read, so the gauge/slot math can
+        never drift from what is actually allocated."""
+        if name.endswith(("_k_scale", "_v_scale")):
+            # per-token dequant scales for the int8 caches
+            return self._cache_shape[:3], jnp.dtype(jnp.float32)
+        if self._quantize_kv:
+            return self._cache_shape, jnp.dtype(jnp.int8)
+        return self._cache_shape, jnp.dtype(self._cache_dtype)
+
+    def kv_cache_bytes(self):
+        """Total bytes of the KV-cache aux pytree (every layer's k/v
+        caches, plus their per-token f32 scale caches under
+        quantize_kv) at this Generator's (batch_size, max_len) —
+        computed from shapes/dtypes alone. Divide by batch_size for
+        bytes per serving slot."""
+        total = 0
+        for name in self._sym.list_auxiliary_states():
+            shape, dtype = self._aux_spec(name)
+            n = 1
+            for d in shape:
+                n *= int(d)
+            total += n * dtype.itemsize
+        return total
 
     @staticmethod
     def _check_sampling(temperature, top_k, top_p):
@@ -207,16 +242,10 @@ class Generator:
     def _fresh_aux(self):
         aux = {}
         for name in self._sym.list_auxiliary_states():
-            if name.endswith(("_k_scale", "_v_scale")):
-                # per-token dequant scales for the int8 caches
-                z = jnp.zeros(self._cache_shape[:3], jnp.float32)
-                shard = self._scale_sharding
-            elif self._quantize_kv:
-                z = jnp.zeros(self._cache_shape, jnp.int8)
-                shard = self._cache_sharding
-            else:
-                z = jnp.zeros(self._cache_shape, self._cache_dtype)
-                shard = self._cache_sharding
+            shape, dtype = self._aux_spec(name)
+            z = jnp.zeros(shape, dtype)
+            shard = self._scale_sharding if len(shape) == 3 \
+                else self._cache_sharding
             if shard is not None:
                 z = jax.device_put(z, shard)
             aux[name] = z

@@ -252,6 +252,9 @@ def get_decode_symbol(vocab_size, max_len, num_layers=2, num_heads=4,
     (mxnet_tpu/serve/decode.py) retire a finished sequence and admit a
     queued prompt without draining the whole batch. Parameter names
     are unchanged, so the same checkpoint binds both variants.
+    Composes with kv_quantize (the int8-cache op has a per-row scatter
+    for both the int8 rows and their f32 scale rows); rolling_cache
+    remains shared-position only.
 
     New TPU-native capability (the 2017 reference's decode story was
     rnn.RNNCell step-wise unrolling); mxnet_tpu.generation.Generator
@@ -272,10 +275,6 @@ def get_decode_symbol(vocab_size, max_len, num_layers=2, num_heads=4,
         raise ValueError("per_row_pos is not supported with "
                          "rolling_cache (the circular-buffer op has "
                          "no per-row-position variant)")
-    if per_row_pos and kv_quantize:
-        raise ValueError("per_row_pos is not supported with "
-                         "kv_quantize (the int8-cache op has no "
-                         "per-row-position variant)")
     data = sym.Variable("data")
     positions = sym.Variable("positions")
     cache_pos = sym.Variable("cache_pos") if per_row_pos \

@@ -880,6 +880,17 @@ def _cached_attention_op(query, key, value, k_cache, v_cache, pos,
                             scale=scale, window=int(window or 0))
 
 
+def _q8_quantize(x):
+    """Per-token-per-head symmetric int8: absmax/127 scale over the
+    head dim. The 1e-8 clamp stores an all-zero k/v row as zeros, not
+    NaNs. Shared by the shared-position and per-row cache writers so
+    both paths store BIT-IDENTICAL cache entries for the same row."""
+    xf = x.astype(jnp.float32)
+    s = jnp.maximum(jnp.max(jnp.abs(xf), axis=-1), 1e-8) / 127.0
+    q = jnp.round(xf / s[..., None]).astype(jnp.int8)
+    return q, s
+
+
 def cached_attention_q8(query, key, value, k_cache, v_cache, k_scale,
                         v_scale, pos, scale=None, window=0):
     """cached_attention with INT8 caches — the KV-bandwidth half of
@@ -897,6 +908,14 @@ def cached_attention_q8(query, key, value, k_cache, v_cache, k_scale,
     at hd=128). Scales clamp at 1e-8: an all-zero k/v row stores
     zeros, not NaNs.
 
+    PER-ROW POSITIONS (continuous batching): like cached_attention,
+    pos may be (B,) — row b's new int8 rows AND its f32 scale rows
+    land at pb[b], and its causal/window mask reads against pb[b].
+    This is what lets the serving slot pool run int8 caches: one
+    compiled (B, 1) step whatever depths the slots sit at
+    (mxnet_tpu/serve/decode.py). A (1,) pos keeps the shared-position
+    path below bit-for-bit.
+
     Same capacity contract and GQA grouping as cached_attention.
     Returns (out, k_cache, v_cache, k_scale, v_scale)."""
     B, H, Tn, D = query.shape
@@ -908,6 +927,15 @@ def cached_attention_q8(query, key, value, k_cache, v_cache, k_scale,
     G = H // Hkv
     if scale is None:
         scale = D ** -0.5
+    pos = jnp.asarray(pos)
+    if pos.ndim >= 1 and pos.size > 1:
+        if pos.size != B:
+            raise ValueError(
+                "per-row pos must have one entry per batch row: got "
+                "%r for batch %d" % (pos.shape, B))
+        return _cached_attention_q8_per_row(
+            query, key, value, k_cache, v_cache, k_scale, v_scale,
+            jnp.reshape(pos, (B,)), float(scale), int(window or 0))
     p0 = jnp.reshape(pos, ()).astype(jnp.int32)
     if not isinstance(p0, jax.core.Tracer) and \
             int(p0) + Tn > k_cache.shape[2]:
@@ -916,14 +944,8 @@ def cached_attention_q8(query, key, value, k_cache, v_cache, k_scale,
             "exceeds cache capacity Tmax=%d"
             % (int(p0), Tn, k_cache.shape[2]))
 
-    def quantize(x):
-        xf = x.astype(jnp.float32)
-        s = jnp.maximum(jnp.max(jnp.abs(xf), axis=-1), 1e-8) / 127.0
-        q = jnp.round(xf / s[..., None]).astype(jnp.int8)
-        return q, s
-
-    kq, ks = quantize(key)
-    vq, vs = quantize(value)
+    kq, ks = _q8_quantize(key)
+    vq, vs = _q8_quantize(value)
     k_cache = jax.lax.dynamic_update_slice(k_cache, kq, (0, 0, p0, 0))
     v_cache = jax.lax.dynamic_update_slice(v_cache, vq, (0, 0, p0, 0))
     k_scale = jax.lax.dynamic_update_slice(k_scale, ks, (0, 0, p0))
@@ -942,6 +964,64 @@ def cached_attention_q8(query, key, value, k_cache, v_cache, k_scale,
     if window:
         valid = valid & (p0 + rows - cols < window)
     s = jnp.where(valid, s, _NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    out = jnp.einsum("bhgqk,bhkd->bhgqd", p, vf,
+                     precision=jax.lax.Precision.DEFAULT)
+    return (out.reshape(B, H, Tn, D).astype(query.dtype),
+            k_cache, v_cache, k_scale, v_scale)
+
+
+def _cached_attention_q8_per_row(query, key, value, k_cache, v_cache,
+                                 k_scale, v_scale, pb, scale, window):
+    """cached_attention_q8's per-row-position core: the int8 k/v rows
+    AND their per-token f32 scale rows scatter at each row's own
+    offset (vmapped dynamic_update_slice — one per-row start index
+    each), and each row masks against its own position. Quantization
+    is _q8_quantize, the exact shared-path rule, so the stored cache
+    entry for a row is independent of which path wrote it. Same
+    capacity contract as the scalar path, enforced per row."""
+    B, H, Tn, D = query.shape
+    Hkv = k_cache.shape[1]
+    G = H // Hkv
+    C = k_cache.shape[2]
+    pb = pb.astype(jnp.int32)
+    if not isinstance(pb, jax.core.Tracer):
+        import numpy as _np
+        worst = int(_np.asarray(pb).max())
+        if worst + Tn > C:
+            raise ValueError(
+                "cached_attention_q8 overrun: row pos (%d) + Tnew "
+                "(%d) exceeds cache capacity Tmax=%d" % (worst, Tn, C))
+
+    kq, ks = _q8_quantize(key)       # (B, Hkv, Tn, D), (B, Hkv, Tn)
+    vq, vs = _q8_quantize(value)
+
+    def _upd(cache, new, p):
+        return jax.lax.dynamic_update_slice(cache, new, (0, p, 0))
+
+    def _upd_scale(cache, new, p):
+        return jax.lax.dynamic_update_slice(cache, new, (0, p))
+
+    k_cache = jax.vmap(_upd)(k_cache, kq, pb)
+    v_cache = jax.vmap(_upd)(v_cache, vq, pb)
+    k_scale = jax.vmap(_upd_scale)(k_scale, ks, pb)
+    v_scale = jax.vmap(_upd_scale)(v_scale, vs, pb)
+
+    # dequantized views — producers XLA fuses into the einsum reads,
+    # same formulation as the shared-position path
+    kf = k_cache.astype(jnp.float32) * k_scale[..., None]
+    vf = v_cache.astype(jnp.float32) * v_scale[..., None]
+    qg = query.reshape(B, Hkv, G, Tn, D)
+    s = jnp.einsum("bhgqd,bhkd->bhgqk", qg.astype(jnp.float32), kf,
+                   precision=jax.lax.Precision.DEFAULT,
+                   preferred_element_type=jnp.float32) * scale
+    cols = jnp.arange(C)[None, None, :]            # (1, 1, C)
+    rows = jnp.arange(Tn)[None, :, None]           # (1, Tn, 1)
+    prow = pb[:, None, None]                       # (B, 1, 1)
+    valid = cols <= prow + rows                    # (B, Tn, C)
+    if window:
+        valid = valid & (prow + rows - cols < window)
+    s = jnp.where(valid[:, None, None], s, _NEG_INF)
     p = jax.nn.softmax(s, axis=-1)
     out = jnp.einsum("bhgqk,bhkd->bhgqd", p, vf,
                      precision=jax.lax.Precision.DEFAULT)

@@ -13,12 +13,21 @@ longest request in flight.
 
 What makes the single compiled step possible is the per-row-position
 decode graph (``get_decode_symbol(per_row_pos=True)`` →
-``cached_attention`` with a (B,) ``pos``): every slot decodes at its
+``cached_attention`` — or ``cached_attention_q8`` under
+``quantize_kv`` — with a (B,) ``pos``): every slot decodes at its
 own depth inside ONE (B, 1) XLA program, so slot membership changes
 never recompile. Prompt admission reuses the Generator's ordinary
 shared-position prefill (all admitted rows start at position 0) and
 merges the prefilled cache rows into the pool with a batch-axis
-scatter.
+scatter — under ``quantize_kv`` that merge carries the per-token f32
+scale caches alongside the int8 rows.
+
+Decode is bandwidth-bound and the KV cache is its dominant HBM
+stream (re-read every step; each weight read once), so an int8 cache
+(``Generator(quantize_kv=True)``) roughly halves the bytes a slot
+pins in HBM — which directly raises how many slots fit a chip. The
+``serve.decode.kv_bytes_per_slot`` gauge and :meth:`describe` /
+``MXNET_DECODE_SLOTS=auto`` report the sizing math.
 
 Exactness contract: greedy decode (temperature 0) emits token-for-token
 what ``Generator.generate`` emits for the same prompt — the per-row
@@ -42,6 +51,7 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
+from .. import config as _config
 from .. import telemetry as _telemetry
 from .. import trace as _trace
 from ..executor import _graph_eval_fn
@@ -123,17 +133,21 @@ class ContinuousDecoder:
     drains: admitted sequences finish, new submissions raise
     ``EngineClosed``.
 
-    Not supported: rolling caches and int8 KV caches (the per-row
-    position op has no variant for either — the Generator raises at
-    construction here, not mid-request)."""
+    Int8 KV caches (``Generator(quantize_kv=True)``) are supported:
+    the per-row op scatters the int8 rows and their per-token f32
+    scale rows at each slot's own depth, halving cache bytes per slot.
+    Not supported: rolling caches (the circular-buffer op has no
+    per-row-position variant — raised at construction here, not
+    mid-request)."""
 
     def __init__(self, generator, queue_cap=64, logger=None):
         if getattr(generator, "_rolling", False):
-            raise ValueError("continuous batching does not support "
-                             "rolling caches")
-        if getattr(generator, "_quantize_kv", False):
-            raise ValueError("continuous batching does not support "
-                             "int8 KV caches (quantize_kv)")
+            raise ValueError(
+                "continuous batching does not support rolling caches "
+                "(the circular-buffer op has no per-row-position "
+                "variant; quantize_kv int8 caches ARE supported — "
+                "drop rolling_cache and size max_len to prompt + "
+                "max_new_tokens instead)")
         self._gen = generator
         self._B = int(generator.batch_size)
         self._log = logger or logging.getLogger(__name__)
@@ -169,6 +183,20 @@ class ContinuousDecoder:
         self._steps = 0
         self._prefills = 0
         self._g_active = _telemetry.gauge("serve.decode.active_slots")
+        # pool-measured twin of the Generator's static sizing gauge:
+        # actual device-array bytes of the live cache pytree per slot.
+        # Re-published every step (the gauge is last-write-wins and
+        # any OTHER Generator construction — a speculative draft, a
+        # second model — overwrites it with ITS static figure; the
+        # live pool must win while it is serving)
+        self._kv_bytes_per_slot = sum(
+            int(v.nbytes) for v in self._aux.values()) // self._B
+        self._g_kv = _telemetry.gauge("serve.decode.kv_bytes_per_slot")
+        self._g_kv.set(self._kv_bytes_per_slot)
+        # one compiled (B, 1) executable across slot turnover is THE
+        # property continuous batching exists for; the gauge feeds the
+        # decode/decode_q8 perf-gate fingerprints
+        self._g_jit = _telemetry.gauge("serve.decode.jit_cache_size")
         self._h_slotfill = _telemetry.histogram(
             "serve.decode.slot_fill", buckets=_telemetry.COUNT_BUCKETS)
         self._h_req = _telemetry.histogram("serve.decode.request_ms")
@@ -176,9 +204,76 @@ class ContinuousDecoder:
         self._c_finished = _telemetry.counter("serve.decode.finished")
         self._c_steps = _telemetry.counter("serve.decode.steps")
 
+        slots_hint = str(_config.get("MXNET_DECODE_SLOTS") or "")
+        if slots_hint and not slots_hint.startswith("auto"):
+            raise ValueError(
+                "MXNET_DECODE_SLOTS=%r: supported forms are '' (off), "
+                "'auto' (report against the device HBM limit) or "
+                "'auto:<bytes>' — the pool width itself is the "
+                "Generator's batch_size, not this knob" % (slots_hint,))
+        if slots_hint:
+            budget = None
+            if ":" in slots_hint:
+                raw = slots_hint.split(":", 1)[1]
+                try:
+                    budget = float(raw)
+                except ValueError:
+                    budget = float("nan")
+                import math
+                if not (math.isfinite(budget) and budget > 0):
+                    raise ValueError(
+                        "MXNET_DECODE_SLOTS=%r: the budget after "
+                        "'auto:' must be a positive finite number of "
+                        "bytes (e.g. auto:16e9), got %r"
+                        % (slots_hint, raw))
+            self._log.info("decode slot sizing\n%s",
+                           self.describe(hbm_budget=budget))
+
         self._thread = threading.Thread(
             target=self._loop, name="mxnet-serve-decode", daemon=True)
         self._thread.start()
+
+    def describe(self, hbm_budget=None):
+        """SpecLayout.describe()-style sizing report: pool geometry,
+        cache bytes per slot (int8 rows + f32 scale rows under
+        quantize_kv), and — given an HBM budget in bytes — how many
+        slots would fit at the configured max_len. hbm_budget=None
+        tries the device's reported bytes_limit
+        (``MXNET_DECODE_SLOTS=auto:<bytes>`` passes one explicitly).
+        The budget math covers CACHE state only; weights and
+        activations claim their share of HBM on top."""
+        gen = self._gen
+        bps = self._kv_bytes_per_slot
+        kind = "int8 + f32 per-token scales" if gen._quantize_kv \
+            else str(jnp.dtype(gen._cache_dtype))
+        lines = [
+            "ContinuousDecoder pool: %d slot(s), max_len=%d, "
+            "%d layer(s)" % (self._B, gen.max_len,
+                             gen.num_layers),
+            "  cache rows: %s   (%s)" % (
+                "x".join(str(d) for d in gen._cache_shape[1:]), kind),
+            "  kv_bytes_per_slot: %d (%.2f MiB)  pool total: %.2f MiB"
+            % (bps, bps / 2 ** 20, bps * self._B / 2 ** 20),
+        ]
+        if hbm_budget is None:
+            try:
+                stats = jax.local_devices()[0].memory_stats() or {}
+                hbm_budget = float(stats.get("bytes_limit") or 0) \
+                    or None
+            except Exception:  # noqa: BLE001 — backends may not report
+                hbm_budget = None
+        if hbm_budget:
+            fit = int(hbm_budget // bps) if bps else 0
+            lines.append(
+                "  HBM budget %.2f GiB -> %d slot(s) fit at "
+                "max_len=%d (cache bytes only; weights/activations "
+                "not counted)" % (hbm_budget / 2 ** 30, fit,
+                                  gen.max_len))
+        else:
+            lines.append(
+                "  no HBM budget known (backend reports no "
+                "bytes_limit) — set MXNET_DECODE_SLOTS=auto:<bytes>")
+        return "\n".join(lines)
 
     # -- admission ----------------------------------------------------------
     def submit(self, prompt, max_new_tokens, eos_id=None,
@@ -239,7 +334,10 @@ class ContinuousDecoder:
         prefill per distinct prompt length per round (all admitted rows
         start at position 0, so the Generator's ordinary prefill graph
         serves); cache rows merge into the pool by a batch-axis
-        scatter."""
+        scatter that walks the WHOLE aux pytree — under quantize_kv
+        that carries the per-token f32 scale caches alongside the
+        int8 k/v rows (a merged row without its scales would dequant
+        to garbage)."""
         with self._lock:
             free = self._free_slots()
             if not free or not self._queue:
@@ -330,6 +428,12 @@ class ContinuousDecoder:
         self._c_steps.inc()
         self._h_slotfill.observe(len(active))
         self._g_active.set(len(active))
+        cache_size = getattr(self._step_fn, "_cache_size", None)
+        if cache_size is not None:
+            # stays 1 across slot turnover — admissions must never
+            # recompile the (B, 1) step (gate-fingerprinted)
+            self._g_jit.set(cache_size())
+        self._g_kv.set(self._kv_bytes_per_slot)   # live pool wins
         for i in active:
             req = self._slots[i]
             req.n_cached += 1

@@ -23,10 +23,11 @@ pytestmark = pytest.mark.serve
 V, L, H, DIM, T, B = 50, 2, 2, 32, 24, 3
 
 
-def _params(pos_encoding="learned", seed=0):
+def _params(pos_encoding="learned", seed=0, num_kv_heads=None):
     sym = transformer.get_symbol(V, 12, num_layers=L, num_heads=H,
                                  dim=DIM, max_len=T,
-                                 pos_encoding=pos_encoding)
+                                 pos_encoding=pos_encoding,
+                                 num_kv_heads=num_kv_heads)
     step = make_train_step(sym, optimizer="sgd")
     mx.random.seed(seed)
     state = step.init_state(Xavier(), {"data": (2, 12),
@@ -162,14 +163,205 @@ class TestContract:
         with pytest.raises(EngineClosed):
             dec.submit(np.arange(4), 2)
 
-    def test_unsupported_cache_variants_raise(self, params):
-        quant = Generator(params, V, T, num_layers=L, num_heads=H,
-                          dim=DIM, batch_size=B, quantize_kv=True)
-        with pytest.raises(ValueError, match="int8 KV"):
-            quant.serving_decoder()
+    def test_rolling_cache_still_refused(self, params):
+        """Rolling caches remain shared-position only — and the
+        refusal must now name quantize_kv as supported (the contract
+        text changed when the int8 per-row op landed)."""
+        rolling = Generator(params, V, T, num_layers=L, num_heads=H,
+                            dim=DIM, batch_size=B, rolling_cache=True,
+                            attention_window=8)
+        with pytest.raises(ValueError, match="rolling") as e:
+            rolling.serving_decoder()
+        assert "quantize_kv" in str(e.value)
 
     def test_sampling_contract_checked_at_submit(self, params):
         pool = _gen(params, B)
         with pool.serving_decoder() as dec:
             with pytest.raises(ValueError, match="temperature"):
                 dec.submit(np.arange(4), 2, top_k=3)
+
+
+def _q8_shared_reference(q, k, v, kc, vc, ks, vs, p0, scale=None,
+                         window=0):
+    """Pinned copy of the pre-per-row shared-position
+    cached_attention_q8 math. The (1,)-pos path of the live op must
+    stay BITWISE equal to this forever — the per-row dispatch may
+    never reroute or perturb the shared fast path."""
+    import jax
+    import jax.numpy as jnp
+    B_, H_, Tn, D_ = q.shape
+    Hkv = kc.shape[1]
+    G = H_ // Hkv
+    if scale is None:
+        scale = D_ ** -0.5
+    p0 = jnp.reshape(jnp.asarray(p0), ()).astype(jnp.int32)
+
+    def quantize(x):
+        xf = x.astype(jnp.float32)
+        s = jnp.maximum(jnp.max(jnp.abs(xf), axis=-1), 1e-8) / 127.0
+        return jnp.round(xf / s[..., None]).astype(jnp.int8), s
+
+    kq, kss = quantize(k)
+    vq, vss = quantize(v)
+    kc = jax.lax.dynamic_update_slice(kc, kq, (0, 0, p0, 0))
+    vc = jax.lax.dynamic_update_slice(vc, vq, (0, 0, p0, 0))
+    ks = jax.lax.dynamic_update_slice(ks, kss, (0, 0, p0))
+    vs = jax.lax.dynamic_update_slice(vs, vss, (0, 0, p0))
+    kf = kc.astype(jnp.float32) * ks[..., None]
+    vf = vc.astype(jnp.float32) * vs[..., None]
+    qg = q.reshape(B_, Hkv, G, Tn, D_)
+    s = jnp.einsum("bhgqd,bhkd->bhgqk", qg.astype(jnp.float32), kf,
+                   precision=jax.lax.Precision.DEFAULT,
+                   preferred_element_type=jnp.float32) * scale
+    cols = jnp.arange(kc.shape[2])[None, :]
+    rows = jnp.arange(Tn)[:, None]
+    valid = cols <= p0 + rows
+    if window:
+        valid = valid & (p0 + rows - cols < window)
+    s = jnp.where(valid, s, -1e30)
+    p = jax.nn.softmax(s, axis=-1)
+    out = jnp.einsum("bhgqk,bhkd->bhgqd", p, vf,
+                     precision=jax.lax.Precision.DEFAULT)
+    return (out.reshape(B_, H_, Tn, D_).astype(q.dtype),
+            kc, vc, ks, vs)
+
+
+class TestQuantizedKV:
+    """Int8 KV caches through the per-row continuous-batching path
+    (PR 13 tentpole): ragged pool decode == batch_size=1 quantized
+    generate, scale caches ride the prefill merge, GQA grouping
+    holds, and the shared-position op is bitwise untouched."""
+
+    def test_greedy_q8_matches_batch1_quantized_ragged(self, params):
+        """ACCEPTANCE: ragged greedy decode under quantize_kv=True
+        matches batch_size=1 quantized Generator.generate
+        token-for-token, with slot turnover exercised."""
+        pool = _gen(params, B, quantize_kv=True)
+        single = _gen(params, 1, quantize_kv=True)
+        rng = np.random.RandomState(23)
+        prompts = [rng.randint(0, V, (p,)) for p in
+                   (4, 6, 4, 5, 4, 7)]
+        maxnew = [8, 3, 12, 5, 2, 6]
+        with pool.serving_decoder() as dec:
+            futs = [dec.submit(p, n, eos_id=0)
+                    for p, n in zip(prompts, maxnew)]
+            got = [f.result(120.0) for f in futs]
+            st = dec.stats()
+        for i, (p, n) in enumerate(zip(prompts, maxnew)):
+            want = single.generate(p[None], n, eos_id=0)[0]
+            np.testing.assert_array_equal(got[i], want)
+        assert st["finished"] == len(prompts) > B   # slot turnover
+
+    def test_q8_gqa_head_grouping(self):
+        """GQA + int8: the per-row q8 op groups q heads over the
+        (fewer) cached kv heads exactly like the shared path."""
+        params = _params(seed=6, num_kv_heads=1)
+        pool = Generator(params, V, T, num_layers=L, num_heads=H,
+                         dim=DIM, batch_size=2, num_kv_heads=1,
+                         quantize_kv=True)
+        single = Generator(params, V, T, num_layers=L, num_heads=H,
+                           dim=DIM, batch_size=1, num_kv_heads=1,
+                           quantize_kv=True)
+        rng = np.random.RandomState(29)
+        prompts = [rng.randint(0, V, (p,)) for p in (3, 5, 4)]
+        maxnew = [7, 4, 6]
+        with pool.serving_decoder() as dec:
+            got = [dec.submit(p, n).result(120.0)
+                   for p, n in zip(prompts, maxnew)]
+        for p, n, g in zip(prompts, maxnew, got):
+            np.testing.assert_array_equal(
+                g, single.generate(p[None], n)[0])
+
+    def test_prefill_merge_scatters_scale_rows(self, params):
+        """The batch-axis cache-row merge carries the per-token f32
+        scale caches to the RIGHT slots (a merged int8 row without
+        its scales would dequantize to garbage)."""
+        pool = _gen(params, B, quantize_kv=True)
+        rng = np.random.RandomState(31)
+        pa, pb = rng.randint(0, V, (4,)), rng.randint(0, V, (6,))
+        with pool.serving_decoder() as dec:
+            fa = dec.submit(pa, 3)
+            fb = dec.submit(pb, 3)
+            fa.result(120.0)
+            fb.result(120.0)
+            aux = {k: np.asarray(v) for k, v in dec._aux.items()}
+        for slot, prompt in ((0, pa), (1, pb)):
+            rows = np.stack([prompt] * B).astype(np.float32)
+            _, ref = pool._forward(pool._fresh_aux(), rows, 0)
+            P = len(prompt)
+            for name in aux:
+                want = np.asarray(ref[name])[0]
+                if name.endswith(("_k_scale", "_v_scale")):
+                    np.testing.assert_array_equal(
+                        aux[name][slot, :, :P], want[:, :P])
+                    assert (aux[name][slot, :, :P] > 0).all()
+                else:
+                    np.testing.assert_array_equal(
+                        aux[name][slot, :, :P], want[:, :P])
+
+    def test_q8_shared_pos_bitwise_vs_pinned_reference(self):
+        """(1,)-pos cached_attention_q8 is bitwise the pre-per-row
+        implementation; a (B,) pos with equal entries agrees with it
+        up to einsum association order."""
+        import jax.numpy as jnp
+        from mxnet_tpu.ops.attention import cached_attention_q8
+        rng = np.random.RandomState(37)
+        B_, H_, Hkv, Tn, D_, C = 2, 4, 2, 3, 8, 16
+        q = jnp.asarray(rng.randn(B_, H_, Tn, D_), jnp.float32)
+        k = jnp.asarray(rng.randn(B_, Hkv, Tn, D_), jnp.float32)
+        v = jnp.asarray(rng.randn(B_, Hkv, Tn, D_), jnp.float32)
+        kc = jnp.asarray(rng.randint(-127, 128, (B_, Hkv, C, D_)),
+                         jnp.int8)
+        vc = jnp.asarray(rng.randint(-127, 128, (B_, Hkv, C, D_)),
+                         jnp.int8)
+        ks = jnp.asarray(rng.rand(B_, Hkv, C) + 0.01, jnp.float32)
+        vs = jnp.asarray(rng.rand(B_, Hkv, C) + 0.01, jnp.float32)
+        p0 = 5
+        got = cached_attention_q8(
+            q, k, v, kc, vc, ks, vs, jnp.full((1,), p0, jnp.float32))
+        ref = _q8_shared_reference(q, k, v, kc, vc, ks, vs, p0)
+        for g, r in zip(got, ref):
+            np.testing.assert_array_equal(np.asarray(g), np.asarray(r))
+        per_row = cached_attention_q8(
+            q, k, v, kc, vc, ks, vs,
+            jnp.full((B_,), p0, jnp.float32))
+        for g, r in zip(per_row, ref):
+            np.testing.assert_allclose(
+                np.asarray(g, np.float32), np.asarray(r, np.float32),
+                rtol=1e-6, atol=1e-6)
+
+    def test_per_row_q8_capacity_check(self):
+        import jax.numpy as jnp
+        from mxnet_tpu.ops.attention import cached_attention_q8
+        rng = np.random.RandomState(41)
+        B_, Hkv, Tn, D_, C = 2, 2, 2, 8, 8
+        q = jnp.asarray(rng.randn(B_, Hkv, Tn, D_), jnp.float32)
+        k = v = q
+        kc = vc = jnp.zeros((B_, Hkv, C, D_), jnp.int8)
+        ks = vs = jnp.zeros((B_, Hkv, C), jnp.float32)
+        with pytest.raises(ValueError, match="overrun"):
+            cached_attention_q8(q, k, v, kc, vc, ks, vs,
+                                jnp.asarray([0.0, 7.0]))
+
+    def test_kv_bytes_gauge_and_slot_sizing(self, params):
+        """serve.decode.kv_bytes_per_slot is published by Generator
+        (static) and ContinuousDecoder (live pool, same number), int8
+        caches genuinely shrink it, and describe() turns an HBM
+        budget into a slot count."""
+        from mxnet_tpu import telemetry
+        g = telemetry.gauge("serve.decode.kv_bytes_per_slot")
+        fp32 = _gen(params, B)
+        fp32_bps = fp32.kv_cache_bytes() // B
+        assert g.value == fp32_bps
+        q8 = _gen(params, B, quantize_kv=True)
+        q8_bps = q8.kv_cache_bytes() // B
+        assert g.value == q8_bps
+        assert q8_bps < 0.55 * fp32_bps
+        with q8.serving_decoder() as dec:
+            # the live pool republishes the same figure, measured from
+            # the actual device arrays
+            assert dec._kv_bytes_per_slot == q8_bps
+            assert g.value == q8_bps
+            report = dec.describe(hbm_budget=q8_bps * 10 + 1)
+            assert "kv_bytes_per_slot: %d" % q8_bps in report
+            assert "10 slot(s) fit" in report

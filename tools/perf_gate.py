@@ -249,9 +249,11 @@ def _scn_serve():
                                 telemetry.now_ms() - t0, 3))
 
 
-def _scn_decode():
-    """PR 9 surface: continuous-batching decode, sequential ragged
-    requests so admissions/steps/finishes are exact."""
+def _decode_workload(quantize_kv):
+    """Shared body of the decode scenarios: sequential ragged
+    requests through a 3-slot pool so admissions/steps/finishes are
+    exact and every admission is a slot turnover (the jit-cache gauge
+    must stay at ONE compiled (B, 1) step across them)."""
     import numpy as np
 
     import mxnet_tpu as mx
@@ -270,7 +272,7 @@ def _scn_decode():
     state = step.init_state(Xavier(), {"data": (2, 12),
                                        "softmax_label": (2, 12)})
     gen = Generator(state[0], V, T, num_layers=L, num_heads=H,
-                    dim=DIM, batch_size=3)
+                    dim=DIM, batch_size=3, quantize_kv=quantize_kv)
     with gen.serving_decoder() as dec:
         for length, max_new in ((4, 5), (6, 3), (3, 4)):
             dec.submit(np.arange(length), max_new,
@@ -278,6 +280,19 @@ def _scn_decode():
     telemetry.journal_event("gate.probe",
                             decode_elapsed_ms=round(
                                 telemetry.now_ms() - t0, 3))
+
+
+def _scn_decode():
+    """PR 9 surface: continuous-batching decode, sequential ragged
+    requests so admissions/steps/finishes are exact."""
+    _decode_workload(quantize_kv=False)
+
+
+def _scn_decode_q8():
+    """PR 13 surface: the SAME ragged workload with int8 KV caches —
+    the per-row q8 op must keep jit cache size 1 across slot
+    turnover and publish the (halved) kv_bytes_per_slot gauge."""
+    _decode_workload(quantize_kv=True)
 
 
 # which PR-won property each gauge protects is resolved through
@@ -319,7 +334,16 @@ SCENARIOS = {
     "decode": {
         "fn": _scn_decode,
         "desc": "ContinuousDecoder sequential ragged requests",
-        "gauges": (),
+        "gauges": ("serve.decode.jit_cache_size",
+                   "serve.decode.kv_bytes_per_slot"),
+        "noisy_counters": (), "noisy_events": (),
+    },
+    "decode_q8": {
+        "fn": _scn_decode_q8,
+        "desc": "ContinuousDecoder ragged requests, int8 KV caches "
+                "(quantize_kv)",
+        "gauges": ("serve.decode.jit_cache_size",
+                   "serve.decode.kv_bytes_per_slot"),
         "noisy_counters": (), "noisy_events": (),
     },
 }
@@ -341,6 +365,15 @@ _PROPERTY_NOTES = (
     ("counts.gauges.gspmd.sharded_params",
      "PR 11 SpecLayout placement: the expected parameter count is "
      "sharded over the data×fsdp mesh"),
+    ("counts.gauges.serve.decode.jit_cache_size",
+     "PR 13 int8 continuous decode: ONE compiled (B, 1) step across "
+     "slot turnover (a growing jit cache means admissions recompile "
+     "— the per-admission-recompile regression continuous batching "
+     "exists to avoid)"),
+    ("counts.gauges.serve.decode.kv_bytes_per_slot",
+     "PR 13 decode HBM diet: cache bytes per slot follow from the "
+     "cache pytree's shapes/dtypes alone — a drift means the int8 "
+     "rows or per-token scale caches changed layout"),
     ("counts.compile",
      "compile discipline: XLA compiles happen exactly where the "
      "baseline says (first step / per jit variant); extra compile "

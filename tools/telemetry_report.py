@@ -226,6 +226,14 @@ def _serving_section(events, snap):
                     and v.get("type") == "counter" and v.get("value")}
         if counters:
             out["counters"] = dict(sorted(counters.items()))
+        # attach only alongside real serving activity: the gauge is
+        # published by every Generator construction, and a bare
+        # kv-bytes figure must not conjure a serving section into a
+        # journal that never served
+        kvb = snap.get("serve.decode.kv_bytes_per_slot",
+                       {}).get("value")
+        if kvb and out:
+            out["kv_bytes_per_slot"] = int(kvb)
     for name in ("serve.shed", "serve.timeout", "serve.drain"):
         n = sum(1 for e in events if e.get("event") == name)
         if n:
@@ -308,6 +316,12 @@ def format_report(summary):
                    serving["decode_tokens"],
                    serving["decode_ms"]["p50"],
                    serving["decode_ms"]["p95"]))
+        if serving.get("kv_bytes_per_slot"):
+            kvb = serving["kv_bytes_per_slot"]
+            lines.append(
+                "  decode KV cache: %d bytes/slot (%.2f MiB — int8 "
+                "quantize_kv halves this; see docs/serving.md)"
+                % (kvb, kvb / 2.0 ** 20))
         for key, label in (("shed_events", "shed"),
                            ("timeout_events", "timed out"),
                            ("drain_events", "drain(s)")):

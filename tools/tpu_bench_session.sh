@@ -118,6 +118,11 @@ cap "$OUT/decode_kv8.json" decode_kv8 \
 cap "$OUT/decode_int8kv8.json" decode_int8kv8 \
     python bench.py --network transformer_lm --decode \
         --quantize int8+kv8 --seq-len 1024
+# serve-path A/B through the ContinuousDecoder slot pool: bf16 vs
+# int8 cache bytes/slot + decode step ms + slots-per-HBM-budget
+# (benchmark/bench_decode.py; the per-row q8 path, slot turnover on)
+cap "$OUT/decode_kv_ab.json" decode_kv_ab \
+    python benchmark/bench_decode.py
 
 echo "== 3c. long-context sweep (batch 1) =="
 LCTX="$OUT/longcontext.jsonl.new"; : > "$LCTX"
