@@ -16,6 +16,24 @@ latency stays bounded by the coalesce window + one forward).
 The headline `value` is the best throughput across the sweep (req/s);
 `vs_baseline` is the batching gain — best throughput over the C=1
 (unbatched closed-loop) throughput — when the sweep includes C=1.
+
+FLEET MODE (``--replicas N``, docs/serving.md §fleet): the same
+offered-load sweep against a ``ServeRouter`` over N subprocess
+replicas — each replica its own process (its own GIL, its own XLA
+client) behind real TCP, exactly the production topology scaled down.
+Rows add per-replica dispatch fill so imbalance is visible; the
+acceptance shape is req/s scaling near-linearly in replicas at
+bounded p99 (ROADMAP item 2):
+
+    python bench_serve.py --replicas 3          # fleet sweep
+    python bench_serve.py --replicas 1          # same topology, N=1
+                                                #   (the scaling base)
+
+``--work-ms`` (fleet default 5.0) adds a fixed per-forward service
+time in each replica, modeling the device step a CPU-only CI host
+doesn't have — set 0 to measure raw XLA-CPU forwards instead. The
+emitted metric is ``serve_fleet_throughput`` (same shape, plus
+``replicas`` and ``per_replica_fill``).
 """
 import argparse
 import json
@@ -52,27 +70,118 @@ def _build_predictor(feat, hidden, classes, seed=7):
     return Predictor(net, args)
 
 
-def _run_level(pred, feat, buckets, wait_ms, conc, requests):
-    """One closed-loop level: conc clients x requests round trips
-    against a FRESH engine (clean per-level stats). Returns the sweep
-    row."""
-    from mxnet_tpu import telemetry
-    from mxnet_tpu.serve import ServeEngine
+class _TimedModel:
+    """Forward wrapper adding a fixed service time per forward —
+    the stand-in for device step latency on a CPU-only host (the
+    sleep releases the GIL exactly like a device dispatch would)."""
 
-    eng = ServeEngine(pred, buckets=buckets, max_wait_ms=wait_ms,
-                      feature_shapes=[(feat,)],
-                      install_sigterm=False)
-    eng.warmup()
+    def __init__(self, pred, work_ms):
+        self._pred = pred
+        self._work_s = float(work_ms) / 1000.0
+
+    def forward(self, *arrays):
+        outs = self._pred.forward(*arrays)
+        if self._work_s > 0:
+            time.sleep(self._work_s)
+        return outs
+
+
+def _replica_child(args):
+    """``--serve-replica`` subprocess body: one engine + ServeServer,
+    port announced as one JSON line on stdout, serving until stdin
+    closes (the parent's exit — however it exits — is the shutdown
+    signal; no orphaned replicas)."""
+    from mxnet_tpu.serve import ServeEngine, ServeServer
+
+    pred = _build_predictor(args.features, args.hidden, args.classes)
+    model = _TimedModel(pred, args.work_ms) if args.work_ms else pred
+    buckets = tuple(int(b) for b in
+                    args.buckets.replace(",", " ").split()) \
+        if args.buckets else (1, 2, 4)
+    eng = ServeEngine(model, buckets=buckets,
+                      max_wait_ms=(0.5 if args.wait_ms is None
+                                   else args.wait_ms),
+                      queue_cap=512, feature_shapes=[(args.features,)],
+                      install_sigterm=True)
+    srv = ServeServer(eng)
+    print(json.dumps({"port": srv.port, "host": srv.host}), flush=True)
+    try:
+        while sys.stdin.readline():       # parent holds the pipe open
+            pass
+    finally:
+        srv.close()
+        eng.close()
+    return 0
+
+
+def _spawn_fleet(args, n):
+    """N replica subprocesses; returns (procs, [(host, port)])."""
+    import subprocess
+    cmd = [sys.executable, os.path.abspath(__file__),
+           "--serve-replica",
+           "--features", str(args.features),
+           "--hidden", str(args.hidden),
+           "--classes", str(args.classes),
+           "--work-ms", str(args.work_ms)]
+    if args.buckets:
+        cmd += ["--buckets", args.buckets]
+    if args.wait_ms is not None:
+        cmd += ["--wait-ms", str(args.wait_ms)]
+    procs, addrs = [], []
+    for _ in range(n):
+        procs.append(subprocess.Popen(
+            cmd, stdin=subprocess.PIPE, stdout=subprocess.PIPE,
+            text=True))
+    import select
+    deadline = time.monotonic() + 180.0   # XLA import is the cost
+    for p in procs:
+        # bounded read: a child hung in startup must fail the bench
+        # (fail_payload path), not wedge it on a blocking readline
+        remain = deadline - time.monotonic()
+        if remain <= 0 or not select.select([p.stdout], [], [],
+                                            remain)[0]:
+            raise RuntimeError(
+                "replica fleet startup timed out (child rc=%s)"
+                % p.poll())
+        line = p.stdout.readline()
+        if not line:
+            raise RuntimeError(
+                "replica subprocess died before announcing its port "
+                "(rc=%s)" % p.poll())
+        rec = json.loads(line)
+        addrs.append((rec["host"], rec["port"]))
+    return procs, addrs
+
+
+def _kill_fleet(procs):
+    for p in procs:
+        try:
+            p.stdin.close()               # EOF = drain + exit
+        except OSError:
+            pass
+    for p in procs:
+        try:
+            p.wait(10.0)
+        except Exception:  # noqa: BLE001 — escalate to kill
+            p.kill()
+
+
+
+def _closed_loop(one_round_trip, conc, requests):
+    """THE closed-loop measurement harness both sweep modes share:
+    conc client threads x requests round trips of ``one_round_trip()``,
+    returning the common row fields (throughput, latency quantiles,
+    error count). Callers fold in their mode-specific extras."""
+    from mxnet_tpu import telemetry
+
     lat = [[] for _ in range(conc)]
     errs = [0] * conc
-    x = np.random.RandomState(0).standard_normal(
-        (1, feat)).astype(np.float32)
 
     def client(ci):
         for _ in range(requests):
             t0 = telemetry.now_ms()
             try:
-                eng.infer(x, timeout=60.0)
+                one_round_trip()
             except Exception:  # noqa: BLE001 — shed/timeout counts,
                 errs[ci] += 1  # the row reports them
                 continue
@@ -86,8 +195,6 @@ def _run_level(pred, feat, buckets, wait_ms, conc, requests):
     for t in threads:
         t.join()
     wall = time.perf_counter() - t0
-    eng.close()
-    st = eng.stats()
     flat = sorted(v for row in lat for v in row)
     done = len(flat)
     return {
@@ -101,16 +208,80 @@ def _run_level(pred, feat, buckets, wait_ms, conc, requests):
             "p99": round(telemetry.quantile(flat, 0.99), 3),
             "mean": round(sum(flat) / done, 3),
         } if done else None,
-        "forwards": st["forwards"],
-        "mean_batch_fill": round(st["mean_fill"], 3)
-        if st["mean_fill"] else None,
     }
+
+
+def _run_fleet_level(router, names, feat, conc, requests):
+    """One closed-loop level against the (persistent) fleet: conc
+    clients x requests round trips through the router. Per-replica
+    fill comes from dispatch-count deltas."""
+    before = {n: r["dispatched"]
+              for n, r in router.replicas().items()}
+    x = np.random.RandomState(0).standard_normal(
+        (1, feat)).astype(np.float32)
+    row = _closed_loop(lambda: router.request([x]), conc, requests)
+    after = router.replicas()
+    row["per_replica_fill"] = {
+        n: after[n]["dispatched"] - before.get(n, 0) for n in names}
+    return row
+
+
+def _run_fleet(args, levels):
+    """The --replicas N sweep: router + N subprocess replicas, one
+    JSON line out (metric serve_fleet_throughput)."""
+    from mxnet_tpu.serve import ServeRouter
+
+    procs, addrs = _spawn_fleet(args, args.replicas)
+    router = None
+    try:
+        # pool enough connections for the deepest sweep level — a
+        # closed-loop client holds one for its whole round trip, and
+        # re-dialing per request would measure TCP setup, not serving
+        conns = max(int(c) for c in
+                    args.concurrency.replace(",", " ").split())
+        router = ServeRouter(replicas=addrs, conns_per_replica=conns)
+        names = list(router.replicas())
+        router.warmup()                   # no cold compiles in level 1
+        sweep = [_run_fleet_level(router, names, args.features, c,
+                                  args.requests) for c in levels]
+        fleet_stats = router.stats()
+    finally:
+        if router is not None:
+            router.close()
+        _kill_fleet(procs)
+    return sweep, fleet_stats
+
+
+def _run_level(pred, feat, buckets, wait_ms, conc, requests):
+    """One closed-loop level: conc clients x requests round trips
+    against a FRESH engine (clean per-level stats). Returns the sweep
+    row."""
+    from mxnet_tpu.serve import ServeEngine
+
+    eng = ServeEngine(pred, buckets=buckets, max_wait_ms=wait_ms,
+                      feature_shapes=[(feat,)],
+                      install_sigterm=False)
+    eng.warmup()
+    x = np.random.RandomState(0).standard_normal(
+        (1, feat)).astype(np.float32)
+    row = _closed_loop(lambda: eng.infer(x, timeout=60.0), conc,
+                       requests)
+    eng.close()
+    st = eng.stats()
+    row["forwards"] = st["forwards"]
+    row["mean_batch_fill"] = round(st["mean_fill"], 3) \
+        if st["mean_fill"] else None
+    return row
 
 
 def main(argv=None):
     p = argparse.ArgumentParser(description=__doc__)
-    p.add_argument("--concurrency", default="1,2,4,8,16",
-                   help="comma-separated closed-loop client counts")
+    p.add_argument("--concurrency", default=None,
+                   help="comma-separated closed-loop client counts "
+                        "(default 1,2,4,8,16; fleet mode 4,8,16,32 — "
+                        "past-saturation levels where replica count, "
+                        "not the coalesce window, is the capacity "
+                        "knob)")
     p.add_argument("--requests", type=int,
                    default=int(os.environ.get("BENCH_SERVE_REQUESTS",
                                               "100")),
@@ -123,26 +294,52 @@ def main(argv=None):
     p.add_argument("--features", type=int, default=64)
     p.add_argument("--hidden", type=int, default=256)
     p.add_argument("--classes", type=int, default=16)
+    p.add_argument("--replicas", type=int, default=0,
+                   help="fleet mode: router + this many subprocess "
+                        "replicas (0 = classic in-process engine "
+                        "sweep)")
+    p.add_argument("--work-ms", type=float, default=None,
+                   help="fixed per-forward service time in each "
+                        "replica (fleet default 5.0; 0 = raw XLA-CPU "
+                        "forwards)")
+    p.add_argument("--serve-replica", action="store_true",
+                   help=argparse.SUPPRESS)   # internal: child mode
     args = p.parse_args(argv)
+    if args.work_ms is None:
+        args.work_ms = 5.0 if (args.replicas or args.serve_replica) \
+            else 0.0
 
-    try:   # killed mid-run -> still exactly one parseable JSON line
-        from bench_common import install_death_stub
-        install_death_stub("serve_throughput", "req/s")
-    except ImportError:
-        pass
+    metric = "serve_fleet_throughput" if args.replicas \
+        else "serve_throughput"
+    if not args.serve_replica:
+        try:  # killed mid-run -> still exactly one parseable JSON line
+            from bench_common import install_death_stub
+            install_death_stub(metric, "req/s")
+        except ImportError:
+            pass
     if os.environ.get("BENCH_PLATFORM"):
         os.environ["JAX_PLATFORMS"] = os.environ["BENCH_PLATFORM"]
+    if args.serve_replica:
+        return _replica_child(args)
+    if args.concurrency is None:
+        args.concurrency = "4,8,16,32" if args.replicas \
+            else "1,2,4,8,16"
     levels = sorted({int(c) for c in
                      args.concurrency.replace(",", " ").split()})
     buckets = tuple(int(b) for b in
                     args.buckets.replace(",", " ").split()) \
         if args.buckets else None
 
+    fleet_stats = None
     try:
-        pred = _build_predictor(args.features, args.hidden,
-                                args.classes)
-        sweep = [_run_level(pred, args.features, buckets, args.wait_ms,
-                            c, args.requests) for c in levels]
+        if args.replicas:
+            sweep, fleet_stats = _run_fleet(args, levels)
+        else:
+            pred = _build_predictor(args.features, args.hidden,
+                                    args.classes)
+            sweep = [_run_level(pred, args.features, buckets,
+                                args.wait_ms, c, args.requests)
+                     for c in levels]
     except Exception as e:  # noqa: BLE001 — diagnostic line, like
         # bench.py: the driver gets a parseable failure, not a trace,
         # with the newest committed capture attached (bench_common —
@@ -150,9 +347,9 @@ def main(argv=None):
         # outage still yields a contentful artifact
         try:
             from bench_common import fail_payload
-            payload = fail_payload("serve_throughput", "req/s", e)
+            payload = fail_payload(metric, "req/s", e)
         except ImportError:
-            payload = {"metric": "serve_throughput", "value": None,
+            payload = {"metric": metric, "value": None,
                        "unit": "req/s", "vs_baseline": None,
                        "live": False, "error": "%s: %s"
                        % (type(e).__name__, e)}
@@ -160,18 +357,27 @@ def main(argv=None):
         sys.exit(1)
 
     best = max(sweep, key=lambda r: r["throughput_rps"] or 0.0)
-    base = next((r for r in sweep if r["concurrency"] == 1), None)
+    base = next((r for r in sweep if r["concurrency"] == levels[0]),
+                None) if args.replicas else \
+        next((r for r in sweep if r["concurrency"] == 1), None)
     gain = (round(best["throughput_rps"] / base["throughput_rps"], 3)
             if base and base["throughput_rps"] else None)
-    print(json.dumps({
-        "metric": "serve_throughput",
+    payload = {
+        "metric": metric,
         "value": best["throughput_rps"],
         "unit": "req/s",
-        "vs_baseline": gain,          # batching gain over C=1
+        "vs_baseline": gain,          # gain over the sweep's base level
         "best_concurrency": best["concurrency"],
         "best_latency_ms": best["latency_ms"],
-        "best_mean_batch_fill": best["mean_batch_fill"],
-        "sweep": sweep}))
+        "sweep": sweep}
+    if args.replicas:
+        payload["replicas"] = args.replicas
+        payload["work_ms"] = args.work_ms
+        payload["per_replica_fill"] = best["per_replica_fill"]
+        payload["rerouted"] = (fleet_stats or {}).get("rerouted")
+    else:
+        payload["best_mean_batch_fill"] = best["mean_batch_fill"]
+    print(json.dumps(payload))
     return 0
 
 

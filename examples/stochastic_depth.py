@@ -163,7 +163,9 @@ def main():
                                          "rescale_grad": 1.0 / BATCH})
 
     metric = mx.metric.Accuracy()
-    for epoch in range(12):
+    for epoch in range(8):    # trains past the 0.9 gate by epoch 7
+        #                       (0.98 eval) — 12 bought nothing but
+        #                       CI wall time on the 1-core tier-1 host
         # fresh survival coins every epoch (reference: every batch;
         # the iterator carries them as a data field, so per-batch
         # refresh would just mean a smaller resample period)

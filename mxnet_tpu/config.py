@@ -208,6 +208,33 @@ define("MXNET_DECODE_SLOTS", str, "",
        "the configured max_len; 'auto:<bytes>' sizes against an "
        "explicit budget (e.g. auto:16e9). Empty = no report; the "
        "serve.decode.kv_bytes_per_slot gauge is published either way")
+define("MXNET_ROUTER_POLL_MS", float, 200.0,
+       "fleet router stats-poll period: how often the ServeRouter's "
+       "background poller refreshes each replica's cached load "
+       "signals (queue depth, in-flight, warmed buckets, free decode "
+       "slots) via the stats frame. 0 disables the background poller "
+       "— deterministic tests drive router.poll_now() explicitly")
+define("MXNET_ROUTER_CONNS", int, 8,
+       "fleet router data-connection pool: idle connections kept per "
+       "replica (bursts open extras; surplus closes on release). "
+       "Concurrency to one replica is bounded only by offered load, "
+       "not by this")
+define("MXNET_ROUTER_SESSION_CAP", int, 4096,
+       "fleet router session-affinity table bound: pinned "
+       "continuous-decode sessions beyond it evict "
+       "least-recently-dispatched (an evicted session re-places like "
+       "a new one — decode state on the old replica is orphaned until "
+       "its slot frees)")
+define("MXNET_ROUTER_IO_TIMEOUT", float, 30.0,
+       "fleet router per-replica socket timeout (seconds): a replica "
+       "that accepts but never answers surfaces as a transport fault "
+       "(suspect + reroute) instead of wedging the dispatching thread "
+       "and the stats poller forever. 0 = unbounded (trusted local "
+       "fleets only)")
+define("MXNET_ROUTER_DRAIN_TIMEOUT", float, 60.0,
+       "fleet router recycle budget: seconds router.recycle() waits "
+       "for a draining replica's in-flight work (router-tracked and "
+       "stats-observed) to reach zero before giving up loudly")
 define("MXNET_SERVE_DEADLINE_MS", float, 0.0,
        "default per-request serving deadline: a request still queued "
        "past it fails with the typed RequestTimeout instead of "

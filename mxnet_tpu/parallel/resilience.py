@@ -128,27 +128,44 @@ class RetryPolicy:
                            .encode("utf-8")) % 1024) / 1024.0
         return d * (0.5 + 0.5 * frac)
 
-    def run(self, fn, describe="op", on_retry=None):
+    def run(self, fn, describe="op", on_retry=None, on_fatal=None):
         """Call ``fn()`` until it succeeds, a fatal error occurs, the
         retry count is exhausted, or the deadline would be overrun by
         the next backoff sleep. ``on_retry(exc, attempt, delay)`` fires
         before each sleep (the client uses it to drop the broken
-        connection and to log)."""
+        connection and to log).
+
+        ``on_fatal(exc)`` is the per-call reroute hook: consulted for
+        errors :meth:`is_transient` classifies FATAL, and only for
+        those — and only when a retry is actually available (budget
+        and deadline permitting), so the hook's bookkeeping never
+        records a retry that cannot happen. Returning True retries
+        anyway (same budget, same backoff schedule); False/None
+        preserves the fast-fail raise. The fatal classification
+        itself never changes — the hook exists for callers whose
+        ``fn`` re-targets each attempt, e.g. the serve router
+        retrying an ``Overloaded`` on the next-least-loaded replica
+        (a single-replica client keeps its fast-fail contract by
+        simply not passing one: retrying an Overloaded against the
+        same full queue is a retry storm)."""
         start = time.monotonic()
         attempt = 0
         while True:
             try:
                 return fn()
             except Exception as exc:  # noqa: BLE001 — classified below
-                if not self.is_transient(exc):
+                fatal = not self.is_transient(exc)
+                if fatal and on_fatal is None:
                     raise
-                attempt += 1
-                if attempt > self.max_retries:
+                if attempt + 1 > self.max_retries:
                     raise
-                d = self.delay(attempt)
+                d = self.delay(attempt + 1)
                 if self.deadline > 0 and \
                         time.monotonic() - start + d > self.deadline:
                     raise
+                if fatal and not on_fatal(exc):
+                    raise
+                attempt += 1
                 if on_retry is not None:
                     on_retry(exc, attempt, d)
                 # the backoff sleep as a trace span (no-op when tracing
@@ -210,7 +227,12 @@ class FaultInjector:
       (``mxnet_tpu/serve/net.py``) exposes the same grammar under its
       own points — ``serve_send`` / ``serve_recv`` (client) and
       ``serve_srv_send`` / ``serve_srv_recv`` (server) — so serving
-      fault tests never perturb PS injection counts.
+      fault tests never perturb PS injection counts. The serve router
+      (``mxnet_tpu/serve/router.py``) gives each replica its own
+      point family so one replica's transport can be killed without
+      touching the others: ``router<I>_send`` / ``router<I>_recv``
+      (data path to replica index I) and ``router<I>_ctl_send`` /
+      ``router<I>_ctl_recv`` (its stats/warm control connection).
     * ``action`` — ``drop`` (close the socket and fail before any
       bytes move), ``disconnect`` (transmit *half* the frame, then
       close — the peer sees a torn message; on recv points identical

@@ -14,16 +14,23 @@ Three layers, each usable alone:
 * :class:`ServeServer` / :class:`ServeClient` (``net.py``) — a thin
   TCP front end on the async-PS wire plumbing, so the
   ``MXNET_FAULT_SPEC`` fault grammar tests the serving path unchanged.
+* :class:`ServeRouter` (``router.py``) — one endpoint over N
+  replicas: least-loaded dispatch, decode session affinity,
+  shed-and-retry, zero-drop rolling restarts. Speaks the same wire on
+  both sides (``ServeServer(router)`` fronts it; ``ServeClient``s fan
+  out), so clients cannot tell a router from a replica.
 
 Raw ``socket`` use is confined to ``net.py`` by the
-``tools/serve_smoke.sh`` lint — everything else in this package is
-transport-free by construction.
+``tools/serve_smoke.sh`` lint (router.py included) — everything else
+in this package is transport-free by construction.
 """
 from .decode import ContinuousDecoder, DecodeFuture
 from .engine import (EngineClosed, Overloaded, RequestTimeout,
                      ServeEngine, ServeError, ServeFuture)
 from .net import ServeClient, ServeServer
+from .router import ReplicaState, ServeRouter
 
 __all__ = ["ServeEngine", "ServeFuture", "ServeError", "Overloaded",
            "RequestTimeout", "EngineClosed", "ContinuousDecoder",
-           "DecodeFuture", "ServeClient", "ServeServer"]
+           "DecodeFuture", "ServeClient", "ServeServer", "ServeRouter",
+           "ReplicaState"]

@@ -487,3 +487,18 @@ class ContinuousDecoder:
                 "steps": self._steps, "prefills": self._prefills,
                 "active": sum(s is not None for s in self._slots),
                 "queued": len(self._queue)}
+
+    def introspect(self):
+        """Live state for the ``stats`` introspection frame
+        (serve/net.py answers it for ANY engine-like object): slot
+        headroom and queue depth. ``decode_free_slots`` is the signal
+        the fleet router's session placement consumes — a new decode
+        session goes to the replica with the most free slots
+        (serve/router.py)."""
+        out = self.stats()
+        out["queue_depth"] = out.pop("queued")
+        out["in_flight"] = out["active"] + out["queue_depth"]
+        out["decode_free_slots"] = self._B - out["active"]
+        out["slots"] = self._B
+        out["draining"] = self.draining
+        return out

@@ -226,6 +226,7 @@ class ServeEngine:
         self._timeouts = 0
         self._forwards = 0
         self._completed = 0
+        self._failed = 0
         self._fill_sum = 0
         self._warmed = []                 # buckets pre-compiled by warmup()
 
@@ -257,7 +258,7 @@ class ServeEngine:
         self._thread.start()
 
     # -- admission ----------------------------------------------------------
-    def submit(self, *inputs, deadline_ms=None, tc=None):
+    def submit(self, *inputs, deadline_ms=None, tc=None, session=None):
         """Enqueue one request; returns a :class:`ServeFuture`.
 
         ``inputs``: one array per model input, each with a leading
@@ -270,7 +271,14 @@ class ServeEngine:
         ``tc``: an explicit :class:`~mxnet_tpu.trace.TraceContext` the
         batcher's lifecycle spans should parent to (the TCP front end
         hands in the remote caller's); defaults to the submitting
-        thread's current span."""
+        thread's current span.
+
+        ``session``: accepted and ignored — session ids are a ROUTING
+        concern (the fleet router pins a session to the replica
+        holding its decode state, serve/router.py); a single engine
+        has nothing to route, but must accept fleet traffic
+        unchanged."""
+        del session                       # routing concern, see above
         arrays = [np.asarray(a) for a in inputs]
         if not arrays:
             raise ValueError("submit needs at least one input array")
@@ -433,6 +441,7 @@ class ServeEngine:
         except Exception as exc:           # noqa: BLE001 — every
             # request gets exactly one response; an engine-side error
             # IS that response, typed as itself
+            self._failed += len(live)
             for r in live:
                 r._fail(exc)
             _telemetry.journal_event("serve.error",
@@ -532,6 +541,14 @@ class ServeEngine:
         self.close()
         return False
 
+    @property
+    def in_flight(self):
+        """Admitted requests not yet resolved (queued or mid-batch) —
+        the figure a drain-aware router watches reach zero before it
+        recycles this replica (serve/router.py)."""
+        return (self._admitted - self._completed - self._timeouts
+                - self._failed)
+
     def stats(self):
         """This engine's own counters (the registry aggregates across
         engines; these don't)."""
@@ -553,6 +570,7 @@ class ServeEngine:
         which buckets are warmed, on top of :meth:`stats`."""
         out = self.stats()
         out["queue_depth"] = out.pop("queued")
+        out["in_flight"] = self.in_flight
         out["draining"] = self.draining
         out["buckets"] = list(self._buckets)
         out["warmed"] = self.warmed_buckets

@@ -11,6 +11,11 @@ point names:
 * ``serve_send`` / ``serve_recv`` — client request/reply plumbing
 * ``serve_srv_send`` / ``serve_srv_recv`` — server-side plumbing
 
+(The fleet router's clients rename the client-side pair per replica —
+``router<I>_send`` / ``router<I>_recv`` and ``router<I>_ctl_*`` for
+its control connection — via ``ServeClient(fault_points=...)``, so a
+single replica's transport can be killed deterministically.)
+
 e.g. ``MXNET_FAULT_SPEC="serve_send:disconnect@3;serve_recv:drop@5"``
 tears the 3rd request frame mid-message and severs before the 5th
 reply read — and the client's retry/reconnect must still deliver
@@ -124,6 +129,39 @@ class ServeServer:
             return ("err", "ServeError", "malformed request frame")
         if op == "ping":
             return ("ok", None)
+        if op == "hello":
+            # registration frame: who/what this server fronts, so a
+            # fleet router (serve/router.py) can learn a replica's
+            # declared buckets and capabilities at add_replica time
+            # instead of carrying them in its own config. Answered
+            # from live engine state, never cached.
+            try:
+                return ("ok", {
+                    "role": getattr(self._engine, "role",
+                                    type(self._engine).__name__),
+                    "engine": self._engine_state()})
+            except Exception as exc:      # noqa: BLE001 — reply = report
+                return ("err", "ServeError",
+                        "%s: %s" % (type(exc).__name__, exc))
+        if op == "warm":
+            # re-warm frame: pre-compile every declared bucket (the
+            # router calls this on a freshly recycled replica BEFORE
+            # readmitting it, so its first live request never pays a
+            # cold XLA compile)
+            try:
+                warmup = getattr(self._engine, "warmup", None)
+                if not callable(warmup):
+                    return ("err", "ServeError",
+                            "engine %s has no warmup()"
+                            % type(self._engine).__name__)
+                warmup()
+                return ("ok", list(getattr(self._engine,
+                                           "warmed_buckets", []) or []))
+            except _engine.ServeError as exc:
+                return ("err", type(exc).__name__, str(exc))
+            except Exception as exc:      # noqa: BLE001 — reply = report
+                return ("err", "ServeError",
+                        "%s: %s" % (type(exc).__name__, exc))
         if op == "stats":
             # introspection frame: the telemetry registry snapshot +
             # live engine state (queue depth, warmed buckets). Read by
@@ -146,10 +184,15 @@ class ServeServer:
         hsp = _trace.start_span("serve.handle", parent=rtc) \
             if _trace.enabled() else None
         try:
-            fut = self._engine.submit(
-                *payload["inputs"],
-                deadline_ms=payload.get("deadline_ms"),
-                tc=hsp.context() if hsp is not None else rtc)
+            kw = {"deadline_ms": payload.get("deadline_ms"),
+                  "tc": hsp.context() if hsp is not None else rtc}
+            if isinstance(payload, dict) and \
+                    payload.get("session") is not None:
+                # optional routing key (old clients never send it):
+                # the fleet router pins it to the replica holding the
+                # session's decode state; a plain engine ignores it
+                kw["session"] = payload["session"]
+            fut = self._engine.submit(*payload["inputs"], **kw)
             return ("ok", fut.result())
         except _engine.ServeError as exc:
             return ("err", type(exc).__name__, str(exc))
@@ -213,11 +256,19 @@ class ServeClient:
     as themselves (fatal: the transport demonstrably works)."""
 
     def __init__(self, host, port, retry=None, timeout=None,
-                 logger=None):
+                 logger=None, fault_points="serve"):
         self._addr = (host, int(port))
         self._retry = retry or RetryPolicy(seed="serve:%s:%d"
                                            % (host, int(port)))
         self._timeout = timeout
+        # injection-point family for this client's wire plumbing
+        # (resilience.FaultInjector grammar). Default "serve" keeps
+        # the documented serve_send/serve_recv points; the fleet
+        # router names a family per replica (router<I>/router<I>_ctl)
+        # so one replica's transport can be killed deterministically
+        # without touching the others.
+        self._pt_send = "%s_send" % fault_points
+        self._pt_recv = "%s_recv" % fault_points
         self._log = logger or logging.getLogger(__name__)
         self._sock = None
         self._lock = threading.Lock()
@@ -245,13 +296,17 @@ class ServeClient:
                         attempt, delay, exc)
         self._drop()
 
-    def request(self, inputs, deadline_ms=None):
+    def request(self, inputs, deadline_ms=None, session=None):
         """One inference round trip; returns the per-request output
         list. Retries transport faults; raises the engine's typed
-        error otherwise."""
+        error otherwise. ``session``: optional continuous-decode
+        session id the fleet router pins to one replica (a plain
+        engine accepts and ignores it)."""
         payload = {"inputs": [np.asarray(a) for a in inputs]}
         if deadline_ms is not None:
             payload["deadline_ms"] = deadline_ms
+        if session is not None:
+            payload["session"] = session
         # request span + wire trace context: the server's handler span
         # (and the engine's queue/forward lifecycle) joins this trace.
         # Old servers never read the extra "tc" key.
@@ -264,8 +319,8 @@ class ServeClient:
         def attempt():
             sock = self._ensure()
             try:
-                _send_msg(sock, ("infer", payload), "serve_send")
-                reply = _recv_msg(sock, "serve_recv")
+                _send_msg(sock, ("infer", payload), self._pt_send)
+                reply = _recv_msg(sock, self._pt_recv)
             except Exception:
                 self._drop()
                 raise
@@ -288,46 +343,54 @@ class ServeClient:
         raise _engine.typed_error(kind, msg)
 
     def ping(self):
-        with self._lock:
-            def attempt():
-                sock = self._ensure()
-                try:
-                    _send_msg(sock, ("ping", None), "serve_send")
-                    reply = _recv_msg(sock, "serve_recv")
-                except Exception:
-                    self._drop()
-                    raise
-                if reply is None:
-                    self._drop()
-                    raise ConnectionError("no pong")
-                return reply
-            return self._retry.run(attempt, describe="serve.ping",
-                                   on_retry=self._on_retry)[0] == "ok"
+        try:
+            self._simple_op("ping", "serve.ping")
+            return True
+        except _engine.ServeError:
+            return False
 
     def stats(self):
         """Server introspection via the ``stats`` frame:
         ``{"telemetry": <registry snapshot>, "engine": <queue depth,
         drain state, buckets warmed, counters>}`` — the remote twin of
         ``telemetry.snapshot()`` + ``ServeEngine.introspect()``."""
+        return self._simple_op("stats", "serve.stats")
+
+    def _simple_op(self, op, describe):
+        """One no-payload round trip (hello/warm): retried like any
+        transport op, typed errors re-raised."""
         with self._lock:
             def attempt():
                 sock = self._ensure()
                 try:
-                    _send_msg(sock, ("stats", None), "serve_send")
-                    reply = _recv_msg(sock, "serve_recv")
+                    _send_msg(sock, (op, None), self._pt_send)
+                    reply = _recv_msg(sock, self._pt_recv)
                 except Exception:
                     self._drop()
                     raise
                 if reply is None:
                     self._drop()
-                    raise ConnectionError("no stats reply")
+                    raise ConnectionError("no %s reply" % op)
                 return reply
-            reply = self._retry.run(attempt, describe="serve.stats",
+            reply = self._retry.run(attempt, describe=describe,
                                     on_retry=self._on_retry)
         if reply[0] == "ok":
             return reply[1]
         _, kind, msg = reply
         raise _engine.typed_error(kind, msg)
+
+    def hello(self):
+        """The registration frame: ``{"role": ..., "engine": <live
+        engine state>}`` — how a fleet router learns a replica's
+        declared buckets and capabilities at add_replica time."""
+        return self._simple_op("hello", "serve.hello")
+
+    def warm(self):
+        """Ask the server to pre-compile every declared bucket
+        (``ServeEngine.warmup``); returns the warmed bucket list. The
+        router calls this on a freshly recycled replica before
+        readmitting it."""
+        return self._simple_op("warm", "serve.warm")
 
     def close(self):
         with self._lock:

@@ -365,3 +365,12 @@ class TestQuantizedKV:
             report = dec.describe(hbm_budget=q8_bps * 10 + 1)
             assert "kv_bytes_per_slot: %d" % q8_bps in report
             assert "10 slot(s) fit" in report
+            # introspect(): the stats-frame shape a decode replica
+            # publishes — decode_free_slots is what the fleet
+            # router's session placement consumes (serve/router.py)
+            intro = dec.introspect()
+            assert intro["decode_free_slots"] == B
+            assert intro["slots"] == B
+            assert intro["queue_depth"] == 0
+            assert intro["in_flight"] == 0
+            assert intro["draining"] is False

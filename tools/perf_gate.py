@@ -249,6 +249,54 @@ def _scn_serve():
                                 telemetry.now_ms() - t0, 3))
 
 
+def _scn_router():
+    """PR 14 surface: fleet router over two in-process replicas —
+    replica 1 sheds every request (queue cap 0) so each of the 4
+    sequential requests reroutes to replica 2 (exact reroute count),
+    then replica 2 is recycled (drain -> in-process restart ->
+    re-warm over the wire -> readmit) and serves one more. Counters,
+    reroutes, recycle events and the router->replica span edges are
+    all deterministic."""
+    import numpy as np
+
+    from mxnet_tpu import telemetry
+    from mxnet_tpu.serve import ServeEngine, ServeRouter, ServeServer
+    t0 = telemetry.now_ms()
+    pred = _serve_predictor()
+    x = np.zeros((1, 8), np.float32)
+
+    def make_replica(cap):
+        kw = {} if cap is None else {"queue_cap": cap}
+        eng = ServeEngine(pred, buckets=(1, 2), max_wait_ms=0.0,
+                          feature_shapes=[(8,)],
+                          install_sigterm=False, **kw)
+        return eng, ServeServer(eng)
+    e1, s1 = make_replica(0)              # sheds everything
+    e2, s2 = make_replica(None)
+    live = {"e": e2, "s": s2}
+    router = ServeRouter(poll_ms=0)       # no background poller: every
+    #                                       stats RPC is scripted
+    router.add_replica(s1.host, s1.port, name="r1")
+    router.add_replica(s2.host, s2.port, name="r2")
+    router.poll_now()
+    for _ in range(4):                    # r1 sheds -> reroute to r2
+        router.infer(x, timeout=60.0)
+
+    def restart():
+        live["s"].close()
+        live["e"].close()
+        live["e"], live["s"] = make_replica(None)
+        return (live["s"].host, live["s"].port)
+    router.recycle("r2", restart=restart)
+    router.infer(x, timeout=60.0)         # the readmitted replica serves
+    router.close()
+    for closer in (s1, live["s"], e1, live["e"]):
+        closer.close()
+    telemetry.journal_event("gate.probe",
+                            router_elapsed_ms=round(
+                                telemetry.now_ms() - t0, 3))
+
+
 def _decode_workload(quantize_kv):
     """Shared body of the decode scenarios: sequential ragged
     requests through a 3-slot pool so admissions/steps/finishes are
@@ -331,6 +379,14 @@ SCENARIOS = {
         "gauges": (),
         "noisy_counters": (), "noisy_events": (),
     },
+    "router": {
+        "fn": _scn_router,
+        "desc": "fleet router: shed-and-retry + zero-drop recycle "
+                "over two in-process replicas",
+        "gauges": ("serve.router.replicas_live",
+                   "serve.router.sessions"),
+        "noisy_counters": (), "noisy_events": (),
+    },
     "decode": {
         "fn": _scn_decode,
         "desc": "ContinuousDecoder sequential ragged requests",
@@ -389,6 +445,20 @@ _PROPERTY_NOTES = (
     ("counts.counters.guardrail.masked_steps",
      "PR 3 guardrails: the injected non-finite step is masked on "
      "device and counted exactly once"),
+    ("counts.counters.serve.router.rerouted",
+     "PR 14 shed-and-retry: a replica-local Overloaded retries on "
+     "the next-least-loaded replica, counted exactly (a drifting "
+     "reroute count means dispatch order or the on_fatal hook "
+     "changed)"),
+    ("counts.counters.serve.router.recycles",
+     "PR 14 zero-drop rolling restarts: drain -> restart -> re-warm "
+     "-> readmit ran to completion exactly as scripted"),
+    ("counts.counters.serve.router.",
+     "PR 14 fleet router: dispatch/suspect/session counters are "
+     "exact for a deterministic request sequence"),
+    ("counts.gauges.serve.router.replicas_live",
+     "PR 14 fleet health: every replica is live again after the "
+     "recycle (a stuck draining/suspect replica shrinks the fleet)"),
     ("counts.counters.serve.shed",
      "PR 9 backpressure: a full queue sheds with the typed "
      "Overloaded, counted exactly"),

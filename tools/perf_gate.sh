@@ -130,7 +130,9 @@ lint_serve() {
     # -- raw sockets only in serve/net.py --------------------------------
     # Every byte on the serving wire goes through serve/net.py (ps_async
     # framing + FaultInjector hooks); a raw `socket.` call site anywhere
-    # else bypasses the fault grammar and its tests.
+    # else — engine.py, decode.py, and especially the fleet router
+    # (router.py fans out over ServeClient, it must never dial its own)
+    # — bypasses the fault grammar and its tests.
     local hits
     hits=$(grep -rn "socket\." mxnet_tpu/serve/ \
         | grep -v "mxnet_tpu/serve/net\.py:" || true)
@@ -138,10 +140,12 @@ lint_serve() {
         echo "SERVE LINT FAIL: raw socket. usage in mxnet_tpu/serve/ outside net.py" >&2
         echo "$hits" >&2
         echo "Route transport through mxnet_tpu/serve/net.py (ps_async framing" >&2
-        echo "+ FaultInjector hooks) so MXNET_FAULT_SPEC keeps covering it." >&2
+        echo "+ FaultInjector hooks) so MXNET_FAULT_SPEC keeps covering it —" >&2
+        echo "the router included (per-replica point families router<I>_*)." >&2
         exit 1
     fi
-    echo "serve lint: OK (no raw socket. usage in mxnet_tpu/serve/ outside net.py)"
+    echo "serve lint: OK (no raw socket. usage in mxnet_tpu/serve/ outside net.py;" \
+         "router.py included)"
 }
 
 lint_gate() {
@@ -206,6 +210,7 @@ tests_serve() {
     fi
     env JAX_PLATFORMS="$PLATFORM" \
         python -m pytest tests/test_serve.py tests/test_serve_decode.py \
+        tests/test_serve_router.py \
         -q -m "$marker" -p no:cacheprovider "$@"
 }
 

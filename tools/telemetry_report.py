@@ -16,6 +16,7 @@ training step plus one per notable event. This tool reconstructs:
     python tools/telemetry_report.py runs/telemetry-1234.jsonl
     python tools/telemetry_report.py --json runs/telemetry-1234.jsonl
     python tools/telemetry_report.py --stats 127.0.0.1:9911
+    python tools/telemetry_report.py --stats h1:9911 h2:9911 h3:9911
     python tools/telemetry_report.py --diff old.jsonl new.jsonl
 
 ``--diff OLD NEW`` compares two journals regression-first: step-time
@@ -37,6 +38,10 @@ steady-state section also prints achieved FLOP/s and MFU from the
 ``--stats host:port`` instead queries a live ``ServeServer``'s
 introspection frame (telemetry registry snapshot + engine queue/bucket
 state) — same trusted-cluster pickle wire as the serving transport.
+Several targets render as ONE fleet table (per-replica queue depth,
+in-flight, active decode slots, warmed buckets, shed counts — the
+operator's imbalance eyeball for a replicated serve fleet, a dead
+replica shown as unreachable instead of sinking the table).
 """
 import argparse
 import json
@@ -499,6 +504,61 @@ def fetch_stats(addr, timeout=10.0):
     return reply[1]
 
 
+def format_fleet(rows):
+    """Multi-target stats replies as one fleet table — the operator's
+    imbalance eyeball (per-replica queue depth, in-flight, active
+    decode slots, warmed buckets, shed counts) without Perfetto.
+    ``rows``: ``[(addr, stats-or-None)]`` — a None/failed fetch
+    renders as unreachable rather than sinking the table."""
+    def gauge(snap, name):
+        v = (snap.get(name) or {}).get("value")
+        return "-" if v is None else ("%g" % v)
+
+    header = ("| replica | role | queue | in-flight | admitted | "
+              "shed | timeouts | active slots | warmed |")
+    lines = ["serve fleet stats (%d target(s))" % len(rows),
+             "=" * 46, "", header,
+             "|---|---|---|---|---|---|---|---|---|"]
+    for addr, stats in rows:
+        if not stats:
+            lines.append("| %s | unreachable | - | - | - | - | - | - "
+                         "| - |" % addr)
+            continue
+        eng = stats.get("engine") or {}
+        snap = stats.get("telemetry") or {}
+        warmed = eng.get("warmed")
+        lines.append("| %s | %s | %s | %s | %s | %s | %s | %s | %s |"
+                     % (addr,
+                        eng.get("role", "engine"),
+                        eng.get("queue_depth", "-"),
+                        eng.get("in_flight", "-"),
+                        eng.get("admitted", eng.get("dispatched",
+                                                    "-")),
+                        eng.get("shed", "-"),
+                        eng.get("timeouts", "-"),
+                        gauge(snap, "serve.decode.active_slots"),
+                        ",".join(str(b) for b in warmed)
+                        if warmed else "-"))
+    reach = [(a, s) for a, s in rows if s]
+    if reach:
+        engines = [s.get("engine") or {} for _, s in reach]
+        lines += ["", "fleet totals: queue=%s in-flight=%s "
+                  "admitted=%s shed=%s over %d reachable replica(s)"
+                  % (sum(int(e.get("queue_depth") or 0)
+                         for e in engines),
+                     sum(int(e.get("in_flight") or 0)
+                         for e in engines),
+                     # same admitted-or-dispatched fallback as the
+                     # per-row column: a router target counts
+                     # dispatched, an engine counts admitted
+                     sum(int(e.get("admitted",
+                                   e.get("dispatched")) or 0)
+                         for e in engines),
+                     sum(int(e.get("shed") or 0) for e in engines),
+                     len(reach))]
+    return "\n".join(lines)
+
+
 def format_stats(stats):
     """A live-server stats reply as a text report."""
     lines = ["serve server stats", "=" * 46, "", "engine:"]
@@ -526,9 +586,11 @@ def main(argv=None):
                    help="path to a telemetry *.jsonl journal")
     p.add_argument("--json", action="store_true",
                    help="emit the summary dict as JSON instead of text")
-    p.add_argument("--stats", metavar="HOST:PORT",
-                   help="query a live ServeServer's stats frame "
-                        "instead of reading a journal")
+    p.add_argument("--stats", metavar="HOST:PORT", nargs="+",
+                   help="query live ServeServer stats frames instead "
+                        "of reading a journal; several targets render "
+                        "as one fleet table (per-replica queue depth, "
+                        "in-flight, warmed buckets, shed counts)")
     p.add_argument("--diff", nargs=2, metavar=("OLD", "NEW"),
                    help="compare two journals (regression-oriented "
                         "table; the human companion to tools/"
@@ -543,9 +605,21 @@ def main(argv=None):
                   else format_diff(diff, old_p, new_p))
             return
         if args.stats:
-            stats = fetch_stats(args.stats)
-            print(json.dumps(stats, indent=2, default=str)
-                  if args.json else format_stats(stats))
+            if len(args.stats) == 1:
+                stats = fetch_stats(args.stats[0])
+                print(json.dumps(stats, indent=2, default=str)
+                      if args.json else format_stats(stats))
+                return
+            rows = []
+            for addr in args.stats:
+                try:
+                    rows.append((addr, fetch_stats(addr)))
+                except Exception:  # noqa: BLE001 — one dead replica
+                    rows.append((addr, None))   # must not sink the
+                    #                             fleet table
+            print(json.dumps({a: s for a, s in rows}, indent=2,
+                             default=str)
+                  if args.json else format_fleet(rows))
             return
         if not args.journal:
             p.error("give a journal path (or --stats HOST:PORT, or "

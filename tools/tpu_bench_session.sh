@@ -184,6 +184,14 @@ echo "== 4b. serve engine offered-load sweep =="
 # window refreshes their committed captures here
 cap "$OUT/serve.json" serve python bench_serve.py
 
+echo "== 4b2. serve fleet sweep (router + subprocess replicas) =="
+# the ROADMAP-2 scaling anchor: req/s should scale near-linearly in
+# replicas at bounded p99 (docs/serving.md §fleet; CPU acceptance is
+# >=2x at --replicas 3 vs 1 — on a TPU slice set --work-ms 0 so the
+# real per-forward device time is the service time)
+cap "$OUT/serve_fleet.json" serve_fleet \
+    python bench_serve.py --replicas "${BENCH_FLEET_REPLICAS:-3}"
+
 echo "== 4c. scaling sweep + GSPMD one-jit row =="
 # single chip unless the slice offers more (BENCH_SCALING_DEVICES=1,4,8
 # on a multi-chip window); the gspmd row is the 28.8%->45% MFU
