@@ -34,6 +34,28 @@ time in each replica, modeling the device step a CPU-only CI host
 doesn't have — set 0 to measure raw XLA-CPU forwards instead. The
 emitted metric is ``serve_fleet_throughput`` (same shape, plus
 ``replicas`` and ``per_replica_fill``).
+
+DISAGG MODE (``--disagg P:D``, docs/serving.md §disaggregated
+prefill): prefill/decode disaggregation A/B at equal chip count. Two
+fleets of transformer-Generator replicas run the SAME workload —
+short-prompt decode sessions measured for inter-token latency while
+long-prompt generate load runs concurrently:
+
+* disaggregated — P prefill-role + D decode-role replicas: long
+  prefills run on the prefill chips, the decode replicas only scatter
+  imported KV rows (zero prefill graph calls, asserted);
+* colocated — P+D decode-role replicas: every long prefill stalls the
+  admitting replica's (B, 1) step loop for every active slot on it.
+
+The headline ``value`` is the disaggregated decode inter-token p99
+(wall/new-token of a short session under load); ``vs_baseline`` is
+its ratio to the colocated p99 — the acceptance shape is <= 0.7 at
+equal replica count. The payload also carries the handoff cost micro
+(export + pickle + import vs one prefill at the flagship hd=128
+shape; acceptance <= 0.15) and the int8-vs-bf16 blob bytes ratio
+(acceptance <= 0.55). Emitted metric: ``serve_disagg_p99``.
+
+    python bench_serve.py --disagg 1:1      # 2 chips vs 2 chips
 """
 import argparse
 import json
@@ -165,6 +187,335 @@ def _kill_fleet(procs):
         except Exception:  # noqa: BLE001 — escalate to kill
             p.kill()
 
+
+
+def _lm_params(args):
+    """Deterministic transformer-LM params every generator replica
+    shares (same seed in every process — the prefill replica's
+    exported rows must be THIS model's rows on the decode replica
+    too, or the handoff would decode garbage)."""
+    import mxnet_tpu as mx
+    from mxnet_tpu.initializer import Xavier
+    from mxnet_tpu.models import transformer
+    from mxnet_tpu.parallel import make_train_step
+
+    sym = transformer.get_symbol(
+        args.lm_vocab, 12, num_layers=args.lm_layers,
+        num_heads=args.lm_heads, dim=args.lm_dim,
+        max_len=args.lm_max_len)
+    step = make_train_step(sym, optimizer="sgd")
+    mx.random.seed(0)
+    state = step.init_state(Xavier(), {"data": (2, 12),
+                                       "softmax_label": (2, 12)})
+    return state[0]
+
+
+def _lm_generator(args, batch_size):
+    from mxnet_tpu.generation import Generator
+    return Generator(_lm_params(args), args.lm_vocab, args.lm_max_len,
+                     num_layers=args.lm_layers,
+                     num_heads=args.lm_heads, dim=args.lm_dim,
+                     batch_size=batch_size)
+
+
+def _gen_replica_child(args):
+    """``--serve-replica --role prefill|decode`` subprocess body: one
+    Generator-backed engine + ServeServer (same announce/stdin-EOF
+    lifecycle as the predictor replicas)."""
+    from mxnet_tpu.serve import (ContinuousDecoder, PrefillEngine,
+                                 ServeServer)
+
+    if args.role == "prefill":
+        eng = PrefillEngine(_lm_generator(args, 1))
+    else:
+        eng = ContinuousDecoder(_lm_generator(args, args.slots),
+                                queue_cap=512)
+    srv = ServeServer(eng)
+    print(json.dumps({"port": srv.port, "host": srv.host}), flush=True)
+    try:
+        while sys.stdin.readline():
+            pass
+    finally:
+        srv.close()
+        eng.close(timeout=30.0)
+    return 0
+
+
+def _spawn_gen_fleet(args, roles):
+    """One generator replica subprocess per role; returns
+    (procs, [(host, port)])."""
+    import select
+    import subprocess
+    procs = []
+    for role in roles:
+        cmd = [sys.executable, os.path.abspath(__file__),
+               "--serve-replica", "--role", role,
+               "--slots", str(args.slots),
+               "--lm-vocab", str(args.lm_vocab),
+               "--lm-dim", str(args.lm_dim),
+               "--lm-layers", str(args.lm_layers),
+               "--lm-heads", str(args.lm_heads),
+               "--lm-max-len", str(args.lm_max_len)]
+        procs.append(subprocess.Popen(
+            cmd, stdin=subprocess.PIPE, stdout=subprocess.PIPE,
+            text=True))
+    addrs = []
+    deadline = time.monotonic() + 300.0   # XLA import is the cost
+    for p in procs:
+        remain = deadline - time.monotonic()
+        if remain <= 0 or not select.select([p.stdout], [], [],
+                                            remain)[0]:
+            raise RuntimeError(
+                "generator fleet startup timed out (child rc=%s)"
+                % p.poll())
+        line = p.stdout.readline()
+        if not line:
+            raise RuntimeError(
+                "generator replica died before announcing its port "
+                "(rc=%s)" % p.poll())
+        rec = json.loads(line)
+        addrs.append((rec["host"], rec["port"]))
+    return procs, addrs
+
+
+def _replica_engine_stats(addrs):
+    """Raw per-replica engine stats straight off the wire (the
+    router's cached extract drops the decode-specific fields the
+    disagg assertions need: prefills, imported)."""
+    from mxnet_tpu.serve import ServeClient
+    out = []
+    for host, port in addrs:
+        with ServeClient(host, port) as c:
+            out.append(c.stats().get("engine") or {})
+    return out
+
+
+def _run_disagg_config(args, roles, label):
+    """One side of the A/B: spawn the fleet, run short-prompt decode
+    sessions (measured) under concurrent long-prompt generate load,
+    return the row."""
+    from mxnet_tpu import telemetry
+    from mxnet_tpu.serve import ServeRouter
+
+    rng = np.random.RandomState(0)
+    short = rng.randint(1, args.lm_vocab, (args.short_prompt,))
+    long_p = rng.randint(1, args.lm_vocab, (args.long_prompt,))
+    procs, addrs = _spawn_gen_fleet(args, roles)
+    router = None
+    try:
+        router = ServeRouter(
+            replicas=addrs,
+            conns_per_replica=args.sessions + args.load_clients + 2)
+        # warm both graph shapes on EVERY replica before measuring
+        # (cold XLA compiles are a one-time cost, not the steady
+        # state this A/B is about) — per-replica direct clients, not
+        # the router, whose placement would collapse sequential warm
+        # sessions onto the first replica and leave the rest cold
+        from mxnet_tpu.serve import ServeClient
+        handoffs = []
+        for (host, port), role in zip(addrs, roles):
+            if role != "prefill":
+                continue
+            with ServeClient(host, port) as c:
+                handoffs = [c.prefill(long_p), c.prefill(short)]
+        for (host, port), role in zip(addrs, roles):
+            if role == "prefill":
+                continue
+            with ServeClient(host, port) as c:
+                if handoffs:              # disagg: warm the import
+                    # scatter shapes, not the local prefill graphs
+                    c.generate(long_p, 2, handoff=handoffs[0])
+                    c.generate(short, args.max_new,
+                               handoff=handoffs[1])
+                else:                     # colocated: local prefills
+                    c.generate(long_p, 2)
+                    c.generate(short, args.max_new)
+        stop = threading.Event()
+        load_done = [0] * args.load_clients
+
+        def load_client(ci):
+            while not stop.is_set():
+                try:
+                    router.generate(long_p, 2,
+                                    session="load%d" % ci)
+                    load_done[ci] += 1
+                except Exception:  # noqa: BLE001 — shed under burst
+                    time.sleep(0.005)
+
+        lat = [[] for _ in range(args.sessions)]
+        dec_errs = [0] * args.sessions
+
+        def decode_client(ci):
+            for _ in range(args.requests):
+                t0 = telemetry.now_ms()
+                try:
+                    router.generate(short, args.max_new,
+                                    session="sess%d" % ci)
+                except Exception:  # noqa: BLE001 — shed/timeout
+                    dec_errs[ci] += 1  # counts; the row reports them
+                    continue
+                lat[ci].append(
+                    (telemetry.now_ms() - t0) / args.max_new)
+
+        loaders = [threading.Thread(target=load_client, args=(i,))
+                   for i in range(args.load_clients)]
+        clients = [threading.Thread(target=decode_client, args=(i,))
+                   for i in range(args.sessions)]
+        for t in loaders:
+            t.start()
+        time.sleep(0.2)                   # load reaches steady state
+        t0 = time.perf_counter()
+        for t in clients:
+            t.start()
+        for t in clients:
+            t.join()
+        wall = time.perf_counter() - t0
+        stop.set()
+        for t in loaders:
+            t.join()
+        flat = sorted(v for row in lat for v in row)
+        eng_stats = _replica_engine_stats(addrs)
+    finally:
+        if router is not None:
+            router.close()
+        _kill_fleet(procs)
+    if not flat:
+        # every measured request failed: that is a BENCH failure (the
+        # fail_payload diagnostic path), never a success-shaped
+        # payload with a null p99
+        raise RuntimeError(
+            "disagg %s config: all %d decode requests errored "
+            "(per-session errors %r)"
+            % (label, args.sessions * args.requests, dec_errs))
+    decode_stats = [s for s in eng_stats if "imported" in s]
+    return {
+        "config": label,
+        "replicas": len(roles),
+        "roles": list(roles),
+        "decode_requests": len(flat),
+        "decode_errors": sum(dec_errs),
+        "long_generates": sum(load_done),
+        "wall_s": round(wall, 3),
+        "inter_token_ms": {
+            "p50": round(telemetry.quantile(flat, 0.50), 3),
+            "p95": round(telemetry.quantile(flat, 0.95), 3),
+            "p99": round(telemetry.quantile(flat, 0.99), 3),
+            "mean": round(sum(flat) / len(flat), 3),
+        } if flat else None,
+        # the disagg invariant, read off the live replicas: imported
+        # admissions ran zero prefill graph calls decode-side
+        "decode_replica_prefills": sum(
+            s.get("prefills") or 0 for s in decode_stats),
+        "decode_replica_imports": sum(
+            s.get("imported") or 0 for s in decode_stats),
+    }
+
+
+def _handoff_micro(args):
+    """Flagship-shape (hd=128) in-process handoff cost: export +
+    pickle round trip + import scatter vs one prefill forward, plus
+    the int8-vs-bf16 blob bytes ratio. No wire — the wire's cost is
+    the pickle bytes, which the A/B fleet pays for real."""
+    import pickle
+
+    import jax
+
+    import mxnet_tpu as mx
+    from mxnet_tpu.generation import Generator, kv_blob_nbytes
+    from mxnet_tpu.initializer import Xavier
+    from mxnet_tpu.models import transformer
+    from mxnet_tpu.parallel import make_train_step
+
+    V, L_, heads, dim = 64, 2, 2, 256            # head_dim 128
+    P = int(os.environ.get("BENCH_DISAGG_FLAGSHIP_PROMPT", "384"))
+    T_ = P + 128
+    sym = transformer.get_symbol(V, 12, num_layers=L_,
+                                 num_heads=heads, dim=dim,
+                                 max_len=T_)
+    step = make_train_step(sym, optimizer="sgd")
+    mx.random.seed(0)
+    params = step.init_state(Xavier(), {"data": (2, 12),
+                                        "softmax_label": (2, 12)})[0]
+
+    def mk(**kw):
+        return Generator(params, V, T_, num_layers=L_,
+                         num_heads=heads, dim=dim, batch_size=1, **kw)
+
+    gen = mk()
+    prompt = np.arange(1, P + 1).reshape(1, -1).astype(np.float32)
+
+    def prefill_once():
+        logits, aux = gen._forward(gen._fresh_aux(), prompt, 0)
+        np.asarray(logits[:, -1])         # host sync, like serving
+        return aux
+
+    def med(fn, reps):
+        """Median single-iteration wall — GC/scheduler spikes must
+        not decide a ratio criterion."""
+        times = []
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            fn()
+            times.append((time.perf_counter() - t0) * 1000.0)
+        return sorted(times)[len(times) // 2]
+
+    aux = prefill_once()                  # compile
+    prefill_ms = med(prefill_once, 9)
+
+    dec = mk().serving_decoder()
+    wire = [None]
+    try:
+        blob = gen.export_kv_rows(aux, 0, P)
+        dec.import_kv_rows(0, pickle.loads(pickle.dumps(blob)))
+        jax.block_until_ready(list(dec._aux.values()))   # compile
+
+        def handoff_once():
+            blob = gen.export_kv_rows(aux, 0, P)
+            wire[0] = pickle.dumps(blob, protocol=4)
+            dec.import_kv_rows(0, pickle.loads(wire[0]))
+            jax.block_until_ready(list(dec._aux.values()))
+        handoff_ms = med(handoff_once, 21)
+    finally:
+        dec.close(timeout=10.0)
+
+    # bytes ratio at the same shape/position: int8 rows + f32
+    # per-token scales vs bf16 rows (shape math through the real
+    # export path — a fresh aux has the real dtypes/shapes)
+    g16, gq8 = mk(dtype="bfloat16"), mk(quantize_kv=True)
+    bytes_bf16 = kv_blob_nbytes(
+        g16.export_kv_rows(g16._fresh_aux(), 0, P))
+    bytes_int8 = kv_blob_nbytes(
+        gq8.export_kv_rows(gq8._fresh_aux(), 0, P))
+    return {
+        "shape": {"head_dim": dim // heads, "layers": L_,
+                  "prompt": P},
+        "prefill_ms": round(prefill_ms, 3),
+        "handoff_ms": round(handoff_ms, 3),
+        "handoff_frac": round(handoff_ms / prefill_ms, 4)
+        if prefill_ms else None,
+        "blob_bytes_bf16": bytes_bf16,
+        "blob_bytes_int8": bytes_int8,
+        "bytes_ratio_int8_vs_bf16": round(bytes_int8 / bytes_bf16, 4),
+        "wire_bytes_f32": len(wire[0]),
+    }
+
+
+def _run_disagg(args):
+    """The --disagg P:D A/B: disaggregated fleet vs colocated fleet
+    at equal replica count, plus the flagship-shape handoff micro."""
+    try:
+        n_pre, n_dec = (int(x) for x in args.disagg.split(":"))
+    except ValueError:
+        raise SystemExit("--disagg wants P:D (e.g. 1:1), got %r"
+                         % args.disagg)
+    if n_pre < 1 or n_dec < 1:
+        raise SystemExit("--disagg wants at least one prefill and one "
+                         "decode replica, got %r" % args.disagg)
+    disagg = _run_disagg_config(
+        args, ["prefill"] * n_pre + ["decode"] * n_dec, "disagg")
+    coloc = _run_disagg_config(
+        args, ["decode"] * (n_pre + n_dec), "colocated")
+    return disagg, coloc, _handoff_micro(args)
 
 
 def _closed_loop(one_round_trip, conc, requests):
@@ -302,6 +653,35 @@ def main(argv=None):
                    help="fixed per-forward service time in each "
                         "replica (fleet default 5.0; 0 = raw XLA-CPU "
                         "forwards)")
+    p.add_argument("--disagg", default=None, metavar="P:D",
+                   help="prefill/decode disaggregation A/B: P "
+                        "prefill + D decode generator replicas vs "
+                        "P+D colocated ones at equal chip count "
+                        "(docs/serving.md §disaggregated prefill)")
+    p.add_argument("--sessions", type=int,
+                   default=int(os.environ.get("BENCH_DISAGG_SESSIONS",
+                                              "4")),
+                   help="disagg mode: measured short-prompt decode "
+                        "session threads")
+    p.add_argument("--load-clients", type=int,
+                   default=int(os.environ.get("BENCH_DISAGG_LOAD",
+                                              "2")),
+                   help="disagg mode: concurrent long-prompt "
+                        "generate load threads")
+    p.add_argument("--short-prompt", type=int, default=4)
+    p.add_argument("--long-prompt", type=int, default=96)
+    p.add_argument("--max-new", type=int, default=16,
+                   help="disagg mode: tokens per measured decode "
+                        "request (inter-token = wall / this)")
+    p.add_argument("--slots", type=int, default=4,
+                   help="decode replica slot-pool width")
+    p.add_argument("--lm-vocab", type=int, default=64)
+    p.add_argument("--lm-dim", type=int, default=64)
+    p.add_argument("--lm-layers", type=int, default=2)
+    p.add_argument("--lm-heads", type=int, default=2)
+    p.add_argument("--lm-max-len", type=int, default=160)
+    p.add_argument("--role", default=None,
+                   help=argparse.SUPPRESS)   # internal: child role
     p.add_argument("--serve-replica", action="store_true",
                    help=argparse.SUPPRESS)   # internal: child mode
     args = p.parse_args(argv)
@@ -309,18 +689,53 @@ def main(argv=None):
         args.work_ms = 5.0 if (args.replicas or args.serve_replica) \
             else 0.0
 
-    metric = "serve_fleet_throughput" if args.replicas \
-        else "serve_throughput"
+    if args.disagg:
+        metric, unit = "serve_disagg_p99", "ms/token"
+    elif args.replicas:
+        metric, unit = "serve_fleet_throughput", "req/s"
+    else:
+        metric, unit = "serve_throughput", "req/s"
     if not args.serve_replica:
         try:  # killed mid-run -> still exactly one parseable JSON line
             from bench_common import install_death_stub
-            install_death_stub(metric, "req/s")
+            install_death_stub(metric, unit)
         except ImportError:
             pass
     if os.environ.get("BENCH_PLATFORM"):
         os.environ["JAX_PLATFORMS"] = os.environ["BENCH_PLATFORM"]
     if args.serve_replica:
+        if args.role in ("prefill", "decode"):
+            return _gen_replica_child(args)
         return _replica_child(args)
+    if args.disagg:
+        try:
+            disagg, coloc, micro = _run_disagg(args)
+        except Exception as e:  # noqa: BLE001 — diagnostic line (the
+            # bench_common fail_payload contract, like the sweeps)
+            try:
+                from bench_common import fail_payload
+                payload = fail_payload(metric, unit, e)
+            except ImportError:
+                payload = {"metric": metric, "value": None,
+                           "unit": unit, "vs_baseline": None,
+                           "live": False, "error": "%s: %s"
+                           % (type(e).__name__, e)}
+            print(json.dumps(payload))
+            sys.exit(1)
+        d_p99 = (disagg["inter_token_ms"] or {}).get("p99")
+        c_p99 = (coloc["inter_token_ms"] or {}).get("p99")
+        print(json.dumps({
+            "metric": metric,
+            "value": d_p99,
+            "unit": unit,
+            # acceptance shape: disagg p99 <= 0.7x colocated at equal
+            # replica count (lower is better)
+            "vs_baseline": round(d_p99 / c_p99, 4)
+            if d_p99 and c_p99 else None,
+            "disagg": disagg,
+            "colocated": coloc,
+            "handoff": micro}))
+        return 0
     if args.concurrency is None:
         args.concurrency = "4,8,16,32" if args.replicas \
             else "1,2,4,8,16"

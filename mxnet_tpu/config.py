@@ -235,6 +235,14 @@ define("MXNET_ROUTER_DRAIN_TIMEOUT", float, 60.0,
        "fleet router recycle budget: seconds router.recycle() waits "
        "for a draining replica's in-flight work (router-tracked and "
        "stats-observed) to reach zero before giving up loudly")
+define("MXNET_DECODE_DRAIN_TIMEOUT", float, 60.0,
+       "continuous-decode drain budget: seconds "
+       "ContinuousDecoder.close() waits for admitted sequences to "
+       "finish, and the budget router.recycle() uses to drain a "
+       "replica whose hello declared role 'decode' (one drain clock "
+       "for the decode path; MXNET_ROUTER_DRAIN_TIMEOUT keeps "
+       "covering every other role). Must be positive and finite — "
+       "validated loudly at use")
 define("MXNET_SERVE_DEADLINE_MS", float, 0.0,
        "default per-request serving deadline: a request still queued "
        "past it fails with the typed RequestTimeout instead of "

@@ -23,7 +23,15 @@ from . import telemetry as _telemetry
 from .executor import _graph_eval_fn
 from .models import transformer
 
-__all__ = ["Generator"]
+__all__ = ["Generator", "kv_blob_nbytes"]
+
+
+def kv_blob_nbytes(blob):
+    """Payload bytes of an :meth:`Generator.export_kv_rows` blob — the
+    cache-row arrays only (framing/pickle overhead excluded), the
+    figure the ``serve.prefill.blob_bytes`` histogram and the disagg
+    bench's int8-vs-bf16 ratio report."""
+    return sum(int(a.nbytes) for a in blob["rows"].values())
 
 
 class Generator:
@@ -198,6 +206,70 @@ class Generator:
                 n *= int(d)
             total += n * dtype.itemsize
         return total
+
+    def export_kv_rows(self, aux, row, pos):
+        """Serialize ONE sequence's KV-cache state out of an aux
+        pytree — the portable decode state of the prefill/decode
+        disaggregation handoff (docs/serving.md §disaggregated
+        prefill; the arXiv 2603.09555 "portable O(1) cache" enabler).
+
+        ``aux``: a cache pytree this Generator produced (typically the
+        prefill output); ``row``: which batch row to export; ``pos``:
+        how many tokens of cache that row holds. Every cache in the
+        pytree contributes its ``[row, :, :pos, ...]`` prefix — the
+        int8 k/v rows AND their per-token f32 scale rows under
+        ``quantize_kv``, or the bf16/f32 rows otherwise — as numpy
+        with the device dtype preserved bit-for-bit, so a remote
+        :meth:`ContinuousDecoder.import_kv_rows` scatter is
+        device-roundtrip-exact. Cache entries past ``pos`` never ship:
+        they are unattended garbage by the cache-position mask, and
+        the blob is what moves over the wire.
+
+        Returns ``{"v": 1, "pos": pos, "rows": {name: np.ndarray}}``.
+        """
+        if self._rolling:
+            raise ValueError(
+                "export_kv_rows does not support rolling caches (a "
+                "circular buffer's rows are not position-aligned, so "
+                "a prefix slice is not the sequence's state)")
+        row, pos = int(row), int(pos)
+        if not 0 <= row < self.batch_size:
+            raise ValueError("row %d out of range for batch_size=%d"
+                             % (row, self.batch_size))
+        if not 1 <= pos <= self.max_len:
+            raise ValueError("pos %d out of range for max_len=%d"
+                             % (pos, self.max_len))
+        wanted = set(self._sym.list_auxiliary_states())
+        if set(aux) != wanted:
+            raise ValueError(
+                "aux pytree names %s do not match this Generator's "
+                "caches %s" % (sorted(aux), sorted(wanted)))
+        # ONE fused slice program per pos (row rides as a traced
+        # scalar), then one device_get for the whole pytree — the
+        # handoff's export half is a single dispatch, not 2x-per-layer
+        # eager slices (measured ~3x cheaper; the handoff budget is
+        # docs/serving.md's <=15%-of-one-prefill)
+        fn = self._loop_cache.get(("export", pos))
+        if fn is None:
+            fn = jax.jit(lambda a, r: {
+                n: jax.lax.dynamic_index_in_dim(
+                    a[n], r, axis=0, keepdims=False)[:, :pos]
+                for n in a})
+            self._loop_cache[("export", pos)] = fn
+        host = jax.device_get(fn(aux, jnp.int32(row)))
+        rows = {}
+        for name in sorted(wanted):
+            shape, dtype = self._aux_spec(name)
+            arr = np.asarray(host[name])
+            if arr.dtype != dtype or \
+                    arr.shape != (shape[1], pos) + shape[3:]:
+                raise ValueError(
+                    "cache %r is %s%r, expected %s%r — the aux pytree "
+                    "does not belong to this Generator"
+                    % (name, arr.dtype, arr.shape, dtype,
+                       (shape[1], pos) + shape[3:]))
+            rows[name] = arr
+        return {"v": 1, "pos": pos, "rows": rows}
 
     @staticmethod
     def _check_sampling(temperature, top_k, top_p):

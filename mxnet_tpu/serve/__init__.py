@@ -19,6 +19,11 @@ Three layers, each usable alone:
   shed-and-retry, zero-drop rolling restarts. Speaks the same wire on
   both sides (``ServeServer(router)`` fronts it; ``ServeClient``s fan
   out), so clients cannot tell a router from a replica.
+* :class:`PrefillEngine` (``prefill.py``) — the prefill half of
+  prefill/decode disaggregation: answers the ``prefill`` wire frame
+  with ``{first_token, kv_blob, pos}``; the router fans generate
+  requests prefill-replica → decode-replica with the KV blob shipped
+  in the admit (``ContinuousDecoder.submit(handoff=...)``).
 
 Raw ``socket`` use is confined to ``net.py`` by the
 ``tools/serve_smoke.sh`` lint (router.py included) — everything else
@@ -28,9 +33,10 @@ from .decode import ContinuousDecoder, DecodeFuture
 from .engine import (EngineClosed, Overloaded, RequestTimeout,
                      ServeEngine, ServeError, ServeFuture)
 from .net import ServeClient, ServeServer
+from .prefill import PrefillEngine
 from .router import ReplicaState, ServeRouter
 
 __all__ = ["ServeEngine", "ServeFuture", "ServeError", "Overloaded",
            "RequestTimeout", "EngineClosed", "ContinuousDecoder",
-           "DecodeFuture", "ServeClient", "ServeServer", "ServeRouter",
-           "ReplicaState"]
+           "DecodeFuture", "PrefillEngine", "ServeClient",
+           "ServeServer", "ServeRouter", "ReplicaState"]

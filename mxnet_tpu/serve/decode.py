@@ -59,7 +59,25 @@ from ..generation import _pick_token
 from ..models import transformer
 from .engine import EngineClosed, Overloaded, RequestTimeout
 
-__all__ = ["ContinuousDecoder", "DecodeFuture"]
+__all__ = ["ContinuousDecoder", "DecodeFuture", "drain_timeout"]
+
+
+def drain_timeout():
+    """``MXNET_DECODE_DRAIN_TIMEOUT``, loudly validated: the drain
+    budget for a decode replica — :meth:`ContinuousDecoder.close`
+    waits this long for admitted sequences to finish, and the fleet
+    router's :meth:`~mxnet_tpu.serve.ServeRouter.recycle` of a
+    replica whose hello declared ``role: decode`` budgets its drain
+    from the SAME knob (one drain clock; the router knob keeps
+    covering every other role)."""
+    import math
+    t = float(_config.get("MXNET_DECODE_DRAIN_TIMEOUT"))
+    if not (math.isfinite(t) and t > 0):
+        raise ValueError(
+            "MXNET_DECODE_DRAIN_TIMEOUT=%r: wants a positive finite "
+            "number of seconds (a non-positive or non-finite drain "
+            "budget would wedge or skip the drain silently)" % (t,))
+    return t
 
 
 class DecodeFuture:
@@ -68,10 +86,11 @@ class DecodeFuture:
 
     __slots__ = ("prompt", "max_new", "eos_id", "temperature", "top_k",
                  "top_p", "_key", "t_enq", "t_admit", "tc", "emitted",
-                 "pending", "n_cached", "_ev", "_value", "_exc")
+                 "pending", "n_cached", "handoff", "_ev", "_value",
+                 "_exc")
 
     def __init__(self, prompt, max_new, eos_id, temperature, top_k,
-                 top_p, seed):
+                 top_p, seed, handoff=None):
         self.prompt = prompt               # (P,) int64
         self.max_new = max_new
         self.eos_id = eos_id
@@ -84,6 +103,12 @@ class DecodeFuture:
         # the pool
         self._key = jax.random.PRNGKey(seed) \
             if self.temperature > 0 else None
+        self.handoff = handoff             # remote-prefill admit state
+        if handoff is not None and self._key is not None:
+            # the remote prefill consumed the stream's FIRST split for
+            # the first token it ships — advance past it so local
+            # picks continue the exact generate() key discipline
+            self._key, _ = jax.random.split(self._key)
         self.t_enq = _telemetry.now_ms()
         self.t_admit = None                # set when a slot is claimed
         self.tc = _trace.current_context()  # submitter's span, if any
@@ -138,7 +163,16 @@ class ContinuousDecoder:
     scale rows at each slot's own depth, halving cache bytes per slot.
     Not supported: rolling caches (the circular-buffer op has no
     per-row-position variant — raised at construction here, not
-    mid-request)."""
+    mid-request).
+
+    Disaggregated serving (docs/serving.md §disaggregated prefill):
+    ``submit(handoff=...)`` admits a sequence whose prefill ran on a
+    REMOTE prefill replica — the shipped cache rows scatter into the
+    slot (:meth:`import_kv_rows`) and admission runs zero prefill
+    graph calls; the ``role`` attribute is what the fleet router's
+    hello frame reads to learn this replica decodes."""
+
+    role = "decode"                       # the hello frame's identity
 
     def __init__(self, generator, queue_cap=64, logger=None):
         if getattr(generator, "_rolling", False):
@@ -171,6 +205,7 @@ class ContinuousDecoder:
         self._rng0 = jax.random.PRNGKey(0)
 
         self._aux = generator._fresh_aux()     # the pool caches
+        self._import_jit = {}                  # pos -> fused scatter
         self._slots = [None] * self._B         # DecodeFuture per slot
         self._queue = deque()
         self._lock = threading.Lock()
@@ -182,6 +217,7 @@ class ContinuousDecoder:
         self._finished = 0
         self._steps = 0
         self._prefills = 0
+        self._imported = 0
         self._g_active = _telemetry.gauge("serve.decode.active_slots")
         # pool-measured twin of the Generator's static sizing gauge:
         # actual device-array bytes of the live cache pytree per slot.
@@ -203,6 +239,8 @@ class ContinuousDecoder:
         self._c_admitted = _telemetry.counter("serve.decode.admitted")
         self._c_finished = _telemetry.counter("serve.decode.finished")
         self._c_steps = _telemetry.counter("serve.decode.steps")
+        self._c_imported = _telemetry.counter("serve.decode.imported")
+        self._h_import = _telemetry.histogram("serve.decode.import_ms")
 
         slots_hint = str(_config.get("MXNET_DECODE_SLOTS") or "")
         if slots_hint and not slots_hint.startswith("auto"):
@@ -276,16 +314,119 @@ class ContinuousDecoder:
         return "\n".join(lines)
 
     # -- admission ----------------------------------------------------------
+    def _check_blob(self, blob, P=None):
+        """Loud structural validation of a handoff blob BEFORE it is
+        queued: names/shapes/dtypes must match this pool's own cache
+        spec exactly (a blob from a mismatched generator — wrong
+        architecture, wrong quantize_kv, wrong dtype — would scatter
+        silently-wrong state; device-roundtrip exactness starts with
+        refusing anything that isn't bit-compatible). ``P``: the
+        prompt length the blob must cover exactly (None = trust the
+        blob's own ``pos`` — the bare import_kv_rows surface)."""
+        if not isinstance(blob, dict) or blob.get("v") != 1:
+            raise ValueError("kv_blob is not an export_kv_rows v1 "
+                             "blob: %r" % (type(blob).__name__,))
+        pos = int(blob.get("pos", 0))
+        if not 1 <= pos <= self._gen.max_len:
+            raise ValueError(
+                "kv_blob pos %d out of range for max_len=%d"
+                % (pos, self._gen.max_len))
+        if P is not None and pos != P:
+            raise ValueError(
+                "kv_blob covers %d cached token(s) but the prompt is "
+                "%d long — the handoff must ship exactly the prompt's "
+                "prefill state" % (pos, P))
+        rows = blob.get("rows") or {}
+        if set(rows) != set(self._aux):
+            raise ValueError(
+                "kv_blob caches %s do not match this pool's %s"
+                % (sorted(rows), sorted(self._aux)))
+        for name, arr in rows.items():
+            shape, dtype = self._gen._aux_spec(name)
+            want = (shape[1], pos) + shape[3:]
+            if np.asarray(arr).dtype != dtype or arr.shape != want:
+                raise ValueError(
+                    "kv_blob cache %r is %s%r, expected %s%r — blob "
+                    "and pool generators disagree (architecture, "
+                    "dtype or quantize_kv mismatch)"
+                    % (name, np.asarray(arr).dtype, arr.shape, dtype,
+                       want))
+        return pos
+
+    def import_kv_rows(self, slot, blob):
+        """Scatter one exported sequence's cache rows into ``slot`` —
+        the decode half of the KV handoff, exact to the bit vs the
+        prefill device's own rows. Only the blob's ``pos``-token
+        prefix lands; stale entries past it in the slot are never
+        attended (the per-row cache-position mask). Called by the
+        decode loop during handoff admission; external callers must
+        own a quiescent pool (the loop thread is the aux mutator)."""
+        slot = int(slot)
+        if not 0 <= slot < self._B:
+            raise ValueError("slot %d out of range for %d-slot pool"
+                             % (slot, self._B))
+        pos = self._check_blob(blob)
+        t0 = _telemetry.now_ms()
+        # ONE fused scatter program per pos (slot rides as a traced
+        # scalar; the pool aux is donated so the update is in place,
+        # not a whole-pool copy) — a separate jit from the (B, 1)
+        # step, whose cache-size-1 gauge it never touches
+        fn = self._import_jit.get(pos)
+        if fn is None:
+            def scatter(aux, rows, slot_):
+                out = dict(aux)
+                for name, r in rows.items():
+                    start = (slot_,) + (0,) * (r.ndim)
+                    out[name] = jax.lax.dynamic_update_slice(
+                        aux[name], r[None], start)
+                return out
+            fn = jax.jit(scatter, donate_argnums=0)
+            self._import_jit[pos] = fn
+        self._aux = fn(self._aux,
+                       {n: jnp.asarray(a)
+                        for n, a in blob["rows"].items()},
+                       jnp.int32(slot))
+        # block before timing: JAX dispatch is async, and an import_ms
+        # that records dispatch-only would read ~0 while the real
+        # scatter cost silently lands on the next (B, 1) step — the
+        # histogram exists to budget the decode side of the handoff
+        jax.block_until_ready(self._aux)
+        ms = _telemetry.now_ms() - t0
+        self._imported += 1
+        self._c_imported.inc()
+        self._h_import.observe(ms)
+        return pos
+
     def submit(self, prompt, max_new_tokens, eos_id=None,
-               temperature=0.0, top_k=None, top_p=None, seed=0):
+               temperature=0.0, top_k=None, top_p=None, seed=0,
+               handoff=None):
         """Queue one sequence; returns a :class:`DecodeFuture` whose
         result is the full (prompt + generated) id row, exactly as
-        ``Generator.generate`` would emit it for this prompt alone."""
+        ``Generator.generate`` would emit it for this prompt alone.
+
+        ``handoff``: a remote prefill's ``{"first_token", "kv_blob",
+        "pos"}`` reply (the ``prefill`` wire frame / a
+        :class:`PrefillEngine` return). Admission then scatters the
+        shipped cache rows into the slot and emits the shipped first
+        token — zero prefill graph calls on this replica (asserted by
+        the ``prefills`` stat)."""
         self._gen._check_sampling(temperature, top_k, top_p)
         prompt = np.asarray(prompt, np.int64).reshape(-1)
         P, n = int(prompt.shape[0]), int(max_new_tokens)
         if P < 1:
             raise ValueError("empty prompt")
+        if handoff is not None:
+            if not isinstance(handoff, dict) or \
+                    "first_token" not in handoff or \
+                    "kv_blob" not in handoff:
+                raise ValueError(
+                    "handoff wants the prefill frame's {'first_token',"
+                    " 'kv_blob', 'pos'} dict, got %r"
+                    % (type(handoff).__name__,))
+            # structural blob validation happens HERE on the caller's
+            # thread — a mismatched blob must fail the submission
+            # loudly, never reach the decode loop
+            self._check_blob(handoff["kv_blob"], P)
         if P + n > self._gen.max_len:
             raise ValueError(
                 "prompt (%d) + max_new_tokens (%d) exceeds the cache "
@@ -297,7 +438,7 @@ class ContinuousDecoder:
                 "trained position table (%d rows)"
                 % (P, n, self._gen._pos_rows))
         req = DecodeFuture(prompt, n, eos_id, temperature, top_k,
-                           top_p, seed)
+                           top_p, seed, handoff=handoff)
         if n == 0:                        # generate()'s n=0 contract
             req._finish_ok()
             return req
@@ -316,6 +457,21 @@ class ContinuousDecoder:
             self._cond.notify_all()
         return req
 
+    def handle_generate(self, payload):
+        """The ``generate`` wire frame (serve/net.py): submit one
+        sequence — with its ``handoff`` blob when a remote prefill ran
+        — and block the handler thread until the full row is back
+        (concurrency comes from concurrent connections, the wire's
+        standing contract). Payload keys mirror :meth:`submit`."""
+        fut = self.submit(
+            payload["prompt"], payload["max_new_tokens"],
+            eos_id=payload.get("eos_id"),
+            temperature=payload.get("temperature") or 0.0,
+            top_k=payload.get("top_k"), top_p=payload.get("top_p"),
+            seed=payload.get("seed") or 0,
+            handoff=payload.get("handoff"))
+        return fut.result(payload.get("timeout"))
+
     def generate_many(self, prompts, max_new_tokens, eos_id=None,
                       timeout=None, **kwargs):
         """Submit a batch of (possibly ragged) prompts and wait for all
@@ -329,15 +485,45 @@ class ContinuousDecoder:
     def _free_slots(self):
         return [i for i, s in enumerate(self._slots) if s is None]
 
+    def _admit_handoff(self, slot, req):
+        """Admit one remote-prefilled sequence: scatter its shipped
+        cache rows into the slot (zero prefill graph calls — the
+        ``prefills`` stat must not move) and emit the shipped first
+        token. A bad blob fails THAT request's future and frees the
+        slot; the loop and the other slots are untouched."""
+        t0 = _telemetry.now_ms()
+        try:
+            pos = self.import_kv_rows(slot, req.handoff["kv_blob"])
+            tok = int(req.handoff["first_token"])
+        except Exception as exc:          # noqa: BLE001 — the future
+            # is this sequence's one response; a scatter failure must
+            # not kill the decode loop for every other slot
+            req._fail(exc)
+            return
+        self._slots[slot] = req
+        req.handoff = None     # the rows live on device now — holding
+        #                        the host blob would double memory per
+        #                        imported slot for the whole decode
+        req.t_admit = _telemetry.now_ms()
+        req.n_cached = pos
+        if _trace.enabled():
+            _trace.add_span("serve.decode.import", t0, req.t_admit,
+                            parent=req.tc, slot=slot, pos=pos)
+        req.emitted.append(tok)
+        req.pending = tok
+        self._maybe_finish(slot, tok)
+
     def _admit(self):
-        """Move queued prompts into free slots. One shared-position
-        prefill per distinct prompt length per round (all admitted rows
-        start at position 0, so the Generator's ordinary prefill graph
-        serves); cache rows merge into the pool by a batch-axis
-        scatter that walks the WHOLE aux pytree — under quantize_kv
-        that carries the per-token f32 scale caches alongside the
-        int8 k/v rows (a merged row without its scales would dequant
-        to garbage)."""
+        """Move queued prompts into free slots. Remote-prefilled
+        sequences (a ``handoff`` rode the submit) scatter their
+        shipped rows directly — no prefill graph call. Fresh prompts:
+        one shared-position prefill per distinct prompt length per
+        round (all admitted rows start at position 0, so the
+        Generator's ordinary prefill graph serves); cache rows merge
+        into the pool by a batch-axis scatter that walks the WHOLE aux
+        pytree — under quantize_kv that carries the per-token f32
+        scale caches alongside the int8 k/v rows (a merged row without
+        its scales would dequant to garbage)."""
         with self._lock:
             free = self._free_slots()
             if not free or not self._queue:
@@ -346,6 +532,9 @@ class ContinuousDecoder:
                      for _ in range(min(len(free), len(self._queue)))]
         by_len = {}
         for req in batch:
+            if req.handoff is not None:
+                self._admit_handoff(free.pop(0), req)
+                continue
             by_len.setdefault(len(req.prompt), []).append(req)
         for P, reqs in sorted(by_len.items()):
             rows = np.stack([r.prompt for r in reqs] +
@@ -461,9 +650,15 @@ class ContinuousDecoder:
     def draining(self):
         return self._draining or self._closed
 
-    def close(self, timeout=60.0):
+    def close(self, timeout=None):
         """Drain: admitted sequences decode to completion, new
-        submissions raise EngineClosed, then the loop thread exits."""
+        submissions raise EngineClosed, then the loop thread exits.
+        ``timeout=None`` reads ``MXNET_DECODE_DRAIN_TIMEOUT`` (the
+        router's recycle of a decode replica budgets its drain from
+        the same knob — one drain clock, not a hardcoded 60 here and
+        a knob everywhere else)."""
+        if timeout is None:
+            timeout = drain_timeout()
         with self._cond:
             already = self._closed
             self._draining = True
@@ -485,6 +680,7 @@ class ContinuousDecoder:
     def stats(self):
         return {"admitted": self._admitted, "finished": self._finished,
                 "steps": self._steps, "prefills": self._prefills,
+                "imported": self._imported,
                 "active": sum(s is not None for s in self._slots),
                 "queued": len(self._queue)}
 

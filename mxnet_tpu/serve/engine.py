@@ -177,6 +177,12 @@ class ServeEngine:
         off the main thread).
     """
 
+    # the hello frame's identity: a batch-inference replica (the fleet
+    # router's role-aware dispatch keys off declared roles — "prefill"
+    # and "decode" replicas split the generation phases; everything
+    # else, this engine included, serves the colocated paths)
+    role = "batch"
+
     def __init__(self, model, buckets=None, max_wait_ms=None,
                  queue_cap=None, deadline_ms=None, feature_shapes=None,
                  dtype="float32", install_sigterm=True, logger=None):

@@ -10,6 +10,13 @@ point names:
 
 * ``serve_send`` / ``serve_recv`` — client request/reply plumbing
 * ``serve_srv_send`` / ``serve_srv_recv`` — server-side plumbing
+* ``prefill_send`` / ``prefill_recv`` — the ``prefill`` frame's
+  client plumbing, a GLOBAL pair regardless of the client's point
+  family: the disaggregation handoff leg can be killed
+  deterministically without perturbing infer/stats counts. Prefill is
+  pure (same prompt + seed → same reply), so a torn handoff simply
+  replays — the replayed prefill lands the identical blob and the
+  decode side admits exactly once.
 
 (The fleet router's clients rename the client-side pair per replica —
 ``router<I>_send`` / ``router<I>_recv`` and ``router<I>_ctl_*`` for
@@ -173,6 +180,41 @@ class ServeServer:
             except Exception as exc:      # noqa: BLE001 — reply = report
                 return ("err", "ServeError",
                         "%s: %s" % (type(exc).__name__, exc))
+        if op in ("prefill", "generate"):
+            # disaggregation frames (docs/serving.md §disaggregated
+            # prefill), duck-typed like everything else the wire
+            # fronts: `prefill` wants an engine with prefill() (a
+            # PrefillEngine) and answers {first_token, kv_blob, pos};
+            # `generate` wants handle_generate() (a ContinuousDecoder
+            # admitting — with the shipped blob when one rode along —
+            # or a ServeRouter fanning the whole prefill→decode path
+            # out) and answers the full id row.
+            attr = "prefill" if op == "prefill" else "handle_generate"
+            fn = getattr(self._engine, attr, None)
+            if not callable(fn):
+                return ("err", "ServeError",
+                        "engine %s has no %s() — not a %s-capable "
+                        "replica" % (type(self._engine).__name__,
+                                     attr, op))
+            rtc = _trace.TraceContext.from_wire(payload.get("tc")) \
+                if isinstance(payload, dict) else None
+            hsp = _trace.start_span("serve.handle", op=op,
+                                    parent=rtc) \
+                if _trace.enabled() else None
+            try:
+                kw = {k: v for k, v in payload.items() if k != "tc"}
+                if op == "prefill":
+                    return ("ok", fn(kw.pop("prompt"), **kw))
+                return ("ok", fn(kw))
+            except _engine.ServeError as exc:
+                return ("err", type(exc).__name__, str(exc))
+            except Exception as exc:      # noqa: BLE001 — the reply
+                # IS the error report; the client re-raises it typed
+                self._log.exception("serve: %s handling failed", op)
+                return ("err", "ServeError",
+                        "%s: %s" % (type(exc).__name__, exc))
+            finally:
+                _trace.end_span(hsp)
         if op != "infer":
             return ("err", "ServeError", "unknown op %r" % (op,))
         # handler span: adopts the remote caller's trace context ("tc"
@@ -296,6 +338,50 @@ class ServeClient:
                         attempt, delay, exc)
         self._drop()
 
+    _KEEP_TIMEOUT = object()             # sentinel: socket's own
+
+    def _roundtrip(self, frame, describe, pt_send=None, pt_recv=None,
+                   read_timeout=_KEEP_TIMEOUT):
+        """One framed round trip under the retry policy: transport
+        faults drop the socket and replay on a fresh connection, an
+        err reply re-raises the engine's typed error. ``pt_send`` /
+        ``pt_recv`` override this client's injection-point family
+        (the global ``prefill_*`` pair rides here). ``read_timeout``
+        overrides the socket timeout for THIS op only (the generate
+        frame legitimately blocks for a whole decode — the client's
+        io timeout must not misread a long generation as a dead
+        replica); restored before the socket returns to normal use."""
+        pt_send = pt_send or self._pt_send
+        pt_recv = pt_recv or self._pt_recv
+
+        def attempt():
+            sock = self._ensure()
+            if read_timeout is not self._KEEP_TIMEOUT:
+                sock.settimeout(read_timeout)
+            try:
+                _send_msg(sock, frame, pt_send)
+                reply = _recv_msg(sock, pt_recv)
+            except Exception:
+                self._drop()
+                raise
+            finally:
+                if read_timeout is not self._KEEP_TIMEOUT and \
+                        self._sock is sock:
+                    sock.settimeout(self._timeout)
+            if reply is None:
+                self._drop()
+                raise ConnectionError(
+                    "server closed the connection mid-reply")
+            return reply
+
+        with self._lock:
+            reply = self._retry.run(attempt, describe=describe,
+                                    on_retry=self._on_retry)
+        if reply[0] == "ok":
+            return reply[1]
+        _, kind, msg = reply
+        raise _engine.typed_error(kind, msg)
+
     def request(self, inputs, deadline_ms=None, session=None):
         """One inference round trip; returns the per-request output
         list. Retries transport faults; raises the engine's typed
@@ -315,32 +401,83 @@ class ServeClient:
                                 if payload["inputs"][0].ndim else 0)
         if rsp is not None:
             payload["tc"] = rsp.context().to_wire()
-
-        def attempt():
-            sock = self._ensure()
-            try:
-                _send_msg(sock, ("infer", payload), self._pt_send)
-                reply = _recv_msg(sock, self._pt_recv)
-            except Exception:
-                self._drop()
-                raise
-            if reply is None:
-                self._drop()
-                raise ConnectionError(
-                    "server closed the connection mid-reply")
-            return reply
-
         try:
-            with self._lock:
-                reply = self._retry.run(attempt,
-                                        describe="serve.infer",
-                                        on_retry=self._on_retry)
+            return self._roundtrip(("infer", payload), "serve.infer")
         finally:
             _trace.end_span(rsp)
-        if reply[0] == "ok":
-            return reply[1]
-        _, kind, msg = reply
-        raise _engine.typed_error(kind, msg)
+
+    def prefill(self, prompt, temperature=0.0, top_k=None, top_p=None,
+                seed=0):
+        """The ``prefill`` frame: run one sequence's prefill on the
+        remote replica and return its handoff dict ``{"first_token",
+        "kv_blob", "pos"}``. Injection points are the GLOBAL
+        ``prefill_send`` / ``prefill_recv`` pair (not this client's
+        family); prefill is pure, so the transport-fault replay is
+        safe by construction — a replayed prefill lands the identical
+        blob."""
+        payload = {"prompt": np.asarray(prompt, np.int64).reshape(-1),
+                   "temperature": temperature, "top_k": top_k,
+                   "top_p": top_p, "seed": seed}
+        rsp = _trace.start_span("serve.prefill.request",
+                                tokens=int(payload["prompt"].size))
+        if rsp is not None:
+            payload["tc"] = rsp.context().to_wire()
+        # first contact with a prompt length pays the server-side
+        # (B, P) XLA compile — minutes on real hardware, far past a
+        # dead-transport io timeout; give the read a compile-sized
+        # allowance so a cold prefill is never misread as a dead
+        # replica (and replayed into ANOTHER cold compile)
+        wire_timeout = None if self._timeout is None \
+            else float(self._timeout) + 300.0
+        try:
+            return self._roundtrip(("prefill", payload),
+                                   "serve.prefill",
+                                   "prefill_send", "prefill_recv",
+                                   read_timeout=wire_timeout)
+        finally:
+            _trace.end_span(rsp)
+
+    def generate(self, prompt, max_new_tokens, eos_id=None,
+                 temperature=0.0, top_k=None, top_p=None, seed=0,
+                 session=None, handoff=None, timeout=None):
+        """The ``generate`` frame: admit one sequence on the remote
+        replica (with its ``handoff`` blob when a remote prefill ran)
+        and block for the full id row. Replay caveat: a transport
+        fault AFTER the admission landed replays the whole admit — the
+        orphaned first admission still decodes to completion and
+        frees its slot, and both admissions emit identical tokens
+        (greedy, or the same per-request PRNG stream), so the caller
+        still sees exactly one, correct response.
+
+        The wire read is bounded by ``timeout`` (plus this client's
+        io timeout as slack) when one is given, and UNBOUNDED
+        otherwise — a decode lasts as long as its tokens; the
+        client's io timeout exists to catch dead transports and must
+        not misclassify a healthy long generation. Pass ``timeout``
+        to bound a generate against a hung replica."""
+        payload = {"prompt": np.asarray(prompt, np.int64).reshape(-1),
+                   "max_new_tokens": int(max_new_tokens),
+                   "eos_id": eos_id, "temperature": temperature,
+                   "top_k": top_k, "top_p": top_p, "seed": seed}
+        if session is not None:
+            payload["session"] = session
+        if handoff is not None:
+            payload["handoff"] = handoff
+        if timeout is not None:
+            payload["timeout"] = timeout
+        rsp = _trace.start_span("serve.generate.request",
+                                tokens=int(payload["prompt"].size),
+                                max_new=payload["max_new_tokens"])
+        if rsp is not None:
+            payload["tc"] = rsp.context().to_wire()
+        wire_timeout = None if timeout is None \
+            else float(timeout) + (self._timeout or 30.0)
+        try:
+            return self._roundtrip(("generate", payload),
+                                   "serve.generate",
+                                   read_timeout=wire_timeout)
+        finally:
+            _trace.end_span(rsp)
 
     def ping(self):
         try:
@@ -359,25 +496,7 @@ class ServeClient:
     def _simple_op(self, op, describe):
         """One no-payload round trip (hello/warm): retried like any
         transport op, typed errors re-raised."""
-        with self._lock:
-            def attempt():
-                sock = self._ensure()
-                try:
-                    _send_msg(sock, (op, None), self._pt_send)
-                    reply = _recv_msg(sock, self._pt_recv)
-                except Exception:
-                    self._drop()
-                    raise
-                if reply is None:
-                    self._drop()
-                    raise ConnectionError("no %s reply" % op)
-                return reply
-            reply = self._retry.run(attempt, describe=describe,
-                                    on_retry=self._on_retry)
-        if reply[0] == "ok":
-            return reply[1]
-        _, kind, msg = reply
-        raise _engine.typed_error(kind, msg)
+        return self._roundtrip((op, None), describe)
 
     def hello(self):
         """The registration frame: ``{"role": ..., "engine": <live

@@ -1,0 +1,206 @@
+"""Dedicated prefill engine — the compute half of prefill/decode
+disaggregation (docs/serving.md §disaggregated prefill).
+
+In a colocated replica every long prompt stalls the
+``ContinuousDecoder`` step loop: the (B, P) prefill graph call runs on
+the same device stream as the (B, 1) decode step, so every active
+slot's inter-token latency inflates by the whole prefill while it
+runs, and decode HBM headroom has to cover prefill activation peaks.
+Splitting the phases is the paper's own identity applied to inference
+— state moves between machines (the exported KV rows over the wire,
+PAPER.md's push/pull), compute stays local (the prefill graph on
+prefill chips, the decode step on decode chips) — grounded by the
+portable O(1) decode state of arXiv 2603.09555 and halved in bytes by
+the int8 KV cache (PR 13).
+
+:class:`PrefillEngine` is the engine a prefill replica's
+``ServeServer`` fronts: it answers the ``prefill`` wire frame with
+``{"first_token", "kv_blob", "pos"}`` — one shared-position prefill
+forward, the first sampled/greedy token (consuming exactly the first
+split of the request's PRNG stream, so the decode side continues the
+``generate()`` key discipline bit-for-bit), and the sequence's cache
+rows exported via :meth:`Generator.export_kv_rows`. Prefill is PURE:
+the same prompt + seed always lands the same reply, so a transport
+fault mid-handoff simply replays (no dedup table, exactly like the
+infer path's contract in serve/net.py).
+
+No sockets here — transport is serve/net.py's job (lint-enforced).
+"""
+from __future__ import annotations
+
+import logging
+import threading
+
+import numpy as np
+
+from .. import telemetry as _telemetry
+from .. import trace as _trace
+from ..generation import kv_blob_nbytes
+
+__all__ = ["PrefillEngine"]
+
+
+class PrefillEngine:
+    """One Generator serving the ``prefill`` frame.
+
+    The generator's ``batch_size`` is a compute detail here (the
+    prompt is replicated across rows and row 0 exported); size it 1
+    on a dedicated prefill chip unless you batch prefills some other
+    way. ``max_len`` bounds the prompt length this replica accepts —
+    the DECODE side's capacity bounds prompt + max_new_tokens.
+
+    ``warm_lengths``: prompt lengths ``warmup()`` pre-compiles (the
+    prefill graph specializes per (B, P) like any bucket; the fleet
+    router's ``warm`` frame lands here on recycle). Empty = warmup is
+    a no-op."""
+
+    role = "prefill"                      # the hello frame's identity
+
+    def __init__(self, generator, warm_lengths=(), logger=None):
+        if getattr(generator, "_rolling", False):
+            raise ValueError(
+                "prefill disaggregation does not support rolling "
+                "caches (export_kv_rows needs position-aligned rows)")
+        self._gen = generator
+        self._log = logger or logging.getLogger(__name__)
+        self._warm_lengths = tuple(int(p) for p in warm_lengths)
+        # exactly prefill()'s own prompt bounds — a length the
+        # constructor accepts must never make warmup() raise later
+        # (a recycle re-warm that always fails would park the freshly
+        # restarted replica SUSPECT every time)
+        cap = generator.max_len
+        if generator._pos_rows is not None:
+            cap = min(cap, generator._pos_rows)
+        if any(p < 1 or p >= cap for p in self._warm_lengths):
+            raise ValueError(
+                "warm_lengths %r out of range 1..%d (max_len and the "
+                "trained position table both need decode headroom "
+                "past the prompt)" % (self._warm_lengths, cap - 1))
+        self._lock = threading.Lock()
+        self._inflight = 0
+        self._prefills = 0
+        self._warmed = []
+        self._c_requests = _telemetry.counter("serve.prefill.requests")
+        self._c_tokens = _telemetry.counter("serve.prefill.tokens")
+        self._h_ms = _telemetry.histogram("serve.prefill.ms")
+        self._h_export = _telemetry.histogram("serve.prefill.export_ms")
+        # byte-scale buckets (the ms/count defaults top out far below
+        # a cache blob): 1 KiB .. 64 MiB in x4 steps
+        self._h_bytes = _telemetry.histogram(
+            "serve.prefill.blob_bytes",
+            buckets=tuple(float(1 << s) for s in range(10, 27, 2)))
+
+    def prefill(self, prompt, temperature=0.0, top_k=None, top_p=None,
+                seed=0, _record=True, **_ignored):
+        """One sequence's prefill: returns the handoff dict
+        ``{"first_token": int, "kv_blob": export_kv_rows blob,
+        "pos": len(prompt)}`` a remote
+        ``ContinuousDecoder.submit(handoff=...)`` admits from.
+        Pure — replaying the same call lands the same reply.
+        ``_record=False`` (warmup's compile drives) keeps the
+        request-level telemetry/stats clean: ``serve.prefill.*`` and
+        ``stats()['prefills']`` count served traffic only."""
+        import jax
+
+        from ..generation import _pick_token
+        gen = self._gen
+        gen._check_sampling(temperature, top_k, top_p)
+        prompt = np.asarray(prompt, np.int64).reshape(-1)
+        P = int(prompt.shape[0])
+        if P < 1:
+            raise ValueError("empty prompt")
+        if P >= gen.max_len:
+            raise ValueError(
+                "prompt (%d) leaves no decode headroom at this "
+                "prefill replica's max_len=%d" % (P, gen.max_len))
+        if gen._pos_rows is not None and P >= gen._pos_rows:
+            raise ValueError(
+                "prompt (%d) exceeds the trained position table (%d "
+                "rows)" % (P, gen._pos_rows))
+        t0 = _telemetry.now_ms()
+        sp = _trace.start_span("serve.prefill", tokens=P)
+        try:
+            with self._lock:
+                self._inflight += 1
+            rows = np.stack([prompt] * gen.batch_size)
+            logits, aux = gen._forward(gen._fresh_aux(),
+                                       rows.astype(np.float32), 0)
+            # the request PRNG stream's FIRST split picks the first
+            # token — exactly generate()'s round-1 discipline; the
+            # decode side advances its own key past this split
+            _, sub = jax.random.split(jax.random.PRNGKey(seed))
+            tok = int(np.asarray(_pick_token(
+                logits[:1, -1], temperature, top_k, sub, top_p))[0])
+            t_exp = _telemetry.now_ms()
+            blob = gen.export_kv_rows(aux, 0, P)
+            t1 = _telemetry.now_ms()
+            if _record:
+                nbytes = kv_blob_nbytes(blob)
+                with self._lock:
+                    self._prefills += 1
+                self._c_requests.inc()
+                self._c_tokens.inc(P)
+                self._h_ms.observe(t1 - t0)
+                self._h_export.observe(t1 - t_exp)
+                self._h_bytes.observe(nbytes)
+                _telemetry.journal_event(
+                    "serve.prefill", tokens=P, blob_bytes=nbytes,
+                    ms=round(t1 - t0, 3))
+            return {"first_token": tok, "kv_blob": blob, "pos": P}
+        finally:
+            with self._lock:
+                self._inflight -= 1
+            _trace.end_span(sp)
+
+    # -- engine-surface lifecycle / introspection ---------------------------
+    def warmup(self):
+        """Pre-compile the declared prompt-length specializations so a
+        recycled prefill replica never pays a cold XLA compile on a
+        live prompt (the fleet router's ``warm`` frame)."""
+        for P in self._warm_lengths:
+            # compile drive only: request-level telemetry stays clean
+            # (warmups must never read as served traffic)
+            self.prefill(np.zeros((P,), np.int64), _record=False)
+            if P not in self._warmed:
+                self._warmed.append(P)
+        _telemetry.journal_event("serve.prefill.warmup",
+                                 lengths=list(self._warm_lengths))
+
+    @property
+    def warmed_buckets(self):
+        """Prompt lengths warmup() pre-compiled (the warm frame's
+        reply; a prefill 'bucket' is a prompt length)."""
+        return list(self._warmed)
+
+    @property
+    def draining(self):
+        return False
+
+    def stats(self):
+        with self._lock:
+            return {"prefills": self._prefills,
+                    "in_flight": self._inflight}
+
+    def introspect(self):
+        """The ``stats`` frame's engine half: in-flight prefills are
+        the load signal (there is no queue — concurrency is the
+        connection count, each prefill synchronous on its handler
+        thread)."""
+        out = self.stats()
+        out["queue_depth"] = 0
+        out["draining"] = self.draining
+        out["warmed"] = self.warmed_buckets
+        return out
+
+    def close(self, timeout=None):
+        """Nothing to drain: in-flight prefills finish on their
+        handler threads; the engine holds no background thread
+        (``timeout`` accepted for engine-surface parity)."""
+        del timeout
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False

@@ -64,7 +64,9 @@ import numpy as np
 from .. import config as _config
 from .. import telemetry as _telemetry
 from .. import trace as _trace
+from ..generation import kv_blob_nbytes
 from ..parallel.resilience import RetryPolicy
+from .decode import drain_timeout as _decode_drain_timeout
 from .engine import EngineClosed, Overloaded, ServeError
 from .net import ServeClient
 
@@ -109,7 +111,7 @@ class _Replica:
 
     __slots__ = ("name", "host", "port", "index", "state", "control",
                  "idle", "inflight", "dispatched", "rerouted_from",
-                 "faults", "stats", "declared", "recycles")
+                 "faults", "stats", "declared", "role", "recycles")
 
     def __init__(self, name, host, port, index):
         self.name = name
@@ -125,15 +127,24 @@ class _Replica:
         self.faults = 0
         self.stats = {}                  # last successful poll extract
         self.declared = {}               # hello() engine state
+        self.role = None                 # hello-declared replica role
         self.recycles = 0
 
     def describe(self):
         return {"host": self.host, "port": self.port,
-                "state": self.state, "in_flight": self.inflight,
+                "state": self.state, "role": self.role,
+                "in_flight": self.inflight,
                 "dispatched": self.dispatched,
                 "rerouted_from": self.rerouted_from,
                 "faults": self.faults, "recycles": self.recycles,
                 "stats": dict(self.stats)}
+
+
+def _not_prefill(rep):
+    """The default dispatchability predicate: every role but dedicated
+    prefill (legacy fleets declare no role at all and stay fully
+    dispatchable — today's colocated behavior, bit for bit)."""
+    return rep.role != "prefill"
 
 
 def _parse_addr(addr):
@@ -223,6 +234,15 @@ class ServeRouter:
             "serve.router.sessions_replaced")
         self._h_dispatch = _telemetry.histogram(
             "serve.router.dispatch_ms")
+        # disaggregation accounting (docs/serving.md §disaggregated
+        # prefill): prefills this router fanned to prefill replicas,
+        # generate requests it completed, and the handoff blob bytes
+        # it shipped decode-ward (byte-scale buckets, 1 KiB..64 MiB)
+        self._c_generates = _telemetry.counter("serve.router.generates")
+        self._c_prefills = _telemetry.counter("serve.prefill.dispatched")
+        self._h_handoff = _telemetry.histogram(
+            "serve.router.handoff_bytes",
+            buckets=tuple(float(1 << s) for s in range(10, 27, 2)))
 
         _telemetry.journal_event("serve.router.start",
                                  poll_ms=self._poll_ms)
@@ -280,12 +300,12 @@ class ServeRouter:
             raise ConnectionError(
                 "replica %s at %s:%d unreachable at registration: %s"
                 % (name, host, port, exc)) from exc
+        rep.role = (rep.declared or {}).get("role")
         self._poll_replica(rep)
         self._update_gauges()
         _telemetry.journal_event(
             "serve.router.add_replica", name=name,
-            addr="%s:%d" % (host, int(port)),
-            role=(rep.declared or {}).get("role"))
+            addr="%s:%d" % (host, int(port)), role=rep.role)
         return name
 
     def remove_replica(self, name):
@@ -444,16 +464,31 @@ class ServeRouter:
 
     @staticmethod
     def _warm_for(rep, rows):
-        return any(b >= rows for b in rep.stats.get("warmed") or ())
+        """Is this replica compiled for a rows-sized request? Batch
+        replicas warm PADDED buckets (any bucket >= rows serves);
+        a prefill replica's 'warmed' entries are EXACT prompt lengths
+        (the prefill graph specializes per (B, P)) — only an exact
+        match avoids the cold compile the ranking exists to dodge."""
+        warmed = rep.stats.get("warmed") or ()
+        if rep.role == "prefill":
+            return rows in warmed
+        return any(b >= rows for b in warmed)
 
-    def _candidates(self, rows, exclude):
+    def _candidates(self, rows, exclude, want=None):
         """Dispatchable replicas, best first: live before suspect
         (suspects are last-resort, so a one-replica fleet still rides
         out a transport blip), warmed-for-this-size before cold,
-        least-loaded within each class."""
+        least-loaded within each class. ``want``: optional role
+        predicate — the disaggregated paths restrict a leg to its
+        phase's replicas (prefill leg → role 'prefill', decode leg →
+        role 'decode'); ``None`` = the infer/colocated default, every
+        role except dedicated prefill (a prefill replica cannot
+        answer anything but the prefill frame)."""
+        if want is None:
+            want = _not_prefill
         live, suspect = [], []
         for rep in self._replicas.values():
-            if rep.name in exclude or \
+            if not want(rep) or rep.name in exclude or \
                     rep.state == ReplicaState.DRAINING or \
                     rep.stats.get("draining"):
                 # the polled flag catches an EXTERNALLY draining
@@ -468,20 +503,25 @@ class ServeRouter:
                       + self._score(r))
         return live + suspect
 
-    def _pick(self, rows, session, exclude, fresh_pins):
+    def _pick(self, rows, session, exclude, fresh_pins, want=None):
         """Choose and charge the target replica (inflight++ under the
         lock, so concurrent dispatches see each other's load).
         Returns ``(replica, established)`` — established means the
         session pin predates this dispatch (KV state exists on that
         replica, so a shed there must NOT reroute); a pin placed by
         this very dispatch (``fresh_pins``) is speculative and free to
-        move."""
+        move. ``want`` restricts the leg to a role (see
+        :meth:`_candidates`); a pin to a replica outside the wanted
+        role re-places like a pin to a drained one (the fleet's
+        topology changed under the session)."""
+        if want is None:
+            want = _not_prefill
         with self._lock:
             if self._closed:
                 raise EngineClosed("router is closed")
             if session is not None:
                 pinned = self._replicas.get(self._sessions.get(session))
-                if pinned is not None and \
+                if pinned is not None and want(pinned) and \
                         pinned.state != ReplicaState.DRAINING and \
                         not pinned.stats.get("draining") and \
                         pinned.name not in exclude:
@@ -494,7 +534,7 @@ class ServeRouter:
                     # dispatch's own speculative pin failed): the
                     # session re-places fresh
                     self._c_sessions_replaced.inc()
-            cands = self._candidates(rows, exclude)
+            cands = self._candidates(rows, exclude, want)
             if not cands:
                 self._c_shed.inc()
                 _telemetry.journal_event("serve.router.all_shed",
@@ -528,11 +568,14 @@ class ServeRouter:
             rep.dispatched += 1
             return rep, False
 
-    def _has_other_candidate(self, rep, exclude):
+    def _has_other_candidate(self, rep, exclude, want=None):
         """Is any OTHER replica dispatchable right now? (the honesty
         test for the reroute counter)"""
+        if want is None:
+            want = _not_prefill
         with self._lock:
-            return any(r is not rep and r.name not in exclude
+            return any(r is not rep and want(r)
+                       and r.name not in exclude
                        and r.state != ReplicaState.DRAINING
                        and not r.stats.get("draining")
                        for r in self._replicas.values())
@@ -564,6 +607,141 @@ class ServeRouter:
         return self.submit(*inputs, deadline_ms=deadline_ms,
                            session=session).result(timeout)
 
+    # -- disaggregated generation -------------------------------------------
+    def _disagg_active(self):
+        """Disaggregation engages only when the fleet holds BOTH
+        phases: at least one routable prefill-role replica AND one
+        decode-role replica. Any other fleet — legacy no-role, decode
+        replicas alone, prefill replicas mid-deploy — keeps the
+        colocated path bit-for-bit (the replica that admits also
+        prefills)."""
+        with self._lock:
+            have = {None: False, "prefill": False, "decode": False}
+            for r in self._replicas.values():
+                if r.state == ReplicaState.DRAINING or \
+                        r.stats.get("draining"):
+                    continue
+                have[r.role if r.role in have else None] = True
+            return have["prefill"] and have["decode"]
+
+    def _has_role(self, role):
+        with self._lock:
+            return any(r.role == role
+                       and r.state != ReplicaState.DRAINING
+                       and not r.stats.get("draining")
+                       for r in self._replicas.values())
+
+    def generate(self, prompt, max_new_tokens, eos_id=None,
+                 temperature=0.0, top_k=None, top_p=None, seed=0,
+                 session=None, timeout=None, handoff=None, tc=None):
+        """Route one sequence generation through the fleet
+        (docs/serving.md §disaggregated prefill).
+
+        Disaggregated fleet (prefill + decode roles both present): the
+        prefill fans to the least-loaded prefill replica (preferring
+        one with this prompt length warmed), the session places on the
+        decode replica with most free slots — established pins keep
+        their PR-14 affinity semantics untouched — and the exported KV
+        blob ships WITH the admit, so the decode replica runs zero
+        prefill graph calls. Any other fleet: the generate frame goes
+        to one colocated replica that prefills and decodes locally —
+        decode-role replicas when any exist (a ``role: batch``
+        neighbor cannot answer a generate frame), otherwise any
+        non-prefill replica (legacy no-role fleets, bit for bit).
+        Both paths emit exactly what a single-process
+        ``Generator.generate`` would for this prompt + seed.
+
+        ``handoff``: a prefill reply the CALLER already holds (the
+        replica-surface contract — a client that paid its own remote
+        prefill must not pay a second one through the router); the
+        prefill leg is skipped and the blob ships as-is.
+
+        ``timeout`` is a best-effort end-to-end budget: the decode
+        leg receives what remains of it after the prefill leg.
+        Transport-fault replays can stretch the total past it (each
+        replayed attempt re-arms its read window — the price of
+        exactly-one-response delivery); callers needing a hard wall
+        enforce it on their own side of the wire."""
+        prompt = np.asarray(prompt, np.int64).reshape(-1)
+        P = int(prompt.size)
+        if P < 1:
+            raise ValueError("empty prompt")
+        t_entry = _telemetry.now_ms()
+        if tc is None:
+            tc = _trace.current_context()
+        disagg = handoff is None and self._disagg_active()
+        gsp = _trace.start_span("serve.router.generate", parent=tc,
+                                tokens=P, disagg=disagg)
+        try:
+            if disagg:
+                handoff = self._route(
+                    P, None, None,
+                    lambda c: c.prefill(prompt,
+                                        temperature=temperature,
+                                        top_k=top_k, top_p=top_p,
+                                        seed=seed),
+                    want=lambda r: r.role == "prefill",
+                    span="serve.router.prefill")
+                nbytes = kv_blob_nbytes(handoff["kv_blob"])
+                self._c_prefills.inc()
+                self._h_handoff.observe(nbytes)
+                _telemetry.journal_event("serve.router.handoff",
+                                         bytes=nbytes, tokens=P)
+            if disagg or handoff is not None or \
+                    self._has_role("decode"):
+                # a blob (routed or caller-supplied) needs a decode
+                # admit; and in ANY fleet that has decode-role
+                # replicas, the generate frame belongs on them — a
+                # 'batch' neighbor has no handle_generate()
+                want = lambda r: r.role == "decode"  # noqa: E731
+            else:
+                want = None              # legacy no-role fleet
+            # the decode leg must stay BOUNDED even when the caller
+            # passed no timeout: an unbounded wire read on a hung
+            # replica would wedge this dispatch thread forever (the
+            # exact failure MXNET_ROUTER_IO_TIMEOUT exists to catch
+            # on the infer path). Scale the ceiling with the work —
+            # a second per requested token plus queue slack is hung
+            # on any hardware, not slow. A caller budget is
+            # END-TO-END: the decode leg gets what the prefill leg
+            # left of it (floored so an already-blown budget fails
+            # fast with the decoder's typed RequestTimeout)
+            if timeout is not None:
+                leg_timeout = max(
+                    0.001, float(timeout)
+                    - (_telemetry.now_ms() - t_entry) / 1000.0)
+            else:
+                leg_timeout = 120.0 + float(max_new_tokens)
+            out = self._route(
+                P, session, None,
+                lambda c: c.generate(prompt, max_new_tokens,
+                                     eos_id=eos_id,
+                                     temperature=temperature,
+                                     top_k=top_k, top_p=top_p,
+                                     seed=seed, session=session,
+                                     handoff=handoff,
+                                     timeout=leg_timeout),
+                want=want, span="serve.router.decode")
+            self._c_generates.inc()
+            return out
+        finally:
+            _trace.end_span(gsp)
+
+    def handle_generate(self, payload):
+        """The ``generate`` wire frame when a ServeServer fronts the
+        router — clients still cannot tell a router from a replica:
+        the same frame a colocated replica admits, the router fans
+        across the fleet."""
+        return self.generate(
+            payload["prompt"], payload["max_new_tokens"],
+            eos_id=payload.get("eos_id"),
+            temperature=payload.get("temperature") or 0.0,
+            top_k=payload.get("top_k"), top_p=payload.get("top_p"),
+            seed=payload.get("seed") or 0,
+            session=payload.get("session"),
+            timeout=payload.get("timeout"),
+            handoff=payload.get("handoff"))
+
     def _dispatch(self, arrays, deadline_ms, session, tc):
         if not arrays:
             raise ValueError("dispatch needs at least one input array")
@@ -572,6 +750,21 @@ class ServeRouter:
             raise ValueError(
                 "inputs need a leading batch axis (a single sample is "
                 "shape (1, ...)), got %r" % (arrays[0].shape,))
+        return self._route(
+            rows, session, tc,
+            lambda client: client.request(arrays,
+                                          deadline_ms=deadline_ms,
+                                          session=session))
+
+    def _route(self, rows, session, tc, call, want=None,
+               span="serve.router.dispatch"):
+        """THE dispatch scaffolding every routed wire op shares —
+        pick-and-charge, shed-and-retry via the RetryPolicy reroute
+        hook, suspect marking, session-pin hygiene. ``call(client)``
+        performs the actual round trip (infer / prefill / generate);
+        ``want`` restricts candidates to a role (disaggregated legs);
+        ``span`` names the dispatch span (the infer path keeps its
+        established ``serve.router.dispatch`` vocabulary)."""
         t0 = _telemetry.now_ms()
         excluded = set()                 # replicas that shed THIS req
         fresh_pins = set()               # pins THIS dispatch placed
@@ -580,15 +773,13 @@ class ServeRouter:
         def attempt():
             state["rep"] = None
             rep, established = self._pick(rows, session, excluded,
-                                          fresh_pins)
+                                          fresh_pins, want)
             state["rep"], state["established"] = rep, established
             client = self._acquire(rep)
             answered = False
             try:
                 try:
-                    out = client.request(arrays,
-                                         deadline_ms=deadline_ms,
-                                         session=session)
+                    out = call(client)
                     answered = True
                     return out
                 except ServeError:
@@ -631,7 +822,7 @@ class ServeRouter:
                     with self._lock:
                         if self._sessions.get(session) == rep.name:
                             self._sessions.pop(session, None)
-                if not self._has_other_candidate(rep, excluded):
+                if not self._has_other_candidate(rep, excluded, want):
                     # single-replica fleet (or nothing else standing):
                     # the retry necessarily returns HERE — that is a
                     # plain transport retry, not a reroute; counting
@@ -685,8 +876,7 @@ class ServeRouter:
         policy = self._user_retry or RetryPolicy(
             max_retries=max(8, len(self._replicas) + 2),
             base_delay=0.005, seed="router")
-        sp = _trace.start_span("serve.router.dispatch", parent=tc,
-                               rows=rows)
+        sp = _trace.start_span(span, parent=tc, rows=rows)
         try:
             out = policy.run(attempt, describe="router.dispatch",
                              on_retry=on_retry, on_fatal=on_fatal)
@@ -748,14 +938,26 @@ class ServeRouter:
         Raises ValueError when no OTHER live replica exists (a
         one-replica fleet cannot recycle without dropping requests)
         and TimeoutError when the drain outlives the budget
-        (``MXNET_ROUTER_DRAIN_TIMEOUT`` / ``timeout``)."""
-        budget = float(timeout if timeout is not None
-                       else self._drain_timeout)
-        deadline = time.monotonic() + budget
+        (``MXNET_ROUTER_DRAIN_TIMEOUT`` / ``timeout``; a replica
+        whose hello declared role ``decode`` drains on
+        ``MXNET_DECODE_DRAIN_TIMEOUT`` instead — the same clock its
+        own ``ContinuousDecoder.close`` honors, validated loudly
+        there, so a decode drain is never cut short by a router knob
+        tuned for batch replicas)."""
         with self._lock:
+            # ONE lock section from lookup to the DRAINING flip — a
+            # concurrent remove_replica must not slip between them and
+            # leave this recycle operating on an orphaned record
             rep = self._replicas.get(name)
             if rep is None:
                 raise KeyError("no replica %r" % name)
+            if timeout is not None:
+                budget = float(timeout)
+            elif rep.role == "decode":
+                budget = _decode_drain_timeout()
+            else:
+                budget = self._drain_timeout
+            deadline = time.monotonic() + budget
             if not any(r.state == ReplicaState.LIVE
                        and r.name != name
                        for r in self._replicas.values()):
@@ -833,6 +1035,7 @@ class ServeRouter:
                 while True:
                     try:
                         rep.declared = rep.control.hello()
+                        rep.role = (rep.declared or {}).get("role")
                         break
                     except ServeError:
                         raise             # it answered: misconfigured

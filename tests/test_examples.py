@@ -17,31 +17,46 @@ FAST_EXAMPLES = [
     "profile_model.py",
     "gan_toy.py",
     "fit_spmd_elastic.py",
-    "transformer_generate.py",
     "rcnn_train.py",
     "fcn_xs.py",
     "nce_loss.py",
-    "actor_critic.py",
     "multi_task.py",
     "svm_digits.py",
     "vae.py",
     "neural_style.py",
-    "stochastic_depth.py",
     "sgld_bayes.py",
     "dsd_pruning.py",
     "image_folder_training.py",
     "memcost_remat.py",
 ]
 
+# The heaviest end-to-end demos (20-47 s each on the 1-core tier-1
+# host) ride the slow tier: the suite crossed the 870 s tier-1
+# wall-clock budget and these three cost the most while their
+# framework surfaces keep dedicated unit coverage in tier-1
+# (generation/beam/speculative/int8 in test_generation.py +
+# test_serve_decode.py/test_serve_disagg.py; the Module fit API in
+# test_module.py and the perf-gate `module` scenario; RL uses no
+# unique surface). Each still self-checks when the slow tier runs.
+HEAVY_EXAMPLES = [
+    "transformer_generate.py",
+    "actor_critic.py",
+    "stochastic_depth.py",
+]
 
+
+@pytest.mark.slow
 def test_speech_lstm_bucketing_example(tmp_path):
     """Speech-style bucketed pipeline: runs the example (self-checking:
     frame-accuracy floor + cross-bucket padding invariance, the check
-    that caught the round-5 bucket-parameter-sharing regression)."""
+    that caught the round-5 bucket-parameter-sharing regression).
+    Slow tier: ~29 s on the tier-1 host; the bucketing machinery keeps
+    fast coverage in test_rnn_toolkit.py's bucketing tests."""
     _run_example("speech_lstm_bucketing.py", tmp_path, timeout=600,
                  expect="speech_lstm_bucketing OK")
 
 
+@pytest.mark.slow
 def test_dec_clustering_example(tmp_path):
     """DEC has its own entry: the AE pretrain + refinement loop runs
     longer than the FAST_EXAMPLES budget (still self-checking —
@@ -63,7 +78,8 @@ def _run_example(script, tmp_path, timeout=300, extra_args=(),
     return out
 
 
-@pytest.mark.parametrize("script", FAST_EXAMPLES)
+@pytest.mark.parametrize("script", FAST_EXAMPLES + [
+    pytest.param(s, marks=pytest.mark.slow) for s in HEAVY_EXAMPLES])
 def test_example_runs(script, tmp_path):
     extra = [str(tmp_path / "trace.json")] \
         if script == "profile_model.py" else []

@@ -40,7 +40,11 @@ CASES = [
 # the two deepest variants take >60s of CPU compile+run each — the
 # "large sweeps" tier (the fast tier keeps inception-bn/v3 and
 # googlenet covering the family)
-_SLOW_CASES = {"inception_v4", "inception_resnet_v2"}
+_SLOW_CASES = {"inception_v4", "inception_resnet_v2",
+               # 35 s on the tier-1 host; inception_bn stays the
+               # family's fast representative (the 870 s tier-1
+               # wall-clock budget forced a cut)
+               "inception_v3"}
 
 
 @pytest.mark.parametrize(

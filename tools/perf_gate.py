@@ -330,6 +330,65 @@ def _decode_workload(quantize_kv):
                                 telemetry.now_ms() - t0, 3))
 
 
+def _scn_disagg():
+    """PR 15 surface: prefill/decode disaggregation — one prefill +
+    one decode in-process replica behind the role-aware router,
+    sequential ragged generates with ONE injected transport fault torn
+    into the 2nd prefill frame. The pure prefill replays to the
+    identical blob, every admission is a scatter-only import (zero
+    decode-side prefill graph calls), and the decode (B, 1) step stays
+    ONE compiled executable across imported-slot turnover."""
+    import numpy as np
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import telemetry
+    from mxnet_tpu.generation import Generator
+    from mxnet_tpu.initializer import Xavier
+    from mxnet_tpu.models import transformer
+    from mxnet_tpu.parallel import make_train_step
+    from mxnet_tpu.parallel.resilience import (FaultInjector,
+                                               install_fault_injector)
+    from mxnet_tpu.serve import (ContinuousDecoder, PrefillEngine,
+                                 ServeRouter, ServeServer)
+    t0 = telemetry.now_ms()
+    V, L, H, DIM, T = 50, 2, 2, 32, 24
+    sym = transformer.get_symbol(V, 12, num_layers=L, num_heads=H,
+                                 dim=DIM, max_len=T,
+                                 pos_encoding="learned")
+    step = make_train_step(sym, optimizer="sgd")
+    mx.random.seed(0)
+    params = step.init_state(Xavier(), {"data": (2, 12),
+                                        "softmax_label": (2, 12)})[0]
+
+    def gen(bs):
+        return Generator(params, V, T, num_layers=L, num_heads=H,
+                         dim=DIM, batch_size=bs)
+    pre = PrefillEngine(gen(1))
+    dec = ContinuousDecoder(gen(3))
+    s1, s2 = ServeServer(pre), ServeServer(dec)
+    router = ServeRouter(poll_ms=0)       # scripted polling only
+    router.add_replica(s1.host, s1.port, name="prefill0")
+    router.add_replica(s2.host, s2.port, name="decode0")
+    router.poll_now()
+    inj = install_fault_injector(
+        FaultInjector("prefill_send:disconnect@2"))
+    try:
+        for length, max_new in ((4, 5), (6, 3), (3, 4)):
+            router.generate(np.arange(1, length + 1), max_new,
+                            session="s")
+    finally:
+        install_fault_injector(None)
+    assert inj.fired == [("prefill_send", 2, "disconnect")], inj.fired
+    st = dec.stats()
+    assert st["prefills"] == 0 and st["imported"] == 3, st
+    router.close()
+    for closer in (s1, s2, dec, pre):
+        closer.close()
+    telemetry.journal_event("gate.probe",
+                            disagg_elapsed_ms=round(
+                                telemetry.now_ms() - t0, 3))
+
+
 def _scn_decode():
     """PR 9 surface: continuous-batching decode, sequential ragged
     requests so admissions/steps/finishes are exact."""
@@ -402,6 +461,14 @@ SCENARIOS = {
                    "serve.decode.kv_bytes_per_slot"),
         "noisy_counters": (), "noisy_events": (),
     },
+    "disagg": {
+        "fn": _scn_disagg,
+        "desc": "prefill/decode disaggregation: role-aware router, "
+                "KV handoff with one injected mid-handoff fault",
+        "gauges": ("serve.decode.jit_cache_size",
+                   "serve.router.replicas_live"),
+        "noisy_counters": (), "noisy_events": (),
+    },
 }
 
 # field-path prefix -> the protected property a regression names.
@@ -453,6 +520,19 @@ _PROPERTY_NOTES = (
     ("counts.counters.serve.router.recycles",
      "PR 14 zero-drop rolling restarts: drain -> restart -> re-warm "
      "-> readmit ran to completion exactly as scripted"),
+    ("counts.counters.serve.prefill.",
+     "PR 15 disaggregation: prefill fan-out is exact — requests "
+     "prefilled on prefill-role replicas and handoffs shipped, "
+     "counted one per generate even across the injected mid-handoff "
+     "replay (a drift means role-aware dispatch or the pure-replay "
+     "path changed)"),
+    ("counts.counters.serve.decode.imported",
+     "PR 15 disaggregation: every admission of a remote-prefilled "
+     "sequence is a scatter-only import — exactly one admit per "
+     "request, zero prefill graph calls on the decode replica"),
+    ("counts.counters.serve.router.generates",
+     "PR 15 disaggregation: completed generate dispatches are exact "
+     "for a deterministic request sequence"),
     ("counts.counters.serve.router.",
      "PR 14 fleet router: dispatch/suspect/session counters are "
      "exact for a deterministic request sequence"),

@@ -129,23 +129,28 @@ lint_obs() {
 lint_serve() {
     # -- raw sockets only in serve/net.py --------------------------------
     # Every byte on the serving wire goes through serve/net.py (ps_async
-    # framing + FaultInjector hooks); a raw `socket.` call site anywhere
-    # else — engine.py, decode.py, and especially the fleet router
-    # (router.py fans out over ServeClient, it must never dial its own)
-    # — bypasses the fault grammar and its tests.
+    # framing + FaultInjector hooks); a raw `socket.` call site — or a
+    # bare `import socket` staging one — anywhere else (engine.py,
+    # decode.py, the fleet router fanning out over ServeClient, the
+    # disaggregation prefill engine shipping KV blobs) bypasses the
+    # fault grammar and its tests: the prefill handoff leg is
+    # killable ONLY because its bytes ride net.py's prefill_send/
+    # prefill_recv points.
     local hits
-    hits=$(grep -rn "socket\." mxnet_tpu/serve/ \
+    hits=$(grep -rnE "socket\.|^import socket|^from socket" \
+        mxnet_tpu/serve/ \
         | grep -v "mxnet_tpu/serve/net\.py:" || true)
     if [ -n "$hits" ]; then
-        echo "SERVE LINT FAIL: raw socket. usage in mxnet_tpu/serve/ outside net.py" >&2
+        echo "SERVE LINT FAIL: raw socket usage in mxnet_tpu/serve/ outside net.py" >&2
         echo "$hits" >&2
         echo "Route transport through mxnet_tpu/serve/net.py (ps_async framing" >&2
         echo "+ FaultInjector hooks) so MXNET_FAULT_SPEC keeps covering it —" >&2
-        echo "the router included (per-replica point families router<I>_*)." >&2
+        echo "router.py (per-replica families router<I>_*) and the disagg" >&2
+        echo "handoff (prefill_send/prefill_recv) included." >&2
         exit 1
     fi
-    echo "serve lint: OK (no raw socket. usage in mxnet_tpu/serve/ outside net.py;" \
-         "router.py included)"
+    echo "serve lint: OK (no raw socket usage in mxnet_tpu/serve/ outside net.py;" \
+         "router.py + prefill.py included)"
 }
 
 lint_gate() {
@@ -210,7 +215,7 @@ tests_serve() {
     fi
     env JAX_PLATFORMS="$PLATFORM" \
         python -m pytest tests/test_serve.py tests/test_serve_decode.py \
-        tests/test_serve_router.py \
+        tests/test_serve_router.py tests/test_serve_disagg.py \
         -q -m "$marker" -p no:cacheprovider "$@"
 }
 

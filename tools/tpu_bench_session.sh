@@ -192,6 +192,14 @@ echo "== 4b2. serve fleet sweep (router + subprocess replicas) =="
 cap "$OUT/serve_fleet.json" serve_fleet \
     python bench_serve.py --replicas "${BENCH_FLEET_REPLICAS:-3}"
 
+echo "== 4b3. prefill/decode disaggregation A/B =="
+# disaggregated (P prefill + D decode replicas) vs colocated at equal
+# chip count: decode inter-token p99 under concurrent long-prompt
+# load (acceptance <= 0.7x), handoff cost vs one prefill (<= 0.15),
+# int8-vs-bf16 blob bytes (<= 0.55) — docs/serving.md §disaggregated
+cap "$OUT/serve_disagg.json" serve_disagg \
+    python bench_serve.py --disagg "${BENCH_DISAGG_SPLIT:-1:1}"
+
 echo "== 4c. scaling sweep + GSPMD one-jit row =="
 # single chip unless the slice offers more (BENCH_SCALING_DEVICES=1,4,8
 # on a multi-chip window); the gspmd row is the 28.8%->45% MFU
