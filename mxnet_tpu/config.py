@@ -243,6 +243,22 @@ define("MXNET_DECODE_DRAIN_TIMEOUT", float, 60.0,
        "for the decode path; MXNET_ROUTER_DRAIN_TIMEOUT keeps "
        "covering every other role). Must be positive and finite — "
        "validated loudly at use")
+define("MXNET_ROUTER_FAILOVER", bool, True,
+       "fleet router generate failover: when the replica pinned to an "
+       "in-flight generate dies mid-call (transport fault + failed "
+       "control probe), the router replays its retained recovery "
+       "record (prompt, sampling opts, seed, handoff blob) on a "
+       "survivor — token-for-token identical, and the decode-side "
+       "admit-id dedup table makes a replay onto a replica that "
+       "actually survived admit exactly once. Off restores the "
+       "pre-failover contract: an established session's transport "
+       "fault retries only its own replica")
+define("MXNET_ROUTER_MIGRATION_LIMIT", int, 8,
+       "fleet router migration bound: how many evacuated-session "
+       "resume hops one generate may take (each migrating recycle or "
+       "SIGTERM evacuation crossing the request's path costs one) "
+       "before the router fails it with EngineClosed — a cascade of "
+       "evacuating replicas must not bounce a request forever")
 define("MXNET_SERVE_DEADLINE_MS", float, 0.0,
        "default per-request serving deadline: a request still queued "
        "past it fails with the typed RequestTimeout instead of "

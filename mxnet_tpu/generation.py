@@ -23,7 +23,7 @@ from . import telemetry as _telemetry
 from .executor import _graph_eval_fn
 from .models import transformer
 
-__all__ = ["Generator", "kv_blob_nbytes"]
+__all__ = ["Generator", "kv_blob_nbytes", "replay_key"]
 
 
 def kv_blob_nbytes(blob):
@@ -1002,6 +1002,25 @@ def _quantize_weights(arg_params, decode_args):
                              -127, 127).astype(np.int8)
         out[arg] = scale.astype(np.float32)
     return out
+
+
+def replay_key(seed, picks):
+    """The per-request PRNG key after ``picks`` tokens have been drawn
+    from the stream seeded by ``seed``.
+
+    Every sampling path in this module and in the serving decoder
+    follows one discipline: ``key = PRNGKey(seed)`` then exactly one
+    ``key, sub = split(key)`` per drawn token (a disaggregated
+    handoff's remote first token consumed the first split, so
+    local-pick and handoff admissions alike sit at ``len(emitted)``
+    splits after k emitted tokens). That makes PRNG progress derivable
+    state: a migrated session (``ContinuousDecoder.export_session`` /
+    ``submit(resume=...)``) re-derives its key here and the resumed
+    stream continues bit-exactly."""
+    key = jax.random.PRNGKey(int(seed or 0))
+    for _ in range(int(picks)):
+        key, _ = jax.random.split(key)
+    return key
 
 
 def _pick_token(logits, temperature, top_k, key, top_p=None):

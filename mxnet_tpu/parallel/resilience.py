@@ -191,8 +191,40 @@ _RULE_RE = re.compile(
 # action — `nan@5` poisons the 5th step's gradients (exercising the real
 # on-device detection/masking path), `sigterm@3` raises a real SIGTERM
 # through the chaining GracefulShutdown handler at the 3rd step boundary.
+# The `kill<I>` family is the chaos-harness extension of the same form
+# (tools/chaos_fleet.py): the "call" counted is one completed fleet
+# request, and a firing rule tells the harness to SIGKILL child replica
+# index I — `kill1@40` kills replica 1 when the 40th request completes.
 _STEP_RULE_RE = re.compile(
-    r"^(?P<point>nan|sigterm)@(?P<nth>\d+)(?:x(?P<count>\d+|\*))?$")
+    r"^(?P<point>nan|sigterm|kill\d*)@(?P<nth>\d+)(?:x(?P<count>\d+|\*))?$")
+
+# every wire point name the documented hooks can ever fire (the grammar
+# above accepts any \w+ for `point`, so without this check a typo'd
+# point — `serve_snd:drop@1` — would silently never fire and a fault
+# test would pass vacuously). Fixed names plus the per-replica router
+# family; step-rule points validate through _STEP_RULE_RE itself.
+_WIRE_POINTS = frozenset((
+    "send", "recv", "ping", "srv_send", "srv_recv",
+    "serve_send", "serve_recv", "serve_srv_send", "serve_srv_recv",
+    "prefill_send", "prefill_recv",
+))
+_WIRE_POINT_PATTERNS = (
+    re.compile(r"^router\d+_(?:ctl_)?(?:send|recv)$"),
+)
+
+
+def _check_wire_point(point, raw):
+    if point in _WIRE_POINTS or \
+            any(p.match(point) for p in _WIRE_POINT_PATTERNS):
+        return
+    raise ValueError(
+        "MXNET_FAULT_SPEC rule %r names unknown injection point %r — "
+        "documented wire points are %s, plus the per-replica router "
+        "family router<I>_send / router<I>_recv / router<I>_ctl_send / "
+        "router<I>_ctl_recv; step-indexed rules are nan@N / sigterm@N "
+        "/ kill<I>@N (docs/robustness.md). A mistyped point never "
+        "fires, so the fault test it belongs to passes vacuously."
+        % (raw, point, ", ".join(sorted(_WIRE_POINTS))))
 
 
 class _Rule:
@@ -246,7 +278,15 @@ class FaultInjector:
     / ``sigterm@nth[xcount]`` — the "call" counted is one training step
     of a fit loop (``on_train_step``): ``nan@5`` poisons the 5th step's
     gradients, ``sigterm@3`` raises a real SIGTERM at the 3rd step
-    boundary (mxnet_tpu/guardrail.py).
+    boundary (mxnet_tpu/guardrail.py). ``kill<I>@nth[xcount]`` is the
+    chaos-harness member of the same family (``on_chaos_tick``): the
+    call counted is one completed fleet request, and a firing rule
+    tells ``tools/chaos_fleet.py`` to SIGKILL child replica index I.
+
+    Wire point names are validated at parse time against the families
+    above — an unknown point raises ``ValueError`` naming the valid
+    ones, because a typo'd point never fires and the fault test it
+    belongs to would pass vacuously.
 
     Example: ``send:disconnect@4;recv:drop@6`` tears the 4th request
     frame mid-message and severs the connection before the 6th reply
@@ -269,6 +309,7 @@ class FaultInjector:
                           (s.strip() for s in self.spec.split(";"))):
             m = _RULE_RE.match(raw)
             if m is not None:
+                _check_wire_point(m.group("point"), raw)
                 add_rule(m, m.group("action"),
                          float(m.group("arg") or 0.0))
                 continue
@@ -277,7 +318,8 @@ class FaultInjector:
                 raise ValueError(
                     "bad MXNET_FAULT_SPEC rule %r (want "
                     "point:action@nth[xcount][:seconds] or "
-                    "nan@nth[xcount] / sigterm@nth[xcount])" % raw)
+                    "nan@nth[xcount] / sigterm@nth[xcount] / "
+                    "kill<I>@nth[xcount])" % raw)
             add_rule(m, m.group("point"), 0.0)
         self._counts = {}
         self._lock = threading.Lock()
@@ -345,6 +387,16 @@ class FaultInjector:
         advance the per-point counter by one training step; True when a
         rule fires this step. The caller performs the fault (the
         injector has no socket to act on here)."""
+        return self._step(point) is not None
+
+    # -- hook (called once per completed fleet request,
+    #    tools/chaos_fleet.py) --------------------------------------------
+    def on_chaos_tick(self, point):
+        """Chaos-schedule points (the ``kill<I>`` family): advance the
+        named point's counter by one completed fleet request; True when
+        a rule fires this tick. The harness performs the fault — a hard
+        SIGKILL of child replica I — so the schedule is deterministic
+        in request-completion order, never wall time."""
         return self._step(point) is not None
 
 

@@ -31,12 +31,14 @@ in this package is transport-free by construction.
 """
 from .decode import ContinuousDecoder, DecodeFuture
 from .engine import (EngineClosed, Overloaded, RequestTimeout,
-                     ServeEngine, ServeError, ServeFuture)
+                     ServeEngine, ServeError, ServeFuture,
+                     SessionEvacuated)
 from .net import ServeClient, ServeServer
 from .prefill import PrefillEngine
 from .router import ReplicaState, ServeRouter
 
 __all__ = ["ServeEngine", "ServeFuture", "ServeError", "Overloaded",
-           "RequestTimeout", "EngineClosed", "ContinuousDecoder",
-           "DecodeFuture", "PrefillEngine", "ServeClient",
-           "ServeServer", "ServeRouter", "ReplicaState"]
+           "RequestTimeout", "EngineClosed", "SessionEvacuated",
+           "ContinuousDecoder", "DecodeFuture", "PrefillEngine",
+           "ServeClient", "ServeServer", "ServeRouter",
+           "ReplicaState"]

@@ -39,12 +39,24 @@ per emitted token exactly like ``generate``'s loop) and match a
 multi-row static batch — ``jax.random.categorical`` draws one noise
 tensor per CALL, so row b of a (B, V) batch and the same logits alone
 see different noise.
+
+Because every piece of a mid-decode sequence is either portable
+(cache rows via ``export_kv_rows``) or derivable (PRNG progress =
+``len(emitted)`` splits — ``generation.replay_key``), an active
+session survives its replica: :meth:`export_session` packages one
+slot's full recovery state, ``submit(resume=...)`` readmits it on
+another pool at its own depth, and :meth:`evacuate` (also wired to
+SIGTERM via ``install_sigterm=True``) exports every active slot at
+once so a migrating recycle is bounded by export+import cost instead
+of longest-sequence drain (docs/robustness.md, fleet failure
+semantics).
 """
 from __future__ import annotations
 
 import logging
+import signal
 import threading
-from collections import deque
+from collections import OrderedDict, deque
 
 import numpy as np
 
@@ -55,11 +67,19 @@ from .. import config as _config
 from .. import telemetry as _telemetry
 from .. import trace as _trace
 from ..executor import _graph_eval_fn
-from ..generation import _pick_token
+from ..generation import _pick_token, replay_key
 from ..models import transformer
-from .engine import EngineClosed, Overloaded, RequestTimeout
+from .engine import (EngineClosed, Overloaded, RequestTimeout,
+                     SessionEvacuated)
 
 __all__ = ["ContinuousDecoder", "DecodeFuture", "drain_timeout"]
+
+# replay dedup (PR 1's (cid, seq) pattern on the serving side): how
+# many admit ids a decode replica remembers. Sized far past any
+# plausible in-flight window — eviction is LRU, and evicting an id
+# that could still be replayed would re-open the double-admit hole,
+# so the cap exists only to bound memory over a replica's lifetime.
+_DEDUP_CAP = 4096
 
 
 def drain_timeout():
@@ -85,9 +105,9 @@ class DecodeFuture:
     (prompt + generated, eos included when hit) or a typed error."""
 
     __slots__ = ("prompt", "max_new", "eos_id", "temperature", "top_k",
-                 "top_p", "_key", "t_enq", "t_admit", "tc", "emitted",
-                 "pending", "n_cached", "handoff", "_ev", "_value",
-                 "_exc")
+                 "top_p", "seed", "_key", "t_enq", "t_admit", "tc",
+                 "emitted", "pending", "n_cached", "handoff", "resume",
+                 "_ev", "_value", "_exc")
 
     def __init__(self, prompt, max_new, eos_id, temperature, top_k,
                  top_p, seed, handoff=None):
@@ -97,6 +117,7 @@ class DecodeFuture:
         self.temperature = float(temperature or 0.0)
         self.top_k = top_k
         self.top_p = top_p
+        self.seed = int(seed or 0)         # kept for export_session
         # one PRNG stream per request, split once per emitted token —
         # the exact key discipline of Generator.generate's loop, so a
         # sampled request reproduces independently of what else shares
@@ -104,6 +125,7 @@ class DecodeFuture:
         self._key = jax.random.PRNGKey(seed) \
             if self.temperature > 0 else None
         self.handoff = handoff             # remote-prefill admit state
+        self.resume = None                 # migrated-session admit state
         if handoff is not None and self._key is not None:
             # the remote prefill consumed the stream's FIRST split for
             # the first token it ships — advance past it so local
@@ -174,7 +196,8 @@ class ContinuousDecoder:
 
     role = "decode"                       # the hello frame's identity
 
-    def __init__(self, generator, queue_cap=64, logger=None):
+    def __init__(self, generator, queue_cap=64, logger=None,
+                 install_sigterm=False):
         if getattr(generator, "_rolling", False):
             raise ValueError(
                 "continuous batching does not support rolling caches "
@@ -212,12 +235,21 @@ class ContinuousDecoder:
         self._cond = threading.Condition(self._lock)
         self._draining = False
         self._closed = False
+        # replay dedup (admit id -> the admission's own future): a
+        # fleet-router replay after a transient fault returns the
+        # ORIGINAL admission instead of double-admitting
+        self._dedup = OrderedDict()
+        self._evac_waiters = []                # (Event, [result]) pairs
+        self._evac_flag = False                # SIGTERM handler sets
 
         self._admitted = 0
         self._finished = 0
         self._steps = 0
         self._prefills = 0
         self._imported = 0
+        self._resumed = 0
+        self._evacuated = 0
+        self._deduped = 0
         self._g_active = _telemetry.gauge("serve.decode.active_slots")
         # pool-measured twin of the Generator's static sizing gauge:
         # actual device-array bytes of the live cache pytree per slot.
@@ -241,6 +273,19 @@ class ContinuousDecoder:
         self._c_steps = _telemetry.counter("serve.decode.steps")
         self._c_imported = _telemetry.counter("serve.decode.imported")
         self._h_import = _telemetry.histogram("serve.decode.import_ms")
+        self._c_resumed = _telemetry.counter("serve.decode.resumed")
+        self._c_evacuated = _telemetry.counter("serve.decode.evacuated")
+        self._c_deduped = _telemetry.counter("serve.decode.deduped")
+
+        self._shutdown = None
+        if install_sigterm:
+            from .. import guardrail as _guardrail
+            self._shutdown = _guardrail.GracefulShutdown(
+                signals=(signal.SIGTERM,), logger=self._log,
+                on_request=self._request_evacuate,
+                action="decode pool evacuating (active sessions "
+                       "export for migration, then the pool drains)"
+            ).install()
 
         slots_hint = str(_config.get("MXNET_DECODE_SLOTS") or "")
         if slots_hint and not slots_hint.startswith("auto"):
@@ -314,15 +359,19 @@ class ContinuousDecoder:
         return "\n".join(lines)
 
     # -- admission ----------------------------------------------------------
-    def _check_blob(self, blob, P=None):
-        """Loud structural validation of a handoff blob BEFORE it is
-        queued: names/shapes/dtypes must match this pool's own cache
-        spec exactly (a blob from a mismatched generator — wrong
+    def _check_blob(self, blob, want_pos=None,
+                    why="the handoff must ship exactly the prompt's "
+                        "prefill state"):
+        """Loud structural validation of a handoff/resume blob BEFORE
+        it is queued: names/shapes/dtypes must match this pool's own
+        cache spec exactly (a blob from a mismatched generator — wrong
         architecture, wrong quantize_kv, wrong dtype — would scatter
         silently-wrong state; device-roundtrip exactness starts with
-        refusing anything that isn't bit-compatible). ``P``: the
-        prompt length the blob must cover exactly (None = trust the
-        blob's own ``pos`` — the bare import_kv_rows surface)."""
+        refusing anything that isn't bit-compatible). ``want_pos``:
+        the cached depth the blob must cover exactly — the prompt
+        length for a handoff, prompt + fed tokens for a migrated
+        session (None = trust the blob's own ``pos`` — the bare
+        import_kv_rows surface)."""
         if not isinstance(blob, dict) or blob.get("v") != 1:
             raise ValueError("kv_blob is not an export_kv_rows v1 "
                              "blob: %r" % (type(blob).__name__,))
@@ -331,11 +380,10 @@ class ContinuousDecoder:
             raise ValueError(
                 "kv_blob pos %d out of range for max_len=%d"
                 % (pos, self._gen.max_len))
-        if P is not None and pos != P:
+        if want_pos is not None and pos != want_pos:
             raise ValueError(
-                "kv_blob covers %d cached token(s) but the prompt is "
-                "%d long — the handoff must ship exactly the prompt's "
-                "prefill state" % (pos, P))
+                "kv_blob covers %d cached token(s) but the admission "
+                "expects %d — %s" % (pos, want_pos, why))
         rows = blob.get("rows") or {}
         if set(rows) != set(self._aux):
             raise ValueError(
@@ -397,9 +445,42 @@ class ContinuousDecoder:
         self._h_import.observe(ms)
         return pos
 
+    def export_session(self, slot):
+        """The portable mid-decode state of one active slot — every
+        piece a survivor needs to continue the sequence bit-exactly:
+        the cache rows at ``pos = prompt + fed`` (device-exact, via
+        the Generator's ``export_kv_rows``), the full request
+        contract (prompt, sampling opts, seed), the emitted tokens
+        and the pending not-yet-fed one. PRNG progress ships as
+        DERIVED state — the stream splits once per drawn token, so
+        ``submit(resume=...)`` re-derives the key by advancing
+        ``len(emitted)`` splits (``generation.replay_key``) instead
+        of trusting a shipped key. Callers outside the decode loop
+        must own a quiescent pool (the loop thread is the aux
+        mutator; :meth:`evacuate` runs this ON the loop thread)."""
+        slot = int(slot)
+        if not 0 <= slot < self._B:
+            raise ValueError("slot %d out of range for %d-slot pool"
+                             % (slot, self._B))
+        req = self._slots[slot]
+        if req is None:
+            raise ValueError("slot %d holds no active sequence" % slot)
+        blob = self._gen.export_kv_rows(self._aux, slot, req.n_cached)
+        return {"v": 1,
+                "prompt": np.asarray(req.prompt, np.int64),
+                "max_new_tokens": int(req.max_new),
+                "eos_id": req.eos_id,
+                "temperature": req.temperature,
+                "top_k": req.top_k,
+                "top_p": req.top_p,
+                "seed": req.seed,
+                "emitted": [int(t) for t in req.emitted],
+                "pending": int(req.pending),
+                "kv_blob": blob}
+
     def submit(self, prompt, max_new_tokens, eos_id=None,
                temperature=0.0, top_k=None, top_p=None, seed=0,
-               handoff=None):
+               handoff=None, admit_id=None, resume=None):
         """Queue one sequence; returns a :class:`DecodeFuture` whose
         result is the full (prompt + generated) id row, exactly as
         ``Generator.generate`` would emit it for this prompt alone.
@@ -409,12 +490,34 @@ class ContinuousDecoder:
         :class:`PrefillEngine` return). Admission then scatters the
         shipped cache rows into the slot and emits the shipped first
         token — zero prefill graph calls on this replica (asserted by
-        the ``prefills`` stat)."""
+        the ``prefills`` stat).
+
+        ``admit_id``: opaque exactly-once token (the fleet router
+        sends one per generate). A resubmission carrying an id this
+        replica has already admitted returns the ORIGINAL admission's
+        future — a failover replay after a transient transport fault
+        can never double-admit onto a replica that actually survived.
+
+        ``resume``: an :meth:`export_session` state dict — readmit a
+        session migrated off another replica mid-decode. The request
+        args must describe the SAME request (the router re-sends the
+        originals); the state supplies the progress: emitted tokens,
+        the pending not-yet-fed token, and the cache rows, which
+        scatter at ``pos = prompt + fed`` with zero prefill graph
+        calls. The PRNG stream re-derives its key by advancing
+        ``len(emitted)`` splits (``generation.replay_key``), so the
+        remaining tokens are bit-identical to an unmigrated run."""
         self._gen._check_sampling(temperature, top_k, top_p)
         prompt = np.asarray(prompt, np.int64).reshape(-1)
         P, n = int(prompt.shape[0]), int(max_new_tokens)
         if P < 1:
             raise ValueError("empty prompt")
+        if handoff is not None and resume is not None:
+            raise ValueError(
+                "handoff and resume are mutually exclusive — a "
+                "migrated session's state already contains its cache "
+                "rows; the original handoff was consumed before the "
+                "export")
         if handoff is not None:
             if not isinstance(handoff, dict) or \
                     "first_token" not in handoff or \
@@ -427,6 +530,60 @@ class ContinuousDecoder:
             # thread — a mismatched blob must fail the submission
             # loudly, never reach the decode loop
             self._check_blob(handoff["kv_blob"], P)
+        emitted = None
+        if resume is not None:
+            if not isinstance(resume, dict) or resume.get("v") != 1 \
+                    or "kv_blob" not in resume \
+                    or "emitted" not in resume:
+                raise ValueError(
+                    "resume wants an export_session() state dict, "
+                    "got %r" % (type(resume).__name__,))
+            if not np.array_equal(
+                    prompt, np.asarray(resume["prompt"],
+                                       np.int64).reshape(-1)):
+                raise ValueError(
+                    "resume state is for a different prompt — the "
+                    "request args and the migrated state must "
+                    "describe the same generate")
+            emitted = [int(t) for t in resume["emitted"]]
+            if not emitted:
+                raise ValueError(
+                    "resume state carries no emitted tokens — a "
+                    "session exports only after its first emission; "
+                    "replay the request from scratch instead")
+            if len(emitted) >= n:
+                raise ValueError(
+                    "resume state already holds %d emitted token(s) "
+                    "of a max_new_tokens=%d request — nothing left "
+                    "to decode" % (len(emitted), n))
+            # the request args are authoritative, but they must
+            # RESTATE the migrated request: a resume admitted under
+            # different sampling opts would continue the stream
+            # silently diverged from the donor (the PRNG key and the
+            # pick discipline both derive from these args)
+            for fld, have in (
+                    ("temperature", float(temperature or 0.0)),
+                    ("top_k", top_k), ("top_p", top_p),
+                    ("seed", int(seed or 0))):
+                theirs = resume.get(fld)
+                if fld == "temperature":
+                    theirs = float(theirs or 0.0)
+                elif fld == "seed":
+                    theirs = int(theirs or 0)
+                if theirs != have:
+                    raise ValueError(
+                        "resume state was exported with %s=%r but "
+                        "this admission says %s=%r — the request "
+                        "args must restate the migrated request "
+                        "(the resumed stream would silently "
+                        "diverge)" % (fld, theirs, fld, have))
+            # after k emitted tokens the last one is still pending
+            # (not yet fed through the step), so the cache covers
+            # exactly P + k - 1 positions
+            self._check_blob(
+                resume["kv_blob"], P + len(emitted) - 1,
+                why="a migrated session's rows must cover prompt + "
+                    "fed tokens")
         if P + n > self._gen.max_len:
             raise ValueError(
                 "prompt (%d) + max_new_tokens (%d) exceeds the cache "
@@ -439,10 +596,32 @@ class ContinuousDecoder:
                 % (P, n, self._gen._pos_rows))
         req = DecodeFuture(prompt, n, eos_id, temperature, top_k,
                            top_p, seed, handoff=handoff)
+        if resume is not None:
+            # PRNG progress is DERIVED state: one split per drawn
+            # token, whatever path drew it (local pick or remote
+            # handoff) — re-derive rather than ship a key
+            if req._key is not None:
+                req._key = replay_key(req.seed, len(emitted))
+            req.emitted = emitted
+            req.pending = int(resume["pending"])
+            req.resume = resume["kv_blob"]
         if n == 0:                        # generate()'s n=0 contract
             req._finish_ok()
             return req
+        if admit_id is not None:
+            admit_id = str(admit_id)
         with self._cond:
+            if admit_id is not None:
+                prev = self._dedup.get(admit_id)
+                if prev is not None:
+                    # exactly-once admit: the replay rides the
+                    # original admission (checked before the draining
+                    # gate so a replayed request can still collect
+                    # its answer from a draining replica)
+                    self._dedup.move_to_end(admit_id)
+                    self._deduped += 1
+                    self._c_deduped.inc()
+                    return prev
             if self._draining or self._closed:
                 raise EngineClosed(
                     "decoder is draining — sequence rejected")
@@ -451,6 +630,10 @@ class ContinuousDecoder:
                 raise Overloaded(
                     "decode queue full (%d sequences)"
                     % len(self._queue))
+            if admit_id is not None:
+                self._dedup[admit_id] = req
+                while len(self._dedup) > _DEDUP_CAP:
+                    self._dedup.popitem(last=False)
             self._queue.append(req)
             self._admitted += 1
             self._c_admitted.inc()
@@ -469,8 +652,16 @@ class ContinuousDecoder:
             temperature=payload.get("temperature") or 0.0,
             top_k=payload.get("top_k"), top_p=payload.get("top_p"),
             seed=payload.get("seed") or 0,
-            handoff=payload.get("handoff"))
-        return fut.result(payload.get("timeout"))
+            handoff=payload.get("handoff"),
+            admit_id=payload.get("admit_id"),
+            resume=payload.get("resume"))
+        try:
+            return fut.result(payload.get("timeout"))
+        except SessionEvacuated as exc:
+            # the reply IS the session's portable state — the fleet
+            # router resumes it on a survivor (serve/router.py) rather
+            # than surfacing an error for a request nothing lost
+            return {"evacuated": exc.state}
 
     def generate_many(self, prompts, max_new_tokens, eos_id=None,
                       timeout=None, **kwargs):
@@ -513,6 +704,32 @@ class ContinuousDecoder:
         req.pending = tok
         self._maybe_finish(slot, tok)
 
+    def _admit_resume(self, slot, req):
+        """Admit one migrated mid-decode session: scatter its exported
+        rows at ``pos = prompt + fed`` and continue the stream — no
+        first-token emission (the state already carries the pending
+        token) and no prefill graph call. A bad blob fails THAT
+        request's future; the loop and the other slots are
+        untouched."""
+        t0 = _telemetry.now_ms()
+        try:
+            pos = self.import_kv_rows(slot, req.resume)
+        except Exception as exc:          # noqa: BLE001 — the future
+            # is this sequence's one response; an import failure must
+            # not kill the decode loop for every other slot
+            req._fail(exc)
+            return
+        self._slots[slot] = req
+        req.resume = None      # the rows live on device now
+        req.t_admit = _telemetry.now_ms()
+        req.n_cached = pos
+        self._resumed += 1
+        self._c_resumed.inc()
+        if _trace.enabled():
+            _trace.add_span("serve.decode.resume", t0, req.t_admit,
+                            parent=req.tc, slot=slot, pos=pos,
+                            emitted=len(req.emitted))
+
     def _admit(self):
         """Move queued prompts into free slots. Remote-prefilled
         sequences (a ``handoff`` rode the submit) scatter their
@@ -532,6 +749,9 @@ class ContinuousDecoder:
                      for _ in range(min(len(free), len(self._queue)))]
         by_len = {}
         for req in batch:
+            if req.resume is not None:
+                self._admit_resume(free.pop(0), req)
+                continue
             if req.handoff is not None:
                 self._admit_handoff(free.pop(0), req)
                 continue
@@ -635,15 +855,99 @@ class ContinuousDecoder:
         while True:
             with self._cond:
                 while not self._queue and not self._draining and \
+                        not self._evac_waiters and \
+                        not self._evac_flag and \
                         all(s is None for s in self._slots):
                     self._cond.wait(0.05)
                 if self._draining and not self._queue and \
+                        not self._evac_waiters and \
+                        not self._evac_flag and \
                         all(s is None for s in self._slots):
                     break
+            if self._evac_waiters or self._evac_flag:
+                self._do_evacuate()
+                continue
             self._admit()
             self._step()
         self._g_active.set(0)
         _telemetry.journal_event("serve.decode.stop")
+
+    # -- migration ----------------------------------------------------------
+    def evacuate(self, timeout=30.0):
+        """Export every active session off the pool: each in-flight
+        generate's future fails with :class:`SessionEvacuated`
+        carrying its :meth:`export_session` state (the wire handler
+        turns that into an ``evacuated`` reply the fleet router
+        resumes on a survivor); queued-but-unadmitted requests fail
+        with ``EngineClosed`` and replay from scratch. The export runs
+        on the decode loop thread (the pool's one aux mutator); this
+        call blocks until it completes and returns the number of
+        sessions exported. The pool itself stays OPEN — a
+        config-reload recycle re-warms and readmits this replica —
+        so a migrating recycle is bounded by export+import cost, not
+        by its longest sequence."""
+        ev = threading.Event()
+        out = []
+        with self._cond:
+            if self._closed:
+                raise EngineClosed("decoder is closed")
+            self._evac_waiters.append((ev, out))
+            self._cond.notify_all()
+        if not ev.wait(timeout):
+            raise RequestTimeout(
+                "evacuation still pending after %.3fs" % timeout)
+        return out[0]
+
+    def _request_evacuate(self):
+        # SIGTERM-handler context (guardrail.GracefulShutdown): set
+        # the flag only — no locks, no telemetry, no XLA. The decode
+        # loop notices within one 0.05s cond-wait tick.
+        self._evac_flag = True
+
+    def _do_evacuate(self):
+        """Runs ON the decode loop thread: export + fail every active
+        slot, reject the queue, wake the evacuate() waiters. A SIGTERM
+        evacuation (``_evac_flag``) also drains the pool — the process
+        is ending, so there is nothing to readmit for."""
+        t0 = _telemetry.now_ms()
+        with self._cond:
+            waiters, self._evac_waiters = self._evac_waiters, []
+            sig, self._evac_flag = self._evac_flag, False
+            if sig:
+                self._draining = True
+            queued = list(self._queue)
+            self._queue.clear()
+        n = 0
+        for slot in range(self._B):
+            req = self._slots[slot]
+            if req is None:
+                continue
+            try:
+                state = self.export_session(slot)
+            except Exception as exc:      # noqa: BLE001 — the future
+                # is this sequence's one response; a failed export
+                # must surface there, not kill the loop
+                self._slots[slot] = None
+                req._fail(exc)
+                continue
+            self._slots[slot] = None
+            req._fail(SessionEvacuated(state))
+            n += 1
+        for req in queued:
+            req._fail(EngineClosed(
+                "evacuated before admission — replay the request on "
+                "another replica"))
+        self._evacuated += n
+        if n:
+            self._c_evacuated.inc(n)
+        self._g_active.set(0)
+        _telemetry.journal_event(
+            "serve.decode.evacuate", sessions=n, queued=len(queued),
+            sigterm=bool(sig),
+            ms=round(_telemetry.now_ms() - t0, 3))
+        for ev, out in waiters:
+            out.append(n)
+            ev.set()
 
     # -- lifecycle ----------------------------------------------------------
     @property
@@ -669,6 +973,9 @@ class ContinuousDecoder:
                                      pending=pending)
         self._thread.join(timeout)
         self._closed = True
+        if self._shutdown is not None:
+            self._shutdown.uninstall()
+            self._shutdown = None
 
     def __enter__(self):
         return self
@@ -680,7 +987,9 @@ class ContinuousDecoder:
     def stats(self):
         return {"admitted": self._admitted, "finished": self._finished,
                 "steps": self._steps, "prefills": self._prefills,
-                "imported": self._imported,
+                "imported": self._imported, "resumed": self._resumed,
+                "evacuated": self._evacuated,
+                "deduped": self._deduped,
                 "active": sum(s is not None for s in self._slots),
                 "queued": len(self._queue)}
 

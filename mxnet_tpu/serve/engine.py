@@ -57,7 +57,8 @@ from .. import telemetry as _telemetry
 from .. import trace as _trace
 
 __all__ = ["ServeEngine", "ServeFuture", "ServeError", "Overloaded",
-           "RequestTimeout", "EngineClosed", "typed_error"]
+           "RequestTimeout", "EngineClosed", "SessionEvacuated",
+           "typed_error"]
 
 
 class ServeError(RuntimeError):
@@ -82,6 +83,23 @@ class RequestTimeout(ServeError):
 class EngineClosed(ServeError):
     """The engine is draining (close() or SIGTERM): admitted requests
     finish, new ones are rejected with this."""
+
+
+class SessionEvacuated(ServeError):
+    """An in-flight decode session was exported off its replica
+    (migrating recycle or SIGTERM — ``ContinuousDecoder.evacuate``):
+    ``.state`` carries the portable session dict from
+    ``export_session`` instead of a finished row. This never crosses
+    the wire as a typed error — the generate handler catches it and
+    answers an ``evacuated`` reply, which the fleet router resumes on
+    a survivor token-exactly (docs/robustness.md, fleet failure
+    semantics)."""
+
+    def __init__(self, state):
+        super().__init__(
+            "session evacuated after %d emitted token(s) — resume it "
+            "on a survivor" % len(state.get("emitted") or ()))
+        self.state = state
 
 
 _TYPED = {c.__name__: c for c in (Overloaded, RequestTimeout,

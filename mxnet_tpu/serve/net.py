@@ -169,6 +169,28 @@ class ServeServer:
             except Exception as exc:      # noqa: BLE001 — reply = report
                 return ("err", "ServeError",
                         "%s: %s" % (type(exc).__name__, exc))
+        if op == "evacuate":
+            # migration frame: export every active decode session off
+            # this replica — each in-flight generate answers with its
+            # portable state instead of a row, and the fleet router
+            # resumes it on a survivor (docs/robustness.md). Duck-typed
+            # like everything else: an engine without evacuate() (a
+            # batch ServeEngine, a PrefillEngine, a router) declines
+            # typed, and the router falls back to a full drain.
+            fn = getattr(self._engine, "evacuate", None)
+            if not callable(fn):
+                return ("err", "ServeError",
+                        "engine %s has no evacuate() — not a "
+                        "migratable replica"
+                        % type(self._engine).__name__)
+            try:
+                return ("ok", fn())
+            except _engine.ServeError as exc:
+                return ("err", type(exc).__name__, str(exc))
+            except Exception as exc:      # noqa: BLE001 — reply = report
+                self._log.exception("serve: evacuate handling failed")
+                return ("err", "ServeError",
+                        "%s: %s" % (type(exc).__name__, exc))
         if op == "stats":
             # introspection frame: the telemetry registry snapshot +
             # live engine state (queue depth, warmed buckets). Read by
@@ -439,15 +461,23 @@ class ServeClient:
 
     def generate(self, prompt, max_new_tokens, eos_id=None,
                  temperature=0.0, top_k=None, top_p=None, seed=0,
-                 session=None, handoff=None, timeout=None):
+                 session=None, handoff=None, timeout=None,
+                 admit_id=None, resume=None):
         """The ``generate`` frame: admit one sequence on the remote
         replica (with its ``handoff`` blob when a remote prefill ran)
         and block for the full id row. Replay caveat: a transport
-        fault AFTER the admission landed replays the whole admit — the
-        orphaned first admission still decodes to completion and
-        frees its slot, and both admissions emit identical tokens
-        (greedy, or the same per-request PRNG stream), so the caller
-        still sees exactly one, correct response.
+        fault AFTER the admission landed replays the whole admit —
+        without an ``admit_id`` the orphaned first admission still
+        decodes to completion and frees its slot, and both admissions
+        emit identical tokens (greedy, or the same per-request PRNG
+        stream), so the caller still sees exactly one, correct
+        response; WITH an ``admit_id`` (the fleet router always sends
+        one) the replay rides the original admission outright —
+        exactly-once admit, no orphan.
+
+        ``resume``: an evacuated session's ``export_session`` state —
+        readmit a migrated sequence mid-decode
+        (``ContinuousDecoder.submit(resume=...)``).
 
         The wire read is bounded by ``timeout`` (plus this client's
         io timeout as slack) when one is given, and UNBOUNDED
@@ -465,6 +495,10 @@ class ServeClient:
             payload["handoff"] = handoff
         if timeout is not None:
             payload["timeout"] = timeout
+        if admit_id is not None:
+            payload["admit_id"] = admit_id
+        if resume is not None:
+            payload["resume"] = resume
         rsp = _trace.start_span("serve.generate.request",
                                 tokens=int(payload["prompt"].size),
                                 max_new=payload["max_new_tokens"])
@@ -510,6 +544,16 @@ class ServeClient:
         router calls this on a freshly recycled replica before
         readmitting it."""
         return self._simple_op("warm", "serve.warm")
+
+    def evacuate(self):
+        """The ``evacuate`` frame: export every active decode session
+        off the replica — each in-flight generate answers with its
+        portable state instead of a row, and queued admissions fail
+        for replay. Returns the number of sessions exported. The fleet
+        router sends this at the start of a migrating recycle so the
+        drain is bounded by export+import cost, not longest-sequence
+        completion (docs/robustness.md, fleet failure semantics)."""
+        return self._simple_op("evacuate", "serve.evacuate")
 
     def close(self):
         with self._lock:

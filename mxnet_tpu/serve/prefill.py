@@ -22,7 +22,13 @@ split of the request's PRNG stream, so the decode side continues the
 rows exported via :meth:`Generator.export_kv_rows`. Prefill is PURE:
 the same prompt + seed always lands the same reply, so a transport
 fault mid-handoff simply replays (no dedup table, exactly like the
-infer path's contract in serve/net.py).
+infer path's contract in serve/net.py). The same purity is one leg
+of the fleet's replica-death failover: when a decode replica dies
+mid-generate, the router replays the whole request — a re-run
+prefill (local or remote) recomputes the identical first token and
+blob, so the replayed completion is token-for-token what the dead
+replica would have emitted (docs/robustness.md, fleet failure
+semantics).
 
 No sockets here — transport is serve/net.py's job (lint-enforced).
 """

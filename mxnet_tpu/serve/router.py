@@ -45,6 +45,22 @@ one logical service, load balanced and failure-masked. The
   process), re-warms the declared buckets over the wire, and
   readmits. A client sweep running throughout observes exactly one
   response per request.
+* **Replica death survival** (docs/robustness.md, fleet failure
+  semantics) — the router is the durable owner of every generate's
+  recovery state. When the replica pinned to an in-flight generate
+  dies mid-call (transport fault + failed control probe), the
+  request REPLAYS on a survivor from its retained recovery record
+  (prompt, sampling opts, seed, handoff blob) — token-for-token
+  identical, because prefill is pure and per-request PRNG streams
+  split once per emitted token; every generate carries an admit id,
+  so a replay onto a replica that actually survived rides the
+  original admission (decode-side dedup — exactly-once admit). A
+  recycle of a decode-role replica EVACUATES instead of draining:
+  active sessions export mid-decode (``evacuate`` frame) and resume
+  on survivors bit-exactly, so the restart is bounded by
+  export+import cost, not the longest sequence in flight.
+  ``MXNET_ROUTER_FAILOVER`` / ``MXNET_ROUTER_MIGRATION_LIMIT``
+  govern both paths.
 
 The router IS an engine to the front end: ``ServeServer(router)``
 serves the same wire (infer/ping/stats/hello/warm frames) — clients
@@ -54,7 +70,9 @@ socket (lint-enforced, tools/perf_gate.sh).
 """
 from __future__ import annotations
 
+import itertools
 import logging
+import os
 import threading
 import time
 from collections import OrderedDict, deque
@@ -243,6 +261,27 @@ class ServeRouter:
         self._h_handoff = _telemetry.histogram(
             "serve.router.handoff_bytes",
             buckets=tuple(float(1 << s) for s in range(10, 27, 2)))
+        # replica-death survival accounting (docs/robustness.md):
+        # replays = generate attempts re-sent after a transport fault
+        # (same replica when the probe says it lives, a survivor when
+        # it is dead); failovers = the dead-replica subset of those;
+        # migrations = evacuated sessions resumed on a survivor;
+        # evacuations = evacuate frames a migrating recycle sent
+        self._c_failovers = _telemetry.counter("serve.router.failovers")
+        self._c_replays = _telemetry.counter("serve.router.replays")
+        self._c_migrations = _telemetry.counter(
+            "serve.router.migrations")
+        self._c_evacuations = _telemetry.counter(
+            "serve.router.evacuations")
+        self._failover = bool(_config.get("MXNET_ROUTER_FAILOVER"))
+        self._migration_limit = int(
+            _config.get("MXNET_ROUTER_MIGRATION_LIMIT"))
+        # admit-id source (PR 1's (cid, seq) pattern on the serving
+        # side): unique per router instance ACROSS processes, so two
+        # routers sharing a fleet can never collide in a replica's
+        # dedup table
+        self._admit_cid = "g%d.%x" % (os.getpid(), id(self) & 0xFFFFFF)
+        self._admit_seq = itertools.count(1)
 
         _telemetry.journal_event("serve.router.start",
                                  poll_ms=self._poll_ms)
@@ -414,8 +453,38 @@ class ServeRouter:
         # notify_all() that condition constantly, which would wake the
         # poller after nearly every request and turn the configured
         # poll period into a continuous stats hammer under load
+        failing = False
         while not self._poll_stop.wait(self._poll_ms / 1000.0):
-            self.poll_now()
+            try:
+                self.poll_now()
+                failing = False
+            except Exception:  # noqa: BLE001 — the poller must outlive
+                # any one bad stats frame: an uncaught error here used
+                # to kill the thread silently, freezing load scores
+                # and suspect revival for the router's lifetime. Log
+                # the FIRST failure of a streak loudly, the rest at
+                # debug (a flapping replica must not flood the log).
+                if not failing:
+                    self._log.exception(
+                        "router: poll_now failed — poller keeps "
+                        "running (repeats logged at debug)")
+                else:
+                    self._log.debug("router: poll_now failed again",
+                                    exc_info=True)
+                failing = True
+
+    def _probe(self, rep):
+        """Is the replica's process demonstrably alive? One control
+        ping — the failover discriminator between a transport blip on
+        a surviving replica (replay to the SAME replica; its admit-id
+        dedup makes that exactly-once) and a dead one (replay on a
+        survivor). Any failure to answer means dead for failover
+        purposes; the poller keeps probing afterwards and revives it
+        when it answers stats again."""
+        try:
+            return bool(rep.control.ping())
+        except Exception:  # noqa: BLE001 — unreachable = not alive
+            return False
 
     def _mark_suspect(self, rep, exc):
         with self._lock:
@@ -712,16 +781,61 @@ class ServeRouter:
                     - (_telemetry.now_ms() - t_entry) / 1000.0)
             else:
                 leg_timeout = 120.0 + float(max_new_tokens)
-            out = self._route(
-                P, session, None,
-                lambda c: c.generate(prompt, max_new_tokens,
-                                     eos_id=eos_id,
-                                     temperature=temperature,
-                                     top_k=top_k, top_p=top_p,
-                                     seed=seed, session=session,
-                                     handoff=handoff,
-                                     timeout=leg_timeout),
-                want=want, span="serve.router.decode")
+            # the recovery record: every attempt of this generate —
+            # first dispatch, failover replay, migration resume —
+            # re-sends the same request under ONE admit-id lineage,
+            # so a replay onto a replica that already admitted it
+            # rides the original admission (exactly-once)
+            admit_id = "%s:%d" % (self._admit_cid,
+                                  next(self._admit_seq))
+
+            def leg(c, resume=None, aid=admit_id):
+                return c.generate(prompt, max_new_tokens,
+                                  eos_id=eos_id,
+                                  temperature=temperature,
+                                  top_k=top_k, top_p=top_p,
+                                  seed=seed, session=session,
+                                  handoff=None if resume is not None
+                                  else handoff,
+                                  timeout=leg_timeout,
+                                  admit_id=aid, resume=resume)
+            out = self._route(P, session, None, leg, want=want,
+                              span="serve.router.decode",
+                              recoverable=True)
+            hops = 0
+            while isinstance(out, dict) and "evacuated" in out:
+                # the replica exported this in-flight session instead
+                # of finishing it (migrating recycle / SIGTERM
+                # evacuation): resume the portable state on a
+                # survivor. The session re-pins where the resume
+                # lands; the resumed stream re-derives its PRNG key
+                # by advancing the same splits, so the remaining
+                # tokens are bit-identical to an unmigrated run.
+                mstate = out["evacuated"]
+                hops += 1
+                if hops > self._migration_limit:
+                    raise EngineClosed(
+                        "generate migrated %d times without "
+                        "completing (MXNET_ROUTER_MIGRATION_LIMIT="
+                        "%d) — the fleet is evacuating faster than "
+                        "it decodes" % (hops - 1,
+                                        self._migration_limit))
+                self._c_migrations.inc()
+                _telemetry.journal_event(
+                    "serve.router.migrate", hop=hops,
+                    session=str(session),
+                    tokens=len(mstate.get("emitted") or ()))
+                out = self._route(
+                    P, session, None,
+                    lambda c, s=mstate, h=hops: leg(
+                        c, resume=s,
+                        # a fresh id per hop: a resume that bounces
+                        # back to a re-opened replica must never
+                        # collide with a STALE dedup entry from an
+                        # earlier life of this request
+                        aid="%s:m%d" % (admit_id, h)),
+                    want=want, span="serve.router.migrate",
+                    recoverable=True)
             self._c_generates.inc()
             return out
         finally:
@@ -757,14 +871,25 @@ class ServeRouter:
                                           session=session))
 
     def _route(self, rows, session, tc, call, want=None,
-               span="serve.router.dispatch"):
+               span="serve.router.dispatch", recoverable=False):
         """THE dispatch scaffolding every routed wire op shares —
         pick-and-charge, shed-and-retry via the RetryPolicy reroute
         hook, suspect marking, session-pin hygiene. ``call(client)``
         performs the actual round trip (infer / prefill / generate);
         ``want`` restricts candidates to a role (disaggregated legs);
         ``span`` names the dispatch span (the infer path keeps its
-        established ``serve.router.dispatch`` vocabulary)."""
+        established ``serve.router.dispatch`` vocabulary).
+
+        ``recoverable``: the generate-failover contract — ``call`` is
+        a full recovery record (the router re-sends prompt, sampling
+        opts, seed and handoff on every attempt, under one admit id).
+        A transport fault on an ESTABLISHED session then probes the
+        pinned replica: alive → replay to it (the decode-side dedup
+        admits exactly once); dead → drop the pin and replay on a
+        survivor, token-for-token identical. Without it (infer legs,
+        or ``MXNET_ROUTER_FAILOVER`` off) an established session's
+        fault retries only its own replica, the pre-failover
+        behavior."""
         t0 = _telemetry.now_ms()
         excluded = set()                 # replicas that shed THIS req
         fresh_pins = set()               # pins THIS dispatch placed
@@ -809,10 +934,47 @@ class ServeRouter:
             if rep is not None:
                 self._mark_suspect(rep, exc)
                 if state["established"]:
-                    # the session's KV state lives on that replica:
-                    # the retry goes back to it (a blip heals, a dead
-                    # replica exhausts the budget — rerouting would
-                    # silently orphan the decode state instead)
+                    if not (recoverable and self._failover):
+                        # the session's KV state lives on that
+                        # replica: the retry goes back to it (a blip
+                        # heals, a dead replica exhausts the budget —
+                        # rerouting would silently orphan the decode
+                        # state instead)
+                        return
+                    if self._probe(rep):
+                        # the replica survived — the fault was the
+                        # wire's. Replay to the pin: the dedup table
+                        # returns the original admission, so the
+                        # replay admits exactly once
+                        self._c_replays.inc()
+                        _trace.instant("serve.router.replay",
+                                       replica=rep.name)
+                        return
+                    # the pinned replica is DEAD mid-generate: drop
+                    # the pin and replay the full recovery record on
+                    # a survivor — prefill is pure and the request's
+                    # PRNG stream splits once per emitted token, so
+                    # the replayed completion is token-for-token
+                    # identical to what the dead replica would have
+                    # finished
+                    with self._lock:
+                        if self._sessions.get(session) == rep.name:
+                            self._sessions.pop(session, None)
+                    self._c_failovers.inc()
+                    self._c_replays.inc()
+                    _telemetry.journal_event("serve.router.failover",
+                                             name=rep.name,
+                                             session=str(session))
+                    _trace.instant("serve.router.failover",
+                                   replica=rep.name)
+                    self._log.warning(
+                        "router: replica %s dead mid-generate (probe "
+                        "failed) — replaying session %r on a "
+                        "survivor", rep.name, session)
+                    if self._has_other_candidate(rep, excluded, want):
+                        rep.rerouted_from += 1
+                        state["reroutes"] += 1
+                        self._c_rerouted.inc()
                     return
                 if session is not None:
                     # a SPECULATIVE pin (this dispatch placed it, no
@@ -919,10 +1081,15 @@ class ServeRouter:
 
         1. stop routing new work to it (state -> draining; dispatch
            excludes it from the same instant, under the same lock);
-        2. wait for the router's own in-flight count to reach zero
-           (condition-signaled, exact) and for the replica's
-           stats-observed engine ``in_flight``/``queue_depth`` to
-           reach zero (covers other frontends);
+        2. for a decode-role replica, EVACUATE first: the ``evacuate``
+           frame exports every active session mid-decode, the blocked
+           generate dispatches resume them on survivors (bit-exact —
+           docs/robustness.md), and the drain below is bounded by
+           export+import cost instead of the longest sequence in
+           flight. Then wait for the router's own in-flight count to
+           reach zero (condition-signaled, exact) and for the
+           replica's stats-observed engine ``in_flight``/
+           ``queue_depth`` to reach zero (covers other frontends);
         3. run ``restart()`` — the operator hook that actually
            restarts the replica (SIGTERM → GracefulShutdown drain →
            fresh process, a k8s pod delete, or an in-process
@@ -943,7 +1110,11 @@ class ServeRouter:
         ``MXNET_DECODE_DRAIN_TIMEOUT`` instead — the same clock its
         own ``ContinuousDecoder.close`` honors, validated loudly
         there, so a decode drain is never cut short by a router knob
-        tuned for batch replicas)."""
+        tuned for batch replicas). A drain timeout fails OPEN, never
+        stranding the replica in DRAINING: decode-role replicas park
+        SUSPECT (wedged sequences make the replica suspect by
+        definition; the next successful poll revives it), other
+        roles return LIVE."""
         with self._lock:
             # ONE lock section from lookup to the DRAINING flip — a
             # concurrent remove_replica must not slip between them and
@@ -977,6 +1148,39 @@ class ServeRouter:
         t0 = _telemetry.now_ms()
         _telemetry.journal_event("serve.router.recycle",
                                  name=name, phase="drain")
+        if rep.role == "decode":
+            # migrating recycle: evacuate active sessions FIRST —
+            # each in-flight generate on this replica answers with
+            # its portable state and resumes on a survivor (the
+            # dispatch threads repin it there), so the drain below
+            # is bounded by export+import cost instead of the
+            # longest sequence in flight. A replica that declines
+            # (no evacuate(): an old build) falls back to the full
+            # drain; an unreachable one is already dead — the drain
+            # loop below classifies that as drained.
+            try:
+                evacuated = rep.control.evacuate()
+                self._c_evacuations.inc()
+                _telemetry.journal_event(
+                    "serve.router.recycle", name=name,
+                    phase="evacuate", sessions=int(evacuated or 0))
+            except ServeError as exc:
+                self._log.warning(
+                    "router: %s declined evacuation (%s) — falling "
+                    "back to a full decode drain", name, exc)
+            except Exception as exc:      # noqa: BLE001 — transport:
+                self._log.warning(
+                    "router: evacuate frame to %s failed (%s) — "
+                    "continuing with the drain", name, exc)
+        # a decode replica that cannot drain is suspect by definition
+        # (sequences wedged past their own drain clock); any other
+        # role fails open LIVE — its requests are short, the timeout
+        # usually means budget misconfiguration, and SUSPECT would
+        # deprioritize a working replica. Either way the replica is
+        # never stranded DRAINING: the next successful poll revives
+        # a suspect, and LIVE routes immediately.
+        fail_open = ReplicaState.SUSPECT if rep.role == "decode" \
+            else ReplicaState.LIVE
         timed_out = 0
         with self._cond:
             while rep.inflight > 0:
@@ -985,12 +1189,12 @@ class ServeRouter:
                     # re-checked AFTER every wait: a wait that times
                     # out concurrently with the last completion must
                     # re-read the predicate, not fail a finished drain
-                    rep.state = ReplicaState.LIVE   # fail open
+                    rep.state = fail_open
                     timed_out = rep.inflight
                     break
                 self._cond.wait(remain)
         if timed_out:
-            self._update_gauges()         # the fail-open is LIVE again
+            self._update_gauges()         # the fail-open is routable
             raise TimeoutError(
                 "replica %r still has %d router-dispatched "
                 "request(s) in flight after %.1fs drain budget"
@@ -1010,7 +1214,7 @@ class ServeRouter:
                 break
             if time.monotonic() >= deadline:
                 with self._lock:
-                    rep.state = ReplicaState.LIVE   # fail open
+                    rep.state = fail_open
                 self._update_gauges()
                 raise TimeoutError(
                     "replica %r engine still reports %d in flight / "

@@ -389,6 +389,108 @@ def _scn_disagg():
                                 telemetry.now_ms() - t0, 3))
 
 
+def _scn_failover():
+    """PR 16 surface: fleet survives replica death — two in-process
+    decode replicas behind the router. One pinned replica "dies"
+    (every data send AND the liveness probe dropped) mid-generate:
+    the router fails the pin over and REPLAYS the request on the
+    survivor token-for-token (same prompt + seed => byte-equal row).
+    Then a recycle of the replica holding a live session migrates it
+    mid-decode (evacuate -> resume on a survivor) instead of
+    draining, and the migrated row is byte-equal to an undisturbed
+    run. Failover/replay/migration/evacuation counters, the
+    suspect->revive cycle and the decode resume/dedup counters are
+    all deterministic; the (B, 1) decode step stays ONE compiled
+    executable across the evacuated-slot turnover."""
+    import threading
+    import time
+
+    import numpy as np
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import telemetry
+    from mxnet_tpu.generation import Generator
+    from mxnet_tpu.initializer import Xavier
+    from mxnet_tpu.models import transformer
+    from mxnet_tpu.parallel import make_train_step
+    from mxnet_tpu.parallel.resilience import (FaultInjector,
+                                               install_fault_injector)
+    from mxnet_tpu.serve import ContinuousDecoder, ServeRouter, ServeServer
+    t0 = telemetry.now_ms()
+    V, L, H, DIM, T = 50, 2, 2, 32, 24
+    sym = transformer.get_symbol(V, 12, num_layers=L, num_heads=H,
+                                 dim=DIM, max_len=T,
+                                 pos_encoding="learned")
+    step = make_train_step(sym, optimizer="sgd")
+    mx.random.seed(0)
+    params = step.init_state(Xavier(), {"data": (2, 12),
+                                        "softmax_label": (2, 12)})[0]
+
+    def gen():
+        return Generator(params, V, T, num_layers=L, num_heads=H,
+                         dim=DIM, batch_size=3)
+
+    def cval(name):
+        rec = telemetry.snapshot().get(name) or {}
+        return rec.get("value", 0)
+    d0 = ContinuousDecoder(gen())
+    d1 = ContinuousDecoder(gen())
+    s0, s1 = ServeServer(d0), ServeServer(d1)
+    router = ServeRouter(poll_ms=0)       # scripted polling only
+    router.add_replica(s0.host, s0.port, name="d0")
+    router.add_replica(s1.host, s1.port, name="d1")
+    router.poll_now()
+    p = np.arange(1, 5)
+    kw = {"temperature": 0.8, "top_k": 8, "seed": 7}
+    r1 = router.generate(p, 5, session="s", timeout=120.0, **kw)
+    pin = router.sessions()["s"]
+    idx = int(pin[-1])                    # add_replica order == family
+    # the pinned replica "dies": every data send and the control-path
+    # liveness probe fail from here on
+    inj = install_fault_injector(FaultInjector(
+        "router%d_send:drop@1x*;router%d_ctl_send:drop@1x*"
+        % (idx, idx)))
+    try:
+        r2 = router.generate(p, 5, session="s", timeout=120.0, **kw)
+    finally:
+        install_fault_injector(None)
+    assert inj.fired and {f[0] for f in inj.fired} <= {
+        "router%d_send" % idx, "router%d_ctl_send" % idx}, inj.fired
+    # token-exact replay: same prompt + seed on the survivor
+    assert np.array_equal(r1, r2), (r1, r2)
+    assert router.sessions()["s"] != pin
+    router.poll_now()                     # fault gone -> revive
+    # -- live migration: recycle the replica holding session "m" ----
+    steps0 = cval("serve.decode.steps")
+    box = {}
+
+    def bg():
+        box["row"] = router.generate(np.arange(1, 4), 18, session="m",
+                                     timeout=120.0, temperature=0.8,
+                                     top_k=8, seed=11)
+    th = threading.Thread(target=bg)
+    th.start()
+    while cval("serve.decode.steps") < steps0 + 2:   # mid-decode
+        time.sleep(0.005)
+    router.recycle(router.sessions()["m"], timeout=60.0)
+    th.join(120.0)
+    assert not th.is_alive(), "migrated generate never completed"
+    # byte-equal to an undisturbed run of the same request
+    ver = router.generate(np.arange(1, 4), 18, session="v",
+                          timeout=120.0, temperature=0.8, top_k=8,
+                          seed=11)
+    assert np.array_equal(box["row"], ver), (box["row"], ver)
+    st0, st1 = d0.stats(), d1.stats()
+    assert st0["evacuated"] + st1["evacuated"] == 1, (st0, st1)
+    assert st0["resumed"] + st1["resumed"] == 1, (st0, st1)
+    router.close()
+    for closer in (s0, s1, d0, d1):
+        closer.close()
+    telemetry.journal_event("gate.probe",
+                            failover_elapsed_ms=round(
+                                telemetry.now_ms() - t0, 3))
+
+
 def _scn_decode():
     """PR 9 surface: continuous-batching decode, sequential ragged
     requests so admissions/steps/finishes are exact."""
@@ -469,6 +571,14 @@ SCENARIOS = {
                    "serve.router.replicas_live"),
         "noisy_counters": (), "noisy_events": (),
     },
+    "failover": {
+        "fn": _scn_failover,
+        "desc": "fleet replica death: token-exact generate failover "
+                "+ one live mid-decode session migration",
+        "gauges": ("serve.decode.jit_cache_size",
+                   "serve.router.replicas_live"),
+        "noisy_counters": (), "noisy_events": (),
+    },
 }
 
 # field-path prefix -> the protected property a regression names.
@@ -533,6 +643,33 @@ _PROPERTY_NOTES = (
     ("counts.counters.serve.router.generates",
      "PR 15 disaggregation: completed generate dispatches are exact "
      "for a deterministic request sequence"),
+    ("counts.counters.serve.router.failovers",
+     "PR 16 replica-death failover: a pinned replica whose probe "
+     "fails is failed over exactly once per dead pin (a drift means "
+     "the probe discriminator or pin handoff changed)"),
+    ("counts.counters.serve.router.replays",
+     "PR 16 token-exact replay: the recovery record replays a "
+     "mid-flight generate exactly once — on the survivor after a "
+     "dead pin, on the same replica after a transient fault"),
+    ("counts.counters.serve.router.migrations",
+     "PR 16 live session migration: each mid-decode session a "
+     "recycle evacuates resumes on a survivor exactly once "
+     "(bit-exact continuation, never a from-scratch replay)"),
+    ("counts.counters.serve.router.evacuations",
+     "PR 16 evacuating recycle: a decode-role recycle exports its "
+     "active sessions instead of draining them — the evacuate count "
+     "is exact for a scripted recycle"),
+    ("counts.counters.serve.decode.resumed",
+     "PR 16 migration landing: every evacuated session is admitted "
+     "exactly once via the scatter-only resume path (no re-prefill, "
+     "no divergence)"),
+    ("counts.counters.serve.decode.evacuated",
+     "PR 16 session export: the engine exports exactly the sessions "
+     "the recycle evacuated mid-decode"),
+    ("counts.counters.serve.decode.deduped",
+     "PR 16 exactly-once admission: the decode dedup table swallows "
+     "replayed admits — a drift means the admit-id lineage or the "
+     "dedup window changed"),
     ("counts.counters.serve.router.",
      "PR 14 fleet router: dispatch/suspect/session counters are "
      "exact for a deterministic request sequence"),
