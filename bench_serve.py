@@ -56,6 +56,19 @@ shape; acceptance <= 0.15) and the int8-vs-bf16 blob bytes ratio
 (acceptance <= 0.55). Emitted metric: ``serve_disagg_p99``.
 
     python bench_serve.py --disagg 1:1      # 2 chips vs 2 chips
+
+STREAMING MODE (``--streaming``, docs/serving.md §streaming): the
+PR-17 A/B pair on one in-process decode replica — streamed frames vs
+one-shot (acceptance: streamed TTFT p50 <= 0.25x one-shot total at
+max_new >= 32) and chunked vs monolithic prefill under long-prompt
+load (acceptance: chunked inter-token p99 <= 0.5x unchunked at equal
+replica count). Every sweep row in every mode also now reports
+``ttft_ms``/``inter_token_ms`` quantiles: streaming callables feed
+real per-emission marks, one-shot callables degenerate to TTFT ==
+request latency with null inter-token. Emitted metric:
+``serve_streaming_ttft``.
+
+    python bench_serve.py --streaming
 """
 import argparse
 import json
@@ -522,21 +535,40 @@ def _closed_loop(one_round_trip, conc, requests):
     """THE closed-loop measurement harness both sweep modes share:
     conc client threads x requests round trips of ``one_round_trip()``,
     returning the common row fields (throughput, latency quantiles,
-    error count). Callers fold in their mode-specific extras."""
+    error count). Callers fold in their mode-specific extras.
+
+    TTFT and inter-token quantiles ride every row: a round trip that
+    returns a list of per-emission ``now_ms()`` marks (the streaming
+    callables do) yields true time-to-first-token and gap quantiles;
+    any other return (infer replies, one-shot rows) is a single-shot
+    round trip whose first byte IS the whole reply — TTFT equals the
+    request latency and inter-token is null."""
     from mxnet_tpu import telemetry
 
     lat = [[] for _ in range(conc)]
+    ttft = [[] for _ in range(conc)]
+    gaps = [[] for _ in range(conc)]
     errs = [0] * conc
 
     def client(ci):
         for _ in range(requests):
             t0 = telemetry.now_ms()
             try:
-                one_round_trip()
+                marks = one_round_trip()
             except Exception:  # noqa: BLE001 — shed/timeout counts,
                 errs[ci] += 1  # the row reports them
                 continue
-            lat[ci].append(telemetry.now_ms() - t0)
+            t1 = telemetry.now_ms()
+            lat[ci].append(t1 - t0)
+            if isinstance(marks, list) and marks and \
+                    all(type(m) is float for m in marks):
+                ttft[ci].append(marks[0] - t0)
+                gaps[ci].extend(b - a for a, b in
+                                zip(marks, marks[1:]))
+            else:
+                # infer replies are LISTS of output arrays — only a
+                # list of now_ms() floats is an emission-mark trail
+                ttft[ci].append(t1 - t0)
 
     threads = [threading.Thread(target=client, args=(i,))
                for i in range(conc)]
@@ -547,7 +579,14 @@ def _closed_loop(one_round_trip, conc, requests):
         t.join()
     wall = time.perf_counter() - t0
     flat = sorted(v for row in lat for v in row)
+    tflat = sorted(v for row in ttft for v in row)
+    gflat = sorted(v for row in gaps for v in row)
     done = len(flat)
+
+    def _q(vals):
+        return {"p50": round(telemetry.quantile(vals, 0.50), 3),
+                "p99": round(telemetry.quantile(vals, 0.99), 3)}
+
     return {
         "concurrency": conc,
         "requests": done,
@@ -559,6 +598,8 @@ def _closed_loop(one_round_trip, conc, requests):
             "p99": round(telemetry.quantile(flat, 0.99), 3),
             "mean": round(sum(flat) / done, 3),
         } if done else None,
+        "ttft_ms": _q(tflat) if tflat else None,
+        "inter_token_ms": _q(gflat) if gflat else None,
     }
 
 
@@ -625,6 +666,129 @@ def _run_level(pred, feat, buckets, wait_ms, conc, requests):
     return row
 
 
+def _run_streaming(args):
+    """The --streaming A/B pair (docs/serving.md §streaming), one
+    in-process transformer decode replica behind real TCP each side:
+
+    * streamed vs one-shot — the SAME short-prompt generate with and
+      without frames; the acceptance shape is streamed TTFT p50 <=
+      0.25x the one-shot total latency p50 at max_new >= 32 (the
+      whole point of frames: the first token stops waiting for the
+      last);
+    * chunked vs monolithic prefill — short streamed sessions
+      measured for inter-token gaps while a loader injects
+      long-prompt generates; the acceptance shape is chunked
+      inter-token p99 <= 0.5x unchunked at equal replica count (a
+      monolithic long prefill stalls every active session for its
+      whole forward, a chunk stalls them for one slice).
+
+    Every graph width is warmed before measuring in each config —
+    cold XLA compiles are a one-time cost, not the steady state."""
+    from mxnet_tpu import telemetry
+    from mxnet_tpu.serve import ContinuousDecoder, ServeClient, \
+        ServeServer
+
+    rng = np.random.RandomState(0)
+    short = rng.randint(1, args.lm_vocab, (args.short_prompt,))
+    long_p = rng.randint(1, args.lm_vocab, (args.long_prompt,))
+    max_new = max(int(args.max_new), 32)
+    reps = max(8, min(args.requests, 40))
+
+    def _q(vals):
+        vals = sorted(vals)
+        return {"p50": round(telemetry.quantile(vals, 0.50), 3),
+                "p99": round(telemetry.quantile(vals, 0.99), 3)}
+
+    # -- A/B 1: streamed TTFT vs one-shot total latency ------------
+    dec = ContinuousDecoder(_lm_generator(args, args.slots),
+                            queue_cap=512)
+    srv = ServeServer(dec)
+    try:
+        with ServeClient(srv.host, srv.port) as cli:
+            cli.generate(short, max_new)                      # warm
+            cli.generate(short, max_new, on_token=lambda t: None)
+            oneshot, ttfts, sgaps = [], [], []
+            for _ in range(reps):
+                t0 = telemetry.now_ms()
+                cli.generate(short, max_new)
+                oneshot.append(telemetry.now_ms() - t0)
+            for _ in range(reps):
+                marks = []
+                t0 = telemetry.now_ms()
+                cli.generate(short, max_new, on_token=lambda t:
+                             marks.append(telemetry.now_ms()))
+                ttfts.append(marks[0] - t0)
+                sgaps.extend(b - a for a, b in
+                             zip(marks, marks[1:]))
+    finally:
+        srv.close()
+        dec.close()
+
+    # -- A/B 2: chunked vs monolithic prefill under long load ------
+    def config(chunk):
+        os.environ["MXNET_PREFILL_CHUNK"] = str(chunk)
+        d = ContinuousDecoder(_lm_generator(args, args.slots),
+                              queue_cap=512)
+        s = ServeServer(d)
+        gaps = []
+        try:
+            with ServeClient(s.host, s.port) as cli, \
+                    ServeClient(s.host, s.port) as loader:
+                cli.generate(short, max_new)              # warm the
+                loader.generate(long_p, 2)    # short, long (chunked
+                stop = threading.Event()      # or monolithic) + step
+
+                def load():
+                    while not stop.is_set():
+                        try:
+                            loader.generate(long_p, 2)
+                        except Exception:  # noqa: BLE001 — shed
+                            time.sleep(0.005)
+
+                lt = threading.Thread(target=load)
+                lt.start()
+                time.sleep(0.1)           # load reaches steady state
+                try:
+                    for _ in range(reps):
+                        marks = []
+                        cli.generate(short, max_new, on_token=lambda
+                                     t: marks.append(
+                                         telemetry.now_ms()))
+                        gaps.extend(b - a for a, b in
+                                    zip(marks, marks[1:]))
+                finally:
+                    stop.set()
+                    lt.join()
+        finally:
+            s.close()
+            d.close()
+            os.environ.pop("MXNET_PREFILL_CHUNK", None)
+        return gaps
+
+    chunked = sorted(config(args.prefill_chunk))
+    mono = sorted(config(0))
+    oneshot, ttfts = sorted(oneshot), sorted(ttfts)
+    return {
+        "max_new": max_new,
+        "requests": reps,
+        "oneshot_total_ms": _q(oneshot),
+        "streamed_ttft_ms": _q(ttfts),
+        "streamed_inter_token_ms": _q(sgaps),
+        # acceptance: <= 0.25 at max_new >= 32
+        "ttft_vs_oneshot": round(
+            telemetry.quantile(ttfts, 0.5)
+            / telemetry.quantile(oneshot, 0.5), 4),
+        "chunk": args.prefill_chunk,
+        "long_prompt": int(args.long_prompt),
+        "chunked_inter_token_ms": _q(chunked),
+        "unchunked_inter_token_ms": _q(mono),
+        # acceptance: <= 0.5 at equal replica count
+        "chunked_p99_ratio": round(
+            telemetry.quantile(chunked, 0.99)
+            / telemetry.quantile(mono, 0.99), 4),
+    }
+
+
 def main(argv=None):
     p = argparse.ArgumentParser(description=__doc__)
     p.add_argument("--concurrency", default=None,
@@ -668,8 +832,20 @@ def main(argv=None):
                                               "2")),
                    help="disagg mode: concurrent long-prompt "
                         "generate load threads")
+    p.add_argument("--streaming", action="store_true",
+                   help="streaming A/B pair: streamed-vs-one-shot "
+                        "TTFT and chunked-vs-monolithic prefill "
+                        "inter-token p99 (docs/serving.md "
+                        "§streaming)")
+    p.add_argument("--prefill-chunk", type=int, default=16,
+                   help="streaming mode: MXNET_PREFILL_CHUNK for the "
+                        "chunked side of the prefill A/B")
     p.add_argument("--short-prompt", type=int, default=4)
-    p.add_argument("--long-prompt", type=int, default=96)
+    p.add_argument("--long-prompt", type=int, default=None,
+                   help="loader prompt tokens (default 96; streaming "
+                        "mode 512 — the chunked-prefill A/B needs a "
+                        "prefill wall that dwarfs one-core scheduling "
+                        "noise)")
     p.add_argument("--max-new", type=int, default=16,
                    help="disagg mode: tokens per measured decode "
                         "request (inter-token = wall / this)")
@@ -679,18 +855,29 @@ def main(argv=None):
     p.add_argument("--lm-dim", type=int, default=64)
     p.add_argument("--lm-layers", type=int, default=2)
     p.add_argument("--lm-heads", type=int, default=2)
-    p.add_argument("--lm-max-len", type=int, default=160)
+    p.add_argument("--lm-max-len", type=int, default=None,
+                   help="decode cache length (default 160; streaming "
+                        "mode 544 to hold the long-prompt A/B)")
     p.add_argument("--role", default=None,
                    help=argparse.SUPPRESS)   # internal: child role
     p.add_argument("--serve-replica", action="store_true",
                    help=argparse.SUPPRESS)   # internal: child mode
     args = p.parse_args(argv)
+    if args.long_prompt is None:
+        args.long_prompt = 512 if args.streaming else 96
+    if args.lm_max_len is None:
+        args.lm_max_len = 544 if args.streaming else 160
+    if args.streaming and \
+            args.long_prompt + max(args.max_new, 32) > args.lm_max_len:
+        p.error("--long-prompt + max_new exceeds --lm-max-len")
     if args.work_ms is None:
         args.work_ms = 5.0 if (args.replicas or args.serve_replica) \
             else 0.0
 
     if args.disagg:
         metric, unit = "serve_disagg_p99", "ms/token"
+    elif args.streaming:
+        metric, unit = "serve_streaming_ttft", "ms"
     elif args.replicas:
         metric, unit = "serve_fleet_throughput", "req/s"
     else:
@@ -707,6 +894,30 @@ def main(argv=None):
         if args.role in ("prefill", "decode"):
             return _gen_replica_child(args)
         return _replica_child(args)
+    if args.streaming:
+        try:
+            row = _run_streaming(args)
+        except Exception as e:  # noqa: BLE001 — diagnostic line (the
+            # bench_common fail_payload contract, like the sweeps)
+            try:
+                from bench_common import fail_payload
+                payload = fail_payload(metric, unit, e)
+            except ImportError:
+                payload = {"metric": metric, "value": None,
+                           "unit": unit, "vs_baseline": None,
+                           "live": False, "error": "%s: %s"
+                           % (type(e).__name__, e)}
+            print(json.dumps(payload))
+            sys.exit(1)
+        print(json.dumps({
+            "metric": metric,
+            "value": row["streamed_ttft_ms"]["p50"],
+            "unit": unit,
+            # acceptance shape: streamed TTFT p50 <= 0.25x the
+            # one-shot total at max_new >= 32 (lower is better)
+            "vs_baseline": row["ttft_vs_oneshot"],
+            **row}))
+        return 0
     if args.disagg:
         try:
             disagg, coloc, micro = _run_disagg(args)

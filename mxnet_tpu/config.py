@@ -264,3 +264,20 @@ define("MXNET_SERVE_DEADLINE_MS", float, 0.0,
        "past it fails with the typed RequestTimeout instead of "
        "occupying a batch slot (0 = no deadline; submit(deadline_ms=) "
        "overrides per request)")
+define("MXNET_PREFILL_CHUNK", int, 0,
+       "colocated chunked-prefill width (tokens): a queued prompt "
+       "longer than this is fed to the cache in chunk-sized forwards, "
+       "one chunk interleaved per decode-loop iteration, so active "
+       "sessions keep emitting tokens while a long prompt prefills "
+       "(bounds inter-token p99 under long-prompt arrivals; "
+       "docs/serving.md §streaming). 0 = off (whole-prompt prefill). "
+       "Chunk forwards ride the shared-position prefill graph — the "
+       "(B, 1) decode step stays a single XLA specialization")
+define("MXNET_STREAM_IDLE_TIMEOUT", float, 30.0,
+       "streamed-generate per-frame idle timeout (seconds): a "
+       "streaming client (ServeClient.generate(on_token=) and every "
+       "router decode leg relaying frames) fails the read when the "
+       "gap since the previous frame exceeds it — a hung replica "
+       "fails over after one missed inter-frame gap instead of the "
+       "old whole-completion deadline (120 s + 1 s/token). Must be "
+       "positive and finite — validated loudly at use")

@@ -953,12 +953,17 @@ class Generator:
         return ContinuousDecoder(self, **kwargs)
 
     def generate(self, prompt, max_new_tokens, temperature=0.0,
-                 top_k=None, top_p=None, eos_id=None, seed=0):
+                 top_k=None, top_p=None, eos_id=None, seed=0,
+                 on_token=None):
         """Greedy (temperature 0) or sampled continuation.
 
         prompt: (B, P) int token ids. Returns (B, P + n) ids as numpy
         (n <= max_new_tokens; generation stops early only when every
-        row has emitted eos_id)."""
+        row has emitted eos_id). ``on_token``, when given, is called
+        with each round's (B,) numpy token array as soon as it is
+        picked — the local twin of the serve path's streamed frames
+        (the returned rows are exactly the concatenation the callback
+        saw, so callers can cross-check stream against one-shot)."""
         self._check_sampling(temperature, top_k, top_p)
         prompt, P = self._check_prompt(prompt, max_new_tokens)
         key = jax.random.PRNGKey(seed)
@@ -975,6 +980,8 @@ class Generator:
                 nxt = np.where(done, eos_id, nxt)
                 done |= nxt == eos_id
             ids.append(nxt[:, None])
+            if on_token is not None:
+                on_token(nxt.copy())
             if eos_id is not None and done.all():
                 break
             if i + 1 < max_new_tokens:

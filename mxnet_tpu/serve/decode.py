@@ -72,7 +72,8 @@ from ..models import transformer
 from .engine import (EngineClosed, Overloaded, RequestTimeout,
                      SessionEvacuated)
 
-__all__ = ["ContinuousDecoder", "DecodeFuture", "drain_timeout"]
+__all__ = ["ContinuousDecoder", "DecodeFuture", "drain_timeout",
+           "prefill_chunk"]
 
 # replay dedup (PR 1's (cid, seq) pattern on the serving side): how
 # many admit ids a decode replica remembers. Sized far past any
@@ -100,14 +101,32 @@ def drain_timeout():
     return t
 
 
+def prefill_chunk():
+    """``MXNET_PREFILL_CHUNK``, loudly validated: the colocated
+    chunked-prefill width in tokens (0 = off, whole-prompt prefill).
+    Read per admission round so tests and live reconfigures take
+    effect without rebuilding the pool."""
+    c = int(_config.get("MXNET_PREFILL_CHUNK") or 0)
+    if c < 0:
+        raise ValueError(
+            "MXNET_PREFILL_CHUNK=%r: wants a non-negative chunk width "
+            "in tokens (0 disables chunking)" % (c,))
+    return c
+
+
 class DecodeFuture:
     """One sequence's pending result: the full token row
-    (prompt + generated, eos included when hit) or a typed error."""
+    (prompt + generated, eos included when hit) or a typed error.
+
+    Streaming consumers :meth:`subscribe` a sink to see every emitted
+    token as the decode loop picks it (plus a ``None`` sentinel when
+    the sequence settles) — the engine half of the serve path's
+    streamed generate frames."""
 
     __slots__ = ("prompt", "max_new", "eos_id", "temperature", "top_k",
-                 "top_p", "seed", "_key", "t_enq", "t_admit", "tc",
-                 "emitted", "pending", "n_cached", "handoff", "resume",
-                 "_ev", "_value", "_exc")
+                 "top_p", "seed", "_key", "t_enq", "t_admit", "t_last",
+                 "tc", "emitted", "pending", "n_cached", "handoff",
+                 "resume", "_ev", "_value", "_exc", "_slock", "_sinks")
 
     def __init__(self, prompt, max_new, eos_id, temperature, top_k,
                  top_p, seed, handoff=None):
@@ -133,6 +152,7 @@ class DecodeFuture:
             self._key, _ = jax.random.split(self._key)
         self.t_enq = _telemetry.now_ms()
         self.t_admit = None                # set when a slot is claimed
+        self.t_last = None                 # last emission (inter-token)
         self.tc = _trace.current_context()  # submitter's span, if any
         self.emitted = []
         self.pending = None                # sampled but not yet fed
@@ -140,6 +160,8 @@ class DecodeFuture:
         self._ev = threading.Event()
         self._value = None
         self._exc = None
+        self._slock = threading.Lock()     # emitted/sink consistency
+        self._sinks = []                   # streaming subscribers
 
     def _pick(self, row_logits):
         """Next token id from this row's last-position logits."""
@@ -150,14 +172,54 @@ class DecodeFuture:
                 self.top_p))[0])
         return int(np.argmax(np.asarray(row_logits)))
 
+    def subscribe(self, sink):
+        """Register a token sink: it is first fed every
+        already-emitted token in order (the replayed prefix a deduped
+        or resumed streaming attempt owes its client), then each new
+        token as the loop emits it, then ``None`` once the sequence
+        settles (result or error). Delivery holds the emission lock,
+        so a sink sees the stream exactly once, in order, with no gap
+        between the prefix replay and live emissions — sinks must be
+        cheap and non-blocking (a queue put)."""
+        with self._slock:
+            for t in self.emitted:
+                sink(t)
+            if self._ev.is_set():
+                sink(None)
+            else:
+                self._sinks.append(sink)
+
+    def unsubscribe(self, sink):
+        with self._slock:
+            try:
+                self._sinks.remove(sink)
+            except ValueError:
+                pass
+
+    def _emit(self, tok):
+        """One emission: append + notify streaming sinks atomically
+        (decode loop thread only)."""
+        with self._slock:
+            self.emitted.append(tok)
+            for s in self._sinks:
+                s(tok)
+        self.pending = tok
+
+    def _settle_sinks(self):
+        with self._slock:
+            self._ev.set()
+            sinks, self._sinks = self._sinks, []
+        for s in sinks:
+            s(None)
+
     def _finish_ok(self):
         self._value = np.concatenate(
             [self.prompt, np.asarray(self.emitted, np.int64)])
-        self._ev.set()
+        self._settle_sinks()
 
     def _fail(self, exc):
         self._exc = exc
-        self._ev.set()
+        self._settle_sinks()
 
     def done(self):
         return self._ev.is_set()
@@ -230,6 +292,8 @@ class ContinuousDecoder:
         self._aux = generator._fresh_aux()     # the pool caches
         self._import_jit = {}                  # pos -> fused scatter
         self._slots = [None] * self._B         # DecodeFuture per slot
+        self._reserved = set()                 # slots held mid-chunk
+        self._chunking = None                  # in-progress chunked prefill
         self._queue = deque()
         self._lock = threading.Lock()
         self._cond = threading.Condition(self._lock)
@@ -250,6 +314,8 @@ class ContinuousDecoder:
         self._resumed = 0
         self._evacuated = 0
         self._deduped = 0
+        self._streams = 0
+        self._streams_inflight = 0
         self._g_active = _telemetry.gauge("serve.decode.active_slots")
         # pool-measured twin of the Generator's static sizing gauge:
         # actual device-array bytes of the live cache pytree per slot.
@@ -276,6 +342,17 @@ class ContinuousDecoder:
         self._c_resumed = _telemetry.counter("serve.decode.resumed")
         self._c_evacuated = _telemetry.counter("serve.decode.evacuated")
         self._c_deduped = _telemetry.counter("serve.decode.deduped")
+        # interactive-latency product metrics (PR 17): time to first
+        # emitted token (from enqueue) and the gap between consecutive
+        # emissions of one sequence — what streaming users actually
+        # feel; tools/telemetry_report.py renders the quantiles
+        self._h_ttft = _telemetry.histogram("serve.ttft_ms")
+        self._h_itl = _telemetry.histogram("serve.inter_token_ms")
+        self._c_streams = _telemetry.counter("serve.decode.streams")
+        self._g_streams = _telemetry.gauge(
+            "serve.decode.streams_active")
+        self._c_chunks = _telemetry.counter(
+            "serve.decode.prefill_chunks")
 
         self._shutdown = None
         if install_sigterm:
@@ -508,6 +585,9 @@ class ContinuousDecoder:
         ``len(emitted)`` splits (``generation.replay_key``), so the
         remaining tokens are bit-identical to an unmigrated run."""
         self._gen._check_sampling(temperature, top_k, top_p)
+        prefill_chunk()   # loud knob validation on the CALLER's
+        #                   thread — the decode loop must never die
+        #                   on a config typo
         prompt = np.asarray(prompt, np.int64).reshape(-1)
         P, n = int(prompt.shape[0]), int(max_new_tokens)
         if P < 1:
@@ -663,6 +743,74 @@ class ContinuousDecoder:
             # than surfacing an error for a request nothing lost
             return {"evacuated": exc.state}
 
+    def handle_generate_stream(self, payload, emit):
+        """The streamed twin of :meth:`handle_generate`
+        (serve/net.py's ``generate`` frame with ``stream: True``):
+        submit the sequence, then relay every emitted token to
+        ``emit(tokens, offset)`` ON THIS handler thread as the decode
+        loop picks it — ``offset`` is the emission index of the
+        chunk's first token, so a deduped replay (whose subscription
+        replays the already-emitted prefix from offset 0) lets the
+        client resume token-exact with no duplicated or missing
+        frames. Returns the same final value as the one-shot path
+        (the full id row, or the ``evacuated`` state dict) — the
+        terminal frame carries it for bitwise comparison."""
+        import queue as _qmod
+        fut = self.submit(
+            payload["prompt"], payload["max_new_tokens"],
+            eos_id=payload.get("eos_id"),
+            temperature=payload.get("temperature") or 0.0,
+            top_k=payload.get("top_k"), top_p=payload.get("top_p"),
+            seed=payload.get("seed") or 0,
+            handoff=payload.get("handoff"),
+            admit_id=payload.get("admit_id"),
+            resume=payload.get("resume"))
+        q = _qmod.Queue()
+        sink = q.put
+        timeout = payload.get("timeout")
+        deadline = None if timeout is None else \
+            _telemetry.now_ms() + float(timeout) * 1000.0
+        with self._lock:
+            self._streams += 1
+            self._streams_inflight += 1
+            self._g_streams.set(self._streams_inflight)
+        self._c_streams.inc()
+        fut.subscribe(sink)
+        try:
+            offset = 0
+            settled = False
+            while not settled:
+                wait = None if deadline is None else max(
+                    0.0, (deadline - _telemetry.now_ms()) / 1000.0)
+                try:
+                    item = q.get(timeout=wait)
+                except _qmod.Empty:
+                    raise RequestTimeout(
+                        "sequence still decoding after %.3fs"
+                        % float(timeout))
+                toks = []
+                while True:
+                    if item is None:       # settle sentinel
+                        settled = True
+                        break
+                    toks.append(int(item))
+                    try:
+                        item = q.get_nowait()
+                    except _qmod.Empty:
+                        break
+                if toks:
+                    emit(toks, offset)
+                    offset += len(toks)
+        finally:
+            fut.unsubscribe(sink)
+            with self._lock:
+                self._streams_inflight -= 1
+                self._g_streams.set(self._streams_inflight)
+        try:
+            return fut.result(0)
+        except SessionEvacuated as exc:
+            return {"evacuated": exc.state}
+
     def generate_many(self, prompts, max_new_tokens, eos_id=None,
                       timeout=None, **kwargs):
         """Submit a batch of (possibly ragged) prompts and wait for all
@@ -674,7 +822,8 @@ class ContinuousDecoder:
 
     # -- the decode loop ----------------------------------------------------
     def _free_slots(self):
-        return [i for i, s in enumerate(self._slots) if s is None]
+        return [i for i, s in enumerate(self._slots)
+                if s is None and i not in self._reserved]
 
     def _admit_handoff(self, slot, req):
         """Admit one remote-prefilled sequence: scatter its shipped
@@ -700,8 +849,7 @@ class ContinuousDecoder:
         if _trace.enabled():
             _trace.add_span("serve.decode.import", t0, req.t_admit,
                             parent=req.tc, slot=slot, pos=pos)
-        req.emitted.append(tok)
-        req.pending = tok
+        self._emit(req, tok)
         self._maybe_finish(slot, tok)
 
     def _admit_resume(self, slot, req):
@@ -747,7 +895,9 @@ class ContinuousDecoder:
                 return
             batch = [self._queue.popleft()
                      for _ in range(min(len(free), len(self._queue)))]
+        chunk = prefill_chunk()
         by_len = {}
+        waiting = []       # long prompts parked behind an active chunk
         for req in batch:
             if req.resume is not None:
                 self._admit_resume(free.pop(0), req)
@@ -755,7 +905,27 @@ class ContinuousDecoder:
             if req.handoff is not None:
                 self._admit_handoff(free.pop(0), req)
                 continue
+            if chunk and len(req.prompt) > chunk:
+                # long prompt: feed it to the cache chunk-by-chunk,
+                # interleaved with decode steps, instead of stalling
+                # every active slot for one monolithic (B, P) forward.
+                # One chunked prefill at a time; later long prompts
+                # wait their turn at the queue front (short prompts
+                # are deliberately NOT held behind them)
+                if self._chunking is None:
+                    slot = free.pop(0)
+                    self._reserved.add(slot)
+                    self._chunking = {"req": req, "slot": slot,
+                                      "aux": self._gen._fresh_aux(),
+                                      "pos": 0,
+                                      "t0": _telemetry.now_ms()}
+                else:
+                    waiting.append(req)
+                continue
             by_len.setdefault(len(req.prompt), []).append(req)
+        if waiting:
+            with self._lock:
+                self._queue.extendleft(reversed(waiting))
         for P, reqs in sorted(by_len.items()):
             rows = np.stack([r.prompt for r in reqs] +
                             [reqs[0].prompt] * (self._B - len(reqs)))
@@ -775,9 +945,27 @@ class ContinuousDecoder:
                 req.t_admit = _telemetry.now_ms()
                 req.n_cached = P
                 tok = req._pick(last[i])
-                req.emitted.append(tok)
-                req.pending = tok
+                self._emit(req, tok)
                 self._maybe_finish(slot, tok)
+
+    def _emit(self, req, tok):
+        """One token emission: latency metrics (TTFT on the first
+        emission of a fresh request, inter-token gap after that), then
+        the append + streaming-sink notify. Every emission path —
+        fresh-prefill pick, shipped handoff token, chunked-prefill
+        completion, per-step pick — funnels through here so the
+        latency histograms and streamed frames can never drift from
+        the row the one-shot path returns."""
+        now = _telemetry.now_ms()
+        if not req.emitted:
+            self._h_ttft.observe(now - req.t_enq)
+        elif req.t_last is not None:
+            # resumed sessions arrive with a non-empty emitted prefix
+            # but no local t_last — their first local emission gap
+            # spans the migration, not a decode step, so it is skipped
+            self._h_itl.observe(now - req.t_last)
+        req.t_last = now
+        req._emit(tok)
 
     def _maybe_finish(self, slot, tok):
         """Retire the slot's sequence if this emission ended it (eos or
@@ -847,9 +1035,65 @@ class ContinuousDecoder:
             req = self._slots[i]
             req.n_cached += 1
             tok = req._pick(last[i])
-            req.emitted.append(tok)
-            req.pending = tok
+            self._emit(req, tok)
             self._maybe_finish(i, tok)
+
+    def _chunk_step(self):
+        """Feed ONE chunk of the in-progress chunked prefill — called
+        once per loop iteration between admission and the (B, 1) step,
+        so active sessions pay one chunk-width forward per token
+        instead of the whole prompt at once. Chunk forwards ride the
+        Generator's ordinary shared-position graph (one XLA program
+        per chunk width — the ragged final chunk adds at most one
+        more); the per-row (B, 1) step's jit cache never moves. The
+        math is bit-identical to the monolithic prefill: every forward
+        attends the full masked cache buffer, so splitting the query
+        axis changes no reduction a kept position sees."""
+        ch = self._chunking
+        if ch is None:
+            return
+        req, slot = ch["req"], ch["slot"]
+        P = len(req.prompt)
+        lo = ch["pos"]
+        hi = min(lo + prefill_chunk(), P)
+        rows = np.stack([req.prompt[lo:hi]] * self._B)
+        try:
+            logits, ch["aux"] = self._gen._forward(
+                ch["aux"], rows.astype(np.float32), lo)
+        except Exception as exc:          # noqa: BLE001 — the future
+            # is this sequence's one response; a failed chunk must not
+            # kill the decode loop for every other slot
+            self._chunking = None
+            self._reserved.discard(slot)
+            req._fail(exc)
+            return
+        ch["pos"] = hi
+        self._c_chunks.inc()
+        if _trace.enabled():
+            _trace.add_span("serve.decode.prefill_chunk",
+                            ch.pop("t_chunk", ch["t0"]),
+                            _telemetry.now_ms(), parent=req.tc,
+                            slot=slot, lo=lo, hi=hi)
+            ch["t_chunk"] = _telemetry.now_ms()
+        if hi < P:
+            return
+        # final chunk: merge the fully-prefilled row into the pool
+        # (same batch-axis scatter as the monolithic path) and emit
+        # the first token
+        idx = jnp.asarray(np.array([slot], np.int32))
+        self._aux = {
+            name: self._aux[name].at[idx].set(ch["aux"][name][:1])
+            for name in self._aux}
+        self._prefills += 1
+        last = np.asarray(logits[:1, -1].astype(jnp.float32))
+        self._chunking = None
+        self._reserved.discard(slot)
+        self._slots[slot] = req
+        req.t_admit = _telemetry.now_ms()
+        req.n_cached = P
+        tok = req._pick(last[0])
+        self._emit(req, tok)
+        self._maybe_finish(slot, tok)
 
     def _loop(self):
         while True:
@@ -857,17 +1101,20 @@ class ContinuousDecoder:
                 while not self._queue and not self._draining and \
                         not self._evac_waiters and \
                         not self._evac_flag and \
+                        self._chunking is None and \
                         all(s is None for s in self._slots):
                     self._cond.wait(0.05)
                 if self._draining and not self._queue and \
                         not self._evac_waiters and \
                         not self._evac_flag and \
+                        self._chunking is None and \
                         all(s is None for s in self._slots):
                     break
             if self._evac_waiters or self._evac_flag:
                 self._do_evacuate()
                 continue
             self._admit()
+            self._chunk_step()
             self._step()
         self._g_active.set(0)
         _telemetry.journal_event("serve.decode.stop")
@@ -933,6 +1180,13 @@ class ContinuousDecoder:
             self._slots[slot] = None
             req._fail(SessionEvacuated(state))
             n += 1
+        ch, self._chunking = self._chunking, None
+        if ch is not None:
+            # a half-prefilled prompt has no portable session yet
+            # (no emitted token, partial cache) — prefill is pure, so
+            # it replays from scratch exactly like a queued request
+            self._reserved.discard(ch["slot"])
+            queued.append(ch["req"])
         for req in queued:
             req._fail(EngineClosed(
                 "evacuated before admission — replay the request on "
@@ -990,6 +1244,7 @@ class ContinuousDecoder:
                 "imported": self._imported, "resumed": self._resumed,
                 "evacuated": self._evacuated,
                 "deduped": self._deduped,
+                "streams": self._streams,
                 "active": sum(s is not None for s in self._slots),
                 "queued": len(self._queue)}
 
@@ -1003,7 +1258,9 @@ class ContinuousDecoder:
         out = self.stats()
         out["queue_depth"] = out.pop("queued")
         out["in_flight"] = out["active"] + out["queue_depth"]
-        out["decode_free_slots"] = self._B - out["active"]
+        out["decode_free_slots"] = (self._B - out["active"]
+                                    - len(self._reserved))
         out["slots"] = self._B
+        out["streams_in_flight"] = self._streams_inflight
         out["draining"] = self.draining
         return out

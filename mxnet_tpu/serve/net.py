@@ -48,13 +48,35 @@ import threading
 
 import numpy as np
 
+from .. import config as _config
 from .. import telemetry as _telemetry
 from .. import trace as _trace
 from ..parallel.ps_async import _recv_msg, _send_msg
 from ..parallel.resilience import RetryPolicy
 from . import engine as _engine
 
-__all__ = ["ServeServer", "ServeClient"]
+__all__ = ["ServeServer", "ServeClient", "stream_idle_timeout"]
+
+
+def stream_idle_timeout():
+    """``MXNET_STREAM_IDLE_TIMEOUT``, loudly validated: the per-frame
+    idle bound every streamed-generate read applies — the gap since
+    the previous frame, not the whole completion, is what a healthy
+    streaming replica keeps short, so a hung replica surfaces as a
+    transport fault after ONE missed inter-frame gap instead of the
+    one-shot path's whole-completion deadline. The first frame's gap
+    covers queue wait + prefill (TTFT), so size the knob past worst-
+    case admission latency — the fleet router warms recycled replicas
+    precisely so a cold XLA compile never lands here."""
+    import math
+    t = float(_config.get("MXNET_STREAM_IDLE_TIMEOUT"))
+    if not (math.isfinite(t) and t > 0):
+        raise ValueError(
+            "MXNET_STREAM_IDLE_TIMEOUT=%r: wants a positive finite "
+            "number of seconds (a non-positive or non-finite idle "
+            "bound would either fail every stream instantly or wedge "
+            "on a hung replica forever)" % (t,))
+    return t
 
 
 class ServeServer:
@@ -80,6 +102,8 @@ class ServeServer:
         self._conn_threads = set()         # live handler threads only
         self._conn_lock = threading.Lock()
         self._c_conns = _telemetry.counter("serve.net.connections")
+        self._c_frames = _telemetry.counter("serve.net.stream_frames")
+        self._c_streams = _telemetry.counter("serve.net.streams")
         self._accept_thread = threading.Thread(
             target=self._accept_loop, name="mxnet-serve-accept",
             daemon=True)
@@ -114,7 +138,7 @@ class ServeServer:
                 msg = _recv_msg(conn, "serve_srv_recv")
                 if msg is None:           # clean EOF or torn frame
                     break
-                reply = self._handle(msg)
+                reply = self._handle(msg, conn)
                 _send_msg(conn, reply, "serve_srv_send")
         except (ConnectionError, OSError) as exc:
             # includes injected FaultInjected severs: this connection
@@ -129,7 +153,7 @@ class ServeServer:
                 self._conns.discard(conn)
                 self._conn_threads.discard(threading.current_thread())
 
-    def _handle(self, msg):
+    def _handle(self, msg, conn=None):
         try:
             op, payload = msg
         except (TypeError, ValueError):
@@ -224,9 +248,36 @@ class ServeServer:
                                     parent=rtc) \
                 if _trace.enabled() else None
             try:
-                kw = {k: v for k, v in payload.items() if k != "tc"}
+                kw = {k: v for k, v in payload.items()
+                      if k not in ("tc", "stream")}
                 if op == "prefill":
                     return ("ok", fn(kw.pop("prompt"), **kw))
+                sfn = getattr(self._engine, "handle_generate_stream",
+                              None)
+                if payload.get("stream") and conn is not None and \
+                        callable(sfn):
+                    # streamed generate: intermediate ("frame", {seq,
+                    # offset, tokens}) frames ride THIS connection
+                    # ahead of the ordinary terminal reply (which
+                    # still carries the full row — the bitwise cross-
+                    # check against the one-shot path). A client that
+                    # asked to stream against an engine without the
+                    # handler simply gets the one-shot reply: zero
+                    # frames is a valid stream.
+                    seq = [0]
+
+                    def emit(tokens, offset):
+                        _send_msg(conn, ("frame",
+                                         {"seq": seq[0],
+                                          "offset": int(offset),
+                                          "tokens": [int(t)
+                                                     for t in tokens]}),
+                                  "serve_srv_send")
+                        seq[0] += 1
+                        self._c_frames.inc()
+
+                    self._c_streams.inc()
+                    return ("ok", sfn(kw, emit))
                 return ("ok", fn(kw))
             except _engine.ServeError as exc:
                 return ("err", type(exc).__name__, str(exc))
@@ -462,7 +513,7 @@ class ServeClient:
     def generate(self, prompt, max_new_tokens, eos_id=None,
                  temperature=0.0, top_k=None, top_p=None, seed=0,
                  session=None, handoff=None, timeout=None,
-                 admit_id=None, resume=None):
+                 admit_id=None, resume=None, on_token=None):
         """The ``generate`` frame: admit one sequence on the remote
         replica (with its ``handoff`` blob when a remote prefill ran)
         and block for the full id row. Replay caveat: a transport
@@ -484,7 +535,18 @@ class ServeClient:
         otherwise — a decode lasts as long as its tokens; the
         client's io timeout exists to catch dead transports and must
         not misclassify a healthy long generation. Pass ``timeout``
-        to bound a generate against a hung replica."""
+        to bound a generate against a hung replica.
+
+        ``on_token``: streaming mode — the server emits a frame per
+        decode step and ``on_token(tok)`` fires per NEW token, in
+        emission order, exactly once each (transport replays re-read
+        the stream from offset 0; tokens already delivered are
+        verified against the replay, never re-delivered). Streamed
+        reads replace the whole-completion deadline with the
+        per-frame ``MXNET_STREAM_IDLE_TIMEOUT`` idle bound: a replica
+        that stops producing frames fails after one missed gap. The
+        returned row is the terminal frame's full result — bitwise
+        what the one-shot path returns."""
         payload = {"prompt": np.asarray(prompt, np.int64).reshape(-1),
                    "max_new_tokens": int(max_new_tokens),
                    "eos_id": eos_id, "temperature": temperature,
@@ -499,19 +561,150 @@ class ServeClient:
             payload["admit_id"] = admit_id
         if resume is not None:
             payload["resume"] = resume
+        if on_token is not None:
+            payload["stream"] = True
         rsp = _trace.start_span("serve.generate.request",
                                 tokens=int(payload["prompt"].size),
-                                max_new=payload["max_new_tokens"])
+                                max_new=payload["max_new_tokens"],
+                                stream=bool(on_token))
         if rsp is not None:
             payload["tc"] = rsp.context().to_wire()
-        wire_timeout = None if timeout is None \
-            else float(timeout) + (self._timeout or 30.0)
         try:
+            if on_token is not None:
+                return self._stream_roundtrip(payload, on_token)
+            wire_timeout = None if timeout is None \
+                else float(timeout) + (self._timeout or 30.0)
             return self._roundtrip(("generate", payload),
                                    "serve.generate",
                                    read_timeout=wire_timeout)
         finally:
             _trace.end_span(rsp)
+
+    def _stream_roundtrip(self, payload, on_token):
+        """The streamed ``generate`` round trip: read ("frame", {seq,
+        offset, tokens}) frames until the terminal ok/err reply, each
+        read bounded by the per-frame idle timeout. ``offset`` (the
+        emission index of a frame's first token) is what makes replay
+        exact: a retry — same socket replay or a fleet failover — re-
+        reads the stream from 0; tokens at already-delivered offsets
+        must MATCH what was delivered (a mismatch is a determinism
+        violation and fails loudly, typed) and only the tail past the
+        delivered prefix reaches ``on_token``. No token is ever
+        delivered twice or skipped."""
+        idle = stream_idle_timeout()
+        delivered = []
+        first = [True]
+
+        def attempt():
+            sock = self._ensure()
+            sock.settimeout(idle)
+            last_seq = -1
+            try:
+                _send_msg(sock, ("generate", payload), self._pt_send)
+                while True:
+                    reply = _recv_msg(sock, self._pt_recv)
+                    if reply is None:
+                        raise ConnectionError(
+                            "server closed the connection mid-stream")
+                    if not (isinstance(reply, tuple) and reply and
+                            reply[0] == "frame"):
+                        return reply      # terminal ok/err
+                    fr = reply[1]
+                    seq = int(fr.get("seq", -1))
+                    if seq != last_seq + 1:
+                        raise ConnectionError(
+                            "stream frame seq %d after %d — torn "
+                            "stream" % (seq, last_seq))
+                    last_seq = seq
+                    off = int(fr["offset"])
+                    if off > len(delivered):
+                        raise ConnectionError(
+                            "stream offset %d past the delivered "
+                            "prefix (%d) — torn stream"
+                            % (off, len(delivered)))
+                    for i, t in enumerate(fr["tokens"]):
+                        self._deliver(off + i, int(t), delivered,
+                                      on_token, first)
+            except Exception:
+                self._drop()
+                raise
+            finally:
+                if self._sock is sock:
+                    sock.settimeout(self._timeout)
+
+        with self._lock:
+            reply = self._retry.run(attempt,
+                                    describe="serve.generate.stream",
+                                    on_retry=self._on_retry)
+        if reply[0] != "ok":
+            _, kind, msg = reply
+            raise _engine.typed_error(kind, msg)
+        out = reply[1]
+        if isinstance(out, dict):
+            # an evacuated-session reply: the caller (the fleet
+            # router's migration loop) resumes the stream elsewhere —
+            # the delivered prefix stands, nothing terminal to check
+            return out
+        # terminal cross-check: the full row's generated tail must be
+        # exactly the streamed tokens (any tail past the last frame —
+        # e.g. a non-streaming engine answered — is delivered now)
+        gen = [int(t) for t in
+               np.asarray(out).reshape(-1)[payload["prompt"].size:]]
+        if gen[:len(delivered)] != delivered or len(gen) < \
+                len(delivered):
+            raise _engine.ServeError(
+                "streamed tokens diverge from the terminal row — "
+                "determinism violation (%d streamed, row tail %r...)"
+                % (len(delivered), gen[:8]))
+        for k in range(len(delivered), len(gen)):
+            self._deliver(k, gen[k], delivered, on_token, first)
+        return out
+
+    def _deliver(self, k, tok, delivered, on_token, first):
+        """Deliver emission-index ``k`` exactly once; verify replays."""
+        if k < len(delivered):
+            if delivered[k] != tok:
+                raise _engine.ServeError(
+                    "stream replay diverged at token %d: %d then %d "
+                    "— determinism violation" % (k, delivered[k], tok))
+            return
+        delivered.append(tok)
+        if first[0]:
+            first[0] = False
+            if _trace.enabled():
+                _trace.instant("serve.stream.first_token", index=k)
+        on_token(tok)
+
+    def generate_stream(self, prompt, max_new_tokens, **kw):
+        """Iterator twin of ``generate(on_token=...)``: yields each
+        new token as its frame arrives; the generator's return value
+        (``StopIteration.value``) is the full id row. The round trip
+        runs on a helper thread so the caller pulls tokens at its own
+        pace without holding the client lock hostage between
+        frames."""
+        import queue as _qmod
+        q = _qmod.Queue()
+
+        def run():
+            try:
+                row = self.generate(prompt, max_new_tokens,
+                                    on_token=lambda t: q.put(("tok", t)),
+                                    **kw)
+                q.put(("done", row))
+            except BaseException as exc:   # noqa: BLE001 — relayed
+                q.put(("exc", exc))
+
+        t = threading.Thread(target=run, daemon=True,
+                             name="mxnet-serve-stream")
+        t.start()
+        while True:
+            kind, val = q.get()
+            if kind == "tok":
+                yield val
+            elif kind == "done":
+                return val
+            else:
+                raise val
 
     def ping(self):
         try:

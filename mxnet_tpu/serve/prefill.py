@@ -39,11 +39,30 @@ import threading
 
 import numpy as np
 
+from .. import config as _config
 from .. import telemetry as _telemetry
 from .. import trace as _trace
 from ..generation import kv_blob_nbytes
+from .engine import EngineClosed
 
 __all__ = ["PrefillEngine"]
+
+
+class _PendingPrefill:
+    """One queued prefill awaiting the coalescing batcher."""
+
+    __slots__ = ("prompt", "temperature", "top_k", "top_p", "seed",
+                 "ev", "out", "exc")
+
+    def __init__(self, prompt, temperature, top_k, top_p, seed):
+        self.prompt = prompt
+        self.temperature = temperature
+        self.top_k = top_k
+        self.top_p = top_p
+        self.seed = seed
+        self.ev = threading.Event()
+        self.out = None                    # (first_token, blob)
+        self.exc = None
 
 
 class PrefillEngine:
@@ -58,7 +77,20 @@ class PrefillEngine:
     ``warm_lengths``: prompt lengths ``warmup()`` pre-compiles (the
     prefill graph specializes per (B, P) like any bucket; the fleet
     router's ``warm`` frame lands here on recycle). Empty = warmup is
-    a no-op."""
+    a no-op.
+
+    Batched prefill (PR 17): with ``batch_size > 1``, concurrent
+    prefills coalesce — a batcher thread holds the oldest queued
+    prompt for the ``MXNET_SERVE_MAX_WAIT_MS`` window (the serve
+    batcher's own knob: one coalescing clock for the whole stack),
+    right-pads the group to its longest prompt and runs ONE shared-
+    position (B, P_max) forward, exporting each row at its own true
+    length. Causal masking makes the padding inert: a row's kept
+    positions attend only its own prefix, and the masked tail
+    contributes exact zeros to every reduction — each coalesced reply
+    is bitwise the solo reply (pinned in
+    tests/test_serve_streaming.py). A window of 0 or a 1-row pool
+    restores the direct per-request path."""
 
     role = "prefill"                      # the hello frame's identity
 
@@ -95,6 +127,24 @@ class PrefillEngine:
         self._h_bytes = _telemetry.histogram(
             "serve.prefill.blob_bytes",
             buckets=tuple(float(1 << s) for s in range(10, 27, 2)))
+        self._c_batched = _telemetry.counter("serve.prefill.batched")
+        self._h_fill = _telemetry.histogram(
+            "serve.prefill.batch_fill",
+            buckets=_telemetry.COUNT_BUCKETS)
+        # the coalescing batcher: only worth a thread when the pool
+        # can actually hold more than one row and the window allows
+        # coalescing at all
+        self._wait_ms = float(
+            _config.get("MXNET_SERVE_MAX_WAIT_MS") or 0.0)
+        self._pending = []
+        self._pcond = threading.Condition()
+        self._closed = False
+        self._batcher = None
+        if generator.batch_size > 1 and self._wait_ms > 0:
+            self._batcher = threading.Thread(
+                target=self._batch_loop, name="mxnet-serve-prefill",
+                daemon=True)
+            self._batcher.start()
 
     def prefill(self, prompt, temperature=0.0, top_k=None, top_p=None,
                 seed=0, _record=True, **_ignored):
@@ -105,10 +155,9 @@ class PrefillEngine:
         Pure — replaying the same call lands the same reply.
         ``_record=False`` (warmup's compile drives) keeps the
         request-level telemetry/stats clean: ``serve.prefill.*`` and
-        ``stats()['prefills']`` count served traffic only."""
-        import jax
-
-        from ..generation import _pick_token
+        ``stats()['prefills']`` count served traffic only — and skips
+        the coalescing batcher (a warmup must compile the exact
+        declared length, not a group's padded one)."""
         gen = self._gen
         gen._check_sampling(temperature, top_k, top_p)
         prompt = np.asarray(prompt, np.int64).reshape(-1)
@@ -125,20 +174,23 @@ class PrefillEngine:
                 "rows)" % (P, gen._pos_rows))
         t0 = _telemetry.now_ms()
         sp = _trace.start_span("serve.prefill", tokens=P)
+        req = _PendingPrefill(prompt, float(temperature or 0.0),
+                              top_k, top_p, int(seed or 0))
         try:
             with self._lock:
                 self._inflight += 1
-            rows = np.stack([prompt] * gen.batch_size)
-            logits, aux = gen._forward(gen._fresh_aux(),
-                                       rows.astype(np.float32), 0)
-            # the request PRNG stream's FIRST split picks the first
-            # token — exactly generate()'s round-1 discipline; the
-            # decode side advances its own key past this split
-            _, sub = jax.random.split(jax.random.PRNGKey(seed))
-            tok = int(np.asarray(_pick_token(
-                logits[:1, -1], temperature, top_k, sub, top_p))[0])
-            t_exp = _telemetry.now_ms()
-            blob = gen.export_kv_rows(aux, 0, P)
+            if self._batcher is not None and _record:
+                with self._pcond:
+                    if self._closed:
+                        raise EngineClosed("prefill engine closed")
+                    self._pending.append(req)
+                    self._pcond.notify_all()
+                req.ev.wait()
+            else:
+                self._run_group([req])
+            if req.exc is not None:
+                raise req.exc
+            tok, blob, export_ms = req.out
             t1 = _telemetry.now_ms()
             if _record:
                 nbytes = kv_blob_nbytes(blob)
@@ -147,7 +199,7 @@ class PrefillEngine:
                 self._c_requests.inc()
                 self._c_tokens.inc(P)
                 self._h_ms.observe(t1 - t0)
-                self._h_export.observe(t1 - t_exp)
+                self._h_export.observe(export_ms)
                 self._h_bytes.observe(nbytes)
                 _telemetry.journal_event(
                     "serve.prefill", tokens=P, blob_bytes=nbytes,
@@ -157,6 +209,80 @@ class PrefillEngine:
             with self._lock:
                 self._inflight -= 1
             _trace.end_span(sp)
+
+    def _run_group(self, group):
+        """One shared-position forward for a coalesced group: prompts
+        right-pad to the group's longest, spare pool rows replicate
+        row 0, and each request's first token and cache rows come off
+        ITS row at ITS true length — causal masking keeps every kept
+        position's math identical to a solo run (the padded tail is
+        never attended by a real position, and masked terms are exact
+        zeros in the reductions), so coalescing is invisible in the
+        bits. Solo callers (warmup, 1-row pools, window 0) pass a
+        1-element group and run on their own thread."""
+        import jax
+
+        from ..generation import _pick_token
+        gen = self._gen
+        pmax = max(int(g.prompt.shape[0]) for g in group)
+        rows = np.zeros((gen.batch_size, pmax), np.int64)
+        for i, g in enumerate(group):
+            rows[i, :g.prompt.shape[0]] = g.prompt
+        for i in range(len(group), gen.batch_size):
+            rows[i] = rows[0]
+        try:
+            logits, aux = gen._forward(gen._fresh_aux(),
+                                       rows.astype(np.float32), 0)
+        except Exception as exc:          # noqa: BLE001 — each waiter
+            # owns its own failure; the batcher thread must survive
+            for g in group:
+                g.exc = exc
+                g.ev.set()
+            return
+        if len(group) > 1:
+            self._c_batched.inc()
+        self._h_fill.observe(len(group))
+        for i, g in enumerate(group):
+            try:
+                P = int(g.prompt.shape[0])
+                # the request PRNG stream's FIRST split picks the
+                # first token — exactly generate()'s round-1
+                # discipline; the decode side advances its own key
+                # past this split
+                _, sub = jax.random.split(jax.random.PRNGKey(g.seed))
+                tok = int(np.asarray(_pick_token(
+                    logits[i:i + 1, P - 1], g.temperature, g.top_k,
+                    sub, g.top_p))[0])
+                t_exp = _telemetry.now_ms()
+                blob = gen.export_kv_rows(aux, i, P)
+                g.out = (tok, blob,
+                         _telemetry.now_ms() - t_exp)
+            except Exception as exc:      # noqa: BLE001 — per-row
+                g.exc = exc
+            g.ev.set()
+
+    def _batch_loop(self):
+        """The coalescing batcher (one per engine, like the serve
+        batcher): hold the oldest queued prefill for the
+        MXNET_SERVE_MAX_WAIT_MS window or until the pool is full,
+        then run the group as one padded forward."""
+        B = self._gen.batch_size
+        while True:
+            with self._pcond:
+                while not self._pending and not self._closed:
+                    self._pcond.wait(0.05)
+                if self._closed and not self._pending:
+                    return
+                t0 = _telemetry.now_ms()
+                while len(self._pending) < B and not self._closed:
+                    left = self._wait_ms - (_telemetry.now_ms() - t0)
+                    if left <= 0:
+                        break
+                    self._pcond.wait(left / 1000.0)
+                group = self._pending[:B]
+                del self._pending[:B]
+            if group:
+                self._run_group(group)
 
     # -- engine-surface lifecycle / introspection ---------------------------
     def warmup(self):
@@ -199,10 +325,21 @@ class PrefillEngine:
         return out
 
     def close(self, timeout=None):
-        """Nothing to drain: in-flight prefills finish on their
-        handler threads; the engine holds no background thread
-        (``timeout`` accepted for engine-surface parity)."""
-        del timeout
+        """In-flight prefills finish on their handler threads; the
+        coalescing batcher (when running) drains its queue and
+        exits — anything still queued after the join fails with
+        ``EngineClosed`` rather than hanging its waiter."""
+        batcher = self._batcher
+        with self._pcond:
+            self._closed = True
+            self._pcond.notify_all()
+        if batcher is not None:
+            batcher.join(5.0 if timeout is None else timeout)
+        with self._pcond:
+            stranded, self._pending = self._pending, []
+        for req in stranded:
+            req.exc = EngineClosed("prefill engine closed")
+            req.ev.set()
 
     def __enter__(self):
         return self

@@ -257,6 +257,7 @@ class ServeRouter:
         # generate requests it completed, and the handoff blob bytes
         # it shipped decode-ward (byte-scale buckets, 1 KiB..64 MiB)
         self._c_generates = _telemetry.counter("serve.router.generates")
+        self._c_streams = _telemetry.counter("serve.router.streams")
         self._c_prefills = _telemetry.counter("serve.prefill.dispatched")
         self._h_handoff = _telemetry.histogram(
             "serve.router.handoff_bytes",
@@ -702,7 +703,8 @@ class ServeRouter:
 
     def generate(self, prompt, max_new_tokens, eos_id=None,
                  temperature=0.0, top_k=None, top_p=None, seed=0,
-                 session=None, timeout=None, handoff=None, tc=None):
+                 session=None, timeout=None, handoff=None, tc=None,
+                 on_token=None):
         """Route one sequence generation through the fleet
         (docs/serving.md §disaggregated prefill).
 
@@ -730,7 +732,21 @@ class ServeRouter:
         Transport-fault replays can stretch the total past it (each
         replayed attempt re-arms its read window — the price of
         exactly-one-response delivery); callers needing a hard wall
-        enforce it on their own side of the wire."""
+        enforce it on their own side of the wire.
+
+        ``on_token``: streaming mode — the decode leg asks its
+        replica to stream and each NEW token relays through
+        ``on_token(tok)`` the moment its frame arrives, without
+        buffering the row. The recovery record extends to the
+        DELIVERED-TOKEN PREFIX: every leg attempt — failover replay
+        on a survivor, migration resume — re-reads its replica's
+        stream from emission index 0, and the router verifies the
+        replayed tokens against what it already delivered (a mismatch
+        fails loudly as a determinism violation), forwarding only the
+        tail. No duplicated or missing frames, across any number of
+        mid-stream replica deaths. Streamed legs drop the blanket
+        whole-completion deadline for the per-frame
+        ``MXNET_STREAM_IDLE_TIMEOUT`` idle bound."""
         prompt = np.asarray(prompt, np.int64).reshape(-1)
         P = int(prompt.size)
         if P < 1:
@@ -779,6 +795,14 @@ class ServeRouter:
                 leg_timeout = max(
                     0.001, float(timeout)
                     - (_telemetry.now_ms() - t_entry) / 1000.0)
+            elif on_token is not None:
+                # streamed leg: liveness is per FRAME, not per
+                # completion — the client applies the
+                # MXNET_STREAM_IDLE_TIMEOUT idle bound to every frame
+                # read, so the old scale-with-the-work ceiling has
+                # nothing left to catch (a hung replica misses one
+                # inter-frame gap and fails over)
+                leg_timeout = None
             else:
                 leg_timeout = 120.0 + float(max_new_tokens)
             # the recovery record: every attempt of this generate —
@@ -788,6 +812,38 @@ class ServeRouter:
             # rides the original admission (exactly-once)
             admit_id = "%s:%d" % (self._admit_cid,
                                   next(self._admit_seq))
+            # the delivered-token prefix — the streaming half of the
+            # recovery record: tokens already relayed to the caller.
+            # Each leg attempt re-reads its replica's stream from
+            # emission index 0 (a deduped or resumed admission
+            # replays the emitted prefix first), so a leg-local
+            # cursor IS the global emission index: verify against
+            # the prefix, relay only the tail
+            delivered = []
+
+            def leg_relay():
+                cur = [0]
+
+                def relay(tok):
+                    k = cur[0]
+                    cur[0] += 1
+                    if k < len(delivered):
+                        if delivered[k] != tok:
+                            raise ServeError(
+                                "stream replay diverged at token %d: "
+                                "%d then %d — determinism violation"
+                                % (k, delivered[k], tok))
+                        return
+                    if k > len(delivered):
+                        raise ServeError(
+                            "stream relay skipped to token %d past "
+                            "the delivered prefix (%d)"
+                            % (k, len(delivered)))
+                    delivered.append(tok)
+                    if k == 0 and _trace.enabled():
+                        _trace.instant("serve.router.stream_relay")
+                    on_token(tok)
+                return relay
 
             def leg(c, resume=None, aid=admit_id):
                 return c.generate(prompt, max_new_tokens,
@@ -798,7 +854,9 @@ class ServeRouter:
                                   handoff=None if resume is not None
                                   else handoff,
                                   timeout=leg_timeout,
-                                  admit_id=aid, resume=resume)
+                                  admit_id=aid, resume=resume,
+                                  on_token=None if on_token is None
+                                  else leg_relay())
             out = self._route(P, session, None, leg, want=want,
                               span="serve.router.decode",
                               recoverable=True)
@@ -837,6 +895,8 @@ class ServeRouter:
                     want=want, span="serve.router.migrate",
                     recoverable=True)
             self._c_generates.inc()
+            if on_token is not None:
+                self._c_streams.inc()
             return out
         finally:
             _trace.end_span(gsp)
@@ -855,6 +915,31 @@ class ServeRouter:
             session=payload.get("session"),
             timeout=payload.get("timeout"),
             handoff=payload.get("handoff"))
+
+    def handle_generate_stream(self, payload, emit):
+        """The streamed ``generate`` frame through a router-fronting
+        ServeServer: relay each leg frame straight out as a front-end
+        frame — the router never buffers the row (``emit`` fires on
+        this dispatch thread the moment a replica frame lands, while
+        the replica is still decoding). ``offset`` restarts at the
+        delivered count, never replays: the router's own prefix
+        verification already absorbed the leg-side replays."""
+        sent = [0]
+
+        def on_token(tok):
+            emit([int(tok)], sent[0])
+            sent[0] += 1
+
+        return self.generate(
+            payload["prompt"], payload["max_new_tokens"],
+            eos_id=payload.get("eos_id"),
+            temperature=payload.get("temperature") or 0.0,
+            top_k=payload.get("top_k"), top_p=payload.get("top_p"),
+            seed=payload.get("seed") or 0,
+            session=payload.get("session"),
+            timeout=payload.get("timeout"),
+            handoff=payload.get("handoff"),
+            on_token=on_token)
 
     def _dispatch(self, arrays, deadline_ms, session, tc):
         if not arrays:

@@ -383,10 +383,13 @@ class TestSeqAxisOp:
                                    np.asarray(ref), rtol=2e-5,
                                    atol=2e-6)
 
+    @pytest.mark.slow
     def test_transformer_trains_sequence_parallel(self):
         """End to end: transformer LM symbol with seq_axis, TrainStep
         over an {'sp': 8} mesh — compiles, runs, loss sane, ring
-        collectives present."""
+        collectives present. Slow tier (~14 s on the 1-core tier-1
+        host); the seq-axis op keeps fast coverage in
+        test_symbol_graph_rings_on_mesh/test_no_mesh_falls_back."""
         import mxnet_tpu as mx
         from mxnet_tpu.initializer import Xavier
         from mxnet_tpu.models import transformer
@@ -415,12 +418,16 @@ class TestSeqAxisOp:
         np.testing.assert_allclose(probs.sum(axis=1), 1.0, rtol=1e-4)
 
 
+@pytest.mark.slow
 @pytest.mark.skipif(jax.device_count() < 8, reason="needs 8-device mesh")
 def test_full_composition_dp_sp_zero1_bf16():
     """The whole v5e-pod recipe in one step: 2-D data x sp mesh, ring
     attention per layer, ZeRO-1 optimizer sharding over 'data', bf16
     compute with f32 masters and protected token ids — compiles,
-    rings, shards, and converges."""
+    rings, shards, and converges. Slow tier (~24 s on the 1-core
+    tier-1 host); every ingredient keeps fast coverage (ring attention
+    in TestRingAttention, seq-axis in TestSeqAxisOp, ZeRO-1/bf16 in
+    test_gspmd.py)."""
     from mxnet_tpu.initializer import Xavier
     from mxnet_tpu.models import transformer
     from mxnet_tpu.parallel import make_mesh, make_train_step
@@ -462,7 +469,13 @@ class TestWindowedRingAttention:
     def _mesh(self):
         return Mesh(np.array(jax.devices()[:8]), ("sp",))
 
-    @pytest.mark.parametrize("window", [1, 5, 8, 13, 24, 1000])
+    # window=1000 (> T: the degenerate all-visible band) costs ~9 s of
+    # compile on the tier-1 host — slow tier; 24 already exercises a
+    # window spanning multiple ring hops
+    @pytest.mark.parametrize("window",
+                             [1, 5, 8, 13, 24,
+                              pytest.param(1000,
+                                           marks=pytest.mark.slow)])
     def test_matches_dense_banded(self, window):
         mesh = self._mesh()
         B, H, T, D = 1, 2, 8 * 8, 16

@@ -15,33 +15,36 @@ FAST_EXAMPLES = [
     "custom_op_softmax.py",
     "adversary_fgsm.py",
     "profile_model.py",
-    "gan_toy.py",
     "fit_spmd_elastic.py",
-    "rcnn_train.py",
     "fcn_xs.py",
-    "nce_loss.py",
-    "multi_task.py",
     "svm_digits.py",
     "vae.py",
     "neural_style.py",
-    "sgld_bayes.py",
     "dsd_pruning.py",
-    "image_folder_training.py",
     "memcost_remat.py",
 ]
 
-# The heaviest end-to-end demos (20-47 s each on the 1-core tier-1
+# The heaviest end-to-end demos (8-47 s each on the 1-core tier-1
 # host) ride the slow tier: the suite crossed the 870 s tier-1
-# wall-clock budget and these three cost the most while their
-# framework surfaces keep dedicated unit coverage in tier-1
+# wall-clock budget and these cost the most while their framework
+# surfaces keep dedicated unit coverage in tier-1
 # (generation/beam/speculative/int8 in test_generation.py +
 # test_serve_decode.py/test_serve_disagg.py; the Module fit API in
-# test_module.py and the perf-gate `module` scenario; RL uses no
-# unique surface). Each still self-checks when the slow tier runs.
+# test_module.py and the perf-gate `module` scenario; rcnn/detection
+# ops in test_rcnn_contrib_ops.py + test_detection_ops.py; the NCE op
+# in test_op_sweep.py; gan_toy/multi_task are plain Module loops; RL
+# uses no unique surface; image_folder_training and sgld_bayes are
+# demo-only surfaces whose self-checks still run in the slow tier).
 HEAVY_EXAMPLES = [
     "transformer_generate.py",
     "actor_critic.py",
     "stochastic_depth.py",
+    "image_folder_training.py",
+    "nce_loss.py",
+    "sgld_bayes.py",
+    "rcnn_train.py",
+    "gan_toy.py",
+    "multi_task.py",
 ]
 
 

@@ -252,9 +252,13 @@ class TestQuantizedKV:
             np.testing.assert_array_equal(got[i], want)
         assert st["finished"] == len(prompts) > B   # slot turnover
 
+    @pytest.mark.slow
     def test_q8_gqa_head_grouping(self):
         """GQA + int8: the per-row q8 op groups q heads over the
-        (fewer) cached kv heads exactly like the shared path."""
+        (fewer) cached kv heads exactly like the shared path. Slow
+        tier (~9 s on the 1-core tier-1 host); GQA+int8 keeps a fast
+        exemplar in test_serve_disagg.py's int8+GQA handoff parity and
+        the non-GQA q8 pool parity stays fast above."""
         params = _params(seed=6, num_kv_heads=1)
         pool = Generator(params, V, T, num_layers=L, num_heads=H,
                          dim=DIM, batch_size=2, num_kv_heads=1,

@@ -303,11 +303,15 @@ def test_segsum_embedding_grad_matches_scatter(monkeypatch):
     assert g_empty.shape == w.shape and (g_empty == 0).all()
 
 
+@pytest.mark.slow
 def test_chunked_loss_head_bf16_remat():
     """The production long-context configuration: chunked-CE head
     under bf16 compute AND remat (checkpointed chunk scan nested in
     the checkpointed forward) — the exact shape of the live 32k/48k
-    runs. Must train with finite, dense-head-close losses."""
+    runs. Must train with finite, dense-head-close losses. Slow tier
+    (~12 s on the 1-core tier-1 host); the chunked head keeps fast
+    coverage in test_chunked_loss_head_matches_dense/_on_mesh and the
+    op-value test."""
     V, T, B = 50, 12, 4
     rng = np.random.RandomState(0)
     batch = {"data": rng.randint(0, V, (B, T)).astype(np.float32),

@@ -6,7 +6,10 @@ a declarative kill schedule SIGKILLs child replicas mid-run and
 restarts them. The acceptance property is the fleet's whole
 robustness contract in one sentence: EVERY request resolves to
 exactly one successful response, token-for-token equal to the
-fault-free run — greedy and seeded alike.
+fault-free run — greedy and seeded alike, and a STREAMED request's
+concatenated frame tokens byte-equal the fault-free generated tail
+even when the kill fires mid-stream (no duplicated, no missing
+frames).
 
 The schedule is the ``kill<I>`` member of the ``MXNET_FAULT_SPEC``
 step-rule family (``parallel/resilience.py``): the call counted is
@@ -153,7 +156,12 @@ def _request_plan(args):
     """The full request matrix, deterministic in (client, j): mixed
     greedy / seeded sampling, varied prompt lengths, eos enabled (a
     random tiny LM does emit eos early — the oracle matches
-    bit-for-bit, so early stops are covered, not avoided)."""
+    bit-for-bit, so early stops are covered, not avoided). Every
+    third request STREAMS (on_token frames): its collected tokens
+    must concatenate byte-equal to the fault-free row's generated
+    tail even when the kill schedule fires mid-stream — the
+    delivered-prefix replay contract (docs/robustness.md §mid-stream
+    failover)."""
     plan = {}
     for c in range(args.clients):
         for j in range(args.requests):
@@ -166,6 +174,7 @@ def _request_plan(args):
                 "temperature": 0.8 if seeded else 0.0,
                 "top_k": 8 if seeded else None,
                 "seed": 1000 * c + j,
+                "stream": (c + j) % 3 == 0,
             }
     return plan
 
@@ -208,6 +217,7 @@ def _run(args):
     tick_lock = threading.Lock()
     completed = [0]
     results = {k: [] for k in plan}
+    stream_toks = {k: [] for k in plan if plan[k]["stream"]}
     failures = []
 
     def restart_replica(i, name):
@@ -244,12 +254,14 @@ def _run(args):
     def client(c):
         for j in range(args.requests):
             r = plan[(c, j)]
+            toks = [] if r["stream"] else None
             try:
                 row = router.generate(
                     r["prompt"], args.max_new, eos_id=0,
                     temperature=r["temperature"], top_k=r["top_k"],
                     seed=r["seed"], session="c%d" % c,
-                    timeout=args.deadline)
+                    timeout=args.deadline,
+                    on_token=toks.append if r["stream"] else None)
             except Exception as exc:  # noqa: BLE001 — a failed
                 # request IS the finding this harness exists to catch
                 failures.append({"client": c, "j": j,
@@ -257,6 +269,9 @@ def _run(args):
                                  % (type(exc).__name__, exc)})
                 continue
             results[(c, j)].append(np.asarray(row))
+            if r["stream"]:
+                stream_toks[(c, j)].append(np.asarray(toks,
+                                                      np.int64))
             on_complete()
 
     restart_threads = []
@@ -296,6 +311,16 @@ def _run(args):
             mismatches.append({"client": key[0], "j": key[1],
                                "got": got[0].tolist(),
                                "want": want[key].tolist()})
+        elif key in stream_toks:
+            # the streamed contract: concatenated frame tokens ==
+            # the fault-free generated tail, exactly once, even when
+            # a kill fired mid-stream
+            tail = want[key][len(plan[key]["prompt"]):]
+            if not np.array_equal(stream_toks[key][0], tail):
+                mismatches.append(
+                    {"client": key[0], "j": key[1], "kind": "stream",
+                     "got": stream_toks[key][0].tolist(),
+                     "want": tail.tolist()})
 
     def cval(name):
         e = telemetry.snapshot().get(name)
@@ -308,6 +333,7 @@ def _run(args):
         "metric": "chaos_fleet",
         "ok": ok,
         "requests": args.clients * args.requests,
+        "streamed": len(stream_toks),
         "clients": args.clients,
         "replicas": args.replicas,
         "fault_spec": spec,

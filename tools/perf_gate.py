@@ -504,6 +504,80 @@ def _scn_decode_q8():
     _decode_workload(quantize_kv=True)
 
 
+def _scn_streaming():
+    """PR 17 surface: streamed generate frames + chunked prefill.
+    One decode replica behind the wire: a streamed generate's
+    on_token tail byte-equals the one-shot row (greedy AND seeded —
+    the terminal reply cross-checks every stream bitwise), a long
+    prompt under MXNET_PREFILL_CHUNK admits in a deterministic chunk
+    count with the same bits, and the (B, 1) decode step stays ONE
+    compiled executable across streamed + chunked turnover. Stream/
+    chunk counters are exact; frame counts are noisy (the handler
+    coalesces emissions per wire frame, which is scheduling-
+    dependent)."""
+    import os as _os
+
+    import numpy as np
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import telemetry
+    from mxnet_tpu.generation import Generator
+    from mxnet_tpu.initializer import Xavier
+    from mxnet_tpu.models import transformer
+    from mxnet_tpu.parallel import make_train_step
+    from mxnet_tpu.serve import ContinuousDecoder, ServeServer
+    from mxnet_tpu.serve.net import ServeClient
+    t0 = telemetry.now_ms()
+    V, L, H, DIM, T = 50, 2, 2, 32, 24
+    sym = transformer.get_symbol(V, 12, num_layers=L, num_heads=H,
+                                 dim=DIM, max_len=T)
+    step = make_train_step(sym, optimizer="sgd")
+    mx.random.seed(0)
+    params = step.init_state(Xavier(), {"data": (2, 12),
+                                        "softmax_label": (2, 12)})[0]
+
+    def gen(bs):
+        return Generator(params, V, T, num_layers=L, num_heads=H,
+                         dim=DIM, batch_size=bs)
+    p, long_p = np.arange(1, 5), np.arange(1, 11)
+    kw = {"temperature": 0.8, "top_k": 8, "seed": 7}
+    single = gen(1)
+    want = single.generate(p[None], 8, eos_id=0)[0]
+    want_s = single.generate(p[None], 8, eos_id=0, **kw)[0]
+    want_l = single.generate(long_p[None], 6, eos_id=0)[0]
+    dec = ContinuousDecoder(gen(2))
+    srv = ServeServer(dec)
+    with ServeClient(srv.host, srv.port) as cli:
+        toks = []
+        out = cli.generate(p, 8, eos_id=0, on_token=toks.append)
+        assert np.array_equal(out, want), (out, want)
+        assert np.array_equal(toks, want[p.size:]), (toks, want)
+        toks = []
+        out = cli.generate(p, 8, eos_id=0, on_token=toks.append,
+                           **kw)
+        assert np.array_equal(out, want_s), (out, want_s)
+        assert np.array_equal(toks, want_s[p.size:]), (toks, want_s)
+        # chunked prefill: 10-token prompt in 3-token slices -> 4
+        # chunks, bit-identical row
+        _os.environ["MXNET_PREFILL_CHUNK"] = "3"
+        try:
+            out = cli.generate(long_p, 6, eos_id=0)
+        finally:
+            _os.environ.pop("MXNET_PREFILL_CHUNK", None)
+        assert np.array_equal(out, want_l), (out, want_l)
+
+    def cval(name):
+        rec = telemetry.snapshot().get(name) or {}
+        return rec.get("value", 0)
+    assert cval("serve.decode.streams") == 2
+    assert cval("serve.decode.prefill_chunks") == 4
+    srv.close()
+    dec.close()
+    telemetry.journal_event("gate.probe",
+                            streaming_elapsed_ms=round(
+                                telemetry.now_ms() - t0, 3))
+
+
 # which PR-won property each gauge protects is resolved through
 # _PROPERTY_NOTES below; `gauges` lists the gauge names a scenario
 # REQUIRES in the final snapshot (absence is itself a gate failure),
@@ -579,6 +653,18 @@ SCENARIOS = {
                    "serve.router.replicas_live"),
         "noisy_counters": (), "noisy_events": (),
     },
+    "streaming": {
+        "fn": _scn_streaming,
+        "desc": "streamed generate frames (token-exact vs one-shot) "
+                "+ chunked prefill, one decode replica on the wire",
+        "gauges": ("serve.decode.jit_cache_size",
+                   "serve.decode.kv_bytes_per_slot"),
+        # emissions coalesce into wire frames per handler wakeup —
+        # the frame count is scheduling-dependent, the token
+        # sequence is not
+        "noisy_counters": ("serve.net.stream_frames",),
+        "noisy_events": (),
+    },
 }
 
 # field-path prefix -> the protected property a regression names.
@@ -630,6 +716,24 @@ _PROPERTY_NOTES = (
     ("counts.counters.serve.router.recycles",
      "PR 14 zero-drop rolling restarts: drain -> restart -> re-warm "
      "-> readmit ran to completion exactly as scripted"),
+    ("counts.counters.serve.decode.streams",
+     "PR 17 streaming: one stream per streamed generate, exactly — "
+     "a drift means the frame subscription path double-registers or "
+     "silently degrades to one-shot"),
+    ("counts.counters.serve.decode.prefill_chunks",
+     "PR 17 chunked prefill: ceil(prompt/MXNET_PREFILL_CHUNK) chunk "
+     "forwards per long admission, exactly — a drift means the "
+     "chunk loop re-runs slices or stopped interleaving"),
+    ("counts.counters.serve.net.stream",
+     "PR 17 streaming wire: streamed requests counted once at the "
+     "server (frame counts are scheduling-dependent and excluded "
+     "where streams run)"),
+    ("counts.counters.serve.router.streams",
+     "PR 17 streaming relay: the router relays frames without "
+     "buffering, one stream per streamed generate"),
+    ("counts.counters.serve.prefill.batched",
+     "PR 17 batched prefill: coalesced prefill groups — nonzero "
+     "only where concurrent prompts rode one padded forward"),
     ("counts.counters.serve.prefill.",
      "PR 15 disaggregation: prefill fan-out is exact — requests "
      "prefilled on prefill-role replicas and handoffs shipped, "

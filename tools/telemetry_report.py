@@ -226,6 +226,16 @@ def _serving_section(events, snap):
         out["decode_ms"] = {"p50": round(_quantile(ms, 0.50), 3),
                             "p95": round(_quantile(ms, 0.95), 3)}
     if snap is not None:
+        # streaming latency first-class: TTFT and inter-token gap
+        # quantiles straight off the registry histograms (populated
+        # by every decode emission, streamed or not)
+        for name, key in (("serve.ttft_ms", "ttft_ms"),
+                          ("serve.inter_token_ms",
+                           "inter_token_ms")):
+            h = snap.get(name) or {}
+            if h.get("type") == "histogram" and h.get("count"):
+                out[key] = {"count": h["count"], "p50": h.get("p50"),
+                            "p95": h.get("p95"), "p99": h.get("p99")}
         counters = {k: v["value"] for k, v in snap.items()
                     if k.startswith("serve.")
                     and v.get("type") == "counter" and v.get("value")}
@@ -321,6 +331,18 @@ def format_report(summary):
                    serving["decode_tokens"],
                    serving["decode_ms"]["p50"],
                    serving["decode_ms"]["p95"]))
+        if serving.get("ttft_ms"):
+            t = serving["ttft_ms"]
+            lines.append(
+                "  TTFT p50/p95/p99: %.1f/%.1f/%.1f ms over %d first "
+                "token(s)" % (t["p50"], t["p95"], t["p99"],
+                              t["count"]))
+        if serving.get("inter_token_ms"):
+            t = serving["inter_token_ms"]
+            lines.append(
+                "  inter-token p50/p95/p99: %.2f/%.2f/%.2f ms over "
+                "%d gap(s)" % (t["p50"], t["p95"], t["p99"],
+                               t["count"]))
         if serving.get("kv_bytes_per_slot"):
             kvb = serving["kv_bytes_per_slot"]
             lines.append(
@@ -514,24 +536,26 @@ def format_fleet(rows):
         v = (snap.get(name) or {}).get("value")
         return "-" if v is None else ("%g" % v)
 
-    header = ("| replica | role | queue | in-flight | admitted | "
-              "shed | timeouts | active slots | warmed |")
+    header = ("| replica | role | queue | in-flight | streams | "
+              "admitted | shed | timeouts | active slots | warmed |")
     lines = ["serve fleet stats (%d target(s))" % len(rows),
              "=" * 46, "", header,
-             "|---|---|---|---|---|---|---|---|---|"]
+             "|---|---|---|---|---|---|---|---|---|---|"]
     for addr, stats in rows:
         if not stats:
             lines.append("| %s | unreachable | - | - | - | - | - | - "
-                         "| - |" % addr)
+                         "| - | - |" % addr)
             continue
         eng = stats.get("engine") or {}
         snap = stats.get("telemetry") or {}
         warmed = eng.get("warmed")
-        lines.append("| %s | %s | %s | %s | %s | %s | %s | %s | %s |"
+        lines.append("| %s | %s | %s | %s | %s | %s | %s | %s | %s "
+                     "| %s |"
                      % (addr,
                         eng.get("role", "engine"),
                         eng.get("queue_depth", "-"),
                         eng.get("in_flight", "-"),
+                        eng.get("streams_in_flight", "-"),
                         eng.get("admitted", eng.get("dispatched",
                                                     "-")),
                         eng.get("shed", "-"),

@@ -200,6 +200,13 @@ echo "== 4b3. prefill/decode disaggregation A/B =="
 cap "$OUT/serve_disagg.json" serve_disagg \
     python bench_serve.py --disagg "${BENCH_DISAGG_SPLIT:-1:1}"
 
+echo "== 4b4. streaming + chunked-prefill A/B =="
+# streamed frames vs one-shot (TTFT p50 <= 0.25x one-shot total at
+# max_new >= 32) and chunked vs monolithic prefill under long-prompt
+# load (inter-token p99 <= 0.5x) — docs/serving.md §streaming
+cap "$OUT/serve_streaming.json" serve_streaming \
+    python bench_serve.py --streaming
+
 echo "== 4c. scaling sweep + GSPMD one-jit row =="
 # single chip unless the slice offers more (BENCH_SCALING_DEVICES=1,4,8
 # on a multi-chip window); the gspmd row is the 28.8%->45% MFU
