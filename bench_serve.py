@@ -69,6 +69,19 @@ request latency with null inter-token. Emitted metric:
 ``serve_streaming_ttft``.
 
     python bench_serve.py --streaming
+
+SPECULATIVE MODE (``--speculative``, docs/serving.md §speculative):
+plain vs draft/verify continuous batching on ONE doctored target
+(post-layer0 residual branches downscaled so the 1-layer truncated
+draft tracks it — the high-acceptance regime). The headline ``value``
+is the speculative per-session effective inter-token latency p99
+((wall first->last token) / (tokens-1), p99 across sessions);
+``vs_baseline`` is its ratio to the plain-decode p99 — acceptance is
+< 1.0 AND ``tokens_per_target_forward`` > 1.5 at gamma=4. Output is
+asserted byte-identical between the phases (exactness is the
+contract, not an aspiration). Emitted metric: ``serve_spec_decode``.
+
+    python bench_serve.py --speculative
 """
 import argparse
 import json
@@ -789,6 +802,136 @@ def _run_streaming(args):
     }
 
 
+def _doctored_lm_params(args, scale=1e-2):
+    """Target params whose post-layer0 residual branches are
+    downscaled so a 1-layer truncated draft tracks the full target
+    closely — the high-acceptance regime speculative decoding is
+    built for, made reproducible on random weights (with every
+    ``layer<k>_`` tensor for k >= 1 scaled to ~0 the pre-norm
+    residual blocks contribute ~nothing, so the deep target computes
+    ~its own first layer). The SAME doctored target runs on BOTH
+    sides of the A/B — the comparison is spec-vs-plain decoding of
+    one model, not shallow-vs-deep models."""
+    params = dict(_lm_params(args))
+    deep = tuple("layer%d_" % k for k in range(1, args.lm_layers))
+    for name in list(params):
+        if name.startswith(deep):
+            params[name] = params[name] * scale
+    return params
+
+
+def _run_speculative(args):
+    """The --speculative A/B (docs/serving.md §speculative): plain
+    vs draft/verify continuous batching on one in-process decode
+    replica behind real TCP, same doctored target both phases.
+
+    Measured shape: `reps` sequential streamed short-prompt sessions
+    per phase; the per-session effective inter-token latency is
+    (wall first token -> last token) / (tokens - 1) — the fair
+    metric, because a spec round emits its accepted tokens in a
+    burst (per-gap quantiles reward the in-burst ~0ms gaps and
+    punish the round boundary; the session mean is what a caller
+    experiences). Acceptance: spec p99 / plain p99 < 1.0 AND
+    tokens-per-target-forward > 1.5 at gamma=4 — both only hold
+    when acceptance is high, which the doctored tail provides."""
+    from mxnet_tpu import telemetry
+    from mxnet_tpu.generation import Generator
+    from mxnet_tpu.serve import ContinuousDecoder, ServeClient, \
+        ServeServer
+
+    rng = np.random.RandomState(0)
+    short = rng.randint(1, args.lm_vocab, (args.short_prompt,))
+    max_new = max(int(args.max_new), 32)
+    reps = max(8, min(args.requests, 40))
+    params = _doctored_lm_params(args)
+
+    def _q(vals):
+        vals = sorted(vals)
+        return {"p50": round(telemetry.quantile(vals, 0.50), 3),
+                "p99": round(telemetry.quantile(vals, 0.99), 3)}
+
+    def phase(speculative):
+        gen = Generator(params, args.lm_vocab, args.lm_max_len,
+                        num_layers=args.lm_layers,
+                        num_heads=args.lm_heads, dim=args.lm_dim,
+                        batch_size=args.slots)
+        draft = gen.truncated_draft(num_layers=args.draft_layers) \
+            if speculative else None
+        dec = ContinuousDecoder(gen, queue_cap=512, draft=draft,
+                                lookahead=args.gamma)
+        srv = ServeServer(dec)
+        eff, gaps, toks = [], [], None
+        try:
+            with ServeClient(srv.host, srv.port) as cli:
+                # warm BOTH target shapes before measuring: the
+                # (B, 1) step and, in the spec phase, the
+                # (B, gamma+1) verify + the draft pair
+                cli.generate(short, max_new)
+                if speculative:
+                    cli.generate(short, max_new, speculative=True)
+                s0 = dec.stats()
+                for _ in range(reps):
+                    marks = []
+                    out = cli.generate(
+                        short, max_new, speculative=speculative,
+                        on_token=lambda t:
+                        marks.append(telemetry.now_ms()))
+                    if toks is None:
+                        toks = [int(t) for t in out]
+                    if len(marks) >= 2:
+                        eff.append((marks[-1] - marks[0])
+                                   / (len(marks) - 1))
+                        gaps.extend(b - a for a, b in
+                                    zip(marks, marks[1:]))
+                s1 = dec.stats()
+        finally:
+            srv.close()
+            dec.close()
+        delta = {k: s1[k] - s0[k] for k in s1
+                 if isinstance(s1[k], (int, float))
+                 and isinstance(s0.get(k), (int, float))}
+        return eff, gaps, delta, toks
+
+    plain_eff, plain_gaps, plain_delta, plain_toks = phase(False)
+    spec_eff, spec_gaps, spec_delta, spec_toks = phase(True)
+    if spec_toks != plain_toks:
+        # speculative decoding is exact BY CONSTRUCTION (shared-noise
+        # verification, docs/serving.md §speculative) — a mismatch
+        # here is a correctness bug, not a benchmark artifact
+        raise RuntimeError(
+            "speculative output diverged from plain decode: %r vs %r"
+            % (spec_toks, plain_toks))
+    plain_p99 = telemetry.quantile(sorted(plain_eff), 0.99)
+    spec_p99 = telemetry.quantile(sorted(spec_eff), 0.99)
+    # during the measured spec window every forward is a verify (the
+    # sole client sends only speculative requests), so the target-
+    # forward count is the steps delta
+    tpf = round((reps * max_new) / spec_delta["steps"], 3) \
+        if spec_delta.get("steps") else None
+    acc = round(spec_delta["spec_accepted"]
+                / spec_delta["spec_proposed"], 4) \
+        if spec_delta.get("spec_proposed") else None
+    return {
+        "gamma": int(args.gamma),
+        "draft_layers": int(args.draft_layers),
+        "target_layers": int(args.lm_layers),
+        "max_new": max_new,
+        "requests": reps,
+        "plain_inter_token_eff_ms": _q(plain_eff),
+        "spec_inter_token_eff_ms": _q(spec_eff),
+        # acceptance: < 1.0 (per-session effective latency, p99
+        # across sessions)
+        "inter_token_eff_p99_ratio": round(spec_p99 / plain_p99, 4),
+        # acceptance: > 1.5 at gamma=4
+        "tokens_per_target_forward": tpf,
+        "accept_rate_mean": acc,
+        "plain_inter_token_gap_ms": _q(plain_gaps),
+        "spec_inter_token_gap_ms": _q(spec_gaps),
+        "plain_stats": plain_delta,
+        "spec_stats": spec_delta,
+    }
+
+
 def main(argv=None):
     p = argparse.ArgumentParser(description=__doc__)
     p.add_argument("--concurrency", default=None,
@@ -837,6 +980,17 @@ def main(argv=None):
                         "TTFT and chunked-vs-monolithic prefill "
                         "inter-token p99 (docs/serving.md "
                         "§streaming)")
+    p.add_argument("--speculative", action="store_true",
+                   help="speculative-decoding A/B: plain vs "
+                        "draft/verify continuous batching on the "
+                        "same doctored target (docs/serving.md "
+                        "§speculative); acceptance is effective "
+                        "inter-token p99 ratio < 1.0 and tokens per "
+                        "target forward > 1.5 at gamma=4")
+    p.add_argument("--gamma", type=int, default=4,
+                   help="speculative mode: draft lookahead per round")
+    p.add_argument("--draft-layers", type=int, default=1,
+                   help="speculative mode: truncated-draft depth")
     p.add_argument("--prefill-chunk", type=int, default=16,
                    help="streaming mode: MXNET_PREFILL_CHUNK for the "
                         "chunked side of the prefill A/B")
@@ -852,8 +1006,15 @@ def main(argv=None):
     p.add_argument("--slots", type=int, default=4,
                    help="decode replica slot-pool width")
     p.add_argument("--lm-vocab", type=int, default=64)
-    p.add_argument("--lm-dim", type=int, default=64)
-    p.add_argument("--lm-layers", type=int, default=2)
+    p.add_argument("--lm-dim", type=int, default=None,
+                   help="decode replica width (default 64; "
+                        "speculative mode 256 — below that, per-"
+                        "forward dispatch overhead hides the "
+                        "draft/target compute gap on CPU)")
+    p.add_argument("--lm-layers", type=int, default=None,
+                   help="decode replica depth (default 2; "
+                        "speculative mode 4 — the draft/target depth "
+                        "gap is where the speedup lives)")
     p.add_argument("--lm-heads", type=int, default=2)
     p.add_argument("--lm-max-len", type=int, default=None,
                    help="decode cache length (default 160; streaming "
@@ -863,6 +1024,18 @@ def main(argv=None):
     p.add_argument("--serve-replica", action="store_true",
                    help=argparse.SUPPRESS)   # internal: child mode
     args = p.parse_args(argv)
+    if args.lm_layers is None:
+        args.lm_layers = 4 if args.speculative else 2
+    if args.lm_dim is None:
+        args.lm_dim = 256 if args.speculative else 64
+    if args.speculative:
+        if args.draft_layers >= args.lm_layers:
+            p.error("--draft-layers must be < --lm-layers (the draft "
+                    "must be cheaper than the target)")
+        if args.short_prompt + max(args.max_new, 32) \
+                > (args.lm_max_len or 160) - args.gamma:
+            p.error("--short-prompt + max_new exceeds the speculative "
+                    "headroom (--lm-max-len - gamma)")
     if args.long_prompt is None:
         args.long_prompt = 512 if args.streaming else 96
     if args.lm_max_len is None:
@@ -876,6 +1049,8 @@ def main(argv=None):
 
     if args.disagg:
         metric, unit = "serve_disagg_p99", "ms/token"
+    elif args.speculative:
+        metric, unit = "serve_spec_decode", "ms/token"
     elif args.streaming:
         metric, unit = "serve_streaming_ttft", "ms"
     elif args.replicas:
@@ -894,6 +1069,31 @@ def main(argv=None):
         if args.role in ("prefill", "decode"):
             return _gen_replica_child(args)
         return _replica_child(args)
+    if args.speculative:
+        try:
+            row = _run_speculative(args)
+        except Exception as e:  # noqa: BLE001 — diagnostic line (the
+            # bench_common fail_payload contract, like the sweeps)
+            try:
+                from bench_common import fail_payload
+                payload = fail_payload(metric, unit, e)
+            except ImportError:
+                payload = {"metric": metric, "value": None,
+                           "unit": unit, "vs_baseline": None,
+                           "live": False, "error": "%s: %s"
+                           % (type(e).__name__, e)}
+            print(json.dumps(payload))
+            sys.exit(1)
+        print(json.dumps({
+            "metric": metric,
+            "value": row["spec_inter_token_eff_ms"]["p99"],
+            "unit": unit,
+            # acceptance shape: spec effective inter-token p99 <
+            # 1.0x plain on the same target (lower is better), with
+            # tokens_per_target_forward > 1.5 at gamma=4
+            "vs_baseline": row["inter_token_eff_p99_ratio"],
+            **row}))
+        return 0
     if args.streaming:
         try:
             row = _run_streaming(args)

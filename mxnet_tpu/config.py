@@ -273,6 +273,18 @@ define("MXNET_PREFILL_CHUNK", int, 0,
        "docs/serving.md §streaming). 0 = off (whole-prompt prefill). "
        "Chunk forwards ride the shared-position prefill graph — the "
        "(B, 1) decode step stays a single XLA specialization")
+define("MXNET_SPEC_DRAFT", str, "",
+       "speculative-decoding draft for the serving decoder: "
+       "'layers=<d>[,gamma=<g>]' makes every ContinuousDecoder built "
+       "without an explicit draft= attach a truncated_draft of its "
+       "own generator (the first <d> transformer blocks, shared "
+       "weights) and verify <g> proposed tokens per round (default "
+       "gamma=4). Requests still opt in per call "
+       "(submit(speculative=True)); the knob only provisions the "
+       "draft, so whole fleets — including subprocess replicas — "
+       "turn it on through the environment. Empty = no draft. "
+       "Validated loudly at decoder construction; docs/serving.md "
+       "§speculative")
 define("MXNET_STREAM_IDLE_TIMEOUT", float, 30.0,
        "streamed-generate per-frame idle timeout (seconds): a "
        "streaming client (ServeClient.generate(on_token=) and every "

@@ -582,21 +582,35 @@ class Generator:
         return fn
 
     def generate_speculative(self, draft, prompt, max_new_tokens,
-                             lookahead=4):
-        """Greedy speculative decoding: a small `draft` Generator
-        proposes `lookahead` tokens per round; this (target) model
-        verifies them in ONE forward and keeps the longest greedy-
-        matching prefix plus its own next token. Output is EXACTLY
-        this model's greedy continuation — the draft only changes how
-        many target forwards it takes (classic speculative decoding,
-        greedy acceptance).
+                             lookahead=4, temperature=0.0, top_k=None,
+                             top_p=None, seed=0):
+        """Speculative decoding: a small `draft` Generator proposes
+        `lookahead` tokens per round; this (target) model verifies
+        them in ONE forward and keeps the longest matching prefix plus
+        its own next token. Output is EXACTLY this model's own
+        ``generate`` continuation for the same sampling args — the
+        draft only changes how many target forwards it takes.
+
+        Sampling uses common-random-numbers verification, a
+        deterministic specialisation of speculative rejection
+        sampling: the token at emission index j is ALWAYS
+        ``_pick_token(target_logits_j, sub_j)`` where ``sub_j`` is the
+        (j+1)-th split of ``PRNGKey(seed)`` — the exact key discipline
+        of ``generate``'s loop (``replay_key``). The draft proposes
+        with the SAME ``sub_j`` on its own logits, so a proposal is
+        accepted exactly when it equals the target's pick under shared
+        noise; acceptance rate tracks how closely the draft's filtered
+        distribution matches the target's. Output is therefore
+        byte-identical to ``generate(seed=...)`` — trivially
+        distribution-exact, and replayable token-for-token (the
+        serving fleet's failover contract rides on this).
 
         Cache rollback is free by construction: `_contrib_
         CachedAttention` writes at `cache_pos` and masks columns
         beyond `pos + row`, so rejected speculative entries are simply
         overwritten by the next append and can never be attended.
 
-        Exactness caveat: "exactly greedy" holds up to XLA kernel
+        Exactness caveat: "exactly generate()" holds up to XLA kernel
         numerics — the chunked verify forward (Tnew = lookahead+1) and
         the one-token decode forward may differ at the last ulp, so a
         near-exact logit TIE can in principle resolve differently than
@@ -604,10 +618,11 @@ class Generator:
         and not observed in tests; noted for bit-exactness audits.
 
         draft: a Generator with the same vocab/batch (typically fewer
-        layers/dims). Returns (B, P + max_new_tokens) ids. Batch rows
-        advance in lockstep (the accepted length each round is the
-        minimum across rows), so batching still helps only with
-        similar acceptance; B=1 is the classic setting."""
+        layers/dims — :meth:`truncated_draft`). Returns
+        (B, P + max_new_tokens) ids. Batch rows advance in lockstep
+        (the accepted length each round is the minimum across rows) —
+        the serving decoder's per-slot rounds lift that restriction;
+        B=1 is the classic setting here."""
         if draft.vocab_size != self.vocab_size or \
                 draft.batch_size != self.batch_size:
             raise ValueError("draft must share vocab_size/batch_size "
@@ -617,11 +632,14 @@ class Generator:
             # a circular buffer (p_s mis-attribution) — not supported
             raise ValueError("speculative decoding is not supported "
                              "with rolling caches")
+        self._check_sampling(temperature, top_k, top_p)
         prompt, P = self._check_prompt(prompt, max_new_tokens)
         if P + max_new_tokens > draft.max_len:
             raise ValueError("draft max_len=%d too small for %d tokens"
                              % (draft.max_len, P + max_new_tokens))
         gamma = max(1, int(lookahead))
+        sampled = bool(temperature and float(temperature) > 0)
+        key = jax.random.PRNGKey(int(seed or 0)) if sampled else None
 
         # invariant: before each round, both caches hold a VALID prefix
         # covering [0, len(out) - 1) — every round's feeds start at
@@ -638,13 +656,23 @@ class Generator:
             pos = out.shape[1]
             budget = max_new_tokens - (pos - P)
             g = min(gamma, budget - 1)      # leave room for the bonus
+            # peek this round's subs WITHOUT advancing the stream: the
+            # draft proposes with the same sub the target will verify
+            # with, and the key only advances by what is emitted
+            subs, k = [], key
+            if sampled:
+                for _ in range(g + 1):
+                    k, sub = jax.random.split(k)
+                    subs.append(sub)
             # draft proposes g tokens, continuing from the last emitted
             cur = out[:, -1]
             props = []
             for i in range(g):
                 dl, d_aux = draft._forward(d_aux, cur[:, None],
                                            pos - 1 + i)
-                cur = np.asarray(jnp.argmax(dl[:, -1], axis=-1))
+                cur = np.asarray(_pick_token(
+                    dl[:, -1], temperature, top_k,
+                    subs[i] if sampled else None, top_p))
                 props.append(cur)
             # ONE target forward scores last_emitted + all proposals:
             # tokens at positions pos-1 .. pos+g-1, logits predicting
@@ -652,18 +680,26 @@ class Generator:
             chunk = np.concatenate(
                 [out[:, -1:]] + [p[:, None] for p in props], axis=1)
             tl, t_aux = self._forward(t_aux, chunk, pos - 1)
-            greedy = np.asarray(jnp.argmax(tl, axis=-1))  # (B, g+1)
+            picks = np.stack(
+                [np.asarray(_pick_token(
+                    tl[:, c], temperature, top_k,
+                    subs[c] if sampled else None, top_p))
+                 for c in range(g + 1)], axis=1)          # (B, g+1)
             # accept while the draft token at pos+i matches the target
-            # greedy prediction for pos+i; lockstep across the batch
+            # pick for pos+i; lockstep across the batch
             acc = 0
             while acc < g and bool(
-                    (props[acc] == greedy[:, acc]).all()):
+                    (props[acc] == picks[:, acc]).all()):
                 acc += 1
-            # emit the accepted draft tokens + the target's own next
-            # token (correctly conditioned: its inputs are the accepted
-            # prefix) — every emitted token is exactly target-greedy
-            emit = np.stack(props[:acc] + [greedy[:, acc]], axis=1)
-            out = np.concatenate([out, emit], axis=1)
+            # emit the accepted tokens + the target's own next token
+            # (correctly conditioned: its inputs are the accepted
+            # prefix — accepted proposals ARE the target's picks, so
+            # every emitted token is exactly what generate() picks)
+            out = np.concatenate([out, picks[:, :acc + 1]], axis=1)
+            if sampled:
+                # one split per EMITTED token, whatever path drew it
+                for _ in range(acc + 1):
+                    key, _ = jax.random.split(key)
             if acc == g and g > 0 and \
                     out.shape[1] - P < max_new_tokens:
                 # full acceptance: the draft never ingested its own
@@ -675,22 +711,68 @@ class Generator:
                                           pos + g - 1)
         return out[:, :P + max_new_tokens]
 
+    def truncated_draft(self, num_layers=1, batch_size=None,
+                        max_len=None):
+        """A draft Generator that runs only the FIRST ``num_layers``
+        transformer blocks of THIS model, sharing its weights — the
+        zero-extra-checkpoint speculative draft. Works because
+        Generator filters ``arg_params`` down to what its own symbol
+        lists: a shallower decode symbol's argument names are a strict
+        subset of the full stack's (layer0..k-1 + embed/head), so the
+        truncated model is literally the full model with the late
+        blocks skipped. Residual connections make that a coarse but
+        real approximation; acceptance rate measures how much the
+        dropped layers change the pick.
+
+        ``batch_size``/``max_len`` default to this model's (the
+        serving decoder wants the same slot-pool shape; give the draft
+        a larger max_len only if you need extra lookahead headroom)."""
+        o = self._decode_opts
+        if o["quantized"]:
+            raise ValueError(
+                "truncated_draft is not supported on a quantize='int8' "
+                "Generator (its stored weights are already int8; build "
+                "the draft from the float checkpoint instead)")
+        if self._rolling:
+            raise ValueError("truncated_draft is not supported with "
+                             "rolling caches (speculative decoding "
+                             "rejects rolling models outright)")
+        nl = int(num_layers)
+        if not 1 <= nl <= self.num_layers:
+            raise ValueError(
+                "truncated_draft num_layers=%d out of range 1..%d"
+                % (nl, self.num_layers))
+        return Generator(
+            self._params, o["vocab_size"],
+            int(max_len) if max_len else o["max_len"],
+            num_layers=nl, num_heads=o["num_heads"], dim=o["dim"],
+            ffn_hidden=o["ffn_hidden"],
+            batch_size=int(batch_size) if batch_size
+            else self.batch_size,
+            dtype=o["compute_dtype"], num_experts=o["num_experts"],
+            mesh=self.mesh, pos_encoding=o["pos_encoding"],
+            attention_window=o["attention_window"],
+            num_kv_heads=o["num_kv_heads"],
+            quantize_kv=o["kv_quantize"])
+
     def generate_speculative_on_device(self, draft, prompt,
                                        max_new_tokens, lookahead=4,
-                                       return_rounds=False):
+                                       return_rounds=False,
+                                       temperature=0.0, top_k=None,
+                                       top_p=None, seed=0):
         """generate_speculative compiled into ONE device program: a
         lax.while_loop whose body runs the draft's propose scan, the
-        target's single verify forward, the lockstep acceptance rule,
-        and the emit — both models' parameters and caches live in one
-        XLA program, no host dispatches per round. Output is exactly
-        the target's greedy continuation (same rule as the host loop;
-        pinned against it in tests).
+        target's single verify forward, the acceptance rule, and the
+        emit — both models' parameters and caches live in one XLA
+        program, no host dispatches per round. Output is exactly the
+        target's own generate() continuation for the same sampling
+        args (same common-random-numbers rule as the host loop; pinned
+        against it in tests).
 
         Static-shape discipline: every round proposes the FULL
         `lookahead` and emissions are clamped to the remaining budget,
         so both caches need headroom — max_len >= P + max_new_tokens +
-        lookahead on target AND draft (validated here). Greedy only,
-        like the host speculative path."""
+        lookahead on target AND draft (validated here)."""
         if draft.vocab_size != self.vocab_size or \
                 draft.batch_size != self.batch_size:
             raise ValueError("draft must share vocab_size/batch_size "
@@ -698,6 +780,7 @@ class Generator:
         if self._rolling or getattr(draft, "_rolling", False):
             raise ValueError("speculative decoding is not supported "
                              "with rolling caches")
+        self._check_sampling(temperature, top_k, top_p)
         prompt, P = self._check_prompt(prompt, max_new_tokens)
         n = int(max_new_tokens)
         if n == 0:
@@ -713,15 +796,19 @@ class Generator:
                     "lookahead (%d) headroom (fixed-shape rounds may "
                     "overrun the budget by up to lookahead)"
                     % (which, who.max_len, P, n, g))
-        key_ = ("spec", P, n, g, id(draft))
+        temp = float(temperature or 0.0)
+        tk = int(top_k) if top_k else 0
+        tp = float(top_p) if top_p else 0.0
+        key_ = ("spec", P, n, g, temp, tk, tp, id(draft))
         cached = self._loop_cache.get(key_)
         if cached is None:
-            fn = self._spec_loop(draft, P, n, g)
+            fn = self._spec_loop(draft, P, n, g, temp, tk, tp)
             self._loop_cache[key_] = (fn, draft)   # pin draft alive
         else:
             fn = cached[0]
         out, rounds = fn(self._params, draft._params,
-                         jnp.asarray(prompt, jnp.float32))
+                         jnp.asarray(prompt, jnp.float32),
+                         jax.random.PRNGKey(int(seed or 0)))
         toks = np.asarray(out[:, :P + n], np.int64)
         if return_rounds:
             # rounds -> acceptance: each round emits acc+1 tokens, so
@@ -729,10 +816,13 @@ class Generator:
             return toks, int(rounds)
         return toks
 
-    def _spec_loop(self, draft, P, n, g):
+    def _spec_loop(self, draft, P, n, g, temp=0.0, tk=0, tp=0.0):
         B = self.batch_size
         t_eval, d_eval = self._eval_fn, draft._eval_fn
         rng0 = jax.random.PRNGKey(0)
+        sampled = temp > 0
+        top_k = tk or None
+        top_p = tp or None
 
         def fwd(eval_fn, params, aux, tokens, pos, tn):
             """tokens (B, tn) int32, pos scalar int32."""
@@ -745,7 +835,7 @@ class Generator:
             return outs[0], aux
 
         # both models' params as jit arguments (see _device_loop)
-        def run(t_params, d_params, prompt):
+        def run(t_params, d_params, prompt, key):
             t_aux = self._fresh_aux()
             d_aux = draft._fresh_aux()
             prompt_i = prompt.astype(jnp.int32)
@@ -764,11 +854,24 @@ class Generator:
                 return carry[3] < n
 
             def body(carry):
-                t_aux, d_aux, buf, emitted, rounds = carry
+                t_aux, d_aux, buf, emitted, rounds, key = carry
                 pos = P + emitted
                 last = jnp.take_along_axis(
                     buf, (pos - 1)[None].repeat(B)[:, None],
                     axis=1)[:, 0]                       # (B,)
+
+                # peek the round's g+1 subs without committing: sub_j
+                # is the split generate() would use for emission index
+                # emitted+j, and keys_after[t] is the key after t
+                # emissions — the carry key only advances by `take`
+                if sampled:
+                    ks, subs, k = [key], [], key
+                    for _ in range(g + 1):
+                        k, s = jax.random.split(k)
+                        ks.append(k)
+                        subs.append(s)
+                    subs = jnp.stack(subs)          # (g+1, 2)
+                    keys_after = jnp.stack(ks)      # (g+2, 2)
 
                 # draft proposes g tokens (ingesting each as it goes;
                 # round 1's first step also ingests the prompt's last
@@ -777,8 +880,16 @@ class Generator:
                     d_aux, cur = dc
                     dl, d_aux = fwd(d_eval, d_params, d_aux,
                                     cur[:, None], pos - 1 + i, 1)
-                    nxt = jnp.argmax(dl[:, -1], axis=-1).astype(
-                        jnp.int32)
+                    if sampled:
+                        # common random numbers: the SAME sub the
+                        # target will verify emission emitted+i with
+                        nxt = _pick_token(
+                            dl[:, -1], temp, top_k,
+                            jnp.take(subs, i, axis=0),
+                            top_p).astype(jnp.int32)
+                    else:
+                        nxt = jnp.argmax(dl[:, -1], axis=-1).astype(
+                            jnp.int32)
                     return (d_aux, nxt), nxt
 
                 (d_aux, _), props = jax.lax.scan(
@@ -790,35 +901,38 @@ class Generator:
                                         axis=1)              # (B, g+1)
                 tl, t_aux = fwd(t_eval, t_params, t_aux, chunk,
                                 pos - 1, g + 1)
-                greedy = jnp.argmax(tl, axis=-1).astype(
-                    jnp.int32)                               # (B, g+1)
+                if sampled:
+                    picks = jnp.stack(
+                        [_pick_token(tl[:, c], temp, top_k, subs[c],
+                                     top_p)
+                         for c in range(g + 1)],
+                        axis=1).astype(jnp.int32)            # (B, g+1)
+                else:
+                    picks = jnp.argmax(tl, axis=-1).astype(
+                        jnp.int32)                           # (B, g+1)
 
                 # lockstep acceptance: leading i with batch-unanimous
-                # draft/target agreement
-                match = (props_t == greedy[:, :g]).all(axis=0)  # (g,)
+                # draft/target agreement (under shared noise when
+                # sampling, so agreement == the target's own pick)
+                match = (props_t == picks[:, :g]).all(axis=0)   # (g,)
                 acc = jnp.cumprod(match.astype(jnp.int32)).sum()
-                # emit accepted proposals + the target's next token
-                idx = jnp.arange(g + 1)
-                bonus = jnp.take_along_axis(
-                    greedy, acc[None].repeat(B)[:, None], axis=1)
-                emit = jnp.where(idx[None, :] < acc,
-                                 jnp.concatenate(
-                                     [props_t, props_t[:, -1:]],
-                                     axis=1),
-                                 bonus)                      # (B, g+1)
                 take = jnp.minimum(acc + 1, n - emitted)
-                # write the g+1 block at pos; columns past `take` hold
-                # junk but land in the headroom region or are
-                # overwritten by the next round (which starts at
-                # pos + take)
+                # emit the picks directly: columns < acc equal the
+                # accepted proposals, column acc is the target's own
+                # next token, columns past `take` hold junk but land
+                # in the headroom region or are overwritten by the
+                # next round (which starts at pos + take)
                 buf = jax.lax.dynamic_update_slice(
-                    buf, emit, (0, pos))
+                    buf, picks, (0, pos))
+                if sampled:
+                    # advance one split per EMITTED token
+                    key = jnp.take(keys_after, take, axis=0)
                 return (t_aux, d_aux, buf, emitted + take,
-                        rounds + 1)
+                        rounds + 1, key)
 
-            _, _, buf, _, rounds = jax.lax.while_loop(
+            _, _, buf, _, rounds, _ = jax.lax.while_loop(
                 cond, body, (t_aux, d_aux, buf, emitted,
-                             jnp.int32(0)))
+                             jnp.int32(0), key))
             return buf, rounds
 
         return jax.jit(run)

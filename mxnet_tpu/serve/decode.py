@@ -50,6 +50,27 @@ SIGTERM via ``install_sigterm=True``) exports every active slot at
 once so a migrating recycle is bounded by export+import cost instead
 of longest-sequence drain (docs/robustness.md, fleet failure
 semantics).
+
+Speculative decoding (docs/serving.md §speculative): an optional
+draft Generator (``draft=`` or ``MXNET_SPEC_DRAFT``) gives the pool a
+second, smaller model sharing the slot shape. When any live slot
+opted in (``submit(speculative=True)``), a loop iteration becomes a
+ROUND: γ compiled (B, 1) draft steps propose tokens per slot, then
+ONE (B, γ+1) target verify forward scores them all — with PER-ROW
+acceptance (each slot keeps its own longest-matching prefix, unlike
+the eager path's lockstep rule) and per-row position bookkeeping, so
+rejected speculative cache entries are simply overwritten in place
+and never attended. Verification is common-random-numbers exact: the
+emission at index j is always ``_pick_token(target_logits_j, sub_j)``
+with ``sub_j`` the request stream's (j+1)-th split, and the draft
+proposes with the SAME sub — so output is byte-identical to plain
+``generate``/non-speculative serving for the same (seed, prompt,
+sampling args), which keeps failover replay and the dedup contract
+token-exact. ``speculative`` is therefore a pure performance hint: a
+draft-less replica admits the same request down the ordinary (B, 1)
+path with identical output. Every emission funnels through
+:meth:`_emit` one token at a time, so TTFT/inter-token metrics,
+streamed frames and mid-stream failover cursors work unchanged.
 """
 from __future__ import annotations
 
@@ -73,7 +94,7 @@ from .engine import (EngineClosed, Overloaded, RequestTimeout,
                      SessionEvacuated)
 
 __all__ = ["ContinuousDecoder", "DecodeFuture", "drain_timeout",
-           "prefill_chunk"]
+           "prefill_chunk", "spec_draft"]
 
 # replay dedup (PR 1's (cid, seq) pattern on the serving side): how
 # many admit ids a decode replica remembers. Sized far past any
@@ -114,6 +135,50 @@ def prefill_chunk():
     return c
 
 
+def spec_draft():
+    """``MXNET_SPEC_DRAFT``, loudly validated: the serving fleet's
+    zero-config speculative draft. ``'layers=<d>[,gamma=<g>]'`` makes
+    every :class:`ContinuousDecoder` built WITHOUT an explicit
+    ``draft=`` attach ``generator.truncated_draft(num_layers=<d>)``
+    and verify ``<g>`` proposals per round (default 4) — subprocess
+    replicas (the chaos harness's children, spawned fleets) opt in
+    through the environment with zero code changes. Empty = no draft.
+    Returns ``(layers, gamma)`` or ``None``."""
+    raw = str(_config.get("MXNET_SPEC_DRAFT") or "").strip()
+    if not raw:
+        return None
+    layers, gamma = None, 4
+    for part in raw.split(","):
+        if "=" not in part:
+            raise ValueError(
+                "MXNET_SPEC_DRAFT=%r: wants 'layers=<d>[,gamma=<g>]' "
+                "(got the fieldless part %r)" % (raw, part))
+        k, v = (s.strip() for s in part.split("=", 1))
+        try:
+            val = int(v)
+        except ValueError:
+            raise ValueError(
+                "MXNET_SPEC_DRAFT=%r: %s wants an integer, got %r"
+                % (raw, k, v)) from None
+        if k == "layers":
+            layers = val
+        elif k == "gamma":
+            gamma = val
+        else:
+            raise ValueError(
+                "MXNET_SPEC_DRAFT=%r: unknown field %r (supported: "
+                "layers, gamma)" % (raw, k))
+    if layers is None or layers < 1:
+        raise ValueError(
+            "MXNET_SPEC_DRAFT=%r: wants layers >= 1 (the draft must "
+            "run at least one block)" % (raw,))
+    if gamma < 1:
+        raise ValueError(
+            "MXNET_SPEC_DRAFT=%r: wants gamma >= 1 (a round must "
+            "propose at least one token)" % (raw,))
+    return layers, gamma
+
+
 class DecodeFuture:
     """One sequence's pending result: the full token row
     (prompt + generated, eos included when hit) or a typed error.
@@ -126,10 +191,11 @@ class DecodeFuture:
     __slots__ = ("prompt", "max_new", "eos_id", "temperature", "top_k",
                  "top_p", "seed", "_key", "t_enq", "t_admit", "t_last",
                  "tc", "emitted", "pending", "n_cached", "handoff",
-                 "resume", "_ev", "_value", "_exc", "_slock", "_sinks")
+                 "resume", "speculative", "_ev", "_value", "_exc",
+                 "_slock", "_sinks")
 
     def __init__(self, prompt, max_new, eos_id, temperature, top_k,
-                 top_p, seed, handoff=None):
+                 top_p, seed, handoff=None, speculative=False):
         self.prompt = prompt               # (P,) int64
         self.max_new = max_new
         self.eos_id = eos_id
@@ -145,6 +211,7 @@ class DecodeFuture:
             if self.temperature > 0 else None
         self.handoff = handoff             # remote-prefill admit state
         self.resume = None                 # migrated-session admit state
+        self.speculative = bool(speculative)   # performance HINT only
         if handoff is not None and self._key is not None:
             # the remote prefill consumed the stream's FIRST split for
             # the first token it ships — advance past it so local
@@ -171,6 +238,19 @@ class DecodeFuture:
                 row_logits[None], self.temperature, self.top_k, sub,
                 self.top_p))[0])
         return int(np.argmax(np.asarray(row_logits)))
+
+    def _peek_subs(self, k):
+        """The next ``k`` sampling subs WITHOUT advancing the stream —
+        the speculative draft proposes with the SAME noise the verify
+        pick will use (common random numbers), and the stream itself
+        only advances per EMITTED token (via :meth:`_pick`), so the
+        key discipline stays exactly ``generate``'s whatever mix of
+        proposals gets accepted."""
+        key, subs = self._key, []
+        for _ in range(k):
+            key, sub = jax.random.split(key)
+            subs.append(sub)
+        return subs
 
     def subscribe(self, sink):
         """Register a token sink: it is first fed every
@@ -259,7 +339,7 @@ class ContinuousDecoder:
     role = "decode"                       # the hello frame's identity
 
     def __init__(self, generator, queue_cap=64, logger=None,
-                 install_sigterm=False):
+                 install_sigterm=False, draft=None, lookahead=None):
         if getattr(generator, "_rolling", False):
             raise ValueError(
                 "continuous batching does not support rolling caches "
@@ -291,6 +371,66 @@ class ContinuousDecoder:
 
         self._aux = generator._fresh_aux()     # the pool caches
         self._import_jit = {}                  # pos -> fused scatter
+
+        # -- speculative decoding (docs/serving.md §speculative) --
+        # draft=None consults MXNET_SPEC_DRAFT so subprocess replicas
+        # opt whole fleets in through the environment; an explicit
+        # draft= (any Generator sharing vocab + slot-pool width) wins
+        if draft is None:
+            cfg = spec_draft()
+            if cfg is not None:
+                layers, env_gamma = cfg
+                draft = generator.truncated_draft(num_layers=layers)
+                if lookahead is None:
+                    lookahead = env_gamma
+        self._draft = draft
+        self._gamma = max(1, int(lookahead)) if lookahead else 4
+        if draft is not None:
+            if draft.vocab_size != generator.vocab_size or \
+                    draft.batch_size != generator.batch_size:
+                raise ValueError(
+                    "speculative draft must share vocab_size/"
+                    "batch_size with the target (draft %d/%d vs "
+                    "target %d/%d) — the draft decodes the same slot "
+                    "pool" % (draft.vocab_size, draft.batch_size,
+                              generator.vocab_size,
+                              generator.batch_size))
+            if getattr(draft, "_rolling", False):
+                raise ValueError(
+                    "speculative draft must not use a rolling cache "
+                    "(rejected entries could alias older positions)")
+            # the draft's own per-row-position twin: γ (B, 1) propose
+            # steps per round, ONE compiled program across slot
+            # turnover — same discipline as the target step
+            d_opts = dict(draft._decode_opts, per_row_pos=True)
+            d_sym = transformer.get_decode_symbol(**d_opts)
+            if d_sym.list_arguments() != draft._sym.list_arguments():
+                raise ValueError(
+                    "per-row draft symbol drifted from the scalar "
+                    "twin: %r vs %r" % (d_sym.list_arguments(),
+                                        draft._sym.list_arguments()))
+            d_eval = _graph_eval_fn(d_sym, mesh=draft.mesh)
+            self._draft_step_fn = jax.jit(
+                lambda args, aux, rng: d_eval(args, aux, rng, False))
+            self._daux = draft._fresh_aux()    # the draft's pool caches
+            # verify rounds write up to γ speculative entries past a
+            # row's live depth (on BOTH pools: the target's verify
+            # chunk and the draft's propose steps), so every admission
+            # needs γ headroom while a draft is attached — enforced
+            # pool-wide in submit() because non-speculative rows ride
+            # the same verify forward with junk tails
+            self._spec_cap = min(int(generator.max_len),
+                                 int(draft.max_len)) - self._gamma
+            if self._spec_cap < 2:
+                raise ValueError(
+                    "lookahead %d leaves no speculative headroom at "
+                    "min(target max_len=%d, draft max_len=%d) — grow "
+                    "max_len or shrink lookahead"
+                    % (self._gamma, generator.max_len, draft.max_len))
+        else:
+            self._draft_step_fn = None
+            self._daux = None
+            self._spec_cap = None
         self._slots = [None] * self._B         # DecodeFuture per slot
         self._reserved = set()                 # slots held mid-chunk
         self._chunking = None                  # in-progress chunked prefill
@@ -328,8 +468,10 @@ class ContinuousDecoder:
         self._g_kv = _telemetry.gauge("serve.decode.kv_bytes_per_slot")
         self._g_kv.set(self._kv_bytes_per_slot)
         # one compiled (B, 1) executable across slot turnover is THE
-        # property continuous batching exists for; the gauge feeds the
-        # decode/decode_q8 perf-gate fingerprints
+        # property continuous batching exists for; with a speculative
+        # draft the target owns exactly TWO programs — the (B, 1) step
+        # plus the (B, γ+1) verify — and never more. The gauge feeds
+        # the decode/decode_q8/spec_decode perf-gate fingerprints
         self._g_jit = _telemetry.gauge("serve.decode.jit_cache_size")
         self._h_slotfill = _telemetry.histogram(
             "serve.decode.slot_fill", buckets=_telemetry.COUNT_BUCKETS)
@@ -353,6 +495,40 @@ class ContinuousDecoder:
             "serve.decode.streams_active")
         self._c_chunks = _telemetry.counter(
             "serve.decode.prefill_chunks")
+
+        # speculative accounting: instance ints always (stats() deltas
+        # for benches), but the serve.spec.* telemetry series register
+        # ONLY when a draft is attached — a draft-less pool must leave
+        # the global snapshot exactly as before (perf-gate baselines
+        # fingerprint every counter in it)
+        self._spec_rounds = 0
+        self._draft_steps = 0
+        self._verify_steps = 0
+        self._spec_proposed = 0
+        self._spec_accepted = 0
+        self._draft_prefills = 0
+        if self._draft is not None:
+            self._c_srounds = _telemetry.counter("serve.spec.rounds")
+            self._c_dsteps = _telemetry.counter(
+                "serve.spec.draft_steps")
+            self._c_vsteps = _telemetry.counter(
+                "serve.spec.verify_steps")
+            self._c_proposed = _telemetry.counter(
+                "serve.spec.proposed")
+            self._c_accepted = _telemetry.counter(
+                "serve.spec.accepted")
+            self._c_dprefills = _telemetry.counter(
+                "serve.spec.draft_prefills")
+            # per-round per-row accepted/γ in [0, 1]; eighths resolve
+            # the useful range at any lookahead <= 8
+            self._h_accept = _telemetry.histogram(
+                "serve.spec.accept_rate",
+                buckets=tuple((i + 1) / 8 for i in range(8)))
+            # one compiled (B, 1) draft propose program across slot
+            # turnover — the draft half of the jit-cache discipline
+            # (the target's gauge covers its step + verify pair)
+            self._g_djit = _telemetry.gauge(
+                "serve.spec.draft_jit_cache_size")
 
         self._shutdown = None
         if install_sigterm:
@@ -553,11 +729,15 @@ class ContinuousDecoder:
                 "seed": req.seed,
                 "emitted": [int(t) for t in req.emitted],
                 "pending": int(req.pending),
+                # a HINT for the survivor, not identity: resume works
+                # (byte-identically) whether or not it carries a draft
+                "speculative": bool(req.speculative),
                 "kv_blob": blob}
 
     def submit(self, prompt, max_new_tokens, eos_id=None,
                temperature=0.0, top_k=None, top_p=None, seed=0,
-               handoff=None, admit_id=None, resume=None):
+               handoff=None, admit_id=None, resume=None,
+               speculative=False):
         """Queue one sequence; returns a :class:`DecodeFuture` whose
         result is the full (prompt + generated) id row, exactly as
         ``Generator.generate`` would emit it for this prompt alone.
@@ -583,7 +763,15 @@ class ContinuousDecoder:
         scatter at ``pos = prompt + fed`` with zero prefill graph
         calls. The PRNG stream re-derives its key by advancing
         ``len(emitted)`` splits (``generation.replay_key``), so the
-        remaining tokens are bit-identical to an unmigrated run."""
+        remaining tokens are bit-identical to an unmigrated run.
+
+        ``speculative``: opt this request into draft/verify rounds
+        when the pool carries a draft — a pure performance HINT, not
+        part of the request's identity: output is byte-identical
+        either way (common-random-numbers verification), so a
+        draft-less replica — e.g. the failover survivor of a
+        speculative session — admits the same request down the
+        ordinary (B, 1) path, and a resume need not restate it."""
         self._gen._check_sampling(temperature, top_k, top_p)
         prefill_chunk()   # loud knob validation on the CALLER's
         #                   thread — the decode loop must never die
@@ -668,6 +856,19 @@ class ContinuousDecoder:
             raise ValueError(
                 "prompt (%d) + max_new_tokens (%d) exceeds the cache "
                 "capacity max_len=%d" % (P, n, self._gen.max_len))
+        if self._draft is not None and P + n > self._spec_cap:
+            # pool-wide, not per-request: verify rounds write up to
+            # lookahead speculative entries past EVERY live row's
+            # depth (non-speculative rows ride the verify forward with
+            # junk tails), so the headroom must hold for any row that
+            # could share a round
+            raise ValueError(
+                "prompt (%d) + max_new_tokens (%d) exceeds the "
+                "speculative headroom %d = min(target max_len=%d, "
+                "draft max_len=%d) - lookahead %d; while a draft is "
+                "attached every admission needs the headroom"
+                % (P, n, self._spec_cap, self._gen.max_len,
+                   self._draft.max_len, self._gamma))
         if self._gen._pos_rows is not None and \
                 P + n > self._gen._pos_rows:
             raise ValueError(
@@ -675,7 +876,8 @@ class ContinuousDecoder:
                 "trained position table (%d rows)"
                 % (P, n, self._gen._pos_rows))
         req = DecodeFuture(prompt, n, eos_id, temperature, top_k,
-                           top_p, seed, handoff=handoff)
+                           top_p, seed, handoff=handoff,
+                           speculative=speculative)
         if resume is not None:
             # PRNG progress is DERIVED state: one split per drawn
             # token, whatever path drew it (local pick or remote
@@ -734,7 +936,8 @@ class ContinuousDecoder:
             seed=payload.get("seed") or 0,
             handoff=payload.get("handoff"),
             admit_id=payload.get("admit_id"),
-            resume=payload.get("resume"))
+            resume=payload.get("resume"),
+            speculative=bool(payload.get("speculative")))
         try:
             return fut.result(payload.get("timeout"))
         except SessionEvacuated as exc:
@@ -764,7 +967,8 @@ class ContinuousDecoder:
             seed=payload.get("seed") or 0,
             handoff=payload.get("handoff"),
             admit_id=payload.get("admit_id"),
-            resume=payload.get("resume"))
+            resume=payload.get("resume"),
+            speculative=bool(payload.get("speculative")))
         q = _qmod.Queue()
         sink = q.put
         timeout = payload.get("timeout")
@@ -825,16 +1029,45 @@ class ContinuousDecoder:
         return [i for i, s in enumerate(self._slots)
                 if s is None and i not in self._reserved]
 
+    def _draft_prefill_rows(self, slot, tokens):
+        """Prefill the DRAFT cache for one admitted row from raw token
+        ids — the local draft leg of handoff/resume admission (the
+        wire blobs carry TARGET rows only; prefill replicas stay
+        draft-agnostic). Rides the draft Generator's shared-position
+        prefill graph, chunked by ``MXNET_PREFILL_CHUNK`` when set so
+        arbitrary handoff lengths reuse the chunk-width programs
+        instead of compiling one prefill shape per length."""
+        toks = np.asarray(tokens, np.int64).reshape(-1)
+        n = len(toks)
+        aux = self._draft._fresh_aux()
+        width = prefill_chunk() or n
+        lo = 0
+        while lo < n:
+            hi = min(lo + width, n)
+            rows = np.stack([toks[lo:hi]] * self._B)
+            _, aux = self._draft._forward(
+                aux, rows.astype(np.float32), lo)
+            lo = hi
+        idx = jnp.asarray(np.array([slot], np.int32))
+        self._daux = {
+            name: self._daux[name].at[idx].set(aux[name][:1])
+            for name in self._daux}
+        self._draft_prefills += 1
+        self._c_dprefills.inc()
+
     def _admit_handoff(self, slot, req):
         """Admit one remote-prefilled sequence: scatter its shipped
-        cache rows into the slot (zero prefill graph calls — the
-        ``prefills`` stat must not move) and emit the shipped first
-        token. A bad blob fails THAT request's future and frees the
-        slot; the loop and the other slots are untouched."""
+        cache rows into the slot (zero TARGET prefill graph calls —
+        the ``prefills`` stat must not move; a speculative request
+        does prefill its DRAFT cache locally) and emit the shipped
+        first token. A bad blob fails THAT request's future and frees
+        the slot; the loop and the other slots are untouched."""
         t0 = _telemetry.now_ms()
         try:
             pos = self.import_kv_rows(slot, req.handoff["kv_blob"])
             tok = int(req.handoff["first_token"])
+            if self._draft is not None and req.speculative:
+                self._draft_prefill_rows(slot, req.prompt)
         except Exception as exc:          # noqa: BLE001 — the future
             # is this sequence's one response; a scatter failure must
             # not kill the decode loop for every other slot
@@ -862,6 +1095,14 @@ class ContinuousDecoder:
         t0 = _telemetry.now_ms()
         try:
             pos = self.import_kv_rows(slot, req.resume)
+            if self._draft is not None and req.speculative:
+                # the cache covers prompt + fed tokens (the pending
+                # last emission is not yet fed) — prefill the draft
+                # over exactly that prefix
+                self._draft_prefill_rows(
+                    slot, np.concatenate(
+                        [np.asarray(req.prompt, np.int64),
+                         np.asarray(req.emitted[:-1], np.int64)]))
         except Exception as exc:          # noqa: BLE001 — the future
             # is this sequence's one response; an import failure must
             # not kill the decode loop for every other slot
@@ -919,6 +1160,11 @@ class ContinuousDecoder:
                                       "aux": self._gen._fresh_aux(),
                                       "pos": 0,
                                       "t0": _telemetry.now_ms()}
+                    if self._draft is not None and req.speculative:
+                        # the draft cache prefills alongside, chunk
+                        # by chunk on the same widths
+                        self._chunking["daux"] = \
+                            self._draft._fresh_aux()
                 else:
                     waiting.append(req)
                 continue
@@ -939,6 +1185,22 @@ class ContinuousDecoder:
                 name: self._aux[name].at[idx].set(
                     pref_aux[name][:len(reqs)])
                 for name in self._aux}
+            if self._draft is not None and \
+                    any(r.speculative for r in reqs):
+                # the draft's cache rows for this group, one shared-
+                # position prefill on the draft's OWN graph (its
+                # per-row propose program never sees prefill shapes) —
+                # scattered for the whole group: non-speculative rows'
+                # draft rows are unread garbage either way
+                _, d_pref = self._draft._forward(
+                    self._draft._fresh_aux(),
+                    rows.astype(np.float32), 0)
+                self._daux = {
+                    name: self._daux[name].at[idx].set(
+                        d_pref[name][:len(reqs)])
+                    for name in self._daux}
+                self._draft_prefills += 1
+                self._c_dprefills.inc()
             for i, req in enumerate(reqs):
                 slot = free.pop(0)
                 self._slots[slot] = req
@@ -1038,6 +1300,182 @@ class ContinuousDecoder:
             self._emit(req, tok)
             self._maybe_finish(i, tok)
 
+    def _draft_forward(self, toks, pos):
+        """One (B, 1) per-row-position DRAFT step: the propose half of
+        a speculative round. Returns the (B, V) last-position logits
+        as float32 numpy."""
+        args = dict(self._draft._params)
+        args["data"] = jnp.asarray(toks)
+        args["positions"] = jnp.asarray(pos[:, None])
+        args["cache_pos"] = jnp.asarray(pos)
+        outs, self._daux = self._draft_step_fn(args, self._daux,
+                                               self._rng0)
+        self._draft_steps += 1
+        self._c_dsteps.inc()
+        cache_size = getattr(self._draft_step_fn, "_cache_size", None)
+        if cache_size is not None:
+            # stays 1 across slot turnover and round count — the
+            # draft's half of the compiled-shape discipline
+            self._g_djit.set(cache_size())
+        return np.asarray(outs[0][:, -1].astype(jnp.float32))
+
+    def _spec_round(self):
+        """One speculative draft/verify round: γ compiled (B, 1) draft
+        steps propose per-slot continuations, ONE (B, γ+1) target
+        forward verifies them, and each row keeps its own longest-
+        matching prefix plus the target's next token — per-row
+        acceptance, lifting the eager path's lockstep rule.
+
+        Exactness: the emission at index j is ALWAYS the target's own
+        ``_pick`` on its logits for that index, with the request
+        stream's (j+1)-th split; the draft proposed with the SAME sub
+        (``_peek_subs`` — common random numbers), so "proposal
+        accepted" literally means "equals what generate() would have
+        picked". Byte-identity to the non-speculative path follows for
+        greedy AND sampled requests, up to the verify forward's
+        Tnew=γ+1 kernel-numerics caveat (generation.py,
+        generate_speculative docstring).
+
+        Cache discipline, per row: the verify forward writes γ+1
+        entries at positions n_cached..n_cached+γ; the walk advances
+        n_cached once per EMITTED token, so rejected entries sit past
+        the row's depth where (a) the per-row mask keeps any
+        correctly-conditioned query from attending them and (b) the
+        next round's writes overwrite them before the row's depth
+        reaches them. Same argument on the draft pool, which is why
+        every admission pays γ headroom (``submit``'s _spec_cap
+        check). Non-speculative rows ride the verify forward with
+        junk tails and take only their column-0 pick — identical math
+        to :meth:`_step`."""
+        t0 = _telemetry.now_ms()
+        active = [i for i, s in enumerate(self._slots)
+                  if s is not None]
+        spec = [i for i in active if self._slots[i].speculative]
+        g = self._gamma
+        toks = np.zeros((self._B, 1), np.float32)
+        pos0 = np.zeros((self._B,), np.float32)
+        for i in active:
+            toks[i, 0] = float(self._slots[i].pending)
+            pos0[i] = float(self._slots[i].n_cached)
+        # peek each sampled row's subs WITHOUT advancing its stream —
+        # the verify walk's _pick() calls advance it, once per
+        # emitted token, exactly like every other emission path
+        subs = {i: self._slots[i]._peek_subs(g) for i in spec
+                if self._slots[i].temperature > 0}
+
+        # -- propose: γ (B, 1) draft steps -----------------------------
+        props = np.zeros((self._B, g), np.int64)
+        cur = np.zeros((self._B, 1), np.float32)
+        dpos = np.zeros((self._B,), np.float32)
+        for t in range(g):
+            for i in spec:
+                cur[i, 0] = toks[i, 0] if t == 0 else \
+                    float(props[i, t - 1])
+                dpos[i] = pos0[i] + t
+            # non-speculative and empty rows feed token 0 at draft
+            # position 0: their draft cache rows are garbage by
+            # definition, and position 0 is always in capacity
+            dl = self._draft_forward(cur, dpos)
+            for i in spec:
+                req = self._slots[i]
+                if req.temperature > 0:
+                    props[i, t] = int(np.asarray(_pick_token(
+                        dl[i][None], req.temperature, req.top_k,
+                        subs[i][t], req.top_p))[0])
+                else:
+                    props[i, t] = int(np.argmax(dl[i]))
+
+        # -- verify: ONE (B, γ+1) target forward -----------------------
+        chunk = np.zeros((self._B, g + 1), np.float32)
+        for i in active:
+            chunk[i, 0] = toks[i, 0]
+            for t in range(g):
+                # non-speculative rows repeat their pending token as a
+                # junk tail; only their column-0 logits are read
+                chunk[i, t + 1] = float(props[i, t]) if i in spec \
+                    else toks[i, 0]
+        args = dict(self._gen._params)
+        args["data"] = jnp.asarray(chunk)
+        args["positions"] = jnp.asarray(
+            pos0[:, None] + np.arange(g + 1, dtype=np.float32)[None])
+        args["cache_pos"] = jnp.asarray(pos0)
+        outs, self._aux = self._step_fn(args, self._aux, self._rng0)
+        logits = np.asarray(outs[0].astype(jnp.float32))  # (B,g+1,V)
+        self._steps += 1
+        self._c_steps.inc()
+        self._verify_steps += 1
+        self._c_vsteps.inc()
+        self._spec_rounds += 1
+        self._c_srounds.inc()
+        self._h_slotfill.observe(len(active))
+        self._g_active.set(len(active))
+        cache_size = getattr(self._step_fn, "_cache_size", None)
+        if cache_size is not None:
+            # exactly TWO target programs — (B, 1) step + (B, γ+1)
+            # verify — across admissions and rounds (gate-pinned)
+            self._g_jit.set(cache_size())
+        self._g_kv.set(self._kv_bytes_per_slot)   # live pool wins
+
+        # -- per-row acceptance walk -----------------------------------
+        accepted = proposed = 0
+        full = []          # rows needing the draft catch-up feed
+        for i in active:
+            req = self._slots[i]
+            if i not in spec:
+                # the _step() math, read off the verify forward
+                req.n_cached += 1
+                tok = req._pick(logits[i, 0])
+                self._emit(req, tok)
+                self._maybe_finish(i, tok)
+                continue
+            proposed += g
+            acc = 0
+            for j in range(g + 1):
+                req.n_cached += 1
+                tok = req._pick(logits[i, j])
+                self._emit(req, tok)
+                matched = j < g and int(props[i, j]) == tok
+                if matched:
+                    acc += 1
+                self._maybe_finish(i, tok)
+                if self._slots[i] is None or not matched:
+                    break
+            accepted += acc
+            self._h_accept.observe(acc / g)
+            if acc == g and self._slots[i] is not None:
+                full.append(i)
+        self._spec_proposed += proposed
+        self._spec_accepted += accepted
+        self._c_proposed.inc(proposed)
+        self._c_accepted.inc(accepted)
+
+        if full:
+            # full acceptance: the draft never ingested its last
+            # proposal's k/v (its loop stops after computing it) —
+            # one conditional catch-up step fills the hole at the
+            # row's old pos0+γ. Rows that were speculative this round
+            # but are not catching up write JUNK at their own pos0+γ
+            # (inside the γ headroom, past their valid prefix, and
+            # overwritten by a later feed before any correctly-
+            # conditioned query can attend it — NEVER at position 0,
+            # which holds their live prompt k/v); non-speculative and
+            # empty rows write at their garbage rows' position 0
+            for i in range(self._B):
+                if i in full:
+                    cur[i, 0] = float(props[i, g - 1])
+                    dpos[i] = pos0[i] + g
+                elif i in spec:
+                    cur[i, 0] = 0.0
+                    dpos[i] = pos0[i] + g
+                else:
+                    cur[i, 0] = 0.0
+                    dpos[i] = 0.0
+            self._draft_forward(cur, dpos)
+        if _trace.enabled():
+            _trace.add_span("serve.spec.round", t0,
+                            _telemetry.now_ms(), rows=len(spec),
+                            proposed=proposed, accepted=accepted)
+
     def _chunk_step(self):
         """Feed ONE chunk of the in-progress chunked prefill — called
         once per loop iteration between admission and the (B, 1) step,
@@ -1060,6 +1498,9 @@ class ContinuousDecoder:
         try:
             logits, ch["aux"] = self._gen._forward(
                 ch["aux"], rows.astype(np.float32), lo)
+            if "daux" in ch:
+                _, ch["daux"] = self._draft._forward(
+                    ch["daux"], rows.astype(np.float32), lo)
         except Exception as exc:          # noqa: BLE001 — the future
             # is this sequence's one response; a failed chunk must not
             # kill the decode loop for every other slot
@@ -1084,6 +1525,13 @@ class ContinuousDecoder:
         self._aux = {
             name: self._aux[name].at[idx].set(ch["aux"][name][:1])
             for name in self._aux}
+        if "daux" in ch:
+            self._daux = {
+                name: self._daux[name].at[idx].set(
+                    ch["daux"][name][:1])
+                for name in self._daux}
+            self._draft_prefills += 1
+            self._c_dprefills.inc()
         self._prefills += 1
         last = np.asarray(logits[:1, -1].astype(jnp.float32))
         self._chunking = None
@@ -1115,7 +1563,16 @@ class ContinuousDecoder:
                 continue
             self._admit()
             self._chunk_step()
-            self._step()
+            if self._draft is not None and any(
+                    s is not None and s.speculative
+                    for s in self._slots):
+                self._spec_round()
+            else:
+                # draft-less pools and rounds with no speculative
+                # participant run the ordinary (B, 1) step — a
+                # mixed-traffic pool flips between the two compiled
+                # target programs, never compiles a third
+                self._step()
         self._g_active.set(0)
         _telemetry.journal_event("serve.decode.stop")
 
@@ -1245,6 +1702,11 @@ class ContinuousDecoder:
                 "evacuated": self._evacuated,
                 "deduped": self._deduped,
                 "streams": self._streams,
+                "spec_rounds": self._spec_rounds,
+                "draft_steps": self._draft_steps,
+                "spec_proposed": self._spec_proposed,
+                "spec_accepted": self._spec_accepted,
+                "draft_prefills": self._draft_prefills,
                 "active": sum(s is not None for s in self._slots),
                 "queued": len(self._queue)}
 
@@ -1262,5 +1724,6 @@ class ContinuousDecoder:
                                     - len(self._reserved))
         out["slots"] = self._B
         out["streams_in_flight"] = self._streams_inflight
+        out["speculative"] = self._draft is not None
         out["draining"] = self.draining
         return out

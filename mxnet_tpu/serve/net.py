@@ -513,7 +513,8 @@ class ServeClient:
     def generate(self, prompt, max_new_tokens, eos_id=None,
                  temperature=0.0, top_k=None, top_p=None, seed=0,
                  session=None, handoff=None, timeout=None,
-                 admit_id=None, resume=None, on_token=None):
+                 admit_id=None, resume=None, on_token=None,
+                 speculative=False):
         """The ``generate`` frame: admit one sequence on the remote
         replica (with its ``handoff`` blob when a remote prefill ran)
         and block for the full id row. Replay caveat: a transport
@@ -529,6 +530,12 @@ class ServeClient:
         ``resume``: an evacuated session's ``export_session`` state —
         readmit a migrated sequence mid-decode
         (``ContinuousDecoder.submit(resume=...)``).
+
+        ``speculative``: ask the replica to decode this request with
+        draft/verify rounds when it carries a speculative draft
+        (docs/serving.md §speculative). A pure performance hint —
+        output is byte-identical either way, and a draft-less replica
+        ignores it — so failover and replay semantics are unchanged.
 
         The wire read is bounded by ``timeout`` (plus this client's
         io timeout as slack) when one is given, and UNBOUNDED
@@ -561,6 +568,8 @@ class ServeClient:
             payload["admit_id"] = admit_id
         if resume is not None:
             payload["resume"] = resume
+        if speculative:
+            payload["speculative"] = True
         if on_token is not None:
             payload["stream"] = True
         rsp = _trace.start_span("serve.generate.request",

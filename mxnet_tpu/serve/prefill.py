@@ -147,7 +147,7 @@ class PrefillEngine:
             self._batcher.start()
 
     def prefill(self, prompt, temperature=0.0, top_k=None, top_p=None,
-                seed=0, _record=True, **_ignored):
+                seed=0, _record=True, speculative=False, **_ignored):
         """One sequence's prefill: returns the handoff dict
         ``{"first_token": int, "kv_blob": export_kv_rows blob,
         "pos": len(prompt)}`` a remote
@@ -157,7 +157,15 @@ class PrefillEngine:
         request-level telemetry/stats clean: ``serve.prefill.*`` and
         ``stats()['prefills']`` count served traffic only — and skips
         the coalescing batcher (a warmup must compile the exact
-        declared length, not a group's padded one)."""
+        declared length, not a group's padded one).
+
+        ``speculative`` is accepted and deliberately IGNORED: prefill
+        replicas are draft-agnostic. The handoff blob carries TARGET
+        cache rows only — a speculative decode admission prefills its
+        DRAFT cache locally from the prompt ids it already holds,
+        riding the chunked-prefill widths (decode.py
+        ``_draft_prefill_rows``), so drafts never change the wire
+        format, the blob bytes, or this replica's compiled shapes."""
         gen = self._gen
         gen._check_sampling(temperature, top_k, top_p)
         prompt = np.asarray(prompt, np.int64).reshape(-1)

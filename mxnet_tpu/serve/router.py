@@ -704,7 +704,7 @@ class ServeRouter:
     def generate(self, prompt, max_new_tokens, eos_id=None,
                  temperature=0.0, top_k=None, top_p=None, seed=0,
                  session=None, timeout=None, handoff=None, tc=None,
-                 on_token=None):
+                 on_token=None, speculative=False):
         """Route one sequence generation through the fleet
         (docs/serving.md §disaggregated prefill).
 
@@ -746,7 +746,15 @@ class ServeRouter:
         tail. No duplicated or missing frames, across any number of
         mid-stream replica deaths. Streamed legs drop the blanket
         whole-completion deadline for the per-frame
-        ``MXNET_STREAM_IDLE_TIMEOUT`` idle bound."""
+        ``MXNET_STREAM_IDLE_TIMEOUT`` idle bound.
+
+        ``speculative``: forwarded on every decode leg — first
+        dispatch, failover replay AND migration resume — as the pure
+        performance hint it is: a draft-carrying replica decodes the
+        request in draft/verify rounds, a draft-less one ignores it,
+        and the emitted tokens are byte-identical either way, so the
+        delivered-prefix verification and the fault-free oracle both
+        hold across mixed fleets."""
         prompt = np.asarray(prompt, np.int64).reshape(-1)
         P = int(prompt.size)
         if P < 1:
@@ -856,7 +864,8 @@ class ServeRouter:
                                   timeout=leg_timeout,
                                   admit_id=aid, resume=resume,
                                   on_token=None if on_token is None
-                                  else leg_relay())
+                                  else leg_relay(),
+                                  speculative=speculative)
             out = self._route(P, session, None, leg, want=want,
                               span="serve.router.decode",
                               recoverable=True)
@@ -914,7 +923,8 @@ class ServeRouter:
             seed=payload.get("seed") or 0,
             session=payload.get("session"),
             timeout=payload.get("timeout"),
-            handoff=payload.get("handoff"))
+            handoff=payload.get("handoff"),
+            speculative=bool(payload.get("speculative")))
 
     def handle_generate_stream(self, payload, emit):
         """The streamed ``generate`` frame through a router-fronting
@@ -939,6 +949,7 @@ class ServeRouter:
             session=payload.get("session"),
             timeout=payload.get("timeout"),
             handoff=payload.get("handoff"),
+            speculative=bool(payload.get("speculative")),
             on_token=on_token)
 
     def _dispatch(self, arrays, deadline_ms, session, tc):

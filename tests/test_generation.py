@@ -919,6 +919,66 @@ class TestGenerator:
         with pytest.raises(ValueError, match="draft max_len"):
             target.generate_speculative(small, np.zeros((B, 2)), 6)
 
+    # each on-device case compiles its own (temp, top_k, top_p)
+    # specialization of the fused loop — keep the fast tier to the
+    # two distinct verification regimes (plain temp, temp+top_k) and
+    # ride top_p on the slow tier
+    @pytest.mark.parametrize("kw", [
+        {"temperature": 0.8, "seed": 0},
+        {"temperature": 1.2, "top_k": 5, "seed": 7},
+        pytest.param({"temperature": 0.9, "top_p": 0.9, "seed": 3},
+                     marks=pytest.mark.slow),
+    ])
+    def test_speculative_sampled_equals_generate(self, kw):
+        """SAMPLED speculative decoding is byte-identical to plain
+        generate(seed) — host and compiled paths alike. The contract
+        is shared-noise verification (docs/serving.md §speculative):
+        emission j is always _pick_token(target_logits_j, sub_j) on
+        the request key's (j+1)-th split, the draft merely proposes
+        with the same noise — so speculation changes the SCHEDULE,
+        never the distribution, and a resumed/failed-over replica
+        replays the identical stream."""
+        cap = 3 + 8 + 4                        # P + n + lookahead
+        sym_t = transformer.get_symbol(V, T, num_layers=L,
+                                       num_heads=H, dim=DIM,
+                                       max_len=cap)
+        step = make_train_step(sym_t, optimizer="sgd")
+        mx.random.seed(0)
+        params = step.init_state(Xavier(), {
+            "data": (B, T), "softmax_label": (B, T)})[0]
+        target = Generator(params, V, max_len=cap, num_layers=L,
+                           num_heads=H, dim=DIM, batch_size=B)
+        draft = target.truncated_draft(num_layers=1)
+        prompt = np.array([[1, 2, 3], [4, 5, 6]])
+        want = target.generate(prompt, max_new_tokens=8, **kw)
+        host = target.generate_speculative(draft, prompt, 8,
+                                           lookahead=3, **kw)
+        dev = target.generate_speculative_on_device(draft, prompt, 8,
+                                                    lookahead=3, **kw)
+        assert (host == want).all(), (kw, host, want)
+        assert (dev == want).all(), (kw, dev, want)
+
+    def test_truncated_draft_shares_params_and_validates(self):
+        """truncated_draft: the self-drafting constructor — the
+        SHALLOW prefix of the target (same embeddings, first k
+        layers, same head) as an independent Generator over the same
+        param dict. Depth bounds and unsupported variants fail
+        loudly."""
+        _, params = _trained_params()
+        target = Generator(params, V, max_len=T, num_layers=L,
+                           num_heads=H, dim=DIM, batch_size=B)
+        draft = target.truncated_draft(num_layers=1)
+        assert draft.num_layers == 1
+        assert draft.batch_size == target.batch_size
+        prompt = np.array([[1, 2, 3], [4, 5, 6]])
+        want = target.generate(prompt, max_new_tokens=6)
+        got = target.generate_speculative(draft, prompt, 6,
+                                          lookahead=2)
+        assert (got == want).all(), (got, want)
+        for bad in (0, L + 1):
+            with pytest.raises(ValueError, match="num_layers"):
+                target.truncated_draft(num_layers=bad)
+
     def test_eos_early_stop(self):
         _, params = _trained_params()
         gen = Generator(params, V, max_len=T, num_layers=L,

@@ -8,15 +8,18 @@ exactly, sampled against a batch_size=1 generate with the same seed
 property the subsystem exists for: ragged workloads finish in fewer
 decode steps than static batching's worst sequence dictates.
 """
+import time
+
 import numpy as np
 import pytest
 
 import mxnet_tpu as mx
+from mxnet_tpu import telemetry
 from mxnet_tpu.generation import Generator
 from mxnet_tpu.initializer import Xavier
 from mxnet_tpu.models import transformer
 from mxnet_tpu.parallel import make_train_step
-from mxnet_tpu.serve import EngineClosed, Overloaded
+from mxnet_tpu.serve import EngineClosed, Overloaded, SessionEvacuated
 
 pytestmark = pytest.mark.serve
 
@@ -378,3 +381,197 @@ class TestQuantizedKV:
             assert intro["queue_depth"] == 0
             assert intro["in_flight"] == 0
             assert intro["draining"] is False
+
+
+def _spec_dec(pool, lookahead=3, draft_layers=1, **kw):
+    return pool.serving_decoder(
+        draft=pool.truncated_draft(num_layers=draft_layers),
+        lookahead=lookahead, **kw)
+
+
+class TestSpeculative:
+    """Per-slot draft/verify continuous batching (PR 18 tentpole):
+    rounds of gamma compiled (B, 1) draft steps plus ONE (B, gamma+1)
+    target verify forward, with common-random-numbers acceptance —
+    so every output stays byte-identical to plain ``generate`` and
+    ``speculative`` is a pure performance hint."""
+
+    def test_spec_mixed_pool_matches_generate_ragged(self, params):
+        """ACCEPTANCE: speculative and plain requests share the slot
+        pool mid-flight; every sequence == static generate token for
+        token, with eos and budget endings and slot turnover."""
+        pool = _gen(params, B)
+        single = _gen(params, 1)
+        rng = np.random.RandomState(43)
+        prompts = [rng.randint(0, V, (p,)) for p in
+                   (4, 6, 4, 5, 4, 6)]
+        maxnew = [8, 3, 12, 5, 4, 9]
+        spec = [True, False, True, False, True, True]
+        with _spec_dec(pool) as dec:
+            futs = [dec.submit(p, n, eos_id=0, speculative=s)
+                    for p, n, s in zip(prompts, maxnew, spec)]
+            got = [f.result(120.0) for f in futs]
+            st = dec.stats()
+        for p, n, g in zip(prompts, maxnew, got):
+            np.testing.assert_array_equal(
+                g, single.generate(p[None], n, eos_id=0)[0])
+        assert st["finished"] == len(prompts) > B    # slot turnover
+        # the draft genuinely ran: rounds happened, proposals were
+        # verified, and speculative admissions paid draft prefills
+        # (batched admissions may share one, so <= the request count)
+        assert st["spec_rounds"] > 0
+        assert st["draft_steps"] >= st["spec_rounds"]
+        assert 0 < st["spec_accepted"] <= st["spec_proposed"]
+        assert 0 < st["draft_prefills"] <= sum(spec)
+
+    def test_spec_sampled_matches_batch1_generate(self, params):
+        """Sampled speculative request reproduces a batch_size=1
+        generate with the same seed — acceptance reuses the EXACT
+        per-token noise the verify pick consumes (common random
+        numbers), so the distribution is not just equal, the draws
+        are."""
+        pool = _gen(params, B)
+        single = _gen(params, 1)
+        rng = np.random.RandomState(47)
+        prompt = rng.randint(0, V, (5,))
+        with _spec_dec(pool) as dec:
+            # crowd the pool: a plain greedy row rides every verify
+            # forward as a passenger
+            other = dec.submit(rng.randint(0, V, (4,)), 10)
+            f = dec.submit(prompt, 6, temperature=0.8, top_k=5,
+                           seed=42, speculative=True)
+            got = f.result(120.0)
+            other.result(120.0)
+        want = single.generate(prompt[None], 6, temperature=0.8,
+                               top_k=5, seed=42)[0]
+        np.testing.assert_array_equal(got, want)
+
+    def test_spec_streaming_one_token_at_a_time(self, params):
+        """A round commits up to gamma+1 tokens at once, but sinks
+        still see them ONE at a time, in order, then the None
+        terminator — the streaming contract is spec-oblivious."""
+        pool = _gen(params, B)
+        rng = np.random.RandomState(53)
+        prompt = rng.randint(0, V, (4,))
+        seen = []
+        with _spec_dec(pool) as dec:
+            fut = dec.submit(prompt, 8, speculative=True)
+            fut.subscribe(seen.append)
+            got = fut.result(120.0)
+            deadline = time.monotonic() + 10.0
+            while (not seen or seen[-1] is not None) and \
+                    time.monotonic() < deadline:
+                time.sleep(0.005)
+        assert seen[-1] is None
+        np.testing.assert_array_equal(np.asarray(seen[:-1]),
+                                      got[len(prompt):])
+
+    def test_spec_headroom_checked_at_submit(self, params):
+        """Verify rounds write up to gamma speculative cache entries
+        past EVERY live row's depth, so with a draft attached each
+        admission needs P + n <= min(max_lens) - gamma — plain
+        requests included, checked loudly at submit."""
+        pool = _gen(params, B)
+        with _spec_dec(pool, lookahead=4) as dec:   # cap = 24 - 4
+            with pytest.raises(ValueError, match="headroom"):
+                dec.submit(np.arange(1, 16), 8, speculative=True)
+            with pytest.raises(ValueError, match="headroom"):
+                dec.submit(np.arange(1, 16), 8)     # plain rows too
+            # at the cap is fine
+            dec.submit(np.arange(1, 13), 8,
+                       speculative=True).result(120.0)
+        # a lookahead that leaves no usable headroom at all is a
+        # construction-time error, not a submit-time surprise
+        with pytest.raises(ValueError, match="headroom"):
+            _spec_dec(pool, lookahead=T)
+
+    def test_spec_jit_cache_discipline(self, params):
+        """The throughput contract: the target owns exactly TWO
+        compiled programs — the (B, 1) step and the (B, gamma+1)
+        verify — and the draft exactly ONE, however ragged the
+        workload."""
+        pool = _gen(params, B)
+        rng = np.random.RandomState(59)
+        with _spec_dec(pool) as dec:
+            assert dec.introspect()["speculative"] is True
+            # plain request first, alone: pins the (B, 1) step trace
+            dec.submit(rng.randint(0, V, (4,)), 6).result(120.0)
+            for p, n in ((3, 8), (6, 4), (5, 11)):
+                dec.submit(rng.randint(0, V, (p,)), n,
+                           speculative=True).result(120.0)
+            assert telemetry.gauge(
+                "serve.decode.jit_cache_size").value == 2
+            assert telemetry.gauge(
+                "serve.spec.draft_jit_cache_size").value == 1
+
+    def test_spec_evacuate_resume_carries_hint(self, params):
+        """Mid-decode migration of a speculative session: the export
+        state records the hint, and the resumed stream on a second
+        draft-attached pool emits the remaining tokens
+        bit-identically."""
+        single = _gen(params, 1)
+        p = np.arange(1, 6)
+        want = single.generate(p[None], 8, temperature=0.8, top_k=8,
+                               seed=7)[0]
+        d1 = _spec_dec(_gen(params, 2))
+        d2 = _spec_dec(_gen(params, 2))
+        try:
+            fut = d1.submit(p, 8, temperature=0.8, top_k=8, seed=7,
+                            speculative=True)
+            deadline = time.monotonic() + 10.0
+            while len(fut.emitted) < 3 and \
+                    time.monotonic() < deadline:
+                time.sleep(0.005)
+            assert len(fut.emitted) >= 3
+            assert d1.evacuate() == 1
+            with pytest.raises(SessionEvacuated) as ei:
+                fut.result(10.0)
+            state = ei.value.state
+            assert state["speculative"] is True
+            got = d2.submit(p, 8, temperature=0.8, top_k=8, seed=7,
+                            resume=state,
+                            speculative=True).result(120.0)
+            np.testing.assert_array_equal(got, want)
+            assert d2.stats()["resumed"] == 1
+        finally:
+            d1.close()
+            d2.close()
+
+    def test_spec_env_draft_config(self, params, monkeypatch):
+        """MXNET_SPEC_DRAFT attaches a truncated draft to every
+        decoder built without an explicit ``draft=`` — the
+        zero-code-change opt-in subprocess replicas use — and gamma
+        is honored."""
+        monkeypatch.setenv("MXNET_SPEC_DRAFT", "layers=1,gamma=2")
+        pool = _gen(params, B)
+        single = _gen(params, 1)
+        rng = np.random.RandomState(61)
+        prompt = rng.randint(0, V, (5,))
+        with pool.serving_decoder() as dec:
+            assert dec._draft is not None
+            assert dec._draft.num_layers == 1
+            assert dec._gamma == 2
+            got = dec.submit(prompt, 7,
+                             speculative=True).result(120.0)
+            st = dec.stats()
+        np.testing.assert_array_equal(
+            got, single.generate(prompt[None], 7)[0])
+        assert st["spec_rounds"] > 0
+
+    def test_spec_env_parse_errors(self, monkeypatch):
+        """spec_draft() validates loudly — a typo'd fleet env var must
+        fail fast, not silently decode draft-less."""
+        from mxnet_tpu.serve.decode import spec_draft
+        for raw, msg in [("1,gamma=2", "fieldless"),
+                         ("layers=one", "integer"),
+                         ("layers=1,speed=9", "unknown field"),
+                         ("gamma=2", "layers >= 1"),
+                         ("layers=0", "layers >= 1"),
+                         ("layers=1,gamma=0", "gamma >= 1")]:
+            monkeypatch.setenv("MXNET_SPEC_DRAFT", raw)
+            with pytest.raises(ValueError, match=msg):
+                spec_draft()
+        monkeypatch.setenv("MXNET_SPEC_DRAFT", "  ")
+        assert spec_draft() is None
+        monkeypatch.setenv("MXNET_SPEC_DRAFT", "layers=2,gamma=5")
+        assert spec_draft() == (2, 5)

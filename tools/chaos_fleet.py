@@ -9,7 +9,11 @@ exactly one successful response, token-for-token equal to the
 fault-free run — greedy and seeded alike, and a STREAMED request's
 concatenated frame tokens byte-equal the fault-free generated tail
 even when the kill fires mid-stream (no duplicated, no missing
-frames).
+frames). Every second streamed request additionally decodes
+SPECULATIVELY (a 1-layer truncated draft on every replica via
+``MXNET_SPEC_DRAFT``, docs/serving.md §speculative) — the oracle
+stays a plain generate, because speculation must never change a
+byte, kills and draft-equipped failover replays included.
 
 The schedule is the ``kill<I>`` member of the ``MXNET_FAULT_SPEC``
 step-rule family (``parallel/resilience.py``): the call counted is
@@ -175,6 +179,12 @@ def _request_plan(args):
                 "top_k": 8 if seeded else None,
                 "seed": 1000 * c + j,
                 "stream": (c + j) % 3 == 0,
+                # every second STREAMED request runs speculatively
+                # (docs/serving.md §speculative): the hint must
+                # change nothing the oracle can see — same bytes
+                # through draft/verify rounds, kills and replays on
+                # draft-equipped survivors included
+                "speculative": (c + j) % 6 == 0,
             }
     return plan
 
@@ -208,6 +218,14 @@ def _run(args):
             raise SystemExit(
                 "kill%d@... targets a replica the fleet does not "
                 "have (--replicas %d)" % (i, args.replicas))
+
+    # every replica (restarts included — _spawn_replica copies this
+    # env) builds a 1-layer truncated draft: speculative requests run
+    # draft/verify rounds, and a kill mid-round fails over to a
+    # survivor that decodes them speculatively too. The oracle stays
+    # a PLAIN in-process generate — speculation is a performance
+    # hint, so byte-equality against the unsped run IS the contract.
+    os.environ.setdefault("MXNET_SPEC_DRAFT", "layers=1,gamma=4")
 
     plan = _request_plan(args)
     want = _oracle_rows(args, plan)
@@ -261,6 +279,7 @@ def _run(args):
                     temperature=r["temperature"], top_k=r["top_k"],
                     seed=r["seed"], session="c%d" % c,
                     timeout=args.deadline,
+                    speculative=r["speculative"],
                     on_token=toks.append if r["stream"] else None)
             except Exception as exc:  # noqa: BLE001 — a failed
                 # request IS the finding this harness exists to catch
@@ -334,6 +353,8 @@ def _run(args):
         "ok": ok,
         "requests": args.clients * args.requests,
         "streamed": len(stream_toks),
+        "speculative": sum(1 for r in plan.values()
+                           if r["speculative"]),
         "clients": args.clients,
         "replicas": args.replicas,
         "fault_spec": spec,

@@ -578,6 +578,76 @@ def _scn_streaming():
                                 telemetry.now_ms() - t0, 3))
 
 
+def _scn_spec_decode():
+    """PR 18 surface: speculative decoding in the serving fleet —
+    one decode replica with a 1-layer truncated draft attached. A
+    plain (non-speculative) request runs FIRST and alone, tracing
+    the (B, 1) target step, then greedy + sampled speculative
+    requests run draft/verify rounds: every row byte-equals the
+    single-row generate (shared-noise verification — speculation is
+    a schedule, not a sampler), the target owns exactly TWO compiled
+    programs ((B, 1) step + (B, gamma+1) verify), the draft exactly
+    ONE, and the round/draft-step/acceptance counters are exact for
+    the deterministic workload."""
+    import numpy as np
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import telemetry
+    from mxnet_tpu.generation import Generator
+    from mxnet_tpu.initializer import Xavier
+    from mxnet_tpu.models import transformer
+    from mxnet_tpu.parallel import make_train_step
+    from mxnet_tpu.serve import ContinuousDecoder, ServeServer
+    from mxnet_tpu.serve.net import ServeClient
+    t0 = telemetry.now_ms()
+    V, L, H, DIM, T = 50, 2, 2, 32, 24
+    sym = transformer.get_symbol(V, 12, num_layers=L, num_heads=H,
+                                 dim=DIM, max_len=T)
+    step = make_train_step(sym, optimizer="sgd")
+    mx.random.seed(0)
+    params = step.init_state(Xavier(), {"data": (2, 12),
+                                        "softmax_label": (2, 12)})[0]
+
+    def gen(bs):
+        return Generator(params, V, T, num_layers=L, num_heads=H,
+                         dim=DIM, batch_size=bs)
+    p = np.arange(1, 5)
+    kw = {"temperature": 0.8, "top_k": 8, "seed": 7}
+    single = gen(1)
+    want = single.generate(p[None], 8, eos_id=0)[0]
+    want_s = single.generate(p[None], 8, eos_id=0, **kw)[0]
+    target = gen(2)
+    dec = ContinuousDecoder(target,
+                            draft=target.truncated_draft(num_layers=1),
+                            lookahead=3)
+    srv = ServeServer(dec)
+    with ServeClient(srv.host, srv.port) as cli:
+        # the plain request runs FIRST and alone so the (B, 1) step
+        # traces before any verify — the jit gauge then pins the
+        # full two-program target contract
+        out = cli.generate(p, 8, eos_id=0)
+        assert np.array_equal(out, want), (out, want)
+        out = cli.generate(p, 8, eos_id=0, speculative=True)
+        assert np.array_equal(out, want), (out, want)
+        out = cli.generate(p, 8, eos_id=0, speculative=True, **kw)
+        assert np.array_equal(out, want_s), (out, want_s)
+    st = dec.stats()
+    assert st["spec_rounds"] > 0 and st["draft_steps"] > 0, st
+    assert st["spec_accepted"] <= st["spec_proposed"], st
+    assert st["draft_prefills"] == 2, st   # one per speculative admit
+
+    def gval(name):
+        rec = telemetry.snapshot().get(name) or {}
+        return rec.get("value", 0)
+    assert gval("serve.decode.jit_cache_size") == 2
+    assert gval("serve.spec.draft_jit_cache_size") == 1
+    srv.close()
+    dec.close()
+    telemetry.journal_event("gate.probe",
+                            spec_decode_elapsed_ms=round(
+                                telemetry.now_ms() - t0, 3))
+
+
 # which PR-won property each gauge protects is resolved through
 # _PROPERTY_NOTES below; `gauges` lists the gauge names a scenario
 # REQUIRES in the final snapshot (absence is itself a gate failure),
@@ -665,6 +735,15 @@ SCENARIOS = {
         "noisy_counters": ("serve.net.stream_frames",),
         "noisy_events": (),
     },
+    "spec_decode": {
+        "fn": _scn_spec_decode,
+        "desc": "speculative decoding: draft/verify rounds on one "
+                "decode replica, token-exact vs plain decode",
+        "gauges": ("serve.decode.jit_cache_size",
+                   "serve.spec.draft_jit_cache_size",
+                   "serve.decode.kv_bytes_per_slot"),
+        "noisy_counters": (), "noisy_events": (),
+    },
 }
 
 # field-path prefix -> the protected property a regression names.
@@ -688,7 +767,25 @@ _PROPERTY_NOTES = (
      "PR 13 int8 continuous decode: ONE compiled (B, 1) step across "
      "slot turnover (a growing jit cache means admissions recompile "
      "— the per-admission-recompile regression continuous batching "
-     "exists to avoid)"),
+     "exists to avoid); with a speculative draft attached the target "
+     "owns exactly TWO programs — the step plus the (B, gamma+1) "
+     "verify (PR 18)"),
+    ("counts.gauges.serve.spec.draft_jit_cache_size",
+     "PR 18 speculative compile discipline: the draft owns exactly "
+     "ONE compiled (B, 1) program across propose steps, catch-ups "
+     "and slot turnover"),
+    ("counts.counters.serve.spec.rounds",
+     "PR 18 speculative serving: one verify forward per draft/"
+     "verify round, exactly — a drifting round count means the "
+     "acceptance walk or the round scheduler changed"),
+    ("counts.counters.serve.spec.accepted",
+     "PR 18 shared-noise verification: the accepted-token count is "
+     "exact for a deterministic workload (a drift means draft "
+     "proposal or target verification changed numerically — and "
+     "token-exactness vs plain decode is probably gone with it)"),
+    ("counts.counters.serve.spec.",
+     "PR 18 speculative serving: draft-step/proposal/draft-prefill "
+     "counters are exact for a deterministic request sequence"),
     ("counts.gauges.serve.decode.kv_bytes_per_slot",
      "PR 13 decode HBM diet: cache bytes per slot follow from the "
      "cache pytree's shapes/dtypes alone — a drift means the int8 "

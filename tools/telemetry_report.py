@@ -236,6 +236,17 @@ def _serving_section(events, snap):
             if h.get("type") == "histogram" and h.get("count"):
                 out[key] = {"count": h["count"], "p50": h.get("p50"),
                             "p95": h.get("p95"), "p99": h.get("p99")}
+        # speculative decoding: per-round-row acceptance (the knob
+        # that decides whether the draft is earning its keep —
+        # docs/serving.md §speculative)
+        h = snap.get("serve.spec.accept_rate") or {}
+        if h.get("type") == "histogram" and h.get("count"):
+            out["spec_accept_rate"] = {
+                "count": h["count"],
+                "mean": round(h["sum"] / h["count"], 4)
+                if h.get("sum") is not None else None,
+                "p50": h.get("p50"), "p95": h.get("p95"),
+                "p99": h.get("p99")}
         counters = {k: v["value"] for k, v in snap.items()
                     if k.startswith("serve.")
                     and v.get("type") == "counter" and v.get("value")}
@@ -343,6 +354,14 @@ def format_report(summary):
                 "  inter-token p50/p95/p99: %.2f/%.2f/%.2f ms over "
                 "%d gap(s)" % (t["p50"], t["p95"], t["p99"],
                                t["count"]))
+        if serving.get("spec_accept_rate"):
+            a = serving["spec_accept_rate"]
+            lines.append(
+                "  speculative accept rate: mean %.2f   p50/p95: "
+                "%.2f/%.2f over %d round-row(s) (docs/serving.md "
+                "§speculative — below ~0.4 the draft costs more "
+                "than it saves)"
+                % (a["mean"] or 0.0, a["p50"], a["p95"], a["count"]))
         if serving.get("kv_bytes_per_slot"):
             kvb = serving["kv_bytes_per_slot"]
             lines.append(

@@ -207,6 +207,14 @@ echo "== 4b4. streaming + chunked-prefill A/B =="
 cap "$OUT/serve_streaming.json" serve_streaming \
     python bench_serve.py --streaming
 
+echo "== 4b5. speculative decoding A/B =="
+# plain vs draft/verify continuous batching on one doctored target
+# (effective inter-token p99 ratio < 1.0 and tokens per target
+# forward > 1.5 at gamma=4, byte-identical output asserted) —
+# docs/serving.md §speculative
+cap "$OUT/serve_spec.json" serve_spec \
+    python bench_serve.py --speculative
+
 echo "== 4c. scaling sweep + GSPMD one-jit row =="
 # single chip unless the slice offers more (BENCH_SCALING_DEVICES=1,4,8
 # on a multi-chip window); the gspmd row is the 28.8%->45% MFU
