@@ -1,24 +1,36 @@
-"""Decode KV-cache HBM A/B: bf16 vs int8 (quantize_kv) caches through
-the per-row continuous-batching path (mxnet_tpu/serve/decode.py).
+"""Decode per-slot-state HBM A/B through the per-row
+continuous-batching path (mxnet_tpu/serve/decode.py). Two modes:
 
-Why: decode is bandwidth-bound and the KV cache is its dominant HBM
-stream — re-read every step while each weight is read once
+``BENCH_DECODE_MODE=kv`` (default) — bf16 vs int8 (quantize_kv) KV
+caches. Decode is bandwidth-bound and the KV cache is its dominant
+HBM stream — re-read every step while each weight is read once
 (ops/attention.py cached_attention). The int8 cache + per-token f32
 scales cut bytes per slot to ~0.52x bf16 at hd=128, which directly
-raises ContinuousDecoder slots per chip. This bench measures both
-sides of that trade at the serve path's real shape: decode step ms
+raises ContinuousDecoder slots per chip.
+
+``BENCH_DECODE_MODE=ssm`` — f32 attention vs ``block_type="ssm"``
+(ops/ssm.py) at a LONG-context shape (max_len defaults to 4096 here).
+The SSM slot is a constant (H, hd, hd) f32 blob with no length axis,
+so its bytes/slot never mention max_len — bytes ratio 2*max_len/hd
+(64x at hd=128, max_len=4096) and the same ratio in slots-per-HBM-
+budget — and its export_kv_rows handoff blob is the same bytes at
+ANY prompt length (measured at two lengths below) where attention's
+grows linearly.
+
+Both modes measure at the serve path's real shape: decode step ms
 and tokens/s through a slot pool with turnover (A/B at identical
 pool geometry), bytes per slot from the cache pytree, and how many
 slots each variant fits under an HBM budget.
 
     python benchmark/bench_decode.py           # or BENCH_PLATFORM=cpu
+    BENCH_DECODE_MODE=ssm python benchmark/bench_decode.py
     BENCH_DECODE_SMOKE=1 ...                   # tiny shape for tests
 
 One BENCH-style JSON line (bench_common fail_payload/last_known
 contract on every failure path, SIGTERM death stub armed): value =
-int8-cache tokens/s, vs_baseline = int8/bf16 throughput ratio, with
-per-variant sub-objects and the bytes/step ratios the acceptance
-criteria read.
+the cheaper variant's tokens/s (int8 / ssm), vs_baseline = its
+throughput ratio over the baseline variant, with per-variant
+sub-objects and the bytes/step ratios the acceptance criteria read.
 """
 import json
 import os
@@ -35,12 +47,18 @@ sys.path.insert(0, _REPO)
 
 from bench_common import fail_payload, install_death_stub  # noqa: E402
 
-METRIC = "decode_kv_ab"
+MODE = os.environ.get("BENCH_DECODE_MODE", "kv")
+if MODE not in ("kv", "ssm"):
+    raise SystemExit("BENCH_DECODE_MODE=%r: wants 'kv' or 'ssm'"
+                     % MODE)
+METRIC = "decode_ssm_ab" if MODE == "ssm" else "decode_kv_ab"
 UNIT = "tokens/s"
 
 # hd = DIM // HEADS stays 128 in both shapes — the bytes math the
-# acceptance criterion quotes (int8+scales = 264 B vs bf16 = 512 B
-# per token per kv head) is an hd=128 statement
+# acceptance criteria quote (int8+scales = 264 B vs bf16 = 512 B per
+# token per kv head; ssm bytes ratio = 2*max_len/hd) is an hd=128
+# statement. ssm mode defaults max_len to 4096: the O(1)-state win is
+# a LONG-context statement and 512 would understate it 8x.
 if os.environ.get("BENCH_DECODE_SMOKE") == "1":
     V, LAYERS, HEADS, DIM = 64, 1, 2, 256
     MAXLEN, PROMPT, MAXNEW, SLOTS = 64, 16, 6, 2
@@ -49,7 +67,8 @@ else:
     LAYERS = int(os.environ.get("BENCH_DECODE_LAYERS", "2"))
     HEADS = int(os.environ.get("BENCH_DECODE_HEADS", "4"))
     DIM = int(os.environ.get("BENCH_DECODE_DIM", "512"))
-    MAXLEN = int(os.environ.get("BENCH_DECODE_MAXLEN", "512"))
+    MAXLEN = int(os.environ.get(
+        "BENCH_DECODE_MAXLEN", "4096" if MODE == "ssm" else "512"))
     PROMPT = int(os.environ.get("BENCH_DECODE_PROMPT", "256"))
     MAXNEW = int(os.environ.get("BENCH_DECODE_MAXNEW", "32"))
     SLOTS = int(os.environ.get("BENCH_DECODE_SLOTS", "4"))
@@ -57,7 +76,7 @@ REQUESTS = 2 * SLOTS      # two waves: every request is a slot turnover
 BUDGET = float(os.environ.get("BENCH_DECODE_HBM_BUDGET", "16e9"))
 
 
-def _params():
+def _params(block_type="attention"):
     """Random weights at the bench shape (numerics are irrelevant to a
     bandwidth A/B; training a checkpoint here would dominate runtime)."""
     import numpy as np
@@ -65,7 +84,8 @@ def _params():
     from mxnet_tpu.models import transformer
     sym = transformer.get_symbol(V, 8, num_layers=LAYERS,
                                  num_heads=HEADS, dim=DIM,
-                                 max_len=MAXLEN)
+                                 max_len=MAXLEN,
+                                 block_type=block_type)
     shapes, _, _ = sym.infer_shape(data=(2, 8), softmax_label=(2, 8))
     rng = np.random.RandomState(0)
     return {name: (0.02 * rng.standard_normal(shp)).astype(np.float32)
@@ -73,13 +93,15 @@ def _params():
             if name not in ("data", "softmax_label")}
 
 
-def run_variant(params, quantize_kv):
+def run_variant(params, quantize_kv, block_type="attention",
+                dtype="bfloat16"):
     import numpy as np
 
     from mxnet_tpu.generation import Generator
     gen = Generator(params, V, MAXLEN, num_layers=LAYERS,
                     num_heads=HEADS, dim=DIM, batch_size=SLOTS,
-                    dtype="bfloat16", quantize_kv=quantize_kv)
+                    dtype=dtype, quantize_kv=quantize_kv,
+                    block_type=block_type)
     bytes_per_slot = gen.kv_cache_bytes() // SLOTS
     rng = np.random.RandomState(7)
     prompts = [rng.randint(0, V, (PROMPT,)) for _ in range(REQUESTS)]
@@ -125,27 +147,101 @@ def run_variant(params, quantize_kv):
             "slots_in_budget": int(BUDGET // bytes_per_slot)}
 
 
+def _handoff_bytes(params, block_type, prompt_len, dtype="float32"):
+    """export_kv_rows blob bytes for one sequence cached to
+    ``prompt_len`` — the wire cost of a prefill->decode handoff or a
+    migration at that depth (O(1) for ssm, O(prompt_len) for
+    attention)."""
+    import numpy as np
+
+    from mxnet_tpu.generation import Generator, kv_blob_nbytes
+    gen = Generator(params, V, MAXLEN, num_layers=LAYERS,
+                    num_heads=HEADS, dim=DIM, batch_size=SLOTS,
+                    dtype=dtype, block_type=block_type)
+    rows = np.random.RandomState(3).randint(
+        0, V, (SLOTS, prompt_len)).astype(np.float32)
+    _, aux = gen._forward(gen._fresh_aux(), rows, 0)
+    return kv_blob_nbytes(gen.export_kv_rows(aux, 0, prompt_len))
+
+
+def _bytes_per_slot_at(params, block_type, max_len, dtype="float32"):
+    from mxnet_tpu.generation import Generator
+    return Generator(params, V, max_len, num_layers=LAYERS,
+                     num_heads=HEADS, dim=DIM, batch_size=SLOTS,
+                     dtype=dtype,
+                     block_type=block_type).state_bytes_per_slot()
+
+
+def _run_kv(jax):
+    params = _params()
+    bf16 = run_variant(params, quantize_kv=False)
+    q8 = run_variant(params, quantize_kv=True)
+    return {"metric": METRIC, "unit": UNIT,
+            "value": q8["tokens_s"], "live": True,
+            "vs_baseline": round(q8["tokens_s"] / bf16["tokens_s"],
+                                 3),
+            "device_kind": jax.devices()[0].device_kind,
+            "hd": DIM // HEADS, "layers": LAYERS,
+            "max_len": MAXLEN, "prompt": PROMPT,
+            "max_new": MAXNEW, "slots": SLOTS,
+            "requests": REQUESTS, "hbm_budget": BUDGET,
+            "bf16": bf16, "int8": q8,
+            "bytes_ratio": round(q8["bytes_per_slot"]
+                                 / bf16["bytes_per_slot"], 4),
+            "step_ms_ratio": round(q8["step_ms"] / bf16["step_ms"],
+                                   3)}
+
+
+def _run_ssm(jax):
+    """f32 attention vs ssm at the long-context shape: throughput,
+    bytes/slot + slots-in-budget (the capacity prize), bytes
+    CONSTANCY in max_len for ssm, and handoff bytes at two prompt
+    lengths (O(1) on the wire)."""
+    attn_params = _params()
+    ssm_params = _params(block_type="ssm")
+    attn = run_variant(attn_params, quantize_kv=False,
+                       dtype="float32")
+    ssm = run_variant(ssm_params, quantize_kv=False,
+                      block_type="ssm", dtype="float32")
+    short_len = max(2, MAXLEN // 4)
+    bytes_vs_maxlen = {
+        "attention_f32": {str(m): _bytes_per_slot_at(
+            attn_params, "attention", m) for m in (short_len, MAXLEN)},
+        "ssm": {str(m): _bytes_per_slot_at(
+            ssm_params, "ssm", m) for m in (short_len, MAXLEN)}}
+    p_short, p_long = max(2, PROMPT // 4), PROMPT
+    handoff = {
+        "attention_f32": {str(p): _handoff_bytes(
+            attn_params, "attention", p) for p in (p_short, p_long)},
+        "ssm": {str(p): _handoff_bytes(
+            ssm_params, "ssm", p) for p in (p_short, p_long)}}
+    return {"metric": METRIC, "unit": UNIT,
+            "value": ssm["tokens_s"], "live": True,
+            "vs_baseline": round(ssm["tokens_s"] / attn["tokens_s"],
+                                 3),
+            "device_kind": jax.devices()[0].device_kind,
+            "hd": DIM // HEADS, "layers": LAYERS,
+            "max_len": MAXLEN, "prompt": PROMPT,
+            "max_new": MAXNEW, "slots": SLOTS,
+            "requests": REQUESTS, "hbm_budget": BUDGET,
+            "attention_f32": attn, "ssm": ssm,
+            # the acceptance criteria read these three
+            "bytes_ratio": round(ssm["bytes_per_slot"]
+                                 / attn["bytes_per_slot"], 6),
+            "slots_ratio": round(ssm["slots_in_budget"]
+                                 / max(1, attn["slots_in_budget"]),
+                                 2),
+            "step_ms_ratio": round(ssm["step_ms"] / attn["step_ms"],
+                                   3),
+            "bytes_per_slot_vs_max_len": bytes_vs_maxlen,
+            "handoff_bytes_vs_prompt": handoff}
+
+
 def main():
     install_death_stub(METRIC, UNIT)
     import jax
     try:
-        params = _params()
-        bf16 = run_variant(params, quantize_kv=False)
-        q8 = run_variant(params, quantize_kv=True)
-        rec = {"metric": METRIC, "unit": UNIT,
-               "value": q8["tokens_s"], "live": True,
-               "vs_baseline": round(q8["tokens_s"] / bf16["tokens_s"],
-                                    3),
-               "device_kind": jax.devices()[0].device_kind,
-               "hd": DIM // HEADS, "layers": LAYERS,
-               "max_len": MAXLEN, "prompt": PROMPT,
-               "max_new": MAXNEW, "slots": SLOTS,
-               "requests": REQUESTS, "hbm_budget": BUDGET,
-               "bf16": bf16, "int8": q8,
-               "bytes_ratio": round(q8["bytes_per_slot"]
-                                    / bf16["bytes_per_slot"], 4),
-               "step_ms_ratio": round(q8["step_ms"] / bf16["step_ms"],
-                                      3)}
+        rec = _run_ssm(jax) if MODE == "ssm" else _run_kv(jax)
         print(json.dumps(rec))
     except Exception as e:  # noqa: BLE001 — one parseable line always
         print(json.dumps(fail_payload(METRIC, UNIT, e)))

@@ -46,7 +46,15 @@ class Generator:
     vocab_size, num_layers, num_heads, dim, ffn_hidden :
         Architecture — must match the training symbol.
     max_len : int
-        KV-cache capacity (prompt + generated tokens must fit).
+        KV-cache capacity (prompt + generated tokens must fit). With
+        SSM layers (block_type) the state itself is O(1), but max_len
+        still bounds total sequence length — it sizes the attention
+        layers of a mixed stack and the learned position table.
+    block_type : "attention" (default), "ssm", or per-layer sequence
+        — SSM layers hold one (num_heads, head_dim, head_dim) f32
+        state blob per slot instead of (max_len, head_dim) KV rows
+        (see ops/ssm.py and models/transformer.get_decode_symbol for
+        knob composition rules).
     batch_size : int
     dtype : optional compute dtype for params/caches (e.g. "bfloat16").
     mesh : optional jax.sharding.Mesh for multi-chip serving. Params
@@ -61,7 +69,7 @@ class Generator:
                  dtype=None, num_experts=0, mesh=None, quantize=None,
                  pos_encoding="learned", attention_window=0,
                  rolling_cache=False, num_kv_heads=None,
-                 quantize_kv=False):
+                 quantize_kv=False, block_type="attention"):
         from .parallel import sharding as shd
 
         if quantize not in (None, "int8"):
@@ -88,6 +96,12 @@ class Generator:
         self._rolling = bool(rolling_cache)
         head_dim = dim // num_heads
         kv_heads = int(num_kv_heads or num_heads)
+        # block_type validation happens in get_decode_symbol below;
+        # the flags steer slot-state accounting and the serving-layer
+        # compatibility refusals (speculative drafts, prefill grouping)
+        self._btypes = transformer._canon_block_types(block_type,
+                                                      num_layers)
+        self._has_ssm = "ssm" in self._btypes
         # kept for twin-symbol builders (serve/decode.py rebuilds this
         # graph with per_row_pos=True against the SAME parameters)
         self._decode_opts = dict(
@@ -99,7 +113,7 @@ class Generator:
             pos_encoding=pos_encoding,
             attention_window=attention_window,
             rolling_cache=rolling_cache, num_kv_heads=num_kv_heads,
-            kv_quantize=quantize_kv)
+            kv_quantize=quantize_kv, block_type=block_type)
         sym = transformer.get_decode_symbol(**self._decode_opts)
         if quantize:
             arg_params = _quantize_weights(
@@ -168,23 +182,34 @@ class Generator:
         self._cache_shape = (self.batch_size, kv_heads, self.max_len,
                              head_dim)
         self._cache_dtype = cache_dtype
+        # SSM layers: one (B, H, hd, hd) recurrent-state blob each,
+        # ALWAYS f32 regardless of compute dtype — the bit-identical-
+        # state rule (ops/ssm.py) is stated in f32, and the blob is so
+        # small (no length axis) that a bf16 diet would save ~nothing
+        self._state_shape = (self.batch_size, int(num_heads),
+                             head_dim, head_dim)
         # quantize_kv: k/v live int8 with per-token f32 scale caches —
         # halves decode's dominant HBM stream (the cache is re-read
         # every step; each weight only once)
         self._quantize_kv = bool(quantize_kv)
-        # static sizing gauge: bytes of KV-cache state one batch row
-        # (= one serving slot) owns across the whole aux pytree —
-        # ContinuousDecoder re-publishes the same gauge from its live
-        # pool, and the MXNET_DECODE_SLOTS sizing hint divides an HBM
-        # budget by it (shape math only, no allocation)
+        # static sizing gauge: bytes of decode state one batch row
+        # (= one serving slot) owns across the whole aux pytree,
+        # whatever its kind — KV rows, int8 KV + scales, or SSM state
+        # blobs. ContinuousDecoder re-publishes the same gauge from
+        # its live pool, and the MXNET_DECODE_SLOTS sizing hint
+        # divides an HBM budget by it (shape math only, no allocation)
         _telemetry.gauge("serve.decode.kv_bytes_per_slot").set(
-            self.kv_cache_bytes() // self.batch_size)
+            self.state_bytes_per_slot())
 
     def _aux_spec(self, name):
-        """(shape, dtype) of one KV-cache aux state — THE single
-        classification both _fresh_aux (allocation) and
-        kv_cache_bytes (sizing) read, so the gauge/slot math can
-        never drift from what is actually allocated."""
+        """(shape, dtype) of one decode-state aux — THE single
+        classification _fresh_aux (allocation), kv_cache_bytes
+        (sizing) and _aux_row_shape (export/import) read, so the
+        gauge/slot math can never drift from what is actually
+        allocated."""
+        if name.endswith("_state"):
+            # SSM recurrent state: fixed-size blob, no length axis
+            return self._state_shape, jnp.dtype(jnp.float32)
         if name.endswith(("_k_scale", "_v_scale")):
             # per-token dequant scales for the int8 caches
             return self._cache_shape[:3], jnp.dtype(jnp.float32)
@@ -192,12 +217,24 @@ class Generator:
             return self._cache_shape, jnp.dtype(jnp.int8)
         return self._cache_shape, jnp.dtype(self._cache_dtype)
 
+    def _aux_row_shape(self, name, pos):
+        """Shape of ONE batch row's exported state for aux ``name`` at
+        sequence position ``pos``: length-indexed caches ship their
+        ``[:, :pos]`` prefix; SSM state blobs have no length axis and
+        ship whole (the O(1)-handoff property — blob bytes constant in
+        prompt length). Shared by export_kv_rows and the serving
+        side's import validation so the two ends of a handoff can
+        never disagree."""
+        shape, _ = self._aux_spec(name)
+        if name.endswith("_state"):
+            return shape[1:]
+        return (shape[1], pos) + shape[3:]
+
     def kv_cache_bytes(self):
-        """Total bytes of the KV-cache aux pytree (every layer's k/v
-        caches, plus their per-token f32 scale caches under
-        quantize_kv) at this Generator's (batch_size, max_len) —
-        computed from shapes/dtypes alone. Divide by batch_size for
-        bytes per serving slot."""
+        """Total bytes of the decode-state aux pytree (every layer's
+        k/v caches plus their per-token f32 scale caches under
+        quantize_kv, and/or SSM state blobs) at this Generator's
+        (batch_size, max_len) — computed from shapes/dtypes alone."""
         total = 0
         for name in self._sym.list_auxiliary_states():
             shape, dtype = self._aux_spec(name)
@@ -207,19 +244,32 @@ class Generator:
             total += n * dtype.itemsize
         return total
 
+    def state_bytes_per_slot(self):
+        """Bytes of decode state ONE batch row (= one serving slot)
+        owns — the state-agnostic number behind the
+        ``serve.decode.kv_bytes_per_slot`` gauge (name kept for
+        dashboard compatibility), ``describe(hbm_budget=)`` and
+        ``MXNET_DECODE_SLOTS=auto`` slot sizing, and
+        tools/telemetry_report.py's bytes/slot line. O(max_len) for
+        attention layers; O(1) for SSM layers."""
+        return self.kv_cache_bytes() // self.batch_size
+
     def export_kv_rows(self, aux, row, pos):
-        """Serialize ONE sequence's KV-cache state out of an aux
+        """Serialize ONE sequence's decode state out of an aux
         pytree — the portable decode state of the prefill/decode
         disaggregation handoff (docs/serving.md §disaggregated
         prefill; the arXiv 2603.09555 "portable O(1) cache" enabler).
 
-        ``aux``: a cache pytree this Generator produced (typically the
+        ``aux``: a state pytree this Generator produced (typically the
         prefill output); ``row``: which batch row to export; ``pos``:
-        how many tokens of cache that row holds. Every cache in the
-        pytree contributes its ``[row, :, :pos, ...]`` prefix — the
-        int8 k/v rows AND their per-token f32 scale rows under
-        ``quantize_kv``, or the bf16/f32 rows otherwise — as numpy
-        with the device dtype preserved bit-for-bit, so a remote
+        how many tokens of state that row holds. Length-indexed caches
+        contribute their ``[row, :, :pos, ...]`` prefix — the int8 k/v
+        rows AND their per-token f32 scale rows under ``quantize_kv``,
+        or the bf16/f32 rows otherwise; SSM state blobs contribute
+        ``[row]`` WHOLE (no length axis — the blob's bytes are
+        constant in ``pos``, which is what makes an SSM handoff O(1)
+        on the wire). Everything ships as numpy with the device dtype
+        preserved bit-for-bit, so a remote
         :meth:`ContinuousDecoder.import_kv_rows` scatter is
         device-roundtrip-exact. Cache entries past ``pos`` never ship:
         they are unattended garbage by the cache-position mask, and
@@ -251,23 +301,25 @@ class Generator:
         # docs/serving.md's <=15%-of-one-prefill)
         fn = self._loop_cache.get(("export", pos))
         if fn is None:
-            fn = jax.jit(lambda a, r: {
-                n: jax.lax.dynamic_index_in_dim(
-                    a[n], r, axis=0, keepdims=False)[:, :pos]
-                for n in a})
+            def _one(a, r, n):
+                # SSM state blobs have no length axis: ship whole
+                # (slicing [:, :pos] would cut the HEAD axis)
+                full = jax.lax.dynamic_index_in_dim(
+                    a[n], r, axis=0, keepdims=False)
+                return full if n.endswith("_state") else full[:, :pos]
+            fn = jax.jit(lambda a, r: {n: _one(a, r, n) for n in a})
             self._loop_cache[("export", pos)] = fn
         host = jax.device_get(fn(aux, jnp.int32(row)))
         rows = {}
         for name in sorted(wanted):
-            shape, dtype = self._aux_spec(name)
+            _, dtype = self._aux_spec(name)
+            want = self._aux_row_shape(name, pos)
             arr = np.asarray(host[name])
-            if arr.dtype != dtype or \
-                    arr.shape != (shape[1], pos) + shape[3:]:
+            if arr.dtype != dtype or arr.shape != want:
                 raise ValueError(
                     "cache %r is %s%r, expected %s%r — the aux pytree "
                     "does not belong to this Generator"
-                    % (name, arr.dtype, arr.shape, dtype,
-                       (shape[1], pos) + shape[3:]))
+                    % (name, arr.dtype, arr.shape, dtype, want))
             rows[name] = arr
         return {"v": 1, "pos": pos, "rows": rows}
 
@@ -632,6 +684,16 @@ class Generator:
             # a circular buffer (p_s mis-attribution) — not supported
             raise ValueError("speculative decoding is not supported "
                              "with rolling caches")
+        if self._has_ssm or getattr(draft, "_has_ssm", False):
+            # the recurrent state is mutated by EVERY fed token and
+            # has no per-position rows — rejected speculative tokens
+            # cannot be rolled back out of it
+            raise ValueError(
+                "speculative decoding is not supported with ssm "
+                "blocks: the recurrent state has no per-position "
+                "entries to overwrite, so rejected proposals would "
+                "corrupt it (use attention blocks for speculative "
+                "serving)")
         self._check_sampling(temperature, top_k, top_p)
         prompt, P = self._check_prompt(prompt, max_new_tokens)
         if P + max_new_tokens > draft.max_len:
@@ -737,6 +799,11 @@ class Generator:
             raise ValueError("truncated_draft is not supported with "
                              "rolling caches (speculative decoding "
                              "rejects rolling models outright)")
+        if self._has_ssm:
+            raise ValueError(
+                "truncated_draft is not supported with ssm blocks "
+                "(speculative decoding rejects SSM models outright — "
+                "the recurrent state has no rollback)")
         nl = int(num_layers)
         if not 1 <= nl <= self.num_layers:
             raise ValueError(
@@ -780,6 +847,13 @@ class Generator:
         if self._rolling or getattr(draft, "_rolling", False):
             raise ValueError("speculative decoding is not supported "
                              "with rolling caches")
+        if self._has_ssm or getattr(draft, "_has_ssm", False):
+            raise ValueError(
+                "speculative decoding is not supported with ssm "
+                "blocks: the recurrent state has no per-position "
+                "entries to overwrite, so rejected proposals would "
+                "corrupt it (use attention blocks for speculative "
+                "serving)")
         self._check_sampling(temperature, top_k, top_p)
         prompt, P = self._check_prompt(prompt, max_new_tokens)
         n = int(max_new_tokens)

@@ -84,6 +84,39 @@ def _attention_block(x, num_heads, dim, prefix, seq_axis=None,
     return _merge_heads_proj(att, dim, prefix)
 
 
+def _ssm_qkvg(x, num_heads, dim, prefix, quantized=False):
+    """Fused q/k/v/gate projection for the SSM block: (B, T, C) ->
+    q/k/v (B, H, T, hd) plus a per-head per-token decay-gate logit
+    (B, H, T). One FullyConnected of width 3*dim + num_heads named
+    "<prefix>qkvg" — shared by the training and decode forms so their
+    parameter packing can never drift (the qkv-packing rule of
+    _qkv_heads, extended by the H gate columns at the end)."""
+    head_dim = dim // num_heads
+    qkvg = _fc(x, 3 * dim + num_heads, prefix + "qkvg", quantized)
+
+    def cut(begin, end):
+        part = sym.slice_axis(qkvg, axis=2, begin=begin, end=end)
+        part = sym.reshape(part, shape=(0, 0, num_heads, head_dim))
+        return sym.transpose(part, axes=(0, 2, 1, 3))  # (B, H, T, hd)
+
+    gate = sym.slice_axis(qkvg, axis=2, begin=3 * dim,
+                          end=3 * dim + num_heads)      # (B, T, H)
+    gate = sym.transpose(gate, axes=(0, 2, 1))          # (B, H, T)
+    return (cut(0, dim), cut(dim, 2 * dim), cut(2 * dim, 3 * dim),
+            gate)
+
+
+def _ssm_block(x, num_heads, dim, prefix):
+    """x: (B, T, C) -> (B, T, C); gated linear-attention (SSM) block —
+    the chunked-scan TRAINING form (ops/ssm.py). No positions enter:
+    the recurrence is ordered by construction, so the block composes
+    with either pos_encoding (learned adds at the embedding; rope
+    rotates only the attention layers of a mixed stack)."""
+    q, k, v, g = _ssm_qkvg(x, num_heads, dim, prefix)
+    out = sym.contrib.SSMScan(q, k, v, g, name=prefix + "ssm")
+    return _merge_heads_proj(out, dim, prefix)
+
+
 def _ffn_block(x, dim, hidden, prefix, quantized=False):
     h = _fc(x, hidden, prefix + "fc1", quantized)
     h = sym.Activation(h, act_type="relu")
@@ -122,6 +155,28 @@ def _check_kv_heads(num_heads, num_kv_heads):
             "for grouped-query attention" % (num_heads, num_kv_heads))
 
 
+def _canon_block_types(block_type, num_layers):
+    """Normalize block_type to a per-layer tuple.
+
+    block_type: "attention" | "ssm" for a uniform stack, or a sequence
+    of those naming each layer's kind (mixed stacks — e.g. mostly-ssm
+    with a few attention layers, the usual hybrid recipe)."""
+    if isinstance(block_type, str):
+        kinds = (block_type,) * num_layers
+    else:
+        kinds = tuple(block_type)
+        if len(kinds) != num_layers:
+            raise ValueError(
+                "block_type sequence names each layer: got %d entries "
+                "for num_layers=%d" % (len(kinds), num_layers))
+    for b in kinds:
+        if b not in ("attention", "ssm"):
+            raise ValueError(
+                "block_type entries must be 'attention' or 'ssm', "
+                "got %r" % (b,))
+    return kinds
+
+
 def _check_pos_encoding(pos_encoding, dim, num_heads):
     if pos_encoding not in ("learned", "rope"):
         raise ValueError("pos_encoding must be 'learned' or 'rope', "
@@ -136,16 +191,20 @@ def _check_pos_encoding(pos_encoding, dim, num_heads):
 def _layer_block(x, num_heads, dim, ffn_hidden, prefix, seq_axis=None,
                  num_experts=0, expert_axis=None, dropout=0.0,
                  moe_capacity_factor=1.25, rope_positions=None,
-                 window=0, num_kv_heads=None):
-    """One pre-LN transformer block: attention residual + FFN/MoE
-    residual. Shared by the monolithic get_symbol layer loop and the
-    pipeline get_stage_symbol so the two can never drift."""
+                 window=0, num_kv_heads=None, block_type="attention"):
+    """One pre-LN transformer block: mixing residual (attention or
+    SSM, by block_type) + FFN/MoE residual. Shared by the monolithic
+    get_symbol layer loop and the pipeline get_stage_symbol so the two
+    can never drift."""
     a = sym.LayerNorm(x, name=prefix + "ln1")
-    x = x + _attention_block(a, num_heads, dim, prefix,
-                             seq_axis=seq_axis,
-                             rope_positions=rope_positions,
-                             window=window,
-                             num_kv_heads=num_kv_heads)
+    if block_type == "ssm":
+        x = x + _ssm_block(a, num_heads, dim, prefix)
+    else:
+        x = x + _attention_block(a, num_heads, dim, prefix,
+                                 seq_axis=seq_axis,
+                                 rope_positions=rope_positions,
+                                 window=window,
+                                 num_kv_heads=num_kv_heads)
     f = sym.LayerNorm(x, name=prefix + "ln2")
     ff = _moe_block(f, dim, ffn_hidden, num_experts, prefix,
                     expert_axis=expert_axis,
@@ -229,12 +288,29 @@ def _decode_attention_block(x, num_heads, dim, prefix, max_len, pos,
     return _merge_heads_proj(att, dim, prefix, quantized)
 
 
+def _decode_ssm_block(x, num_heads, dim, prefix, max_len, pos,
+                      quantized=False):
+    """Incremental variant of _ssm_block: identical qkvg/proj helpers
+    (a training checkpoint binds unchanged), mixing routed through
+    _contrib_SSMCached with one per-layer recurrent-state aux
+    ("<prefix>ssm_state", (B, H, hd, hd) f32, created by the op's
+    state_inputs registration). The state has NO length axis — a
+    decode slot costs the same HBM at any position — and the op
+    ignores pos (the recurrence carries its own), so the per-row-
+    position serving twin is this same graph."""
+    q, k, v, g = _ssm_qkvg(x, num_heads, dim, prefix, quantized)
+    out = sym.contrib.SSMCached(q, k, v, g, pos=pos, max_len=max_len,
+                                name=prefix + "ssm")
+    return _merge_heads_proj(out, dim, prefix, quantized)
+
+
 def get_decode_symbol(vocab_size, max_len, num_layers=2, num_heads=4,
                       dim=128, ffn_hidden=None, num_experts=0,
                       quantized=False, compute_dtype=None,
                       pos_encoding="learned", attention_window=0,
                       rolling_cache=False, num_kv_heads=None,
-                      kv_quantize=False, per_row_pos=False):
+                      kv_quantize=False, per_row_pos=False,
+                      block_type="attention"):
     """Autoregressive-decode twin of get_symbol.
 
     Inputs: data (B, Tnew) token ids for the tokens being appended
@@ -256,6 +332,16 @@ def get_decode_symbol(vocab_size, max_len, num_layers=2, num_heads=4,
     for both the int8 rows and their f32 scale rows); rolling_cache
     remains shared-position only.
 
+    block_type: "attention" (default), "ssm", or a per-layer sequence
+    (mixed stacks). SSM layers replace the (B, H, max_len, hd) KV-row
+    caches with one (B, H, hd, hd) f32 recurrent-state aux per layer
+    ("layerN_ssm_state") — O(1) decode memory in sequence length.
+    Knob composition: kv_quantize and attention_window apply to the
+    attention LAYERS of a mixed stack and refuse on a pure-SSM stack
+    (nothing to quantize/window); rolling_cache refuses with any SSM
+    layer (the state is already O(1) — there is no window to roll);
+    per_row_pos composes freely (the SSM op ignores pos).
+
     New TPU-native capability (the 2017 reference's decode story was
     rnn.RNNCell step-wise unrolling); mxnet_tpu.generation.Generator
     drives this symbol."""
@@ -264,6 +350,9 @@ def get_decode_symbol(vocab_size, max_len, num_layers=2, num_heads=4,
         raise ValueError("dim (%d) must be divisible by num_heads (%d)"
                          % (dim, num_heads))
     _check_kv_heads(num_heads, num_kv_heads)
+    btypes = _canon_block_types(block_type, num_layers)
+    has_ssm = "ssm" in btypes
+    has_attn = "attention" in btypes
     if rolling_cache and not attention_window:
         raise ValueError("rolling_cache needs attention_window > 0 "
                          "(the circular capacity covers one window)")
@@ -275,6 +364,24 @@ def get_decode_symbol(vocab_size, max_len, num_layers=2, num_heads=4,
         raise ValueError("per_row_pos is not supported with "
                          "rolling_cache (the circular-buffer op has "
                          "no per-row-position variant)")
+    if rolling_cache and has_ssm:
+        raise ValueError(
+            "rolling_cache is not supported with ssm blocks: the SSM "
+            "state is already O(1) in sequence length — there is no "
+            "KV window to roll (use block_type='attention' for "
+            "rolling caches, or drop rolling_cache)")
+    if kv_quantize and not has_attn:
+        raise ValueError(
+            "kv_quantize needs at least one attention layer: a pure-"
+            "SSM stack has no KV cache to quantize (its (H, hd, hd) "
+            "f32 state is already O(1); mixed attention/ssm stacks "
+            "compose — the attention layers quantize)")
+    if attention_window and not has_attn:
+        raise ValueError(
+            "attention_window needs at least one attention layer: "
+            "SSM layers have no attention window (their state decays "
+            "continuously; mixed stacks compose — the window applies "
+            "to the attention layers)")
     data = sym.Variable("data")
     positions = sym.Variable("positions")
     cache_pos = sym.Variable("cache_pos") if per_row_pos \
@@ -310,14 +417,17 @@ def get_decode_symbol(vocab_size, max_len, num_layers=2, num_heads=4,
     for i in range(num_layers):
         prefix = "layer%d_" % i
         a = sym.LayerNorm(x, name=prefix + "ln1")
-        x = x + _decode_attention_block(a, num_heads, dim, prefix,
-                                        max_len, cache_pos,
-                                        num_kv_heads=num_kv_heads,
-                                        quantized=quantized,
-                                        rope_positions=rope_positions,
-                                        window=attention_window,
-                                        rolling=rolling_cache,
-                                        kv_quantize=kv_quantize)
+        if btypes[i] == "ssm":
+            x = x + _decode_ssm_block(a, num_heads, dim, prefix,
+                                      max_len, cache_pos,
+                                      quantized=quantized)
+        else:
+            x = x + _decode_attention_block(
+                a, num_heads, dim, prefix, max_len, cache_pos,
+                num_kv_heads=num_kv_heads, quantized=quantized,
+                rope_positions=rope_positions,
+                window=attention_window, rolling=rolling_cache,
+                kv_quantize=kv_quantize)
         f = sym.LayerNorm(x, name=prefix + "ln2")
         # inference never capacity-drops: every token is served, so
         # the factor is raised to E (cap == token count). Training-time
@@ -338,7 +448,8 @@ def get_symbol(vocab_size, seq_len, num_layers=2, num_heads=4, dim=128,
                ffn_hidden=None, dropout=0.0, max_len=None,
                num_experts=0, seq_axis=None, expert_axis=None,
                moe_capacity_factor=1.25, pos_encoding="learned",
-               attention_window=0, num_kv_heads=None, loss_chunk=0):
+               attention_window=0, num_kv_heads=None, loss_chunk=0,
+               block_type="attention"):
     """GPT-style causal LM symbol.
 
     data: (B, T) token ids; softmax_label: (B, T) next-token targets
@@ -368,6 +479,12 @@ def get_symbol(vocab_size, seq_len, num_layers=2, num_heads=4, dim=128,
     layer (no position parameters, graceful length extrapolation; the
     modern long-context choice).
 
+    block_type: "attention" (default), "ssm", or a per-layer sequence
+    — SSM layers are gated linear attention (ops/ssm.py) trained in
+    the chunked-scan form; their decode twin carries O(1) state
+    instead of KV rows (see get_decode_symbol). Incompatible with
+    seq_axis (the scan is sequential over the sequence).
+
     loss_chunk: 0 (default) keeps the reference head — FullyConnected
     logits + SoftmaxOutput, output = softmax probabilities per
     position. A positive value swaps in the fused chunked-CE head
@@ -387,6 +504,16 @@ def get_symbol(vocab_size, seq_len, num_layers=2, num_heads=4, dim=128,
                          % (dim, num_heads))
     _check_kv_heads(num_heads, num_kv_heads)
     _check_pos_encoding(pos_encoding, dim, num_heads)
+    btypes = _canon_block_types(block_type, num_layers)
+    if seq_axis and "ssm" in btypes:
+        raise ValueError(
+            "seq_axis (ring sequence parallelism) is not supported "
+            "with ssm blocks — the chunked scan is sequential over "
+            "the sequence; shard batch/tensor axes instead")
+    if attention_window and "attention" not in btypes:
+        raise ValueError(
+            "attention_window needs at least one attention layer "
+            "(SSM layers have no attention window)")
     data = sym.Variable("data")
     label = sym.Variable("softmax_label")
 
@@ -409,7 +536,8 @@ def get_symbol(vocab_size, seq_len, num_layers=2, num_heads=4, dim=128,
                          moe_capacity_factor=moe_capacity_factor,
                          num_kv_heads=num_kv_heads,
                          rope_positions=rope_positions,
-                         window=attention_window)
+                         window=attention_window,
+                         block_type=btypes[i])
 
     x = sym.LayerNorm(x, name="ln_f")
     if loss_chunk:

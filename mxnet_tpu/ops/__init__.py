@@ -24,5 +24,6 @@ from . import warp_ops      # noqa: F401
 from . import contrib_ops   # noqa: F401
 from . import rcnn_ops      # noqa: F401
 from . import attention     # noqa: F401
+from . import ssm           # noqa: F401
 from . import custom        # noqa: F401
 from . import shape_hooks   # noqa: F401  (must come after all registrations)

@@ -350,3 +350,22 @@ def _cached_attention_q8_shapes(shapes, attrs):
 
 set_param_shapes("_contrib_CachedAttentionQ8",
                  _cached_attention_q8_shapes)
+
+
+# -- SSMCached (O(1) decode state — a fixed blob, no length axis) -----------
+
+def _ssm_cached_shapes(shapes, attrs):
+    """Slot 4 is the (B, H, hd, hd) recurrent state — sized entirely
+    from the query projection; max_len never appears (THE point of the
+    op). Slot 5 is the pos scalar, accepted for cached-attention attr
+    parity and ignored by the op."""
+    q = shapes[0]
+    out = list(shapes)
+    if q is not None and len(out) > 4 and out[4] is None:
+        out[4] = (q[0], q[1], q[3], q[3])
+    if len(out) > 5 and out[5] is None:
+        out[5] = (1,)
+    return out
+
+
+set_param_shapes("_contrib_SSMCached", _ssm_cached_shapes)

@@ -1,0 +1,239 @@
+"""Gated linear-attention / SSM block — both faces of the duality.
+
+The state-space-duality view (PAPERS.md: "Compiler-First State Space
+Duality and Portable O(1) Autoregressive Caching", arXiv 2603.09555)
+gives one recurrence two execution forms:
+
+    S_t = a_t * S_{t-1} + k_t (x) v_t        (per-head matrix state)
+    o_t = q_t . S_t                          (read AFTER the update)
+
+with a data-dependent scalar decay a_t = sigmoid(g_t + gate_bias) in
+(0, 1) per head per token.  Training and prefill run the CHUNKED-SCAN
+form: the sequence is cut into fixed-width chunks, each chunk combines
+an inter-chunk term (carried state, decayed per position) with an
+intra-chunk masked-decay attention matrix — parallel over the chunk
+on the MXU — and a `jax.lax.scan` threads the (B, H, hd, hd) state
+across chunks under the ordinary jit path so XLA fuses it ("Operator
+Fusion in XLA", arXiv 2301.13062 for the scan-fusion cost model).
+Decode runs the FUSED RECURRENT form: one token in, one rank-1 state
+update, one state read — O(1) compute and O(1) memory per step,
+independent of how long the sequence has run.  That constant
+(B, H, hd, hd) blob is the whole serving prize: a decode slot costs
+the same HBM at position 10 and position 100k (vs the (max_len, hd)
+KV rows of _contrib_CachedAttention).
+
+BIT-IDENTICAL STATE RULE (the quantization-rule analogue of
+attention.py's `_q8_quantize`): every path derives the decay through
+`_log_decay` and exponentiates the LOG decay — the fused step uses
+a_t = exp(log_sigmoid(g_t + gate_bias)), never sigmoid() directly —
+and both forms update state with the same einsum contractions.  A
+width-1 chunk's exit state is therefore BITWISE equal to the fused
+step's state for the same inputs, which is what lets serving hand a
+blob from the chunked prefill form to the recurrent decode form (and
+between replicas on migration) with no drift, ever.  (The guarantee
+is under jit — the serving condition; op-by-op eager dispatch skips
+XLA's fused multiply-adds and can differ from the scan in the last
+ulp, which tests/test_ssm.py pins.)
+
+Positions: the recurrence carries its own notion of position (state
+already encodes everything before it), so the cached op accepts and
+IGNORES `pos`.  A slot pool at ragged decode depths needs no per-row
+offsets — the per-row-position "twin" of this op is the op itself.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .registry import register
+
+
+def _log_decay(gate, gate_bias):
+    """log a_t = log_sigmoid(g_t + gate_bias), float32.
+
+    THE shared decay rule (see module docstring): both the chunked-scan
+    and fused recurrent forms must derive their decay from this exact
+    expression and exponentiate it — `exp(log_sigmoid(x))` is NOT
+    bitwise `sigmoid(x)`, so a path that called sigmoid directly would
+    break the bit-identical-state contract.  gate_bias shifts the init
+    toward remembering (bias 4.0 => a ~= 0.982 for zero-init gates);
+    log_sigmoid <= 0 keeps every downstream exp() in (0, 1] — no
+    overflow anywhere in either form."""
+    return jax.nn.log_sigmoid(gate.astype(jnp.float32) + gate_bias)
+
+
+def _check_ssm_shapes(query, key, value, gate, state=None):
+    B, H, T, D = query.shape
+    if key.shape != query.shape or value.shape != query.shape:
+        raise ValueError(
+            "SSM q/k/v must share one (B, H, T, hd) shape: got q=%r "
+            "k=%r v=%r" % (query.shape, key.shape, value.shape))
+    if gate.shape != (B, H, T):
+        raise ValueError(
+            "SSM gate must be (B, H, T) per-head per-token decay "
+            "logits: got %r for q=%r" % (gate.shape, query.shape))
+    if state is not None and state.shape != (B, H, D, D):
+        raise ValueError(
+            "SSM state must be (B, H, hd, hd) = %r: got %r"
+            % ((B, H, D, D), state.shape))
+
+
+def ssm_chunk_scan(query, key, value, gate, state=None, chunk=64,
+                   gate_bias=4.0, scale=None):
+    """Chunked-scan (training / prefill) form.
+
+    query/key/value: (B, H, T, hd); gate: (B, H, T) decay logits;
+    state: (B, H, hd, hd) f32 carried state or None for zeros.
+    Returns (out (B, H, T, hd) in query dtype, new_state f32).
+
+    The sequence is padded to a multiple of the chunk width with
+    la=0 (decay 1), k=0, v=0 — exact: padding multiplies the carried
+    state by exp(0) and adds a zero outer product, so the exit state
+    and the real rows' outputs are untouched.  Within a chunk, row t
+    reads the carried state decayed by exp(L_t) plus an intra-chunk
+    masked score matrix (q_t.k_s) * exp(L_t - L_s) for s <= t, where
+    L is the inclusive cumsum of log decays; the inner where() guard
+    zeroes the log-decay BEFORE the exp so masked s > t entries (where
+    L_t - L_s can be large and positive) never produce inf * 0."""
+    B, H, T, D = query.shape
+    _check_ssm_shapes(query, key, value, gate, state)
+    if scale is None:
+        scale = D ** -0.5
+    if state is None:
+        state = jnp.zeros((B, H, D, D), jnp.float32)
+    state = state.astype(jnp.float32)
+    qf = query.astype(jnp.float32) * scale
+    kf = key.astype(jnp.float32)
+    vf = value.astype(jnp.float32)
+    la = _log_decay(gate, gate_bias)                    # (B, H, T)
+
+    W = max(1, min(int(chunk), T))
+    nc = -(-T // W)
+    pad = nc * W - T
+    if pad:
+        qf = jnp.pad(qf, ((0, 0), (0, 0), (0, pad), (0, 0)))
+        kf = jnp.pad(kf, ((0, 0), (0, 0), (0, pad), (0, 0)))
+        vf = jnp.pad(vf, ((0, 0), (0, 0), (0, pad), (0, 0)))
+        la = jnp.pad(la, ((0, 0), (0, 0), (0, pad)))
+
+    # (nc, B, H, W, .) — scan walks the chunk axis
+    qc = jnp.moveaxis(qf.reshape(B, H, nc, W, D), 2, 0)
+    kc = jnp.moveaxis(kf.reshape(B, H, nc, W, D), 2, 0)
+    vc = jnp.moveaxis(vf.reshape(B, H, nc, W, D), 2, 0)
+    lac = jnp.moveaxis(la.reshape(B, H, nc, W), 2, 0)
+    mask = jnp.tril(jnp.ones((W, W), bool))             # s <= t
+
+    def _chunk(S, inp):
+        q_c, k_c, v_c, la_c = inp
+        L = jnp.cumsum(la_c, axis=-1)                   # (B, H, W)
+        inter = jnp.exp(L)[..., None] * jnp.einsum(
+            "bhtd,bhde->bhte", q_c, S,
+            precision=jax.lax.Precision.DEFAULT)
+        s_qk = jnp.einsum(
+            "bhtd,bhsd->bhts", q_c, k_c,
+            precision=jax.lax.Precision.DEFAULT)        # (B, H, W, W)
+        decay = L[..., :, None] - L[..., None, :]       # L_t - L_s
+        scores = jnp.where(
+            mask, s_qk * jnp.exp(jnp.where(mask, decay, 0.0)), 0.0)
+        o_c = inter + jnp.einsum(
+            "bhts,bhse->bhte", scores, v_c,
+            precision=jax.lax.Precision.DEFAULT)
+        Llast = L[..., -1]                              # (B, H)
+        kd = k_c * jnp.exp(Llast[..., None] - L)[..., None]
+        S = jnp.exp(Llast)[..., None, None] * S + jnp.einsum(
+            "bhsd,bhse->bhde", kd, v_c,
+            precision=jax.lax.Precision.DEFAULT)
+        return S, o_c
+
+    state, outs = jax.lax.scan(_chunk, state, (qc, kc, vc, lac))
+    out = jnp.moveaxis(outs, 0, 2).reshape(B, H, nc * W, D)[:, :, :T]
+    return out.astype(query.dtype), state
+
+
+def ssm_recurrent_step(query, key, value, gate, state, gate_bias=4.0,
+                       scale=None):
+    """Fused recurrent (decode) form — Tnew == 1.
+
+    One rank-1 state update and one state read; O(1) in sequence
+    length.  Deliberately mirrors `ssm_chunk_scan`'s width-1 chunk
+    expression for expression (same `_log_decay`, the exp of the log,
+    the same einsum contractions), so its output AND exit state are
+    BITWISE what a 1-wide chunk produces — the handoff contract the
+    serving stack's export/import and prefill->decode transition rely
+    on."""
+    B, H, Tn, D = query.shape
+    if Tn != 1:
+        raise ValueError(
+            "ssm_recurrent_step is the single-token fused form (got "
+            "Tnew=%d); use ssm_chunk_scan for multi-token prefill"
+            % Tn)
+    _check_ssm_shapes(query, key, value, gate, state)
+    if scale is None:
+        scale = D ** -0.5
+    state = state.astype(jnp.float32)
+    qf = query.astype(jnp.float32) * scale
+    kf = key.astype(jnp.float32)
+    vf = value.astype(jnp.float32)
+    a = jnp.exp(_log_decay(gate, gate_bias))            # (B, H, 1)
+    inter = a[..., None] * jnp.einsum(
+        "bhtd,bhde->bhte", qf, state,
+        precision=jax.lax.Precision.DEFAULT)
+    s_qk = jnp.einsum(
+        "bhtd,bhsd->bhts", qf, kf,
+        precision=jax.lax.Precision.DEFAULT)            # (B, H, 1, 1)
+    out = inter + jnp.einsum(
+        "bhts,bhse->bhte", s_qk, vf,
+        precision=jax.lax.Precision.DEFAULT)
+    state = a[..., None] * state + jnp.einsum(
+        "bhsd,bhse->bhde", kf, vf,
+        precision=jax.lax.Precision.DEFAULT)
+    return out.astype(query.dtype), state
+
+
+@register("_contrib_SSMScan",
+          arg_names=("query", "key", "value", "gate"),
+          defaults={"scale": None, "gate_bias": 4.0, "chunk": 64})
+def _ssm_scan_op(query, key, value, gate, scale=None, gate_bias=4.0,
+                 chunk=64, **_):
+    """(B, H, T, hd) gated linear-attention over a zero-initialized
+    state — the TRAINING form.  Fully differentiable (autodiff
+    through the chunk scan); `chunk` trades intra-chunk MXU work
+    against scan length and does not change the math."""
+    out, _state = ssm_chunk_scan(query, key, value, gate, state=None,
+                                 chunk=int(chunk),
+                                 gate_bias=float(gate_bias),
+                                 scale=scale)
+    return out
+
+
+@register("_contrib_SSMCached",
+          arg_names=("query", "key", "value", "gate", "state", "pos"),
+          state_inputs=(4,), nondiff_inputs=(5,),
+          differentiable=False,
+          defaults={"scale": None, "gate_bias": 4.0, "chunk": 64,
+                    "max_len": 0})
+def _ssm_cached_op(query, key, value, gate, state, pos, scale=None,
+                   gate_bias=4.0, chunk=64, **_):
+    """Incremental-decode SSM over a carried (B, H, hd, hd) f32 state
+    aux (threaded in place by the executor like a KV cache, but with
+    NO length axis — the O(1) decode-slot blob).
+
+    Dispatch is STATIC on Tnew = query.shape[2]: prefill (Tnew > 1)
+    runs the chunked scan continuing from the carried state; decode
+    (Tnew == 1) runs the fused recurrent step.  Both write state under
+    the bit-identical rule, so the prefill->decode transition (and any
+    export/import of the blob between replicas) is drift-free.
+
+    `pos` is accepted and IGNORED — the recurrence carries its own
+    position, so shared-position and per-row-position callers get the
+    same graph (there is no capacity contract either: the state never
+    fills up; `max_len` is accepted only for attr-parity with the
+    cached-attention ops).  Returns (out, new_state)."""
+    del pos
+    if query.shape[2] == 1:
+        return ssm_recurrent_step(query, key, value, gate, state,
+                                  gate_bias=float(gate_bias),
+                                  scale=scale)
+    return ssm_chunk_scan(query, key, value, gate, state=state,
+                          chunk=int(chunk),
+                          gate_bias=float(gate_bias), scale=scale)

@@ -6,27 +6,35 @@ holds every slot until the LAST row finishes, so mean device
 utilization decays toward 1/B as lengths diverge. Continuous batching
 (the O(1)-per-token cached-decode serving model, arXiv:2603.09555)
 fixes the shape instead of the membership: a fixed slot pool over the
-on-device KV cache, where a finished sequence frees its slot at the
-step it finishes and the next queued prompt is admitted at the
-following step. Decode throughput then tracks offered load, not the
-longest request in flight.
+on-device decode state — per-slot KV-cache rows for attention blocks,
+a constant (H, hd, hd) recurrent blob for ``block_type="ssm"`` layers
+— where a finished sequence frees its slot at the step it finishes
+and the next queued prompt is admitted at the following step. Decode
+throughput then tracks offered load, not the longest request in
+flight.
 
 What makes the single compiled step possible is the per-row-position
 decode graph (``get_decode_symbol(per_row_pos=True)`` →
 ``cached_attention`` — or ``cached_attention_q8`` under
 ``quantize_kv`` — with a (B,) ``pos``): every slot decodes at its
 own depth inside ONE (B, 1) XLA program, so slot membership changes
-never recompile. Prompt admission reuses the Generator's ordinary
-shared-position prefill (all admitted rows start at position 0) and
-merges the prefilled cache rows into the pool with a batch-axis
-scatter — under ``quantize_kv`` that merge carries the per-token f32
-scale caches alongside the int8 rows.
+never recompile. SSM layers need no twin at all — the recurrent
+state carries its own position, so their per-row graph IS the shared
+graph and the same one-program discipline holds for free. Prompt
+admission reuses the Generator's ordinary shared-position prefill
+(all admitted rows start at position 0) and merges the prefilled
+state into the pool with a batch-axis scatter — under ``quantize_kv``
+that merge carries the per-token f32 scale caches alongside the int8
+rows, and SSM state blobs ride the same scatter with no length axis.
 
-Decode is bandwidth-bound and the KV cache is its dominant HBM
-stream (re-read every step; each weight read once), so an int8 cache
-(``Generator(quantize_kv=True)``) roughly halves the bytes a slot
-pins in HBM — which directly raises how many slots fit a chip. The
-``serve.decode.kv_bytes_per_slot`` gauge and :meth:`describe` /
+Decode is bandwidth-bound and the per-slot state is its dominant HBM
+stream (re-read every step; each weight read once), so shrinking that
+state directly raises how many slots fit a chip: an int8 cache
+(``Generator(quantize_kv=True)``) roughly halves an attention slot's
+bytes, and an SSM slot pins a CONSTANT byte count independent of
+``max_len`` entirely. The ``serve.decode.kv_bytes_per_slot`` gauge
+(state-agnostic despite the legacy name —
+``Generator.state_bytes_per_slot()``) and :meth:`describe` /
 ``MXNET_DECODE_SLOTS=auto`` report the sizing math.
 
 Exactness contract: greedy decode (temperature 0) emits token-for-token
@@ -314,7 +322,9 @@ class DecodeFuture:
 
 
 class ContinuousDecoder:
-    """Fixed-slot continuous batching over a Generator's KV cache.
+    """Fixed-slot continuous batching over a Generator's decode state
+    (KV caches for attention blocks, O(1) recurrent blobs for ssm
+    blocks, both side by side in a mixed stack).
 
     The pool width is the Generator's ``batch_size``; its ``max_len``
     caps prompt + max_new_tokens per request. Requests queue FIFO
@@ -325,9 +335,12 @@ class ContinuousDecoder:
     Int8 KV caches (``Generator(quantize_kv=True)``) are supported:
     the per-row op scatters the int8 rows and their per-token f32
     scale rows at each slot's own depth, halving cache bytes per slot.
-    Not supported: rolling caches (the circular-buffer op has no
-    per-row-position variant — raised at construction here, not
-    mid-request).
+    SSM blocks (``block_type="ssm"``) are supported: each slot's state
+    is a constant-size blob, so a slot costs the same HBM at any
+    depth. Not supported: rolling caches (the circular-buffer op has
+    no per-row-position variant) and speculative drafts with ssm
+    blocks (no per-position state to roll back) — both raised at
+    construction here, not mid-request.
 
     Disaggregated serving (docs/serving.md §disaggregated prefill):
     ``submit(handoff=...)`` admits a sequence whose prefill ran on a
@@ -386,6 +399,18 @@ class ContinuousDecoder:
         self._draft = draft
         self._gamma = max(1, int(lookahead)) if lookahead else 4
         if draft is not None:
+            if getattr(generator, "_has_ssm", False) or \
+                    getattr(draft, "_has_ssm", False):
+                # the env path (MXNET_SPEC_DRAFT -> truncated_draft)
+                # already refused above; this catches an explicit
+                # draft= with ssm blocks on either side
+                raise ValueError(
+                    "speculative decoding is not supported with ssm "
+                    "blocks: the recurrent state has no per-position "
+                    "entries to overwrite, so rejected proposals "
+                    "would corrupt it (serve SSM models without a "
+                    "draft, or use attention blocks for speculative "
+                    "serving)")
             if draft.vocab_size != generator.vocab_size or \
                     draft.batch_size != generator.batch_size:
                 raise ValueError(
@@ -571,23 +596,30 @@ class ContinuousDecoder:
 
     def describe(self, hbm_budget=None):
         """SpecLayout.describe()-style sizing report: pool geometry,
-        cache bytes per slot (int8 rows + f32 scale rows under
-        quantize_kv), and — given an HBM budget in bytes — how many
-        slots would fit at the configured max_len. hbm_budget=None
-        tries the device's reported bytes_limit
-        (``MXNET_DECODE_SLOTS=auto:<bytes>`` passes one explicitly).
-        The budget math covers CACHE state only; weights and
-        activations claim their share of HBM on top."""
+        state bytes per slot (KV rows — int8 + f32 scale rows under
+        quantize_kv — and/or fixed-size SSM state blobs), and — given
+        an HBM budget in bytes — how many slots would fit at the
+        configured max_len. hbm_budget=None tries the device's
+        reported bytes_limit (``MXNET_DECODE_SLOTS=auto:<bytes>``
+        passes one explicitly). The budget math covers per-slot
+        decode state only; weights and activations claim their share
+        of HBM on top."""
         gen = self._gen
         bps = self._kv_bytes_per_slot
-        kind = "int8 + f32 per-token scales" if gen._quantize_kv \
-            else str(jnp.dtype(gen._cache_dtype))
+        kinds = []
+        if any(not n.endswith("_state") for n in self._aux):
+            kind = "int8 + f32 per-token scales" if gen._quantize_kv \
+                else str(jnp.dtype(gen._cache_dtype))
+            kinds.append("KV rows %s (%s)" % (
+                "x".join(str(d) for d in gen._cache_shape[1:]), kind))
+        if any(n.endswith("_state") for n in self._aux):
+            kinds.append("ssm state %s (float32, O(1) in max_len)" % (
+                "x".join(str(d) for d in gen._state_shape[1:])))
         lines = [
             "ContinuousDecoder pool: %d slot(s), max_len=%d, "
             "%d layer(s)" % (self._B, gen.max_len,
                              gen.num_layers),
-            "  cache rows: %s   (%s)" % (
-                "x".join(str(d) for d in gen._cache_shape[1:]), kind),
+            "  per-slot state: %s" % "; ".join(kinds),
             "  kv_bytes_per_slot: %d (%.2f MiB)  pool total: %.2f MiB"
             % (bps, bps / 2 ** 20, bps * self._B / 2 ** 20),
         ]
@@ -643,8 +675,8 @@ class ContinuousDecoder:
                 "kv_blob caches %s do not match this pool's %s"
                 % (sorted(rows), sorted(self._aux)))
         for name, arr in rows.items():
-            shape, dtype = self._gen._aux_spec(name)
-            want = (shape[1], pos) + shape[3:]
+            _, dtype = self._gen._aux_spec(name)
+            want = self._gen._aux_row_shape(name, pos)
             if np.asarray(arr).dtype != dtype or arr.shape != want:
                 raise ValueError(
                     "kv_blob cache %r is %s%r, expected %s%r — blob "
@@ -655,13 +687,15 @@ class ContinuousDecoder:
         return pos
 
     def import_kv_rows(self, slot, blob):
-        """Scatter one exported sequence's cache rows into ``slot`` —
-        the decode half of the KV handoff, exact to the bit vs the
-        prefill device's own rows. Only the blob's ``pos``-token
-        prefix lands; stale entries past it in the slot are never
-        attended (the per-row cache-position mask). Called by the
-        decode loop during handoff admission; external callers must
-        own a quiescent pool (the loop thread is the aux mutator)."""
+        """Scatter one exported sequence's decode state into ``slot``
+        — the decode half of the handoff, exact to the bit vs the
+        prefill device's own state. For KV caches only the blob's
+        ``pos``-token prefix lands; stale entries past it in the slot
+        are never attended (the per-row cache-position mask). SSM
+        state blobs have no length axis and land whole — the same
+        O(1) bytes at any ``pos``. Called by the decode loop during
+        handoff admission; external callers must own a quiescent pool
+        (the loop thread is the aux mutator)."""
         slot = int(slot)
         if not 0 <= slot < self._B:
             raise ValueError("slot %d out of range for %d-slot pool"

@@ -227,11 +227,27 @@ class PrefillEngine:
         never attended by a real position, and masked terms are exact
         zeros in the reductions), so coalescing is invisible in the
         bits. Solo callers (warmup, 1-row pools, window 0) pass a
-        1-element group and run on their own thread."""
+        1-element group and run on their own thread.
+
+        SSM generators coalesce only length-homogeneous groups: the
+        recurrent state has no positional mask — a padded tail's
+        tokens would be ABSORBED into the exported state blob — so a
+        mixed-length group splits into per-length subgroups, each its
+        own shared forward (same replies, one extra graph call per
+        extra distinct length)."""
         import jax
 
         from ..generation import _pick_token
         gen = self._gen
+        if getattr(gen, "_has_ssm", False):
+            by_len = {}
+            for g in group:
+                by_len.setdefault(int(g.prompt.shape[0]),
+                                  []).append(g)
+            if len(by_len) > 1:
+                for sub in by_len.values():
+                    self._run_group(sub)
+                return
         pmax = max(int(g.prompt.shape[0]) for g in group)
         rows = np.zeros((gen.batch_size, pmax), np.int64)
         for i, g in enumerate(group):

@@ -1,0 +1,329 @@
+"""SSM / gated linear-attention duality (mxnet_tpu/ops/ssm.py,
+models/transformer.py block_type="ssm", ISSUE 19 tentpole).
+
+Load-bearing acceptance gate: the CPU-deterministic parity grid —
+the chunked-scan training/prefill form and the fused recurrent decode
+form are the SAME recurrence, so a width-1 chunk is BITWISE the jitted
+recurrent step (output and exit state) and every other chunk width
+agrees to 1e-5. That bit-identical-state rule is what lets serving
+hand a state blob from prefill to decode (and between replicas) with
+no drift; tests/test_serve_ssm.py pins the serving half.
+"""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import mxnet_tpu as mx
+from mxnet_tpu.generation import Generator, kv_blob_nbytes
+from mxnet_tpu.initializer import Xavier
+from mxnet_tpu.models import transformer
+from mxnet_tpu.ops.ssm import ssm_chunk_scan, ssm_recurrent_step
+from mxnet_tpu.parallel import make_train_step
+
+B_, H_, T_, D_ = 2, 3, 13, 8
+
+
+def _inputs(seed=0, T=T_):
+    rng = np.random.RandomState(seed)
+    q = jnp.asarray(rng.randn(B_, H_, T, D_), jnp.float32)
+    k = jnp.asarray(rng.randn(B_, H_, T, D_), jnp.float32)
+    v = jnp.asarray(rng.randn(B_, H_, T, D_), jnp.float32)
+    g = jnp.asarray(rng.randn(B_, H_, T), jnp.float32)
+    return q, k, v, g
+
+
+def _recurrent_chain(q, k, v, g, state=None):
+    """Token-by-token fused decode over a T-long sequence, each step
+    through jax.jit — the exact condition serving runs the step under
+    (the bit-identical guarantee is stated under jit: eager dispatch
+    skips XLA's fused multiply-adds and can differ in the last ulp)."""
+    T = q.shape[2]
+    if state is None:
+        state = jnp.zeros((q.shape[0], q.shape[1], q.shape[3],
+                           q.shape[3]), jnp.float32)
+    step = jax.jit(ssm_recurrent_step)
+    outs = []
+    for t in range(T):
+        o, state = step(q[:, :, t:t + 1], k[:, :, t:t + 1],
+                        v[:, :, t:t + 1], g[:, :, t:t + 1], state)
+        outs.append(o)
+    return jnp.concatenate(outs, axis=2), state
+
+
+class TestParityGrid:
+    def test_width1_chunk_is_bitwise_the_recurrent_step(self):
+        """ACCEPTANCE: chunk=1 scan == jitted fused step chain, bit
+        for bit, in both the outputs and the exit state — the handoff
+        contract itself."""
+        q, k, v, g = _inputs()
+        out_s, st_s = jax.jit(
+            lambda *a: ssm_chunk_scan(*a, chunk=1))(q, k, v, g)
+        out_r, st_r = _recurrent_chain(q, k, v, g)
+        np.testing.assert_array_equal(np.asarray(out_s),
+                                      np.asarray(out_r))
+        np.testing.assert_array_equal(np.asarray(st_s),
+                                      np.asarray(st_r))
+
+    @pytest.mark.parametrize("W", [2, 3, 4, 8, 13, 64])
+    def test_chunk_width_grid_vs_recurrent(self, W):
+        """Every chunk width (dividing, non-dividing, padded past T)
+        computes the same math as the fused recurrent form to 1e-5 —
+        width changes the MXU/scan split, never the result."""
+        q, k, v, g = _inputs(seed=W)
+        out_c, st_c = ssm_chunk_scan(q, k, v, g, chunk=W)
+        out_r, st_r = _recurrent_chain(q, k, v, g)
+        np.testing.assert_allclose(np.asarray(out_c),
+                                   np.asarray(out_r),
+                                   rtol=0, atol=1e-5)
+        np.testing.assert_allclose(np.asarray(st_c), np.asarray(st_r),
+                                   rtol=0, atol=1e-5)
+
+    def test_carried_state_continuation(self):
+        """Scanning [0, T) in one call == scanning [0, 7) then [7, T)
+        with the carried state — the chunked-prefill / decode
+        transition in miniature."""
+        q, k, v, g = _inputs(seed=3)
+        out_full, st_full = ssm_chunk_scan(q, k, v, g, chunk=4)
+        o1, s1 = ssm_chunk_scan(q[:, :, :7], k[:, :, :7], v[:, :, :7],
+                                g[:, :, :7], chunk=4)
+        o2, s2 = ssm_chunk_scan(q[:, :, 7:], k[:, :, 7:], v[:, :, 7:],
+                                g[:, :, 7:], state=s1, chunk=4)
+        np.testing.assert_allclose(
+            np.asarray(jnp.concatenate([o1, o2], axis=2)),
+            np.asarray(out_full), rtol=0, atol=1e-5)
+        np.testing.assert_allclose(np.asarray(s2), np.asarray(st_full),
+                                   rtol=0, atol=1e-5)
+
+    def test_recurrent_step_continues_chunked_prefill(self):
+        """The real serving sequence: chunked prefill over the prompt,
+        then jitted fused steps — matches the all-chunked run 1e-5."""
+        q, k, v, g = _inputs(seed=5)
+        out_full, st_full = ssm_chunk_scan(q, k, v, g, chunk=64)
+        P = 9
+        _, s_pre = ssm_chunk_scan(q[:, :, :P], k[:, :, :P],
+                                  v[:, :, :P], g[:, :, :P], chunk=64)
+        out_dec, st_dec = _recurrent_chain(
+            q[:, :, P:], k[:, :, P:], v[:, :, P:], g[:, :, P:],
+            state=s_pre)
+        np.testing.assert_allclose(np.asarray(out_dec),
+                                   np.asarray(out_full[:, :, P:]),
+                                   rtol=0, atol=1e-5)
+        np.testing.assert_allclose(np.asarray(st_dec),
+                                   np.asarray(st_full),
+                                   rtol=0, atol=1e-5)
+
+    def test_gradients_flow_and_are_finite(self):
+        q, k, v, g = _inputs(seed=7)
+
+        def loss(q_, k_, v_, g_):
+            out, _ = ssm_chunk_scan(q_, k_, v_, g_, chunk=4)
+            return jnp.sum(out ** 2)
+
+        grads = jax.grad(loss, argnums=(0, 1, 2, 3))(q, k, v, g)
+        for gr in grads:
+            assert bool(jnp.all(jnp.isfinite(gr)))
+            assert float(jnp.max(jnp.abs(gr))) > 0.0
+
+    def test_recurrent_step_rejects_multi_token(self):
+        q, k, v, g = _inputs()
+        st = jnp.zeros((B_, H_, D_, D_), jnp.float32)
+        with pytest.raises(ValueError, match="single-token"):
+            ssm_recurrent_step(q, k, v, g, st)
+
+    def test_shape_validation(self):
+        q, k, v, g = _inputs()
+        with pytest.raises(ValueError, match="share one"):
+            ssm_chunk_scan(q, k[:, :, :5], v, g)
+        with pytest.raises(ValueError, match="gate"):
+            ssm_chunk_scan(q, k, v, g[:, :1])
+        bad = jnp.zeros((B_, H_, D_, D_ + 1), jnp.float32)
+        with pytest.raises(ValueError, match="state"):
+            ssm_chunk_scan(q, k, v, g, state=bad)
+
+
+V, L, H, DIM, ML = 31, 2, 2, 32, 20
+
+
+def _params(block_type="ssm", seed=0):
+    sym = transformer.get_symbol(V, 12, num_layers=L, num_heads=H,
+                                 dim=DIM, max_len=ML,
+                                 block_type=block_type)
+    step = make_train_step(sym, optimizer="sgd")
+    mx.random.seed(seed)
+    state = step.init_state(Xavier(), {"data": (2, 12),
+                                       "softmax_label": (2, 12)})
+    return state[0]
+
+
+@pytest.fixture(scope="module")
+def ssm_params():
+    return _params()
+
+
+@pytest.fixture(scope="module")
+def mixed_params():
+    return _params(block_type=("attention", "ssm"), seed=1)
+
+
+def _gen(params, batch_size, block_type="ssm", **kw):
+    return Generator(params, V, ML, num_layers=L, num_heads=H,
+                     dim=DIM, batch_size=batch_size,
+                     block_type=block_type, **kw)
+
+
+class TestSymbols:
+    def test_decode_symbol_aux_names(self):
+        sym = transformer.get_decode_symbol(
+            V, num_layers=2, num_heads=H, dim=DIM, max_len=ML,
+            block_type="ssm")
+        assert sym.list_auxiliary_states() == [
+            "layer0_ssm_state", "layer1_ssm_state"]
+
+    def test_mixed_stack_aux_names(self):
+        sym = transformer.get_decode_symbol(
+            V, num_layers=2, num_heads=H, dim=DIM, max_len=ML,
+            block_type=("attention", "ssm"))
+        aux = sym.list_auxiliary_states()
+        assert "layer0_attn_k_cache" in aux
+        assert "layer1_ssm_state" in aux
+
+    def test_per_row_twin_binds_same_params(self):
+        """The ContinuousDecoder contract: the per-row-position twin
+        (for SSM, the op itself — the recurrence carries position)
+        lists exactly the shared-position symbol's arguments."""
+        common = dict(num_layers=L, num_heads=H, dim=DIM, max_len=ML,
+                      block_type="ssm")
+        a = transformer.get_decode_symbol(V, **common)
+        b = transformer.get_decode_symbol(V, per_row_pos=True,
+                                          **common)
+        assert a.list_arguments() == b.list_arguments()
+
+    def test_block_type_validation(self):
+        with pytest.raises(ValueError, match="block_type"):
+            transformer.get_symbol(V, 12, num_layers=2, num_heads=H,
+                                   dim=DIM, block_type="mamba")
+        with pytest.raises(ValueError, match="names each layer"):
+            transformer.get_symbol(V, 12, num_layers=3, num_heads=H,
+                                   dim=DIM,
+                                   block_type=("ssm", "attention"))
+
+
+class TestKnobRefusals:
+    """PR 13's refusal-message precedent: every SSM-incompatible knob
+    refuses loudly at construction, naming what IS supported."""
+
+    def test_rolling_cache_refused(self):
+        with pytest.raises(ValueError, match="rolling_cache"):
+            transformer.get_decode_symbol(
+                V, num_layers=L, num_heads=H, dim=DIM, max_len=ML,
+                block_type="ssm", rolling_cache=True)
+
+    def test_quantize_kv_pure_ssm_refused(self):
+        with pytest.raises(ValueError, match="no KV cache"):
+            transformer.get_decode_symbol(
+                V, num_layers=L, num_heads=H, dim=DIM, max_len=ML,
+                block_type="ssm", kv_quantize=True)
+
+    def test_quantize_kv_mixed_composes(self, mixed_params):
+        """int8 KV on the attention layers + f32 state blob on the
+        SSM layer, side by side in one generator."""
+        gen = _gen(mixed_params, 2,
+                   block_type=("attention", "ssm"), quantize_kv=True)
+        aux = gen._fresh_aux()
+        assert aux["layer0_attn_k_cache"].dtype == jnp.int8
+        assert aux["layer1_ssm_state"].dtype == jnp.float32
+
+    def test_attention_window_pure_ssm_refused(self):
+        with pytest.raises(ValueError, match="attention_window"):
+            transformer.get_decode_symbol(
+                V, num_layers=L, num_heads=H, dim=DIM, max_len=ML,
+                block_type="ssm", attention_window=8)
+
+    def test_seq_axis_refused_in_training_symbol(self):
+        with pytest.raises(ValueError, match="seq_axis"):
+            transformer.get_symbol(V, 12, num_layers=L, num_heads=H,
+                                   dim=DIM, block_type="ssm",
+                                   seq_axis="seq")
+
+    def test_speculative_refused(self, ssm_params):
+        gen = _gen(ssm_params, 2)
+        with pytest.raises(ValueError, match="speculative"):
+            gen.truncated_draft(num_layers=1)
+        with pytest.raises(ValueError,
+                           match="speculative decoding is not"):
+            gen.generate_speculative(gen, np.arange(1, 4)[None], 3)
+
+
+class TestGeneratorSSM:
+    def test_greedy_host_vs_device(self, ssm_params):
+        gen = _gen(ssm_params, 2)
+        prompts = np.asarray([[3, 1, 4, 1], [5, 9, 2, 6]])
+        host = gen.generate(prompts, 6)
+        dev = gen.generate_on_device(prompts, 6)
+        np.testing.assert_array_equal(host, np.asarray(dev))
+
+    def test_mixed_greedy_host_vs_device(self, mixed_params):
+        gen = _gen(mixed_params, 2, block_type=("attention", "ssm"))
+        prompts = np.asarray([[3, 1, 4, 1], [5, 9, 2, 6]])
+        np.testing.assert_array_equal(
+            gen.generate(prompts, 6),
+            np.asarray(gen.generate_on_device(prompts, 6)))
+
+    def test_state_bytes_independent_of_max_len(self, ssm_params):
+        """THE perf property: an SSM slot's bytes never mention
+        max_len (vs attention's linear growth)."""
+        hd = DIM // H
+        want = L * H * hd * hd * 4            # f32 blob per layer
+        g_small = Generator(ssm_params, V, 12, num_layers=L,
+                            num_heads=H, dim=DIM, batch_size=2,
+                            block_type="ssm")
+        g_large = Generator(ssm_params, V, ML, num_layers=L,
+                            num_heads=H, dim=DIM, batch_size=2,
+                            block_type="ssm")
+        assert g_small.state_bytes_per_slot() == want
+        assert g_large.state_bytes_per_slot() == want
+        assert g_large.kv_cache_bytes() == want * 2
+
+    def test_export_blob_bytes_constant_in_pos(self, ssm_params):
+        """The O(1) handoff: export_kv_rows ships the same bytes at
+        any cached depth (attention blobs grow with pos)."""
+        gen = _gen(ssm_params, 2)
+        prompts = np.asarray([[3, 1, 4, 1, 5, 9, 2, 6]] * 2)
+        _, aux = gen._forward(gen._fresh_aux(),
+                              prompts.astype(np.float32), 0)
+        b3 = gen.export_kv_rows(aux, 0, 3)
+        b8 = gen.export_kv_rows(aux, 0, 8)
+        assert kv_blob_nbytes(b3) == kv_blob_nbytes(b8)
+        for blob in (b3, b8):
+            st = blob["rows"]["layer0_ssm_state"]
+            assert st.shape == (H, DIM // H, DIM // H)
+            assert st.dtype == np.float32
+
+
+@pytest.mark.slow
+def test_ssm_stack_learns_the_arithmetic_corpus():
+    """Convergence gate (the transformer gates' corpus): a pure-SSM
+    stack drives next-token NLL toward zero — the chunked scan is a
+    trainable block, not just a parity artifact."""
+    from tests._lm_utils import arith_corpus, lm_nll
+    Tn = 12
+    toks, labels = arith_corpus(8, Tn, V)
+    sym = transformer.get_symbol(V, Tn, num_layers=2, num_heads=H,
+                                 dim=DIM, max_len=ML,
+                                 block_type="ssm")
+    step = make_train_step(sym, optimizer="adam",
+                           optimizer_params={"learning_rate": 3e-3})
+    mx.random.seed(0)
+    state = step.init_state(Xavier(), {"data": (8, Tn),
+                                       "softmax_label": (8, Tn)})
+    bv = step.place_batch({"data": toks, "softmax_label": labels})
+    rng = jax.random.PRNGKey(0)
+    nll0 = None
+    for i in range(60):
+        state, outs = step(state, bv, 3e-3, rng)
+        if nll0 is None:
+            nll0 = lm_nll(outs, labels, V)
+    nll = lm_nll(outs, labels, V)
+    assert nll < 0.2 < nll0

@@ -297,7 +297,7 @@ def _scn_router():
                                 telemetry.now_ms() - t0, 3))
 
 
-def _decode_workload(quantize_kv):
+def _decode_workload(quantize_kv, block_type="attention"):
     """Shared body of the decode scenarios: sequential ragged
     requests through a 3-slot pool so admissions/steps/finishes are
     exact and every admission is a slot turnover (the jit-cache gauge
@@ -314,13 +314,15 @@ def _decode_workload(quantize_kv):
     V, L, H, DIM, T = 50, 2, 2, 32, 24
     sym = transformer.get_symbol(V, 12, num_layers=L, num_heads=H,
                                  dim=DIM, max_len=T,
-                                 pos_encoding="learned")
+                                 pos_encoding="learned",
+                                 block_type=block_type)
     step = make_train_step(sym, optimizer="sgd")
     mx.random.seed(0)
     state = step.init_state(Xavier(), {"data": (2, 12),
                                        "softmax_label": (2, 12)})
     gen = Generator(state[0], V, T, num_layers=L, num_heads=H,
-                    dim=DIM, batch_size=3, quantize_kv=quantize_kv)
+                    dim=DIM, batch_size=3, quantize_kv=quantize_kv,
+                    block_type=block_type)
     with gen.serving_decoder() as dec:
         for length, max_new in ((4, 5), (6, 3), (3, 4)):
             dec.submit(np.arange(length), max_new,
@@ -502,6 +504,15 @@ def _scn_decode_q8():
     the per-row q8 op must keep jit cache size 1 across slot
     turnover and publish the (halved) kv_bytes_per_slot gauge."""
     _decode_workload(quantize_kv=True)
+
+
+def _scn_decode_ssm():
+    """ISSUE 19 surface: the SAME ragged workload on an O(1)-state
+    SSM generator — slot turnover over constant (H, hd, hd) state
+    blobs must keep jit cache size 1 (the recurrence needs no per-row
+    twin at all) and publish a kv_bytes_per_slot gauge that never
+    mentions max_len."""
+    _decode_workload(quantize_kv=False, block_type="ssm")
 
 
 def _scn_streaming():
@@ -707,6 +718,14 @@ SCENARIOS = {
                    "serve.decode.kv_bytes_per_slot"),
         "noisy_counters": (), "noisy_events": (),
     },
+    "decode_ssm": {
+        "fn": _scn_decode_ssm,
+        "desc": "ContinuousDecoder ragged requests, O(1) SSM state "
+                "blobs (block_type='ssm')",
+        "gauges": ("serve.decode.jit_cache_size",
+                   "serve.decode.kv_bytes_per_slot"),
+        "noisy_counters": (), "noisy_events": (),
+    },
     "disagg": {
         "fn": _scn_disagg,
         "desc": "prefill/decode disaggregation: role-aware router, "
@@ -787,9 +806,10 @@ _PROPERTY_NOTES = (
      "PR 18 speculative serving: draft-step/proposal/draft-prefill "
      "counters are exact for a deterministic request sequence"),
     ("counts.gauges.serve.decode.kv_bytes_per_slot",
-     "PR 13 decode HBM diet: cache bytes per slot follow from the "
+     "PR 13/19 decode HBM diet: state bytes per slot follow from the "
      "cache pytree's shapes/dtypes alone — a drift means the int8 "
-     "rows or per-token scale caches changed layout"),
+     "rows, per-token scale caches, or O(1) SSM state blobs changed "
+     "layout"),
     ("counts.compile",
      "compile discipline: XLA compiles happen exactly where the "
      "baseline says (first step / per jit variant); extra compile "

@@ -365,8 +365,9 @@ def format_report(summary):
         if serving.get("kv_bytes_per_slot"):
             kvb = serving["kv_bytes_per_slot"]
             lines.append(
-                "  decode KV cache: %d bytes/slot (%.2f MiB — int8 "
-                "quantize_kv halves this; see docs/serving.md)"
+                "  decode state: %d bytes/slot (%.2f MiB — int8 "
+                "quantize_kv halves KV rows; block_type='ssm' makes "
+                "it O(1) in max_len; see docs/serving.md)"
                 % (kvb, kvb / 2.0 ** 20))
         for key, label in (("shed_events", "shed"),
                            ("timeout_events", "timed out"),

@@ -123,6 +123,11 @@ cap "$OUT/decode_int8kv8.json" decode_int8kv8 \
 # (benchmark/bench_decode.py; the per-row q8 path, slot turnover on)
 cap "$OUT/decode_kv_ab.json" decode_kv_ab \
     python benchmark/bench_decode.py
+# O(1)-state decode A/B: f32 attention vs block_type="ssm" at long
+# context — bytes/slot constant in max_len, slots-in-budget ratio,
+# handoff bytes constant in prompt length (ISSUE 19)
+cap "$OUT/decode_ssm_ab.json" decode_ssm_ab \
+    env BENCH_DECODE_MODE=ssm python benchmark/bench_decode.py
 
 echo "== 3c. long-context sweep (batch 1) =="
 LCTX="$OUT/longcontext.jsonl.new"; : > "$LCTX"
