@@ -657,6 +657,93 @@ def _run_fleet(args, levels):
     return sweep, fleet_stats
 
 
+def _run_controller(args, conc):
+    """The --controller load-doubling autoscale bench
+    (docs/serving.md §fleet controller): a 2-replica subprocess fleet
+    under a baseline closed-loop load, then DOUBLED clients — the
+    FleetController's background ticks must scale out mid-window on
+    the sustained queue-depth signal — then the same doubled load
+    against the grown fleet. Acceptance: at least one scale-out, zero
+    request errors in every window (nothing dropped while capacity
+    changed under load), and the tail recovered — window-3 p99 below
+    the pressure window's."""
+    from mxnet_tpu import telemetry
+    from mxnet_tpu.serve import FleetController, ServeRouter
+
+    procs, addrs = _spawn_fleet(args, 2)
+    by_addr = {"%s:%d" % a: p for p, a in zip(procs, addrs)}
+    router, ctrl = None, None
+
+    def spawn(manifest=None):
+        new_procs, new_addrs = _spawn_fleet(args, 1)
+        procs.extend(new_procs)
+        by_addr["%s:%d" % new_addrs[0]] = new_procs[0]
+        return new_addrs[0]
+
+    def retire(name, addr):
+        proc = by_addr.pop(addr, None)
+        if proc is not None:
+            try:
+                proc.stdin.close()        # EOF = drain + exit
+            except OSError:
+                pass
+
+    x = np.random.RandomState(0).standard_normal(
+        (1, args.features)).astype(np.float32)
+    try:
+        router = ServeRouter(replicas=addrs,
+                             conns_per_replica=2 * conc + 2)
+        router.warmup()                   # no cold compiles in window 1
+        # sustain 5 ticks @100ms: the doubled load must hold the
+        # depth signal for half a second before capacity moves — the
+        # inter-window idle gap is far shorter, so the controller
+        # never flaps between measurement windows. The depth band
+        # (in 1.0 / out 5.0) sits between the baseline's steady
+        # per-replica queue (~conc/replicas - 1 in service) and the
+        # doubled load's, so only window 2 crosses it.
+        ctrl = FleetController(router, spawn, retire=retire,
+                               min_replicas=2, max_replicas=4,
+                               scale_out_depth=5.0,
+                               scale_in_depth=1.0,
+                               sustain=5, poll_ms=100.0)
+
+        def rt():
+            return router.request([x])
+        baseline = _closed_loop(rt, conc, args.requests)
+        replicas_base = len(router.replicas())
+        pressure = _closed_loop(rt, 2 * conc, args.requests)
+        replicas_pressure = len(router.replicas())
+        recovered = _closed_loop(rt, 2 * conc, args.requests)
+        scale_outs = int(telemetry.counter(
+            "serve.ctrl.scale_outs").value)
+        fleet = router.stats()
+    finally:
+        if ctrl is not None:
+            ctrl.close()
+        if router is not None:
+            router.close()
+        _kill_fleet(procs)
+    errors = (baseline["errors"] + pressure["errors"]
+              + recovered["errors"])
+    p99_p = (pressure["latency_ms"] or {}).get("p99")
+    p99_r = (recovered["latency_ms"] or {}).get("p99")
+    return {
+        "baseline": baseline,
+        "pressure": pressure,
+        "recovered": recovered,
+        "replicas_baseline": replicas_base,
+        "replicas_pressure": replicas_pressure,
+        "replicas_final": fleet.get("replicas"),
+        "scale_outs": scale_outs,
+        "errors": errors,
+        "p99_recovery_ratio": round(p99_r / p99_p, 4)
+        if p99_r and p99_p else None,
+        "ok": bool(scale_outs >= 1 and errors == 0
+                   and p99_r is not None and p99_p is not None
+                   and p99_r < p99_p),
+    }
+
+
 def _run_level(pred, feat, buckets, wait_ms, conc, requests):
     """One closed-loop level: conc clients x requests round trips
     against a FRESH engine (clean per-level stats). Returns the sweep
@@ -975,6 +1062,15 @@ def main(argv=None):
                                               "2")),
                    help="disagg mode: concurrent long-prompt "
                         "generate load threads")
+    p.add_argument("--controller", action="store_true",
+                   help="load-doubling autoscale bench: 2 subprocess "
+                        "replicas under a FleetController, baseline "
+                        "load then doubled clients (the controller "
+                        "must scale out mid-window) then the doubled "
+                        "load against the grown fleet (docs/"
+                        "serving.md §fleet controller); acceptance "
+                        "is >= 1 scale-out, zero errors, recovered "
+                        "p99 < pressure p99")
     p.add_argument("--streaming", action="store_true",
                    help="streaming A/B pair: streamed-vs-one-shot "
                         "TTFT and chunked-vs-monolithic prefill "
@@ -1043,9 +1139,18 @@ def main(argv=None):
     if args.streaming and \
             args.long_prompt + max(args.max_new, 32) > args.lm_max_len:
         p.error("--long-prompt + max_new exceeds --lm-max-len")
+    if args.controller and args.buckets is None:
+        # the autoscale signal is QUEUE DEPTH: unit buckets keep the
+        # replicas from absorbing the doubled load by coalescing
+        # (which would flatten the depth signal the bench exists to
+        # drive over the policy threshold)
+        args.buckets = "1"
     if args.work_ms is None:
-        args.work_ms = 5.0 if (args.replicas or args.serve_replica) \
-            else 0.0
+        if args.controller:
+            args.work_ms = 20.0
+        else:
+            args.work_ms = 5.0 if (args.replicas or args.serve_replica) \
+                else 0.0
 
     if args.disagg:
         metric, unit = "serve_disagg_p99", "ms/token"
@@ -1053,6 +1158,8 @@ def main(argv=None):
         metric, unit = "serve_spec_decode", "ms/token"
     elif args.streaming:
         metric, unit = "serve_streaming_ttft", "ms"
+    elif args.controller:
+        metric, unit = "serve_controller_scale", "ms"
     elif args.replicas:
         metric, unit = "serve_fleet_throughput", "req/s"
     else:
@@ -1069,6 +1176,33 @@ def main(argv=None):
         if args.role in ("prefill", "decode"):
             return _gen_replica_child(args)
         return _replica_child(args)
+    if args.controller:
+        conc = int(args.concurrency.replace(",", " ").split()[0]) \
+            if args.concurrency else 8
+        try:
+            row = _run_controller(args, conc)
+        except Exception as e:  # noqa: BLE001 — diagnostic line (the
+            # bench_common fail_payload contract, like the sweeps)
+            try:
+                from bench_common import fail_payload
+                payload = fail_payload(metric, unit, e)
+            except ImportError:
+                payload = {"metric": metric, "value": None,
+                           "unit": unit, "vs_baseline": None,
+                           "live": False, "error": "%s: %s"
+                           % (type(e).__name__, e)}
+            print(json.dumps(payload))
+            sys.exit(1)
+        print(json.dumps({
+            "metric": metric,
+            "value": (row["recovered"]["latency_ms"] or {}).get("p99"),
+            "unit": unit,
+            # acceptance shape: recovered p99 < pressure p99 at the
+            # same doubled load (lower is better), zero errors, and
+            # at least one controller scale-out mid-run
+            "vs_baseline": row["p99_recovery_ratio"],
+            **row}))
+        return 0
     if args.speculative:
         try:
             row = _run_speculative(args)

@@ -285,6 +285,55 @@ define("MXNET_SPEC_DRAFT", str, "",
        "turn it on through the environment. Empty = no draft. "
        "Validated loudly at decoder construction; docs/serving.md "
        "§speculative")
+define("MXNET_CTRL_MIN_REPLICAS", int, 1,
+       "fleet controller floor: scale-in never takes the fleet below "
+       "this many live replicas (and the controller refuses to retire "
+       "the last live replica regardless). Must be >= 1 — validated "
+       "loudly at controller construction")
+define("MXNET_CTRL_MAX_REPLICAS", int, 8,
+       "fleet controller ceiling: scale-out never spawns past this "
+       "many live replicas, however hard the load signal pushes. Must "
+       "be >= MXNET_CTRL_MIN_REPLICAS — validated loudly at "
+       "controller construction")
+define("MXNET_CTRL_SCALE_OUT_DEPTH", float, 4.0,
+       "fleet controller scale-out trigger: mean polled queue depth "
+       "per live replica at or above this for MXNET_CTRL_SUSTAIN "
+       "consecutive ticks requests one spawn (shed_rate crossing "
+       "MXNET_CTRL_SCALE_OUT_SHED is the OR'd second trigger)")
+define("MXNET_CTRL_SCALE_OUT_SHED", float, 1.0,
+       "fleet controller scale-out trigger on backpressure: fleet-wide "
+       "shed_rate (requests shed per poll window, summed over "
+       "replicas) at or above this for MXNET_CTRL_SUSTAIN consecutive "
+       "ticks requests one spawn — sheds mean admission is already "
+       "failing, so this fires even while queues look shallow")
+define("MXNET_CTRL_SCALE_IN_DEPTH", float, 0.5,
+       "fleet controller scale-in trigger: mean polled queue depth "
+       "per live replica at or below this AND a zero-shed window for "
+       "MXNET_CTRL_SUSTAIN consecutive ticks retires one replica "
+       "through the zero-drop drain path (never below "
+       "MXNET_CTRL_MIN_REPLICAS)")
+define("MXNET_CTRL_SUSTAIN", int, 3,
+       "fleet controller hysteresis: consecutive ticks a scale signal "
+       "must hold before the controller acts — a one-tick spike (or "
+       "an oscillating signal that keeps resetting the streak) never "
+       "moves the fleet. Must be >= 1 — validated loudly at "
+       "controller construction")
+define("MXNET_CTRL_COOLDOWN", int, 5,
+       "fleet controller cooldown: ticks after any scale action "
+       "during which further scaling is suppressed, so the fleet "
+       "observes the new capacity before deciding again (healing is "
+       "exempt — a dead replica is replaced immediately)")
+define("MXNET_CTRL_CANARY_TIMEOUT", float, 30.0,
+       "fleet controller rollout health gate: seconds a freshly "
+       "promoted replica has to answer the canary infer before the "
+       "gate fails and the rollout rolls back. Must be positive and "
+       "finite — validated loudly at controller construction")
+define("MXNET_CTRL_POLL_MS", float, 500.0,
+       "fleet controller tick period: how often the background "
+       "supervision loop polls the router and evaluates the capacity "
+       "policy. 0 disables the background loop — deterministic tests "
+       "drive controller.tick() explicitly (the poll_now() "
+       "discipline)")
 define("MXNET_STREAM_IDLE_TIMEOUT", float, 30.0,
        "streamed-generate per-frame idle timeout (seconds): a "
        "streaming client (ServeClient.generate(on_token=) and every "

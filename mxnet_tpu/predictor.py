@@ -115,7 +115,7 @@ class Predictor:
         return prefix + ".stablehlo"
 
     def export_buckets(self, prefix, feature_shapes, buckets=None,
-                       dtype="float32"):
+                       dtype="float32", model_id=None):
         """Serve-ready AOT export: one StableHLO artifact per batch
         bucket (``prefix.b<K>.stablehlo``) plus a ``prefix.serve.json``
         manifest, so :meth:`~mxnet_tpu.serve.ServeEngine.from_export`
@@ -124,7 +124,15 @@ class Predictor:
 
         feature_shapes: one per-input shape WITHOUT the batch axis, in
         ``data_names`` order. buckets: ascending batch sizes (default
-        ``MXNET_SERVE_BUCKETS``). Returns the manifest path."""
+        ``MXNET_SERVE_BUCKETS``). model_id: generation stamp written
+        into the manifest — replicas serving the artifact report it in
+        their ``hello`` frame, so a fleet controller can tell a
+        half-promoted fleet from a uniform one. Default: a
+        content-derived ``gen-<hash12>`` over the bucket artifacts, so
+        re-exporting identical weights yields the same stamp. Returns
+        the manifest path."""
+        import hashlib
+
         from . import config as _config
         if buckets is None:
             from .serve.engine import _parse_buckets
@@ -135,16 +143,22 @@ class Predictor:
             raise ValueError(
                 "feature_shapes must have one entry per data input %r"
                 % (self._data_names,))
+        digest = hashlib.sha256()
         for b in buckets:
-            self.export("%s.b%d" % (prefix, b),
-                        {n: (b,) + s for n, s in
-                         zip(self._data_names, feats)}, dtype=dtype)
+            path = self.export("%s.b%d" % (prefix, b),
+                               {n: (b,) + s for n, s in
+                                zip(self._data_names, feats)}, dtype=dtype)
+            with open(path, "rb") as f:
+                digest.update(f.read())
+        if model_id is None:
+            model_id = "gen-" + digest.hexdigest()[:12]
         manifest = prefix + ".serve.json"
         with open(manifest, "w") as f:
             json.dump({"buckets": buckets,
                        "data_names": self._data_names,
                        "feature_shapes": [list(s) for s in feats],
-                       "dtype": dtype}, f)
+                       "dtype": dtype,
+                       "model_id": str(model_id)}, f)
         return manifest
 
 

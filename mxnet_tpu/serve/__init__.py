@@ -24,11 +24,16 @@ Three layers, each usable alone:
   with ``{first_token, kv_blob, pos}``; the router fans generate
   requests prefill-replica → decode-replica with the KV blob shipped
   in the admit (``ContinuousDecoder.submit(handoff=...)``).
+* :class:`FleetController` (``controller.py``) — the fleet operates
+  itself: health-gated autoscaling against a declared capacity
+  policy, self-healing of probe-confirmed-dead replicas, and rolling
+  model rollout with automatic rollback, all journaled crash-safe.
 
 Raw ``socket`` use is confined to ``net.py`` by the
 ``tools/serve_smoke.sh`` lint (router.py included) — everything else
 in this package is transport-free by construction.
 """
+from .controller import FleetController, RolloutResult
 from .decode import ContinuousDecoder, DecodeFuture
 from .engine import (EngineClosed, Overloaded, RequestTimeout,
                      ServeEngine, ServeError, ServeFuture,
@@ -41,4 +46,4 @@ __all__ = ["ServeEngine", "ServeFuture", "ServeError", "Overloaded",
            "RequestTimeout", "EngineClosed", "SessionEvacuated",
            "ContinuousDecoder", "DecodeFuture", "PrefillEngine",
            "ServeClient", "ServeServer", "ServeRouter",
-           "ReplicaState"]
+           "ReplicaState", "FleetController", "RolloutResult"]

@@ -473,6 +473,7 @@ class ContinuousDecoder:
 
         self._admitted = 0
         self._finished = 0
+        self._shed = 0
         self._steps = 0
         self._prefills = 0
         self._imported = 0
@@ -942,6 +943,7 @@ class ContinuousDecoder:
                 raise EngineClosed(
                     "decoder is draining — sequence rejected")
             if len(self._queue) >= self._cap:
+                self._shed += 1
                 _telemetry.counter("serve.shed").inc()
                 raise Overloaded(
                     "decode queue full (%d sequences)"
@@ -1731,6 +1733,7 @@ class ContinuousDecoder:
 
     def stats(self):
         return {"admitted": self._admitted, "finished": self._finished,
+                "shed": self._shed,
                 "steps": self._steps, "prefills": self._prefills,
                 "imported": self._imported, "resumed": self._resumed,
                 "evacuated": self._evacuated,

@@ -201,6 +201,12 @@ class ServeEngine:
     # else, this engine included, serves the colocated paths)
     role = "batch"
 
+    # generation stamp of the served artifact: set by from_export from
+    # the export_buckets manifest, None for in-process models. Rides
+    # the hello frame so a fleet controller (and `describe()`) can tell
+    # a half-promoted fleet from a uniform one.
+    model_id = None
+
     def __init__(self, model, buckets=None, max_wait_ms=None,
                  queue_cap=None, deadline_ms=None, feature_shapes=None,
                  dtype="float32", install_sigterm=True, logger=None):
@@ -598,6 +604,7 @@ class ServeEngine:
         out["draining"] = self.draining
         out["buckets"] = list(self._buckets)
         out["warmed"] = self.warmed_buckets
+        out["model_id"] = self.model_id
         return out
 
     # -- AOT deploy chain ---------------------------------------------------
@@ -618,4 +625,6 @@ class ServeEngine:
                           [tuple(s) for s in
                            manifest["feature_shapes"]])
         kwargs.setdefault("dtype", manifest.get("dtype", "float32"))
-        return cls(models, **kwargs)
+        engine = cls(models, **kwargs)
+        engine.model_id = manifest.get("model_id")
+        return engine

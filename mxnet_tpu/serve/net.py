@@ -167,9 +167,14 @@ class ServeServer:
             # instead of carrying them in its own config. Answered
             # from live engine state, never cached.
             try:
+                # model_id: generation stamp of the served artifact
+                # (export_buckets manifest), None for in-process
+                # models. Optional on the wire — old peers that never
+                # send/read it keep working (duck-typed frames).
                 return ("ok", {
                     "role": getattr(self._engine, "role",
                                     type(self._engine).__name__),
+                    "model_id": getattr(self._engine, "model_id", None),
                     "engine": self._engine_state()})
             except Exception as exc:      # noqa: BLE001 — reply = report
                 return ("err", "ServeError",

@@ -129,7 +129,8 @@ class _Replica:
 
     __slots__ = ("name", "host", "port", "index", "state", "control",
                  "idle", "inflight", "dispatched", "rerouted_from",
-                 "faults", "stats", "declared", "role", "recycles")
+                 "faults", "stats", "declared", "role", "recycles",
+                 "model_id", "window")
 
     def __init__(self, name, host, port, index):
         self.name = name
@@ -147,10 +148,14 @@ class _Replica:
         self.declared = {}               # hello() engine state
         self.role = None                 # hello-declared replica role
         self.recycles = 0
+        self.model_id = None             # hello-declared artifact stamp
+        self.window = {}                 # prev cumulative counters, for
+        #                                  the per-poll-window rates
 
     def describe(self):
         return {"host": self.host, "port": self.port,
                 "state": self.state, "role": self.role,
+                "model_id": self.model_id,
                 "in_flight": self.inflight,
                 "dispatched": self.dispatched,
                 "rerouted_from": self.rerouted_from,
@@ -306,10 +311,14 @@ class ServeRouter:
             self._poll_thread.start()
 
     # -- fleet membership ---------------------------------------------------
-    def add_replica(self, host, port, name=None):
+    def add_replica(self, host, port, name=None, warm=False):
         """Register a replica, hello it (learning its declared buckets
         and engine identity), take a first stats poll, and admit it to
-        dispatch. Returns the replica's name."""
+        dispatch. ``warm=True`` pre-compiles the declared buckets over
+        the wire BEFORE the replica becomes routable (it registers
+        draining, warms, then flips live) — a freshly spawned replica
+        never pays a cold XLA compile on a live request (the fleet
+        controller's scale-out path). Returns the replica's name."""
         with self._lock:
             if self._closed:
                 raise EngineClosed("router is closed")
@@ -319,33 +328,52 @@ class ServeRouter:
             if name in self._replicas:
                 raise ValueError("duplicate replica name %r" % name)
             rep = _Replica(name, host, port, index)
+            if warm:
+                # warm-before-admit: not routable until the buckets
+                # are compiled (dispatch skips DRAINING)
+                rep.state = ReplicaState.DRAINING
             rep.control = self._make_client(rep, control=True)
             self._replicas[name] = rep
+
+        def unwind():
+            with self._lock:
+                self._replicas.pop(name, None)
+            rep.control.close()
         try:
             rep.declared = rep.control.hello()
         except ServeError:
             # a replica that answers but errors is misconfigured —
             # surface it, and do NOT leave the half-registered entry
             # routable (or its control socket open)
-            with self._lock:
-                self._replicas.pop(name, None)
-            rep.control.close()
+            unwind()
             raise
         except Exception as exc:         # noqa: BLE001 — classified:
             # transport-unreachable at registration is the operator's
             # problem to know about NOW, not at first dispatch
-            with self._lock:
-                self._replicas.pop(name, None)
-            rep.control.close()
+            unwind()
             raise ConnectionError(
                 "replica %s at %s:%d unreachable at registration: %s"
                 % (name, host, port, exc)) from exc
         rep.role = (rep.declared or {}).get("role")
+        rep.model_id = (rep.declared or {}).get("model_id")
+        if warm:
+            try:
+                self._warm_replica(rep)   # ServeError declines logged
+            except Exception as exc:      # noqa: BLE001 — transport
+                # mid-warm: same contract as an unreachable hello —
+                # the caller never gets a half-admitted replica
+                unwind()
+                raise ConnectionError(
+                    "replica %s at %s:%d died during pre-admission "
+                    "warm: %s" % (name, host, int(port), exc)) from exc
+            with self._lock:
+                rep.state = ReplicaState.LIVE
         self._poll_replica(rep)
         self._update_gauges()
         _telemetry.journal_event(
             "serve.router.add_replica", name=name,
-            addr="%s:%d" % (host, int(port)), role=rep.role)
+            addr="%s:%d" % (host, int(port)), role=rep.role,
+            warmed=bool(warm))
         return name
 
     def remove_replica(self, name):
@@ -422,11 +450,26 @@ class ServeRouter:
             out["decode_free_slots"] = int(eng["decode_free_slots"])
         if eng.get("shed") is not None:
             out["shed"] = int(eng["shed"])
+        if eng.get("admitted") is not None:
+            out["admitted"] = int(eng["admitted"])
         return out
+
+    # the windowed-rate signals: which cumulative counter feeds which
+    # per-poll-window rate (delta since the previous successful poll —
+    # the fleet controller's scale signals, rendered by
+    # tools/telemetry_report.py --stats for humans)
+    _RATES = (("shed", "shed_rate"), ("admitted", "req_rate"))
 
     def _poll_replica(self, rep):
         """One stats round trip; success refreshes the cached load
-        signals and revives a suspect, failure marks suspect."""
+        signals and revives a suspect, failure marks suspect. Besides
+        the raw extract, each poll derives the per-window rates
+        (``shed_rate``/``req_rate``): the delta of the replica's
+        cumulative counter since the previous successful poll. A
+        counter that went BACKWARDS means the replica restarted — the
+        window restarts with it (rate = counts since the restart),
+        never a negative rate. The first poll of a replica's life
+        reports 0 (no window exists yet)."""
         try:
             reply = rep.control.stats()
         except Exception as exc:          # noqa: BLE001 — any failure
@@ -434,7 +477,18 @@ class ServeRouter:
             self._mark_suspect(rep, exc)
             return False
         with self._lock:
-            rep.stats = self._extract(reply)
+            st = self._extract(reply)
+            for cum, rate in self._RATES:
+                new = st.get(cum)
+                if new is None:
+                    continue
+                prev = rep.window.get(cum)
+                if prev is None:
+                    st[rate] = 0
+                else:
+                    st[rate] = new - prev if new >= prev else new
+                rep.window[cum] = new
+            rep.stats = st
         if rep.state == ReplicaState.SUSPECT:
             self._revive(rep)
         return True
@@ -486,6 +540,42 @@ class ServeRouter:
             return bool(rep.control.ping())
         except Exception:  # noqa: BLE001 — unreachable = not alive
             return False
+
+    def probe_replica(self, name):
+        """The liveness probe by name — the failover discriminator
+        (:meth:`_probe`), exposed for the fleet controller's heal
+        decision: True iff the replica's process answers a control
+        ping right now."""
+        with self._lock:
+            rep = self._replicas.get(name)
+        if rep is None:
+            raise KeyError("no replica %r" % name)
+        return self._probe(rep)
+
+    def canary(self, name, inputs, timeout=None):
+        """One infer pinned to the NAMED replica — no load balancing,
+        no reroute, no retry: the fleet controller's rollout health
+        gate (a freshly promoted replica must answer this within its
+        deadline or the rollout rolls back). Uses a dedicated one-shot
+        client so ``timeout`` bounds the whole round trip; typed
+        replica errors and transport faults both propagate to the
+        caller — every failure mode IS the gate's signal. Not counted
+        as a dispatch (it is control-plane traffic, like warm)."""
+        with self._lock:
+            rep = self._replicas.get(name)
+        if rep is None:
+            raise KeyError("no replica %r" % name)
+        arrays = [np.asarray(a) for a in inputs]
+        client = ServeClient(
+            rep.host, rep.port,
+            retry=RetryPolicy(max_retries=0,
+                              seed="router:%s:canary" % rep.name),
+            timeout=float(timeout) if timeout else self._io_timeout,
+            fault_points="router%d_ctl" % rep.index, logger=self._log)
+        try:
+            return client.request(arrays)
+        finally:
+            client.close()
 
     def _mark_suspect(self, rep, exc):
         with self._lock:
@@ -1172,7 +1262,8 @@ class ServeRouter:
             return dict(self._sessions)
 
     # -- rolling restart ----------------------------------------------------
-    def recycle(self, name, restart=None, warm=True, timeout=None):
+    def recycle(self, name, restart=None, warm=True, timeout=None,
+                admit=True):
         """Zero-drop rolling restart of one replica.
 
         1. stop routing new work to it (state -> draining; dispatch
@@ -1196,7 +1287,14 @@ class ServeRouter:
         4. re-warm the declared buckets over the wire (``warm``
            frame) so the readmitted replica never pays a cold
            compile on a live request;
-        5. readmit (state -> live) and refresh its stats.
+        5. readmit (state -> live) and refresh its stats — unless
+           ``admit=False``, which leaves the restarted replica
+           QUARANTINED (state stays draining, dispatch never routes
+           to it) until :meth:`admit_replica`. That is the rollout
+           gate's seam: the fleet controller recycles a replica onto
+           a candidate artifact, canaries it directly while zero
+           live traffic can reach it, and only admits on a passed
+           gate.
 
         Raises ValueError when no OTHER live replica exists (a
         one-replica fleet cannot recycle without dropping requests)
@@ -1242,8 +1340,135 @@ class ServeRouter:
             cl.close()
         self._update_gauges()
         t0 = _telemetry.now_ms()
-        _telemetry.journal_event("serve.router.recycle",
-                                 name=name, phase="drain")
+        drained_ms = self._drain_replica(rep, deadline, budget,
+                                         event="serve.router.recycle")
+        try:
+            if restart is not None:
+                rep.control.close()
+                addr = restart()
+                if addr is not None:
+                    rep.host, rep.port = _parse_addr(addr)
+                rep.control = self._make_client(rep, control=True)
+                # the bind window of a REAL process restart (fresh
+                # interpreter, XLA import, bind) is seconds, far past
+                # the control client's own ~30 ms retry budget — keep
+                # knocking until the recycle's remaining drain budget
+                # runs out
+                while True:
+                    try:
+                        rep.declared = rep.control.hello()
+                        rep.role = (rep.declared or {}).get("role")
+                        rep.model_id = (rep.declared or {}) \
+                            .get("model_id")
+                        break
+                    except ServeError:
+                        raise             # it answered: misconfigured
+                    except Exception:     # noqa: BLE001 — transport;
+                        if time.monotonic() >= deadline:
+                            raise         # outer fail-open -> SUSPECT
+                        time.sleep(0.05)
+            if warm:
+                self._warm_replica(rep)
+        except Exception as exc:          # noqa: BLE001 — fail OPEN:
+            # a botched restart/hello must not strand the replica in
+            # DRAINING (a permanently shrunk fleet); park it SUSPECT
+            # so the poller readmits it the moment it answers stats
+            with self._lock:
+                rep.state = ReplicaState.SUSPECT
+            self._update_gauges()
+            _telemetry.journal_event("serve.router.recycle",
+                                     name=name, phase="failed",
+                                     error=type(exc).__name__)
+            raise
+        self._poll_replica(rep)
+        with self._lock:
+            if admit:
+                rep.state = ReplicaState.LIVE
+                # the observed-draining flag must not outlive the
+                # recycle: if the final poll blipped, a stale True
+                # here would keep dispatch skipping a replica the
+                # gauge counts as live (and a poll_now-driven
+                # deployment would never clear it)
+                rep.stats.pop("draining", None)
+            rep.recycles += 1
+        self._c_recycles.inc()
+        self._update_gauges()
+        _telemetry.journal_event(
+            "serve.router.recycle", name=name,
+            phase="readmit" if admit else "quarantined",
+            drained_ms=round(drained_ms, 3),
+            total_ms=round(_telemetry.now_ms() - t0, 3))
+
+    def admit_replica(self, name):
+        """Admit a quarantined replica (``recycle(admit=False)``) to
+        traffic: state -> live, routable from this instant. Idempotent
+        on an already-live replica; KeyError on an unknown one."""
+        with self._lock:
+            rep = self._replicas.get(name)
+            if rep is None:
+                raise KeyError("no replica %r" % name)
+            rep.state = ReplicaState.LIVE
+            rep.stats.pop("draining", None)
+        self._update_gauges()
+        _telemetry.journal_event("serve.router.admit", name=name)
+
+    def retire_replica(self, name, timeout=None):
+        """Zero-drop scale-in: stop routing to the replica, drain it
+        exactly like :meth:`recycle` (decode-role replicas evacuate
+        their active sessions onto survivors first), then REMOVE it
+        from the fleet. The replica process itself is not stopped —
+        its lifecycle belongs to whoever started it (the fleet
+        controller's ``retire`` hook reaps it after this returns).
+        Refuses to retire the last live replica; a drain past the
+        budget raises TimeoutError with the replica failed OPEN
+        (routable again — nothing dropped, nothing removed)."""
+        with self._lock:
+            rep = self._replicas.get(name)
+            if rep is None:
+                raise KeyError("no replica %r" % name)
+            if timeout is not None:
+                budget = float(timeout)
+            elif rep.role == "decode":
+                budget = _decode_drain_timeout()
+            else:
+                budget = self._drain_timeout
+            deadline = time.monotonic() + budget
+            if not any(r.state == ReplicaState.LIVE
+                       and r.name != name
+                       for r in self._replicas.values()):
+                raise ValueError(
+                    "retiring %r would leave no live replica — the "
+                    "fleet floor is one" % name)
+            rep.state = ReplicaState.DRAINING
+            for sid in [s for s, n in self._sessions.items()
+                        if n == name]:
+                self._sessions.pop(sid, None)   # pins re-place fresh
+            idle = list(rep.idle)
+            rep.idle.clear()
+        for cl in idle:
+            cl.close()
+        self._update_gauges()
+        drained_ms = self._drain_replica(rep, deadline, budget,
+                                         event="serve.router.retire")
+        self.remove_replica(name)
+        _telemetry.journal_event("serve.router.retire", name=name,
+                                 phase="removed",
+                                 drained_ms=round(drained_ms, 3))
+
+    def _drain_replica(self, rep, deadline, budget, event):
+        """THE zero-drop drain body recycle() and retire_replica()
+        share: evacuate a decode replica's active sessions, wait for
+        the router's own in-flight count (condition-signaled, exact),
+        then for the replica's stats-observed engine in-flight/queue
+        depth (covers other frontends). The replica must already be
+        DRAINING. A budget overrun raises TimeoutError with the
+        replica failed OPEN (SUSPECT for decode roles — wedged
+        sequences make it suspect by definition; LIVE otherwise) so
+        it is never stranded unroutable. Returns the drain wall time
+        in ms."""
+        name = rep.name
+        t0 = _telemetry.now_ms()
+        _telemetry.journal_event(event, name=name, phase="drain")
         if rep.role == "decode":
             # migrating recycle: evacuate active sessions FIRST —
             # each in-flight generate on this replica answers with
@@ -1258,7 +1483,7 @@ class ServeRouter:
                 evacuated = rep.control.evacuate()
                 self._c_evacuations.inc()
                 _telemetry.journal_event(
-                    "serve.router.recycle", name=name,
+                    event, name=name,
                     phase="evacuate", sessions=int(evacuated or 0))
             except ServeError as exc:
                 self._log.warning(
@@ -1319,58 +1544,7 @@ class ServeRouter:
                        budget))
             with self._cond:
                 self._cond.wait(0.01)     # remote state: bounded poll
-        drained_ms = _telemetry.now_ms() - t0
-        try:
-            if restart is not None:
-                rep.control.close()
-                addr = restart()
-                if addr is not None:
-                    rep.host, rep.port = _parse_addr(addr)
-                rep.control = self._make_client(rep, control=True)
-                # the bind window of a REAL process restart (fresh
-                # interpreter, XLA import, bind) is seconds, far past
-                # the control client's own ~30 ms retry budget — keep
-                # knocking until the recycle's remaining drain budget
-                # runs out
-                while True:
-                    try:
-                        rep.declared = rep.control.hello()
-                        rep.role = (rep.declared or {}).get("role")
-                        break
-                    except ServeError:
-                        raise             # it answered: misconfigured
-                    except Exception:     # noqa: BLE001 — transport;
-                        if time.monotonic() >= deadline:
-                            raise         # outer fail-open -> SUSPECT
-                        time.sleep(0.05)
-            if warm:
-                self._warm_replica(rep)
-        except Exception as exc:          # noqa: BLE001 — fail OPEN:
-            # a botched restart/hello must not strand the replica in
-            # DRAINING (a permanently shrunk fleet); park it SUSPECT
-            # so the poller readmits it the moment it answers stats
-            with self._lock:
-                rep.state = ReplicaState.SUSPECT
-            self._update_gauges()
-            _telemetry.journal_event("serve.router.recycle",
-                                     name=name, phase="failed",
-                                     error=type(exc).__name__)
-            raise
-        self._poll_replica(rep)
-        with self._lock:
-            rep.state = ReplicaState.LIVE
-            # the observed-draining flag must not outlive the recycle:
-            # if the final poll blipped, a stale True here would keep
-            # dispatch skipping a replica the gauge counts as live
-            # (and a poll_now-driven deployment would never clear it)
-            rep.stats.pop("draining", None)
-            rep.recycles += 1
-        self._c_recycles.inc()
-        self._update_gauges()
-        _telemetry.journal_event(
-            "serve.router.recycle", name=name, phase="readmit",
-            drained_ms=round(drained_ms, 3),
-            total_ms=round(_telemetry.now_ms() - t0, 3))
+        return _telemetry.now_ms() - t0
 
     # -- engine-surface lifecycle / introspection ---------------------------
     def _warm_replica(self, rep):
@@ -1430,6 +1604,12 @@ class ServeRouter:
             "rerouted": sum(r.rerouted_from for r in reps),
             "recycles": sum(r.recycles for r in reps),
             "sessions": sessions,
+            # fleet-wide windowed rates (per poll window, summed over
+            # replicas) — the controller's scale signals, next to the
+            # cumulative counters above
+            "shed_rate": sum(r.stats.get("shed_rate", 0)
+                             for r in reps),
+            "req_rate": sum(r.stats.get("req_rate", 0) for r in reps),
         }
 
     def introspect(self):

@@ -1,0 +1,657 @@
+"""The fleet controller (mxnet_tpu/serve/controller.py): health-gated
+autoscaling, self-healing, rolling rollout with automatic rollback,
+and crash-safe journaled state.
+
+Everything here is deterministic: the controller is built with
+``poll_ms=0`` (no background loop), the router with ``poll_ms=0`` (no
+background poller), and every decision is driven by explicit
+``tick()`` calls — hysteresis and cooldown count TICKS, so there are
+no wall-clock sleeps in this fast tier. Load signals come from
+scripted engine introspection (the same stats frame a real engine
+answers), so a "sustained queue depth" is three scripted polls, not
+three seconds of real queueing.
+"""
+import json
+import threading
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import telemetry
+from mxnet_tpu.initializer import Xavier
+from mxnet_tpu.predictor import Predictor
+from mxnet_tpu.serve import (FleetController, ReplicaState,
+                             ServeEngine, ServeRouter, ServeServer)
+
+pytestmark = pytest.mark.serve
+
+FEAT, CLASSES = 8, 4
+
+
+def _predictor(seed=7):
+    net = mx.sym.Variable("data")
+    net = mx.sym.FullyConnected(net, name="fc1", num_hidden=16)
+    net = mx.sym.Activation(net, act_type="tanh")
+    net = mx.sym.FullyConnected(net, name="fc2", num_hidden=CLASSES)
+    net = mx.sym.SoftmaxOutput(net, name="softmax")
+    arg_shapes, _, _ = net.infer_shape(data=(2, FEAT))
+    mx.random.seed(seed)
+    init = Xavier()
+    args = {}
+    for name, shp in zip(net.list_arguments(), arg_shapes):
+        if name in ("data", "softmax_label"):
+            continue
+        arr = mx.nd.zeros(shp)
+        init(name, arr)
+        args[name] = arr
+    return Predictor(net, args, data_names=("data",))
+
+
+@pytest.fixture(scope="module")
+def pred():
+    return _predictor()
+
+
+class _Broken:
+    """A model whose forward always fails — the canary-failing
+    artifact a rollout gate must refuse."""
+
+    def forward(self, *arrays):
+        raise RuntimeError("deliberately broken artifact")
+
+
+class _Scripted(ServeEngine):
+    """An engine whose stats frame reports SCRIPTED load signals on
+    top of its real state — sustained queue depth and shedding become
+    deterministic poll responses instead of real queues under real
+    sleeps."""
+
+    def __init__(self, *args, **kwargs):
+        super().__init__(*args, **kwargs)
+        self.fake_depth = 0
+        self.fake_shed = None          # scripted cumulative counter
+        self.fake_admitted = None
+
+    def introspect(self):
+        out = super().introspect()
+        out["queue_depth"] += self.fake_depth
+        if self.fake_shed is not None:
+            out["shed"] = self.fake_shed
+        if self.fake_admitted is not None:
+            out["admitted"] = self.fake_admitted
+        return out
+
+
+class _CtrlFleet:
+    """N in-process replicas behind a router, plus the spawn/retire
+    hooks a controller drives — the whole supervised fleet in one
+    process, every wire real."""
+
+    def __init__(self, pred, n, engine_cls=_Scripted, model_id=None,
+                 router_kw=None, **ctrl_kw):
+        self.pred = pred
+        self.engine_cls = engine_cls
+        self.model_id = model_id
+        self.cells = {}               # "host:port" -> (engine, server)
+        self.retired = []             # (name, addr) retire-hook calls
+        self.spawn_log = []           # manifests the spawn hook saw
+        # manifest -> (model factory, stamp); None covers the default
+        self.artifacts = {None: (lambda: self.pred, model_id)}
+        self.router = ServeRouter(poll_ms=0, **(router_kw or {}))
+        names = []
+        for i in range(n):
+            host, port = self._spawn(None)
+            names.append(self.router.add_replica(host, port,
+                                                 name="r%d" % i))
+        self.names = names
+        self.router.poll_now()
+        ctrl_kw.setdefault("poll_ms", 0)
+        self.ctrl = FleetController(self.router, self.spawn,
+                                    retire=self.retire, **ctrl_kw)
+
+    def _spawn(self, manifest):
+        factory, stamp = self.artifacts[manifest]
+        eng = self.engine_cls(factory(), buckets=(1, 2, 4),
+                              max_wait_ms=0.0,
+                              feature_shapes=[(FEAT,)],
+                              install_sigterm=False)
+        if stamp is not None:
+            eng.model_id = stamp
+        srv = ServeServer(eng)
+        addr = (srv.host, srv.port)
+        self.cells["%s:%d" % addr] = (eng, srv)
+        return addr
+
+    def spawn(self, manifest=None):
+        self.spawn_log.append(manifest)
+        return self._spawn(manifest)
+
+    def retire(self, name, addr):
+        self.retired.append((name, addr))
+        cell = self.cells.pop(addr, None)
+        if cell is not None:
+            eng, srv = cell
+            srv.close()
+            eng.close()
+
+    def kill(self, name):
+        """SIGKILL analogue: the replica's server and engine vanish
+        without draining (the router discovers it via transport
+        faults / failed polls)."""
+        desc = self.router.replicas()[name]
+        addr = "%s:%d" % (desc["host"], desc["port"])
+        eng, srv = self.cells.pop(addr)
+        srv.close()
+        eng.close()
+
+    def engines(self):
+        """name -> live engine, via the router's address records."""
+        out = {}
+        for name, desc in self.router.replicas().items():
+            cell = self.cells.get("%s:%d" % (desc["host"],
+                                             desc["port"]))
+            if cell is not None:
+                out[name] = cell[0]
+        return out
+
+    def close(self):
+        self.ctrl.close()
+        self.router.close()
+        for eng, srv in self.cells.values():
+            srv.close()
+            eng.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False
+
+
+def _cval(name):
+    return telemetry.counter(name).value
+
+
+class TestKnobValidation:
+    """The config-validated pattern: every bad policy dies loudly at
+    construction, never as a silent misbehavior mid-supervision."""
+
+    def _ctor(self, **kw):
+        router = ServeRouter(poll_ms=0)
+        try:
+            kw.setdefault("poll_ms", 0)
+            FleetController(router, lambda m=None: ("h", 1), **kw)
+        finally:
+            router.close()
+
+    @pytest.mark.parametrize("kw,needle", [
+        (dict(min_replicas=0), "MIN_REPLICAS"),
+        (dict(min_replicas=3, max_replicas=2), "MAX_REPLICAS"),
+        (dict(sustain=0), "SUSTAIN"),
+        (dict(cooldown=-1), "COOLDOWN"),
+        (dict(canary_timeout=0.0), "CANARY_TIMEOUT"),
+        (dict(canary_timeout=float("inf")), "CANARY_TIMEOUT"),
+        (dict(scale_out_shed=0.0), "SCALE_OUT_SHED"),
+        (dict(scale_in_depth=5.0, scale_out_depth=4.0),
+         "SCALE_IN_DEPTH"),
+        (dict(poll_ms=-1.0), "POLL_MS"),
+    ])
+    def test_bad_knobs_raise(self, kw, needle):
+        with pytest.raises(ValueError, match=needle):
+            self._ctor(**kw)
+
+    def test_env_knob_path(self, monkeypatch):
+        from mxnet_tpu import config
+        config.set_override("MXNET_CTRL_SUSTAIN", 0)
+        try:
+            with pytest.raises(ValueError, match="SUSTAIN"):
+                self._ctor()
+        finally:
+            config.clear_override("MXNET_CTRL_SUSTAIN")
+
+    def test_hooks_must_be_callable(self):
+        router = ServeRouter(poll_ms=0)
+        try:
+            with pytest.raises(ValueError, match="spawn"):
+                FleetController(router, "not-a-hook", poll_ms=0)
+            with pytest.raises(ValueError, match="retire"):
+                FleetController(router, lambda m=None: ("h", 1),
+                                retire="nope", poll_ms=0)
+        finally:
+            router.close()
+
+
+class TestAutoscale:
+    def test_scale_out_on_sustained_depth(self, pred):
+        """Depth over threshold for SUSTAIN consecutive ticks spawns
+        exactly one warmed replica; the streak resets after."""
+        with _CtrlFleet(pred, 1, sustain=2, cooldown=0,
+                        scale_out_depth=4.0, max_replicas=3) as f:
+            c0 = _cval("serve.ctrl.scale_outs")
+            f.engines()["r0"].fake_depth = 8
+            assert f.ctrl.tick()["scaled_out"] == []   # streak 1 of 2
+            out = f.ctrl.tick()                        # sustained
+            assert len(out["scaled_out"]) == 1
+            assert _cval("serve.ctrl.scale_outs") == c0 + 1
+            assert len(f.spawn_log) == 1
+            reps = f.router.replicas()
+            assert len(reps) == 2
+            new = out["scaled_out"][0]
+            # warm-before-admit: the spawned replica came in live AND
+            # already compiled its declared buckets
+            assert reps[new]["state"] == ReplicaState.LIVE
+            assert reps[new]["stats"]["warmed"] == [1, 2, 4]
+            # one infer proves the scaled-out replica actually serves
+            f.router.infer(np.zeros((1, FEAT), np.float32))
+
+    def test_scale_out_on_shed_window(self, pred):
+        """A shedding window scales out even while queues look
+        shallow — sheds mean admission is already failing."""
+        with _CtrlFleet(pred, 1, sustain=1, cooldown=0,
+                        scale_out_shed=2.0, max_replicas=2) as f:
+            eng = f.engines()["r0"]
+            eng.fake_shed = 10
+            f.router.poll_now()            # window baseline
+            assert f.ctrl.tick()["scaled_out"] == []   # delta 0
+            eng.fake_shed = 15             # 5 sheds this window
+            out = f.ctrl.tick()
+            assert len(out["scaled_out"]) == 1
+
+    def test_max_replicas_caps_scale_out(self, pred):
+        with _CtrlFleet(pred, 2, sustain=1, cooldown=0,
+                        max_replicas=2) as f:
+            for eng in f.engines().values():
+                eng.fake_depth = 50
+            for _ in range(3):
+                assert f.ctrl.tick()["scaled_out"] == []
+            assert len(f.router.replicas()) == 2
+
+    def test_scale_in_drains_to_floor(self, pred):
+        """A sustained idle window retires the newest replica through
+        the zero-drop drain, never below MIN_REPLICAS."""
+        with _CtrlFleet(pred, 3, sustain=2, cooldown=0,
+                        min_replicas=2) as f:
+            c0 = _cval("serve.ctrl.scale_ins")
+            f.ctrl.tick()                  # idle streak 1
+            out = f.ctrl.tick()            # sustained -> retire one
+            assert out["scaled_in"] == ["r2"]
+            assert f.retired and f.retired[-1][0] == "r2"
+            assert _cval("serve.ctrl.scale_ins") == c0 + 1
+            # floor: two more sustained-idle ticks must NOT go below 2
+            for _ in range(4):
+                assert f.ctrl.tick()["scaled_in"] == []
+            assert len(f.router.replicas()) == 2
+            f.router.infer(np.zeros((1, FEAT), np.float32))
+
+    def test_flap_suppression(self, pred):
+        """An oscillating signal keeps resetting the streak: no
+        action, ever — and after a real scale-out, cooldown holds
+        further scaling until it expires."""
+        with _CtrlFleet(pred, 1, sustain=2, cooldown=3,
+                        max_replicas=4) as f:
+            eng = f.engines()["r0"]
+            for i in range(8):             # hot, cold, hot, cold ...
+                eng.fake_depth = 8 if i % 2 == 0 else 0
+                out = f.ctrl.tick()
+                assert out["scaled_out"] == []
+                assert out["scaled_in"] == []
+            assert len(f.router.replicas()) == 1
+            # now a SUSTAINED signal: scales once, then cooldown
+            # suppresses the (still hot) signal for 3 ticks
+            eng.fake_depth = 8
+            f.ctrl.tick()                          # streak 1
+            assert len(f.ctrl.tick()["scaled_out"]) == 1   # acts
+            holds = [f.ctrl.tick()["scaled_out"] for _ in range(2)]
+            assert holds == [[], []]               # cooling down
+            # cooldown expired + streak sustained throughout: acts
+            assert len(f.ctrl.tick()["scaled_out"]) == 1
+            assert len(f.router.replicas()) == 3
+
+
+class TestSelfHealing:
+    def test_dead_replica_respawned_same_name(self, pred):
+        """Suspect + probe-confirmed dead -> retired and respawned
+        under the same name; the healed replica serves."""
+        with _CtrlFleet(pred, 2, sustain=99) as f:
+            c0 = _cval("serve.ctrl.heals")
+            f.kill("r1")
+            out = f.ctrl.tick()            # poll marks suspect, probe
+            #                                confirms, heal respawns
+            assert out["healed"] == ["r1"]
+            assert _cval("serve.ctrl.heals") == c0 + 1
+            reps = f.router.replicas()
+            assert reps["r1"]["state"] == ReplicaState.LIVE
+            assert reps["r1"]["stats"]["warmed"] == [1, 2, 4]
+            for _ in range(4):
+                f.router.infer(np.zeros((1, FEAT), np.float32))
+            # a live replica is never healed
+            assert f.ctrl.tick()["healed"] == []
+
+    def test_in_flight_requests_survive_the_death(self, pred):
+        """Requests in flight while a replica dies ride the router's
+        failover/reroute path: a concurrent sweep sees exactly one
+        response per request and zero errors, then the controller
+        heals the corpse."""
+        with _CtrlFleet(pred, 2, sustain=99) as f:
+            x = np.zeros((1, FEAT), np.float32)
+            errors, done = [], []
+
+            def client():
+                for _ in range(10):
+                    try:
+                        f.router.infer(x)
+                        done.append(1)
+                    except Exception as exc:   # noqa: BLE001 — count
+                        errors.append(exc)
+
+            ts = [threading.Thread(target=client) for _ in range(3)]
+            for t in ts:
+                t.start()
+            f.kill("r0")
+            for t in ts:
+                t.join()
+            assert not errors, errors
+            assert len(done) == 30
+            assert f.ctrl.tick()["healed"] == ["r0"]
+            assert f.router.replicas()["r0"]["state"] == \
+                ReplicaState.LIVE
+
+
+class TestRollout:
+    def test_promote_both_replicas(self, pred):
+        """The happy path: every replica recycles onto the new
+        artifact, every gate passes, the fleet ends uniform on the
+        new stamp."""
+        with _CtrlFleet(pred, 2, model_id="v1", sustain=99,
+                        canary_inputs=[np.zeros((1, FEAT),
+                                                np.float32)]) as f:
+            f.artifacts["m2"] = (lambda: f.pred, "v2")
+            c0 = _cval("serve.ctrl.promotes")
+            res = f.ctrl.rollout("m2", model_id="v2")
+            assert not res.rolled_back
+            assert res.promoted == ["r0", "r1"]
+            assert res.manifest == "m2"
+            assert f.ctrl.manifest == "m2"
+            assert _cval("serve.ctrl.promotes") == c0 + 2
+            reps = f.router.replicas()
+            assert {d["model_id"] for d in reps.values()} == {"v2"}
+            # the old processes were retired, the new ones serve
+            f.router.infer(np.zeros((1, FEAT), np.float32))
+
+    def test_canary_failure_rolls_back(self, pred):
+        """A deliberately broken artifact fails the canary on the
+        FIRST replica: it rolls back to the prior manifest, the fleet
+        is uniform on the old stamp, and a concurrent request sweep
+        sees zero errors."""
+        with _CtrlFleet(pred, 2, model_id="v1", sustain=99,
+                        canary_inputs=[np.zeros((1, FEAT),
+                                                np.float32)]) as f:
+            f.artifacts["bad"] = (_Broken, "v2")
+            c0 = _cval("serve.ctrl.rollbacks")
+            x = np.zeros((1, FEAT), np.float32)
+            stop, errors, done = threading.Event(), [], []
+
+            def sweep():
+                while not stop.is_set():
+                    try:
+                        f.router.infer(x)
+                        done.append(1)
+                    except Exception as exc:   # noqa: BLE001 — count
+                        errors.append(exc)
+
+            t = threading.Thread(target=sweep)
+            t.start()
+            try:
+                res = f.ctrl.rollout("bad", model_id="v2")
+            finally:
+                stop.set()
+                t.join()
+            assert res.rolled_back
+            assert "canary failed" in res.reason
+            assert res.manifest is None          # the prior (default)
+            assert f.ctrl.manifest is None
+            assert _cval("serve.ctrl.rollbacks") == c0 + 1
+            reps = f.router.replicas()
+            assert {d["model_id"] for d in reps.values()} == {"v1"}
+            assert not errors, errors
+            assert done                          # the sweep ran
+            f.router.infer(x)
+
+    def test_stamp_mismatch_rolls_back(self, pred):
+        """A spawn hook handing back the WRONG artifact (hello stamp
+        disagrees with the manifest) fails the gate before any canary
+        — exactly the half-promoted state model_id exists to catch."""
+        with _CtrlFleet(pred, 2, model_id="v1", sustain=99) as f:
+            f.artifacts["m2"] = (lambda: f.pred, "v1")   # stale build
+            res = f.ctrl.rollout("m2", model_id="v2")
+            assert res.rolled_back
+            assert "stamp mismatch" in res.reason
+            assert {d["model_id"]
+                    for d in f.router.replicas().values()} == {"v1"}
+
+    def test_gate_failure_on_second_replica_rolls_back_first(
+            self, pred):
+        """A gate that fails mid-fleet rolls back the already-promoted
+        replicas too — never a mixed-version fleet after return."""
+        with _CtrlFleet(pred, 2, model_id="v1", sustain=99,
+                        canary_inputs=[np.zeros((1, FEAT),
+                                                np.float32)]) as f:
+            flaky = iter([lambda: f.pred, _Broken])
+
+            def factory():
+                return next(flaky)()
+            f.artifacts["m2"] = (factory, "v2")
+            c0 = _cval("serve.ctrl.rollbacks")
+            res = f.ctrl.rollout("m2", model_id="v2")
+            assert res.rolled_back
+            # both touched replicas rolled back (r1 failed, r0 was
+            # already promoted)
+            assert _cval("serve.ctrl.rollbacks") == c0 + 2
+            assert {d["model_id"]
+                    for d in f.router.replicas().values()} == {"v1"}
+
+
+class TestJournal:
+    def test_actions_journal_atomically(self, pred, tmp_path):
+        path = str(tmp_path / "ctrl.json")
+        with _CtrlFleet(pred, 1, sustain=1, cooldown=0,
+                        max_replicas=2, journal=path) as f:
+            f.engines()["r0"].fake_depth = 50
+            f.ctrl.tick()
+            with open(path) as fh:
+                doc = json.load(fh)
+            assert doc["version"] == 1
+            assert doc["pending_rollout"] is None
+            assert [a["action"] for a in doc["actions"]] == \
+                ["scale_out"]
+
+    def test_restart_resumes_interrupted_rollout(self, pred,
+                                                 tmp_path):
+        """A controller that dies mid-rollout (spawn hook starts
+        failing hard after the first promote) leaves the pending
+        record in its journal; a NEW controller on the same journal
+        rolls the fleet back to the prior manifest on its first
+        tick instead of re-deciding from scratch."""
+        path = str(tmp_path / "ctrl.json")
+        with _CtrlFleet(pred, 2, model_id="v1", sustain=99,
+                        journal=path) as f:
+            calls = []
+
+            def dying_factory():
+                calls.append(1)
+                if len(calls) > 1:
+                    raise RuntimeError("spawn infrastructure down")
+                return f.pred
+
+            def dead_prior():
+                raise RuntimeError("spawn infrastructure down")
+
+            # promote r0 works, promote r1 dies — and the spawn
+            # infrastructure stays down for the PRIOR artifact too,
+            # so the in-process rollback also fails: exactly the
+            # state a controller crash mid-rollout leaves behind
+            f.artifacts["m2"] = (dying_factory, "v2")
+            good_prior = f.artifacts[None]
+            f.artifacts[None] = (dead_prior, "v1")
+            with pytest.raises(RuntimeError,
+                               match="infrastructure down"):
+                f.ctrl.rollout("m2", model_id="v2")
+            with open(path) as fh:
+                pend = json.load(fh)["pending_rollout"]
+            assert pend is not None
+            assert pend["promoted"] == ["r0"]
+            assert pend["promoting"] == "r1"
+
+            # "restart": a fresh controller over the same journal and
+            # a healed spawn path
+            f.artifacts[None] = good_prior
+            f.artifacts["m2"] = (lambda: f.pred, "v2")
+            f.ctrl.close()
+            c0 = _cval("serve.ctrl.rollbacks")
+            f.ctrl = FleetController(f.router, f.spawn,
+                                     retire=f.retire, journal=path,
+                                     poll_ms=0, sustain=99)
+            out = f.ctrl.tick()
+            assert out["recovered"]
+            assert _cval("serve.ctrl.rollbacks") >= c0 + 1
+            assert f.ctrl.manifest is None       # back on the prior
+            assert {d["model_id"]
+                    for d in f.router.replicas().values()} == {"v1"}
+            with open(path) as fh:
+                assert json.load(fh)["pending_rollout"] is None
+            f.router.infer(np.zeros((1, FEAT), np.float32))
+
+    def test_journal_version_guard(self, pred, tmp_path):
+        path = str(tmp_path / "ctrl.json")
+        with open(path, "w") as fh:
+            json.dump({"version": 999}, fh)
+        router = ServeRouter(poll_ms=0)
+        try:
+            with pytest.raises(ValueError, match="version"):
+                FleetController(router, lambda m=None: ("h", 1),
+                                journal=path, poll_ms=0)
+        finally:
+            router.close()
+
+
+class TestWindowedRates:
+    def test_rates_are_per_window_deltas(self, pred):
+        """shed_rate / req_rate are deltas of the cumulative counters
+        between consecutive polls — and a counter that went BACKWARDS
+        (replica restart) restarts the window instead of reporting a
+        negative rate."""
+        with _CtrlFleet(pred, 1, sustain=99) as f:
+            eng = f.engines()["r0"]
+            eng.fake_shed, eng.fake_admitted = 4, 10
+            f.router.poll_now()
+            st = f.router.replicas()["r0"]["stats"]
+            # the very first poll of this fleet already ran in the
+            # constructor (window exists): this poll sees the full
+            # scripted jump
+            assert st["shed_rate"] == 4
+            f.router.poll_now()                  # no movement
+            st = f.router.replicas()["r0"]["stats"]
+            assert st["shed_rate"] == 0 and st["req_rate"] == 0
+            eng.fake_shed, eng.fake_admitted = 7, 16
+            f.router.poll_now()
+            st = f.router.replicas()["r0"]["stats"]
+            assert st["shed_rate"] == 3 and st["req_rate"] == 6
+            # counter reset: rate = counts since the restart
+            eng.fake_shed, eng.fake_admitted = 1, 2
+            f.router.poll_now()
+            st = f.router.replicas()["r0"]["stats"]
+            assert st["shed_rate"] == 1 and st["req_rate"] == 2
+            # the fleet aggregate carries the summed windowed rates
+            agg = f.router.stats()
+            assert "shed_rate" in agg and "req_rate" in agg
+
+
+class TestModelIdPlumb:
+    def test_export_manifest_carries_stamp(self, pred, tmp_path):
+        prefix = str(tmp_path / "m")
+        manifest = pred.export_buckets(prefix, [(FEAT,)],
+                                       buckets=(1, 2))
+        with open(manifest) as fh:
+            doc = json.load(fh)
+        assert doc["model_id"].startswith("gen-")
+        # content-derived: a re-export of identical weights stamps
+        # identically
+        manifest2 = pred.export_buckets(str(tmp_path / "m2"),
+                                        [(FEAT,)], buckets=(1, 2))
+        with open(manifest2) as fh:
+            assert json.load(fh)["model_id"] == doc["model_id"]
+        # explicit stamp wins
+        pred.export_buckets(str(tmp_path / "m3"), [(FEAT,)],
+                            buckets=(1,), model_id="release-7")
+        with open(str(tmp_path / "m3") + ".serve.json") as fh:
+            assert json.load(fh)["model_id"] == "release-7"
+
+    def test_hello_ships_stamp_and_router_records_it(self, pred,
+                                                     tmp_path):
+        prefix = str(tmp_path / "m")
+        pred.export_buckets(prefix, [(FEAT,)], buckets=(1, 2))
+        eng = ServeEngine.from_export(prefix, max_wait_ms=0.0,
+                                      install_sigterm=False)
+        assert eng.model_id and eng.model_id.startswith("gen-")
+        srv = ServeServer(eng)
+        router = ServeRouter(poll_ms=0)
+        try:
+            router.add_replica(srv.host, srv.port, name="r0")
+            desc = router.replicas()["r0"]
+            assert desc["model_id"] == eng.model_id
+        finally:
+            router.close()
+            srv.close()
+            eng.close()
+
+    def test_in_process_models_report_none(self, pred):
+        """The bugfix's compat half: engines without an export
+        manifest hello model_id None and everything keeps working
+        (duck-typed wire)."""
+        eng = ServeEngine(pred, buckets=(1, 2), max_wait_ms=0.0,
+                          feature_shapes=[(FEAT,)],
+                          install_sigterm=False)
+        srv = ServeServer(eng)
+        router = ServeRouter(poll_ms=0)
+        try:
+            router.add_replica(srv.host, srv.port, name="r0")
+            assert router.replicas()["r0"]["model_id"] is None
+            router.infer(np.zeros((1, FEAT), np.float32))
+        finally:
+            router.close()
+            srv.close()
+            eng.close()
+
+
+class TestRetireReplica:
+    def test_zero_drop_retire_under_load(self, pred):
+        """retire_replica drains like recycle then removes: a sweep
+        running throughout sees one response per request."""
+        with _CtrlFleet(pred, 2, sustain=99) as f:
+            x = np.zeros((1, FEAT), np.float32)
+            errors, done = [], []
+
+            def client():
+                for _ in range(8):
+                    try:
+                        f.router.infer(x)
+                        done.append(1)
+                    except Exception as exc:   # noqa: BLE001 — count
+                        errors.append(exc)
+
+            ts = [threading.Thread(target=client) for _ in range(3)]
+            for t in ts:
+                t.start()
+            f.router.retire_replica("r1")
+            for t in ts:
+                t.join()
+            assert not errors, errors
+            assert len(done) == 24
+            assert list(f.router.replicas()) == ["r0"]
+
+    def test_refuses_last_live_replica(self, pred):
+        with _CtrlFleet(pred, 1, sustain=99) as f:
+            with pytest.raises(ValueError, match="no live replica"):
+                f.router.retire_replica("r0")

@@ -26,6 +26,16 @@ request-completion order, never wall time::
     python tools/chaos_fleet.py --fault-spec kill0@20;kill2@80
     MXNET_FAULT_SPEC=kill2@60 python tools/chaos_fleet.py
     python tools/chaos_fleet.py --smoke             # perf-gate smoke
+    python tools/chaos_fleet.py --controller        # controller tier
+    python tools/chaos_fleet.py --controller --smoke
+
+``--controller`` hands replica lifecycle to the ``FleetController``
+(docs/serving.md §fleet controller): the harness only SIGKILLs —
+the controller's own suspect -> probe -> heal path must respawn the
+victim under the same name (the harness's restart thread is disabled,
+so a controller that fails to heal FAILS the run: heals must equal
+kills). The acceptance contract is unchanged on top: every request
+exactly one response, byte-equal to the fault-free oracle.
 
 ``kill1@40`` SIGKILLs child replica index 1 when the 40th request
 completes; the harness then restarts it (new subprocess, re-admitted
@@ -207,7 +217,7 @@ def _oracle_rows(args, plan):
 def _run(args):
     from mxnet_tpu import telemetry
     from mxnet_tpu.parallel.resilience import FaultInjector
-    from mxnet_tpu.serve import ServeRouter
+    from mxnet_tpu.serve import FleetController, ServeRouter
 
     spec = args.fault_spec or os.environ.get("MXNET_FAULT_SPEC") \
         or args.default_spec
@@ -230,13 +240,38 @@ def _run(args):
     plan = _request_plan(args)
     want = _oracle_rows(args, plan)
 
-    procs, router = [None] * args.replicas, None
+    procs, router, ctrl = [None] * args.replicas, None, None
+    procs_by_addr = {}                    # "host:port" -> proc
     restarts, kills = [], []
     tick_lock = threading.Lock()
     completed = [0]
     results = {k: [] for k in plan}
     stream_toks = {k: [] for k in plan if plan[k]["stream"]}
     failures = []
+
+    def ctrl_spawn(manifest=None):
+        """Controller spawn hook (also boots the initial fleet):
+        one subprocess replica, tracked by address so the retire
+        hook can reap exactly the process behind a fleet slot."""
+        proc, (host, port) = _spawn_replica(args)
+        procs_by_addr["%s:%d" % (host, port)] = proc
+        if router is not None:            # heal/rollout, not boot
+            restarts.append({"at_request": completed[0]})
+        return (host, port)
+
+    def ctrl_retire(name, addr):
+        proc = procs_by_addr.pop(addr, None)
+        if proc is None:
+            return
+        if proc.poll() is None:
+            try:
+                proc.stdin.close()        # EOF = drain + exit
+            except OSError:
+                pass
+        try:
+            proc.wait(15.0)
+        except Exception:  # noqa: BLE001 — escalate to kill
+            proc.kill()
 
     def restart_replica(i, name):
         """Background: boot a fresh child, then swap it in under the
@@ -257,15 +292,24 @@ def _run(args):
             fired = [i for i in kill_points
                      if inj.on_chaos_tick("kill%d" % i)]
             for i in fired:
-                p = procs[i]
+                name = "replica%d" % i
+                if args.controller:
+                    desc = router.replicas().get(name)
+                    p = procs_by_addr.get(
+                        "%s:%d" % (desc["host"], desc["port"])) \
+                        if desc else None
+                else:
+                    p = procs[i]
                 if p is not None and p.poll() is None:
                     p.kill()              # SIGKILL — no goodbye frame
                     p.wait()
                 kills.append({"replica": i,
                               "at_request": completed[0]})
+                if args.controller:
+                    continue              # the CONTROLLER must heal it
                 t = threading.Thread(
                     target=restart_replica,
-                    args=(i, "replica%d" % i), daemon=True)
+                    args=(i, name), daemon=True)
                 t.start()
                 restart_threads.append(t)
 
@@ -293,11 +337,17 @@ def _run(args):
                                                       np.int64))
             on_complete()
 
+    def heals():
+        return int(telemetry.counter("serve.ctrl.heals").value)
+
     restart_threads = []
     t0 = time.monotonic()
     try:
         for i in range(args.replicas):
-            procs[i], addr = _spawn_replica(args)
+            if args.controller:
+                addr = ctrl_spawn()
+            else:
+                procs[i], addr = _spawn_replica(args)
             if i == 0:
                 addrs = []
             addrs.append(addr)
@@ -305,6 +355,15 @@ def _run(args):
                              conns_per_replica=args.clients + 2)
         for i, (host, port) in enumerate(addrs):
             router.add_replica(host, port, name="replica%d" % i)
+        if args.controller:
+            # supervision only — the huge sustain keeps autoscaling
+            # out of the chaos contract, heal is streak-exempt
+            ctrl = FleetController(router, ctrl_spawn,
+                                   retire=ctrl_retire,
+                                   min_replicas=1,
+                                   max_replicas=args.replicas,
+                                   sustain=10 ** 6,
+                                   poll_ms=100.0)
         threads = [threading.Thread(target=client, args=(c,))
                    for c in range(args.clients)]
         for t in threads:
@@ -313,11 +372,20 @@ def _run(args):
             t.join()
         for t in restart_threads:
             t.join(300.0)
+        if ctrl is not None:
+            # the controller owns respawn: hold the fleet open until
+            # its heal count catches the kill schedule (bounded)
+            deadline = time.monotonic() + 300.0
+            while heals() < len(kills) and \
+                    time.monotonic() < deadline:
+                time.sleep(0.1)
         fleet = router.stats()
     finally:
+        if ctrl is not None:
+            ctrl.close()
         if router is not None:
             router.close()
-        _kill_fleet(procs)
+        _kill_fleet(procs + list(procs_by_addr.values()))
     wall = time.monotonic() - t0
 
     mismatches = []
@@ -347,10 +415,13 @@ def _run(args):
 
     ok = not failures and not mismatches and \
         len(kills) == len(kill_points) and \
-        len(restarts) == len(kills)
+        len(restarts) == len(kills) and \
+        (not args.controller or cval("serve.ctrl.heals") == len(kills))
     print(json.dumps({
         "metric": "chaos_fleet",
         "ok": ok,
+        "controller": bool(args.controller),
+        "heals": cval("serve.ctrl.heals") if args.controller else None,
         "requests": args.clients * args.requests,
         "streamed": len(stream_toks),
         "speculative": sum(1 for r in plan.values()
@@ -389,6 +460,10 @@ def main(argv=None):
     p.add_argument("--smoke", action="store_true",
                    help="perf-gate scale: 2 replicas, 2 clients x 3 "
                         "requests, kill1@2")
+    p.add_argument("--controller", action="store_true",
+                   help="controller tier: the FleetController owns "
+                        "respawn (harness restart thread disabled); "
+                        "heals must equal kills")
     p.add_argument("--lm-vocab", type=int, default=50)
     p.add_argument("--lm-dim", type=int, default=32)
     p.add_argument("--lm-layers", type=int, default=2)

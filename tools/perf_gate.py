@@ -659,6 +659,92 @@ def _scn_spec_decode():
                                 telemetry.now_ms() - t0, 3))
 
 
+def _scn_controller():
+    """PR 20 surface: the fleet controller over an in-process
+    2-replica fleet — one forced scale-out on a scripted sustained
+    queue-depth signal, one self-heal of a cold-killed replica, one
+    scale-in back to the floor, and one rollout gated down by a
+    deliberately broken canary artifact (rolled back, zero traffic
+    ever routed to it). Decisions are explicit ``tick()`` calls
+    against scripted stats frames, so every serve.ctrl counter is
+    exact."""
+    import numpy as np
+
+    from mxnet_tpu import telemetry
+    from mxnet_tpu.serve import (FleetController, ServeEngine,
+                                 ServeRouter, ServeServer)
+    t0 = telemetry.now_ms()
+    pred = _serve_predictor()
+    x = np.zeros((1, 8), np.float32)
+
+    class Scripted(ServeEngine):
+        fake_depth = 0
+
+        def introspect(self):
+            out = super().introspect()
+            out["queue_depth"] += self.fake_depth
+            return out
+
+    class Broken:
+        def forward(self, *arrays):
+            raise RuntimeError("deliberately broken artifact")
+
+    cells = {}                  # "host:port" -> (engine, server)
+
+    def spawn(manifest=None):
+        model = Broken() if manifest == "bad" else pred
+        eng = Scripted(model, buckets=(1, 2), max_wait_ms=0.0,
+                       feature_shapes=[(8,)], install_sigterm=False)
+        srv = ServeServer(eng)
+        cells["%s:%d" % (srv.host, srv.port)] = (eng, srv)
+        return (srv.host, srv.port)
+
+    def retire(name, addr):
+        cell = cells.pop(addr, None)
+        if cell is not None:
+            cell[1].close()
+            cell[0].close()
+
+    def script_depth(depth):
+        for eng, _ in cells.values():
+            eng.fake_depth = depth
+
+    router = ServeRouter(poll_ms=0)       # every stats RPC scripted
+    for i in range(2):
+        host, port = spawn(None)
+        router.add_replica(host, port, name="r%d" % i)
+    router.poll_now()
+    ctrl = FleetController(router, spawn, retire=retire, poll_ms=0,
+                           min_replicas=2, max_replicas=3,
+                           sustain=1, cooldown=0, canary_inputs=[x])
+    # 1. scale-out: a sustained (sustain=1) scripted depth signal
+    script_depth(50)
+    assert len(ctrl.tick()["scaled_out"]) == 1
+    script_depth(2)                       # neutral band: no action
+    router.infer(x, timeout=60.0)         # the grown fleet serves
+    # 2. heal: kill r1 cold (no drain); the next tick suspects,
+    # probe-confirms, and respawns it under the same name
+    desc = router.replicas()["r1"]
+    retire("r1", "%s:%d" % (desc["host"], desc["port"]))
+    assert ctrl.tick()["healed"] == ["r1"]
+    # 3. scale-in: an idle window drains the newest replica away
+    script_depth(0)
+    assert len(ctrl.tick()["scaled_in"]) == 1
+    # 4. gated rollback: the broken artifact fails its canary on the
+    # first replica and rolls back — the fleet stays on the prior
+    res = ctrl.rollout("bad", model_id="vBad")
+    assert res.rolled_back, res
+    router.infer(x, timeout=60.0)         # still serving, uniform
+    ctrl.close()
+    router.close()
+    for eng, srv in list(cells.values()):
+        srv.close()
+        eng.close()
+    telemetry.journal_event("gate.probe",
+                            controller_elapsed_ms=round(
+                                telemetry.now_ms() - t0, 3))
+
+
 # which PR-won property each gauge protects is resolved through
 # _PROPERTY_NOTES below; `gauges` lists the gauge names a scenario
 # REQUIRES in the final snapshot (absence is itself a gate failure),
@@ -761,6 +847,14 @@ SCENARIOS = {
         "gauges": ("serve.decode.jit_cache_size",
                    "serve.spec.draft_jit_cache_size",
                    "serve.decode.kv_bytes_per_slot"),
+        "noisy_counters": (), "noisy_events": (),
+    },
+    "controller": {
+        "fn": _scn_controller,
+        "desc": "fleet controller: scripted scale-out, self-heal, "
+                "scale-in, and one canary-gated rollback",
+        "gauges": ("serve.router.replicas_live",
+                   "serve.router.replicas"),
         "noisy_counters": (), "noisy_events": (),
     },
 }
@@ -897,6 +991,11 @@ _PROPERTY_NOTES = (
     ("counts.gauges.serve.router.replicas_live",
      "PR 14 fleet health: every replica is live again after the "
      "recycle (a stuck draining/suspect replica shrinks the fleet)"),
+    ("counts.counters.serve.ctrl.",
+     "PR 20 fleet controller: scale-out/scale-in/heal/promote/"
+     "rollback decisions are exact for a scripted signal sequence — "
+     "a drift means hysteresis, cooldown, the liveness probe, or the "
+     "rollout gate changed semantics"),
     ("counts.counters.serve.shed",
      "PR 9 backpressure: a full queue sheds with the typed "
      "Overloaded, counted exactly"),

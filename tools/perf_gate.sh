@@ -217,11 +217,15 @@ tests_serve() {
         python -m pytest tests/test_serve.py tests/test_serve_decode.py \
         tests/test_serve_router.py tests/test_serve_disagg.py \
         tests/test_serve_failover.py tests/test_serve_streaming.py \
-        tests/test_serve_ssm.py \
+        tests/test_serve_ssm.py tests/test_serve_controller.py \
         -q -m "$marker" -p no:cacheprovider "$@"
     # deterministic chaos harness, smoke tier: 2-replica subprocess
     # fleet, one SIGKILL mid-run, every reply byte-equal to fault-free
     env JAX_PLATFORMS="$PLATFORM" python tools/chaos_fleet.py --smoke
+    # controller tier: the FleetController (not the harness) must
+    # respawn the SIGKILL'd replica — heals == kills, same contract
+    env JAX_PLATFORMS="$PLATFORM" python tools/chaos_fleet.py \
+        --controller --smoke
 }
 
 tests_gate() {

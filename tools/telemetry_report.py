@@ -556,29 +556,36 @@ def format_fleet(rows):
         v = (snap.get(name) or {}).get("value")
         return "-" if v is None else ("%g" % v)
 
-    header = ("| replica | role | queue | in-flight | streams | "
-              "admitted | shed | timeouts | active slots | warmed |")
+    header = ("| replica | role | model | queue | in-flight | streams "
+              "| admitted | shed | shed/s | req/s | timeouts | "
+              "active slots | warmed |")
     lines = ["serve fleet stats (%d target(s))" % len(rows),
              "=" * 46, "", header,
-             "|---|---|---|---|---|---|---|---|---|---|"]
+             "|---|---|---|---|---|---|---|---|---|---|---|---|---|"]
     for addr, stats in rows:
         if not stats:
             lines.append("| %s | unreachable | - | - | - | - | - | - "
-                         "| - | - |" % addr)
+                         "| - | - | - | - | - |" % addr)
             continue
         eng = stats.get("engine") or {}
         snap = stats.get("telemetry") or {}
         warmed = eng.get("warmed")
         lines.append("| %s | %s | %s | %s | %s | %s | %s | %s | %s "
-                     "| %s |"
+                     "| %s | %s | %s | %s |"
                      % (addr,
                         eng.get("role", "engine"),
+                        eng.get("model_id") or "-",
                         eng.get("queue_depth", "-"),
                         eng.get("in_flight", "-"),
                         eng.get("streams_in_flight", "-"),
                         eng.get("admitted", eng.get("dispatched",
                                                     "-")),
                         eng.get("shed", "-"),
+                        # windowed rates (per router poll window):
+                        # router targets aggregate them fleet-wide,
+                        # plain engine targets have no poller -> "-"
+                        eng.get("shed_rate", "-"),
+                        eng.get("req_rate", "-"),
                         eng.get("timeouts", "-"),
                         gauge(snap, "serve.decode.active_slots"),
                         ",".join(str(b) for b in warmed)

@@ -220,6 +220,15 @@ echo "== 4b5. speculative decoding A/B =="
 cap "$OUT/serve_spec.json" serve_spec \
     python bench_serve.py --speculative
 
+echo "== 4b6. fleet-controller load-doubling autoscale =="
+# baseline load, then doubled clients (the FleetController must scale
+# out mid-window on the sustained depth signal), then the doubled
+# load against the grown fleet — acceptance >= 1 scale-out, zero
+# errors, recovered p99 < pressure p99 (docs/serving.md §fleet
+# controller)
+cap "$OUT/serve_controller.json" serve_controller \
+    python bench_serve.py --controller
+
 echo "== 4c. scaling sweep + GSPMD one-jit row =="
 # single chip unless the slice offers more (BENCH_SCALING_DEVICES=1,4,8
 # on a multi-chip window); the gspmd row is the 28.8%->45% MFU
